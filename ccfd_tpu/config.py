@@ -1,0 +1,117 @@
+"""12-factor env-var configuration surface.
+
+Keeps the reference's environment-variable contract verbatim so a user of the
+reference can drop in this framework with the same manifests:
+
+- router vars: reference deploy/router.yaml:54-70 (BROKER_URL, KAFKA_TOPIC,
+  CUSTOMER_NOTIFICATION_TOPIC, CUSTOMER_RESPONSE_TOPIC, KIE_SERVER_URL,
+  SELDON_URL, SELDON_ENDPOINT, FRAUD_THRESHOLD) plus optional SELDON_TOKEN
+  (reference README.md:447-451).
+- KIE-server vars: reference deploy/ccd-service.yaml:54-66 and
+  README.md:370-402 (SELDON_TIMEOUT, SELDON_POOL_SIZE, CONFIDENCE_THRESHOLD).
+- producer vars: reference deploy/kafka/ProducerDeployment.yaml:77-97
+  (topic, s3endpoint, s3bucket, filename, bootstrap).
+- notification var: reference deploy/notification-service.yaml:50-52
+  (BROKER_URL).
+
+TPU-side knobs (CCFD_*) are new: they configure micro-batching, model choice
+and compute dtype for the XLA scorer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # --- bus / topics (reference router.yaml:54-62) ---
+    broker_url: str = "inproc://local"
+    kafka_topic: str = "odh-demo"
+    customer_notification_topic: str = "ccd-customer-outgoing"
+    customer_response_topic: str = "ccd-customer-response"
+
+    # --- service endpoints (reference router.yaml:63-68) ---
+    kie_server_url: str = "inproc://engine"
+    seldon_url: str = "inproc://scorer"
+    # URL path suffix, as in the reference manifests (router.yaml:65-68) —
+    # NOT a model name; model selection is CCFD_MODEL / model_name below.
+    seldon_endpoint: str = "api/v0.1/predictions"
+    seldon_token: str = ""
+
+    # --- decision thresholds (reference router.yaml:69-70, README.md:395-402) ---
+    fraud_threshold: float = 0.5
+    confidence_threshold: float = 1.0
+
+    # --- HTTP client knobs (reference README.md:386-393) ---
+    seldon_timeout_ms: int = 5000
+    seldon_pool_size: int = 5
+
+    # --- producer (reference ProducerDeployment.yaml:88-97) ---
+    producer_topic: str = "odh-demo"
+    s3_endpoint: str = ""
+    s3_bucket: str = "ccdata"
+    filename: str = "creditcard.csv"
+    bootstrap: str = "odh-message-bus-kafka-brokers:9092"
+
+    # --- process engine (reference README.md:554-605 semantics) ---
+    customer_reply_timeout_s: float = 30.0
+    low_amount_threshold: float = 200.0
+    low_proba_threshold: float = 0.75
+
+    # --- TPU scorer knobs (new) ---
+    model_name: str = "mlp"
+    compute_dtype: str = "bfloat16"
+    batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384)
+    batch_deadline_ms: float = 2.0
+    serve_host: str = "0.0.0.0"
+    serve_port: int = 8000
+
+    @staticmethod
+    def from_env(env: Mapping[str, str] | None = None) -> "Config":
+        e = dict(os.environ if env is None else env)
+        sizes = e.get("CCFD_BATCH_SIZES", "")
+        return Config(
+            broker_url=e.get("BROKER_URL", Config.broker_url),
+            kafka_topic=e.get("KAFKA_TOPIC", Config.kafka_topic),
+            customer_notification_topic=e.get(
+                "CUSTOMER_NOTIFICATION_TOPIC", Config.customer_notification_topic
+            ),
+            customer_response_topic=e.get(
+                "CUSTOMER_RESPONSE_TOPIC", Config.customer_response_topic
+            ),
+            kie_server_url=e.get("KIE_SERVER_URL", Config.kie_server_url),
+            seldon_url=e.get("SELDON_URL", Config.seldon_url),
+            seldon_endpoint=e.get("SELDON_ENDPOINT", Config.seldon_endpoint),
+            seldon_token=e.get("SELDON_TOKEN", Config.seldon_token),
+            fraud_threshold=float(e.get("FRAUD_THRESHOLD", str(Config.fraud_threshold))),
+            confidence_threshold=float(
+                e.get("CONFIDENCE_THRESHOLD", str(Config.confidence_threshold))
+            ),
+            seldon_timeout_ms=int(e.get("SELDON_TIMEOUT", str(Config.seldon_timeout_ms))),
+            seldon_pool_size=int(e.get("SELDON_POOL_SIZE", str(Config.seldon_pool_size))),
+            producer_topic=e.get("topic", Config.producer_topic),
+            s3_endpoint=e.get("s3endpoint", Config.s3_endpoint),
+            s3_bucket=e.get("s3bucket", Config.s3_bucket),
+            filename=e.get("filename", Config.filename),
+            bootstrap=e.get("bootstrap", Config.bootstrap),
+            customer_reply_timeout_s=float(
+                e.get("CCFD_REPLY_TIMEOUT_S", str(Config.customer_reply_timeout_s))
+            ),
+            low_amount_threshold=float(
+                e.get("CCFD_LOW_AMOUNT", str(Config.low_amount_threshold))
+            ),
+            low_proba_threshold=float(
+                e.get("CCFD_LOW_PROBA", str(Config.low_proba_threshold))
+            ),
+            model_name=e.get("CCFD_MODEL", Config.model_name),
+            compute_dtype=e.get("CCFD_DTYPE", Config.compute_dtype),
+            batch_sizes=tuple(int(s) for s in sizes.split(",")) if sizes else Config.batch_sizes,
+            batch_deadline_ms=float(
+                e.get("CCFD_BATCH_DEADLINE_MS", str(Config.batch_deadline_ms))
+            ),
+            serve_host=e.get("CCFD_SERVE_HOST", Config.serve_host),
+            serve_port=int(e.get("CCFD_SERVE_PORT", str(Config.serve_port))),
+        )

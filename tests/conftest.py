@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the CI strategy in SURVEY.md §4: multi-chip sharding logic is
+exercised on `--xla_force_host_platform_device_count=8` CPU devices; real-TPU
+runs happen in bench.py / the driver's dryrun, not in unit tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def dataset():
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+
+    return synthetic_dataset(n=4000, fraud_rate=0.05, seed=0)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

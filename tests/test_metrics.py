@@ -1,0 +1,78 @@
+"""Prometheus registry: counters/gauges/histograms + text exposition."""
+
+import math
+
+import pytest
+
+from ccfd_tpu.metrics.prom import AMOUNT_BUCKETS, Counter, Histogram, Registry
+
+
+def test_counter_labels():
+    reg = Registry()
+    c = reg.counter("transaction_outgoing_total")
+    c.inc(labels={"type": "standard"})
+    c.inc(2, labels={"type": "fraud"})
+    assert c.value({"type": "standard"}) == 1
+    assert c.value({"type": "fraud"}) == 2
+    text = reg.render()
+    assert 'transaction_outgoing_total{type="fraud"} 2.0' in text
+    assert "# TYPE transaction_outgoing_total counter" in text
+
+
+def test_counter_monotonic():
+    c = Counter("x")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_buckets_and_quantile():
+    h = Histogram("fraud_investigation_amount", buckets=AMOUNT_BUCKETS)
+    for v in [10, 20, 30, 40, 5000, 20000]:
+        h.observe(v)
+    assert h.count() == 6
+    assert h.sum() == 25100
+    q50 = h.quantile(0.5)
+    assert 10 <= q50 <= 50
+    lines = "\n".join(h.render())
+    assert 'le="+Inf"' in lines and "_sum" in lines and "_count" in lines
+
+
+def test_histogram_inf_bucket_always_added():
+    h = Histogram("t", buckets=(1.0, 2.0))
+    assert h.buckets[-1] == math.inf
+
+
+def test_registry_type_conflict():
+    reg = Registry()
+    reg.counter("a")
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+
+
+def test_gauge_set_and_render():
+    reg = Registry()
+    g = reg.gauge("proba_1")
+    g.set(0.75)
+    assert "proba_1 0.75" in reg.render()
+
+
+def test_label_escaping():
+    reg = Registry()
+    c = reg.counter("n")
+    c.inc(labels={"response": 'he said "no"\nok\\'})
+    text = reg.render()
+    assert 'he said \\"no\\"\\nok\\\\' in text
+
+
+def test_config_from_env_roundtrip():
+    from ccfd_tpu.config import Config
+
+    cfg = Config.from_env({})
+    assert cfg.fraud_threshold == 0.5 and cfg.kafka_topic == "odh-demo"
+    cfg2 = Config.from_env(
+        {"CUSTOMER_NOTIFICATION_TOPIC": "out", "CUSTOMER_RESPONSE_TOPIC": "in",
+         "CCFD_BATCH_SIZES": "8,64"}
+    )
+    assert cfg2.customer_notification_topic == "out"
+    assert cfg2.customer_response_topic == "in"
+    assert cfg2.batch_sizes == (8, 64)

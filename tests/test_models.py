@@ -1,0 +1,122 @@
+"""Scorer math: JAX models vs sklearn/numpy references (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccfd_tpu.data.ccfd import NUM_FEATURES, synthetic_dataset
+from ccfd_tpu.models import logreg, mlp, trees
+from ccfd_tpu.models.registry import get_model
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.ensemble import GradientBoostingClassifier  # noqa: E402
+from sklearn.linear_model import LogisticRegression  # noqa: E402
+from sklearn.preprocessing import StandardScaler  # noqa: E402
+
+
+def test_dataset_shape(dataset):
+    assert dataset.X.shape == (4000, NUM_FEATURES)
+    assert set(np.unique(dataset.y)) <= {0, 1}
+    assert 0.01 < dataset.y.mean() < 0.2
+
+
+def test_logreg_sklearn_parity(dataset):
+    scaler = StandardScaler().fit(dataset.X)
+    clf = LogisticRegression(max_iter=500).fit(scaler.transform(dataset.X), dataset.y)
+    params = logreg.from_sklearn(clf, scaler)
+    ours = np.asarray(logreg.apply(params, jnp.asarray(dataset.X)))
+    ref = clf.predict_proba(scaler.transform(dataset.X))[:, 1]
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_logreg_fit_numpy_matches_sklearn(dataset):
+    params = logreg.fit_numpy(dataset.X, dataset.y)
+    scaler = StandardScaler().fit(dataset.X)
+    clf = LogisticRegression(max_iter=1000, C=1.0).fit(
+        scaler.transform(dataset.X), dataset.y
+    )
+    ref_params = logreg.from_sklearn(clf, scaler)
+    ours = np.asarray(logreg.apply(params, jnp.asarray(dataset.X)))
+    ref = np.asarray(logreg.apply(ref_params, jnp.asarray(dataset.X)))
+    # Same regularized objective -> probabilities agree closely.
+    assert np.abs(ours - ref).max() < 0.02
+
+
+def test_gbt_sklearn_parity(dataset):
+    clf = GradientBoostingClassifier(
+        n_estimators=20, max_depth=3, random_state=0
+    ).fit(dataset.X, dataset.y)
+    params = trees.from_sklearn_gbt(clf)
+    ours = np.asarray(trees.apply(params, jnp.asarray(dataset.X)))
+    ref = clf.predict_proba(dataset.X)[:, 1]
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gbt_unbalanced_tree_embedding():
+    # Hand-built unbalanced stump-in-depth-2: root splits f0@0.5; left child is
+    # a leaf (v=-1), right child splits f1@0.0 into leaves +1 / +3.
+    children_left = np.array([1, -1, 3, -1, -1])
+    children_right = np.array([2, -1, 4, -1, -1])
+    feature = np.array([0, -2, 1, -2, -2])
+    threshold = np.array([0.5, -2.0, 0.0, -2.0, -2.0])
+    value = np.array([0.0, -1.0, 0.0, 1.0, 3.0])
+    f, t, leaves = trees._embed_tree(
+        children_left, children_right, feature, threshold, value, depth=2, scale=1.0
+    )
+    params = {
+        "feature": jnp.asarray(f[None]),
+        "threshold": jnp.asarray(t[None]),
+        "leaf": jnp.asarray(leaves[None]),
+        "base": jnp.asarray(0.0, jnp.float32),
+    }
+    x = jnp.asarray(
+        [[0.0, 9.9], [1.0, -1.0], [1.0, 1.0]], jnp.float32
+    )
+    out = np.asarray(trees.logits(params, x))
+    np.testing.assert_allclose(out, [-1.0, 1.0, 3.0])
+
+
+def test_mlp_learns_synthetic():
+    ds = synthetic_dataset(n=3000, fraud_rate=0.3, seed=1)
+    key = jax.random.PRNGKey(0)
+    params = mlp.init(key, hidden=128)
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+
+    x, y = jnp.asarray(ds.X), jnp.asarray(ds.y)
+    grad_fn = jax.jit(jax.grad(lambda p: mlp.loss_fn(p, x, y, compute_dtype=jnp.float32)))
+
+    lr = 0.05
+    for _ in range(60):
+        g = grad_fn(params)
+        params = jax.tree.map(lambda p, gi: p - lr * gi, params, g)
+    proba = np.asarray(mlp.apply(params, x, compute_dtype=jnp.float32))
+    acc = float(((proba > 0.5) == (np.asarray(ds.y) > 0.5)).mean())
+    assert acc > 0.9, f"MLP failed to learn separable synthetic data: acc={acc}"
+
+
+def test_mlp_bf16_close_to_f32():
+    ds = synthetic_dataset(n=512, seed=2)
+    params = mlp.init(jax.random.PRNGKey(1))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    x = jnp.asarray(ds.X)
+    p32 = np.asarray(mlp.apply(params, x, compute_dtype=jnp.float32))
+    p16 = np.asarray(mlp.apply(params, x, compute_dtype=jnp.bfloat16))
+    assert np.abs(p32 - p16).max() < 0.03
+
+
+def test_registry_lookup():
+    spec = get_model("modelfull")
+    params = spec.init(jax.random.PRNGKey(0))
+    out = spec.apply(params, jnp.zeros((4, NUM_FEATURES)))
+    assert out.shape == (4,)
+    with pytest.raises(KeyError):
+        get_model("nope")
+
+
+def test_models_jit_static_shapes():
+    """All scorers trace once per batch shape (no data-dependent control flow)."""
+    params = mlp.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((8, NUM_FEATURES))
+    lowered = jax.jit(lambda p, xx: mlp.apply(p, xx)).lower(params, x)
+    assert "while" not in lowered.as_text().lower()
