@@ -1,0 +1,220 @@
+"""In-process message bus with Kafka-shaped semantics.
+
+The reference's transport is a Strimzi Kafka cluster with 3 brokers and the
+topics ``odh-demo``, ``ccd-customer-outgoing``, ``ccd-customer-response``
+(reference deploy/frauddetection_cr.yaml:73-77, deploy/router.yaml:55-62).
+This module provides the same *semantics* — partitioned topics, keyed
+partitioning, consumer groups with per-group committed offsets, blocking
+polls — as a zero-dependency in-process broker, so every component of the
+framework is written against a Kafka-shaped API and can swap in a real
+``kafka-python`` client via the same interface when a cluster exists
+(see ``KafkaAdapter`` stub at the bottom).
+
+Semantics kept faithful to Kafka:
+- total order *within* a partition, none across partitions;
+- hash(key) % n_partitions routing, round-robin for keyless records;
+- consumer groups: each partition is owned by exactly one live member;
+  offsets are committed per (group, topic, partition) and survive consumer
+  close/reopen (resume-from-offset is the reference's de-facto recovery
+  mechanism, SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+
+@dataclass(frozen=True)
+class Record:
+    topic: str
+    partition: int
+    offset: int
+    key: Any
+    value: Any
+    timestamp: float
+
+
+class _Topic:
+    def __init__(self, name: str, n_partitions: int):
+        self.name = name
+        self.partitions: list[list[Record]] = [[] for _ in range(n_partitions)]
+        self._rr = itertools.count()
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.partitions)
+
+    def route(self, key: Any) -> int:
+        if key is None:
+            return next(self._rr) % self.n_partitions
+        return hash(key) % self.n_partitions
+
+
+class Broker:
+    """Thread-safe in-process broker. One instance == one cluster."""
+
+    def __init__(self, default_partitions: int = 3):
+        self._default_partitions = default_partitions
+        self._topics: dict[str, _Topic] = {}
+        self._groups: dict[str, dict[tuple[str, int], int]] = {}  # group -> {(t,p): offset}
+        self._members: dict[str, list["Consumer"]] = {}
+        self._lock = threading.Lock()
+        self._data_ready = threading.Condition(self._lock)
+
+    # -- admin ------------------------------------------------------------
+    def create_topic(self, name: str, n_partitions: int | None = None) -> None:
+        with self._lock:
+            if name not in self._topics:
+                self._topics[name] = _Topic(
+                    name, n_partitions or self._default_partitions
+                )
+
+    def _topic(self, name: str) -> _Topic:
+        t = self._topics.get(name)
+        if t is None:
+            self._topics[name] = t = _Topic(name, self._default_partitions)
+        return t
+
+    def end_offsets(self, topic: str) -> list[int]:
+        with self._lock:
+            return [len(p) for p in self._topic(topic).partitions]
+
+    # -- produce ----------------------------------------------------------
+    def produce(self, topic: str, value: Any, key: Any = None) -> Record:
+        with self._lock:
+            t = self._topic(topic)
+            part = t.route(key)
+            rec = Record(
+                topic=topic,
+                partition=part,
+                offset=len(t.partitions[part]),
+                key=key,
+                value=value,
+                timestamp=time.time(),
+            )
+            t.partitions[part].append(rec)
+            self._data_ready.notify_all()
+            return rec
+
+    # -- consume ----------------------------------------------------------
+    def consumer(self, group_id: str, topics: Iterable[str]) -> "Consumer":
+        with self._lock:
+            for t in topics:
+                self._topic(t)
+            c = Consumer(self, group_id, tuple(topics))
+            self._members.setdefault(group_id, []).append(c)
+            self._rebalance(group_id)
+            return c
+
+    def _close(self, consumer: "Consumer") -> None:
+        with self._lock:
+            members = self._members.get(consumer.group_id, [])
+            if consumer in members:
+                members.remove(consumer)
+                self._rebalance(consumer.group_id)
+
+    def _rebalance(self, group_id: str) -> None:
+        """Round-robin partition assignment over live group members."""
+        members = self._members.get(group_id, [])
+        if not members:
+            return
+        all_parts: list[tuple[str, int]] = []
+        topics = sorted({t for m in members for t in m.topics})
+        for tname in topics:
+            t = self._topic(tname)
+            all_parts.extend((tname, p) for p in range(t.n_partitions))
+        for m in members:
+            m._assignment = []
+        for i, tp in enumerate(all_parts):
+            owner = members[i % len(members)]
+            if tp[0] in owner.topics:
+                owner._assignment.append(tp)
+            else:  # partition of a topic this member didn't subscribe to
+                for m in members:
+                    if tp[0] in m.topics:
+                        m._assignment.append(tp)
+                        break
+
+    def _committed(self, group_id: str, tp: tuple[str, int]) -> int:
+        return self._groups.setdefault(group_id, {}).get(tp, 0)
+
+    def _commit(self, group_id: str, tp: tuple[str, int], offset: int) -> None:
+        g = self._groups.setdefault(group_id, {})
+        if offset > g.get(tp, 0):
+            g[tp] = offset
+
+    def _fetch(
+        self, consumer: "Consumer", max_records: int
+    ) -> list[Record]:
+        out: list[Record] = []
+        for tname, p in consumer._assignment:
+            if len(out) >= max_records:
+                break
+            t = self._topic(tname)
+            start = self._committed(consumer.group_id, (tname, p))
+            log = t.partitions[p]
+            take = log[start : start + (max_records - len(out))]
+            if take:
+                out.extend(take)
+                self._commit(consumer.group_id, (tname, p), start + len(take))
+        return out
+
+
+class Consumer:
+    """Poll-based consumer. Offsets auto-commit on poll (at-most-once hand-off
+    inside one process; the in-process broker never loses the log, so replay
+    is available by resetting the group offset)."""
+
+    def __init__(self, broker: Broker, group_id: str, topics: tuple[str, ...]):
+        self._broker = broker
+        self.group_id = group_id
+        self.topics = topics
+        self._assignment: list[tuple[str, int]] = []
+        self._closed = False
+
+    def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[Record]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._broker._lock:
+                if self._closed:
+                    return []
+                recs = self._broker._fetch(self, max_records)
+                if recs:
+                    return recs
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._broker._data_ready.wait(timeout=min(remaining, 0.05))
+
+    def close(self) -> None:
+        self._closed = True
+        self._broker._close(self)
+
+    def __enter__(self) -> "Consumer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class KafkaAdapter:  # pragma: no cover - requires a real cluster
+    """Same interface backed by ``kafka-python``, when available.
+
+    Instantiate with a bootstrap string (reference
+    deploy/kafka/ProducerDeployment.yaml:96-97). Kept as a thin seam so the
+    in-process broker and a real cluster are interchangeable.
+    """
+
+    def __init__(self, bootstrap: str):
+        try:
+            import kafka  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "kafka-python is not installed; use the in-process Broker"
+            ) from e
+        self.bootstrap = bootstrap
+        raise NotImplementedError("real-cluster adapter lands with deployment support")

@@ -1,0 +1,309 @@
+"""Business-process engine: the jBPM/KIE-server capability, TPU-framework native.
+
+The reference runs fraud/standard processes on a KIE execution server
+(reference deploy/ccd-service.yaml:1-124; semantics README.md:583-605 and
+docs/process-fraud.png): a customer-notification node, a no-reply timer
+racing a customer-response signal, a DMN decision over amount+probability,
+a user task for human investigators, and a Seldon-backed prediction service
+that auto-completes user tasks at high confidence
+(``-Dorg.jbpm.task.prediction.service=SeldonPredictionService``,
+ccd-service.yaml:65-66; confidence semantics README.md:571-581).
+
+This engine re-creates those semantics as an explicit state machine:
+
+- A ``ProcessDefinition`` is a named graph of nodes; node kinds are
+  ``ServiceNode`` (run a function, move on), ``EventNode`` (wait for a
+  signal OR a timer — whichever fires first wins, atomically),
+  ``UserTaskNode`` (open a human task, consult the prediction service),
+  and ``EndNode``.
+- The signal-vs-timer race is resolved under one engine lock with a
+  per-wait generation counter: the first of {matching signal, timer with
+  matching generation} consumes the wait; the loser is a no-op.
+- The prediction service hook mirrors jBPM's: confidence >=
+  ``confidence_threshold`` auto-completes the task with the predicted
+  outcome; below it, the prediction is only pre-filled as
+  ``task.suggested_outcome`` (README.md:580-581).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Protocol
+
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.clock import Clock, RealClock, TimerHandle
+
+# ---------------------------------------------------------------------------
+# Nodes
+
+
+@dataclass(frozen=True)
+class ServiceNode:
+    name: str
+    fn: Callable[["Engine", "Instance"], None]
+    next: str
+
+
+@dataclass(frozen=True)
+class EventNode:
+    """Wait for ``signal`` or a timer of ``timeout_s`` — first one wins."""
+
+    name: str
+    signal: str
+    timeout_s: float | Callable[["Instance"], float]
+    on_signal: str
+    on_timeout: str
+
+
+@dataclass(frozen=True)
+class UserTaskNode:
+    name: str
+    task_name: str
+    next: str  # node run after completion; outcome in vars["task_outcome"]
+
+
+@dataclass(frozen=True)
+class GatewayNode:
+    """Exclusive (XOR) gateway: choose() names the next node."""
+
+    name: str
+    choose: Callable[["Engine", "Instance"], str]
+
+
+@dataclass(frozen=True)
+class EndNode:
+    name: str
+    status: str = "completed"
+
+
+Node = ServiceNode | EventNode | GatewayNode | UserTaskNode | EndNode
+
+
+@dataclass(frozen=True)
+class ProcessDefinition:
+    id: str
+    start: str
+    nodes: Mapping[str, Node]
+
+    def __post_init__(self) -> None:
+        for n in self.nodes.values():
+            targets = [
+                t
+                for t in (
+                    getattr(n, "next", None),
+                    getattr(n, "on_signal", None),
+                    getattr(n, "on_timeout", None),
+                )
+                if t is not None
+            ]
+            for t in targets:
+                if t not in self.nodes:
+                    raise ValueError(f"{self.id}:{n.name} -> unknown node {t!r}")
+        if self.start not in self.nodes:
+            raise ValueError(f"{self.id}: unknown start node {self.start!r}")
+
+
+# ---------------------------------------------------------------------------
+# Runtime state
+
+
+@dataclass
+class Instance:
+    pid: int
+    definition: ProcessDefinition
+    vars: dict[str, Any]
+    status: str = "active"  # active | completed | aborted
+    node: str = ""
+    wait_signal: str | None = None
+    wait_gen: int = 0
+    timer: TimerHandle | None = None
+    history: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Task:
+    task_id: int
+    pid: int
+    name: str
+    vars: dict[str, Any]
+    status: str = "open"  # open | completed
+    suggested_outcome: Any = None
+    prediction_confidence: float | None = None
+    outcome: Any = None
+
+
+class PredictionService(Protocol):
+    """jBPM prediction-service shape: predict a user-task outcome."""
+
+    def predict(self, task: Task) -> tuple[Any, float]: ...
+
+
+# ---------------------------------------------------------------------------
+# Engine
+
+
+class Engine:
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        registry: Registry | None = None,
+        prediction_service: PredictionService | None = None,
+        confidence_threshold: float = 1.0,
+    ):
+        self.clock: Clock = clock or RealClock()
+        self.registry = registry or Registry()
+        self.prediction_service = prediction_service
+        self.confidence_threshold = confidence_threshold
+        self._definitions: dict[str, ProcessDefinition] = {}
+        self._instances: dict[int, Instance] = {}
+        self._tasks: dict[int, Task] = {}
+        self._pid = itertools.count(1)
+        self._tid = itertools.count(1)
+        self._lock = threading.RLock()
+        self._started = self.registry.counter(
+            "process_instances_started_total", "process starts by definition"
+        )
+        self._completed = self.registry.counter(
+            "process_instances_completed_total", "process completions by status"
+        )
+
+    # -- definitions ------------------------------------------------------
+    def register(self, definition: ProcessDefinition) -> None:
+        self._definitions[definition.id] = definition
+
+    # -- public API (KIE-server-shaped: start / signal / tasks) -----------
+    def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
+        with self._lock:
+            d = self._definitions[def_id]
+            inst = Instance(pid=next(self._pid), definition=d, vars=dict(variables))
+            self._instances[inst.pid] = inst
+            self._started.inc(labels={"process": def_id})
+            self._run_from(inst, d.start)
+            return inst.pid
+
+    def signal(self, pid: int, name: str, payload: Any = None) -> bool:
+        """Deliver a signal; returns True iff it was consumed by a wait."""
+        with self._lock:
+            inst = self._instances.get(pid)
+            if inst is None or inst.status != "active" or inst.wait_signal != name:
+                return False
+            node = inst.definition.nodes[inst.node]
+            assert isinstance(node, EventNode)
+            self._consume_wait(inst)
+            inst.vars["signal_payload"] = payload
+            self._run_from(inst, node.on_signal)
+            return True
+
+    def instance(self, pid: int) -> Instance:
+        with self._lock:
+            return self._instances[pid]
+
+    def instances(self, status: str | None = None) -> list[Instance]:
+        with self._lock:
+            return [
+                i
+                for i in self._instances.values()
+                if status is None or i.status == status
+            ]
+
+    def tasks(self, status: str = "open") -> list[Task]:
+        with self._lock:
+            return [t for t in self._tasks.values() if t.status == status]
+
+    def task(self, task_id: int) -> Task:
+        with self._lock:
+            return self._tasks[task_id]
+
+    def complete_task(self, task_id: int, outcome: Any) -> None:
+        with self._lock:
+            t = self._tasks[task_id]
+            if t.status != "open":
+                raise ValueError(f"task {task_id} already {t.status}")
+            t.status = "completed"
+            t.outcome = outcome
+            inst = self._instances[t.pid]
+            node = inst.definition.nodes[inst.node]
+            assert isinstance(node, UserTaskNode)
+            inst.vars["task_outcome"] = outcome
+            self._run_from(inst, node.next)
+
+    # -- internals --------------------------------------------------------
+    def _consume_wait(self, inst: Instance) -> None:
+        inst.wait_signal = None
+        inst.wait_gen += 1
+        if inst.timer is not None:
+            inst.timer.cancel()
+            inst.timer = None
+
+    def _timer_fired(self, pid: int, gen: int) -> None:
+        with self._lock:
+            inst = self._instances.get(pid)
+            if (
+                inst is None
+                or inst.status != "active"
+                or inst.wait_signal is None
+                or inst.wait_gen != gen
+            ):
+                return  # a signal won the race; timer is a no-op
+            node = inst.definition.nodes[inst.node]
+            assert isinstance(node, EventNode)
+            self._consume_wait(inst)
+            self._run_from(inst, node.on_timeout)
+
+    def _run_from(self, inst: Instance, node_name: str) -> None:
+        """Advance the instance until it blocks (event/user task) or ends."""
+        while True:
+            node = inst.definition.nodes[node_name]
+            inst.node = node_name
+            inst.history.append(node_name)
+            if isinstance(node, ServiceNode):
+                node.fn(self, inst)
+                node_name = node.next
+            elif isinstance(node, GatewayNode):
+                node_name = node.choose(self, inst)
+                if node_name not in inst.definition.nodes:
+                    raise ValueError(
+                        f"{inst.definition.id}:{node.name} chose unknown node "
+                        f"{node_name!r}"
+                    )
+            elif isinstance(node, EventNode):
+                timeout = (
+                    node.timeout_s(inst) if callable(node.timeout_s) else node.timeout_s
+                )
+                inst.wait_signal = node.signal
+                gen = inst.wait_gen
+                inst.timer = self.clock.call_later(
+                    timeout, lambda pid=inst.pid, g=gen: self._timer_fired(pid, g)
+                )
+                return
+            elif isinstance(node, UserTaskNode):
+                task = Task(
+                    task_id=next(self._tid),
+                    pid=inst.pid,
+                    name=node.task_name,
+                    vars=dict(inst.vars),
+                )
+                self._tasks[task.task_id] = task
+                if self.prediction_service is not None:
+                    outcome, confidence = self.prediction_service.predict(task)
+                    task.prediction_confidence = confidence
+                    if confidence >= self.confidence_threshold:
+                        # jBPM semantics: auto-close the task (README.md:580)
+                        task.status = "completed"
+                        task.outcome = outcome
+                        inst.vars["task_outcome"] = outcome
+                        inst.vars["task_auto_completed"] = True
+                        node_name = node.next
+                        continue
+                    task.suggested_outcome = outcome  # pre-fill only (README.md:581)
+                return
+            elif isinstance(node, EndNode):
+                inst.status = node.status
+                self._completed.inc(
+                    labels={"process": inst.definition.id, "status": node.status}
+                )
+                return
+            else:  # pragma: no cover
+                raise TypeError(f"unknown node type {type(node)}")

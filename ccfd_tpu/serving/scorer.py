@@ -1,0 +1,111 @@
+"""Compiled TPU scorer: fixed-shape bucketed dispatch + hot-swappable params.
+
+This replaces the reference's Seldon-wrapped CPU model container
+(reference deploy/model/modelfull.json:18-52) as the prediction hop. Design
+follows the latency plan in SURVEY.md §7 "hard parts":
+
+- **Fixed batch shapes.** XLA compiles one executable per input shape; a
+  streaming workload with ragged batch sizes would re-trace constantly. The
+  scorer pads every request batch up to a configured bucket
+  (CCFD_BATCH_SIZES) so steady state reuses a handful of cached executables.
+- **Warmup.** ``warmup()`` runs every bucket once so no request pays the
+  compile cost.
+- **Double-buffered params.** Online retrain (BASELINE.json configs[4])
+  must not pause serving: ``swap_params`` device-puts the new pytree and
+  swaps a reference atomically between dispatches — in-flight calls keep the
+  old buffers alive, the next call picks up the new ones.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccfd_tpu.data.ccfd import NUM_FEATURES
+from ccfd_tpu.models.registry import ModelSpec, get_model
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+class Scorer:
+    def __init__(
+        self,
+        model_name: str = "mlp",
+        params: Any = None,
+        batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384),
+        compute_dtype: str = "bfloat16",
+        num_features: int = NUM_FEATURES,
+        seed: int = 0,
+    ):
+        self.spec: ModelSpec = get_model(model_name)
+        self.batch_sizes = tuple(sorted(batch_sizes))
+        self.num_features = num_features
+        self._params = params if params is not None else self.spec.init(
+            jax.random.PRNGKey(seed)
+        )
+        self._params = jax.device_put(self._params)
+        self._lock = threading.Lock()
+        dtype = _DTYPES.get(compute_dtype, jnp.float32)
+        # models without a dtype knob (e.g. trees) take (params, x) only
+        import inspect
+
+        sig = inspect.signature(self.spec.apply)
+        if "compute_dtype" in sig.parameters:
+            self._apply = lambda p, x: self.spec.apply(p, x, compute_dtype=dtype)
+        else:
+            self._apply = self.spec.apply
+
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    def bucket(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return self.batch_sizes[-1]
+
+    def warmup(self) -> None:
+        for b in self.batch_sizes:
+            jax.block_until_ready(
+                self._apply(self._params, jnp.zeros((b, self.num_features)))
+            )
+
+    def swap_params(self, new_params: Any) -> None:
+        """Atomically publish retrained params without pausing serving."""
+        staged = jax.device_put(new_params)
+        jax.block_until_ready(staged)
+        with self._lock:
+            self._params = staged
+
+    def score(self, x: np.ndarray) -> np.ndarray:
+        """(n, F) float32 -> (n,) float32 proba_1, padding to a shape bucket."""
+        x = np.asarray(x, dtype=np.float32)
+        n = x.shape[0]
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        chunks: list[np.ndarray] = []
+        with self._lock:
+            params = self._params
+        start = 0
+        largest = self.batch_sizes[-1]
+        while start < n:
+            take = min(n - start, largest)
+            b = self.bucket(take)
+            chunk = x[start : start + take]
+            if take < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
+                )
+            out = np.asarray(self._apply(params, jnp.asarray(chunk)))[:take]
+            chunks.append(out)
+            start += take
+        return np.concatenate(chunks).astype(np.float32)

@@ -7,12 +7,20 @@ runs happen in bench.py / the driver's dryrun, not in unit tests.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may preset axon/tpu
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The axon (TPU-tunnel) plugin's site hook force-updates jax_platforms to
+# "axon" at interpreter start, overriding the env var above; tests must run
+# hermetically on virtual CPU devices, so override it back before any
+# backend initializes (dialing the tunnel from tests is slow and flaky).
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

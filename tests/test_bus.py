@@ -1,0 +1,71 @@
+"""In-process broker: Kafka semantics (partitions, groups, offsets)."""
+
+import threading
+
+from ccfd_tpu.bus.broker import Broker
+
+
+def test_produce_consume_roundtrip():
+    b = Broker()
+    b.produce("t", {"a": 1}, key="k")
+    c = b.consumer("g", ("t",))
+    recs = c.poll(10)
+    assert len(recs) == 1 and recs[0].value == {"a": 1}
+    assert c.poll(10) == []  # offset committed
+
+
+def test_partition_order_preserved_per_key():
+    b = Broker(default_partitions=4)
+    for i in range(20):
+        b.produce("t", i, key="same-key")
+    c = b.consumer("g", ("t",))
+    vals = [r.value for r in c.poll(100)]
+    assert vals == list(range(20))  # same key -> same partition -> total order
+
+
+def test_consumer_groups_independent_offsets():
+    b = Broker()
+    b.produce("t", "x")
+    c1 = b.consumer("g1", ("t",))
+    c2 = b.consumer("g2", ("t",))
+    assert len(c1.poll(10)) == 1
+    assert len(c2.poll(10)) == 1  # groups each see the full log
+
+
+def test_group_members_split_partitions():
+    b = Broker(default_partitions=4)
+    c1 = b.consumer("g", ("t",))
+    c2 = b.consumer("g", ("t",))
+    assert len(c1._assignment) == 2 and len(c2._assignment) == 2
+    owned = set(c1._assignment) | set(c2._assignment)
+    assert len(owned) == 4
+    c2.close()
+    assert len(c1._assignment) == 4  # rebalance on leave
+
+
+def test_offsets_survive_consumer_restart():
+    b = Broker(default_partitions=1)
+    for i in range(5):
+        b.produce("t", i)
+    c = b.consumer("g", ("t",))
+    assert len(c.poll(3)) == 3
+    c.close()
+    c2 = b.consumer("g", ("t",))
+    vals = [r.value for r in c2.poll(10)]
+    assert vals == [3, 4]  # committed offsets resumed
+
+
+def test_blocking_poll_wakes_on_produce():
+    b = Broker()
+    c = b.consumer("g", ("t",))
+    got = []
+
+    def consume():
+        got.extend(c.poll(10, timeout_s=2.0))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    b.produce("t", 42)
+    t.join(timeout=3.0)
+    assert not t.is_alive()
+    assert [r.value for r in got] == [42]

@@ -1,0 +1,127 @@
+"""End-to-end pipeline: producer -> bus -> router -> scorer -> engine -> notify.
+
+This is the in-process equivalent of the reference's full demo loop
+(SURVEY.md §3 call stacks A and B), run deterministically with a manual
+clock and a seeded notification service.
+"""
+
+import numpy as np
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, synthetic_dataset
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.notify.service import NotificationService
+from ccfd_tpu.process.clock import ManualClock
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.producer.producer import Producer
+from ccfd_tpu.router.router import Router, decode_features
+from ccfd_tpu.serving.scorer import Scorer
+
+
+CFG = Config(customer_reply_timeout_s=30.0, fraud_threshold=0.5)
+
+
+def amount_based_score(x: np.ndarray) -> np.ndarray:
+    """Deterministic stand-in scorer: fraud iff Amount > 100."""
+    amount = x[:, FEATURE_NAMES.index("Amount")]
+    return (amount > 100.0).astype(np.float32)
+
+
+def build(score_fn=amount_based_score, reply_prob=1.0, approve_prob=1.0):
+    broker = Broker()
+    clock = ManualClock()
+    reg_router, reg_kie, reg_notify = Registry(), Registry(), Registry()
+    engine = build_engine(CFG, broker, reg_kie, clock)
+    router = Router(CFG, broker, score_fn, engine, reg_router)
+    notify = NotificationService(
+        CFG, broker, reg_notify, reply_prob=reply_prob, approve_prob=approve_prob, seed=1
+    )
+    return broker, clock, engine, router, notify, reg_router, reg_kie
+
+
+def test_decode_features_schema_order():
+    txs = [{"Time": 1.0, "V1": 2.0, "Amount": 3.0}, {"V28": 9.0}]
+    x, bad = decode_features(txs)
+    assert x.shape == (2, 30) and bad == 0
+    assert x[0, 0] == 1.0 and x[0, 1] == 2.0 and x[0, 29] == 3.0
+    assert x[1, 28] == 9.0
+
+
+def test_poison_pill_does_not_crash_router():
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    broker.produce(CFG.kafka_topic, {"id": 1, "Amount": "not-a-number"})
+    broker.produce(CFG.kafka_topic, None)
+    assert router.step() == 2  # scored with zeroed fields, loop alive
+    assert reg_r.counter("transaction_decode_errors_total").value() >= 2
+
+
+def test_threshold_routing_and_counters():
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    broker.produce(CFG.kafka_topic, {"id": 1, "Amount": 50.0})
+    broker.produce(CFG.kafka_topic, {"id": 2, "Amount": 500.0})
+    n = router.step()
+    assert n == 2
+    assert reg_r.counter("transaction_incoming_total").value() == 2
+    assert reg_r.counter("transaction_outgoing_total").value({"type": "standard"}) == 1
+    assert reg_r.counter("transaction_outgoing_total").value({"type": "fraud"}) == 1
+    # fraud instance waits for the customer; standard completed
+    active = engine.instances("active")
+    assert len(active) == 1 and active[0].definition.id == "fraud"
+
+
+def test_full_customer_reply_loop():
+    broker, clock, engine, router, notify, reg_r, reg_k = build(
+        reply_prob=1.0, approve_prob=1.0
+    )
+    broker.produce(CFG.kafka_topic, {"id": 7, "Amount": 900.0})
+    router.step()          # score + start fraud process + notification emitted
+    assert notify.step() == 1   # customer replies approved
+    router.step()          # response forwarded as engine signal
+    assert reg_r.counter("notifications_outgoing_total").value() == 1
+    assert reg_r.counter("notifications_incoming_total").value({"response": "approved"}) == 1
+    insts = engine.instances()
+    assert len(insts) == 1 and insts[0].status == "completed"
+    assert reg_k.histogram("fraud_approved_amount").count() == 1
+
+
+def test_no_reply_timer_path_end_to_end():
+    broker, clock, engine, router, notify, reg_r, reg_k = build(reply_prob=0.0)
+    broker.produce(CFG.kafka_topic, {"id": 8, "Amount": 5000.0})
+    router.step()
+    notify.step()  # customer stays silent
+    clock.advance(31.0)  # no-reply timer -> DMN -> investigation task
+    tasks = engine.tasks()
+    assert len(tasks) == 1
+    assert reg_k.histogram("fraud_investigation_amount").count() == 1
+
+
+def test_producer_streams_dataset():
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    ds = synthetic_dataset(n=50, seed=3)
+    produced = Producer(CFG, broker, ds).run(limit=50)
+    assert produced == 50
+    total = 0
+    while True:
+        n = router.step()
+        if n == 0:
+            break
+        total += n
+    assert total == 50
+    assert reg_r.counter("transaction_incoming_total").value() == 50
+
+
+def test_pipeline_with_real_jax_scorer():
+    """Producer -> router -> actual jit MLP scorer -> engine, on CPU devices."""
+    scorer = Scorer(model_name="mlp", batch_sizes=(16, 64), compute_dtype="float32")
+    broker, clock, engine, router, notify, reg_r, reg_k = build(score_fn=scorer.score)
+    ds = synthetic_dataset(n=40, seed=4)
+    Producer(CFG, broker, ds).run(limit=40)
+    total = 0
+    while (n := router.step()) > 0:
+        total += n
+    assert total == 40
+    outgoing = reg_r.counter("transaction_outgoing_total")
+    assert (
+        outgoing.value({"type": "fraud"}) + outgoing.value({"type": "standard"}) == 40
+    )

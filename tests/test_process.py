@@ -1,0 +1,159 @@
+"""Process engine: timer-vs-signal race, DMN triage, prediction service."""
+
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.clock import ManualClock
+from ccfd_tpu.process.dmn import DecisionTable, Rule
+from ccfd_tpu.process.engine import (
+    EndNode,
+    Engine,
+    EventNode,
+    ProcessDefinition,
+    ServiceNode,
+)
+from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL, build_engine
+from ccfd_tpu.process.prediction import FixedPredictionService
+
+
+CFG = Config(customer_reply_timeout_s=30.0, low_amount_threshold=200.0,
+             low_proba_threshold=0.75, confidence_threshold=1.0)
+
+
+def make(prediction_service=None, cfg=CFG):
+    broker = Broker()
+    clock = ManualClock()
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, clock, prediction_service)
+    return broker, clock, reg, engine
+
+
+def tx(amount, txid=1):
+    return {"id": txid, "Amount": amount, "V17": 0.1, "V10": 0.2}
+
+
+def test_standard_process_completes():
+    _, _, _, engine = make()
+    pid = engine.start_process("standard", {"transaction": tx(10.0)})
+    assert engine.instance(pid).status == "completed"
+
+
+def test_fraud_emits_notification():
+    broker, clock, reg, engine = make()
+    pid = engine.start_process("fraud", {"transaction": tx(500.0), "proba": 0.9})
+    c = broker.consumer("t", (CFG.customer_notification_topic,))
+    recs = c.poll(10)
+    assert len(recs) == 1
+    assert recs[0].value["process_id"] == pid
+    assert engine.instance(pid).node == "await_reply"
+
+
+def test_signal_approved_wins_race():
+    _, clock, reg, engine = make()
+    pid = engine.start_process("fraud", {"transaction": tx(500.0), "proba": 0.9})
+    assert engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+    inst = engine.instance(pid)
+    assert inst.status == "completed"
+    assert reg.histogram("fraud_approved_amount").count() == 1
+    # late timer must be a no-op
+    clock.advance(100.0)
+    assert inst.status == "completed"
+    assert reg.histogram("fraud_approved_low_amount").count() == 0
+
+
+def test_signal_not_approved_cancels():
+    _, clock, reg, engine = make()
+    pid = engine.start_process("fraud", {"transaction": tx(500.0), "proba": 0.9})
+    engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": False})
+    assert engine.instance(pid).status == "cancelled"
+    assert reg.histogram("fraud_rejected_amount").count() == 1
+
+
+def test_timer_low_amount_auto_approves():
+    _, clock, reg, engine = make()
+    pid = engine.start_process("fraud", {"transaction": tx(50.0), "proba": 0.6})
+    clock.advance(31.0)
+    assert engine.instance(pid).status == "completed"
+    assert reg.histogram("fraud_approved_low_amount").count() == 1
+    # signal after timer resolved the wait is rejected
+    assert not engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": False})
+
+
+def test_timer_high_amount_opens_investigation():
+    _, clock, reg, engine = make()
+    pid = engine.start_process("fraud", {"transaction": tx(5000.0), "proba": 0.9})
+    clock.advance(31.0)
+    tasks = engine.tasks()
+    assert len(tasks) == 1 and tasks[0].name == "fraud-investigation"
+    assert reg.histogram("fraud_investigation_amount").count() == 1
+    engine.complete_task(tasks[0].task_id, True)  # investigator confirms fraud
+    assert engine.instance(pid).status == "cancelled"
+    assert reg.histogram("fraud_rejected_amount").count() == 1
+
+
+def test_investigation_approval_path():
+    _, clock, reg, engine = make()
+    pid = engine.start_process("fraud", {"transaction": tx(5000.0), "proba": 0.9})
+    clock.advance(31.0)
+    engine.complete_task(engine.tasks()[0].task_id, False)
+    assert engine.instance(pid).status == "completed"
+    assert reg.histogram("fraud_approved_amount").count() == 1
+
+
+def test_prediction_service_auto_completes_at_threshold():
+    ps = FixedPredictionService(outcome=True, confidence=0.95)
+    cfg = Config(confidence_threshold=0.9, customer_reply_timeout_s=30.0)
+    _, clock, reg, engine = make(ps, cfg)
+    pid = engine.start_process("fraud", {"transaction": tx(5000.0), "proba": 0.9})
+    clock.advance(31.0)
+    # confidence 0.95 >= threshold 0.9 -> task auto-closed, fraud confirmed
+    assert engine.tasks() == []
+    assert engine.instance(pid).status == "cancelled"
+    assert ps.calls  # the service was consulted
+
+
+def test_prediction_service_prefills_below_threshold():
+    ps = FixedPredictionService(outcome=True, confidence=0.6)
+    cfg = Config(confidence_threshold=0.9, customer_reply_timeout_s=30.0)
+    _, clock, reg, engine = make(ps, cfg)
+    engine.start_process("fraud", {"transaction": tx(5000.0), "proba": 0.9})
+    clock.advance(31.0)
+    tasks = engine.tasks()
+    assert len(tasks) == 1
+    assert tasks[0].suggested_outcome is True  # pre-filled, not closed
+    assert tasks[0].prediction_confidence == 0.6
+
+
+def test_dmn_first_match_and_default():
+    table = DecisionTable(
+        "t",
+        rules=[
+            Rule(when={"amount": ("<", 100)}, then="low"),
+            Rule(when={"amount": ("between", (100, 1000))}, then="mid"),
+        ],
+        default="high",
+    )
+    assert table.evaluate({"amount": 5}) == "low"
+    assert table.evaluate({"amount": 500}) == "mid"
+    assert table.evaluate({"amount": 5000}) == "high"
+
+
+def test_definition_validates_edges():
+    with pytest.raises(ValueError):
+        ProcessDefinition(
+            id="bad",
+            start="a",
+            nodes={"a": ServiceNode("a", lambda e, i: None, next="missing")},
+        )
+
+
+def test_double_complete_task_raises():
+    _, clock, _, engine = make()
+    engine.start_process("fraud", {"transaction": tx(5000.0), "proba": 0.9})
+    clock.advance(31.0)
+    tid = engine.tasks()[0].task_id
+    engine.complete_task(tid, False)
+    with pytest.raises(ValueError):
+        engine.complete_task(tid, True)
