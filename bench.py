@@ -1,0 +1,81 @@
+"""Benchmark: end-to-end transaction-scoring throughput on the TPU scorer.
+
+Measures the prediction hop the framework replaces (reference Seldon CPU
+model, SURVEY.md §3 stack A): host-side feature matrix -> bucketed jit
+dispatch (ccfd_tpu/serving/scorer.py) -> probabilities back on host. That
+is the full serving round-trip the router pays per micro-batch — H2D copy,
+XLA executable, D2H copy — not a device-only FLOP timing.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tx/s, "unit": "tx/s", "vs_baseline": ratio}
+
+``vs_baseline`` is the ratio against the 50,000 tx/s north-star target
+(BASELINE.json: the reference publishes no numbers of its own — the
+driver-set target is the baseline to beat; >1.0 means the target is beaten).
+
+Env knobs: CCFD_BENCH_BATCH (default 16384), CCFD_BENCH_SECONDS (default 3),
+CCFD_BENCH_PLATFORM=cpu to force CPU (local testing without the TPU tunnel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+NORTH_STAR_TX_S = 50_000.0  # BASELINE.json north_star: >=50k tx/s on v5e-1
+
+
+def main() -> None:
+    if os.environ.get("CCFD_BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["CCFD_BENCH_PLATFORM"])
+    import jax
+    import numpy as np
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import mlp
+    from ccfd_tpu.serving.scorer import Scorer
+
+    batch = int(os.environ.get("CCFD_BENCH_BATCH", "16384"))
+    seconds = float(os.environ.get("CCFD_BENCH_SECONDS", "3"))
+
+    ds = synthetic_dataset(n=max(batch, 4096), fraud_rate=0.01, seed=0)
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    scorer = Scorer(
+        model_name="mlp",
+        params=params,
+        batch_sizes=(16, 128, 1024, 4096, batch),
+        compute_dtype="bfloat16",
+    )
+    scorer.warmup()
+
+    x = ds.X[:batch]
+    # timed region: full host->device->host scoring round trips
+    n_rows = 0
+    t0 = time.perf_counter()
+    while True:
+        proba = scorer.score(x)
+        n_rows += x.shape[0]
+        elapsed = time.perf_counter() - t0
+        if elapsed >= seconds:
+            break
+    assert proba.shape == (batch,)
+    tx_per_s = n_rows / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "end_to_end_scoring_throughput_mlp_bf16",
+                "value": round(tx_per_s, 1),
+                "unit": "tx/s",
+                "vs_baseline": round(tx_per_s / NORTH_STAR_TX_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

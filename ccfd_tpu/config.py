@@ -61,6 +61,11 @@ class Config:
     low_amount_threshold: float = 200.0
     low_proba_threshold: float = 0.75
 
+    # --- online retrain (new; BASELINE.json configs[4]) ---
+    labels_topic: str = "ccd-labels"
+    retrain_batch: int = 1024
+    retrain_min_labels: int = 256
+
     # --- TPU scorer knobs (new) ---
     model_name: str = "mlp"
     compute_dtype: str = "bfloat16"
@@ -105,6 +110,11 @@ class Config:
             ),
             low_proba_threshold=float(
                 e.get("CCFD_LOW_PROBA", str(Config.low_proba_threshold))
+            ),
+            labels_topic=e.get("CCFD_LABELS_TOPIC", Config.labels_topic),
+            retrain_batch=int(e.get("CCFD_RETRAIN_BATCH", str(Config.retrain_batch))),
+            retrain_min_labels=int(
+                e.get("CCFD_RETRAIN_MIN_LABELS", str(Config.retrain_min_labels))
             ),
             model_name=e.get("CCFD_MODEL", Config.model_name),
             compute_dtype=e.get("CCFD_DTYPE", Config.compute_dtype),

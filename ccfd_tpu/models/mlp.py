@@ -67,7 +67,10 @@ def set_normalizer(params: Params, mean: np.ndarray, std: np.ndarray) -> Params:
 
 
 def logits(params: Params, x: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
-    h = (x - params["norm"]["mu"]) / params["norm"]["sigma"]
+    # the normalizer is data statistics, not a trainable parameter
+    mu = jax.lax.stop_gradient(params["norm"]["mu"])
+    sigma = jax.lax.stop_gradient(params["norm"]["sigma"])
+    h = (x - mu) / sigma
     h = h.astype(compute_dtype)
     layers = params["layers"]
     for layer in layers[:-1]:

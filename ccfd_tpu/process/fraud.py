@@ -117,10 +117,25 @@ def build_engine(
     def task_outcome(engine_: Engine, inst: Instance) -> str:
         return "cancel" if inst.vars.get("task_outcome") else "approve"
 
-    def record(hist):
+    def record(hist, label: int | None = None):
+        """Observe the KIE amount histogram and, when the resolution carries a
+        ground-truth fraud label, publish it for online retraining
+        (BASELINE.json configs[4]: SGD from jBPM human-task labels)."""
+
         def fn(engine_: Engine, inst: Instance) -> None:
             hist.observe(amount_of(inst))
             inst.vars["resolution"] = hist.name
+            if label is not None:
+                broker.produce(
+                    cfg.labels_topic,
+                    {
+                        "transaction": inst.vars.get("transaction", {}),
+                        "label": label,
+                        "process_id": inst.pid,
+                        "source": hist.name,
+                    },
+                    key=inst.pid,
+                )
 
         return fn
 
@@ -148,8 +163,12 @@ def build_engine(
                 "investigate", task_name="fraud-investigation", next="outcome_gateway"
             ),
             "outcome_gateway": GatewayNode("outcome_gateway", task_outcome),
-            "approve": ServiceNode("approve", record(h_approved), next="end_approved"),
-            "cancel": ServiceNode("cancel", record(h_rejected), next="end_cancelled"),
+            "approve": ServiceNode(
+                "approve", record(h_approved, label=0), next="end_approved"
+            ),
+            "cancel": ServiceNode(
+                "cancel", record(h_rejected, label=1), next="end_cancelled"
+            ),
             "end_approved": EndNode("end_approved", status="completed"),
             "end_cancelled": EndNode("end_cancelled", status="cancelled"),
         },

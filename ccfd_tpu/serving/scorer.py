@@ -80,8 +80,13 @@ class Scorer:
             )
 
     def swap_params(self, new_params: Any) -> None:
-        """Atomically publish retrained params without pausing serving."""
-        staged = jax.device_put(new_params)
+        """Atomically publish retrained params without pausing serving.
+
+        Copies into fresh buffers: ``device_put`` on already-committed arrays
+        is an aliasing no-op, and aliased buffers would be deleted under us
+        when the trainer's next donated step consumes its argument.
+        """
+        staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
         jax.block_until_ready(staged)
         with self._lock:
             self._params = staged

@@ -1,0 +1,194 @@
+"""REST serving layer with the Seldon wire contract, backed by the TPU scorer.
+
+Replaces the reference's Seldon-Core engine + model pod
+(reference deploy/model/modelfull.json:18-52, route
+deploy/model/modelfull-route.yaml:1-12) with one process:
+
+- ``POST /api/v0.1/predictions`` — the Seldon REST contract the router and
+  KIE server call (reference deploy/router.yaml:65-68, README.md:454-459).
+  Request: ``{"data": {"names": [...], "ndarray": [[...], ...]}}``;
+  response mirrors the shape with ``names: ["proba_0", "proba_1"]`` and one
+  probability row per input row.
+- ``POST /predict`` — the jBPM prediction-service endpoint
+  (reference ccd-service.yaml:61-62, README.md:379).
+- Bearer-token auth when ``SELDON_TOKEN`` is configured
+  (reference README.md:372-384, 447-451).
+- ``GET /prometheus`` (and ``/metrics``) — scrape body carrying
+  SeldonCore-dashboard-compatible series (reference
+  deploy/grafana/SeldonCore.json:119-531):
+  ``seldon_api_executor_client_requests_seconds_{count,sum,bucket}`` plus
+  the ModelPrediction per-request gauges ``proba_1``/``Amount``/``V17``/
+  ``V10`` (reference deploy/grafana/ModelPrediction.json:96-104).
+- ``GET /health/status`` — Seldon-style readiness.
+
+Implementation is stdlib ``ThreadingHTTPServer``: no web framework is
+needed for a fixed four-route contract, and keeping the handler thin
+matters more for p99 than any framework feature. The GIL is released
+during the XLA dispatch, so scoring threads overlap host work.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.serving.scorer import Scorer
+
+
+class PredictionServer:
+    def __init__(
+        self,
+        scorer: Scorer,
+        cfg: Config | None = None,
+        registry: Registry | None = None,
+    ):
+        self.scorer = scorer
+        self.cfg = cfg or Config()
+        self.registry = registry or Registry()
+        r = self.registry
+        # SeldonCore dashboard series (request rate / success / 4xx / 5xx and
+        # latency quantiles come from this histogram + status-coded counter).
+        self._h_latency = r.histogram(
+            "seldon_api_executor_client_requests_seconds",
+            "request latency by endpoint",
+        )
+        self._c_requests = r.counter(
+            "seldon_api_executor_server_requests_total", "requests by code"
+        )
+        # ModelPrediction board: per-request feature/probability gauges.
+        self._g_proba = r.gauge("proba_1", "last scored fraud probability")
+        self._g_amount = r.gauge("Amount", "last scored transaction amount")
+        self._g_v17 = r.gauge("V17", "last scored V17")
+        self._g_v10 = r.gauge("V10", "last scored V10")
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # -- scoring ----------------------------------------------------------
+    def predict_ndarray(self, names: list[str], rows: list[list[float]]) -> dict:
+        x = np.zeros((len(rows), self.scorer.num_features), np.float32)
+        if names and names != list(FEATURE_NAMES):
+            idx = {n: j for j, n in enumerate(FEATURE_NAMES)}
+            for i, row in enumerate(rows):
+                for name, v in zip(names, row):
+                    j = idx.get(name)
+                    if j is not None:
+                        x[i, j] = float(v)
+        else:
+            for i, row in enumerate(rows):
+                x[i, : len(row)] = np.asarray(row, np.float32)[
+                    : self.scorer.num_features
+                ]
+        proba = self.scorer.score(x)
+        if len(rows):
+            self._g_proba.set(float(proba[-1]))
+            self._g_amount.set(float(x[-1, FEATURE_NAMES.index("Amount")]))
+            self._g_v17.set(float(x[-1, FEATURE_NAMES.index("V17")]))
+            self._g_v10.set(float(x[-1, FEATURE_NAMES.index("V10")]))
+        return {
+            "data": {
+                "names": ["proba_0", "proba_1"],
+                "ndarray": [[float(1.0 - p), float(p)] for p in proba],
+            },
+            "meta": {"model": self.scorer.spec.name},
+        }
+
+    # -- HTTP plumbing ----------------------------------------------------
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                server._c_requests.inc(labels={"code": str(code)})
+
+            def _send_json(self, code: int, obj: Any) -> None:
+                self._send(code, json.dumps(obj).encode(), "application/json")
+
+            def _authorized(self) -> bool:
+                token = server.cfg.seldon_token
+                if not token:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                return auth == f"Bearer {token}"
+
+            def do_GET(self):
+                if self.path in ("/prometheus", "/metrics"):
+                    self._send(200, server.registry.render().encode(), "text/plain")
+                elif self.path in ("/health/status", "/health", "/healthz"):
+                    self._send_json(200, {"status": "ok", "model": server.scorer.spec.name})
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                t0 = time.perf_counter()
+                # Always drain the body first: on HTTP/1.1 keep-alive an
+                # unread body would be parsed as the next request line by the
+                # reused connection (pooled clients hit this on 401/404).
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    length = 0
+                raw = self.rfile.read(length) if length else b"{}"
+                if not self._authorized():
+                    self._send_json(401, {"error": "unauthorized"})
+                    return
+                try:
+                    payload = json.loads(raw or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._send_json(400, {"error": "malformed JSON body"})
+                    return
+                path = self.path.rstrip("/")
+                if path.endswith("/predictions") or path == "/predict":
+                    data = payload.get("data", {})
+                    rows = data.get("ndarray")
+                    if rows is None or not isinstance(rows, list):
+                        self._send_json(
+                            400, {"error": "missing data.ndarray in request"}
+                        )
+                        return
+                    try:
+                        out = server.predict_ndarray(data.get("names") or [], rows)
+                    except (TypeError, ValueError) as e:
+                        self._send_json(400, {"error": f"bad ndarray: {e}"})
+                        return
+                    server._h_latency.observe(
+                        time.perf_counter() - t0, labels={"endpoint": path}
+                    )
+                    self._send_json(200, out)
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+        return Handler
+
+    def start(self, host: str | None = None, port: int | None = None) -> int:
+        """Start serving on a background thread; returns the bound port."""
+        host = host if host is not None else self.cfg.serve_host
+        port = port if port is not None else self.cfg.serve_port
+        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        t = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="ccfd-serving"
+        )
+        t.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,165 @@
+"""Multi-chip sharding on the virtual 8-device CPU mesh (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import synthetic_dataset
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.models import mlp
+from ccfd_tpu.parallel.checkpoint import CheckpointManager
+from ccfd_tpu.parallel.mesh import make_mesh
+from ccfd_tpu.parallel.online import OnlineTrainer
+from ccfd_tpu.parallel.sharding import batch_spec, mlp_param_spec, shard_params
+from ccfd_tpu.parallel.train import TrainConfig, fit_mlp, init_state, make_train_step
+from ccfd_tpu.process.clock import ManualClock
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.serving.scorer import Scorer
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices"
+)
+
+TC = TrainConfig(compute_dtype="float32", learning_rate=0.05)
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(model_parallel=2)
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("data", "model")
+    with pytest.raises(ValueError):
+        make_mesh(model_parallel=3)
+
+
+def test_sharded_train_step_matches_single_device():
+    ds = synthetic_dataset(n=512, fraud_rate=0.3, seed=5)
+    x = jnp.asarray(ds.X)
+    y = jnp.asarray(ds.y, jnp.float32)
+
+    def train(mesh):
+        params = mlp.init(jax.random.PRNGKey(0), hidden=128)
+        params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+        if mesh is not None:
+            params = shard_params(params, mlp_param_spec(params, mesh))
+        state = init_state(params, TC)
+        step = make_train_step(TC, mesh=mesh)
+        for _ in range(5):
+            state, loss = step(state, x, y)
+        return jax.tree.map(np.asarray, state["params"]), float(loss)
+
+    p_single, l_single = train(None)
+    p_mesh, l_mesh = train(make_mesh(model_parallel=2))
+    assert np.isfinite(l_single) and np.isfinite(l_mesh)
+    assert abs(l_single - l_mesh) < 1e-3
+    # weights evolve identically up to collective reduction order
+    for a, b in zip(jax.tree.leaves(p_single), jax.tree.leaves(p_mesh)):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+
+def test_dp_only_mesh_runs():
+    mesh = make_mesh(model_parallel=1)
+    ds = synthetic_dataset(n=256, seed=6)
+    params = fit_mlp(ds.X, ds.y, hidden=128, steps=3, tc=TC, mesh=mesh)
+    out = mlp.apply(params, jnp.asarray(ds.X[:16]), compute_dtype=jnp.float32)
+    assert np.asarray(out).shape == (16,)
+
+
+def test_training_improves_loss():
+    ds = synthetic_dataset(n=2000, fraud_rate=0.3, seed=7)
+    params = fit_mlp(ds.X, ds.y, hidden=128, steps=200, tc=TC)
+    proba = np.asarray(mlp.apply(params, jnp.asarray(ds.X), compute_dtype=jnp.float32))
+    acc = float(((proba > 0.5) == (ds.y > 0.5)).mean())
+    assert acc > 0.9, acc
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = mlp.init(jax.random.PRNGKey(2), hidden=128)
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(1, params)
+    mgr.save(5, params)
+    assert mgr.latest_step() == 5
+    restored, step = mgr.restore(params)
+    assert step == 5
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_gc_keeps_last_n(tmp_path):
+    params = {"w": jnp.ones((4,))}
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, params)
+    steps = [s for s, _ in __import__("ccfd_tpu.parallel.checkpoint", fromlist=["x"])._step_dirs(str(tmp_path))]
+    assert steps == [3, 4]
+
+
+def test_online_retrain_swaps_serving_params(tmp_path):
+    """Engine label events -> trainer -> scorer hot swap, end to end."""
+    cfg = Config(retrain_min_labels=8, retrain_batch=32, customer_reply_timeout_s=30.0)
+    broker = Broker()
+    clock = ManualClock()
+    engine = build_engine(cfg, broker, Registry(), clock)
+
+    ds = synthetic_dataset(n=64, fraud_rate=0.5, seed=8)
+    params = mlp.init(jax.random.PRNGKey(0), hidden=128)
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    scorer = Scorer(model_name="mlp", params=params, batch_sizes=(16, 64),
+                    compute_dtype="float32")
+    before = scorer.score(ds.X[:16]).copy()
+
+    trainer = OnlineTrainer(
+        cfg, broker, scorer, params, tc=TC,
+        checkpoints=CheckpointManager(str(tmp_path)),
+        steps_per_round=2, seed=0,
+    )
+    # resolve some fraud processes to emit labels: signal half approved,
+    # half cancelled
+    from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL
+
+    for i in range(16):
+        tx = {"id": i, "Amount": float(50 + i)}
+        pid = engine.start_process("fraud", {"transaction": tx, "proba": 0.9})
+        engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": i % 2 == 0})
+
+    assert trainer.step() is True  # ingested 16 labels >= min 8 -> trained
+    after = scorer.score(ds.X[:16])
+    assert not np.allclose(before, after)  # serving picked up new params
+    assert trainer.registry.counter("retrain_param_swaps_total").value() == 1
+    assert trainer.checkpoints.latest_step() is not None
+    trainer.close()
+
+
+def test_online_trainer_ignores_partial_bad_labels():
+    cfg = Config(retrain_min_labels=4, retrain_batch=8)
+    broker = Broker()
+    scorer = Scorer(model_name="mlp", batch_sizes=(16,), compute_dtype="float32")
+    trainer = OnlineTrainer(cfg, broker, scorer, scorer.params, tc=TC, seed=0)
+    broker.produce(cfg.labels_topic, {"transaction": {"Amount": 5.0}, "label": None})
+    broker.produce(cfg.labels_topic, {"transaction": {"Amount": 6.0}, "label": 1})
+    trainer._ingest()
+    assert len(trainer._X) == len(trainer._y) == 1  # bad record fully dropped
+    trainer.close()
+
+
+def test_online_trainer_no_busy_loop_without_new_labels():
+    cfg = Config(retrain_min_labels=2, retrain_batch=4)
+    broker = Broker()
+    scorer = Scorer(model_name="mlp", batch_sizes=(16,), compute_dtype="float32")
+    trainer = OnlineTrainer(cfg, broker, scorer, scorer.params, tc=TC,
+                            steps_per_round=1, seed=0)
+    for i in range(4):
+        broker.produce(cfg.labels_topic, {"transaction": {"Amount": float(i)}, "label": i % 2})
+    assert trainer.step() is True   # new labels -> train
+    assert trainer.step() is False  # same buffer, no new labels -> idle
+    trainer.close()
+
+
+def test_swap_params_does_not_alias_trainer_buffers():
+    scorer = Scorer(model_name="mlp", batch_sizes=(16,), compute_dtype="float32")
+    p = scorer.params
+    scorer.swap_params(p)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(scorer.params)):
+        assert a is not b  # fresh buffers: donation elsewhere can't delete them

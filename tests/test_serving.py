@@ -1,0 +1,148 @@
+"""Golden tests for the Seldon REST contract (SURVEY.md §4)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.serving.client import SeldonClient
+from ccfd_tpu.serving.scorer import Scorer
+from ccfd_tpu.serving.server import PredictionServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    scorer = Scorer(model_name="logreg", batch_sizes=(16, 64), compute_dtype="float32")
+    srv = PredictionServer(scorer, Config())
+    port = srv.start(host="127.0.0.1", port=0)
+    yield srv, port
+    srv.stop()
+
+
+def _post(port, path, body, token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}
+        | ({"Authorization": f"Bearer {token}"} if token else {}),
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_predictions_contract_shape(server):
+    srv, port = server
+    rows = [[0.0] * 30, [1.0] * 30]
+    code, out = _post(port, "/api/v0.1/predictions",
+                      {"data": {"names": list(FEATURE_NAMES), "ndarray": rows}})
+    assert code == 200
+    assert out["data"]["names"] == ["proba_0", "proba_1"]
+    nd = out["data"]["ndarray"]
+    assert len(nd) == 2 and all(len(r) == 2 for r in nd)
+    for p0, p1 in nd:
+        assert abs(p0 + p1 - 1.0) < 1e-5
+        assert 0.0 <= p1 <= 1.0
+
+
+def test_predict_endpoint_alias(server):
+    srv, port = server
+    code, out = _post(port, "/predict", {"data": {"ndarray": [[0.5] * 30]}})
+    assert code == 200 and len(out["data"]["ndarray"]) == 1
+
+
+def test_names_reordering(server):
+    """Feature values are mapped by name when names are shuffled."""
+    srv, port = server
+    names = list(FEATURE_NAMES)[::-1]
+    row = list(np.arange(30, dtype=float))[::-1]
+    code, out = _post(port, "/api/v0.1/predictions",
+                      {"data": {"names": names, "ndarray": [row]}})
+    code2, out2 = _post(port, "/api/v0.1/predictions",
+                        {"data": {"names": list(FEATURE_NAMES),
+                                  "ndarray": [list(np.arange(30, dtype=float))]}})
+    assert out["data"]["ndarray"] == out2["data"]["ndarray"]
+
+
+def test_malformed_body_400(server):
+    srv, port = server
+    code, out = _post(port, "/api/v0.1/predictions", {"nope": 1})
+    assert code == 400
+    code, _ = _post(port, "/api/v0.1/predictions", {"data": {"ndarray": "x"}})
+    assert code == 400
+
+
+def test_unknown_route_404(server):
+    srv, port = server
+    code, _ = _post(port, "/api/v9/bogus", {})
+    assert code == 404
+
+
+def test_health_and_metrics(server):
+    srv, port = server
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/health/status") as r:
+        assert json.loads(r.read())["status"] == "ok"
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/prometheus") as r:
+        body = r.read().decode()
+    assert "seldon_api_executor_client_requests_seconds" in body
+    assert "proba_1" in body
+
+
+def test_token_auth():
+    scorer = Scorer(model_name="logreg", batch_sizes=(16,), compute_dtype="float32")
+    srv = PredictionServer(scorer, Config(seldon_token="sekrit"))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        code, _ = _post(port, "/predict", {"data": {"ndarray": [[0.0] * 30]}})
+        assert code == 401
+        code, _ = _post(port, "/predict", {"data": {"ndarray": [[0.0] * 30]}},
+                        token="sekrit")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+def test_seldon_client_roundtrip(server):
+    srv, port = server
+    cfg = Config(
+        seldon_url=f"http://127.0.0.1:{port}",
+        seldon_endpoint="api/v0.1/predictions",
+        seldon_pool_size=2,
+    )
+    client = SeldonClient(cfg)
+    x = np.random.default_rng(0).normal(size=(5, 30)).astype(np.float32)
+    proba = client.score(x)
+    assert proba.shape == (5,)
+    direct = srv.scorer.score(x)
+    np.testing.assert_allclose(proba, direct, atol=1e-6)
+    client.close()
+
+
+def test_keepalive_survives_401_then_succeeds():
+    """Pooled HTTP/1.1 connection must stay in sync after an auth failure."""
+    import http.client
+
+    scorer = Scorer(model_name="logreg", batch_sizes=(16,), compute_dtype="float32")
+    srv = PredictionServer(scorer, Config(seldon_token="tok"))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        body = json.dumps({"data": {"ndarray": [[0.0] * 30]}})
+        conn.request("POST", "/predict", body, {"Content-Type": "application/json"})
+        r1 = conn.getresponse(); r1.read()
+        assert r1.status == 401
+        # same connection, now with the token: must parse cleanly
+        conn.request("POST", "/predict", body,
+                     {"Content-Type": "application/json",
+                      "Authorization": "Bearer tok"})
+        r2 = conn.getresponse(); out = json.loads(r2.read())
+        assert r2.status == 200 and len(out["data"]["ndarray"]) == 1
+        conn.close()
+    finally:
+        srv.stop()
