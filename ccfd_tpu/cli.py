@@ -1,0 +1,199 @@
+"""Command-line entry points: the reference run-book as one binary.
+
+The reference's "entry point" is a 600-line oc-apply run-book whose step
+order is a dependency sort (SURVEY.md §3 D). Here the same topology boots
+in-process:
+
+  python -m ccfd_tpu demo    # full pipeline: produce -> route -> score ->
+                             # process -> notify -> retrain, prints metrics
+  python -m ccfd_tpu serve   # REST scorer (Seldon contract) on a port
+  python -m ccfd_tpu train   # offline-train the flagship MLP + checkpoint
+  python -m ccfd_tpu bench   # the benchmark JSON line (same as bench.py)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def cmd_demo(args: argparse.Namespace) -> int:
+    import jax
+
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import load_dataset
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.notify.service import NotificationService
+    from ccfd_tpu.parallel.online import OnlineTrainer
+    from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.process.prediction import ScorerPredictionService
+    from ccfd_tpu.producer.producer import Producer
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.serving.scorer import Scorer
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        Config.from_env(), customer_reply_timeout_s=args.reply_timeout
+    )
+    ds = load_dataset(n_synthetic=max(args.transactions, 4000))
+    print(f"[demo] dataset: {ds.n} rows; training flagship MLP...", file=sys.stderr)
+    params = fit_mlp(
+        ds.X, ds.y, steps=args.train_steps, tc=TrainConfig(compute_dtype="float32")
+    )
+
+    broker = Broker()
+    reg_router, reg_kie, reg_notify, reg_retrain = (
+        Registry(), Registry(), Registry(), Registry(),
+    )
+    scorer = Scorer(model_name="mlp", params=params, compute_dtype=cfg.compute_dtype)
+    scorer.warmup()
+    engine = build_engine(
+        cfg, broker, reg_kie,
+        prediction_service=ScorerPredictionService(scorer.score),
+    )
+    router = Router(cfg, broker, scorer.score, engine, reg_router)
+    notify = NotificationService(cfg, broker, reg_notify, seed=args.seed)
+    trainer = OnlineTrainer(cfg, broker, scorer, params, registry=reg_retrain)
+
+    router.start(poll_timeout_s=0.02)
+    notify.start(poll_timeout_s=0.02)
+    trainer.start(interval_s=0.5)
+
+    t0 = time.perf_counter()
+    Producer(cfg, broker, ds).run(
+        limit=args.transactions,
+        rate_per_s=args.rate,
+        wire_format=args.wire_format,
+    )
+    # drain: wait until the router consumed everything + timers fired
+    deadline = time.monotonic() + args.drain_s
+    while time.monotonic() < deadline:
+        if reg_router.counter("transaction_incoming_total").value() >= args.transactions:
+            break
+        time.sleep(0.1)
+    time.sleep(args.reply_timeout + 1.0)
+    elapsed = time.perf_counter() - t0
+    router.stop(); notify.stop(); trainer.stop()
+
+    out = reg_router.counter("transaction_outgoing_total")
+    summary = {
+        "transactions": int(reg_router.counter("transaction_incoming_total").value()),
+        "fraud_routed": int(out.value({"type": "fraud"})),
+        "standard_routed": int(out.value({"type": "standard"})),
+        "notifications": int(reg_router.counter("notifications_outgoing_total").value()),
+        "approved_amount_n": reg_kie.histogram("fraud_approved_amount").count(),
+        "rejected_amount_n": reg_kie.histogram("fraud_rejected_amount").count(),
+        "low_amount_auto_n": reg_kie.histogram("fraud_approved_low_amount").count(),
+        "investigations_n": reg_kie.histogram("fraud_investigation_amount").count(),
+        "open_tasks": len(engine.tasks()),
+        "retrain_swaps": int(reg_retrain.counter("retrain_param_swaps_total").value()),
+        "wall_s": round(elapsed, 2),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import load_dataset
+    from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.serving.server import PredictionServer
+
+    cfg = Config.from_env()
+    params = None
+    if args.train:
+        if cfg.model_name != "mlp":
+            print(
+                f"[serve] --train trains the MLP; CCFD_MODEL={cfg.model_name!r} "
+                "params would not match — unset --train or set CCFD_MODEL=mlp",
+                file=sys.stderr,
+            )
+            return 2
+        ds = load_dataset()
+        params = fit_mlp(ds.X, ds.y, steps=args.train_steps,
+                         tc=TrainConfig(compute_dtype="float32"))
+    scorer = Scorer(
+        model_name=cfg.model_name, params=params, compute_dtype=cfg.compute_dtype,
+        batch_sizes=cfg.batch_sizes,
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, cfg)
+    port = srv.start(args.host, args.port)
+    print(f"[serve] model={cfg.model_name} listening on {args.host}:{port}",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    from ccfd_tpu.data.ccfd import load_dataset
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+
+    ds = load_dataset()
+    params = fit_mlp(ds.X, ds.y, steps=args.steps,
+                     tc=TrainConfig(compute_dtype="float32"))
+    path = CheckpointManager(args.checkpoint_dir).save(args.steps, params)
+    print(json.dumps({"checkpoint": path, "rows": ds.n, "steps": args.steps}))
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    # bench.py lives at the repo root (next to the package), not inside it
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="ccfd_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("demo", help="run the full pipeline in-process")
+    d.add_argument("--transactions", type=int, default=2000)
+    d.add_argument("--rate", type=float, default=None)
+    d.add_argument("--train-steps", type=int, default=200)
+    d.add_argument("--reply-timeout", type=float, default=2.0)
+    d.add_argument("--drain-s", type=float, default=30.0)
+    d.add_argument("--wire-format", choices=("dict", "csv"), default="dict")
+    d.add_argument("--seed", type=int, default=0)
+    d.set_defaults(fn=cmd_demo)
+
+    s = sub.add_parser("serve", help="REST prediction server (Seldon contract)")
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--train", action="store_true", help="train before serving")
+    s.add_argument("--train-steps", type=int, default=300)
+    s.set_defaults(fn=cmd_serve)
+
+    t = sub.add_parser("train", help="offline-train the flagship MLP")
+    t.add_argument("--steps", type=int, default=500)
+    t.add_argument("--checkpoint-dir", default="./checkpoints")
+    t.set_defaults(fn=cmd_train)
+
+    b = sub.add_parser("bench", help="print the benchmark JSON line")
+    b.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

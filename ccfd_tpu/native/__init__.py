@@ -1,0 +1,140 @@
+"""Native (C++) hot-path bindings with a transparent numpy fallback.
+
+Builds ``decode.cpp`` with g++ on first import (cached next to the source),
+loads it via ctypes, and exposes:
+
+- ``decode_csv(data: bytes, n_features) -> (np.ndarray (B, F) f32, bad_rows)``
+- ``pad_batch(x, bucket_rows) -> np.ndarray (bucket, F) f32``
+
+If no toolchain is available the numpy implementations (identical
+semantics, asserted by tests/test_native.py) are used — the framework never
+hard-requires a compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "decode.cpp")
+_SO = os.path.join(_HERE, "_ccfd_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> str | None:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load():
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ccfd_decode_csv.restype = ctypes.c_int
+        lib.ccfd_decode_csv.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ccfd_pad_batch.restype = None
+        lib.ccfd_pad_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# numpy reference implementations (identical semantics)
+
+
+def _decode_csv_numpy(data: bytes, n_features: int) -> tuple[np.ndarray, int]:
+    lines = data.decode("utf-8", errors="replace").splitlines()
+    out = np.zeros((len(lines), n_features), np.float32)
+    bad = 0
+    for i, line in enumerate(lines):
+        parts = line.split(",")
+        if len(parts) != n_features:
+            bad += 1
+            continue
+        try:
+            out[i] = [float(p) for p in parts]
+        except ValueError:
+            out[i] = 0.0
+            bad += 1
+    return out, bad
+
+
+def decode_csv(data: bytes, n_features: int = 30) -> tuple[np.ndarray, int]:
+    """Newline-separated CSV float rows -> ((B, F) float32, #bad rows)."""
+    if not data:
+        return np.zeros((0, n_features), np.float32), 0
+    lib = _load()
+    if lib is None:
+        return _decode_csv_numpy(data, n_features)
+    max_rows = data.count(b"\n") + (0 if data.endswith(b"\n") else 1)
+    out = np.zeros((max_rows, n_features), np.float32)
+    bad = ctypes.c_int(0)
+    rows = lib.ccfd_decode_csv(
+        data,
+        len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_rows,
+        n_features,
+        ctypes.byref(bad),
+    )
+    return out[:rows], int(bad.value)
+
+
+def pad_batch(x: np.ndarray, bucket_rows: int) -> np.ndarray:
+    """(n, F) -> (bucket_rows, F) zero-padded float32 (truncates if larger)."""
+    x = np.ascontiguousarray(x, np.float32)
+    lib = _load()
+    if lib is None:
+        out = np.zeros((bucket_rows, x.shape[1]), np.float32)
+        out[: min(len(x), bucket_rows)] = x[:bucket_rows]
+        return out
+    out = np.empty((bucket_rows, x.shape[1]), np.float32)
+    lib.ccfd_pad_batch(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        x.shape[0],
+        x.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        bucket_rows,
+    )
+    return out

@@ -1,0 +1,80 @@
+// Native hot-path: CSV transaction decode + batch assembly.
+//
+// The reference's per-message hop runs feature extraction inside a JVM Camel
+// route (reference deploy/router.yaml, README.md:549); our router instead
+// assembles one (B, 30) float32 matrix per micro-batch and the Python
+// dict-walk is the slowest host-side stage at high throughput. This decoder
+// parses newline-separated CSV transaction rows straight into the caller's
+// float32 buffer — one pass, no allocations, no Python per-field overhead.
+//
+// Exposed via ctypes (see ccfd_tpu/native/__init__.py); the fallback numpy
+// path implements identical semantics, asserted by tests.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Parse up to max_rows CSV rows of exactly n_features floats each from
+// buf[0..len) into out (row-major, max_rows * n_features floats).
+// Rows with parse errors or the wrong field count are zero-filled and
+// counted in *bad_rows. Returns the number of rows consumed.
+int ccfd_decode_csv(const char* buf, size_t len, float* out, int max_rows,
+                    int n_features, int* bad_rows) {
+  int rows = 0;
+  int bad = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end && rows < max_rows) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (line_end == nullptr) line_end = end;
+    float* row_out = out + static_cast<size_t>(rows) * n_features;
+    int field = 0;
+    bool ok = true;
+    const char* q = p;
+    while (q < line_end && field < n_features) {
+      char* next = nullptr;
+      float v = strtof(q, &next);
+      if (next == q) {  // no parse progress
+        ok = false;
+        break;
+      }
+      row_out[field++] = v;
+      q = next;
+      if (q < line_end) {
+        if (*q == ',') {
+          ++q;
+        } else if (*q != '\n' && *q != '\r') {
+          ok = false;
+          break;
+        }
+      }
+    }
+    // trailing \r (CRLF) is fine; any other leftover content means the row
+    // had extra fields — reject it like the numpy fallback does
+    while (q < line_end && *q == '\r') ++q;
+    if (!ok || field != n_features || q != line_end) {
+      memset(row_out, 0, sizeof(float) * n_features);
+      ++bad;
+    }
+    ++rows;
+    p = (line_end < end) ? line_end + 1 : end;
+  }
+  if (bad_rows != nullptr) *bad_rows = bad;
+  return rows;
+}
+
+// Batch assembly: scatter variable-count rows into a zero-padded bucket.
+// src is n_rows * n_features floats; dst is bucket_rows * n_features and is
+// fully zeroed first (padding rows score as zeros).
+void ccfd_pad_batch(const float* src, int n_rows, int n_features, float* dst,
+                    int bucket_rows) {
+  const size_t row_bytes = sizeof(float) * static_cast<size_t>(n_features);
+  memset(dst, 0, row_bytes * static_cast<size_t>(bucket_rows));
+  const int copy = n_rows < bucket_rows ? n_rows : bucket_rows;
+  memcpy(dst, src, row_bytes * static_cast<size_t>(copy));
+}
+
+}  // extern "C"

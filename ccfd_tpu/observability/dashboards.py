@@ -1,0 +1,186 @@
+"""Grafana dashboard generation: the reference's metrics contract, regenerated.
+
+The reference ships six hand-exported Grafana dashboards
+(reference deploy/grafana/{KIE,Kafka,ModelPrediction,Router,SeldonCore,
+SparkMetrics}.json, ~4k lines) that define its observability contract
+(SURVEY.md §5). Rather than hand-maintaining 4k lines of panel JSON, this
+module *generates* the equivalent dashboards from the framework's actual
+metric names, one builder per board:
+
+- Router      — transaction/notification counters (reference Router.json:88-326)
+- KIE         — the four amount histograms (reference KIE.json bucket panels)
+- ModelPrediction — proba_1 / Amount / V17 / V10 gauges
+  (reference ModelPrediction.json:96-322)
+- SeldonCore  — request rate / status codes / latency quantiles
+  (reference SeldonCore.json:119-531)
+- Bus         — in-process broker depth/throughput (the Kafka.json analog)
+- Retrain     — online-training health (new capability; no reference analog)
+
+``write_dashboards(dir)`` emits one importable JSON file per board.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_PANEL_W = 12
+_PANEL_H = 8
+
+
+def _panel(panel_id: int, title: str, exprs: list[str], panel_type: str = "timeseries") -> dict:
+    x = (panel_id % 2) * _PANEL_W
+    y = (panel_id // 2) * _PANEL_H
+    return {
+        "id": panel_id + 1,
+        "title": title,
+        "type": panel_type,
+        "datasource": {"type": "prometheus", "uid": "${DS_PROMETHEUS}"},
+        "gridPos": {"h": _PANEL_H, "w": _PANEL_W, "x": x, "y": y},
+        "targets": [
+            {"expr": expr, "refId": chr(ord("A") + i), "legendFormat": "__auto"}
+            for i, expr in enumerate(exprs)
+        ],
+    }
+
+
+def _dashboard(title: str, uid: str, panels: list[dict]) -> dict:
+    return {
+        "title": title,
+        "uid": uid,
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": []},
+        "panels": panels,
+        "__inputs": [
+            {
+                "name": "DS_PROMETHEUS",
+                "label": "Prometheus",
+                "type": "datasource",
+                "pluginId": "prometheus",
+            }
+        ],
+    }
+
+
+def router_dashboard() -> dict:
+    p = [
+        _panel(0, "Incoming transactions / s",
+               ["rate(transaction_incoming_total[5m])"]),
+        _panel(1, "Outgoing by type / s",
+               ['rate(transaction_outgoing_total{type="standard"}[5m])',
+                'rate(transaction_outgoing_total{type="fraud"}[5m])']),
+        _panel(2, "Customer notifications out",
+               ["notifications_outgoing_total"], "stat"),
+        _panel(3, "Customer responses",
+               ['notifications_incoming_total{response="approved"}',
+                'notifications_incoming_total{response="non_approved"}'], "stat"),
+        _panel(4, "Scoring batch size p50/p95",
+               ["histogram_quantile(0.5, rate(router_batch_size_bucket[5m]))",
+                "histogram_quantile(0.95, rate(router_batch_size_bucket[5m]))"]),
+        _panel(5, "Scorer dispatch latency p99",
+               ["histogram_quantile(0.99, rate(router_score_seconds_bucket[5m]))"]),
+        _panel(6, "Decode errors / s", ["rate(transaction_decode_errors_total[5m])"]),
+    ]
+    return _dashboard("CCFD Router", "ccfd-router", p)
+
+
+def kie_dashboard() -> dict:
+    hists = [
+        "fraud_investigation_amount",
+        "fraud_approved_low_amount",
+        "fraud_approved_amount",
+        "fraud_rejected_amount",
+    ]
+    p = []
+    for i, h in enumerate(hists):
+        p.append(_panel(2 * i, f"{h} rate", [f"rate({h}_count[5m])"]))
+        p.append(_panel(2 * i + 1, f"{h} mean amount",
+                        [f"rate({h}_sum[5m]) / rate({h}_count[5m])"]))
+    p.append(_panel(8, "Process starts by definition",
+                    ['rate(process_instances_started_total[5m])']))
+    p.append(_panel(9, "Process completions by status",
+                    ['rate(process_instances_completed_total[5m])']))
+    return _dashboard("CCFD Process Engine (KIE)", "ccfd-kie", p)
+
+
+def model_prediction_dashboard() -> dict:
+    p = [
+        _panel(0, "proba_1 (last scored)", ["proba_1"]),
+        _panel(1, "Amount (last scored)", ["Amount"]),
+        _panel(2, "V17", ["V17"]),
+        _panel(3, "V10", ["V10"]),
+    ]
+    return _dashboard("CCFD Model Prediction", "ccfd-modelpred", p)
+
+
+def seldon_core_dashboard() -> dict:
+    h = "seldon_api_executor_client_requests_seconds"
+    p = [
+        _panel(0, "Request rate / s", [f"rate({h}_count[5m])"]),
+        _panel(1, "Success vs error codes / s",
+               ['rate(seldon_api_executor_server_requests_total{code="200"}[5m])',
+                'rate(seldon_api_executor_server_requests_total{code=~"4.."}[5m])',
+                'rate(seldon_api_executor_server_requests_total{code=~"5.."}[5m])']),
+    ]
+    for i, q in enumerate((0.5, 0.75, 0.9, 0.95, 0.99)):
+        p.append(
+            _panel(2 + i, f"Latency p{int(q*100)}",
+                   [f"histogram_quantile({q}, rate({h}_bucket[5m]))"])
+        )
+    return _dashboard("CCFD Serving (SeldonCore)", "ccfd-seldon", p)
+
+
+def bus_dashboard() -> dict:
+    p = [
+        _panel(0, "Producer rows / s", ["rate(producer_rows_total[5m])"]),
+        _panel(1, "Notifications sent / replies",
+               ["rate(notifications_sent_total[5m])",
+                "rate(notifications_replied_total[5m])",
+                "rate(notifications_no_reply_total[5m])"]),
+    ]
+    return _dashboard("CCFD Bus", "ccfd-bus", p)
+
+
+def retrain_dashboard() -> dict:
+    p = [
+        _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
+        _panel(1, "Optimizer steps / s", ["rate(retrain_steps_total[5m])"]),
+        _panel(2, "Serving hot swaps", ["retrain_param_swaps_total"], "stat"),
+        _panel(3, "Last training loss", ["retrain_last_loss"], "stat"),
+    ]
+    return _dashboard("CCFD Online Retrain", "ccfd-retrain", p)
+
+
+def build_all_dashboards() -> dict[str, dict]:
+    return {
+        "Router": router_dashboard(),
+        "KIE": kie_dashboard(),
+        "ModelPrediction": model_prediction_dashboard(),
+        "SeldonCore": seldon_core_dashboard(),
+        "Bus": bus_dashboard(),
+        "Retrain": retrain_dashboard(),
+    }
+
+
+def write_dashboards(out_dir: str) -> list[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for name, board in build_all_dashboards().items():
+        path = os.path.join(out_dir, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(board, f, indent=2, sort_keys=True)
+            f.write("\n")
+        paths.append(path)
+    return paths
+
+
+if __name__ == "__main__":
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "deploy/grafana"
+    for p in write_dashboards(out):
+        print(p)

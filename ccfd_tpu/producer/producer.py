@@ -32,16 +32,33 @@ class Producer:
         self.registry = registry or Registry()
         self._c_rows = self.registry.counter("producer_rows_total", "rows produced")
 
-    def run(self, limit: int | None = None, rate_per_s: float | None = None) -> int:
+    def run(
+        self,
+        limit: int | None = None,
+        rate_per_s: float | None = None,
+        wire_format: str = "dict",
+    ) -> int:
         """Stream rows to the tx topic; returns number produced.
 
         ``rate_per_s`` paces emission (sleep-based) for latency experiments;
         None streams as fast as the bus accepts (throughput experiments).
+        ``wire_format="csv"`` emits raw CSV byte rows (the reference's
+        creditcard.csv line format) which the router decodes through the
+        native C++ fast path; ``"dict"`` emits parsed transactions.
         """
+        if wire_format == "csv":
+            X = self.dataset.X
+            payloads = (
+                (",".join(repr(float(v)) for v in X[i]).encode(), i)
+                for i in range(X.shape[0])
+            )
+        else:
+            payloads = ((tx, tx["id"]) for tx in iter_transactions(self.dataset))
+
         produced = 0
         interval = 1.0 / rate_per_s if rate_per_s else 0.0
         next_emit = time.perf_counter()
-        for tx in iter_transactions(self.dataset):
+        for value, key in payloads:
             if limit is not None and produced >= limit:
                 break
             if interval:
@@ -51,7 +68,7 @@ class Producer:
                 next_emit += interval
             # the reference's producer-side `topic` env var (ProducerDeployment
             # contract) decides the sink topic, not the router's KAFKA_TOPIC
-            self.broker.produce(self.cfg.producer_topic, tx, key=tx["id"])
+            self.broker.produce(self.cfg.producer_topic, value, key=key)
             self._c_rows.inc()
             produced += 1
         return produced

@@ -32,6 +32,7 @@ from ccfd_tpu.bus.broker import Broker
 from ccfd_tpu.config import Config
 from ccfd_tpu.data.ccfd import FEATURE_NAMES
 from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.native import decode_csv as native_decode_csv
 from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL
 
 
@@ -129,19 +130,56 @@ class Router:
         records = self._tx_consumer.poll(self.max_batch, poll_timeout_s)
         if not records:
             return 0
-        txs: list[Mapping[str, Any]] = []
-        bad = 0
-        for rec in records:
-            if isinstance(rec.value, Mapping):
-                txs.append(rec.value)
-            else:  # poison pill: score as all-zeros rather than crash the loop
-                txs.append({})
-                bad += 1
-        self._c_in.inc(len(txs))
-        self._h_batch.observe(len(txs))
+        n = len(records)
+        self._c_in.inc(n)
+        self._h_batch.observe(n)
 
-        x, bad_fields = decode_features(txs)
-        bad += bad_fields
+        # Two wire formats share the batch: dict transactions (decoded in
+        # Python) and raw CSV lines (decoded by the native C++ fast path in
+        # one pass). Rows keep their arrival order.
+        x = np.zeros((n, len(FEATURE_NAMES)), np.float32)
+        txs: list[Mapping[str, Any]] = [{}] * n
+        bad = 0
+        dict_rows: list[int] = []
+        dict_vals: list[Mapping[str, Any]] = []
+        csv_rows: list[int] = []
+        csv_lines: list[bytes] = []
+        for i, rec in enumerate(records):
+            v = rec.value
+            if isinstance(v, Mapping):
+                dict_rows.append(i)
+                dict_vals.append(v)
+            elif isinstance(v, (bytes, str)):
+                raw = v.encode() if isinstance(v, str) else v
+                # one record == one CSV row; embedded newlines would desync
+                # the joined decode below, so keep only the first line and
+                # count the rest as malformed
+                lines = raw.splitlines() or [b""]
+                if len(lines) > 1:
+                    bad += len(lines) - 1
+                csv_rows.append(i)
+                csv_lines.append(lines[0])
+            else:  # poison pill: score as all-zeros rather than crash the loop
+                bad += 1
+        if dict_vals:
+            xd, bad_fields = decode_features(dict_vals)
+            bad += bad_fields
+            for j, i in enumerate(dict_rows):
+                x[i] = xd[j]
+                txs[i] = dict_vals[j]
+        if csv_lines:
+            xc, bad_csv = native_decode_csv(
+                b"\n".join(csv_lines) + b"\n", len(FEATURE_NAMES)
+            )
+            bad += bad_csv
+            amount_col = FEATURE_NAMES.index("Amount")
+            for j, i in enumerate(csv_rows):
+                if j < xc.shape[0]:
+                    x[i] = xc[j]
+                txs[i] = {
+                    "id": records[i].key,
+                    "Amount": float(x[i, amount_col]),
+                }
         if bad:
             self._c_decode_err.inc(bad)
         t0 = time.perf_counter()

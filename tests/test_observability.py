@@ -1,0 +1,61 @@
+"""Dashboards generator, tracer spans, CLI demo smoke."""
+
+import json
+
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.dashboards import build_all_dashboards, write_dashboards
+from ccfd_tpu.utils.tracing import Tracer
+
+
+def test_dashboards_cover_contract_metrics():
+    boards = build_all_dashboards()
+    assert set(boards) == {
+        "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus", "Retrain",
+    }
+    blob = json.dumps(boards)
+    for metric in [
+        "transaction_incoming_total",
+        "transaction_outgoing_total",
+        "notifications_outgoing_total",
+        "notifications_incoming_total",
+        "fraud_investigation_amount",
+        "fraud_approved_low_amount",
+        "fraud_approved_amount",
+        "fraud_rejected_amount",
+        "proba_1", "Amount", "V17", "V10",
+        "seldon_api_executor_client_requests_seconds",
+        "retrain_param_swaps_total",
+    ]:
+        assert metric in blob, f"dashboard contract missing {metric}"
+
+
+def test_write_dashboards_roundtrip(tmp_path):
+    paths = write_dashboards(str(tmp_path))
+    assert len(paths) == 6
+    for p in paths:
+        board = json.load(open(p))
+        assert board["panels"] and board["uid"].startswith("ccfd-")
+
+
+def test_tracer_spans_land_in_histogram():
+    reg = Registry()
+    tr = Tracer(reg)
+    with tr.span("score"):
+        pass
+    with tr.span("score"):
+        pass
+    assert reg.histogram("trace_span_seconds").count({"span": "score"}) == 2
+    assert len(tr.recent()) == 2
+
+
+def test_cli_demo_smoke(capsys):
+    from ccfd_tpu.cli import main
+
+    rc = main([
+        "demo", "--transactions", "60", "--train-steps", "5",
+        "--reply-timeout", "0.2", "--drain-s", "5",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["transactions"] == 60
+    assert summary["fraud_routed"] + summary["standard_routed"] == 60

@@ -1,0 +1,67 @@
+"""Pallas fused-MLP kernel vs the XLA reference path (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccfd_tpu.data.ccfd import synthetic_dataset
+from ccfd_tpu.models import mlp
+from ccfd_tpu.ops.fused_mlp import (
+    fold_for_kernel,
+    fused_mlp_score,
+    make_score_fn,
+    pad_features,
+)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    ds = synthetic_dataset(n=1024, fraud_rate=0.2, seed=11)
+    params = mlp.init(jax.random.PRNGKey(3), hidden=256)
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    return ds, params
+
+
+def test_fold_matches_reference_math(trained):
+    ds, params = trained
+    kp = fold_for_kernel(params)
+    assert kp["w1"].shape == (128, 256)
+    # folded layer-0 affine == standardize-then-affine
+    x = jnp.asarray(ds.X[:64])
+    ref_h = (x - params["norm"]["mu"]) / params["norm"]["sigma"]
+    ref_h = ref_h @ params["layers"][0]["w"] + params["layers"][0]["b"]
+    got_h = pad_features(x) @ kp["w1"] + kp["b1"]
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(ref_h), rtol=2e-4, atol=2e-4)
+
+
+def test_kernel_parity_with_xla_path(trained):
+    ds, params = trained
+    kp = fold_for_kernel(params)
+    x = jnp.asarray(ds.X[:512])
+    got = np.asarray(fused_mlp_score(kp, x, tile=256, interpret=True))
+    ref = np.asarray(mlp.apply(params, x, compute_dtype=jnp.bfloat16))
+    assert got.shape == (512,)
+    # both paths run bf16 matmuls with f32 accumulation
+    np.testing.assert_allclose(got, ref, atol=0.02)
+
+
+def test_kernel_rejects_ragged_batch(trained):
+    _, params = trained
+    kp = fold_for_kernel(params)
+    with pytest.raises(ValueError):
+        fused_mlp_score(kp, jnp.zeros((100, 30)), tile=256, interpret=True)
+
+
+def test_make_score_fn_auto_interpret(trained):
+    ds, params = trained
+    score = make_score_fn(params, tile=128)
+    out = np.asarray(score(jnp.asarray(ds.X[:128])))
+    assert out.shape == (128,)
+    assert np.all((out >= 0) & (out <= 1))
+
+
+def test_fold_rejects_wrong_depth():
+    params = mlp.init(jax.random.PRNGKey(0), depth=2)
+    with pytest.raises(ValueError):
+        fold_for_kernel(params)

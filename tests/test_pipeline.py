@@ -125,3 +125,46 @@ def test_pipeline_with_real_jax_scorer():
     assert (
         outgoing.value({"type": "fraud"}) + outgoing.value({"type": "standard"}) == 40
     )
+
+
+def test_csv_wire_format_fast_path():
+    """CSV byte rows flow through the native decoder to the same routing."""
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    ds = synthetic_dataset(n=30, seed=12)
+    Producer(CFG, broker, ds).run(limit=30, wire_format="csv")
+    total = 0
+    while (n := router.step()) > 0:
+        total += n
+    assert total == 30
+    outgoing = reg_r.counter("transaction_outgoing_total")
+    assert outgoing.value({"type": "fraud"}) + outgoing.value({"type": "standard"}) == 30
+    # fraud decisions match the dict path (same scorer on same features)
+    broker2, _, engine2, router2, _, reg_r2, _ = build()
+    Producer(CFG, broker2, ds).run(limit=30, wire_format="dict")
+    while router2.step() > 0:
+        pass
+    assert (
+        reg_r.counter("transaction_outgoing_total").value({"type": "fraud"})
+        == reg_r2.counter("transaction_outgoing_total").value({"type": "fraud"})
+    )
+
+
+def test_mixed_wire_formats_in_one_batch():
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    broker.produce(CFG.kafka_topic, {"id": 1, "Amount": 500.0})
+    broker.produce(CFG.kafka_topic, b"0.0," + b"0.0," * 28 + b"900.0", key=2)
+    assert router.step() == 2
+    out = reg_r.counter("transaction_outgoing_total")
+    assert out.value({"type": "fraud"}) == 2  # both amounts > 100
+
+
+def test_embedded_newline_csv_record_does_not_desync():
+    """A multi-line CSV payload must not shift features onto later records."""
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    two_rows = (b"0.0," * 29 + b"5.0\n") + (b"0.0," * 29 + b"6.0")
+    broker.produce(CFG.kafka_topic, two_rows, key=1)          # malformed
+    broker.produce(CFG.kafka_topic, b"0.0," * 29 + b"900.0", key=2)  # fraud
+    assert router.step() == 2
+    out = reg_r.counter("transaction_outgoing_total")
+    assert out.value({"type": "fraud"}) == 1   # the 900 row kept its features
+    assert reg_r.counter("transaction_decode_errors_total").value() >= 1
