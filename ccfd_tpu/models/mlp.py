@@ -96,12 +96,9 @@ def loss_fn(
     compute_dtype=jnp.bfloat16,
 ) -> jax.Array:
     """Weighted binary cross-entropy on logits (numerically stable)."""
-    z = logits(params, x, compute_dtype)
-    y = y.astype(jnp.float32)
-    # log-sum-exp form: max(z,0) - z*y + log(1+exp(-|z|)), weighted by class.
-    per = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-    w = jnp.where(y > 0.5, pos_weight, 1.0)
-    return jnp.sum(per * w) / jnp.sum(w)
+    from ccfd_tpu.models.losses import weighted_bce_from_logits
+
+    return weighted_bce_from_logits(logits(params, x, compute_dtype), y, pos_weight)
 
 
 def fit_numpy_reference(
