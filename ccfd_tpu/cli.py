@@ -162,6 +162,53 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_store(args: argparse.Namespace) -> int:
+    """Object-store ops: the reference run-book's Ceph/S3 steps
+    (README.md:136-343 — serve the store, upload the CSV, `aws s3 ls`)."""
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.store.client import S3Client
+    from ccfd_tpu.store.objectstore import Credentials, ObjectStore
+    from ccfd_tpu.store.server import StoreServer
+
+    cfg = Config.from_env()
+    creds = Credentials(
+        cfg.access_key_id or "ccfd-access", cfg.secret_access_key or "ccfd-secret"
+    )
+    if args.action == "serve":
+        store = ObjectStore(root=args.root)
+        store.add_credentials(creds)
+        store.create_bucket(cfg.s3_bucket)
+        srv = StoreServer(store, host=args.host, port=args.port).start()
+        print(json.dumps({"endpoint": srv.endpoint, "bucket": cfg.s3_bucket}))
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+
+    # explicit --endpoint beats the s3endpoint env var
+    client = S3Client(
+        args.endpoint or cfg.s3_endpoint or "http://127.0.0.1:9000", creds
+    )
+    if args.action == "put":
+        if args.file:
+            with open(args.file, "rb") as f:
+                data = f.read()
+        else:  # upload the (synthetic or CCFD_CSV) dataset as creditcard.csv
+            from ccfd_tpu.data.ccfd import load_dataset, to_csv_bytes
+
+            data = to_csv_bytes(load_dataset())
+        client.create_bucket(cfg.s3_bucket)
+        client.put(cfg.s3_bucket, cfg.filename, data)
+        print(json.dumps({"bucket": cfg.s3_bucket, "key": cfg.filename,
+                          "bytes": len(data)}))
+    elif args.action == "ls":
+        print(json.dumps({"bucket": cfg.s3_bucket,
+                          "keys": client.list(cfg.s3_bucket)}))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="ccfd_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -190,6 +237,16 @@ def main(argv: list[str] | None = None) -> int:
 
     b = sub.add_parser("bench", help="print the benchmark JSON line")
     b.set_defaults(fn=cmd_bench)
+
+    st = sub.add_parser("store", help="S3-shaped object store (serve/put/ls)")
+    st.add_argument("action", choices=("serve", "put", "ls"))
+    st.add_argument("--root", default=None, help="persistence dir (serve)")
+    st.add_argument("--host", default="127.0.0.1")
+    st.add_argument("--port", type=int, default=9000)
+    st.add_argument("--endpoint", default=None,
+                    help="store endpoint (overrides s3endpoint env)")
+    st.add_argument("--file", default=None, help="local file to upload (put)")
+    st.set_defaults(fn=cmd_store)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -55,6 +55,10 @@ class Config:
     s3_bucket: str = "ccdata"
     filename: str = "creditcard.csv"
     bootstrap: str = "odh-message-bus-kafka-brokers:9092"
+    # secret-ref pair from the reference's `keysecret`
+    # (ProducerDeployment.yaml:78-87, deploy/ceph/s3-secretceph.yaml:4-7)
+    access_key_id: str = ""
+    secret_access_key: str = ""
 
     # --- process engine (reference README.md:554-605 semantics) ---
     customer_reply_timeout_s: float = 30.0
@@ -102,6 +106,8 @@ class Config:
             s3_bucket=e.get("s3bucket", Config.s3_bucket),
             filename=e.get("filename", Config.filename),
             bootstrap=e.get("bootstrap", Config.bootstrap),
+            access_key_id=e.get("ACCESS_KEY_ID", Config.access_key_id),
+            secret_access_key=e.get("SECRET_ACCESS_KEY", Config.secret_access_key),
             customer_reply_timeout_s=float(
                 e.get("CCFD_REPLY_TIMEOUT_S", str(Config.customer_reply_timeout_s))
             ),

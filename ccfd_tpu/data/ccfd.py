@@ -57,24 +57,44 @@ def synthetic_dataset(
     return Dataset(X=X, y=y)
 
 
-def load_csv(path: str, limit: int | None = None) -> Dataset:
-    """Load a Kaggle-format creditcard.csv (header row, Class last column)."""
+def parse_csv_rows(rows: Iterator[list[str]] , limit: int | None = None) -> Dataset:
+    """Parse Kaggle-format rows (header first) from any csv.reader source."""
     xs: list[list[float]] = []
     ys: list[int] = []
-    with open(path, newline="") as f:
-        reader = csv.reader(f)
-        header = next(reader)
-        cols = [h.strip().strip('"') for h in header]
-        feat_idx = [cols.index(name) for name in FEATURE_NAMES]
-        label_idx = cols.index(LABEL_NAME) if LABEL_NAME in cols else None
-        for i, row in enumerate(reader):
-            if limit is not None and i >= limit:
-                break
-            xs.append([float(row[j]) for j in feat_idx])
-            ys.append(int(float(row[label_idx].strip('"'))) if label_idx is not None else 0)
+    header = next(rows)
+    cols = [h.strip().strip('"') for h in header]
+    feat_idx = [cols.index(name) for name in FEATURE_NAMES]
+    label_idx = cols.index(LABEL_NAME) if LABEL_NAME in cols else None
+    for i, row in enumerate(rows):
+        if limit is not None and i >= limit:
+            break
+        xs.append([float(row[j]) for j in feat_idx])
+        ys.append(int(float(row[label_idx].strip('"'))) if label_idx is not None else 0)
     return Dataset(
         X=np.asarray(xs, dtype=np.float32), y=np.asarray(ys, dtype=np.int32)
     )
+
+
+def load_csv(path: str, limit: int | None = None) -> Dataset:
+    """Load a Kaggle-format creditcard.csv (header row, Class last column)."""
+    with open(path, newline="") as f:
+        return parse_csv_rows(iter(csv.reader(f)), limit=limit)
+
+
+def load_csv_bytes(data: bytes, limit: int | None = None) -> Dataset:
+    """Parse an in-memory creditcard.csv, e.g. fetched from the object store."""
+    lines = data.decode("utf-8").splitlines()
+    return parse_csv_rows(iter(csv.reader(lines)), limit=limit)
+
+
+def to_csv_bytes(ds: Dataset) -> bytes:
+    """Serialize a Dataset back to the Kaggle wire format (for store upload)."""
+    out = [",".join(FEATURE_NAMES + (LABEL_NAME,))]
+    for i in range(ds.n):
+        out.append(
+            ",".join(repr(float(v)) for v in ds.X[i]) + f",{int(ds.y[i])}"
+        )
+    return ("\n".join(out) + "\n").encode()
 
 
 def load_dataset(

@@ -14,8 +14,27 @@ import time
 
 from ccfd_tpu.bus.broker import Broker
 from ccfd_tpu.config import Config
-from ccfd_tpu.data.ccfd import Dataset, iter_transactions, load_dataset
+from ccfd_tpu.data.ccfd import (
+    Dataset,
+    iter_transactions,
+    load_csv_bytes,
+    load_dataset,
+)
 from ccfd_tpu.metrics.prom import Registry
+
+
+def dataset_from_store(cfg: Config, limit: int | None = None) -> Dataset:
+    """Fetch ``filename`` from ``s3bucket`` at ``s3endpoint`` — exactly the
+    reference producer's data path (ProducerDeployment.yaml:90-95): endpoint +
+    bucket + key env vars, credentials from the ``keysecret`` pair."""
+    from ccfd_tpu.store.client import S3Client
+    from ccfd_tpu.store.objectstore import Credentials
+
+    client = S3Client(
+        cfg.s3_endpoint,
+        Credentials(cfg.access_key_id, cfg.secret_access_key),
+    )
+    return load_csv_bytes(client.get(cfg.s3_bucket, cfg.filename), limit=limit)
 
 
 class Producer:
@@ -28,7 +47,12 @@ class Producer:
     ):
         self.cfg = cfg
         self.broker = broker
-        self.dataset = dataset if dataset is not None else load_dataset()
+        if dataset is not None:
+            self.dataset = dataset
+        elif cfg.s3_endpoint:
+            self.dataset = dataset_from_store(cfg)
+        else:
+            self.dataset = load_dataset()
         self.registry = registry or Registry()
         self._c_rows = self.registry.counter("producer_rows_total", "rows produced")
 
