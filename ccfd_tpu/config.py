@@ -48,6 +48,10 @@ class Config:
     # --- HTTP client knobs (reference README.md:386-393) ---
     seldon_timeout_ms: int = 5000
     seldon_pool_size: int = 5
+    # new: bounded retries on transport failure (reference's only failure
+    # knob is the timeout; retries keep the pipeline up across scorer
+    # restarts under the supervisor)
+    client_retries: int = 2
 
     # --- producer (reference ProducerDeployment.yaml:88-97) ---
     producer_topic: str = "odh-demo"
@@ -101,6 +105,7 @@ class Config:
             ),
             seldon_timeout_ms=int(e.get("SELDON_TIMEOUT", str(Config.seldon_timeout_ms))),
             seldon_pool_size=int(e.get("SELDON_POOL_SIZE", str(Config.seldon_pool_size))),
+            client_retries=int(e.get("CCFD_CLIENT_RETRIES", str(Config.client_retries))),
             producer_topic=e.get("topic", Config.producer_topic),
             s3_endpoint=e.get("s3endpoint", Config.s3_endpoint),
             s3_bucket=e.get("s3bucket", Config.s3_bucket),

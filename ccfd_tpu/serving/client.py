@@ -15,6 +15,7 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import time
 import urllib.parse
 from typing import Any
 
@@ -42,28 +43,41 @@ class SeldonClient:
         return http.client.HTTPConnection(self._host, self._port, timeout=self._timeout)
 
     def _request(self, body: dict[str, Any]) -> dict[str, Any]:
+        """POST with per-attempt SELDON_TIMEOUT and bounded retries.
+
+        Retries (CCFD_CLIENT_RETRIES, with short linear backoff) cover the
+        window where the supervisor is restarting a crashed scorer — the
+        reference has no app-level retry, only the timeout knob
+        (README.md:386-393), so a scorer restart drops messages there.
+        """
         conn = self._pool.get()
         try:
             payload = json.dumps(body)
             headers = {"Content-Type": "application/json"}
             if self.cfg.seldon_token:
                 headers["Authorization"] = f"Bearer {self.cfg.seldon_token}"
-            try:
-                conn.request("POST", self._path, payload, headers)
-                resp = conn.getresponse()
-                data = resp.read()
-            except (http.client.HTTPException, OSError):
-                # stale pooled connection: reconnect once
-                conn.close()
-                conn = self._connect()
-                conn.request("POST", self._path, payload, headers)
-                resp = conn.getresponse()
-                data = resp.read()
-            if resp.status != 200:
-                raise RuntimeError(
-                    f"prediction server returned {resp.status}: {data[:200]!r}"
-                )
-            return json.loads(data)
+            attempts = max(1, self.cfg.client_retries + 1)
+            last_exc: Exception | None = None
+            for attempt in range(attempts):
+                try:
+                    conn.request("POST", self._path, payload, headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"prediction server returned {resp.status}: {data[:200]!r}"
+                        )
+                    return json.loads(data)
+                except (http.client.HTTPException, OSError) as e:
+                    # stale pooled connection or server mid-restart: reconnect
+                    last_exc = e
+                    conn.close()
+                    if attempt < attempts - 1:
+                        time.sleep(0.05 * (attempt + 1))
+                    conn = self._connect()
+            raise ConnectionError(
+                f"prediction server unreachable after {attempts} attempts"
+            ) from last_exc
         finally:
             self._pool.put(conn)
 

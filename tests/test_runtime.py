@@ -1,0 +1,260 @@
+"""Runtime supervision tests: restart policies, backoff, probes, client retry.
+
+The failure-detection capability the reference delegates to Kubernetes
+(restartPolicy: Always, crash-loop backoff, readiness gates — reference
+deploy/router.yaml:75, README.md:81-85) exercised in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ccfd_tpu.runtime.health import HealthServer
+from ccfd_tpu.runtime.supervisor import (
+    ManagedService,
+    RestartPolicy,
+    ServiceState,
+    Supervisor,
+)
+
+
+def wait_until(pred, timeout_s=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class FlakyService:
+    """Crashes `fail_times` times, then runs until stopped."""
+
+    def __init__(self, fail_times: int):
+        self.fail_times = fail_times
+        self.attempts = 0
+        self._stop = threading.Event()
+        self.became_stable = threading.Event()
+
+    def run(self) -> None:
+        self.attempts += 1
+        if self.attempts <= self.fail_times:
+            raise RuntimeError(f"boom #{self.attempts}")
+        self.became_stable.set()
+        self._stop.wait()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class TestSupervisor:
+    def test_restart_until_stable(self):
+        svc = FlakyService(fail_times=3)
+        sup = Supervisor(backoff_initial_s=0.01, backoff_cap_s=0.05)
+        sup.add_thread_service("flaky", svc.run, svc.stop)
+        sup.start()
+        try:
+            assert wait_until(svc.became_stable.is_set)
+            assert svc.attempts == 4
+            st = sup.status()["flaky"]
+            assert st["state"] == "Running"
+            assert st["restarts"] == 3
+            assert "boom #3" in st["last_error"]
+        finally:
+            sup.stop()
+        assert sup.status()["flaky"]["state"] == "Stopped"
+
+    def test_policy_never_does_not_restart(self):
+        svc = FlakyService(fail_times=100)
+        sup = Supervisor(backoff_initial_s=0.01)
+        sup.add_thread_service(
+            "oneshot", svc.run, svc.stop, policy=RestartPolicy.NEVER
+        )
+        sup.start()
+        try:
+            assert wait_until(
+                lambda: sup.status()["oneshot"]["state"] == "Failed"
+            )
+            time.sleep(0.2)
+            assert svc.attempts == 1
+        finally:
+            sup.stop()
+
+    def test_policy_on_failure_ignores_clean_exit(self):
+        ran = []
+        sup = Supervisor(backoff_initial_s=0.01)
+        sup.add_thread_service(
+            "clean", lambda: ran.append(1), policy=RestartPolicy.ON_FAILURE
+        )
+        sup.start()
+        try:
+            assert wait_until(
+                lambda: sup.status()["clean"]["state"] == "Succeeded"
+            )
+            time.sleep(0.2)
+            assert ran == [1]
+        finally:
+            sup.stop()
+
+    def test_policy_always_restarts_clean_exit(self):
+        counter = {"n": 0}
+
+        def run():
+            counter["n"] += 1
+            time.sleep(0.01)
+
+        sup = Supervisor(backoff_initial_s=0.01)
+        sup.add_thread_service("looper", run, policy=RestartPolicy.ALWAYS)
+        sup.start()
+        try:
+            assert wait_until(lambda: counter["n"] >= 3)
+        finally:
+            sup.stop()
+
+    def test_max_restarts_bounds_crash_loop(self):
+        svc = FlakyService(fail_times=100)
+        sup = Supervisor(backoff_initial_s=0.005)
+        sup.add_thread_service("dying", svc.run, svc.stop, max_restarts=2)
+        sup.start()
+        try:
+            assert wait_until(lambda: svc.attempts == 3 and
+                              sup.status()["dying"]["state"] == "Failed")
+            time.sleep(0.1)
+            assert svc.attempts == 3  # initial + 2 restarts, then give up
+        finally:
+            sup.stop()
+
+    def test_backoff_grows_with_streak(self):
+        """Consecutive crashes must be spaced by growing backoff."""
+        times: list[float] = []
+
+        def run():
+            times.append(time.monotonic())
+            raise RuntimeError("x")
+
+        sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=10.0,
+                         poll_interval_s=0.005)
+        sup.add_thread_service("crasher", run)
+        sup.start()
+        try:
+            assert wait_until(lambda: len(times) >= 4, timeout_s=10.0)
+        finally:
+            sup.stop()
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        # doubling: ~0.05, ~0.1, ~0.2 (allow generous jitter, require order)
+        assert gaps[1] > gaps[0] * 1.3
+        assert gaps[2] > gaps[1] * 1.3
+
+    def test_readiness_gate(self):
+        ready_flag = threading.Event()
+        stop_flag = threading.Event()
+        sup = Supervisor()
+        sup.add_thread_service(
+            "gated", stop_flag.wait, stop_flag.set, ready=ready_flag.is_set
+        )
+        sup.start()
+        try:
+            assert wait_until(
+                lambda: sup.status()["gated"]["state"] == "Running"
+            )
+            assert not sup.ready()
+            ready_flag.set()
+            assert sup.wait_ready(timeout_s=2.0)
+        finally:
+            sup.stop()
+
+    def test_duplicate_name_rejected(self):
+        sup = Supervisor()
+        sup.add_thread_service("a", lambda: None)
+        with pytest.raises(ValueError):
+            sup.add_thread_service("a", lambda: None)
+
+
+class TestHealthServer:
+    def test_probe_endpoints(self):
+        stop_flag = threading.Event()
+        sup = Supervisor()
+        sup.add_thread_service("svc", stop_flag.wait, stop_flag.set)
+        sup.start()
+        hs = HealthServer(sup).start()
+        try:
+            def get(path):
+                try:
+                    with urllib.request.urlopen(hs.endpoint + path) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            assert get("/healthz") == (200, {"ok": True})
+            assert wait_until(lambda: get("/readyz")[0] == 200)
+            status, body = get("/status")
+            assert status == 200 and body["svc"]["state"] == "Running"
+            assert get("/nope")[0] == 404
+        finally:
+            hs.stop()
+            sup.stop()
+
+
+class TestClientRetry:
+    def test_scoring_survives_server_restart(self):
+        """Seldon-contract client rides through a scorer restart (the
+        supervisor-restart window the retry knob exists for)."""
+        import numpy as np
+
+        from ccfd_tpu.config import Config
+        from ccfd_tpu.serving.client import SeldonClient
+        from ccfd_tpu.serving.scorer import Scorer
+        from ccfd_tpu.serving.server import PredictionServer
+
+        scorer = Scorer(model_name="logreg", batch_sizes=(16,))
+        srv = PredictionServer(scorer)
+        port = srv.start(host="127.0.0.1", port=0)
+        cfg = Config(
+            seldon_url=f"http://127.0.0.1:{port}",
+            seldon_timeout_ms=2000,
+            client_retries=30,  # generous: restart takes a moment
+        )
+        client = SeldonClient(cfg)
+        x = np.zeros((4, 30), np.float32)
+        assert client.score(x).shape == (4,)
+
+        srv.stop()
+        # restart on the same port while the client retries
+        result: dict = {}
+
+        def score_during_restart():
+            result["proba"] = client.score(x)
+
+        t = threading.Thread(target=score_during_restart)
+        t.start()
+        time.sleep(0.2)
+        srv2 = PredictionServer(scorer)
+        srv2.start(host="127.0.0.1", port=port)
+        try:
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+            assert result["proba"].shape == (4,)
+        finally:
+            srv2.stop()
+            client.close()
+
+    def test_exhausted_retries_raise_connection_error(self):
+        import numpy as np
+
+        from ccfd_tpu.config import Config
+        from ccfd_tpu.serving.client import SeldonClient
+
+        cfg = Config(
+            seldon_url="http://127.0.0.1:1",  # nothing listens on port 1
+            seldon_timeout_ms=200,
+            client_retries=1,
+        )
+        client = SeldonClient(cfg)
+        with pytest.raises(ConnectionError):
+            client.score(np.zeros((1, 30), np.float32))
+        client.close()
