@@ -209,6 +209,36 @@ def cmd_store(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_up(args: argparse.Namespace) -> int:
+    """Operator entry: CR file -> running platform (the reference run-book
+    README.md:44-537 as one command)."""
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    spec = PlatformSpec.from_yaml(args.file)
+    platform = Platform(spec).up()
+    print(json.dumps(platform.status(), indent=2), file=sys.stderr)
+    try:
+        if args.exit_after_producer and not spec.component("producer").enabled:
+            print("[up] --exit-after-producer given but producer is disabled "
+                  "in the CR", file=sys.stderr)
+            platform.down()
+            return 2
+        if args.exit_after_producer:
+            platform.wait_producer(timeout_s=args.drain_s)
+            time.sleep(2.0)  # let timers/signals drain
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for name, reg in platform.registries.items():
+            print(f"--- {name} ---", file=sys.stderr)
+            print(reg.render(), file=sys.stderr)
+        platform.down()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="ccfd_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -247,6 +277,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="store endpoint (overrides s3endpoint env)")
     st.add_argument("--file", default=None, help="local file to upload (put)")
     st.set_defaults(fn=cmd_store)
+
+    u = sub.add_parser("up", help="bring up the platform from a CR file")
+    u.add_argument("-f", "--file", default="deploy/platform_cr.yaml")
+    u.add_argument("--exit-after-producer", action="store_true")
+    u.add_argument("--drain-s", type=float, default=120.0)
+    u.set_defaults(fn=cmd_up)
 
     args = p.parse_args(argv)
     return args.fn(args)

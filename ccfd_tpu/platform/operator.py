@@ -1,0 +1,389 @@
+"""Platform operator: CR-shaped spec -> running pipeline, in run-book order.
+
+The reference is deployed by an OpenDataHub operator CR whose spec toggles
+each platform component (Seldon, Kafka, monitoring, notebooks — reference
+deploy/frauddetection_cr.yaml:1-89) followed by a 600-line run-book whose
+step order is a dependency sort (reference README.md:44-537; SURVEY.md §3 D:
+project → operator → Kafka → Ceph/S3 → model → data → KIE → notification →
+router → producer → monitoring). This module is both: a declarative spec
+(`PlatformSpec`, loadable from a CR-shaped YAML) and the operator that
+brings components up in that topological order with readiness gates between
+steps, running every long-lived service under the runtime Supervisor
+(restart-on-crash) with health probes and a single Prometheus exporter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Mapping
+
+from ccfd_tpu.config import Config
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentSpec:
+    enabled: bool = True
+    options: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def opt(self, key: str, default: Any = None) -> Any:
+        return self.options.get(key, default)
+
+
+_COMPONENTS = (
+    "store",      # Ceph/S3 analog (L0)
+    "bus",        # Strimzi Kafka analog (L2)
+    "scorer",     # Seldon model serving (L4)
+    "engine",     # KIE server (L5)
+    "notify",     # notification service (L6)
+    "router",     # Camel router (L3)
+    "producer",   # Kafka producer (L1) — one-shot job semantics
+    "retrain",    # online retrain (new; BASELINE.json configs[4])
+    "monitoring", # Prometheus exporter (L7)
+    "health",     # runtime probes (platform)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlatformSpec:
+    components: Mapping[str, ComponentSpec]
+    cfg: Config
+
+    @staticmethod
+    def from_cr(cr: Mapping[str, Any], cfg: Config | None = None) -> "PlatformSpec":
+        """Parse a CR-shaped mapping: top-level ``spec`` holds one block per
+        component (the frauddetection_cr.yaml shape), each with ``enabled``
+        plus free-form options."""
+        spec = cr.get("spec", cr)
+        comps: dict[str, ComponentSpec] = {}
+        for name in _COMPONENTS:
+            block = spec.get(name, {})
+            if isinstance(block, bool):
+                block = {"enabled": block}
+            comps[name] = ComponentSpec(
+                enabled=bool(block.get("enabled", name not in ("producer", "store"))),
+                options={k: v for k, v in block.items() if k != "enabled"},
+            )
+        return PlatformSpec(components=comps, cfg=cfg or Config.from_env())
+
+    @staticmethod
+    def from_yaml(path: str, cfg: Config | None = None) -> "PlatformSpec":
+        import yaml
+
+        with open(path) as f:
+            return PlatformSpec.from_cr(yaml.safe_load(f) or {}, cfg=cfg)
+
+    def component(self, name: str) -> ComponentSpec:
+        return self.components.get(name, ComponentSpec(enabled=False))
+
+
+class Platform:
+    """Brings a PlatformSpec up/down; owns every component's lifecycle."""
+
+    def __init__(self, spec: PlatformSpec):
+        self.spec = spec
+        self.cfg = spec.cfg
+        self.registries: dict[str, Any] = {}
+        self.supervisor = None
+        self.broker = None
+        self.scorer = None
+        self.engine = None
+        self.store_server = None
+        self.prediction_server = None
+        self.prediction_host = "127.0.0.1"
+        self.prediction_port = 0
+        self.exporter = None
+        self.health_server = None
+        self._producer_done = threading.Event()
+        self._up = False
+
+    # -- bring-up, in the run-book's dependency order ---------------------
+    def up(self, wait_ready_s: float = 30.0) -> "Platform":
+        from ccfd_tpu.runtime.supervisor import Supervisor
+
+        if self._up:
+            return self
+        spec, cfg = self.spec, self.cfg
+        self.supervisor = Supervisor()
+
+        # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
+        if spec.component("store").enabled:
+            self._up_store()
+
+        # 2. bus (Kafka, README.md:87-134)
+        if spec.component("bus").enabled:
+            from ccfd_tpu.bus.broker import Broker
+
+            self.broker = Broker(
+                default_partitions=int(spec.component("bus").opt("partitions", 3))
+            )
+        else:
+            needs_bus = [
+                n for n in ("engine", "notify", "router", "retrain", "producer")
+                if spec.component(n).enabled
+            ]
+            if needs_bus:
+                raise ValueError(
+                    f"bus disabled in CR but required by: {needs_bus}"
+                )
+
+        # 3. model serving (Seldon, README.md:271-301)
+        if spec.component("scorer").enabled:
+            self._up_scorer()
+
+        # 4. process engine (KIE, README.md:345-408)
+        if spec.component("engine").enabled:
+            self._up_engine()
+
+        # 5. notification service (README.md:410-422)
+        if spec.component("notify").enabled:
+            self._up_notify()
+
+        # 6. router (README.md:424-459)
+        if spec.component("router").enabled:
+            self._up_router()
+
+        # 7. online retrain (new capability; BASELINE.json configs[4])
+        if spec.component("retrain").enabled and self.scorer is not None:
+            self._up_retrain()
+
+        # 8. monitoring (README.md:487-537)
+        if spec.component("monitoring").enabled:
+            from ccfd_tpu.metrics.exporter import MetricsExporter
+
+            mon = spec.component("monitoring")
+            self.exporter = MetricsExporter(
+                self.registries,
+                host=mon.opt("host", "127.0.0.1"),
+                port=int(mon.opt("port", 0)),
+            ).start()
+
+        if spec.component("health").enabled:
+            from ccfd_tpu.runtime.health import HealthServer
+
+            h = spec.component("health")
+            self.health_server = HealthServer(
+                self.supervisor,
+                host=h.opt("host", "127.0.0.1"),
+                port=int(h.opt("port", 0)),
+            ).start()
+
+        self.supervisor.start()
+        if not self.supervisor.wait_ready(timeout_s=wait_ready_s):
+            raise TimeoutError(
+                f"platform not ready after {wait_ready_s}s: "
+                f"{self.supervisor.status()}"
+            )
+
+        # 9. producer last (README.md:461-485) — starts the traffic
+        if spec.component("producer").enabled:
+            self._up_producer()
+
+        self._up = True
+        return self
+
+    # -- per-component builders -------------------------------------------
+    def _registry(self, name: str):
+        from ccfd_tpu.metrics.prom import Registry
+
+        if name not in self.registries:
+            self.registries[name] = Registry()
+            if self.exporter is not None:  # registries created post-start
+                self.exporter.add(name, self.registries[name])
+        return self.registries[name]
+
+    def _up_store(self) -> None:
+        from ccfd_tpu.data.ccfd import load_dataset, to_csv_bytes
+        from ccfd_tpu.store.objectstore import Credentials, ObjectStore
+        from ccfd_tpu.store.server import StoreServer
+
+        c = self.spec.component("store")
+        cfg = self.cfg
+        store = ObjectStore(root=c.opt("root"))
+        store.add_credentials(
+            Credentials(
+                cfg.access_key_id or "ccfd-access",
+                cfg.secret_access_key or "ccfd-secret",
+            )
+        )
+        store.create_bucket(cfg.s3_bucket)
+        if c.opt("seed_dataset", True):
+            try:
+                store.get(cfg.s3_bucket, cfg.filename)
+            except Exception:  # noqa: BLE001 — absent: upload (README.md:303-343)
+                store.put(cfg.s3_bucket, cfg.filename, to_csv_bytes(load_dataset()))
+        self.store_server = StoreServer(
+            store, host=c.opt("host", "127.0.0.1"), port=int(c.opt("port", 0))
+        ).start()
+        # repoint the producer's endpoint at the live store
+        self.cfg = dataclasses.replace(
+            self.cfg,
+            s3_endpoint=self.store_server.endpoint,
+            access_key_id=self.cfg.access_key_id or "ccfd-access",
+            secret_access_key=self.cfg.secret_access_key or "ccfd-secret",
+        )
+
+    def _up_scorer(self) -> None:
+        from ccfd_tpu.serving.scorer import Scorer
+
+        c = self.spec.component("scorer")
+        cfg = self.cfg
+        params = None
+        if c.opt("train_steps", 0):
+            from ccfd_tpu.data.ccfd import load_dataset
+            from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+
+            ds = load_dataset()
+            params = fit_mlp(
+                ds.X, ds.y, steps=int(c.opt("train_steps")),
+                tc=TrainConfig(compute_dtype="float32"),
+            )
+        self.scorer = Scorer(
+            model_name=c.opt("model", cfg.model_name),
+            params=params,
+            compute_dtype=c.opt("dtype", cfg.compute_dtype),
+            batch_sizes=cfg.batch_sizes,
+        )
+        self.scorer.warmup()
+        if c.opt("rest", False):
+            from ccfd_tpu.serving.server import PredictionServer
+
+            self.prediction_server = PredictionServer(
+                self.scorer, self.cfg, self._registry("seldon")
+            )
+            self.prediction_host = c.opt("host", "127.0.0.1")
+            self.prediction_port = self.prediction_server.start(
+                self.prediction_host, int(c.opt("port", 0))
+            )
+
+    def _up_engine(self) -> None:
+        from ccfd_tpu.process.fraud import build_engine
+        from ccfd_tpu.process.prediction import ScorerPredictionService
+
+        pred = (
+            ScorerPredictionService(self.scorer.score)
+            if self.scorer is not None
+            else None
+        )
+        self.engine = build_engine(
+            self.cfg, self.broker, self._registry("kie"), prediction_service=pred
+        )
+
+    def _up_notify(self) -> None:
+        from ccfd_tpu.notify.service import NotificationService
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("notify")
+        notify = NotificationService(
+            self.cfg, self.broker, self._registry("notify"),
+            seed=int(c.opt("seed", 0)),
+        )
+        self.supervisor.add_thread_service(
+            "notify",
+            lambda: notify.run(poll_timeout_s=0.02),
+            notify.stop,
+            policy=RestartPolicy.ALWAYS,
+        )
+
+    def _up_router(self) -> None:
+        from ccfd_tpu.router.router import Router
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        if self.scorer is not None:
+            score_fn = self.scorer.score
+        else:  # remote scorer over the Seldon REST contract
+            from ccfd_tpu.serving.client import SeldonClient
+
+            score_fn = SeldonClient(self.cfg).score
+        router = Router(
+            self.cfg, self.broker, score_fn, self.engine, self._registry("router")
+        )
+        self.supervisor.add_thread_service(
+            "router",
+            lambda: router.run(poll_timeout_s=0.02),
+            router.stop,
+            policy=RestartPolicy.ALWAYS,
+        )
+
+    def _up_retrain(self) -> None:
+        from ccfd_tpu.parallel.online import OnlineTrainer
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("retrain")
+        trainer = OnlineTrainer(
+            self.cfg, self.broker, self.scorer, self.scorer.params,
+            registry=self._registry("retrain"),
+        )
+        interval = float(c.opt("interval_s", 0.5))
+        self.supervisor.add_thread_service(
+            "retrain",
+            lambda: trainer.run(interval_s=interval),
+            trainer.stop,
+            policy=RestartPolicy.ALWAYS,
+        )
+
+    def _up_producer(self) -> None:
+        from ccfd_tpu.producer.producer import Producer
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("producer")
+        producer = Producer(
+            self.cfg, self.broker, registry=self._registry("producer")
+        )
+        limit = c.opt("transactions")
+        rate = c.opt("rate")
+        wire = c.opt("wire_format", "dict")
+        done = self._producer_done
+
+        def run() -> None:
+            try:
+                producer.run(
+                    limit=int(limit) if limit is not None else None,
+                    rate_per_s=float(rate) if rate else None,
+                    wire_format=wire,
+                )
+            finally:
+                done.set()
+
+        # one-shot job semantics, like the reference's producer pod
+        self.supervisor.add_thread_service(
+            "producer", run, policy=RestartPolicy.NEVER
+        )
+        self.supervisor.start_service("producer")
+
+    # -- status / teardown -------------------------------------------------
+    def wait_producer(self, timeout_s: float = 60.0) -> bool:
+        return self._producer_done.wait(timeout=timeout_s)
+
+    def status(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "services": self.supervisor.status() if self.supervisor else {},
+            "endpoints": {},
+        }
+        if self.store_server:
+            out["endpoints"]["store"] = self.store_server.endpoint
+        if self.prediction_server:
+            out["endpoints"]["scorer"] = (
+                f"http://{self.prediction_host}:{self.prediction_port}"
+            )
+        if self.exporter:
+            out["endpoints"]["metrics"] = self.exporter.endpoint
+        if self.health_server:
+            out["endpoints"]["health"] = self.health_server.endpoint
+        return out
+
+    def down(self) -> None:
+        if self.supervisor:
+            self.supervisor.stop()
+        for srv in (
+            self.prediction_server,
+            self.exporter,
+            self.health_server,
+            self.store_server,
+        ):
+            if srv is not None:
+                try:
+                    srv.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+        self._up = False

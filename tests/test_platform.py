@@ -1,0 +1,175 @@
+"""Platform operator tests: CR parsing, topological bring-up, end-to-end flow.
+
+The reference's deployment contract — an operator CR with component toggles
+(deploy/frauddetection_cr.yaml) applied through an ordered run-book with
+readiness gates (README.md:44-537) — exercised in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+
+def minimal_cr(**overrides) -> dict:
+    spec = {
+        "store": {"enabled": False},
+        "bus": {"partitions": 2},
+        "scorer": {"enabled": True, "model": "logreg", "train_steps": 0},
+        "engine": {"enabled": True},
+        "notify": {"enabled": True, "seed": 0},
+        "router": {"enabled": True},
+        "retrain": {"enabled": False},
+        "producer": {"enabled": False},
+        "monitoring": {"enabled": True},
+        "health": {"enabled": True},
+    }
+    spec.update(overrides)
+    return {"apiVersion": "ccfd.tpu/v1", "kind": "FraudDetectionPlatform",
+            "spec": spec}
+
+
+class TestSpecParsing:
+    def test_defaults_without_blocks(self):
+        spec = PlatformSpec.from_cr({"spec": {}}, cfg=Config())
+        assert spec.component("router").enabled
+        assert spec.component("scorer").enabled
+        assert not spec.component("producer").enabled  # job: explicit opt-in
+        assert not spec.component("store").enabled
+
+    def test_bool_shorthand(self):
+        spec = PlatformSpec.from_cr(
+            {"spec": {"notify": False, "store": True}}, cfg=Config()
+        )
+        assert not spec.component("notify").enabled
+        assert spec.component("store").enabled
+
+    def test_options_surface(self):
+        spec = PlatformSpec.from_cr(minimal_cr(), cfg=Config())
+        assert spec.component("bus").opt("partitions") == 2
+        assert spec.component("scorer").opt("model") == "logreg"
+
+    def test_yaml_roundtrip(self, tmp_path):
+        import yaml
+
+        p = tmp_path / "cr.yaml"
+        p.write_text(yaml.safe_dump(minimal_cr()))
+        spec = PlatformSpec.from_yaml(str(p), cfg=Config())
+        assert spec.component("scorer").opt("model") == "logreg"
+
+
+class TestBringUp:
+    def test_up_ready_down(self):
+        spec = PlatformSpec.from_cr(minimal_cr(), cfg=Config())
+        platform = Platform(spec).up(wait_ready_s=20.0)
+        try:
+            st = platform.status()
+            assert st["services"]["router"]["state"] == "Running"
+            assert st["services"]["notify"]["state"] == "Running"
+            assert "metrics" in st["endpoints"]
+            assert "health" in st["endpoints"]
+        finally:
+            platform.down()
+        assert platform.status()["services"]["router"]["state"] == "Stopped"
+
+    def test_probes_and_metrics_endpoints_live(self):
+        spec = PlatformSpec.from_cr(minimal_cr(), cfg=Config())
+        platform = Platform(spec).up(wait_ready_s=20.0)
+        try:
+            health = platform.status()["endpoints"]["health"]
+            with urllib.request.urlopen(health + "/readyz") as r:
+                assert json.loads(r.read())["ready"] is True
+            metrics = platform.status()["endpoints"]["metrics"]
+            with urllib.request.urlopen(metrics + "/prometheus/router") as r:
+                body = r.read().decode()
+            assert "transaction_incoming_total" in body
+            # KIE registry on the reference's scrape path
+            with urllib.request.urlopen(metrics + "/rest/metrics") as r:
+                assert "fraud_investigation_amount" in r.read().decode()
+        finally:
+            platform.down()
+
+    def test_full_pipeline_with_producer_and_store(self):
+        """CR-driven end-to-end: store-seeded dataset -> producer -> router ->
+        scorer -> engine; transactions land as process starts."""
+        cfg = Config(customer_reply_timeout_s=0.5)
+        cr = minimal_cr(
+            store={"enabled": True, "seed_dataset": True},
+            producer={"enabled": True, "transactions": 300},
+        )
+        spec = PlatformSpec.from_cr(cr, cfg=cfg)
+        platform = Platform(spec).up(wait_ready_s=20.0)
+        try:
+            assert platform.wait_producer(timeout_s=30.0)
+            router_reg = platform.registries["router"]
+            deadline = time.monotonic() + 30.0
+            c_in = router_reg.counter("transaction_incoming_total")
+            while time.monotonic() < deadline and c_in.value() < 300:
+                time.sleep(0.05)
+            assert c_in.value() == 300
+            out = router_reg.counter("transaction_outgoing_total")
+            started = out.value(labels={"type": "standard"}) + out.value(
+                labels={"type": "fraud"}
+            )
+            assert started > 0  # processes started on the engine
+        finally:
+            platform.down()
+
+    def test_producer_registry_reaches_exporter_and_readyz_stays_up(self):
+        """Registries created after exporter start must still be scraped, and
+        a finished one-shot producer must not degrade readiness."""
+        cfg = Config(customer_reply_timeout_s=0.2)
+        cr = minimal_cr(producer={"enabled": True, "transactions": 50})
+        platform = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+        try:
+            assert platform.wait_producer(timeout_s=20.0)
+            deadline = time.monotonic() + 10.0
+            while (time.monotonic() < deadline and
+                   platform.status()["services"]["producer"]["state"] != "Succeeded"):
+                time.sleep(0.05)
+            metrics = platform.status()["endpoints"]["metrics"]
+            with urllib.request.urlopen(metrics + "/prometheus/producer") as r:
+                assert "producer_rows_total" in r.read().decode()
+            health = platform.status()["endpoints"]["health"]
+            with urllib.request.urlopen(health + "/readyz") as r:
+                assert r.status == 200
+        finally:
+            platform.down()
+
+    def test_healthz_degrades_after_supervisor_stop(self):
+        from ccfd_tpu.runtime.health import HealthServer
+        from ccfd_tpu.runtime.supervisor import Supervisor
+
+        sup = Supervisor().start()
+        hs = HealthServer(sup).start()
+        try:
+            with urllib.request.urlopen(hs.endpoint + "/healthz") as r:
+                assert r.status == 200
+            sup.stop()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(hs.endpoint + "/healthz")
+            assert exc.value.code == 503
+        finally:
+            hs.stop()
+
+    def test_bus_disabled_with_dependents_errors(self):
+        cr = minimal_cr(bus={"enabled": False})
+        with pytest.raises(ValueError, match="bus disabled"):
+            Platform(PlatformSpec.from_cr(cr, cfg=Config())).up()
+
+    def test_missing_engine_block_disables_engine(self):
+        cr = minimal_cr(engine={"enabled": False}, router={"enabled": False},
+                        retrain={"enabled": False})
+        spec = PlatformSpec.from_cr(cr, cfg=Config())
+        platform = Platform(spec).up(wait_ready_s=10.0)
+        try:
+            assert platform.engine is None
+            assert "router" not in platform.status()["services"]
+        finally:
+            platform.down()
