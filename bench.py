@@ -13,7 +13,8 @@ Prints ONE JSON line:
 (BASELINE.json: the reference publishes no numbers of its own — the
 driver-set target is the baseline to beat; >1.0 means the target is beaten).
 
-Env knobs: CCFD_BENCH_BATCH (default 16384), CCFD_BENCH_SECONDS (default 3),
+Env knobs: CCFD_BENCH_BATCH (default 131072), CCFD_BENCH_SECONDS (default 3),
+CCFD_BENCH_PIPELINE (in-flight dispatch depth, default 2),
 CCFD_BENCH_PLATFORM=cpu to force CPU (local testing without the TPU tunnel).
 """
 
@@ -38,8 +39,9 @@ def main() -> None:
     from ccfd_tpu.models import mlp
     from ccfd_tpu.serving.scorer import Scorer
 
-    batch = int(os.environ.get("CCFD_BENCH_BATCH", "16384"))
+    batch = int(os.environ.get("CCFD_BENCH_BATCH", "131072"))
     seconds = float(os.environ.get("CCFD_BENCH_SECONDS", "3"))
+    depth = int(os.environ.get("CCFD_BENCH_PIPELINE", "2"))
 
     ds = synthetic_dataset(n=max(batch, 4096), fraud_rate=0.01, seed=0)
     params = mlp.init(jax.random.PRNGKey(0))
@@ -53,11 +55,12 @@ def main() -> None:
     scorer.warmup()
 
     x = ds.X[:batch]
-    # timed region: full host->device->host scoring round trips
+    # timed region: full host->device->host scoring round trips (the fused
+    # Pallas kernel + bf16 wire + pipelined dispatch when depth > 1)
     n_rows = 0
     t0 = time.perf_counter()
     while True:
-        proba = scorer.score(x)
+        proba = scorer.score_pipelined(x, depth=depth)
         n_rows += x.shape[0]
         elapsed = time.perf_counter() - t0
         if elapsed >= seconds:

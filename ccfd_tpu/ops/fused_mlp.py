@@ -97,11 +97,17 @@ def fused_mlp_score(
     tile: int = DEFAULT_TILE,
     interpret: bool = False,
 ) -> jax.Array:
-    """(B, F<=128) float32 -> (B,) float32 proba. B must be a tile multiple."""
+    """(B, F<=128) float or bfloat16 -> (B,) float32 proba. B must be a tile
+    multiple. bfloat16 input is the fast path: the kernel computes in bf16
+    regardless, and bf16 rows halve the host->HBM transfer — on serving
+    setups where the wire dominates (tunneled chips, DCN-remote hosts) that
+    is ~2x end-to-end throughput for identical numerics."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    x = pad_features(x.astype(jnp.float32))
+    if x.dtype != jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    x = pad_features(x)
     batch = x.shape[0]
     if batch % tile != 0:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")

@@ -23,6 +23,7 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 from ccfd_tpu.data.ccfd import NUM_FEATURES
@@ -44,6 +45,7 @@ class Scorer:
         compute_dtype: str = "bfloat16",
         num_features: int = NUM_FEATURES,
         seed: int = 0,
+        use_fused: bool | None = None,
     ):
         self.spec: ModelSpec = get_model(model_name)
         self.batch_sizes = tuple(sorted(batch_sizes))
@@ -63,6 +65,37 @@ class Scorer:
         else:
             self._apply = self.spec.apply
 
+        # Pallas fused path: the whole MLP in one kernel, weights VMEM-
+        # resident (ccfd_tpu/ops/fused_mlp.py). Auto-on for the flagship MLP
+        # in reduced precision; params are re-folded on every swap so online
+        # retrain keeps working. ``use_fused=False`` forces the XLA path.
+        self._fused_params = None
+        if use_fused is None:
+            # auto only on real TPU: the CPU interpreter runs the same kernel
+            # body but orders of magnitude slower (tests opt in explicitly)
+            use_fused = (
+                self.spec.name == "mlp"
+                and dtype == jnp.bfloat16
+                and jax.default_backend() == "tpu"
+            )
+        if use_fused:
+            from ccfd_tpu.ops import fused_mlp
+
+            self._fused_mod = fused_mlp
+            try:
+                self._fused_params = fused_mlp.fold_for_kernel(self._params)
+            except (KeyError, TypeError, ValueError):
+                self._fused_params = None  # incompatible layout: XLA path
+            self._fused_interpret = jax.default_backend() == "cpu"
+
+    def _fused_apply(self, fused_params: Any, x: jax.Array) -> jax.Array:
+        tile = min(x.shape[0], self._fused_mod.DEFAULT_TILE)
+        while x.shape[0] % tile:  # largest power-of-two-ish divisor <= 512
+            tile //= 2
+        return self._fused_mod.fused_mlp_score(
+            fused_params, x, tile=tile, interpret=self._fused_interpret
+        )
+
     @property
     def params(self) -> Any:
         return self._params
@@ -73,11 +106,23 @@ class Scorer:
                 return b
         return self.batch_sizes[-1]
 
+    @property
+    def fused(self) -> bool:
+        return self._fused_params is not None
+
     def warmup(self) -> None:
         for b in self.batch_sizes:
-            jax.block_until_ready(
-                self._apply(self._params, jnp.zeros((b, self.num_features)))
-            )
+            if self._fused_params is not None:
+                jax.block_until_ready(
+                    self._fused_apply(
+                        self._fused_params,
+                        jnp.zeros((b, self.num_features), jnp.bfloat16),
+                    )
+                )
+            else:
+                jax.block_until_ready(
+                    self._apply(self._params, jnp.zeros((b, self.num_features)))
+                )
 
     def swap_params(self, new_params: Any) -> None:
         """Atomically publish retrained params without pausing serving.
@@ -88,8 +133,56 @@ class Scorer:
         """
         staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
         jax.block_until_ready(staged)
+        staged_fused = None
+        if self._fused_params is not None:
+            staged_fused = self._fused_mod.fold_for_kernel(staged)
+            jax.block_until_ready(staged_fused)
         with self._lock:
             self._params = staged
+            if staged_fused is not None:
+                self._fused_params = staged_fused
+
+    def score_pipelined(self, x: np.ndarray, depth: int = 2) -> np.ndarray:
+        """Bulk scoring with ``depth`` dispatches in flight.
+
+        JAX dispatch is async: by enqueuing the next chunk's H2D + kernel
+        before blocking on the previous chunk's D2H, transfer and compute
+        overlap. Wins when the host<->device wire dominates (large offline
+        scoring runs); the synchronous ``score`` stays the latency path.
+        """
+        x = np.asarray(x, dtype=np.float32)
+        n = x.shape[0]
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        with self._lock:
+            params = self._params
+            fused_params = self._fused_params
+        largest = self.batch_sizes[-1]
+        pending: list[tuple[jax.Array, int]] = []
+        chunks: list[np.ndarray] = []
+        start = 0
+        while start < n:
+            take = min(n - start, largest)
+            b = self.bucket(take)
+            chunk = x[start : start + take]
+            if take < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
+                )
+            if fused_params is not None:
+                out = self._fused_apply(
+                    fused_params, jnp.asarray(chunk.astype(ml_dtypes.bfloat16))
+                )
+            else:
+                out = self._apply(params, jnp.asarray(chunk))
+            pending.append((out, take))
+            if len(pending) >= depth:
+                done, took = pending.pop(0)
+                chunks.append(np.asarray(done)[:took])
+            start += take
+        for done, took in pending:
+            chunks.append(np.asarray(done)[:took])
+        return np.concatenate(chunks).astype(np.float32)
 
     def score(self, x: np.ndarray) -> np.ndarray:
         """(n, F) float32 -> (n,) float32 proba_1, padding to a shape bucket."""
@@ -100,6 +193,7 @@ class Scorer:
         chunks: list[np.ndarray] = []
         with self._lock:
             params = self._params
+            fused_params = self._fused_params
         start = 0
         largest = self.batch_sizes[-1]
         while start < n:
@@ -110,7 +204,16 @@ class Scorer:
                 chunk = np.concatenate(
                     [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
                 )
-            out = np.asarray(self._apply(params, jnp.asarray(chunk)))[:take]
+            if fused_params is not None:
+                # ship rows as bf16: the kernel computes in bf16 either way,
+                # and half the bytes ≈ double the H2D-bound throughput
+                out = np.asarray(
+                    self._fused_apply(
+                        fused_params, jnp.asarray(chunk.astype(ml_dtypes.bfloat16))
+                    )
+                )[:take]
+            else:
+                out = np.asarray(self._apply(params, jnp.asarray(chunk)))[:take]
             chunks.append(out)
             start += take
         return np.concatenate(chunks).astype(np.float32)

@@ -146,3 +146,67 @@ def test_keepalive_survives_401_then_succeeds():
         conn.close()
     finally:
         srv.stop()
+
+
+class TestFusedScorerPath:
+    """Pallas fused kernel wired into the serving Scorer (interpret on CPU)."""
+
+    def _trained_params(self):
+        import jax
+
+        from ccfd_tpu.data.ccfd import synthetic_dataset
+        from ccfd_tpu.models import mlp
+
+        ds = synthetic_dataset(n=512, seed=5)
+        params = mlp.init(jax.random.PRNGKey(0))
+        return mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0)), ds
+
+    def test_fused_matches_unfused(self):
+        params, ds = self._trained_params()
+        fused = Scorer(model_name="mlp", params=params, batch_sizes=(64, 256),
+                       use_fused=True)
+        plain = Scorer(model_name="mlp", params=params, batch_sizes=(64, 256),
+                       compute_dtype="float32", use_fused=False)
+        assert fused.fused and not plain.fused
+        x = ds.X[:100]  # spans a full 64 bucket + padded 256 bucket
+        np.testing.assert_allclose(
+            fused.score(x), plain.score(x), atol=2e-2
+        )  # bf16 matmuls in the kernel vs f32 reference
+
+    def test_swap_params_refolds_kernel_weights(self):
+        import jax
+
+        from ccfd_tpu.models import mlp
+
+        params, ds = self._trained_params()
+        scorer = Scorer(model_name="mlp", params=params, batch_sizes=(64,),
+                        use_fused=True)
+        x = ds.X[:64]
+        before = scorer.score(x)
+        new_params = mlp.init(jax.random.PRNGKey(42))
+        new_params = mlp.set_normalizer(new_params, ds.X.mean(0), ds.X.std(0))
+        scorer.swap_params(new_params)
+        after = scorer.score(x)
+        assert not np.allclose(before, after)
+        ref = Scorer(model_name="mlp", params=new_params, batch_sizes=(64,),
+                     compute_dtype="float32", use_fused=False).score(x)
+        np.testing.assert_allclose(after, ref, atol=2e-2)
+
+    def test_odd_bucket_sizes_fall_back_to_smaller_tiles(self):
+        params, ds = self._trained_params()
+        scorer = Scorer(model_name="mlp", params=params, batch_sizes=(48,),
+                        use_fused=True)
+        proba = scorer.score(ds.X[:48])
+        assert proba.shape == (48,)
+        assert np.all((proba >= 0) & (proba <= 1))
+
+    def test_score_pipelined_matches_score(self):
+        params, ds = self._trained_params()
+        for fused in (True, False):
+            scorer = Scorer(model_name="mlp", params=params,
+                            batch_sizes=(64, 128), use_fused=fused,
+                            compute_dtype="float32" if not fused else "bfloat16")
+            x = ds.X[:300]  # 2 full 128-buckets + padded tail, > depth chunks
+            np.testing.assert_allclose(
+                scorer.score_pipelined(x, depth=3), scorer.score(x), atol=1e-6
+            )
