@@ -149,6 +149,43 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_analyze(args: argparse.Namespace) -> int:
+    """Batch analytics report: the notebook workflow the reference runs on
+    JupyterHub+Spark (frauddetection_cr.yaml:7-53), as one CLI command."""
+    import numpy as np
+
+    from ccfd_tpu.analytics.engine import AnalyticsEngine
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES, load_dataset
+
+    ds = load_dataset()
+    engine = AnalyticsEngine(nbins=args.nbins)
+    report = engine.summarize(ds.X, ds.y)
+    out = report.to_dict()
+    out["workers"] = engine.mesh.size
+    # strongest off-diagonal correlations — what the exploration notebook eyeballs
+    corr = report.corr.copy()
+    idx = np.triu_indices_from(corr, k=1)
+    order = np.argsort(-np.abs(corr[idx]))[: args.top_corr]
+    out["top_correlations"] = [
+        {
+            "a": FEATURE_NAMES[idx[0][k]],
+            "b": FEATURE_NAMES[idx[1][k]],
+            "corr": float(corr[idx][k]),
+        }
+        for k in order
+    ]
+    if args.drift_split:
+        half = ds.n // 2
+        scores = engine.drift(engine.summarize(ds.X[:half]), ds.X[half:])
+        worst = int(np.argmax(scores))
+        out["drift_self_check"] = {
+            "max_psi": float(scores[worst]),
+            "worst_feature": FEATURE_NAMES[worst],
+        }
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     # bench.py lives at the repo root (next to the package), not inside it
     import importlib.util
@@ -264,6 +301,13 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--steps", type=int, default=500)
     t.add_argument("--checkpoint-dir", default="./checkpoints")
     t.set_defaults(fn=cmd_train)
+
+    an = sub.add_parser("analyze", help="dataset analytics report (Spark/notebook analog)")
+    an.add_argument("--nbins", type=int, default=32)
+    an.add_argument("--top-corr", type=int, default=8)
+    an.add_argument("--drift-split", action="store_true",
+                    help="also run a first-half vs second-half drift self-check")
+    an.set_defaults(fn=cmd_analyze)
 
     b = sub.add_parser("bench", help="print the benchmark JSON line")
     b.set_defaults(fn=cmd_bench)

@@ -14,6 +14,8 @@ metric names, one builder per board:
 - SeldonCore  — request rate / status codes / latency quantiles
   (reference SeldonCore.json:119-531)
 - Bus         — in-process broker depth/throughput (the Kafka.json analog)
+- Analytics   — mesh analytics jobs + drift PSI (the SparkMetrics.json analog:
+  Spark executor panels become device-mesh worker/job panels)
 - Retrain     — online-training health (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
@@ -145,6 +147,22 @@ def bus_dashboard() -> dict:
     return _dashboard("CCFD Bus", "ccfd-bus", p)
 
 
+def analytics_dashboard() -> dict:
+    p = [
+        _panel(0, "Analytics jobs / s",
+               ["rate(analytics_jobs_completed_total[5m])"]),
+        _panel(1, "Job duration p50/p95",
+               ["histogram_quantile(0.5, rate(analytics_job_seconds_bucket[5m]))",
+                "histogram_quantile(0.95, rate(analytics_job_seconds_bucket[5m]))"]),
+        _panel(2, "Rows aggregated / s",
+               ["rate(analytics_rows_processed_total[5m])"]),
+        _panel(3, "Mesh workers", ["analytics_workers"], "stat"),
+        _panel(4, "Per-feature drift PSI", ["analytics_drift_psi"]),
+        _panel(5, "Worst-feature PSI", ["analytics_drift_max_psi"], "stat"),
+    ]
+    return _dashboard("CCFD Analytics", "ccfd-analytics", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -162,6 +180,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "ModelPrediction": model_prediction_dashboard(),
         "SeldonCore": seldon_core_dashboard(),
         "Bus": bus_dashboard(),
+        "Analytics": analytics_dashboard(),
         "Retrain": retrain_dashboard(),
     }
 

@@ -39,6 +39,8 @@ _COMPONENTS = (
     "router",     # Camel router (L3)
     "producer",   # Kafka producer (L1) — one-shot job semantics
     "retrain",    # online retrain (new; BASELINE.json configs[4])
+    "analytics",  # batch analytics + drift (JupyterHub/Spark analog,
+                  # reference frauddetection_cr.yaml:7-53)
     "monitoring", # Prometheus exporter (L7)
     "health",     # runtime probes (platform)
 )
@@ -119,7 +121,8 @@ class Platform:
             )
         else:
             needs_bus = [
-                n for n in ("engine", "notify", "router", "retrain", "producer")
+                n for n in ("engine", "notify", "router", "retrain",
+                            "analytics", "producer")
                 if spec.component(n).enabled
             ]
             if needs_bus:
@@ -146,6 +149,11 @@ class Platform:
         # 7. online retrain (new capability; BASELINE.json configs[4])
         if spec.component("retrain").enabled and self.scorer is not None:
             self._up_retrain()
+
+        # 7b. analytics / drift monitor (notebooks+spark analog,
+        #     reference frauddetection_cr.yaml:7-53)
+        if spec.component("analytics").enabled:
+            self._up_analytics()
 
         # 8. monitoring (README.md:487-537)
         if spec.component("monitoring").enabled:
@@ -319,6 +327,41 @@ class Platform:
             "retrain",
             lambda: trainer.run(interval_s=interval),
             trainer.stop,
+            policy=RestartPolicy.ALWAYS,
+        )
+
+    def _up_analytics(self) -> None:
+        from ccfd_tpu.analytics.engine import AnalyticsEngine, DriftMonitor
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("analytics")
+        registry = self._registry("analytics")
+        engine = AnalyticsEngine(
+            nbins=int(c.opt("nbins", 32)), registry=registry
+        )
+
+        def build_reference():
+            # dataset load + two jit compiles: runs on the supervised
+            # thread so bring-up (probes, exporter, producer) isn't blocked
+            from ccfd_tpu.data.ccfd import load_dataset
+
+            ds = load_dataset()
+            return engine.summarize(ds.X, ds.y)
+
+        monitor = DriftMonitor(
+            self.cfg,
+            self.broker,
+            None,
+            engine=engine,
+            registry=registry,
+            window=int(c.opt("window", 4096)),
+            reference_builder=build_reference,
+        )
+        interval = float(c.opt("interval_s", 0.25))
+        self.supervisor.add_thread_service(
+            "analytics",
+            lambda: monitor.run(interval_s=interval),
+            monitor.stop,
             policy=RestartPolicy.ALWAYS,
         )
 

@@ -67,6 +67,63 @@ def decode_features(values: list[Mapping[str, Any]]) -> tuple[np.ndarray, int]:
     return out, bad
 
 
+def decode_records(records) -> tuple[np.ndarray, list[Mapping[str, Any]], int]:
+    """Bus records -> ((B, 30) matrix, per-row tx dicts, #malformed fields).
+
+    The one decoder for the transaction topic's mixed wire formats — the
+    router's scoring batches and the drift monitor's windows must see the
+    SAME rows. Two formats share the batch: dict transactions (decoded in
+    Python) and raw CSV lines (decoded by the native C++ fast path in one
+    pass). Rows keep their arrival order; a poison pill decodes to an
+    all-zero row rather than crashing the loop.
+    """
+    n = len(records)
+    x = np.zeros((n, len(FEATURE_NAMES)), np.float32)
+    txs: list[Mapping[str, Any]] = [{}] * n
+    bad = 0
+    dict_rows: list[int] = []
+    dict_vals: list[Mapping[str, Any]] = []
+    csv_rows: list[int] = []
+    csv_lines: list[bytes] = []
+    for i, rec in enumerate(records):
+        v = rec.value
+        if isinstance(v, Mapping):
+            dict_rows.append(i)
+            dict_vals.append(v)
+        elif isinstance(v, (bytes, str)):
+            raw = v.encode() if isinstance(v, str) else v
+            # one record == one CSV row; embedded newlines would desync
+            # the joined decode below, so keep only the first line and
+            # count the rest as malformed
+            lines = raw.splitlines() or [b""]
+            if len(lines) > 1:
+                bad += len(lines) - 1
+            csv_rows.append(i)
+            csv_lines.append(lines[0])
+        else:  # poison pill: score as all-zeros rather than crash the loop
+            bad += 1
+    if dict_vals:
+        xd, bad_fields = decode_features(dict_vals)
+        bad += bad_fields
+        for j, i in enumerate(dict_rows):
+            x[i] = xd[j]
+            txs[i] = dict_vals[j]
+    if csv_lines:
+        xc, bad_csv = native_decode_csv(
+            b"\n".join(csv_lines) + b"\n", len(FEATURE_NAMES)
+        )
+        bad += bad_csv
+        amount_col = FEATURE_NAMES.index("Amount")
+        for j, i in enumerate(csv_rows):
+            if j < xc.shape[0]:
+                x[i] = xc[j]
+            txs[i] = {
+                "id": records[i].key,
+                "Amount": float(x[i, amount_col]),
+            }
+    return x, txs, bad
+
+
 class Router:
     def __init__(
         self,
@@ -133,53 +190,7 @@ class Router:
         n = len(records)
         self._c_in.inc(n)
         self._h_batch.observe(n)
-
-        # Two wire formats share the batch: dict transactions (decoded in
-        # Python) and raw CSV lines (decoded by the native C++ fast path in
-        # one pass). Rows keep their arrival order.
-        x = np.zeros((n, len(FEATURE_NAMES)), np.float32)
-        txs: list[Mapping[str, Any]] = [{}] * n
-        bad = 0
-        dict_rows: list[int] = []
-        dict_vals: list[Mapping[str, Any]] = []
-        csv_rows: list[int] = []
-        csv_lines: list[bytes] = []
-        for i, rec in enumerate(records):
-            v = rec.value
-            if isinstance(v, Mapping):
-                dict_rows.append(i)
-                dict_vals.append(v)
-            elif isinstance(v, (bytes, str)):
-                raw = v.encode() if isinstance(v, str) else v
-                # one record == one CSV row; embedded newlines would desync
-                # the joined decode below, so keep only the first line and
-                # count the rest as malformed
-                lines = raw.splitlines() or [b""]
-                if len(lines) > 1:
-                    bad += len(lines) - 1
-                csv_rows.append(i)
-                csv_lines.append(lines[0])
-            else:  # poison pill: score as all-zeros rather than crash the loop
-                bad += 1
-        if dict_vals:
-            xd, bad_fields = decode_features(dict_vals)
-            bad += bad_fields
-            for j, i in enumerate(dict_rows):
-                x[i] = xd[j]
-                txs[i] = dict_vals[j]
-        if csv_lines:
-            xc, bad_csv = native_decode_csv(
-                b"\n".join(csv_lines) + b"\n", len(FEATURE_NAMES)
-            )
-            bad += bad_csv
-            amount_col = FEATURE_NAMES.index("Amount")
-            for j, i in enumerate(csv_rows):
-                if j < xc.shape[0]:
-                    x[i] = xc[j]
-                txs[i] = {
-                    "id": records[i].key,
-                    "Amount": float(x[i, amount_col]),
-                }
+        x, txs, bad = decode_records(records)
         if bad:
             self._c_decode_err.inc(bad)
         t0 = time.perf_counter()

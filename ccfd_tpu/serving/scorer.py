@@ -134,13 +134,20 @@ class Scorer:
         staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
         jax.block_until_ready(staged)
         staged_fused = None
-        if self._fused_params is not None:
-            staged_fused = self._fused_mod.fold_for_kernel(staged)
-            jax.block_until_ready(staged_fused)
+        # gate on the fused MODULE, not the current fused params: one
+        # unfoldable swap drops to the XLA path, but a later foldable tree
+        # must re-enable the kernel
+        if getattr(self, "_fused_mod", None) is not None:
+            try:
+                staged_fused = self._fused_mod.fold_for_kernel(staged)
+                jax.block_until_ready(staged_fused)
+            except (KeyError, TypeError, ValueError):
+                staged_fused = None  # incompatible layout: drop to XLA path
         with self._lock:
             self._params = staged
-            if staged_fused is not None:
-                self._fused_params = staged_fused
+            # never keep serving stale fused weights: an unfoldable tree
+            # disables the fused path rather than pinning the old params
+            self._fused_params = staged_fused
 
     def score_pipelined(self, x: np.ndarray, depth: int = 2) -> np.ndarray:
         """Bulk scoring with ``depth`` dispatches in flight.
@@ -170,6 +177,8 @@ class Scorer:
                     [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
                 )
             if fused_params is not None:
+                # ship rows as bf16: the kernel computes in bf16 either way,
+                # and half the bytes ≈ double the H2D-bound throughput
                 out = self._fused_apply(
                     fused_params, jnp.asarray(chunk.astype(ml_dtypes.bfloat16))
                 )
@@ -185,35 +194,10 @@ class Scorer:
         return np.concatenate(chunks).astype(np.float32)
 
     def score(self, x: np.ndarray) -> np.ndarray:
-        """(n, F) float32 -> (n,) float32 proba_1, padding to a shape bucket."""
-        x = np.asarray(x, dtype=np.float32)
-        n = x.shape[0]
-        if n == 0:
-            return np.zeros((0,), np.float32)
-        chunks: list[np.ndarray] = []
-        with self._lock:
-            params = self._params
-            fused_params = self._fused_params
-        start = 0
-        largest = self.batch_sizes[-1]
-        while start < n:
-            take = min(n - start, largest)
-            b = self.bucket(take)
-            chunk = x[start : start + take]
-            if take < b:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
-                )
-            if fused_params is not None:
-                # ship rows as bf16: the kernel computes in bf16 either way,
-                # and half the bytes ≈ double the H2D-bound throughput
-                out = np.asarray(
-                    self._fused_apply(
-                        fused_params, jnp.asarray(chunk.astype(ml_dtypes.bfloat16))
-                    )
-                )[:take]
-            else:
-                out = np.asarray(self._apply(params, jnp.asarray(chunk)))[:take]
-            chunks.append(out)
-            start += take
-        return np.concatenate(chunks).astype(np.float32)
+        """(n, F) float32 -> (n,) float32 proba_1, padding to a shape bucket.
+
+        The synchronous latency path: one dispatch in flight (``depth=1``
+        blocks on each chunk before the next), same bucketing/padding as
+        the pipelined bulk path.
+        """
+        return self.score_pipelined(x, depth=1)

@@ -1,0 +1,162 @@
+"""Batch analytics (Spark/notebook analog): numpy parity on the sharded
+jobs, drift detection, and the supervised DriftMonitor service."""
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.analytics.engine import AnalyticsEngine, DriftMonitor, psi
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, NUM_FEATURES
+from ccfd_tpu.metrics.prom import Registry
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return AnalyticsEngine()
+
+
+def test_summarize_matches_numpy(engine, dataset):
+    rep = engine.summarize(dataset.X, dataset.y)
+    assert rep.n == dataset.n
+    np.testing.assert_allclose(rep.mean, dataset.X.mean(axis=0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(rep.std, dataset.X.std(axis=0), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(rep.min, dataset.X.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(rep.max, dataset.X.max(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(
+        rep.corr, np.corrcoef(dataset.X.T), rtol=1e-2, atol=5e-3
+    )
+    assert rep.class_counts.sum() == dataset.n
+    assert rep.class_counts[1] == dataset.y.sum()
+    amount = dataset.X[:, -1]
+    np.testing.assert_allclose(
+        rep.amount_sum_by_class[1], amount[dataset.y == 1].sum(), rtol=1e-3
+    )
+    d = rep.to_dict()
+    assert d["rows"] == dataset.n
+    assert 0.0 < d["fraud_rate"] < 1.0
+    assert set(d["features"]) == set(FEATURE_NAMES)
+
+
+def test_summarize_pads_non_multiple_rows(engine, dataset):
+    # 4000 is a multiple of 8; a ragged slice exercises the mask path
+    rep = engine.summarize(dataset.X[:1017], dataset.y[:1017])
+    assert rep.n == 1017
+    np.testing.assert_allclose(
+        rep.mean, dataset.X[:1017].mean(axis=0), rtol=1e-4, atol=1e-4
+    )
+    assert rep.hist.sum() == pytest.approx(1017 * NUM_FEATURES)
+
+
+def test_histograms_count_every_row(engine, dataset):
+    rep = engine.summarize(dataset.X, dataset.y)
+    # every feature's histogram accounts for every (unmasked) row
+    np.testing.assert_allclose(rep.hist.sum(axis=1), dataset.n)
+    assert rep.edges.shape == (NUM_FEATURES, engine.nbins + 1)
+    np.testing.assert_allclose(rep.edges[:, 0], rep.min, atol=1e-5)
+
+
+def test_drift_stable_vs_shifted(engine, dataset):
+    # random split: sequential halves genuinely drift in Time (sorted ramp)
+    perm = np.random.default_rng(7).permutation(dataset.n)
+    half = dataset.n // 2
+    ref = engine.summarize(dataset.X[perm[:half]])
+    same = engine.drift(ref, dataset.X[perm[half:]])
+    # same distribution: stable (heavy-tailed Amount is the noisiest feature,
+    # ~0.1 with 2k rows x 32 bins, so the bound sits between noise and action)
+    assert float(same.max()) < 0.15
+    shifted = dataset.X[perm[half:]].copy()
+    v17 = FEATURE_NAMES.index("V17")
+    shifted[:, v17] += 3.0
+    scores = engine.drift(ref, shifted)
+    assert float(scores[v17]) > 0.25  # classic "action needed" PSI
+    assert int(np.argmax(scores)) == v17
+
+
+def test_psi_is_symmetric_zero_on_identical():
+    h = np.random.default_rng(0).random((NUM_FEATURES, 16))
+    np.testing.assert_allclose(psi(h, h), 0.0, atol=1e-9)
+
+
+def test_engine_metrics(dataset):
+    reg = Registry()
+    eng = AnalyticsEngine(registry=reg)
+    eng.summarize(dataset.X, dataset.y)
+    eng.drift(eng.summarize(dataset.X), dataset.X)
+    body = reg.render()
+    assert 'analytics_jobs_completed_total{job="summarize"}' in body
+    assert 'analytics_jobs_completed_total{job="drift"}' in body
+    assert "analytics_workers" in body
+    import jax
+
+    assert f"analytics_workers {float(jax.device_count())!r}" in body
+
+
+def _tx(row):
+    return {name: float(row[j]) for j, name in enumerate(FEATURE_NAMES)}
+
+
+def test_drift_monitor_requires_reference_or_builder(dataset):
+    with pytest.raises(ValueError):
+        DriftMonitor(Config.from_env({}), Broker(), None)
+
+
+def test_drift_monitor_builds_reference_lazily(dataset):
+    cfg = Config.from_env({})
+    broker = Broker()
+    eng = AnalyticsEngine()
+    built = []
+
+    def builder():
+        built.append(1)
+        return eng.summarize(dataset.X, dataset.y)
+
+    mon = DriftMonitor(cfg, broker, None, engine=eng, window=128,
+                       reference_builder=builder)
+    try:
+        assert not built  # bring-up stays non-blocking
+        for row in dataset.X[:256]:
+            broker.produce(cfg.kafka_topic, _tx(row))
+        for _ in range(5):
+            mon.step()
+            if mon.windows_scored:
+                break
+        assert built == [1]
+        assert mon.windows_scored >= 1
+    finally:
+        mon.stop()
+
+
+def test_drift_monitor_scores_windows(dataset):
+    cfg = Config.from_env({})
+    broker = Broker()
+    reg = Registry()
+    eng = AnalyticsEngine(registry=reg)
+    ref = eng.summarize(dataset.X, dataset.y)
+    mon = DriftMonitor(cfg, broker, ref, engine=eng, registry=reg, window=256)
+    try:
+        shifted = dataset.X[:512].copy()
+        amount_col = FEATURE_NAMES.index("Amount")
+        shifted[:, amount_col] *= 25.0
+        # mixed wire formats, like the live topic: dicts + raw CSV lines
+        for row in shifted[:400]:
+            broker.produce(cfg.kafka_topic, _tx(row))
+        for row in shifted[400:]:
+            broker.produce(
+                cfg.kafka_topic,
+                (",".join(str(float(v)) for v in row)).encode(),
+            )
+        seen = 0
+        for _ in range(20):
+            seen += mon.step()
+            if mon.windows_scored >= 2:
+                break
+        assert mon.windows_scored >= 2
+        assert seen == 512
+        psi_amount = reg.gauge("analytics_drift_psi").value(
+            labels={"feature": "Amount"}
+        )
+        assert psi_amount > 0.25
+        assert reg.gauge("analytics_drift_max_psi").value() >= psi_amount
+    finally:
+        mon.stop()

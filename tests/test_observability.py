@@ -10,7 +10,8 @@ from ccfd_tpu.utils.tracing import Tracer
 def test_dashboards_cover_contract_metrics():
     boards = build_all_dashboards()
     assert set(boards) == {
-        "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus", "Retrain",
+        "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus", "Analytics",
+        "Retrain",
     }
     blob = json.dumps(boards)
     for metric in [
@@ -31,7 +32,7 @@ def test_dashboards_cover_contract_metrics():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 6
+    assert len(paths) == 7
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")

@@ -163,6 +163,13 @@ class TestBringUp:
         with pytest.raises(ValueError, match="bus disabled"):
             Platform(PlatformSpec.from_cr(cr, cfg=Config())).up()
 
+    def test_bus_disabled_with_only_analytics_errors(self):
+        cr = minimal_cr(bus={"enabled": False}, scorer={"enabled": False},
+                        engine={"enabled": False}, notify={"enabled": False},
+                        router={"enabled": False})
+        with pytest.raises(ValueError, match="analytics"):
+            Platform(PlatformSpec.from_cr(cr, cfg=Config())).up()
+
     def test_missing_engine_block_disables_engine(self):
         cr = minimal_cr(engine={"enabled": False}, router={"enabled": False},
                         retrain={"enabled": False})

@@ -192,6 +192,31 @@ class TestFusedScorerPath:
                      compute_dtype="float32", use_fused=False).score(x)
         np.testing.assert_allclose(after, ref, atol=2e-2)
 
+    def test_swap_params_unfoldable_tree_drops_to_xla_path(self):
+        import jax
+
+        from ccfd_tpu.models import mlp
+
+        params, ds = self._trained_params()
+        scorer = Scorer(model_name="mlp", params=params, batch_sizes=(64,),
+                        use_fused=True)
+        assert scorer.fused
+        x = ds.X[:64]
+        # a 2-layer tree: fold_for_kernel only accepts the 3-layer flagship
+        odd = mlp.init(jax.random.PRNGKey(3), depth=2)
+        odd = mlp.set_normalizer(odd, ds.X.mean(0), ds.X.std(0))
+        scorer.swap_params(odd)
+        assert not scorer.fused  # stale fused weights must not keep serving
+        ref = Scorer(model_name="mlp", params=odd, batch_sizes=(64,),
+                     compute_dtype="float32", use_fused=False).score(x)
+        np.testing.assert_allclose(scorer.score(x), ref, atol=2e-2)
+        # a later foldable tree re-enables the kernel path
+        scorer.swap_params(params)
+        assert scorer.fused
+        ref2 = Scorer(model_name="mlp", params=params, batch_sizes=(64,),
+                      compute_dtype="float32", use_fused=False).score(x)
+        np.testing.assert_allclose(scorer.score(x), ref2, atol=2e-2)
+
     def test_odd_bucket_sizes_fall_back_to_smaller_tiles(self):
         params, ds = self._trained_params()
         scorer = Scorer(model_name="mlp", params=params, batch_sizes=(48,),
