@@ -14,6 +14,7 @@ in-process:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -107,6 +108,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
     from ccfd_tpu.serving.server import PredictionServer
 
     cfg = Config.from_env()
+    if cfg.graph_cr:
+        # Serve a whole SeldonDeployment-shaped inference graph (ensemble /
+        # router / transformer tree) compiled to one jitted callable.
+        from ccfd_tpu.serving.graph import load_graph_cr
+
+        if args.train:
+            print(
+                "[serve] --train trains the MLP; a CCFD_GRAPH_CR graph has "
+                "graph-shaped params — unset --train or unset CCFD_GRAPH_CR",
+                file=sys.stderr,
+            )
+            return 2
+        spec = load_graph_cr(cfg.graph_cr)
+        cfg = dataclasses.replace(cfg, model_name=spec.name)
     params = None
     if args.train:
         if cfg.model_name != "mlp":

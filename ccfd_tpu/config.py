@@ -76,6 +76,7 @@ class Config:
 
     # --- TPU scorer knobs (new) ---
     model_name: str = "mlp"
+    graph_cr: str = ""  # SeldonDeployment-shaped CR file -> serving/graph.py
     compute_dtype: str = "bfloat16"
     batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384)
     batch_deadline_ms: float = 2.0
@@ -128,6 +129,7 @@ class Config:
                 e.get("CCFD_RETRAIN_MIN_LABELS", str(Config.retrain_min_labels))
             ),
             model_name=e.get("CCFD_MODEL", Config.model_name),
+            graph_cr=e.get("CCFD_GRAPH_CR", Config.graph_cr),
             compute_dtype=e.get("CCFD_DTYPE", Config.compute_dtype),
             batch_sizes=tuple(int(s) for s in sizes.split(",")) if sizes else Config.batch_sizes,
             batch_deadline_ms=float(
