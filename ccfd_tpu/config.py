@@ -43,6 +43,7 @@ class Config:
 
     # --- decision thresholds (reference router.yaml:69-70, README.md:395-402) ---
     fraud_threshold: float = 0.5
+    rules_file: str = ""  # JSON rule base (CCFD_RULES) -> router/rules.py
     confidence_threshold: float = 1.0
 
     # --- HTTP client knobs (reference README.md:386-393) ---
@@ -101,6 +102,7 @@ class Config:
             seldon_endpoint=e.get("SELDON_ENDPOINT", Config.seldon_endpoint),
             seldon_token=e.get("SELDON_TOKEN", Config.seldon_token),
             fraud_threshold=float(e.get("FRAUD_THRESHOLD", str(Config.fraud_threshold))),
+            rules_file=e.get("CCFD_RULES", Config.rules_file),
             confidence_threshold=float(
                 e.get("CONFIDENCE_THRESHOLD", str(Config.confidence_threshold))
             ),

@@ -170,6 +170,12 @@ class Engine:
         )
 
     # -- definitions ------------------------------------------------------
+    def definitions(self) -> tuple[str, ...]:
+        """Registered process-definition ids (the router validates its rule
+        base against these at wiring time)."""
+        with self._lock:
+            return tuple(self._definitions)
+
     def register(self, definition: ProcessDefinition) -> None:
         self._definitions[definition.id] = definition
 

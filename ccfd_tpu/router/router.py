@@ -34,6 +34,7 @@ from ccfd_tpu.data.ccfd import FEATURE_NAMES
 from ccfd_tpu.metrics.prom import Registry
 from ccfd_tpu.native import decode_csv as native_decode_csv
 from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL
+from ccfd_tpu.router.rules import RuleSet, default_rules
 
 
 class EngineClient(Protocol):
@@ -133,6 +134,7 @@ class Router:
         engine: EngineClient,
         registry: Registry | None = None,
         max_batch: int = 4096,
+        rules: RuleSet | None = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -140,6 +142,28 @@ class Router:
         self.engine = engine
         self.registry = registry or Registry()
         self.max_batch = max_batch
+        # Drools-analog rule base (ccfd_tpu/router/rules.py). Precedence:
+        # explicit arg > CCFD_RULES file > the reference's threshold rule.
+        if rules is None:
+            rules = (
+                RuleSet.from_file(cfg.rules_file)
+                if cfg.rules_file
+                else default_rules(cfg.fraud_threshold)
+            )
+        self.rules = rules
+        # Fail fast on a rule naming a process the engine doesn't have —
+        # discovering it on the first matching transaction would kill the
+        # routing loop mid-batch. Remote (REST) engines don't expose a
+        # definition list; those fall back to the runtime guard in step().
+        list_defs = getattr(engine, "definitions", None)
+        if callable(list_defs):
+            known = set(list_defs())
+            missing = {r.process for r in rules.rules} - known
+            if missing:
+                raise ValueError(
+                    f"rules reference unregistered processes {sorted(missing)}; "
+                    f"engine has {sorted(known)}"
+                )
 
         self._tx_consumer = broker.consumer("router", (cfg.kafka_topic,))
         self._resp_consumer = broker.consumer(
@@ -166,6 +190,10 @@ class Router:
             "transaction_decode_errors_total", "malformed transaction fields"
         )
         self._h_score_s = r.histogram("router_score_seconds", "scorer dispatch latency")
+        self._c_rule = r.counter("router_rule_fired_total", "rule activations")
+        self._c_start_err = r.counter(
+            "router_process_start_errors_total", "failed process starts"
+        )
         self._stop = threading.Event()
 
     # -- one synchronous cycle (used by tests and the run loop) ------------
@@ -197,18 +225,24 @@ class Router:
         proba = np.asarray(self.score(x))
         self._h_score_s.observe(time.perf_counter() - t0)
 
-        is_fraud = proba >= self.cfg.fraud_threshold
-        for tx, p, fraud in zip(txs, proba, is_fraud):
-            kind = "fraud" if fraud else "standard"
-            self.engine.start_process(
-                kind,
-                {
-                    "transaction": tx,
-                    "proba": float(p),
-                    "customer_id": tx.get("id"),
-                },
-            )
-            self._c_out.inc(labels={"type": kind})
+        fired = self.rules.evaluate(x, proba)
+        for tx, p, ridx in zip(txs, proba, fired):
+            rule = self.rules.rules[ridx]
+            variables = {
+                "transaction": tx,
+                "proba": float(p),
+                "customer_id": tx.get("id"),
+            }
+            variables.update(rule.set_vars)
+            try:
+                self.engine.start_process(rule.process, variables)
+            except Exception:
+                # a bad rule target or a flaky remote engine must not take
+                # down the routing loop; the rest of the batch still routes
+                self._c_start_err.inc(labels={"type": rule.process})
+                continue
+            self._c_out.inc(labels={"type": rule.process})
+            self._c_rule.inc(labels={"rule": rule.name})
         return len(txs)
 
     # -- daemon loop -------------------------------------------------------

@@ -1,0 +1,232 @@
+"""Rule-base tests: Drools-analog semantics (salience, first-match, JSON).
+
+Reference behavior under test: the router's embedded Drools rule routes on
+``proba >= FRAUD_THRESHOLD`` (reference deploy/router.yaml:69-70,
+README.md:424-459); ccfd_tpu/router/rules.py generalizes it to a declarative
+salience-ordered base evaluated vectorized over the micro-batch.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, NUM_FEATURES
+from ccfd_tpu.router.rules import Condition, Rule, RuleSet, default_rules
+
+AMOUNT = FEATURE_NAMES.index("Amount")
+
+
+def _x(n):
+    return np.zeros((n, NUM_FEATURES), np.float32)
+
+
+def test_default_rules_match_reference_threshold():
+    rs = default_rules(0.5)
+    proba = np.array([0.0, 0.49, 0.5, 0.51, 1.0], np.float32)
+    fired = rs.evaluate(_x(5), proba)
+    got = [rs.rules[i].process for i in fired]
+    assert got == ["standard", "standard", "fraud", "fraud", "fraud"]
+
+
+def test_salience_orders_activation_and_first_match_wins():
+    rs = RuleSet(
+        [
+            Rule("low", process="standard", when=(Condition("proba", ">=", 0.2),),
+                 salience=1),
+            Rule("high", process="fraud", when=(Condition("proba", ">=", 0.2),),
+                 salience=5),
+            Rule("default", process="standard"),
+        ]
+    )
+    fired = rs.evaluate(_x(2), np.array([0.9, 0.1], np.float32))
+    assert [rs.rules[i].name for i in fired] == ["high", "default"]
+
+
+def test_conjunction_and_feature_conditions():
+    rs = RuleSet(
+        [
+            Rule(
+                "big-sure", process="fraud", salience=10,
+                when=(
+                    Condition("proba", ">=", 0.5),
+                    Condition("Amount", ">", 1000.0),
+                ),
+            ),
+            Rule("default", process="standard"),
+        ]
+    )
+    x = _x(4)
+    x[:, AMOUNT] = [2000.0, 2000.0, 10.0, 10.0]
+    proba = np.array([0.9, 0.1, 0.9, 0.1], np.float32)
+    fired = rs.evaluate(x, proba)
+    assert [rs.rules[i].name for i in fired] == [
+        "big-sure", "default", "default", "default"
+    ]
+
+
+def test_between_and_equality_ops():
+    c = Condition("Amount", "between", [10.0, 20.0])
+    x = _x(3)
+    x[:, AMOUNT] = [5.0, 15.0, 25.0]
+    assert c.mask(x, np.zeros(3)).tolist() == [False, True, False]
+    cne = Condition("proba", "!=", 0.0)
+    assert cne.mask(x, np.array([0.0, 0.5, 0.0])).tolist() == [False, True, False]
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="unknown op"):
+        Condition("proba", "~", 1)
+    with pytest.raises(ValueError, match="unknown field"):
+        Condition("NotAFeature", ">", 1)
+    with pytest.raises(ValueError, match="between"):
+        Condition("proba", "between", 3)
+    with pytest.raises(ValueError, match="no default rule"):
+        RuleSet([Rule("a", process="x", when=(Condition("proba", ">", 0),))])
+    with pytest.raises(ValueError, match="duplicate rule names"):
+        RuleSet([Rule("a", process="x"), Rule("a", process="y")])
+    with pytest.raises(ValueError, match="empty rule base"):
+        RuleSet([])
+
+
+def test_json_roundtrip(tmp_path):
+    obj = [
+        {
+            "name": "vip-review", "process": "fraud", "salience": 20,
+            "when": [
+                {"field": "Amount", "op": ">", "value": 5000},
+                {"field": "proba", "op": ">=", "value": 0.2},
+            ],
+            "set_vars": {"priority": "high"},
+        },
+        {"name": "default", "process": "standard"},
+    ]
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(obj))
+    rs = RuleSet.from_file(str(path))
+    x = _x(2)
+    x[:, AMOUNT] = [9000.0, 9000.0]
+    fired = rs.evaluate(x, np.array([0.3, 0.1], np.float32))
+    assert [rs.rules[i].name for i in fired] == ["vip-review", "default"]
+    assert rs.rules[fired[0]].set_vars == {"priority": "high"}
+
+
+def test_equality_matches_float32_columns():
+    """0.1 is not float32-dyadic; == must cast to the column dtype to fire."""
+    c = Condition("Amount", "==", 0.1)
+    x = _x(1)
+    x[:, AMOUNT] = 0.1
+    assert c.mask(x, np.zeros(1)).tolist() == [True]
+    assert Condition("Amount", "!=", 0.1).mask(x, np.zeros(1)).tolist() == [False]
+
+
+def test_between_rejects_non_numeric_bounds():
+    with pytest.raises(ValueError, match="between"):
+        Condition("proba", "between", [0.1, "x"])
+    with pytest.raises(ValueError, match="between"):
+        Condition("proba", "between", "ab")
+    with pytest.raises(ValueError, match="non-numeric"):
+        Condition("proba", ">", "high")
+
+
+def test_router_rejects_rules_with_unknown_process(tmp_path):
+    """A rule naming an unregistered process fails at wiring, not mid-batch."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.process.clock import ManualClock
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.router import Router
+
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([
+        {"name": "typo", "process": "fraud-review", "salience": 5,
+         "when": [{"field": "proba", "op": ">=", "value": 0.5}]},
+        {"name": "default", "process": "standard"},
+    ]))
+    cfg = Config(rules_file=str(path))
+    broker = Broker()
+    engine = build_engine(cfg, broker, Registry(), ManualClock())
+    with pytest.raises(ValueError, match="unregistered processes.*fraud-review"):
+        Router(cfg, broker, lambda x: np.zeros(x.shape[0]), engine, Registry())
+
+
+def test_router_survives_engine_start_failure():
+    """A flaky engine (remote) must not kill the routing loop mid-batch."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.router.router import Router
+
+    calls = []
+
+    class FlakyEngine:  # no definitions(): wiring-time validation skipped
+        def start_process(self, def_id, variables):
+            calls.append(def_id)
+            if len(calls) == 1:
+                raise ConnectionError("engine down")
+            return len(calls)
+
+        def signal(self, pid, name, payload=None):
+            return True
+
+    broker, reg = Broker(), Registry()
+    cfg = Config()
+    router = Router(
+        cfg, broker, lambda x: np.zeros(x.shape[0], np.float32), FlakyEngine(), reg
+    )
+    for i in range(3):
+        broker.produce(cfg.kafka_topic, {n: 0.0 for n in FEATURE_NAMES} | {"id": i})
+    assert router.step() == 3
+    assert len(calls) == 3  # all rows attempted despite the first failing
+    text = reg.render()
+    assert 'router_process_start_errors_total{type="standard"} 1' in text
+    assert 'transaction_outgoing_total{type="standard"} 2' in text
+    router.close()
+
+
+def test_router_uses_custom_rules_and_counts_activations(tmp_path):
+    """Router wiring: CCFD_RULES file routes and set_vars reach the engine."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.router.router import Router
+
+    rules = [
+        {
+            "name": "big", "process": "fraud", "salience": 5,
+            "when": [{"field": "Amount", "op": ">", "value": 100}],
+            "set_vars": {"priority": "high"},
+        },
+        {"name": "default", "process": "standard"},
+    ]
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(rules))
+    cfg = Config(rules_file=str(path))
+
+    starts = []
+
+    class Engine:
+        def start_process(self, def_id, variables):
+            starts.append((def_id, variables))
+            return len(starts)
+
+        def signal(self, pid, name, payload=None):
+            return True
+
+    broker = Broker()
+    reg = Registry()
+    router = Router(
+        cfg, broker, lambda x: np.zeros(x.shape[0], np.float32), Engine(), reg
+    )
+    tx_big = {n: 0.0 for n in FEATURE_NAMES} | {"Amount": 500.0, "id": "a"}
+    tx_small = {n: 0.0 for n in FEATURE_NAMES} | {"Amount": 5.0, "id": "b"}
+    broker.produce(cfg.kafka_topic, tx_big)
+    broker.produce(cfg.kafka_topic, tx_small)
+    assert router.step() == 2
+    kinds = sorted(k for k, _ in starts)
+    assert kinds == ["fraud", "standard"]
+    fraud_vars = next(v for k, v in starts if k == "fraud")
+    assert fraud_vars["priority"] == "high"
+    text = reg.render()
+    assert 'router_rule_fired_total{rule="big"} 1' in text
+    assert 'router_rule_fired_total{rule="default"} 1' in text
+    router.close()
