@@ -21,6 +21,7 @@ Semantics kept faithful to Kafka:
 
 from __future__ import annotations
 
+import binascii
 import itertools
 import threading
 import time
@@ -51,33 +52,90 @@ class _Topic:
     def route(self, key: Any) -> int:
         if key is None:
             return next(self._rr) % self.n_partitions
-        return hash(key) % self.n_partitions
+        # stable across processes (Python's str hash is per-process salted;
+        # a durable log replayed into a new process must keep key->partition
+        # ordering, like Kafka's murmur2-on-key-bytes)
+        data = key if isinstance(key, bytes) else str(key).encode()
+        return binascii.crc32(data) % self.n_partitions
 
 
 class Broker:
-    """Thread-safe in-process broker. One instance == one cluster."""
+    """Thread-safe in-process broker. One instance == one cluster.
 
-    def __init__(self, default_partitions: int = 3):
+    With ``log_dir`` set, every record and committed offset also lands in
+    an on-disk segment log (ccfd_tpu/bus/log.py): reopening a Broker on the
+    same directory replays topics, records, and group offsets, so consumers
+    resume exactly where the crashed process left off — the reference's
+    Kafka recovery semantics (SURVEY.md §5).
+    """
+
+    def __init__(
+        self,
+        default_partitions: int = 3,
+        log_dir: str | None = None,
+        fsync: bool = False,
+    ):
         self._default_partitions = default_partitions
         self._topics: dict[str, _Topic] = {}
         self._groups: dict[str, dict[tuple[str, int], int]] = {}  # group -> {(t,p): offset}
         self._members: dict[str, list["Consumer"]] = {}
         self._lock = threading.Lock()
         self._data_ready = threading.Condition(self._lock)
+        self._log = None
+        if log_dir is not None:
+            from ccfd_tpu.bus.log import BusLog
+
+            self._log = BusLog(log_dir, fsync=fsync)
+            for name, n_parts in self._log.replay_topics().items():
+                t = _Topic(name, n_parts)
+                self._topics[name] = t
+                for p in range(n_parts):
+                    for key, ts, value in self._log.replay_partition(name, p):
+                        t.partitions[p].append(
+                            Record(
+                                topic=name,
+                                partition=p,
+                                offset=len(t.partitions[p]),
+                                key=key,
+                                value=value,
+                                timestamp=ts,
+                            )
+                        )
+            # Clamp replayed offsets to the replayed log: a torn-tail
+            # truncation may have dropped records whose consumption was
+            # already committed; an out-of-range offset would silently skip
+            # every record produced at those slots after restart (Kafka
+            # resets out-of-range offsets the same way).
+            for g, tps in self._log.replay_offsets().items():
+                mine = self._groups.setdefault(g, {})
+                for (tname, p), off in tps.items():
+                    t = self._topics.get(tname)
+                    if t is None or p >= t.n_partitions:
+                        continue  # topic/partition lost with the meta log
+                    mine[(tname, p)] = min(off, len(t.partitions[p]))
 
     # -- admin ------------------------------------------------------------
     def create_topic(self, name: str, n_partitions: int | None = None) -> None:
         with self._lock:
             if name not in self._topics:
-                self._topics[name] = _Topic(
-                    name, n_partitions or self._default_partitions
-                )
+                n = n_partitions or self._default_partitions
+                self._topics[name] = _Topic(name, n)
+                if self._log is not None:
+                    self._log.add_topic(name, n)
 
     def _topic(self, name: str) -> _Topic:
         t = self._topics.get(name)
         if t is None:
             self._topics[name] = t = _Topic(name, self._default_partitions)
+            if self._log is not None:
+                self._log.add_topic(name, t.n_partitions)
         return t
+
+    def close(self) -> None:
+        """Flush and close segment files (no-op for a memory-only broker)."""
+        with self._lock:
+            if self._log is not None:
+                self._log.close()
 
     def end_offsets(self, topic: str) -> list[int]:
         with self._lock:
@@ -96,7 +154,16 @@ class Broker:
                 value=value,
                 timestamp=time.time(),
             )
+            payload = None
+            if self._log is not None:
+                # encode BEFORE the in-memory append: an unencodable record
+                # must fail cleanly, not leave memory and disk diverged
+                from ccfd_tpu.bus.log import encode_entry
+
+                payload = encode_entry(key, rec.timestamp, value)
             t.partitions[part].append(rec)
+            if self._log is not None:
+                self._log.append_payload(topic, part, payload)
             self._data_ready.notify_all()
             return rec
 
@@ -146,6 +213,8 @@ class Broker:
         g = self._groups.setdefault(group_id, {})
         if offset > g.get(tp, 0):
             g[tp] = offset
+            if self._log is not None:
+                self._log.commit_offset(group_id, tp[0], tp[1], offset)
 
     def _fetch(
         self, consumer: "Consumer", max_records: int

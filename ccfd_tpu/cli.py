@@ -47,7 +47,7 @@ def cmd_demo(args: argparse.Namespace) -> int:
         ds.X, ds.y, steps=args.train_steps, tc=TrainConfig(compute_dtype="float32")
     )
 
-    broker = Broker()
+    broker = Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync)
     reg_router, reg_kie, reg_notify, reg_retrain = (
         Registry(), Registry(), Registry(), Registry(),
     )

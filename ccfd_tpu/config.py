@@ -29,6 +29,8 @@ from typing import Mapping, Sequence
 class Config:
     # --- bus / topics (reference router.yaml:54-62) ---
     broker_url: str = "inproc://local"
+    bus_log_dir: str = ""  # durable segment-log dir (CCFD_BUS_DIR); "" = memory
+    bus_fsync: bool = False  # fsync per append (CCFD_BUS_FSYNC=1)
     kafka_topic: str = "odh-demo"
     customer_notification_topic: str = "ccd-customer-outgoing"
     customer_response_topic: str = "ccd-customer-response"
@@ -90,6 +92,8 @@ class Config:
         sizes = e.get("CCFD_BATCH_SIZES", "")
         return Config(
             broker_url=e.get("BROKER_URL", Config.broker_url),
+            bus_log_dir=e.get("CCFD_BUS_DIR", Config.bus_log_dir),
+            bus_fsync=e.get("CCFD_BUS_FSYNC", "") in ("1", "true", "yes"),
             kafka_topic=e.get("KAFKA_TOPIC", Config.kafka_topic),
             customer_notification_topic=e.get(
                 "CUSTOMER_NOTIFICATION_TOPIC", Config.customer_notification_topic

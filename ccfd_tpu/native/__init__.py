@@ -21,7 +21,7 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "decode.cpp")
+_SRCS = [os.path.join(_HERE, "decode.cpp"), os.path.join(_HERE, "log.cpp")]
 _SO = os.path.join(_HERE, "_ccfd_native.so")
 
 _lib = None
@@ -30,11 +30,13 @@ _build_failed = False
 
 
 def _build() -> str | None:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= max(
+        os.path.getmtime(s) for s in _SRCS
+    ):
         return _SO
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO],
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", *_SRCS, "-o", _SO],
             check=True,
             capture_output=True,
             timeout=120,
@@ -70,6 +72,22 @@ def _load():
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_float),
             ctypes.c_int,
+        ]
+        lib.ccfd_log_frame.restype = ctypes.c_size_t
+        lib.ccfd_log_frame.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.ccfd_log_scan.restype = ctypes.c_int
+        lib.ccfd_log_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
         ]
         _lib = lib
         return _lib
@@ -119,6 +137,88 @@ def decode_csv(data: bytes, n_features: int = 30) -> tuple[np.ndarray, int]:
         ctypes.byref(bad),
     )
     return out[:rows], int(bad.value)
+
+
+def frame_records(payloads: list[bytes]) -> bytes:
+    """Frame payloads as ``[u32 len][u32 crc32][payload]...`` (one buffer)."""
+    if not payloads:
+        return b""
+    lib = _load()
+    if lib is None:
+        import binascii
+        import struct
+
+        parts = []
+        for p in payloads:
+            parts.append(struct.pack("<II", len(p), binascii.crc32(p)))
+            parts.append(p)
+        return b"".join(parts)
+    concat = b"".join(payloads)
+    lens = (ctypes.c_uint32 * len(payloads))(*[len(p) for p in payloads])
+    out = ctypes.create_string_buffer(len(concat) + 8 * len(payloads))
+    n = lib.ccfd_log_frame(
+        concat, lens, len(payloads), ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8))
+    )
+    return out.raw[:n]
+
+
+def scan_records(buf: bytes) -> tuple[list[bytes], int, bool]:
+    """Replay a segment buffer -> (payloads, valid_prefix_len, corrupt).
+
+    Stops at the first torn or corrupt frame; ``valid_prefix_len`` is where
+    a recovering writer should truncate. ``corrupt`` distinguishes a bad
+    CRC / insane length from a clean partial tail.
+    """
+    lib = _load()
+    if lib is None:
+        return _scan_records_py(buf)
+    out: list[bytes] = []
+    pos = 0
+    corrupt = False
+    chunk = 4096
+    offs = (ctypes.c_uint64 * chunk)()
+    lens = (ctypes.c_uint32 * chunk)()
+    consumed = ctypes.c_size_t(0)
+    # one buffer copy up front, then chunked scans by pointer offset —
+    # re-slicing bytes per chunk would make large-segment replay O(n^2)
+    base = ctypes.create_string_buffer(buf, len(buf))
+    addr = ctypes.addressof(base)
+    while pos < len(buf):
+        n = lib.ccfd_log_scan(
+            ctypes.c_char_p(addr + pos), len(buf) - pos, offs, lens, chunk,
+            ctypes.byref(consumed),
+        )
+        got = n if n >= 0 else -n - 1  # corruption encodes -(valid+1)
+        for i in range(got):
+            off = pos + offs[i]
+            out.append(buf[off : off + lens[i]])
+        pos += consumed.value
+        if n < 0:
+            corrupt = True
+            break
+        if n < chunk:  # clean end (EOF or partial tail)
+            break
+    return out, pos, corrupt
+
+
+def _scan_records_py(buf: bytes) -> tuple[list[bytes], int, bool]:
+    import binascii
+    import struct
+
+    out: list[bytes] = []
+    pos = 0
+    while pos + 8 <= len(buf):
+        plen, want = struct.unpack_from("<II", buf, pos)
+        if plen > 1 << 30:
+            return out, pos, True
+        if pos + 8 + plen > len(buf):
+            break
+        payload = buf[pos + 8 : pos + 8 + plen]
+        if binascii.crc32(payload) != want:
+            return out, pos, True
+        out.append(payload)
+        pos += 8 + plen
+    return out, pos, False
 
 
 def pad_batch(x: np.ndarray, bucket_rows: int) -> np.ndarray:

@@ -116,8 +116,12 @@ class Platform:
         if spec.component("bus").enabled:
             from ccfd_tpu.bus.broker import Broker
 
+            bus_spec = spec.component("bus")
+            log_dir = bus_spec.opt("log_dir", "") or None
             self.broker = Broker(
-                default_partitions=int(spec.component("bus").opt("partitions", 3))
+                default_partitions=int(bus_spec.opt("partitions", 3)),
+                log_dir=log_dir,
+                fsync=bool(bus_spec.opt("fsync", False)),
             )
         else:
             needs_bus = [

@@ -1,0 +1,206 @@
+"""Durable-bus tests: segment-log persistence, offset resume, crash recovery.
+
+Capability under test: the reference's recovery semantics — Kafka log
+persistence + committed consumer offsets (SURVEY.md §5 "Checkpoint /
+resume") — reproduced by ccfd_tpu/bus/log.py + Broker(log_dir=...).
+"""
+
+import json
+import os
+import struct
+
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.bus.log import BusLog, SegmentFile, decode_entry, encode_entry
+from ccfd_tpu.native import frame_records, native_available, scan_records
+
+
+def test_wire_roundtrip_all_value_types():
+    for value in (b"\x00raw\xff", "csv,line,1.5", {"Amount": 3.5, "id": "t1"},
+                  [1, 2], None, 3.25):
+        key, ts, got = decode_entry(encode_entry("k1", 12.5, value))
+        assert key == "k1" and ts == 12.5 and got == value
+    assert isinstance(decode_entry(encode_entry(None, 0, b"x"))[2], bytes)
+    assert isinstance(decode_entry(encode_entry(None, 0, "x"))[2], str)
+
+
+def test_frame_scan_roundtrip_and_parity():
+    payloads = [b"a", b"", b"x" * 1000, json.dumps({"v": 1}).encode()]
+    buf = frame_records(payloads)
+    got, consumed, corrupt = scan_records(buf)
+    assert got == payloads and consumed == len(buf) and not corrupt
+
+    # native and Python fallback produce identical bytes and scans
+    from ccfd_tpu.native import _scan_records_py
+
+    assert _scan_records_py(buf) == (payloads, len(buf), False)
+    if native_available():
+        import binascii
+
+        parts = []
+        for p in payloads:
+            parts.append(struct.pack("<II", len(p), binascii.crc32(p)))
+            parts.append(p)
+        assert buf == b"".join(parts)
+
+
+def test_scan_stops_at_torn_tail_and_corruption():
+    payloads = [b"one", b"two", b"three"]
+    buf = frame_records(payloads)
+    # torn tail: cut mid-frame
+    got, consumed, corrupt = scan_records(buf[:-2])
+    assert got == [b"one", b"two"] and not corrupt
+    assert consumed == len(frame_records([b"one", b"two"]))
+    # corruption: flip a payload byte in the middle frame
+    bad = bytearray(buf)
+    bad[len(frame_records([b"one"])) + 8] ^= 0xFF
+    got, consumed, corrupt = scan_records(bytes(bad))
+    assert got == [b"one"] and corrupt
+    assert consumed == len(frame_records([b"one"]))
+
+
+def test_segment_file_truncates_crashed_tail(tmp_path):
+    path = str(tmp_path / "seg.log")
+    seg = SegmentFile(path)
+    seg.append(b"alpha", b"beta")
+    seg.close()
+    with open(path, "ab") as f:
+        f.write(b"\x99\x00\x00\x00")  # torn header from a crashed writer
+    seg2 = SegmentFile(path)
+    assert seg2.replay() == [b"alpha", b"beta"]
+    assert os.path.getsize(path) == len(frame_records([b"alpha", b"beta"]))
+    seg2.append(b"gamma")  # appends continue cleanly after recovery
+    seg2.close()
+    assert SegmentFile(path).replay() == [b"alpha", b"beta", b"gamma"]
+
+
+def test_broker_records_and_offsets_survive_reopen(tmp_path):
+    d = str(tmp_path / "bus")
+    b1 = Broker(default_partitions=2, log_dir=d)
+    b1.create_topic("odh-demo", 2)
+    for i in range(10):
+        b1.produce("odh-demo", {"i": i}, key=str(i))
+    c = b1.consumer("router", ("odh-demo",))
+    first = c.poll(max_records=6)
+    assert len(first) == 6
+    b1.close()  # process "crashes" after consuming 6
+
+    b2 = Broker(log_dir=d)
+    # partition layout replayed from meta, not default_partitions
+    assert sum(b2.end_offsets("odh-demo")) == 10
+    assert len(b2.end_offsets("odh-demo")) == 2
+    c2 = b2.consumer("router", ("odh-demo",))
+    rest = c2.poll(max_records=100)
+    got = sorted(r.value["i"] for r in first) + sorted(r.value["i"] for r in rest)
+    assert sorted(got) == list(range(10))
+    assert len(rest) == 4  # resumes exactly after the committed 6
+    b2.close()
+
+
+def test_broker_replays_mixed_wire_values(tmp_path):
+    d = str(tmp_path / "bus")
+    b1 = Broker(log_dir=d)
+    b1.produce("t", b"1.5,2.5\n", key="csv")
+    b1.produce("t", "plain-string")
+    b1.produce("t", {"Amount": 9.0})
+    b1.close()
+    b2 = Broker(log_dir=d)
+    c = b2.consumer("g", ("t",))
+    values = [r.value for r in sorted(c.poll(100), key=lambda r: r.timestamp)]
+    assert b"1.5,2.5\n" in values and "plain-string" in values
+    assert {"Amount": 9.0} in values
+    b2.close()
+
+
+def test_new_group_on_reopened_broker_reads_from_start(tmp_path):
+    d = str(tmp_path / "bus")
+    b1 = Broker(log_dir=d)
+    for i in range(5):
+        b1.produce("t", i)
+    c = b1.consumer("g1", ("t",))
+    assert len(c.poll(100)) == 5
+    b1.close()
+    b2 = Broker(log_dir=d)
+    fresh = b2.consumer("g2", ("t",))
+    assert len(fresh.poll(100)) == 5  # new group: full replay
+    done = b2.consumer("g1", ("t",))
+    assert done.poll(100, timeout_s=0.0) == []  # old group: fully committed
+    b2.close()
+
+
+def test_key_routing_is_stable_across_processes(tmp_path):
+    """Same key -> same partition after reopen (Python's salted str hash
+    must not leak into routing; Kafka hashes key bytes)."""
+    d = str(tmp_path / "bus")
+    b1 = Broker(default_partitions=3, log_dir=d)
+    routed = {k: b1.produce("t", 0, key=k).partition for k in ("a", "b", "c", "d")}
+    b1.close()
+    b2 = Broker(log_dir=d)
+    for k, part in routed.items():
+        assert b2.produce("t", 1, key=k).partition == part
+    b2.close()
+
+
+def test_bytes_keys_survive_durable_roundtrip(tmp_path):
+    d = str(tmp_path / "bus")
+    b1 = Broker(log_dir=d)
+    part = b1.produce("t", {"v": 1}, key=b"\x00cust\xff").partition
+    b1.close()
+    b2 = Broker(log_dir=d)
+    rec = b2.consumer("g", ("t",)).poll(10)[0]
+    assert rec.key == b"\x00cust\xff" and rec.partition == part
+    b2.close()
+
+
+def test_unencodable_value_fails_without_diverging_state(tmp_path):
+    b = Broker(log_dir=str(tmp_path / "bus"))
+    with pytest.raises(TypeError):
+        b.produce("t", object())  # not JSON-able
+    assert b.end_offsets("t") == [0, 0, 0]  # memory untouched
+    b.close()
+
+
+def test_committed_offset_clamped_after_log_truncation(tmp_path):
+    """Torn-tail truncation + surviving offsets must not skip future records."""
+    d = str(tmp_path / "bus")
+    b1 = Broker(default_partitions=1, log_dir=d)
+    for i in range(10):
+        b1.produce("t", i)
+    c = b1.consumer("g", ("t",))
+    assert len(c.poll(100)) == 10  # commits offset 10
+    b1.close()
+    # crash lost the last 5 records but offsets.log survived
+    seg = next(f for f in os.listdir(d) if f.startswith("t0_p0"))
+    path = os.path.join(d, seg)
+    with open(path, "rb") as f:
+        payloads, _, _ = scan_records(f.read())
+    with open(path, "r+b") as f:
+        f.truncate(len(frame_records(payloads[:5])))
+    b2 = Broker(log_dir=d)
+    assert b2.end_offsets("t") == [5]
+    for i in range(5, 8):
+        b2.produce("t", i)  # lands at offsets 5..7
+    c2 = b2.consumer("g", ("t",))
+    got = [r.value for r in c2.poll(100)]
+    assert got == [5, 6, 7]  # resumes at the clamped offset, skips nothing
+    b2.close()
+
+
+def test_memory_broker_unaffected():
+    b = Broker()
+    b.produce("t", 1)
+    assert len(b.consumer("g", ("t",)).poll(10)) == 1
+    b.close()  # no-op
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+def test_native_crc_matches_binascii():
+    import binascii
+    import ctypes
+
+    from ccfd_tpu.native import _load
+
+    lib = _load()
+    for data in (b"", b"abc", bytes(range(256)) * 7):
+        assert lib.ccfd_crc32(data, len(data)) == binascii.crc32(data)
