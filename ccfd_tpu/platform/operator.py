@@ -15,6 +15,8 @@ steps, running every long-lived service under the runtime Supervisor
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 import threading
 from typing import Any, Mapping
 
@@ -280,6 +282,30 @@ class Platform:
         self.engine = build_engine(
             self.cfg, self.broker, self._registry("kie"), prediction_service=pred
         )
+        # jBPM-style engine persistence: restore process state across
+        # restarts (overdue timers fire promptly after restore)
+        c = self.spec.component("engine")
+        state_file = c.opt("state_file", "")
+        self._engine_state_file = state_file or None
+        if state_file and os.path.exists(state_file):
+            self.engine.load(state_file)
+        if state_file:
+            # periodic checkpoint: a crash between saves loses at most
+            # save_interval_s of process state — save-on-down alone would
+            # lose everything exactly when persistence matters (SIGKILL/OOM)
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            interval = float(c.opt("save_interval_s", 5.0))
+            stop = threading.Event()
+
+            def checkpoint_loop() -> None:
+                while not stop.wait(interval):
+                    self._save_engine_state()
+
+            self.supervisor.add_thread_service(
+                "engine-persist", checkpoint_loop, stop.set,
+                policy=RestartPolicy.ALWAYS,
+            )
 
     def _up_notify(self) -> None:
         from ccfd_tpu.notify.service import NotificationService
@@ -419,9 +445,20 @@ class Platform:
             out["endpoints"]["health"] = self.health_server.endpoint
         return out
 
+    def _save_engine_state(self) -> None:
+        try:
+            self.engine.save(self._engine_state_file)
+        except Exception:  # noqa: BLE001 - persistence must not kill the host
+            logging.getLogger(__name__).exception(
+                "engine state save to %s failed; process state will NOT "
+                "survive a restart", self._engine_state_file,
+            )
+
     def down(self) -> None:
         if self.supervisor:
             self.supervisor.stop()
+        if self.engine is not None and getattr(self, "_engine_state_file", None):
+            self._save_engine_state()
         for srv in (
             self.prediction_server,
             self.exporter,

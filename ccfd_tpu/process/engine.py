@@ -28,6 +28,8 @@ This engine re-creates those semantics as an explicit state machine:
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping, Protocol
@@ -119,6 +121,7 @@ class Instance:
     wait_signal: str | None = None
     wait_gen: int = 0
     timer: TimerHandle | None = None
+    timer_deadline: float | None = None  # clock.now()-relative; for snapshots
     history: list[str] = field(default_factory=list)
 
 
@@ -235,10 +238,170 @@ class Engine:
             inst.vars["task_outcome"] = outcome
             self._run_from(inst, node.next)
 
+    # -- persistence (jBPM keeps process state in its engine store;
+    #    SURVEY.md §5 "jBPM process state (persistent in the engine)") ----
+    def snapshot(self, include_completed: bool = False) -> dict[str, Any]:
+        """Serializable engine state: instances, tasks, id counters.
+
+        Timer waits serialize as *remaining* seconds (clock epochs differ
+        across processes). Process vars must be JSON-able — the same
+        contract jBPM puts on persisted process variables.
+
+        By default only ACTIVE instances and their open tasks are captured
+        (jBPM likewise drops completed instances from the runtime store,
+        keeping history in the audit log — here, in metrics): a long-running
+        pipeline starts a process per flagged transaction, and snapshotting
+        every completed instance forever would grow the state file and the
+        save/restore cost without bound.
+        """
+        with self._lock:
+            now = self.clock.now()
+            live = {
+                pid
+                for pid, i in self._instances.items()
+                if include_completed or i.status == "active"
+            }
+            instances = []
+            for i in self._instances.values():
+                if i.pid not in live:
+                    continue
+                instances.append(
+                    {
+                        "pid": i.pid,
+                        "def": i.definition.id,
+                        "vars": i.vars,
+                        "status": i.status,
+                        "node": i.node,
+                        "wait_signal": i.wait_signal,
+                        "wait_gen": i.wait_gen,
+                        "timer_remaining_s": (
+                            None
+                            if i.timer_deadline is None
+                            else max(0.0, i.timer_deadline - now)
+                        ),
+                        "history": list(i.history),
+                    }
+                )
+            tasks = [
+                {
+                    "task_id": t.task_id,
+                    "pid": t.pid,
+                    "name": t.name,
+                    "vars": t.vars,
+                    "status": t.status,
+                    "suggested_outcome": t.suggested_outcome,
+                    "prediction_confidence": t.prediction_confidence,
+                    "outcome": t.outcome,
+                }
+                for t in self._tasks.values()
+                if t.pid in live and (include_completed or t.status == "open")
+            ]
+            snap = {
+                "version": 1,
+                "next_pid": next(self._pid),
+                "next_tid": next(self._tid),
+                "instances": instances,
+                "tasks": tasks,
+            }
+            # the counters advanced to produce the snapshot; keep going from
+            # the recorded values so live allocation stays consistent
+            self._pid = itertools.count(snap["next_pid"])
+            self._tid = itertools.count(snap["next_tid"])
+            # round-trip through JSON: validates serializability now (not at
+            # restore time months later) and detaches the snapshot from live
+            # engine state so later mutations can't corrupt it
+            return json.loads(json.dumps(snap))
+
+    def restore(self, snap: Mapping[str, Any]) -> None:
+        """Load a snapshot into an empty engine and re-arm pending timers.
+
+        Definitions are code, not data (like jBPM KJARs): every definition
+        referenced by the snapshot must already be ``register``-ed. Waits
+        whose timers expired while the engine was down are re-armed with
+        zero delay — the timeout path fires promptly after restore, which
+        is jBPM's overdue-timer recovery behavior.
+        """
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
+        with self._lock:
+            if self._instances or self._tasks:
+                raise ValueError("restore requires an empty engine")
+            missing = {i["def"] for i in snap["instances"]} - set(self._definitions)
+            if missing:
+                raise ValueError(f"snapshot needs unregistered definitions {sorted(missing)}")
+            # definitions are code and may have drifted since the snapshot:
+            # an instance parked on a renamed node would pass restore and
+            # then KeyError at signal/timer time, wedging it permanently —
+            # fail here, with names
+            for s in snap["instances"]:
+                d = self._definitions[s["def"]]
+                if s["status"] == "active" and s["node"] not in d.nodes:
+                    raise ValueError(
+                        f"instance {s['pid']}: node {s['node']!r} no longer in "
+                        f"definition {d.id!r} (has {sorted(d.nodes)})"
+                    )
+                if s["status"] == "active" and s["wait_signal"] is not None:
+                    node = d.nodes[s["node"]]
+                    if not isinstance(node, EventNode) or node.signal != s["wait_signal"]:
+                        raise ValueError(
+                            f"instance {s['pid']}: waiting on signal "
+                            f"{s['wait_signal']!r} but node {s['node']!r} is not "
+                            f"an EventNode for it"
+                        )
+            for s in snap["instances"]:
+                inst = Instance(
+                    pid=int(s["pid"]),
+                    definition=self._definitions[s["def"]],
+                    vars=dict(s["vars"]),
+                    status=s["status"],
+                    node=s["node"],
+                    wait_signal=s["wait_signal"],
+                    wait_gen=int(s["wait_gen"]),
+                    history=list(s["history"]),
+                )
+                self._instances[inst.pid] = inst
+            for s in snap["tasks"]:
+                t = Task(
+                    task_id=int(s["task_id"]),
+                    pid=int(s["pid"]),
+                    name=s["name"],
+                    vars=dict(s["vars"]),
+                    status=s["status"],
+                    suggested_outcome=s["suggested_outcome"],
+                    prediction_confidence=s["prediction_confidence"],
+                    outcome=s["outcome"],
+                )
+                self._tasks[t.task_id] = t
+            self._pid = itertools.count(int(snap["next_pid"]))
+            self._tid = itertools.count(int(snap["next_tid"]))
+            # re-arm after all state is in place: a zero-delay timer may
+            # fire (RealClock scheduler thread) as soon as we release _lock
+            for s in snap["instances"]:
+                remaining = s["timer_remaining_s"]
+                if s["status"] == "active" and remaining is not None:
+                    inst = self._instances[int(s["pid"])]
+                    inst.timer_deadline = self.clock.now() + remaining
+                    inst.timer = self.clock.call_later(
+                        remaining,
+                        lambda pid=inst.pid, g=inst.wait_gen: self._timer_fired(pid, g),
+                    )
+
+    def save(self, path: str) -> None:
+        """Atomic snapshot-to-file (tmp + rename)."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        with open(path) as f:
+            self.restore(json.load(f))
+
     # -- internals --------------------------------------------------------
     def _consume_wait(self, inst: Instance) -> None:
         inst.wait_signal = None
         inst.wait_gen += 1
+        inst.timer_deadline = None
         if inst.timer is not None:
             inst.timer.cancel()
             inst.timer = None
@@ -280,6 +443,7 @@ class Engine:
                 )
                 inst.wait_signal = node.signal
                 gen = inst.wait_gen
+                inst.timer_deadline = self.clock.now() + timeout
                 inst.timer = self.clock.call_later(
                     timeout, lambda pid=inst.pid, g=gen: self._timer_fired(pid, g)
                 )

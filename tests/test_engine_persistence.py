@@ -1,0 +1,262 @@
+"""Engine persistence: snapshot/restore with timer re-arming across restart.
+
+Capability under test: jBPM keeps process state persistent in the engine
+(SURVEY.md §5 "Checkpoint / resume"); the restored engine must preserve the
+timer-vs-signal race — including timers that were mid-countdown or became
+overdue while the process was down.
+"""
+
+import os
+
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.clock import ManualClock
+from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL, build_engine
+
+CFG = Config(customer_reply_timeout_s=30.0, low_amount_threshold=200.0,
+             low_proba_threshold=0.75)
+
+
+def make(start_time=0.0):
+    broker = Broker()
+    clock = ManualClock(start=start_time)
+    engine = build_engine(CFG, broker, Registry(), clock)
+    return broker, clock, engine
+
+
+def tx(amount, txid=1):
+    return {"id": txid, "Amount": amount, "V17": 0.1, "V10": 0.2}
+
+
+def start_fraud(engine, amount=500.0, proba=0.9):
+    return engine.start_process(
+        "fraud", {"transaction": tx(amount), "proba": proba, "customer_id": "c1"}
+    )
+
+
+def restart(engine, clock_start):
+    """Snapshot -> fresh engine on a new clock epoch -> restore."""
+    snap = engine.snapshot()
+    _, clock2, engine2 = make(start_time=clock_start)
+    engine2.restore(snap)
+    return clock2, engine2
+
+
+def test_signal_after_restart_approves():
+    _, clock, engine = make()
+    pid = start_fraud(engine)
+    assert engine.instance(pid).status == "active"
+    clock2, engine2 = restart(engine, clock_start=1000.0)
+    assert engine2.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+    assert engine2.instance(pid).status == "completed"
+
+
+def test_timer_keeps_remaining_time_across_restart():
+    """10s elapse before the crash; after restore the timer fires at +20s,
+    not a fresh +30s."""
+    _, clock, engine = make()
+    pid = start_fraud(engine)
+    clock.advance(10.0)
+    clock2, engine2 = restart(engine, clock_start=5000.0)
+    inst = engine2.instance(pid)
+    assert inst.status == "active"
+    clock2.advance(19.9)
+    assert engine2.instance(pid).node == "await_reply"  # not yet
+    clock2.advance(0.2)
+    assert engine2.instance(pid).node != "await_reply"  # timeout path taken
+
+
+def test_overdue_timer_fires_promptly_after_restore():
+    """The engine was down past the deadline: remaining clamps to zero and
+    the timeout path runs on the first clock tick after restore."""
+    _, clock, engine = make()
+    pid = start_fraud(engine)
+    clock.advance(29.0)
+    snap = engine.snapshot()
+    # ... process down for a long time ...
+    _, clock2, engine2 = make(start_time=99999.0)
+    engine2.restore(snap)
+    clock2.advance(1.0)  # only 1s of the original 1s remaining passes
+    assert engine2.instance(pid).node != "await_reply"
+
+
+def test_signal_loses_to_timer_that_fired_before_snapshot():
+    _, clock, engine = make()
+    pid = start_fraud(engine)
+    clock.advance(31.0)  # timer already fired: DMN path taken
+    node_after_timeout = engine.instance(pid).node
+    clock2, engine2 = restart(engine, clock_start=0.0)
+    assert not engine2.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+    assert engine2.instance(pid).node == node_after_timeout
+
+
+def test_open_user_task_survives_restart():
+    _, clock, engine = make()
+    pid = start_fraud(engine, amount=5000.0, proba=0.99)
+    clock.advance(31.0)  # no reply -> DMN -> investigation user task
+    open_before = engine.tasks("open")
+    assert len(open_before) == 1
+    clock2, engine2 = restart(engine, clock_start=0.0)
+    open_after = engine2.tasks("open")
+    assert [t.task_id for t in open_after] == [t.task_id for t in open_before]
+    engine2.complete_task(open_after[0].task_id, True)  # truthy = fraud confirmed
+    assert engine2.instance(pid).status == "cancelled"
+    assert engine2.instance(pid).vars["resolution"] == "fraud_rejected_amount"
+
+
+def test_id_counters_continue_after_restore():
+    _, clock, engine = make()
+    pid1 = start_fraud(engine)
+    clock2, engine2 = restart(engine, clock_start=0.0)
+    pid2 = start_fraud(engine2)
+    assert pid2 > pid1
+
+
+def test_save_load_file_roundtrip(tmp_path):
+    path = str(tmp_path / "engine.json")
+    _, clock, engine = make()
+    pid = start_fraud(engine)
+    engine.save(path)
+    _, clock2, engine2 = make(start_time=777.0)
+    engine2.load(path)
+    assert engine2.instance(pid).status == "active"
+    assert engine2.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+
+
+def test_restore_validation():
+    _, _, engine = make()
+    with pytest.raises(ValueError, match="unknown snapshot version"):
+        engine.restore({"version": 99})
+    snap = engine.snapshot()
+    start_fraud(engine)
+    with pytest.raises(ValueError, match="empty engine"):
+        engine.restore(snap)
+    from ccfd_tpu.process.engine import Engine
+
+    bare = Engine(clock=ManualClock())
+    snap2 = engine.snapshot()
+    with pytest.raises(ValueError, match="unregistered definitions"):
+        bare.restore(snap2)
+
+
+def test_snapshot_is_detached_from_live_state():
+    _, clock, engine = make()
+    pid = start_fraud(engine)
+    snap = engine.snapshot()
+    engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})  # mutate live
+    assert snap["instances"][0]["status"] == "active"  # snapshot unchanged
+
+
+def test_completed_instances_excluded_by_default():
+    """jBPM drops completed instances from the runtime store; the snapshot
+    must not grow without bound as the pipeline completes processes."""
+    _, clock, engine = make()
+    done = engine.start_process("standard", {"transaction": tx(10.0)})
+    live = start_fraud(engine)
+    snap = engine.snapshot()
+    assert [s["pid"] for s in snap["instances"]] == [live]
+    full = engine.snapshot(include_completed=True)
+    assert sorted(s["pid"] for s in full["instances"]) == [done, live]
+    # id counters still advance past completed instances after restore
+    _, clock2, engine2 = make()
+    engine2.restore(snap)
+    assert engine2.start_process("standard", {"transaction": tx(1.0)}) > live
+
+
+def test_completed_task_of_active_instance_excluded():
+    _, clock, engine = make()
+    pid = start_fraud(engine, amount=5000.0, proba=0.99)
+    clock.advance(31.0)  # -> investigation user task
+    (task,) = engine.tasks("open")
+    engine.complete_task(task.task_id, False)  # approve -> instance completes
+    pid2 = start_fraud(engine, amount=5000.0, proba=0.99)
+    clock.advance(31.0)
+    snap = engine.snapshot()
+    assert [t["pid"] for t in snap["tasks"]] == [pid2]  # only the open one
+
+
+def test_restore_rejects_snapshot_from_drifted_definition():
+    _, clock, engine = make()
+    start_fraud(engine)
+    snap = engine.snapshot()
+    snap["instances"][0]["node"] = "await_customer"  # renamed in "new code"
+    _, _, engine2 = make()
+    with pytest.raises(ValueError, match="no longer in definition"):
+        engine2.restore(snap)
+    snap["instances"][0]["node"] = "notify"  # exists, but not an EventNode
+    _, _, engine3 = make()
+    with pytest.raises(ValueError, match="not an EventNode"):
+        engine3.restore(snap)
+
+
+def test_platform_periodic_checkpoint_survives_crash(tmp_path):
+    """State reaches disk on the checkpoint interval, not just clean down():
+    a SIGKILL between saves loses at most save_interval_s of state."""
+    import time as _time
+
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+    from tests.test_platform import minimal_cr
+
+    state = str(tmp_path / "state.json")
+    cfg = Config(customer_reply_timeout_s=3600.0)
+    cr = minimal_cr(
+        engine={"enabled": True, "state_file": state, "save_interval_s": 0.1},
+        notify={"enabled": False},
+    )
+    p1 = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+    try:
+        pid = p1.engine.start_process(
+            "fraud", {"transaction": tx(100.0), "proba": 0.9, "customer_id": "c"}
+        )
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            if os.path.exists(state):
+                import json as _json
+
+                with open(state) as f:
+                    snap = _json.load(f)
+                if any(s["pid"] == pid for s in snap["instances"]):
+                    break
+            _time.sleep(0.05)
+        else:
+            raise AssertionError("checkpoint never reached disk")
+    finally:
+        # crash: no down(), threads die with the process in real life; here
+        # we only assert the file content written by the periodic saver
+        p1.supervisor.stop()
+    p2 = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+    try:
+        assert p2.engine.instance(pid).status == "active"
+    finally:
+        p2.down()
+
+
+def test_platform_engine_state_file_roundtrip(tmp_path):
+    """Operator wiring: engine state_file persists across up/down cycles."""
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+    from tests.test_platform import minimal_cr
+
+    state = str(tmp_path / "engine-state.json")
+    cfg = Config(customer_reply_timeout_s=3600.0)
+    # notify disabled: the simulated customer would reply and complete the
+    # process before the platform goes down
+    cr = minimal_cr(
+        engine={"enabled": True, "state_file": state},
+        notify={"enabled": False},
+    )
+    p1 = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+    try:
+        pid = p1.engine.start_process(
+            "fraud", {"transaction": tx(100.0), "proba": 0.9, "customer_id": "c"}
+        )
+    finally:
+        p1.down()
+    p2 = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+    try:
+        assert p2.engine.instance(pid).status == "active"
+        assert p2.engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+    finally:
+        p2.down()
