@@ -92,6 +92,7 @@ class Platform:
         self.broker = None
         self.scorer = None
         self.engine = None
+        self.usertask_model = None
         self.store_server = None
         self.prediction_server = None
         self.prediction_host = "127.0.0.1"
@@ -274,22 +275,39 @@ class Platform:
         from ccfd_tpu.process.fraud import build_engine
         from ccfd_tpu.process.prediction import ScorerPredictionService
 
-        pred = (
-            ScorerPredictionService(self.scorer.score)
-            if self.scorer is not None
-            else None
-        )
+        c = self.spec.component("engine")
+        listener = None
+        if c.opt("usertask_model", False):
+            # dedicated learned user-task model (the reference's second
+            # Seldon model, README.md:347-353): trains on investigator
+            # decisions, replaces the fraud-scorer-backed service
+            from ccfd_tpu.process.usertask_model import OnlineUserTaskModel
+
+            self.usertask_model = OnlineUserTaskModel(
+                min_examples=int(c.opt("usertask_min_examples", 32)),
+            )
+            self._usertask_state_file = c.opt("usertask_state_file", "") or None
+            if self._usertask_state_file and os.path.exists(self._usertask_state_file):
+                self.usertask_model.load(self._usertask_state_file)
+            pred = self.usertask_model
+            listener = self.usertask_model.observe
+        else:
+            pred = (
+                ScorerPredictionService(self.scorer.score)
+                if self.scorer is not None
+                else None
+            )
         self.engine = build_engine(
-            self.cfg, self.broker, self._registry("kie"), prediction_service=pred
+            self.cfg, self.broker, self._registry("kie"), prediction_service=pred,
+            task_listener=listener,
         )
         # jBPM-style engine persistence: restore process state across
         # restarts (overdue timers fire promptly after restore)
-        c = self.spec.component("engine")
         state_file = c.opt("state_file", "")
         self._engine_state_file = state_file or None
         if state_file and os.path.exists(state_file):
             self.engine.load(state_file)
-        if state_file:
+        if state_file or getattr(self, "_usertask_state_file", None):
             # periodic checkpoint: a crash between saves loses at most
             # save_interval_s of process state — save-on-down alone would
             # lose everything exactly when persistence matters (SIGKILL/OOM)
@@ -446,18 +464,29 @@ class Platform:
         return out
 
     def _save_engine_state(self) -> None:
-        try:
-            self.engine.save(self._engine_state_file)
-        except Exception:  # noqa: BLE001 - persistence must not kill the host
-            logging.getLogger(__name__).exception(
-                "engine state save to %s failed; process state will NOT "
-                "survive a restart", self._engine_state_file,
-            )
+        if self._engine_state_file:
+            try:
+                self.engine.save(self._engine_state_file)
+            except Exception:  # noqa: BLE001 - persistence must not kill the host
+                logging.getLogger(__name__).exception(
+                    "engine state save to %s failed; process state will NOT "
+                    "survive a restart", self._engine_state_file,
+                )
+        if getattr(self, "_usertask_state_file", None) and self.usertask_model:
+            try:
+                self.usertask_model.save(self._usertask_state_file)
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "user-task model save to %s failed", self._usertask_state_file
+                )
 
     def down(self) -> None:
         if self.supervisor:
             self.supervisor.stop()
-        if self.engine is not None and getattr(self, "_engine_state_file", None):
+        if self.engine is not None and (
+            getattr(self, "_engine_state_file", None)
+            or getattr(self, "_usertask_state_file", None)
+        ):
             self._save_engine_state()
         for srv in (
             self.prediction_server,

@@ -154,11 +154,17 @@ class Engine:
         registry: Registry | None = None,
         prediction_service: PredictionService | None = None,
         confidence_threshold: float = 1.0,
+        task_listener: Callable[[Task], None] | None = None,
     ):
         self.clock: Clock = clock or RealClock()
         self.registry = registry or Registry()
         self.prediction_service = prediction_service
         self.confidence_threshold = confidence_threshold
+        # fired once per HUMAN complete_task (never for prediction-service
+        # auto-completions): the user-task model trains on investigator
+        # decisions only — learning from its own auto-closures would be
+        # feedback, not supervision
+        self.task_listener = task_listener
         self._definitions: dict[str, ProcessDefinition] = {}
         self._instances: dict[int, Instance] = {}
         self._tasks: dict[int, Task] = {}
@@ -237,6 +243,18 @@ class Engine:
             assert isinstance(node, UserTaskNode)
             inst.vars["task_outcome"] = outcome
             self._run_from(inst, node.next)
+        if self.task_listener is not None:
+            try:
+                self.task_listener(t)
+            except Exception:  # noqa: BLE001
+                # the task is already completed and the process advanced; a
+                # broken observer (bad feature value, training failure) must
+                # not surface as a failed complete_task to the investigator
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "task listener failed for task %d", t.task_id
+                )
 
     # -- persistence (jBPM keeps process state in its engine store;
     #    SURVEY.md §5 "jBPM process state (persistent in the engine)") ----

@@ -53,6 +53,7 @@ def build_engine(
     registry: Registry | None = None,
     clock: Clock | None = None,
     prediction_service=None,
+    task_listener=None,
 ) -> Engine:
     registry = registry or Registry()
     engine = Engine(
@@ -60,6 +61,7 @@ def build_engine(
         registry=registry,
         prediction_service=prediction_service,
         confidence_threshold=cfg.confidence_threshold,
+        task_listener=task_listener,
     )
 
     h_invest = registry.histogram(

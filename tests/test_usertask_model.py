@@ -1,0 +1,194 @@
+"""User-task prediction model: learns investigator decisions, then auto-triages.
+
+Capability under test: the reference's second Seldon model
+(``ccfd-seldon-usertask-model``, reference README.md:347-353, 571-581) —
+user-task outcome prediction with CONFIDENCE_THRESHOLD auto-completion —
+re-built as an online-trained JAX model (ccfd_tpu/process/usertask_model.py).
+"""
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.clock import ManualClock
+from ccfd_tpu.process.engine import Task
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.process.usertask_model import (
+    NUM_TASK_FEATURES,
+    OnlineUserTaskModel,
+    task_row,
+)
+
+
+def make_task(amount, proba=0.9, outcome=None, task_id=1):
+    t = Task(
+        task_id=task_id,
+        pid=task_id,
+        name="fraud-investigation",
+        vars={"transaction": {"Amount": amount, "V17": 0.0}, "proba": proba},
+    )
+    if outcome is not None:
+        t.status = "completed"
+        t.outcome = outcome
+    return t
+
+
+def test_task_row_shape_and_proba_feature():
+    row = task_row(make_task(123.0, proba=0.75))
+    assert row.shape == (1, NUM_TASK_FEATURES)
+    assert row[0, -1] == pytest.approx(0.75)
+    assert row[0, :-1].max() == pytest.approx(123.0)
+
+
+def test_cold_start_never_auto_closes():
+    m = OnlineUserTaskModel(min_examples=8)
+    outcome, confidence = m.predict(make_task(5000.0))
+    assert outcome is None and confidence == 0.0
+    assert not m.trained
+
+
+def test_learns_amount_rule_from_human_decisions(rng):
+    """Investigators confirm fraud iff Amount > 1000; after observing their
+    decisions the model predicts that rule with high confidence."""
+    m = OnlineUserTaskModel(min_examples=32, fit_every=8)
+    for i in range(64):
+        amount = float(rng.uniform(0, 2000))
+        m.observe(make_task(amount, proba=0.5, outcome=amount > 1000, task_id=i))
+    assert m.trained and m.n_examples == 64
+    hi_out, hi_conf = m.predict(make_task(1900.0, proba=0.5))
+    lo_out, lo_conf = m.predict(make_task(50.0, proba=0.5))
+    assert hi_out is True and lo_out is False
+    assert hi_conf > 0.8 and lo_conf > 0.8
+
+
+def test_open_tasks_are_not_observed():
+    m = OnlineUserTaskModel()
+    m.observe(make_task(100.0))  # still open
+    assert m.n_examples == 0
+
+
+def test_engine_feeds_human_decisions_only(rng):
+    """End-to-end: tasks stay open while the model is cold; human decisions
+    train it; once confident, new tasks auto-complete — and auto-completions
+    do NOT feed back into training."""
+    cfg = Config(customer_reply_timeout_s=1.0, low_amount_threshold=10.0,
+                 low_proba_threshold=0.01, confidence_threshold=0.9)
+    broker = Broker()
+    clock = ManualClock()
+    model = OnlineUserTaskModel(min_examples=24, fit_every=4)
+    engine = build_engine(cfg, broker, Registry(), clock,
+                          prediction_service=model, task_listener=model.observe)
+
+    def run_fraud(i, amount):
+        pid = engine.start_process(
+            "fraud",
+            {"transaction": {"id": i, "Amount": amount}, "proba": 0.99,
+             "customer_id": i},
+        )
+        clock.advance(1.1)  # no reply -> DMN -> investigate
+        return pid
+
+    # phase 1: cold model -> every task stays open; investigators decide.
+    # Exactly min_examples human decisions: the model trains on the last
+    # one and phase 2 must then auto-triage.
+    for i in range(24):
+        amount = float(rng.uniform(0, 2000))
+        pid = run_fraud(i, amount)
+        open_tasks = [t for t in engine.tasks("open") if t.pid == pid]
+        assert len(open_tasks) == 1, "cold model must not auto-close"
+        engine.complete_task(open_tasks[0].task_id, amount > 1000)
+    assert model.trained
+    n_human = model.n_examples
+
+    # phase 2: the trained model auto-triages clear-cut cases
+    pid_hi = run_fraud(1000, 1950.0)
+    inst = engine.instance(pid_hi)
+    assert inst.vars.get("task_auto_completed") is True
+    assert inst.status == "cancelled"  # confirmed fraud
+    pid_lo = run_fraud(1001, 5.0)
+    inst_lo = engine.instance(pid_lo)
+    assert inst_lo.vars.get("task_auto_completed") is True
+    assert inst_lo.status == "completed"  # approved
+
+    # auto-completions must not have been observed as training data
+    assert model.n_examples == n_human
+
+
+def test_low_confidence_prefills_only(rng):
+    cfg = Config(customer_reply_timeout_s=1.0, low_amount_threshold=10.0,
+                 low_proba_threshold=0.01, confidence_threshold=1.1)  # unreachable
+    broker = Broker()
+    clock = ManualClock()
+    model = OnlineUserTaskModel(min_examples=16, fit_every=4)
+    engine = build_engine(cfg, broker, Registry(), clock,
+                          prediction_service=model, task_listener=model.observe)
+    for i in range(20):
+        amount = float(rng.uniform(0, 2000))
+        pid = engine.start_process(
+            "fraud", {"transaction": {"id": i, "Amount": amount}, "proba": 0.99,
+                      "customer_id": i},
+        )
+        clock.advance(1.1)
+        t = [t for t in engine.tasks("open") if t.pid == pid][0]
+        engine.complete_task(t.task_id, amount > 1000)
+    pid = engine.start_process(
+        "fraud", {"transaction": {"id": 999, "Amount": 1900.0}, "proba": 0.99,
+                  "customer_id": 999},
+    )
+    clock.advance(1.1)
+    (t,) = [t for t in engine.tasks("open") if t.pid == pid]
+    assert t.suggested_outcome is True  # pre-filled (README.md:581)
+    assert t.prediction_confidence is not None and t.prediction_confidence <= 1.0
+    assert engine.instance(pid).status == "active"  # still needs a human
+
+
+def test_model_save_load_roundtrip(tmp_path, rng):
+    m = OnlineUserTaskModel(min_examples=32, fit_every=8)
+    for i in range(40):
+        amount = float(rng.uniform(0, 2000))
+        m.observe(make_task(amount, proba=0.5, outcome=amount > 1000, task_id=i))
+    assert m.trained
+    path = str(tmp_path / "utm.npz")
+    m.save(path)
+    m2 = OnlineUserTaskModel()
+    m2.load(path)
+    assert m2.trained and m2.n_examples == m.n_examples
+    for amount in (1900.0, 50.0):
+        np.testing.assert_allclose(
+            m.predict(make_task(amount, proba=0.5))[1],
+            m2.predict(make_task(amount, proba=0.5))[1],
+            rtol=1e-6,
+        )
+    # restored model keeps learning
+    m2.observe(make_task(30.0, proba=0.5, outcome=False, task_id=999))
+    assert m2.n_examples == m.n_examples + 1
+
+
+def test_task_row_flat_vars_fallback_matches_prediction_service():
+    """Both services fall back to flat task vars when no transaction dict."""
+    t = Task(task_id=1, pid=1, name="x", vars={"Amount": 77.0, "proba": 0.4})
+    row = task_row(t)
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES as F
+
+    assert row[0, F.index("Amount")] == pytest.approx(77.0)
+    assert row[0, -1] == pytest.approx(0.4)
+
+
+def test_platform_wires_usertask_model(tmp_path):
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+    from tests.test_platform import minimal_cr
+
+    cfg = Config(customer_reply_timeout_s=3600.0)
+    cr = minimal_cr(
+        engine={"enabled": True, "usertask_model": True},
+        notify={"enabled": False},
+    )
+    p = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+    try:
+        assert p.usertask_model is not None
+        assert p.engine.prediction_service is p.usertask_model
+        assert p.engine.task_listener == p.usertask_model.observe
+    finally:
+        p.down()
