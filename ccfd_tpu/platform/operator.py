@@ -93,6 +93,8 @@ class Platform:
         self.scorer = None
         self.engine = None
         self.usertask_model = None
+        self.engine_server = None
+        self.engine_port = None
         self.store_server = None
         self.prediction_server = None
         self.prediction_host = "127.0.0.1"
@@ -324,6 +326,17 @@ class Platform:
                 "engine-persist", checkpoint_loop, stop.set,
                 policy=RestartPolicy.ALWAYS,
             )
+        if c.opt("rest", False):
+            # KIE-shaped REST surface (reference :8090, README.md:509-515).
+            # Started strictly AFTER the snapshot restore: an early remote
+            # start_process would populate the engine and make restore()
+            # refuse ("requires an empty engine").
+            from ccfd_tpu.process.server import EngineServer
+
+            self.engine_server = EngineServer(self.engine)
+            self.engine_port = self.engine_server.start(
+                c.opt("rest_host", "127.0.0.1"), int(c.opt("rest_port", 0))
+            )
 
     def _up_notify(self) -> None:
         from ccfd_tpu.notify.service import NotificationService
@@ -351,8 +364,18 @@ class Platform:
             from ccfd_tpu.serving.client import SeldonClient
 
             score_fn = SeldonClient(self.cfg).score
+        engine = self.engine
+        if engine is None and self.cfg.kie_server_url.startswith("http"):
+            # remote engine over the KIE-shaped REST contract
+            from ccfd_tpu.process.client import EngineRestClient
+
+            engine = EngineRestClient(
+                self.cfg.kie_server_url,
+                timeout_s=self.cfg.seldon_timeout_ms / 1000.0,
+                retries=self.cfg.client_retries,
+            )
         router = Router(
-            self.cfg, self.broker, score_fn, self.engine, self._registry("router")
+            self.cfg, self.broker, score_fn, engine, self._registry("router")
         )
         self.supervisor.add_thread_service(
             "router",
@@ -490,6 +513,7 @@ class Platform:
             self._save_engine_state()
         for srv in (
             self.prediction_server,
+            self.engine_server,
             self.exporter,
             self.health_server,
             self.store_server,

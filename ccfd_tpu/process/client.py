@@ -1,0 +1,110 @@
+"""REST client for a remote process engine (the router's KIE_SERVER_URL hop).
+
+The reference router drives the KIE server over HTTP
+(``KIE_SERVER_URL``, reference deploy/router.yaml:63-64): process starts
+for scored transactions and signal forwarding for customer responses.
+This client implements the in-process ``EngineClient`` protocol
+(ccfd_tpu/router/router.py) against ccfd_tpu/process/server.py, so the
+router can run on the TPU host while the engine lives elsewhere. Pooled
+connections + bounded retries, mirroring ccfd_tpu/serving/client.py.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import urllib.parse
+from typing import Any, Mapping
+
+
+class EngineRestClient:
+    def __init__(
+        self,
+        base_url: str,
+        pool_size: int = 4,
+        timeout_s: float = 5.0,
+        retries: int = 2,
+    ):
+        u = urllib.parse.urlparse(base_url)
+        if u.scheme not in ("http", ""):
+            raise ValueError(f"unsupported scheme in KIE_SERVER_URL: {base_url!r}")
+        self._host = u.hostname or "localhost"
+        self._port = u.port or 8090
+        self._timeout = timeout_s
+        self._retries = max(0, retries)
+        self._pool: "queue.Queue[http.client.HTTPConnection]" = queue.Queue()
+        for _ in range(max(1, pool_size)):
+            self._pool.put(self._connect())
+
+    def _connect(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self._timeout
+        )
+
+    def _request(
+        self, method: str, path: str, body: Any = None, idempotent: bool = True
+    ) -> tuple[int, Any]:
+        payload = json.dumps(body).encode() if body is not None else None
+        last_exc: Exception | None = None
+        for _ in range(self._retries + 1):
+            conn = self._pool.get()
+            try:
+                conn.request(
+                    method, path, body=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+                self._pool.put(conn)
+                return resp.status, (json.loads(data) if data else None)
+            except (OSError, http.client.HTTPException) as e:
+                last_exc = e
+                conn.close()
+                self._pool.put(self._connect())
+                # a non-idempotent request (start_process) may have reached
+                # the engine before the failure — blind retry would start a
+                # duplicate instance. Only a refused connection proves the
+                # request never arrived.
+                if not idempotent and not isinstance(e, ConnectionRefusedError):
+                    break
+        raise ConnectionError(
+            f"engine at {self._host}:{self._port} unreachable: {last_exc}"
+        )
+
+    # -- EngineClient protocol --------------------------------------------
+    def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
+        code, body = self._request(
+            "POST", f"/rest/processes/{def_id}/instances",
+            {"variables": dict(variables)},
+            idempotent=False,
+        )
+        if code != 201:
+            raise RuntimeError(f"start_process {def_id!r} failed: {code} {body}")
+        return int(body["process_id"])
+
+    def signal(self, pid: int, name: str, payload: Any = None) -> bool:
+        code, body = self._request(
+            "POST", f"/rest/instances/{pid}/signal/{name}", {"payload": payload}
+        )
+        return code == 200 and bool(body.get("consumed"))
+
+    # -- convenience (investigator tooling) -------------------------------
+    def instance(self, pid: int) -> Mapping[str, Any]:
+        code, body = self._request("GET", f"/rest/instances/{pid}")
+        if code != 200:
+            raise KeyError(pid)
+        return body
+
+    def tasks(self, status: str = "open") -> list[Mapping[str, Any]]:
+        code, body = self._request("GET", f"/rest/tasks?status={status}")
+        if code != 200:
+            raise RuntimeError(f"tasks query failed: {code} {body}")
+        return body or []
+
+    def complete_task(self, task_id: int, outcome: Any) -> None:
+        code, body = self._request(
+            "POST", f"/rest/tasks/{task_id}/complete", {"outcome": outcome}
+        )
+        if code != 200:
+            raise RuntimeError(f"complete_task {task_id} failed: {code} {body}")

@@ -178,6 +178,13 @@ class Engine:
             "process_instances_completed_total", "process completions by status"
         )
 
+    @property
+    def state_lock(self) -> threading.RLock:
+        """The lock guarding instance/task state. External viewers (the REST
+        server) hold it while serializing ``vars`` dicts — the engine mutates
+        them in place, and iterating a live dict during a signal races."""
+        return self._lock
+
     # -- definitions ------------------------------------------------------
     def definitions(self) -> tuple[str, ...]:
         """Registered process-definition ids (the router validates its rule

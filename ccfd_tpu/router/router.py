@@ -194,6 +194,9 @@ class Router:
         self._c_start_err = r.counter(
             "router_process_start_errors_total", "failed process starts"
         )
+        self._c_signal_err = r.counter(
+            "router_signal_errors_total", "failed signal forwards"
+        )
         self._stop = threading.Event()
 
     # -- one synchronous cycle (used by tests and the run loop) ------------
@@ -210,7 +213,12 @@ class Router:
             )
             pid = payload.get("process_id")
             if pid is not None:
-                self.engine.signal(int(pid), CUSTOMER_RESPONSE_SIGNAL, payload)
+                try:
+                    self.engine.signal(int(pid), CUSTOMER_RESPONSE_SIGNAL, payload)
+                except Exception:
+                    # remote engine briefly unreachable: the rest of the
+                    # already-consumed response batch must still forward
+                    self._c_signal_err.inc()
 
         records = self._tx_consumer.poll(self.max_batch, poll_timeout_s)
         if not records:

@@ -1,0 +1,211 @@
+"""KIE-shaped REST surface: engine server + router-side client.
+
+Capability under test: the reference drives its jBPM engine over REST on
+:8090 — process starts and signal forwarding via KIE_SERVER_URL (reference
+deploy/router.yaml:63-64, README.md:552,569) and the /rest/metrics scrape
+path (README.md:509-515). ccfd_tpu/process/server.py + client.py reproduce
+that network contract for the in-tree engine.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.client import EngineRestClient
+from ccfd_tpu.process.clock import ManualClock
+from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL, build_engine
+from ccfd_tpu.process.server import EngineServer
+
+CFG = Config(customer_reply_timeout_s=30.0, low_amount_threshold=200.0,
+             low_proba_threshold=0.75)
+
+
+@pytest.fixture()
+def served_engine():
+    broker = Broker()
+    clock = ManualClock()
+    engine = build_engine(CFG, broker, Registry(), clock)
+    srv = EngineServer(engine)
+    port = srv.start(host="127.0.0.1", port=0)
+    client = EngineRestClient(f"http://127.0.0.1:{port}")
+    yield engine, clock, client, port
+    srv.stop()
+
+
+def tx(amount):
+    return {"id": 1, "Amount": amount, "V17": 0.1, "V10": 0.2}
+
+
+def test_start_signal_and_views_over_http(served_engine):
+    engine, clock, client, port = served_engine
+    pid = client.start_process(
+        "fraud", {"transaction": tx(500.0), "proba": 0.9, "customer_id": "c"}
+    )
+    view = client.instance(pid)
+    assert view["status"] == "active" and view["node"] == "await_reply"
+    assert client.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+    assert client.instance(pid)["status"] == "completed"
+    # consumed=False for a second signal (wait already gone)
+    assert not client.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+
+
+def test_task_listing_and_completion_over_http(served_engine):
+    engine, clock, client, port = served_engine
+    pid = client.start_process(
+        "fraud", {"transaction": tx(5000.0), "proba": 0.99, "customer_id": "c"}
+    )
+    clock.advance(31.0)  # no reply -> DMN -> investigation
+    (task,) = client.tasks("open")
+    assert task["process_id"] == pid and task["name"] == "fraud-investigation"
+    client.complete_task(task["task_id"], True)
+    assert client.instance(pid)["status"] == "cancelled"
+    # double-completion is a 409 surfaced as RuntimeError
+    with pytest.raises(RuntimeError, match="409"):
+        client.complete_task(task["task_id"], True)
+
+
+def test_errors_over_http(served_engine):
+    engine, clock, client, port = served_engine
+    with pytest.raises(RuntimeError, match="404"):
+        client.start_process("nope", {})
+    with pytest.raises(KeyError):
+        client.instance(99999)
+
+
+def test_metrics_scrape_paths(served_engine):
+    engine, clock, client, port = served_engine
+    client.start_process(
+        "standard", {"transaction": tx(10.0), "proba": 0.1, "customer_id": "c"}
+    )
+    for path in ("/rest/metrics", "/metrics"):
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}"
+        ).read().decode()
+        assert 'process_instances_started_total{process="standard"} 1' in body
+    health = json.load(
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/health/status")
+    )
+    assert health["status"] == "ok" and "fraud" in health["definitions"]
+
+
+def test_router_drives_remote_engine(served_engine):
+    """Full hop: router on one 'host', engine behind HTTP on another."""
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES
+    from ccfd_tpu.router.router import Router
+
+    engine, clock, client, port = served_engine
+    broker = Broker()
+    cfg = Config(customer_reply_timeout_s=30.0)
+    reg = Registry()
+    router = Router(
+        cfg, broker, lambda x: np.full(x.shape[0], 0.9, np.float32), client, reg
+    )
+    for i in range(5):
+        broker.produce(
+            cfg.kafka_topic, {n: 0.0 for n in FEATURE_NAMES} | {"id": i}
+        )
+    assert router.step() == 5
+    assert len(engine.instances()) == 5  # all started over HTTP
+    # customer response forwarded as a signal over HTTP
+    pid = engine.instances()[0].pid
+    broker.produce(
+        cfg.customer_response_topic, {"process_id": pid, "approved": True}
+    )
+    router.step()
+    assert engine.instance(pid).status == "completed"
+    text = reg.render()
+    assert 'transaction_outgoing_total{type="fraud"} 5' in text
+    router.close()
+
+
+def test_non_object_json_body_is_400(served_engine):
+    engine, clock, client, port = served_engine
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/rest/processes/fraud/instances",
+        data=b"[1, 2]", headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 400
+
+
+def test_router_survives_remote_signal_failure(served_engine):
+    """A dead engine during the response batch must not kill the loop."""
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES
+    from ccfd_tpu.router.router import Router
+
+    engine, clock, client, port = served_engine
+
+    class DeadEngine:
+        def start_process(self, def_id, variables):
+            return 1
+
+        def signal(self, pid, name, payload=None):
+            raise ConnectionError("engine down")
+
+    broker, reg = Broker(), Registry()
+    cfg = Config()
+    router = Router(
+        cfg, broker, lambda x: np.zeros(x.shape[0], np.float32), DeadEngine(), reg
+    )
+    for pid in (1, 2, 3):
+        broker.produce(cfg.customer_response_topic,
+                       {"process_id": pid, "approved": True})
+    broker.produce(cfg.kafka_topic, {n: 0.0 for n in FEATURE_NAMES} | {"id": 9})
+    assert router.step() == 1  # tx still scored and routed
+    assert "router_signal_errors_total 3" in reg.render()
+    router.close()
+
+
+def test_client_does_not_retry_start_process_after_send(served_engine):
+    """Non-idempotent POSTs must not blind-retry: a duplicate would open a
+    second fraud case for the same transaction."""
+    engine, clock, client, port = served_engine
+
+    class OneShotTimeout(EngineRestClient):
+        def __init__(self, url):
+            super().__init__(url, retries=3)
+            self.sends = 0
+
+        def _connect(self):
+            conn = super()._connect()
+            outer = self
+
+            class Wrapped:
+                def __getattr__(self, name):
+                    return getattr(conn, name)
+
+                def getresponse(self):
+                    outer.sends += 1
+                    raise TimeoutError("response timed out")  # after send
+
+            return Wrapped()
+
+    c = OneShotTimeout(f"http://127.0.0.1:{port}")
+    with pytest.raises(ConnectionError):
+        c.start_process("fraud", {"transaction": tx(1.0), "proba": 0.5})
+    assert c.sends == 1  # sent once, never re-sent
+
+
+def test_platform_exposes_engine_rest(tmp_path):
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+    from tests.test_platform import minimal_cr
+
+    cfg = Config(customer_reply_timeout_s=3600.0)
+    cr = minimal_cr(engine={"enabled": True, "rest": True},
+                    notify={"enabled": False})
+    p = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+    try:
+        assert p.engine_port
+        client = EngineRestClient(f"http://127.0.0.1:{p.engine_port}")
+        pid = client.start_process(
+            "standard", {"transaction": tx(5.0), "proba": 0.1, "customer_id": "x"}
+        )
+        assert client.instance(pid)["status"] == "completed"
+    finally:
+        p.down()
