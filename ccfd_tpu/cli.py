@@ -19,6 +19,8 @@ import json
 import sys
 import time
 
+from ccfd_tpu.config import Config
+
 
 def cmd_demo(args: argparse.Namespace) -> int:
     import jax
@@ -291,7 +293,171 @@ def cmd_up(args: argparse.Namespace) -> int:
     return 0
 
 
+def _broker_for(cfg):
+    """BROKER_URL decides the transport: http:// -> RemoteBroker against a
+    `bus serve` process; anything else -> in-process Broker (durable when
+    CCFD_BUS_DIR is set)."""
+    from ccfd_tpu.bus.client import broker_from_url
+
+    remote = broker_from_url(cfg.broker_url)
+    if remote is not None:
+        return remote
+    from ccfd_tpu.bus.broker import Broker
+
+    return Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync)
+
+
+def _serve_forever() -> int:
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_bus(args: argparse.Namespace) -> int:
+    """Standalone networked broker — the Kafka-cluster role (reference
+    deploy/frauddetection_cr.yaml:73-77), durable when --dir is given."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.bus.server import BrokerServer
+
+    cfg = Config.from_env()
+    log_dir = args.dir or (cfg.bus_log_dir or None)
+    broker = Broker(log_dir=log_dir, fsync=cfg.bus_fsync)
+    srv = BrokerServer(broker)
+    port = srv.start(args.host, args.port)
+    print(f"[bus] listening on {args.host}:{port}"
+          + (f" (durable: {log_dir})" if log_dir else " (memory)"), file=sys.stderr)
+    rc = _serve_forever()
+    srv.stop()
+    return rc
+
+
+def cmd_engine(args: argparse.Namespace) -> int:
+    """Standalone KIE-shaped engine server (reference ccd-service on :8090)."""
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.process.server import EngineServer
+
+    cfg = Config.from_env()
+    broker = _broker_for(cfg)
+    engine = build_engine(cfg, broker)
+    if args.state_file:
+        import os as _os
+
+        if _os.path.exists(args.state_file):
+            engine.load(args.state_file)
+    srv = EngineServer(engine)
+    port = srv.start(args.host, args.port)
+    print(f"[engine] KIE REST on {args.host}:{port} "
+          f"definitions={list(engine.definitions())}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(args.save_interval_s if args.state_file else 3600)
+            if args.state_file:
+                engine.save(args.state_file)
+    except KeyboardInterrupt:
+        if args.state_file:
+            engine.save(args.state_file)
+    srv.stop()
+    return 0
+
+
+def cmd_router(args: argparse.Namespace) -> int:
+    """Standalone decision router (reference ccd-fuse): remote bus, remote
+    or local scorer (SELDON_URL), remote engine (KIE_SERVER_URL)."""
+    from ccfd_tpu.router.router import Router
+
+    cfg = Config.from_env()
+    # fail the cheap misconfiguration first: building + warming the local
+    # scorer can cost minutes of XLA compilation
+    if not cfg.kie_server_url.startswith("http"):
+        print("[router] standalone mode needs KIE_SERVER_URL=http://... "
+              "(run `python -m ccfd_tpu engine`)", file=sys.stderr)
+        return 2
+    broker = _broker_for(cfg)
+    if cfg.seldon_url.startswith("http"):
+        from ccfd_tpu.serving.client import SeldonClient
+
+        score_fn = SeldonClient(cfg).score
+    else:
+        from ccfd_tpu.serving.scorer import Scorer
+
+        scorer = Scorer(model_name=cfg.model_name, compute_dtype=cfg.compute_dtype,
+                        batch_sizes=cfg.batch_sizes)
+        scorer.warmup()
+        score_fn = scorer.score
+    from ccfd_tpu.process.client import EngineRestClient
+
+    engine = EngineRestClient(cfg.kie_server_url,
+                              timeout_s=cfg.seldon_timeout_ms / 1000.0,
+                              retries=cfg.client_retries)
+    router = Router(cfg, broker, score_fn, engine)
+    print(f"[router] consuming {cfg.kafka_topic!r} from {cfg.broker_url}",
+          file=sys.stderr)
+    try:
+        router.run(poll_timeout_s=0.05)
+    except KeyboardInterrupt:
+        router.close()
+    return 0
+
+
+def cmd_notify(args: argparse.Namespace) -> int:
+    """Standalone notification service (reference notification-service)."""
+    from ccfd_tpu.notify.service import NotificationService
+
+    cfg = Config.from_env()
+    broker = _broker_for(cfg)
+    svc = NotificationService(cfg, broker, reply_prob=args.reply_prob,
+                              approve_prob=args.approve_prob, seed=args.seed)
+    print(f"[notify] consuming {cfg.customer_notification_topic!r} from "
+          f"{cfg.broker_url}", file=sys.stderr)
+    try:
+        svc.run(poll_timeout_s=0.05)
+    except KeyboardInterrupt:
+        svc.stop()
+    return 0
+
+
+def cmd_producer(args: argparse.Namespace) -> int:
+    """Standalone transaction producer (reference ProducerDeployment)."""
+    from ccfd_tpu.producer.producer import Producer
+
+    cfg = Config.from_env()
+    broker = _broker_for(cfg)
+    producer = Producer(cfg, broker)
+    n = producer.run(limit=args.limit, rate_per_s=args.rate,
+                     wire_format=args.wire_format)
+    print(f"[producer] streamed {n} rows to {cfg.producer_topic!r}",
+          file=sys.stderr)
+    return 0
+
+
+def _honor_platform_env() -> None:
+    """A site hook may force its own jax platform (e.g. a TPU tunnel plugin)
+    over the environment; an operator who exported JAX_PLATFORMS explicitly
+    wins — services must not hang dialing an unavailable accelerator when
+    told to run on CPU."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        except Exception:  # pragma: no cover - jax absent/odd build
+            pass
+
+
+# commands whose code path imports jax; the others (bus, notify, producer,
+# store, engine) stay jax-free and must not pay the import at startup
+_JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up"}
+
+
 def main(argv: list[str] | None = None) -> int:
+    args_list = list(sys.argv[1:] if argv is None else argv)
+    if args_list and args_list[0] in _JAX_CMDS:
+        _honor_platform_env()
     p = argparse.ArgumentParser(prog="ccfd_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -336,6 +502,34 @@ def main(argv: list[str] | None = None) -> int:
                     help="store endpoint (overrides s3endpoint env)")
     st.add_argument("--file", default=None, help="local file to upload (put)")
     st.set_defaults(fn=cmd_store)
+
+    bus = sub.add_parser("bus", help="networked broker (Kafka-cluster role)")
+    bus.add_argument("--host", default="0.0.0.0")
+    bus.add_argument("--port", type=int, default=9092)
+    bus.add_argument("--dir", default=None, help="durable segment-log dir")
+    bus.set_defaults(fn=cmd_bus)
+
+    en = sub.add_parser("engine", help="KIE-shaped process engine server")
+    en.add_argument("--host", default="0.0.0.0")
+    en.add_argument("--port", type=int, default=8090)
+    en.add_argument("--state-file", default=None)
+    en.add_argument("--save-interval-s", type=float, default=5.0)
+    en.set_defaults(fn=cmd_engine)
+
+    ro = sub.add_parser("router", help="standalone decision router")
+    ro.set_defaults(fn=cmd_router)
+
+    no = sub.add_parser("notify", help="standalone notification service")
+    no.add_argument("--reply-prob", type=float, default=0.8)
+    no.add_argument("--approve-prob", type=float, default=0.7)
+    no.add_argument("--seed", type=int, default=0)
+    no.set_defaults(fn=cmd_notify)
+
+    pr = sub.add_parser("producer", help="standalone transaction producer")
+    pr.add_argument("--limit", type=int, default=None)
+    pr.add_argument("--rate", type=float, default=None)
+    pr.add_argument("--wire-format", choices=("dict", "csv"), default="csv")
+    pr.set_defaults(fn=cmd_producer)
 
     u = sub.add_parser("up", help="bring up the platform from a CR file")
     u.add_argument("-f", "--file", default="deploy/platform_cr.yaml")

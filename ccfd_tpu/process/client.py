@@ -11,11 +11,9 @@ connections + bounded retries, mirroring ccfd_tpu/serving/client.py.
 
 from __future__ import annotations
 
-import http.client
-import json
-import queue
-import urllib.parse
 from typing import Any, Mapping
+
+from ccfd_tpu.utils.httpclient import PooledHTTPClient
 
 
 class EngineRestClient:
@@ -26,51 +24,19 @@ class EngineRestClient:
         timeout_s: float = 5.0,
         retries: int = 2,
     ):
-        u = urllib.parse.urlparse(base_url)
-        if u.scheme not in ("http", ""):
-            raise ValueError(f"unsupported scheme in KIE_SERVER_URL: {base_url!r}")
-        self._host = u.hostname or "localhost"
-        self._port = u.port or 8090
-        self._timeout = timeout_s
-        self._retries = max(0, retries)
-        self._pool: "queue.Queue[http.client.HTTPConnection]" = queue.Queue()
-        for _ in range(max(1, pool_size)):
-            self._pool.put(self._connect())
-
-    def _connect(self) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(
-            self._host, self._port, timeout=self._timeout
+        self._http = PooledHTTPClient(
+            base_url, default_port=8090, pool_size=pool_size,
+            timeout_s=timeout_s, retries=retries,
+            scheme_error="unsupported scheme in KIE_SERVER_URL",
         )
 
     def _request(
         self, method: str, path: str, body: Any = None, idempotent: bool = True
     ) -> tuple[int, Any]:
-        payload = json.dumps(body).encode() if body is not None else None
-        last_exc: Exception | None = None
-        for _ in range(self._retries + 1):
-            conn = self._pool.get()
-            try:
-                conn.request(
-                    method, path, body=payload,
-                    headers={"Content-Type": "application/json"},
-                )
-                resp = conn.getresponse()
-                data = resp.read()
-                self._pool.put(conn)
-                return resp.status, (json.loads(data) if data else None)
-            except (OSError, http.client.HTTPException) as e:
-                last_exc = e
-                conn.close()
-                self._pool.put(self._connect())
-                # a non-idempotent request (start_process) may have reached
-                # the engine before the failure — blind retry would start a
-                # duplicate instance. Only a refused connection proves the
-                # request never arrived.
-                if not idempotent and not isinstance(e, ConnectionRefusedError):
-                    break
-        raise ConnectionError(
-            f"engine at {self._host}:{self._port} unreachable: {last_exc}"
-        )
+        # non-idempotent start_process must not blind-retry after the request
+        # may have reached the engine — a re-send would start a duplicate
+        # instance (retry policy lives in PooledHTTPClient)
+        return self._http.request(method, path, body, idempotent=idempotent)
 
     # -- EngineClient protocol --------------------------------------------
     def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:

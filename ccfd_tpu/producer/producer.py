@@ -81,6 +81,25 @@ class Producer:
 
         produced = 0
         interval = 1.0 / rate_per_s if rate_per_s else 0.0
+        # unpaced + networked broker: chunk rows into one HTTP round-trip
+        # per batch instead of one per row (RemoteBroker.produce_batch)
+        batcher = getattr(self.broker, "produce_batch", None)
+        if not interval and batcher is not None:
+            chunk_v: list = []
+            chunk_k: list = []
+            for value, key in payloads:
+                if limit is not None and produced + len(chunk_v) >= limit:
+                    break
+                chunk_v.append(value)
+                chunk_k.append(key)
+                if len(chunk_v) >= 1000:
+                    produced += batcher(self.cfg.producer_topic, chunk_v, chunk_k)
+                    self._c_rows.inc(len(chunk_v))
+                    chunk_v, chunk_k = [], []
+            if chunk_v:
+                produced += batcher(self.cfg.producer_topic, chunk_v, chunk_k)
+                self._c_rows.inc(len(chunk_v))
+            return produced
         next_emit = time.perf_counter()
         for value, key in payloads:
             if limit is not None and produced >= limit:

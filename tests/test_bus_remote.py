@@ -1,0 +1,209 @@
+"""Networked bus: broker server + RemoteBroker client.
+
+Capability under test: the reference's message plane is a *networked*
+Kafka cluster every service dials (reference deploy/router.yaml:55-56);
+ccfd_tpu/bus/server.py + client.py put the in-process broker's semantics
+behind HTTP so the same per-service topology deploys here.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.bus.client import RemoteBroker, broker_from_url
+from ccfd_tpu.bus.server import BrokerServer
+from ccfd_tpu.config import Config
+
+
+@pytest.fixture()
+def bus():
+    srv = BrokerServer(Broker(default_partitions=2))
+    port = srv.start(host="127.0.0.1", port=0)
+    client = RemoteBroker(f"http://127.0.0.1:{port}")
+    yield srv, client, port
+    client.close()
+    srv.stop()
+
+
+def test_produce_consume_roundtrip_with_mixed_values(bus):
+    srv, client, port = bus
+    client.produce("t", {"Amount": 5.0}, key="a")
+    client.produce("t", b"1.5,2.5\n", key=b"\x00k")
+    client.produce("t", "csv,string")
+    c = client.consumer("g", ("t",))
+    recs = sorted(c.poll(100), key=lambda r: r.timestamp)
+    assert [r.value for r in recs] == [{"Amount": 5.0}, b"1.5,2.5\n", "csv,string"]
+    assert recs[0].key == "a" and recs[1].key == b"\x00k"
+    assert all(r.topic == "t" for r in recs)
+    # offsets committed server-side: nothing redelivered
+    assert c.poll(100) == []
+    assert sum(client.end_offsets("t")) == 3
+    c.close()
+
+
+def test_groups_are_independent_and_resume(bus):
+    srv, client, port = bus
+    for i in range(10):
+        client.produce("t", i)
+    c1 = client.consumer("g1", ("t",))
+    assert len(c1.poll(6)) == 6
+    assert len(c1.poll(100)) == 4
+    c2 = client.consumer("g2", ("t",))
+    assert len(c2.poll(100)) == 10  # fresh group: full replay
+    c1.close()
+    c2.close()
+
+
+def test_long_poll_wakes_on_produce(bus):
+    srv, client, port = bus
+    c = client.consumer("g", ("t",))
+    got = {}
+
+    def poller():
+        t0 = time.perf_counter()
+        got["recs"] = c.poll(10, timeout_s=5.0)
+        got["dt"] = time.perf_counter() - t0
+
+    th = threading.Thread(target=poller)
+    th.start()
+    time.sleep(0.3)
+    client.produce("t", {"x": 1})
+    th.join(timeout=10)
+    assert got["recs"] and got["dt"] < 4.0  # woke early, did not sleep out 5s
+    c.close()
+
+
+def test_reaped_consumer_transparently_reregisters(bus):
+    srv, client, port = bus
+    srv.consumer_ttl_s = 0.2
+    c = client.consumer("g", ("t",))
+    client.produce("t", 1)
+    assert len(c.poll(10)) == 1
+    time.sleep(0.4)
+    client.consumer("g2", ("t",))  # triggers reap on register
+    client.produce("t", 2)
+    recs = c.poll(10, timeout_s=2.0)  # 404 -> re-register -> resume
+    assert [r.value for r in recs] == [2]
+    c.close()
+
+
+def test_poll_retry_with_same_seq_redelivers_not_skips(bus):
+    """A poll whose response was lost must not lose the batch: the server
+    auto-commits on fetch, so the retry (same seq) gets the cached batch."""
+    srv, client, port = bus
+    c = client.consumer("g", ("t",))
+    for i in range(5):
+        client.produce("t", i)
+    recs = c.poll(10)
+    assert sorted(r.value for r in recs) == [0, 1, 2, 3, 4]
+    order = [r.value for r in recs]
+    # simulate the lost-response retry: same seq again
+    code, body = c._poll_once(10, 0.0)
+    assert code == 200
+    assert [r["value"] for r in body["records"]] == order  # redelivered verbatim
+    # a NEW poll (next seq) advances normally
+    client.produce("t", 5)
+    assert [r.value for r in c.poll(10)] == [5]
+    c.close()
+
+
+def test_dead_group_member_partitions_rebalance_on_survivor_poll(bus):
+    """Reaping must happen on the poll path: a crashed member's partitions
+    move to the survivor without any new registration."""
+    srv, client, port = bus
+    srv.consumer_ttl_s = 0.2
+    dead = client.consumer("g", ("t",))   # will stop polling
+    live = client.consumer("g", ("t",))
+    dead.poll(10)
+    live.poll(10)
+    time.sleep(0.4)  # dead's session times out
+    for i in range(20):
+        client.produce("t", i)
+    got = []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(got) < 20:
+        got.extend(r.value for r in live.poll(100, timeout_s=0.2))
+    assert sorted(got) == list(range(20))  # survivor now owns ALL partitions
+    live.close()
+
+
+def test_broker_from_url_seam():
+    assert broker_from_url("inproc://local") is None
+    assert broker_from_url("") is None
+    with pytest.raises(ValueError):
+        RemoteBroker("kafka://somewhere:9092")
+
+
+def test_full_pipeline_over_remote_bus():
+    """producer -> remote bus -> router -> engine -> notify, every component
+    holding only a RemoteBroker."""
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.notify.service import NotificationService
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.producer.producer import Producer
+    from ccfd_tpu.router.router import Router
+
+    srv = BrokerServer(Broker(default_partitions=2))
+    port = srv.start(host="127.0.0.1", port=0)
+    url = f"http://127.0.0.1:{port}"
+    cfg = Config(customer_reply_timeout_s=30.0, broker_url=url)
+
+    engine_bus = RemoteBroker(url)
+    router_bus = RemoteBroker(url)
+    notify_bus = RemoteBroker(url)
+    producer_bus = RemoteBroker(url)
+    try:
+        engine = build_engine(cfg, engine_bus, Registry())
+        reg_router = Registry()
+        router = Router(
+            cfg, router_bus,
+            lambda x: np.full(x.shape[0], 0.9, np.float32), engine, reg_router,
+        )
+        notify = NotificationService(cfg, notify_bus, Registry(),
+                                     reply_prob=1.0, approve_prob=1.0, seed=1)
+        ds = synthetic_dataset(n=40, fraud_rate=0.5, seed=0)
+        n = Producer(cfg, producer_bus, dataset=ds).run(wire_format="dict")
+        assert n == 40
+        deadline = time.monotonic() + 20
+        scored = 0
+        while time.monotonic() < deadline and scored < 40:
+            scored += router.step(poll_timeout_s=0.05)
+            notify.step()
+        assert scored == 40
+        # customer replies flowed back through the remote bus as signals
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            notify.step()
+            router.step(poll_timeout_s=0.02)
+            done = [i for i in engine.instances() if i.status != "active"]
+            if len(done) == len(engine.instances()) and engine.instances():
+                break
+        text = reg_router.render()
+        assert "transaction_incoming_total 40" in text
+        assert 'transaction_outgoing_total{type="fraud"} 40' in text
+        router.close()
+    finally:
+        for b in (engine_bus, router_bus, notify_bus, producer_bus):
+            b.close()
+        srv.stop()
+
+
+def test_producer_batches_over_remote_bus(bus):
+    srv, client, port = bus
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.producer.producer import Producer
+
+    cfg = Config()
+    ds = synthetic_dataset(n=2500, fraud_rate=0.1, seed=0)
+    n = Producer(cfg, client, dataset=ds).run(wire_format="csv")
+    assert n == 2500
+    assert sum(client.end_offsets(cfg.producer_topic)) == 2500
+    # batched: far fewer HTTP round trips than records
+    c = client.consumer("check", (cfg.producer_topic,))
+    recs = c.poll(5000)
+    assert all(isinstance(r.value, bytes) for r in recs)
+    c.close()

@@ -167,10 +167,10 @@ def test_client_does_not_retry_start_process_after_send(served_engine):
     second fraud case for the same transaction."""
     engine, clock, client, port = served_engine
 
-    class OneShotTimeout(EngineRestClient):
-        def __init__(self, url):
-            super().__init__(url, retries=3)
-            self.sends = 0
+    from ccfd_tpu.utils.httpclient import PooledHTTPClient
+
+    class TimeoutPool(PooledHTTPClient):
+        sends = 0
 
         def _connect(self):
             conn = super()._connect()
@@ -181,15 +181,16 @@ def test_client_does_not_retry_start_process_after_send(served_engine):
                     return getattr(conn, name)
 
                 def getresponse(self):
-                    outer.sends += 1
+                    type(outer).sends += 1
                     raise TimeoutError("response timed out")  # after send
 
             return Wrapped()
 
-    c = OneShotTimeout(f"http://127.0.0.1:{port}")
+    c = EngineRestClient(f"http://127.0.0.1:{port}", retries=3)
+    c._http = TimeoutPool(f"http://127.0.0.1:{port}", default_port=8090, retries=3)
     with pytest.raises(ConnectionError):
         c.start_process("fraud", {"transaction": tx(1.0), "proba": 0.5})
-    assert c.sends == 1  # sent once, never re-sent
+    assert TimeoutPool.sends == 1  # sent once, never re-sent
 
 
 def test_platform_exposes_engine_rest(tmp_path):

@@ -108,16 +108,22 @@ class TestBringUp:
         try:
             assert platform.wait_producer(timeout_s=30.0)
             router_reg = platform.registries["router"]
-            deadline = time.monotonic() + 30.0
+            deadline = time.monotonic() + 60.0
             c_in = router_reg.counter("transaction_incoming_total")
-            while time.monotonic() < deadline and c_in.value() < 300:
+            out = router_reg.counter("transaction_outgoing_total")
+
+            def started() -> float:
+                return out.value(labels={"type": "standard"}) + out.value(
+                    labels={"type": "fraud"}
+                )
+
+            # wait on the OUTGOING counter: incoming increments before the
+            # scoring dispatch and the 300 engine starts, so sampling right
+            # after c_in reaches 300 can observe a mid-batch router
+            while time.monotonic() < deadline and started() < 300:
                 time.sleep(0.05)
             assert c_in.value() == 300
-            out = router_reg.counter("transaction_outgoing_total")
-            started = out.value(labels={"type": "standard"}) + out.value(
-                labels={"type": "fraud"}
-            )
-            assert started > 0  # processes started on the engine
+            assert started() == 300  # every transaction routed to a process
         finally:
             platform.down()
 
