@@ -21,7 +21,7 @@ Contract (JSON bodies; bytes values ride base64 under ``{"__b64__": ...}``):
 
 Long-polling maps straight onto ``Consumer.poll(timeout_s=...)`` — the
 handler thread parks on the broker's condition variable, so an idle
-consumer costs a blocked thread, not a busy loop (ThreadingHTTPServer gives
+consumer costs a blocked thread, not a busy loop (the threaded server gives
 each request its own thread). Consumers that stop polling for
 ``consumer_ttl_s`` are reaped so their partitions rebalance to live group
 members — Kafka's session-timeout behavior.
@@ -34,8 +34,10 @@ import json
 import re
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 from ccfd_tpu.bus.broker import Broker, Consumer, Record
 from ccfd_tpu.metrics.prom import Registry
@@ -88,7 +90,7 @@ class BrokerServer:
         self._delivered: dict[int, tuple[int, list[dict[str, Any]]]] = {}
         self._cid = 0
         self._lock = threading.Lock()
-        self._httpd: ThreadingHTTPServer | None = None
+        self._httpd: FrameworkHTTPServer | None = None
         r = self.registry
         self._c_produced = r.counter("bus_records_produced_total", "records in")
         self._c_delivered = r.counter("bus_records_delivered_total", "records out")
@@ -258,7 +260,7 @@ class BrokerServer:
         return Handler
 
     def start(self, host: str = "0.0.0.0", port: int = 9092) -> int:
-        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        self._httpd = FrameworkHTTPServer((host, port), self._handler_class())
         threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="ccfd-bus"
         ).start()

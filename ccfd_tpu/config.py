@@ -83,6 +83,7 @@ class Config:
     compute_dtype: str = "bfloat16"
     batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384)
     batch_deadline_ms: float = 2.0
+    dynamic_batching: bool = True  # serving-side request coalescing
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
 
@@ -141,6 +142,8 @@ class Config:
             batch_deadline_ms=float(
                 e.get("CCFD_BATCH_DEADLINE_MS", str(Config.batch_deadline_ms))
             ),
+            dynamic_batching=e.get("CCFD_DYNAMIC_BATCHING", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
             serve_host=e.get("CCFD_SERVE_HOST", Config.serve_host),
             serve_port=int(e.get("CCFD_SERVE_PORT", str(Config.serve_port))),
         )

@@ -16,7 +16,9 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
 from __future__ import annotations
 
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 from ccfd_tpu.metrics.prom import Registry
 
@@ -49,7 +51,7 @@ class MetricsExporter:
                 self.end_headers()
                 self.wfile.write(data)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = FrameworkHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
 
     def add(self, name: str, registry: Registry) -> None:

@@ -17,7 +17,7 @@ the engine:
     GET  /rest/metrics | /metrics              Prometheus scrape (KIE path)
     GET  /health/status                        readiness
 
-Same stdlib ``ThreadingHTTPServer`` approach as the scoring server
+Same threaded stdlib HTTP server approach as the scoring server
 (ccfd_tpu/serving/server.py): a fixed contract needs no framework, and the
 engine does its own locking so handlers stay thin.
 """
@@ -27,8 +27,10 @@ from __future__ import annotations
 import json
 import re
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 from ccfd_tpu.process.engine import Engine, Instance, Task
 
@@ -66,7 +68,7 @@ def task_view(t: Task) -> dict[str, Any]:
 class EngineServer:
     def __init__(self, engine: Engine):
         self.engine = engine
-        self._httpd: ThreadingHTTPServer | None = None
+        self._httpd: FrameworkHTTPServer | None = None
 
     def _handler_class(self):
         server = self
@@ -184,7 +186,7 @@ class EngineServer:
         return Handler
 
     def start(self, host: str = "0.0.0.0", port: int = 8090) -> int:
-        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        self._httpd = FrameworkHTTPServer((host, port), self._handler_class())
         threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="ccfd-kie"
         ).start()

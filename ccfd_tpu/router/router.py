@@ -223,6 +223,24 @@ class Router:
         records = self._tx_consumer.poll(self.max_batch, poll_timeout_s)
         if not records:
             return 0
+        # size x deadline micro-batching (SURVEY.md §7 stage 3): after the
+        # first records arrive, keep accumulating until the batch bucket
+        # fills or batch_deadline_ms elapses — under sustained load the TPU
+        # dispatch amortizes over a full bucket, while the deadline bounds
+        # the latency a lone transaction can be held for
+        deadline_s = self.cfg.batch_deadline_ms / 1e3
+        if deadline_s > 0 and len(records) < self.max_batch:
+            deadline = time.perf_counter() + deadline_s
+            while len(records) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                more = self._tx_consumer.poll(
+                    self.max_batch - len(records), remaining
+                )
+                if not more:
+                    break  # poll slept out the remaining deadline
+                records.extend(more)
         n = len(records)
         self._c_in.inc(n)
         self._h_batch.observe(n)

@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 from ccfd_tpu.runtime.supervisor import Supervisor
 
@@ -50,7 +52,7 @@ class _Handler(BaseHTTPRequestHandler):
 class HealthServer:
     def __init__(self, supervisor: Supervisor, host: str = "127.0.0.1", port: int = 0):
         handler = type("BoundHealth", (_Handler,), {"supervisor": supervisor})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = FrameworkHTTPServer((host, port), handler)
         self._thread: threading.Thread | None = None
 
     @property

@@ -21,7 +21,7 @@ deploy/model/modelfull-route.yaml:1-12) with one process:
   ``V10`` (reference deploy/grafana/ModelPrediction.json:96-104).
 - ``GET /health/status`` — Seldon-style readiness.
 
-Implementation is stdlib ``ThreadingHTTPServer``: no web framework is
+Implementation is a threaded stdlib HTTP server: no web framework is
 needed for a fixed four-route contract, and keeping the handler thin
 matters more for p99 than any framework feature. The GIL is released
 during the XLA dispatch, so scoring threads overlap host work.
@@ -32,8 +32,10 @@ from __future__ import annotations
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 import numpy as np
 
@@ -68,7 +70,33 @@ class PredictionServer:
         self._g_amount = r.gauge("Amount", "last scored transaction amount")
         self._g_v17 = r.gauge("V17", "last scored V17")
         self._g_v10 = r.gauge("V10", "last scored V10")
-        self._httpd: ThreadingHTTPServer | None = None
+        self._httpd: FrameworkHTTPServer | None = None
+        # dynamic batching (SURVEY.md §7 stage 2: request -> micro-batch
+        # queue -> TPU): concurrent requests coalesce into one dispatch;
+        # the adaptive policy adds no latency for a lone sequential client
+        self.batcher = None
+        if self.cfg.dynamic_batching:
+            self._c_dispatches = r.counter(
+                "serving_batcher_dispatches_total", "coalesced TPU dispatches"
+            )
+            self._c_batched_rows = r.counter(
+                "serving_batcher_rows_total", "rows through the batcher"
+            )
+            self.batcher = self._make_batcher()
+
+    def _make_batcher(self):
+        from ccfd_tpu.serving.batcher import DynamicBatcher
+
+        def on_dispatch(n_rows: int) -> None:
+            self._c_dispatches.inc()
+            self._c_batched_rows.inc(n_rows)
+
+        return DynamicBatcher(
+            self.scorer.score,
+            max_batch=max(self.scorer.batch_sizes),
+            deadline_ms=self.cfg.batch_deadline_ms,
+            on_dispatch=on_dispatch,
+        )
 
     # -- scoring ----------------------------------------------------------
     def predict_ndarray(self, names: list[str], rows: list[list[float]]) -> dict:
@@ -85,7 +113,10 @@ class PredictionServer:
                 x[i, : len(row)] = np.asarray(row, np.float32)[
                     : self.scorer.num_features
                 ]
-        proba = self.scorer.score(x)
+        if self.batcher is not None:
+            proba = self.batcher.score(x)
+        else:
+            proba = self.scorer.score(x)
         if len(rows):
             self._g_proba.set(float(proba[-1]))
             self._g_amount.set(float(x[-1, FEATURE_NAMES.index("Amount")]))
@@ -178,9 +209,13 @@ class PredictionServer:
 
     def start(self, host: str | None = None, port: int | None = None) -> int:
         """Start serving on a background thread; returns the bound port."""
+        if self.cfg.dynamic_batching and self.batcher is None:
+            # stop() tears the batcher down; a restarted server needs a
+            # fresh one or every predict would fail on the stopped worker
+            self.batcher = self._make_batcher()
         host = host if host is not None else self.cfg.serve_host
         port = port if port is not None else self.cfg.serve_port
-        self._httpd = ThreadingHTTPServer((host, port), self._handler_class())
+        self._httpd = FrameworkHTTPServer((host, port), self._handler_class())
         t = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="ccfd-serving"
         )
@@ -192,3 +227,7 @@ class PredictionServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self.batcher is not None:
+            self.batcher.stop()
+            self.batcher = None  # start() recreates; direct predict_ndarray
+            # on a stopped server falls back to unbatched scoring

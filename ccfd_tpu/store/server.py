@@ -24,7 +24,9 @@ import base64
 import hashlib
 import hmac
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 from urllib.parse import parse_qs, quote, unquote, urlsplit
 from xml.sax.saxutils import escape
 
@@ -190,7 +192,7 @@ class StoreServer:
 
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1", port: int = 0):
         handler = type("BoundHandler", (_Handler,), {"store": store})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = FrameworkHTTPServer((host, port), handler)
         self._thread: threading.Thread | None = None
 
     @property

@@ -1,0 +1,18 @@
+"""Shared HTTP server base for every service surface in the framework.
+
+``ThreadingHTTPServer``'s socketserver default listen backlog
+(``request_queue_size``) is 5: a burst of concurrent clients — exactly the
+load the dynamic batcher exists to coalesce, or N components dialing the
+bus at bring-up — overflows the accept queue and gets connection resets.
+One subclass fixes it for every server (serving, engine, bus, store,
+metrics, health).
+"""
+
+from __future__ import annotations
+
+from http.server import ThreadingHTTPServer
+
+
+class FrameworkHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 256

@@ -1,0 +1,227 @@
+"""Micro-batching policy tests: serving dynamic batcher + router deadline.
+
+Capability under test: SURVEY.md §7 stage 2 ("request -> micro-batch queue
+-> TPU") and hard part (d) — batch accumulation that amortizes the TPU
+dispatch without blowing the latency budget.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, NUM_FEATURES
+from ccfd_tpu.serving.batcher import DynamicBatcher
+
+
+def counting_score(delay_s: float = 0.0):
+    calls = []
+
+    def fn(x):
+        calls.append(x.shape[0])
+        if delay_s:
+            time.sleep(delay_s)
+        return x[:, 0] * 0.5  # deterministic per-row result
+
+    return fn, calls
+
+
+def _x(n, fill):
+    x = np.zeros((n, NUM_FEATURES), np.float32)
+    x[:, 0] = fill
+    return x
+
+
+def test_results_route_back_to_each_request():
+    fn, calls = counting_score()
+    b = DynamicBatcher(fn, deadline_ms=5.0)
+    futs = [b.submit(_x(i + 1, float(i))) for i in range(5)]
+    for i, f in enumerate(futs):
+        out = f.result(timeout=5)
+        assert out.shape == (i + 1,)
+        np.testing.assert_allclose(out, 0.5 * i)
+    b.stop()
+
+
+def test_sequential_client_pays_no_deadline():
+    fn, calls = counting_score()
+    b = DynamicBatcher(fn, deadline_ms=50.0)  # a deadline that would hurt
+    t0 = time.perf_counter()
+    for _ in range(10):
+        b.score(_x(4, 1.0))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.25, f"sequential requests waited on the deadline: {elapsed}"
+    assert len(calls) == 10  # no coalescing opportunity, no forced waiting
+    b.stop()
+
+
+def test_concurrent_requests_coalesce():
+    fn, calls = counting_score(delay_s=0.01)
+    b = DynamicBatcher(fn, deadline_ms=20.0, max_batch=4096)
+    n_clients = 24
+    results = {}
+
+    def client(i):
+        results[i] = b.score(_x(8, float(i)))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == n_clients
+    for i, out in results.items():
+        np.testing.assert_allclose(out, 0.5 * i)
+    # the slow first dispatch queues the rest; far fewer launches than clients
+    assert len(calls) < n_clients, calls
+    assert sum(calls) == n_clients * 8
+    b.stop()
+
+
+def test_scorer_failure_fails_batch_not_worker():
+    state = {"fail": True}
+
+    def fn(x):
+        if state["fail"]:
+            raise ValueError("bad batch")
+        return x[:, 0]
+
+    b = DynamicBatcher(fn, deadline_ms=1.0)
+    with pytest.raises(ValueError, match="bad batch"):
+        b.score(_x(3, 1.0))
+    state["fail"] = False
+    out = b.score(_x(3, 2.0))  # worker survived
+    np.testing.assert_allclose(out, 2.0)
+    b.stop()
+
+
+def test_stop_fails_pending_and_rejects_new():
+    fn, calls = counting_score()
+    b = DynamicBatcher(fn, deadline_ms=1.0)
+    b.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        b.submit(_x(1, 0.0))
+
+
+def test_oversized_request_still_served():
+    fn, calls = counting_score()
+    b = DynamicBatcher(fn, max_batch=16, deadline_ms=1.0)
+    out = b.score(_x(100, 3.0))  # bigger than max_batch: single dispatch
+    assert out.shape == (100,)
+    np.testing.assert_allclose(out, 1.5)
+    b.stop()
+
+
+def test_oversized_head_not_merged_into_accumulating_batch():
+    """A request bigger than the remaining room gets its own dispatch; the
+    small batch it would have bloated dispatches without it."""
+    fn, calls = counting_score(delay_s=0.02)
+    b = DynamicBatcher(fn, max_batch=32, deadline_ms=30.0)
+    futs = [b.submit(_x(8, 1.0)), b.submit(_x(8, 1.0))]  # accumulate
+    time.sleep(0.005)
+    big = b.submit(_x(30, 2.0))  # won't fit in the remaining room (16)
+    for f in futs:
+        f.result(timeout=5)
+    np.testing.assert_allclose(big.result(timeout=5), 1.0)
+    assert 30 in calls  # dispatched alone, not merged past max_batch
+    assert all(c <= 32 for c in calls)
+    b.stop()
+
+
+def test_server_wires_batcher_and_metrics():
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.serving.server import PredictionServer
+
+    scorer = Scorer(model_name="logreg", batch_sizes=(16, 64), compute_dtype="float32")
+    cfg = Config(dynamic_batching=True, batch_deadline_ms=1.0)
+    srv = PredictionServer(scorer, cfg, Registry())
+    out = srv.predict_ndarray([], [[0.0] * NUM_FEATURES] * 3)
+    assert len(out["data"]["ndarray"]) == 3
+    assert srv.batcher is not None and srv.batcher.dispatches >= 1
+    text = srv.registry.render()
+    assert "serving_batcher_dispatches_total 1" in text
+    assert "serving_batcher_rows_total 3" in text
+    # stop/start cycle gets a fresh batcher; predicts keep working
+    port = srv.start(host="127.0.0.1", port=0)
+    srv.stop()
+    assert srv.batcher is None
+    srv.start(host="127.0.0.1", port=0)
+    assert srv.batcher is not None
+    out2 = srv.predict_ndarray([], [[0.0] * NUM_FEATURES] * 2)
+    assert len(out2["data"]["ndarray"]) == 2
+    srv.stop()
+
+    off = PredictionServer(
+        scorer, Config(dynamic_batching=False), Registry()
+    )
+    assert off.batcher is None
+    assert len(off.predict_ndarray([], [[0.0] * NUM_FEATURES])["data"]["ndarray"]) == 1
+    off.stop()
+
+
+def test_router_accumulates_to_deadline():
+    """Records produced during the deadline window join the same batch."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.router.router import Router
+
+    cfg = Config(batch_deadline_ms=150.0)
+    broker, reg = Broker(), Registry()
+    batches = []
+
+    class Engine:
+        def start_process(self, def_id, variables):
+            return 1
+
+        def signal(self, pid, name, payload=None):
+            return True
+
+    def score(x):
+        batches.append(x.shape[0])
+        return np.zeros(x.shape[0], np.float32)
+
+    router = Router(cfg, broker, score, Engine(), reg)
+    tx = {n: 0.0 for n in FEATURE_NAMES}
+    broker.produce(cfg.kafka_topic, tx)
+
+    def trickle():
+        for _ in range(9):
+            time.sleep(0.01)
+            broker.produce(cfg.kafka_topic, tx)
+
+    t = threading.Thread(target=trickle)
+    t.start()
+    n = router.step()
+    t.join()
+    # the first record triggered the poll; the deadline window scooped the
+    # trickle into the SAME dispatch instead of 10 tiny ones
+    assert n == 10 and batches == [10]
+    router.close()
+
+
+def test_router_zero_deadline_dispatches_immediately():
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.router.router import Router
+
+    cfg = Config(batch_deadline_ms=0.0)
+    broker, reg = Broker(), Registry()
+
+    class Engine:
+        def start_process(self, def_id, variables):
+            return 1
+
+        def signal(self, pid, name, payload=None):
+            return True
+
+    router = Router(
+        cfg, broker, lambda x: np.zeros(x.shape[0], np.float32), Engine(), reg
+    )
+    broker.produce(cfg.kafka_topic, {n: 0.0 for n in FEATURE_NAMES})
+    t0 = time.perf_counter()
+    assert router.step() == 1
+    assert time.perf_counter() - t0 < 0.1  # no deadline wait
+    router.close()
