@@ -131,25 +131,41 @@ class RemoteConsumer:
         self._seq = 0
         self._closed = False
 
-    def _poll_once(self, max_records: int, timeout_s: float) -> tuple[int, Any]:
+    def _poll_once(
+        self, seq: int, max_records: int, timeout_s: float
+    ) -> tuple[int, Any]:
         # idempotent BECAUSE of the seq: a retry re-requests the same batch
         return self._broker._request(
             "POST", f"/consumers/{self._cid}/poll",
-            {"max_records": max_records, "timeout_s": timeout_s, "seq": self._seq},
+            {"max_records": max_records, "timeout_s": timeout_s, "seq": seq},
         )
 
     def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[_RemoteRecord]:
         if self._closed:
             return []
-        self._seq += 1
-        code, body = self._poll_once(max_records, timeout_s)
+        # advance seq only AFTER a successful response: if transport retries
+        # are exhausted and RemoteBusError propagates, the next poll() call
+        # re-sends the SAME seq, so a batch the broker consumed and
+        # auto-committed under the failed seq is redelivered from the
+        # server-side cache instead of silently lost (at-least-once across
+        # application-level retries, not just in-request transport retries)
+        seq = self._seq + 1
+        code, body = self._poll_once(seq, max_records, timeout_s)
         if code == 404:  # reaped by session timeout: re-register and retry once
             fresh = self._broker.consumer(self.group_id, self.topics)
             self._cid = fresh._cid
-            code, body = self._poll_once(max_records, timeout_s)
+            code, body = self._poll_once(seq, max_records, timeout_s)
         if code != 200:
             raise RemoteBusError(f"poll failed: {code} {body}")
-        return [_RemoteRecord(r) for r in body["records"]]
+        # decode BEFORE advancing seq: a decode error (version-skewed server)
+        # must leave the seq un-advanced so the retry still hits the cache —
+        # and surface as RemoteBusError so callers' bus error handling engages
+        try:
+            records = [_RemoteRecord(r) for r in body["records"]]
+        except (KeyError, ValueError, TypeError) as e:
+            raise RemoteBusError(f"undecodable poll batch: {e}") from e
+        self._seq = seq
+        return records
 
     def close(self) -> None:
         if not self._closed:

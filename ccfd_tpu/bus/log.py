@@ -10,7 +10,18 @@ mechanisms"). This module gives the in-process broker the same property:
 - a topic catalog (``meta.log``) mapping topic names to file ids and
   partition counts, so filenames never depend on topic-name sanitization
 - a committed-offsets log (``offsets.log``), appended on every group
-  commit, last-write-wins on replay
+  commit, last-write-wins on replay; the file is COMPACTED on reopen
+  (rewritten to one entry per (group, topic, partition), tmp + rename)
+  once the append tail dominates, so long-running durable buses don't pay
+  unbounded reopen time for commit history
+
+Retention limitation (documented, deliberate): record segments are never
+rotated or truncated — every record of every topic is kept and replayed
+into memory on reopen, like a Kafka topic with ``retention.ms=-1``. The
+demo pipeline's topics are bounded (one Kaggle pass); a production
+deployment would cap topics with segment rotation + delete-before-
+committed-offset, which the framing here supports but the broker's
+in-memory partition lists (offset == list index) do not yet.
 
 Framing is ``[u32 len][u32 crc32][payload]`` with the byte-crunching
 (frame building, replay scan, torn-tail detection) in C++
@@ -144,11 +155,34 @@ class BusLog:
 
     def replay_offsets(self) -> dict[str, dict[tuple[str, int], int]]:
         groups: dict[str, dict[tuple[str, int], int]] = {}
+        n_raw = 0
         for payload in self._offsets.replay():
+            n_raw += 1
             o = json.loads(payload)
             g = groups.setdefault(o["g"], {})
             tp = (o["t"], int(o["p"]))
             g[tp] = max(g.get(tp, 0), int(o["o"]))
+        n_unique = sum(len(g) for g in groups.values())
+        # offsets.log grows one entry per commit forever; once history
+        # dominates (>4x the live key count), rewrite it compacted. Atomic
+        # (tmp + rename) and done before any append opens the file, so a
+        # crash mid-compaction leaves either the old or the new file intact.
+        if n_raw > max(64, 4 * n_unique):
+            tmp = self._offsets.path + ".tmp"
+            compacted = SegmentFile(tmp, fsync=self.fsync)
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            payloads = [
+                json.dumps({"g": g_name, "t": t, "p": p, "o": off}).encode()
+                for g_name, tps in groups.items()
+                for (t, p), off in tps.items()
+            ]
+            if payloads:  # one write (and one fsync) for the whole rewrite
+                compacted.append(*payloads)
+            compacted.close()
+            os.replace(tmp, self._offsets.path)
         return groups
 
     # -- append -------------------------------------------------------------

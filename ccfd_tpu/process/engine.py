@@ -294,7 +294,7 @@ class Engine:
                     {
                         "pid": i.pid,
                         "def": i.definition.id,
-                        "vars": i.vars,
+                        "vars": dict(i.vars),
                         "status": i.status,
                         "node": i.node,
                         "wait_signal": i.wait_signal,
@@ -312,7 +312,7 @@ class Engine:
                     "task_id": t.task_id,
                     "pid": t.pid,
                     "name": t.name,
-                    "vars": t.vars,
+                    "vars": dict(t.vars),
                     "status": t.status,
                     "suggested_outcome": t.suggested_outcome,
                     "prediction_confidence": t.prediction_confidence,
@@ -332,10 +332,16 @@ class Engine:
             # the recorded values so live allocation stays consistent
             self._pid = itertools.count(snap["next_pid"])
             self._tid = itertools.count(snap["next_tid"])
-            # round-trip through JSON: validates serializability now (not at
-            # restore time months later) and detaches the snapshot from live
-            # engine state so later mutations can't corrupt it
-            return json.loads(json.dumps(snap))
+        # JSON round-trip OUTSIDE the lock: the platform's checkpoint loop
+        # calls snapshot() every few seconds, and serializing every live
+        # instance while holding the lock would periodically stall
+        # start_process/signal/complete_task for time proportional to the
+        # active-instance count. The dicts above shallow-copied ``vars`` and
+        # ``history`` under the lock; the engine only does top-level
+        # assignments into those, so the round-trip here still sees a
+        # consistent snapshot while also validating serializability now (not
+        # at restore time months later) and detaching it from live state.
+        return json.loads(json.dumps(snap))
 
     def restore(self, snap: Mapping[str, Any]) -> None:
         """Load a snapshot into an empty engine and re-arm pending timers.

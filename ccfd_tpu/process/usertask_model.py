@@ -31,8 +31,10 @@ The engine hook is ``Engine(task_listener=...)``: called once per human
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
+import weakref
 from typing import TYPE_CHECKING, Any
 
 import jax
@@ -45,6 +47,26 @@ if TYPE_CHECKING:  # pragma: no cover
     from ccfd_tpu.process.engine import Task
 
 NUM_TASK_FEATURES = len(FEATURE_NAMES) + 1  # + fraud probability
+
+# Models whose construction-time warmup thread may still be compiling; a
+# WeakSet so discarded models are collectable. The single atexit hook stops
+# and joins the stragglers (a thread mid-XLA-compile killed at exit aborts
+# the process with "exception not rethrown").
+_live_warmups: "weakref.WeakSet[OnlineUserTaskModel]" = weakref.WeakSet()
+_atexit_registered = False
+
+
+def _register_warmup(model: "OnlineUserTaskModel") -> None:
+    global _atexit_registered
+    _live_warmups.add(model)
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_cancel_all_warmups)
+
+
+def _cancel_all_warmups() -> None:
+    for m in list(_live_warmups):
+        m._warmup_cancel()
 
 
 def task_row(task: "Task") -> np.ndarray:
@@ -104,6 +126,7 @@ class OnlineUserTaskModel:
         learning_rate: float = 0.5,
         buffer_size: int = 4096,
         seed: int = 0,
+        warmup: bool = True,
     ):
         self.min_examples = min_examples
         self.fit_every = fit_every
@@ -126,6 +149,58 @@ class OnlineUserTaskModel:
         self._trained = False
         self._lock = threading.Lock()
         self.last_loss: float | None = None
+        # Pre-compile the jitted predict/fit executables off the request
+        # path: the first _fit would otherwise run XLA compilation
+        # synchronously inside the investigator's complete_task call (the
+        # engine task_listener fires in the REST handler thread), and every
+        # new power-of-two buffer bucket would recompile again. Warming on a
+        # daemon thread at construction covers every bucket this buffer can
+        # ever reach, so human task completions never pay a compile.
+        self._warmup_thread: threading.Thread | None = None
+        self._warmup_stop = threading.Event()
+        if warmup:
+            self._warmup_thread = threading.Thread(
+                target=self._warmup, name="usertask-model-warmup", daemon=True
+            )
+            self._warmup_thread.start()
+            # a daemon thread killed mid-XLA-compile at interpreter exit
+            # aborts the process ("exception not rethrown"); stop between
+            # buckets and join instead. One module-level atexit hook over a
+            # WeakSet — registering a bound method per instance would pin
+            # every model (params + example buffer) until interpreter exit.
+            _register_warmup(self)
+
+    def _warmup(self) -> None:
+        try:
+            params = self._params
+            _predict(params, jnp.zeros((1, NUM_TASK_FEATURES), jnp.float32))
+            lr = jnp.float32(self.learning_rate)
+            bucket = 1
+            while bucket < self.min_examples:
+                bucket *= 2
+            while not self._warmup_stop.is_set():
+                x = jnp.zeros((bucket, NUM_TASK_FEATURES), jnp.float32)
+                y = jnp.zeros((bucket,), jnp.float32)
+                _sgd_epoch(params, x, y, y, lr)
+                if bucket >= self.buffer_size:  # pow2 ceiling covered
+                    break
+                bucket *= 2
+        except Exception:  # pragma: no cover - warmup is best-effort
+            pass
+
+    def _warmup_cancel(self) -> None:
+        self._warmup_stop.set()
+        if self._warmup_thread is not None:
+            # bounded join: if a compile wedged (e.g. a hung device tunnel)
+            # the thread never sees the stop event — cap the wait so
+            # interpreter exit is never blocked forever
+            self._warmup_thread.join(timeout=10.0)
+
+    def warmup_join(self, timeout: float | None = None) -> None:
+        """Block until the construction-time compile warmup finishes
+        (benchmarks and tests that measure fit latency call this first)."""
+        if self._warmup_thread is not None:
+            self._warmup_thread.join(timeout)
 
     # -- PredictionService protocol ---------------------------------------
     def predict(self, task: "Task") -> tuple[Any, float]:

@@ -6,11 +6,15 @@ services the same way — pooled HTTP with `SELDON_POOL_SIZE`-style knobs
 (reference README.md:389-393).
 
 Retry policy: idempotent requests retry on any transport error. A
-non-idempotent request (process start, produce) retries ONLY when the
-connection was refused — that is the one failure that proves the request
-never reached the server; anything later (timeout reading the response,
-reset mid-flight) may have been processed, and re-sending would duplicate
-the side effect.
+non-idempotent request (process start, produce) retries ONLY on failures
+that prove the server cannot have processed it: a refused connection, or
+an error raised while SENDING the request (``conn.request`` dying on a
+stale pooled keep-alive with BrokenPipe/ConnectionReset — the request was
+never completely written, so an incomplete HTTP message is all the server
+could have seen and it will not dispatch it). A failure while READING the
+response (timeout, reset after the request was fully sent) may mean the
+server processed it, and re-sending would duplicate the side effect — no
+retry there.
 """
 
 from __future__ import annotations
@@ -56,11 +60,13 @@ class PooledHTTPClient:
         last: Exception | None = None
         for _ in range(self._retries + 1):
             conn = self._pool.get()
+            sent = False
             try:
                 conn.request(
                     method, path, body=payload,
                     headers={"Content-Type": "application/json"},
                 )
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
                 self._pool.put(conn)
@@ -69,7 +75,11 @@ class PooledHTTPClient:
                 last = e
                 conn.close()
                 self._pool.put(self._connect())
-                if not idempotent and not isinstance(e, ConnectionRefusedError):
+                # send-phase failures (conn.request raised — including a
+                # refused connect — mean the request was never fully written,
+                # so the server can't have dispatched it) are safe to retry
+                # even for non-idempotent requests
+                if not idempotent and sent:
                     break
         raise ConnectionError(f"{self.host}:{self.port} unreachable: {last}")
 

@@ -101,12 +101,34 @@ def test_poll_retry_with_same_seq_redelivers_not_skips(bus):
     assert sorted(r.value for r in recs) == [0, 1, 2, 3, 4]
     order = [r.value for r in recs]
     # simulate the lost-response retry: same seq again
-    code, body = c._poll_once(10, 0.0)
+    code, body = c._poll_once(c._seq, 10, 0.0)
     assert code == 200
     assert [r["value"] for r in body["records"]] == order  # redelivered verbatim
     # a NEW poll (next seq) advances normally
     client.produce("t", 5)
     assert [r.value for r in c.poll(10)] == [5]
+    c.close()
+
+
+def test_poll_seq_advances_only_on_success(bus):
+    """ADVICE r1 (medium): if transport retries are exhausted and
+    RemoteBusError propagates out of poll(), the NEXT poll() call must
+    re-use the same seq — otherwise the batch the broker consumed and
+    auto-committed under the failed seq is silently lost."""
+    srv, client, port = bus
+    c = client.consumer("g", ("t",))
+    for i in range(4):
+        client.produce("t", i)
+    # server processes the poll (consumes + caches under seq) but the
+    # client never sees the response: exactly a lost-response failure
+    lost_seq = c._seq + 1
+    code, body = c._poll_once(lost_seq, 10, 0.0)
+    assert code == 200 and len(body["records"]) == 4
+    assert c._seq == lost_seq - 1  # client state untouched: poll "failed"
+    # application-level retry: plain poll() must redeliver that batch
+    recs = c.poll(10)
+    assert sorted(r.value for r in recs) == [0, 1, 2, 3]
+    assert c._seq == lost_seq
     c.close()
 
 
