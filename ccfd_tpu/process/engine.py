@@ -37,6 +37,22 @@ from typing import Any, Callable, Mapping, Protocol
 from ccfd_tpu.metrics.prom import Registry
 from ccfd_tpu.process.clock import Clock, RealClock, TimerHandle
 
+def _copy_containers(v: Any) -> Any:
+    """Recursive copy of JSON containers (dict/list), leaves shared.
+
+    Snapshots detach from live engine state with this instead of a full
+    ``json.dumps`` under the lock: copying containers is cheap (no string
+    building), and since dicts/lists are the only mutable JSON values, a
+    ServiceNode that mutates NESTED vars (``inst.vars["x"]["y"] = ...``)
+    still can't tear the snapshot serialized after the lock is released.
+    """
+    if isinstance(v, dict):
+        return {k: _copy_containers(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_copy_containers(x) for x in v]
+    return v
+
+
 # ---------------------------------------------------------------------------
 # Nodes
 
@@ -294,7 +310,7 @@ class Engine:
                     {
                         "pid": i.pid,
                         "def": i.definition.id,
-                        "vars": dict(i.vars),
+                        "vars": _copy_containers(i.vars),
                         "status": i.status,
                         "node": i.node,
                         "wait_signal": i.wait_signal,
@@ -312,7 +328,7 @@ class Engine:
                     "task_id": t.task_id,
                     "pid": t.pid,
                     "name": t.name,
-                    "vars": dict(t.vars),
+                    "vars": _copy_containers(t.vars),
                     "status": t.status,
                     "suggested_outcome": t.suggested_outcome,
                     "prediction_confidence": t.prediction_confidence,
@@ -336,11 +352,10 @@ class Engine:
         # calls snapshot() every few seconds, and serializing every live
         # instance while holding the lock would periodically stall
         # start_process/signal/complete_task for time proportional to the
-        # active-instance count. The dicts above shallow-copied ``vars`` and
-        # ``history`` under the lock; the engine only does top-level
-        # assignments into those, so the round-trip here still sees a
-        # consistent snapshot while also validating serializability now (not
-        # at restore time months later) and detaching it from live state.
+        # active-instance count. ``_copy_containers`` above already detached
+        # every mutable JSON container under the lock (so even ServiceNodes
+        # that mutate nested vars can't tear this), and the round-trip here
+        # validates serializability now, not at restore time months later.
         return json.loads(json.dumps(snap))
 
     def restore(self, snap: Mapping[str, Any]) -> None:

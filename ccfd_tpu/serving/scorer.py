@@ -14,6 +14,18 @@ follows the latency plan in SURVEY.md §7 "hard parts":
   must not pause serving: ``swap_params`` device-puts the new pytree and
   swaps a reference atomically between dispatches — in-flight calls keep the
   old buffers alive, the next call picks up the new ones.
+- **Mesh-sharded dispatch.** The reference scales serving by k8s replicas +
+  Kafka partitioning (reference deploy/frauddetection_cr.yaml:76,
+  router.yaml:32); the TPU analog is ONE scorer whose batch shards over the
+  ``"data"`` axis of a ``jax.sharding.Mesh`` (SURVEY.md §7 stage 6).
+  ``Scorer(mesh=...)`` keeps the exact same bucketing/warmup/swap surface:
+  buckets round up to multiples of the data-axis size, inputs are
+  device_put with a NamedSharding so each chip receives only its rows, and
+  params ride replicated (default) or megatron-sharded over the ``"model"``
+  axis (``param_partition="model"``, layout in ccfd_tpu/parallel/sharding.py).
+  The fused Pallas kernel composes via ``shard_map``: every chip runs the
+  single-chip kernel on its shard — collectives only appear if the model
+  axis is used, and XLA schedules those.
 """
 
 from __future__ import annotations
@@ -46,14 +58,51 @@ class Scorer:
         num_features: int = NUM_FEATURES,
         seed: int = 0,
         use_fused: bool | None = None,
+        mesh: Any = None,
+        param_partition: str = "replicated",
     ):
         self.spec: ModelSpec = get_model(model_name)
-        self.batch_sizes = tuple(sorted(batch_sizes))
         self.num_features = num_features
+        self.mesh = mesh
+        if param_partition not in ("replicated", "model"):
+            raise ValueError(f"unknown param_partition {param_partition!r}")
+        if param_partition == "model" and model_name != "mlp":
+            # a silent fallback to replication would hand a caller who needs
+            # the sharded layout (model too big replicated) an OOM later
+            raise ValueError(
+                f"param_partition='model' has a layout only for 'mlp', "
+                f"not {model_name!r}"
+            )
+        self._param_partition = param_partition
+        self._batch_sharding = None
+        self._param_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ccfd_tpu.parallel.mesh import DATA_AXIS
+
+            self._data_size = mesh.shape[DATA_AXIS]
+            # every bucket must split evenly over the data axis
+            batch_sizes = {
+                -(-b // self._data_size) * self._data_size for b in batch_sizes
+            }
+            self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+            self._out_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self.batch_sizes = tuple(sorted(batch_sizes))
         self._params = params if params is not None else self.spec.init(
             jax.random.PRNGKey(seed)
         )
-        self._params = jax.device_put(self._params)
+        if mesh is not None:
+            from ccfd_tpu.parallel import sharding as shardlib
+
+            if param_partition == "model":
+                self._param_sharding = shardlib.mlp_param_spec(self._params, mesh)
+            else:
+                rep = shardlib.replicated(mesh)
+                self._param_sharding = jax.tree.map(lambda _: rep, self._params)
+            self._params = jax.device_put(self._params, self._param_sharding)
+        else:
+            self._params = jax.device_put(self._params)
         self._lock = threading.Lock()
         dtype = _DTYPES.get(compute_dtype, jnp.float32)
         # models without a dtype knob (e.g. trees) take (params, x) only
@@ -64,6 +113,10 @@ class Scorer:
             self._apply = lambda p, x: self.spec.apply(p, x, compute_dtype=dtype)
         else:
             self._apply = self.spec.apply
+        if mesh is not None:
+            # constrain the output to stay data-sharded: the partitioner
+            # must not all-gather probabilities onto one chip before D2H
+            self._apply = jax.jit(self._apply, out_shardings=self._out_sharding)
 
         # Pallas fused path: the whole MLP in one kernel, weights VMEM-
         # resident (ccfd_tpu/ops/fused_mlp.py). Auto-on for the flagship MLP
@@ -83,18 +136,70 @@ class Scorer:
 
             self._fused_mod = fused_mlp
             try:
-                self._fused_params = fused_mlp.fold_for_kernel(self._params)
+                self._fused_params = self._put_fused(
+                    fused_mlp.fold_for_kernel(self._params)
+                )
             except (KeyError, TypeError, ValueError):
                 self._fused_params = None  # incompatible layout: XLA path
             self._fused_interpret = jax.default_backend() == "cpu"
+            self._fused_sharded_cache: dict[int, Any] = {}
+
+    def _put_fused(self, folded: Any) -> Any:
+        """Fused weights live whole in every chip's VMEM: replicate on mesh."""
+        if self.mesh is None:
+            return folded
+        from ccfd_tpu.parallel.sharding import replicated
+
+        return jax.device_put(folded, replicated(self.mesh))
+
+    def _put_batch(self, chunk: np.ndarray) -> jax.Array:
+        """H2D with placement: on a mesh each chip gets only its row shard."""
+        if self._batch_sharding is None:
+            return jnp.asarray(chunk)
+        return jax.device_put(chunk, self._batch_sharding)
 
     def _fused_apply(self, fused_params: Any, x: jax.Array) -> jax.Array:
-        tile = min(x.shape[0], self._fused_mod.DEFAULT_TILE)
-        while x.shape[0] % tile:  # largest power-of-two-ish divisor <= 512
+        rows = x.shape[0] if self.mesh is None else x.shape[0] // self._data_size
+        tile = min(rows, self._fused_mod.DEFAULT_TILE)
+        while rows % tile:  # largest power-of-two-ish divisor <= 512
             tile //= 2
-        return self._fused_mod.fused_mlp_score(
-            fused_params, x, tile=tile, interpret=self._fused_interpret
-        )
+        if self.mesh is None:
+            return self._fused_mod.fused_mlp_score(
+                fused_params, x, tile=tile, interpret=self._fused_interpret
+            )
+        return self._fused_sharded(tile)(fused_params, x)
+
+    def _fused_sharded(self, tile: int) -> Any:
+        """SPMD composition of the single-chip Pallas kernel: ``shard_map``
+        over the data axis runs the kernel on each chip's row shard with the
+        full (replicated) weights — the TPU-native form of the reference's
+        "more replicas" scaling (reference deploy/frauddetection_cr.yaml:76).
+        Cached per tile so each bucket compiles once."""
+        fn = self._fused_sharded_cache.get(tile)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ccfd_tpu.parallel.mesh import DATA_AXIS
+
+            def per_chip(p, xs):
+                return self._fused_mod.fused_mlp_score(
+                    p, xs, tile=tile, interpret=self._fused_interpret
+                )
+
+            fn = jax.jit(
+                jax.shard_map(
+                    per_chip,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(DATA_AXIS, None)),
+                    out_specs=P(DATA_AXIS),
+                    # pallas_call emits ShapeDtypeStructs without a vma
+                    # annotation; the kernel is elementwise-per-shard, so
+                    # the varying-across-mesh check adds nothing here
+                    check_vma=False,
+                )
+            )
+            self._fused_sharded_cache[tile] = fn
+        return fn
 
     @property
     def params(self) -> Any:
@@ -116,12 +221,17 @@ class Scorer:
                 jax.block_until_ready(
                     self._fused_apply(
                         self._fused_params,
-                        jnp.zeros((b, self.num_features), jnp.bfloat16),
+                        self._put_batch(
+                            np.zeros((b, self.num_features), ml_dtypes.bfloat16)
+                        ),
                     )
                 )
             else:
                 jax.block_until_ready(
-                    self._apply(self._params, jnp.zeros((b, self.num_features)))
+                    self._apply(
+                        self._params,
+                        self._put_batch(np.zeros((b, self.num_features), np.float32)),
+                    )
                 )
 
     def swap_params(self, new_params: Any) -> None:
@@ -131,7 +241,14 @@ class Scorer:
         is an aliasing no-op, and aliased buffers would be deleted under us
         when the trainer's next donated step consumes its argument.
         """
-        staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
+        if self._param_sharding is not None:
+            # re-lay the fresh tree onto the mesh with the serving sharding
+            staged = jax.device_put(
+                jax.tree.map(lambda a: np.array(a), new_params),
+                self._param_sharding,
+            )
+        else:
+            staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
         jax.block_until_ready(staged)
         staged_fused = None
         # gate on the fused MODULE, not the current fused params: one
@@ -139,7 +256,7 @@ class Scorer:
         # must re-enable the kernel
         if getattr(self, "_fused_mod", None) is not None:
             try:
-                staged_fused = self._fused_mod.fold_for_kernel(staged)
+                staged_fused = self._put_fused(self._fused_mod.fold_for_kernel(staged))
                 jax.block_until_ready(staged_fused)
             except (KeyError, TypeError, ValueError):
                 staged_fused = None  # incompatible layout: drop to XLA path
@@ -180,10 +297,10 @@ class Scorer:
                 # ship rows as bf16: the kernel computes in bf16 either way,
                 # and half the bytes ≈ double the H2D-bound throughput
                 out = self._fused_apply(
-                    fused_params, jnp.asarray(chunk.astype(ml_dtypes.bfloat16))
+                    fused_params, self._put_batch(chunk.astype(ml_dtypes.bfloat16))
                 )
             else:
-                out = self._apply(params, jnp.asarray(chunk))
+                out = self._apply(params, self._put_batch(chunk))
             pending.append((out, take))
             if len(pending) >= depth:
                 done, took = pending.pop(0)

@@ -1,0 +1,102 @@
+"""Mesh-sharded serving on the virtual 8-device CPU mesh.
+
+VERDICT r1 "Missing #2": the reference scales serving by replicas + Kafka
+partitioning (reference deploy/frauddetection_cr.yaml:76, router.yaml:32);
+SURVEY.md §7 stage 6 maps that to pjit-sharded batch scoring. These tests
+pin the contract: a ``Scorer(mesh=...)`` must produce the same
+probabilities as the single-device scorer while actually sharding the
+batch (and optionally the params) over the mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ccfd_tpu.data.ccfd import synthetic_dataset
+from ccfd_tpu.models import mlp
+from ccfd_tpu.parallel.mesh import make_mesh
+from ccfd_tpu.serving.scorer import Scorer
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices"
+)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return synthetic_dataset(n=4096, fraud_rate=0.05, seed=3)
+
+
+@pytest.fixture(scope="module")
+def params(ds):
+    p = mlp.init(jax.random.PRNGKey(0))
+    return mlp.set_normalizer(p, ds.X.mean(0), ds.X.std(0))
+
+
+def _single(params, **kw):
+    return Scorer(model_name="mlp", params=params, use_fused=False, **kw)
+
+
+def test_sharded_scoring_matches_single_device(ds, params):
+    ref = _single(params).score(ds.X[:1000])
+    mesh = make_mesh()
+    sharded = _single(params, mesh=mesh).score(ds.X[:1000])
+    assert sharded.shape == (1000,)
+    np.testing.assert_allclose(ref, sharded, rtol=2e-2, atol=2e-3)
+
+
+def test_bucket_sizes_round_up_to_data_axis(params):
+    mesh = make_mesh()  # data axis = 8
+    s = _single(params, mesh=mesh, batch_sizes=(3, 10, 64))
+    assert all(b % 8 == 0 for b in s.batch_sizes)
+    assert s.batch_sizes == (8, 16, 64)
+    # a 5-row request still scores correctly through the padded bucket
+    out = s.score(np.zeros((5, 30), np.float32))
+    assert out.shape == (5,)
+
+
+def test_model_partition_matches_replicated(ds, params):
+    mesh = make_mesh(model_parallel=2)
+    rep = _single(params, mesh=mesh).score(ds.X[:512])
+    mp = _single(params, mesh=mesh, param_partition="model").score(ds.X[:512])
+    # same math up to collective reduction order
+    np.testing.assert_allclose(rep, mp, rtol=2e-2, atol=2e-3)
+
+
+def test_swap_params_on_mesh_changes_output(ds, params):
+    mesh = make_mesh()
+    s = _single(params, mesh=mesh)
+    before = s.score(ds.X[:256])
+    p2 = mlp.init(jax.random.PRNGKey(9))
+    p2 = mlp.set_normalizer(p2, ds.X.mean(0), ds.X.std(0))
+    s.swap_params(p2)
+    after = s.score(ds.X[:256])
+    assert not np.allclose(before, after)
+    # and the swapped params serve the same result as a fresh sharded scorer
+    np.testing.assert_allclose(
+        after, _single(p2, mesh=mesh).score(ds.X[:256]), rtol=2e-2, atol=2e-3
+    )
+
+
+def test_fused_kernel_composes_via_shard_map(ds, params):
+    """The Pallas kernel is single-chip; on a mesh it must ride shard_map
+    (each chip runs the kernel on its row shard) and agree with XLA."""
+    mesh = make_mesh()
+    xla = _single(params, mesh=mesh).score(ds.X[:256])
+    fused = Scorer(
+        model_name="mlp", params=params, mesh=mesh, use_fused=True,
+        batch_sizes=(16, 128, 1024),
+    )
+    assert fused.fused
+    got = fused.score(ds.X[:256])
+    # bf16 wire + bf16 kernel accumulation vs bf16 XLA path
+    np.testing.assert_allclose(xla, got, rtol=5e-2, atol=5e-3)
+
+
+def test_pipelined_bulk_scoring_on_mesh(ds, params):
+    mesh = make_mesh()
+    s = _single(params, mesh=mesh, batch_sizes=(128, 1024))
+    out = s.score_pipelined(ds.X[:3000], depth=3)
+    ref = _single(params).score_pipelined(ds.X[:3000], depth=1)
+    assert out.shape == (3000,)
+    np.testing.assert_allclose(ref, out, rtol=2e-2, atol=2e-3)
