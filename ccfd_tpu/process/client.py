@@ -11,7 +11,7 @@ connections + bounded retries, mirroring ccfd_tpu/serving/client.py.
 
 from __future__ import annotations
 
-from typing import Any, Mapping
+from typing import Any, Mapping, Sequence
 
 from ccfd_tpu.utils.httpclient import PooledHTTPClient
 
@@ -48,6 +48,21 @@ class EngineRestClient:
         if code != 201:
             raise RuntimeError(f"start_process {def_id!r} failed: {code} {body}")
         return int(body["process_id"])
+
+    def start_process_batch(
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+    ) -> list[int | None]:
+        """One HTTP round-trip for a micro-batch of process starts (the
+        router's hot path). ``None`` slots are instances the engine aborted
+        on a service-node error; a transport failure raises instead."""
+        code, body = self._request(
+            "POST", f"/rest/processes/{def_id}/instances/batch",
+            {"variables_list": [dict(v) for v in variables_list]},
+            idempotent=False,
+        )
+        if code != 201:
+            raise RuntimeError(f"start_process_batch {def_id!r} failed: {code} {body}")
+        return [None if p is None else int(p) for p in body["process_ids"]]
 
     def signal(self, pid: int, name: str, payload: Any = None) -> bool:
         code, body = self._request(

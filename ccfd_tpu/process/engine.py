@@ -31,8 +31,9 @@ import itertools
 import json
 import os
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Mapping, Protocol
+from typing import Any, Callable, Mapping, Protocol, Sequence
 
 from ccfd_tpu.metrics.prom import Registry
 from ccfd_tpu.process.clock import Clock, RealClock, TimerHandle
@@ -127,7 +128,7 @@ class ProcessDefinition:
 # Runtime state
 
 
-@dataclass
+@dataclass(slots=True)
 class Instance:
     pid: int
     definition: ProcessDefinition
@@ -141,7 +142,7 @@ class Instance:
     history: list[str] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Task:
     task_id: int
     pid: int
@@ -171,6 +172,7 @@ class Engine:
         prediction_service: PredictionService | None = None,
         confidence_threshold: float = 1.0,
         task_listener: Callable[[Task], None] | None = None,
+        completed_retention: int = 10_000,
     ):
         self.clock: Clock = clock or RealClock()
         self.registry = registry or Registry()
@@ -187,6 +189,18 @@ class Engine:
         self._pid = itertools.count(1)
         self._tid = itertools.count(1)
         self._lock = threading.RLock()
+        # Completed instances are evicted FIFO past this cap (jBPM likewise
+        # drops finished instances from the runtime store, keeping history in
+        # the audit log — here, in metrics): a pipeline starting a process
+        # per scored transaction would otherwise grow ``_instances`` without
+        # bound at tens of thousands of entries per second.
+        self._completed_retention = completed_retention
+        self._completed_order: deque[int] = deque()
+        self._tasks_by_pid: dict[int, list[int]] = {}
+        # def_id -> (service_nodes, end_node, history) for straight-through
+        # definitions (ServiceNode chain into an EndNode, no waits/gateways/
+        # tasks): the hot batch path runs these without per-node dispatch
+        self._static_chains: dict[str, tuple[list[ServiceNode], EndNode, list[str]]] = {}
         self._started = self.registry.counter(
             "process_instances_started_total", "process starts by definition"
         )
@@ -210,6 +224,32 @@ class Engine:
 
     def register(self, definition: ProcessDefinition) -> None:
         self._definitions[definition.id] = definition
+        chain = self._straight_through_chain(definition)
+        if chain is not None:
+            self._static_chains[definition.id] = chain
+        else:
+            self._static_chains.pop(definition.id, None)
+
+    @staticmethod
+    def _straight_through_chain(
+        definition: ProcessDefinition,
+    ) -> tuple[list[ServiceNode], EndNode, list[str]] | None:
+        """ServiceNode* -> EndNode with no branches? Then the node walk is
+        static and the batch start path can skip per-node dispatch."""
+        services: list[ServiceNode] = []
+        history: list[str] = []
+        name = definition.start
+        for _ in range(len(definition.nodes) + 1):
+            node = definition.nodes[name]
+            history.append(name)
+            if isinstance(node, ServiceNode):
+                services.append(node)
+                name = node.next
+            elif isinstance(node, EndNode):
+                return services, node, history
+            else:
+                return None
+        return None  # cycle of service nodes: not straight-through
 
     # -- public API (KIE-server-shaped: start / signal / tasks) -----------
     def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
@@ -220,6 +260,88 @@ class Engine:
             self._started.inc(labels={"process": def_id})
             self._run_from(inst, d.start)
             return inst.pid
+
+    def start_process_batch(
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+    ) -> list[int | None]:
+        """Start many instances of one definition under a single lock
+        acquisition — the router's hot path (one start per scored
+        transaction, reference README.md:552) would otherwise pay a lock
+        round-trip and per-label counter bump per transaction.
+
+        Straight-through definitions (a ServiceNode chain into an EndNode —
+        the "standard" process) additionally skip per-node dispatch: the
+        node walk is precomputed at ``register`` time and the metrics
+        counters advance once per batch instead of once per instance.
+
+        Error semantics (unlike single ``start_process``, which propagates):
+        an exception from a service/gateway aborts THAT instance only — its
+        slot in the returned list is ``None``, the instance is left
+        ``aborted``, and the rest of the batch still starts. One poisoned
+        transaction must not drop a whole micro-batch of process starts.
+        """
+        with self._lock:
+            d = self._definitions[def_id]
+            chain = self._static_chains.get(def_id)
+            pids: list[int | None] = []
+            if chain is None:
+                for variables in variables_list:
+                    try:
+                        # a non-mapping element must poison only its slot:
+                        # dict() belongs inside the isolation boundary too
+                        inst = Instance(
+                            pid=next(self._pid), definition=d, vars=dict(variables)
+                        )
+                    except (TypeError, ValueError):
+                        pids.append(None)
+                        continue
+                    self._instances[inst.pid] = inst
+                    self._started.inc(labels={"process": def_id})
+                    try:
+                        self._run_from(inst, d.start)
+                    except Exception:
+                        inst.status = "aborted"
+                        self._note_completed(inst.pid)
+                        pids.append(None)
+                        continue
+                    pids.append(inst.pid)
+                return pids
+            services, end, history = chain
+            n_ok = 0
+            n_started = 0
+            for variables in variables_list:
+                try:
+                    inst = Instance(
+                        pid=next(self._pid), definition=d, vars=dict(variables)
+                    )
+                except (TypeError, ValueError):
+                    pids.append(None)
+                    continue
+                self._instances[inst.pid] = inst
+                n_started += 1
+                try:
+                    for si, svc in enumerate(services):
+                        inst.node = svc.name
+                        svc.fn(self, inst)
+                except Exception:
+                    inst.history = list(history[: si + 1])
+                    inst.status = "aborted"
+                    self._note_completed(inst.pid)
+                    pids.append(None)
+                    continue
+                inst.node = end.name
+                inst.history = list(history)
+                inst.status = end.status
+                pids.append(inst.pid)
+                self._note_completed(inst.pid)
+                n_ok += 1
+            if n_started:
+                self._started.inc(n_started, labels={"process": def_id})
+            if n_ok:
+                self._completed.inc(
+                    n_ok, labels={"process": def_id, "status": end.status}
+                )
+            return pids
 
     def signal(self, pid: int, name: str, payload: Any = None) -> bool:
         """Deliver a signal; returns True iff it was consumed by a wait."""
@@ -406,6 +528,8 @@ class Engine:
                     history=list(s["history"]),
                 )
                 self._instances[inst.pid] = inst
+                if inst.status != "active":
+                    self._completed_order.append(inst.pid)
             for s in snap["tasks"]:
                 t = Task(
                     task_id=int(s["task_id"]),
@@ -418,6 +542,7 @@ class Engine:
                     outcome=s["outcome"],
                 )
                 self._tasks[t.task_id] = t
+                self._tasks_by_pid.setdefault(t.pid, []).append(t.task_id)
             self._pid = itertools.count(int(snap["next_pid"]))
             self._tid = itertools.count(int(snap["next_tid"]))
             # re-arm after all state is in place: a zero-delay timer may
@@ -444,6 +569,18 @@ class Engine:
             self.restore(json.load(f))
 
     # -- internals --------------------------------------------------------
+    def _note_completed(self, pid: int) -> None:
+        """Record a terminal instance and evict past the retention cap.
+        Caller holds the lock. Evicted instances (and their tasks) leave the
+        runtime store; history lives on in the metrics, like jBPM's audit
+        log vs runtime separation."""
+        self._completed_order.append(pid)
+        while len(self._completed_order) > self._completed_retention:
+            old = self._completed_order.popleft()
+            self._instances.pop(old, None)
+            for tid in self._tasks_by_pid.pop(old, ()):
+                self._tasks.pop(tid, None)
+
     def _consume_wait(self, inst: Instance) -> None:
         inst.wait_signal = None
         inst.wait_gen += 1
@@ -502,6 +639,7 @@ class Engine:
                     vars=dict(inst.vars),
                 )
                 self._tasks[task.task_id] = task
+                self._tasks_by_pid.setdefault(inst.pid, []).append(task.task_id)
                 if self.prediction_service is not None:
                     outcome, confidence = self.prediction_service.predict(task)
                     task.prediction_confidence = confidence
@@ -520,6 +658,7 @@ class Engine:
                 self._completed.inc(
                     labels={"process": inst.definition.id, "status": node.status}
                 )
+                self._note_completed(inst.pid)
                 return
             else:  # pragma: no cover
                 raise TypeError(f"unknown node type {type(node)}")

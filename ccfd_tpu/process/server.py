@@ -35,6 +35,7 @@ from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 from ccfd_tpu.process.engine import Engine, Instance, Task
 
 _INSTANCES = re.compile(r"^/rest/processes/([\w.-]+)/instances$")
+_INSTANCES_BATCH = re.compile(r"^/rest/processes/([\w.-]+)/instances/batch$")
 _SIGNAL = re.compile(r"^/rest/instances/(\d+)/signal/([\w.-]+)$")
 _INSTANCE = re.compile(r"^/rest/instances/(\d+)$")
 _COMPLETE = re.compile(r"^/rest/tasks/(\d+)/complete$")
@@ -151,6 +152,21 @@ class EngineServer:
                     return
                 path = self.path.rstrip("/")
                 eng = server.engine
+                m = _INSTANCES_BATCH.match(path)
+                if m:
+                    vlist = payload.get("variables_list")
+                    if not isinstance(vlist, list):
+                        self._send_json(
+                            400, {"error": "variables_list must be a list"}
+                        )
+                        return
+                    try:
+                        pids = eng.start_process_batch(m.group(1), vlist)
+                    except KeyError:
+                        self._send_json(404, {"error": f"no process {m.group(1)!r}"})
+                        return
+                    self._send_json(201, {"process_ids": pids})
+                    return
                 m = _INSTANCES.match(path)
                 if m:
                     try:

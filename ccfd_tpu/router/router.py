@@ -165,6 +165,10 @@ class Router:
                     f"engine has {sorted(known)}"
                 )
 
+        # engines (in-process or REST) exposing the batched start API get
+        # one call per (rule, micro-batch) group instead of one per tx
+        self._start_batch = getattr(engine, "start_process_batch", None)
+
         self._tx_consumer = broker.consumer("router", (cfg.kafka_topic,))
         self._resp_consumer = broker.consumer(
             "router-responses", (cfg.customer_response_topic,)
@@ -252,6 +256,12 @@ class Router:
         self._h_score_s.observe(time.perf_counter() - t0)
 
         fired = self.rules.evaluate(x, proba)
+        # group the micro-batch by fired rule: one batched process-start per
+        # (rule, process) instead of one engine round-trip per transaction —
+        # the engine amortizes its lock (and the remote client its HTTP hop)
+        # over the group, which is what lets L5 absorb the TPU scorer's
+        # output rate (VERDICT r1: engine throughput >= scorer throughput)
+        groups: dict[int, list[dict]] = {}
         for tx, p, ridx in zip(txs, proba, fired):
             rule = self.rules.rules[ridx]
             variables = {
@@ -260,15 +270,34 @@ class Router:
                 "customer_id": tx.get("id"),
             }
             variables.update(rule.set_vars)
+            groups.setdefault(ridx, []).append(variables)
+        for ridx, vars_list in groups.items():
+            rule = self.rules.rules[ridx]
             try:
-                self.engine.start_process(rule.process, variables)
+                if self._start_batch is not None:
+                    pids = self._start_batch(rule.process, vars_list)
+                else:  # engine without the batch API: per-item, isolated
+                    pids = []
+                    for variables in vars_list:
+                        try:
+                            pids.append(
+                                self.engine.start_process(rule.process, variables)
+                            )
+                        except Exception:
+                            pids.append(None)
             except Exception:
-                # a bad rule target or a flaky remote engine must not take
-                # down the routing loop; the rest of the batch still routes
-                self._c_start_err.inc(labels={"type": rule.process})
+                # bad rule target or unreachable remote engine: the whole
+                # group failed to start, but the routing loop (and the other
+                # groups in this poll) must keep going
+                self._c_start_err.inc(len(vars_list), labels={"type": rule.process})
                 continue
-            self._c_out.inc(labels={"type": rule.process})
-            self._c_rule.inc(labels={"rule": rule.name})
+            n_err = sum(1 for p in pids if p is None)
+            if n_err:
+                self._c_start_err.inc(n_err, labels={"type": rule.process})
+            n_ok = len(pids) - n_err
+            if n_ok:
+                self._c_out.inc(n_ok, labels={"type": rule.process})
+                self._c_rule.inc(n_ok, labels={"rule": rule.name})
         return len(txs)
 
     # -- daemon loop -------------------------------------------------------

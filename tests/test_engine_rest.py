@@ -210,3 +210,20 @@ def test_platform_exposes_engine_rest(tmp_path):
         assert client.instance(pid)["status"] == "completed"
     finally:
         p.down()
+
+
+def test_batch_start_over_http(served_engine):
+    """One HTTP round-trip starts a micro-batch; the straight-through
+    standard process completes server-side and pids come back in order."""
+    engine, clock, client, port = served_engine
+    pids = client.start_process_batch(
+        "standard", [{"transaction": tx(float(i))} for i in range(50)]
+    )
+    assert len(pids) == 50 and all(isinstance(p, int) for p in pids)
+    assert pids == sorted(pids)
+    assert engine.instance(pids[-1]).status == "completed"
+    # unknown definition -> RuntimeError from the 404, not a silent drop
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        client.start_process_batch("nope", [{}])
