@@ -1,30 +1,49 @@
-"""Benchmark: end-to-end transaction-scoring throughput + latency on TPU.
+"""Benchmark: transaction-scoring throughput + latency, end to end.
 
-Measures the prediction hop the framework replaces (reference Seldon CPU
-model, SURVEY.md §3 stack A): host-side feature matrix -> bucketed jit
-dispatch (ccfd_tpu/serving/scorer.py) -> probabilities back on host. That
-is the full serving round-trip the router pays per micro-batch — H2D copy,
-XLA executable, D2H copy — not a device-only FLOP timing.
+Four timed surfaces, matching the hops the reference instruments on its
+SeldonCore/Router dashboards (SURVEY.md §3 stack A, §6):
 
-Prints ONE JSON line:
+1. **Scorer hop** — host feature matrix -> bucketed jit dispatch
+   (ccfd_tpu/serving/scorer.py) -> probabilities on host. Full H2D +
+   XLA executable + D2H round trip, the number ``metric``/``value`` report.
+2. **Fused vs XLA A/B** — the same hop through the Pallas fused kernel and
+   through plain XLA, so the kernel's win (or loss) is a recorded number
+   (VERDICT r1 next-steps #2).
+3. **REST hop** — concurrent HTTP clients -> PredictionServer ->
+   DynamicBatcher -> scorer; p50/p99 per request plus aggregate req/s and
+   rows/s. This is the hop the reference's Seldon engine histograms
+   measure (reference deploy/grafana/SeldonCore.json:499-531).
+4. **Pipeline loop** — producer -> bus -> router (micro-batch + rules) ->
+   engine (batched process starts) sustained tx/s with the real fraud
+   process at a realistic fired mix.
+
+Prints ONE JSON line; primary fields:
   {"metric": ..., "value": tx/s, "unit": "tx/s", "vs_baseline": ratio,
-   "p99_ms": ..., "p50_ms": ..., "platform": ...}
+   "p99_ms": ..., "platform": ...}
+plus sections ``rest`` / ``pipeline`` / ``fused_ab``.
 
 ``vs_baseline`` is the ratio against the 50,000 tx/s north-star target
-(BASELINE.json: the reference publishes no numbers of its own — the
-driver-set target is the baseline to beat; >1.0 means the target is
-beaten). ``p99_ms`` covers the second north-star target (p99 end-to-end
-predict < 10 ms): per-dispatch latency of a router-sized micro-batch.
+(BASELINE.json; the reference publishes no numbers of its own). ``p99_ms``
+covers the p99 < 10 ms target on the REST surface when measured, else the
+scorer hop.
 
-Robustness: the accelerator backend is probed in a SUBPROCESS with a
-timeout first — a wedged TPU tunnel would otherwise hang ``jax.devices()``
-forever and take the whole bench (and the driver waiting on it) with it.
-On probe failure the bench runs on CPU and says so in ``platform``.
+Robustness (VERDICT r1 next-steps #1): the accelerator backend is probed
+in a SUBPROCESS with a timeout — a wedged TPU tunnel would otherwise hang
+``jax.devices()`` forever and take the whole bench with it — and the probe
+RETRIES with backoff (CCFD_BENCH_PROBE_ATTEMPTS x CCFD_BENCH_PROBE_S,
+CCFD_BENCH_PROBE_BACKOFF_S apart) because the tunnel wedges
+intermittently. On fallback the bench runs on CPU, says so in
+``platform``, and attaches the newest cached TPU result
+(BENCH_TPU_LAST_GOOD.json, written on every successful TPU run) under
+``last_good_tpu`` with its capture time.
 
 Env knobs: CCFD_BENCH_BATCH (default 131072), CCFD_BENCH_SECONDS (default 3),
 CCFD_BENCH_PIPELINE (in-flight dispatch depth, default 2),
 CCFD_BENCH_LATENCY_BATCH (default 4096), CCFD_BENCH_PLATFORM=cpu to force
-CPU, CCFD_BENCH_PROBE_S (backend probe timeout, default 90).
+CPU, CCFD_BENCH_PROBE_S (per-attempt probe timeout, default 90),
+CCFD_BENCH_PROBE_ATTEMPTS (default 3), CCFD_BENCH_PROBE_BACKOFF_S (default
+30), CCFD_BENCH_REST_CLIENTS (default 8), CCFD_BENCH_REST_ROWS (rows per
+request, default 16), CCFD_BENCH_SKIP=rest,pipeline,ab to skip sections.
 """
 
 from __future__ import annotations
@@ -37,28 +56,209 @@ import time
 
 NORTH_STAR_TX_S = 50_000.0  # BASELINE.json north_star: >=50k tx/s on v5e-1
 NORTH_STAR_P99_MS = 10.0  # BASELINE.json north_star: p99 e2e predict <10ms
+LAST_GOOD_PATH = os.path.join(os.path.dirname(__file__), "BENCH_TPU_LAST_GOOD.json")
 
 
-def _probe_backend(timeout_s: float) -> bool:
+def _probe_backend(timeout_s: float, attempts: int, backoff_s: float) -> bool:
     """Can this environment initialize its default jax backend? Run the
-    check in a child so a wedged TPU tunnel can't hang the bench itself."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
+    check in a child so a wedged TPU tunnel can't hang the bench itself,
+    and retry: the tunnel wedges intermittently, and one failed probe must
+    not cost the whole round its TPU number."""
+    for i in range(max(1, attempts)):
+        if i:
+            time.sleep(backoff_s)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+        except (subprocess.SubprocessError, OSError):
+            pass
+    return False
+
+
+def _bench_scorer(scorer, X, batch, lat_batch, seconds, depth):
+    import numpy as np
+
+    x = X[:batch]
+    n_rows = 0
+    t0 = time.perf_counter()
+    while True:
+        proba = scorer.score_pipelined(x, depth=depth)
+        n_rows += x.shape[0]
+        elapsed = time.perf_counter() - t0
+        if elapsed >= seconds:
+            break
+    assert proba.shape == (batch,)
+    tx_per_s = n_rows / elapsed
+
+    xl = X[:lat_batch]
+    lat = []
+    t_end = time.perf_counter() + max(1.0, seconds / 2)
+    while time.perf_counter() < t_end:
+        t1 = time.perf_counter()
+        scorer.score(xl)
+        lat.append((time.perf_counter() - t1) * 1e3)
+    lat_a = np.asarray(lat)
+    return tx_per_s, float(np.percentile(lat_a, 50)), float(np.percentile(lat_a, 99))
+
+
+_REST_CLIENT_SCRIPT = r"""
+import http.client, json, socket, sys, time
+port, rows_n, seconds = int(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3])
+row = [float(j % 7) for j in range(30)]
+payload = json.dumps({"data": {"ndarray": [row] * rows_n}})
+headers = {"Content-Type": "application/json"}
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+conn.connect()
+conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+lat = []
+stop_at = time.perf_counter() + seconds
+t_loop = time.perf_counter()
+while time.perf_counter() < stop_at:
+    t1 = time.perf_counter()
+    conn.request("POST", "/api/v0.1/predictions", payload, headers)
+    resp = conn.getresponse()
+    body = resp.read()
+    assert resp.status == 200, body[:200]
+    lat.append((time.perf_counter() - t1) * 1e3)
+print(json.dumps({"lat": lat, "loop_s": time.perf_counter() - t_loop}))
+"""
+
+
+def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req):
+    """HTTP clients -> PredictionServer -> DynamicBatcher -> scorer: the full
+    REST round trip. Clients run in SUBPROCESSES — in-process client threads
+    would share the GIL with the server handlers and pollute the p99 with
+    client-side scheduling, which is not the hop under test."""
+    import numpy as np
+
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.serving.server import PredictionServer
+
+    scorer = Scorer(
+        model_name="mlp", params=scorer_params,
+        batch_sizes=(16, 128, 1024, lat_batch), compute_dtype="bfloat16",
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, Config(dynamic_batching=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _REST_CLIENT_SCRIPT,
+             str(port), str(rows_per_req), str(seconds)],
+            stdout=subprocess.PIPE,
         )
-        return r.returncode == 0
-    except (subprocess.SubprocessError, OSError):
-        return False
+        for _ in range(n_clients)
+    ]
+    lat: list[float] = []
+    rate = 0.0
+    ok = 0
+    try:
+        for p in procs:
+            # throughput aggregates per-client measured windows: the
+            # parent's wall clock would also count interpreter startup
+            # (~2 s of site hooks here), which is not the hop under test
+            try:
+                out, _ = p.communicate(timeout=seconds + 120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                continue
+            if p.returncode == 0:
+                try:
+                    r = json.loads(out)
+                except ValueError:
+                    continue
+                lat.extend(r["lat"])
+                rate += len(r["lat"]) / max(r["loop_s"], 1e-9)
+                ok += 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+    if not lat:
+        return {"error": "all REST bench clients failed"}
+    lat_a = np.asarray(lat)
+    return {
+        "clients": ok,
+        "rows_per_request": rows_per_req,
+        "requests_s": round(rate, 1),
+        "tx_s": round(rate * rows_per_req, 1),
+        "p50_ms": round(float(np.percentile(lat_a, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_a, 99)), 3),
+    }
+
+
+def _bench_pipeline(scorer_params, seconds):
+    """producer -> bus -> router -> engine sustained loop, realistic mix."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES, synthetic_dataset
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.serving.scorer import Scorer
+
+    cfg = Config()
+    broker = Broker()
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, None)
+    scorer = Scorer(model_name="mlp", params=scorer_params)
+    scorer.warmup()
+    router = Router(cfg, broker, scorer.score, engine, reg, max_batch=4096)
+
+    ds = synthetic_dataset(n=8192, fraud_rate=0.01, seed=1)
+    recs = []
+    for i in range(len(ds.X)):
+        rec = {FEATURE_NAMES[j]: float(ds.X[i, j]) for j in range(30)}
+        rec["id"] = i
+        recs.append(rec)
+
+    # feeder thread keeps the topic ahead of the router
+    import threading
+
+    stop = threading.Event()
+
+    def feed():
+        while not stop.is_set():
+            backlog = sum(broker.end_offsets(cfg.kafka_topic))
+            if backlog - router._c_in.value() > 50_000:
+                time.sleep(0.002)
+                continue
+            broker.produce_batch(cfg.kafka_topic, recs)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+    t0 = time.perf_counter()
+    total = 0
+    while time.perf_counter() - t0 < seconds:
+        total += router.step(poll_timeout_s=0.05)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    feeder.join(timeout=5)
+    out = reg.counter("transaction_outgoing_total")
+    return {
+        "tx_s": round(total / elapsed, 1),
+        "standard_starts": out.value(labels={"type": "standard"}),
+        "fraud_starts": out.value(labels={"type": "fraud"}),
+    }
 
 
 def main() -> None:
     platform_forced = os.environ.get("CCFD_BENCH_PLATFORM", "")
     fellback = False
     if not platform_forced:
-        probe_s = float(os.environ.get("CCFD_BENCH_PROBE_S", "90"))
-        if not _probe_backend(probe_s):
+        ok = _probe_backend(
+            float(os.environ.get("CCFD_BENCH_PROBE_S", "90")),
+            int(os.environ.get("CCFD_BENCH_PROBE_ATTEMPTS", "3")),
+            float(os.environ.get("CCFD_BENCH_PROBE_BACKOFF_S", "30")),
+        )
+        if not ok:
             fellback = True
             platform_forced = "cpu"
     if platform_forced:
@@ -77,10 +277,21 @@ def main() -> None:
     seconds = float(os.environ.get("CCFD_BENCH_SECONDS", "3"))
     depth = int(os.environ.get("CCFD_BENCH_PIPELINE", "2"))
     lat_batch = int(os.environ.get("CCFD_BENCH_LATENCY_BATCH", "4096"))
+    skip = set(os.environ.get("CCFD_BENCH_SKIP", "").split(","))
+    on_tpu = jax.default_backend() == "tpu"
 
     ds = synthetic_dataset(n=max(batch, lat_batch, 4096), fraud_rate=0.01, seed=0)
     params = mlp.init(jax.random.PRNGKey(0))
     params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    # push probabilities to a trained-model-like range so the pipeline
+    # section's fired mix is realistic (~1% fraud), not the untrained ~50%
+    import jax.numpy as jnp
+
+    pipe_params = dict(params)
+    pipe_params["layers"] = [dict(l) for l in params["layers"]]
+    pipe_params["layers"][-1] = dict(pipe_params["layers"][-1])
+    pipe_params["layers"][-1]["b"] = jnp.asarray([-4.0], jnp.float32)
+
     scorer = Scorer(
         model_name="mlp",
         params=params,
@@ -88,49 +299,84 @@ def main() -> None:
         compute_dtype="bfloat16",
     )
     scorer.warmup()
+    tx_per_s, p50, p99 = _bench_scorer(scorer, ds.X, batch, lat_batch, seconds, depth)
 
-    x = ds.X[:batch]
-    # timed region: full host->device->host scoring round trips (the fused
-    # Pallas kernel + bf16 wire + pipelined dispatch when depth > 1)
-    n_rows = 0
-    t0 = time.perf_counter()
-    while True:
-        proba = scorer.score_pipelined(x, depth=depth)
-        n_rows += x.shape[0]
-        elapsed = time.perf_counter() - t0
-        if elapsed >= seconds:
-            break
-    assert proba.shape == (batch,)
-    tx_per_s = n_rows / elapsed
+    fused_ab = None
+    if "ab" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_AB")):
+        # A/B the two scorer paths on identical work so the Pallas kernel's
+        # effect is a recorded number, not a docstring claim
+        ab = {}
+        for label, use_fused in (("fused", True), ("xla", False)):
+            s = Scorer(
+                model_name="mlp", params=params,
+                batch_sizes=(16, 128, 1024, lat_batch, batch),
+                compute_dtype="bfloat16", use_fused=use_fused,
+            )
+            if use_fused and not s.fused:
+                ab[label] = None
+                continue
+            s.warmup()
+            r_tx, r_p50, r_p99 = _bench_scorer(
+                s, ds.X, batch, lat_batch, max(1.0, seconds / 2), depth
+            )
+            ab[label] = {"tx_s": round(r_tx, 1), "p50_ms": round(r_p50, 3),
+                         "p99_ms": round(r_p99, 3)}
+        fused_ab = ab
 
-    # latency: synchronous single-dispatch round trips on a router-sized
-    # micro-batch — the p99 the SeldonCore dashboard would record
-    xl = ds.X[:lat_batch]
-    lat = []
-    t_end = time.perf_counter() + max(1.0, seconds / 2)
-    while time.perf_counter() < t_end:
-        t1 = time.perf_counter()
-        scorer.score(xl)
-        lat.append((time.perf_counter() - t1) * 1e3)
-    lat_a = np.asarray(lat)
-    p99 = float(np.percentile(lat_a, 99))
-
-    print(
-        json.dumps(
-            {
-                "metric": "end_to_end_scoring_throughput_mlp_bf16",
-                "value": round(tx_per_s, 1),
-                "unit": "tx/s",
-                "vs_baseline": round(tx_per_s / NORTH_STAR_TX_S, 3),
-                "p50_ms": round(float(np.percentile(lat_a, 50)), 3),
-                "p99_ms": round(p99, 3),
-                "p99_vs_target": round(NORTH_STAR_P99_MS / max(p99, 1e-9), 3),
-                "latency_batch": lat_batch,
-                "platform": jax.default_backend()
-                + (" (fallback: accelerator probe failed)" if fellback else ""),
-            }
+    rest = None
+    if "rest" not in skip:
+        rest = _bench_rest(
+            params, lat_batch, max(2.0, seconds),
+            int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "8")),
+            int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
         )
-    )
+
+    pipeline = None
+    if "pipeline" not in skip:
+        pipeline = _bench_pipeline(pipe_params, max(2.0, seconds))
+
+    # the e2e p99 the north star talks about is the REST predict hop when
+    # measured; the raw scorer-hop p99 otherwise (also when the REST
+    # section errored — its numbers are then absent, not zero)
+    p99_e2e = rest["p99_ms"] if rest and "p99_ms" in rest else p99
+    result = {
+        "metric": "end_to_end_scoring_throughput_mlp_bf16",
+        "value": round(tx_per_s, 1),
+        "unit": "tx/s",
+        "vs_baseline": round(tx_per_s / NORTH_STAR_TX_S, 3),
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "p99_e2e_ms": round(p99_e2e, 3),
+        "p99_vs_target": round(NORTH_STAR_P99_MS / max(p99_e2e, 1e-9), 3),
+        "latency_batch": lat_batch,
+        "fused_active": scorer.fused,
+        "platform": jax.default_backend()
+        + (" (fallback: accelerator probe failed)" if fellback else ""),
+    }
+    if fused_ab is not None:
+        result["fused_ab"] = fused_ab
+    if rest is not None:
+        result["rest"] = rest
+    if pipeline is not None:
+        result["pipeline"] = pipeline
+
+    if on_tpu:
+        # cache this as the round's last-good TPU number: later fallback
+        # runs (wedged tunnel) attach it instead of losing the TPU evidence
+        try:
+            with open(LAST_GOOD_PATH, "w") as f:
+                json.dump({"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                           "result": result}, f)
+        except OSError:
+            pass
+    elif fellback and os.path.exists(LAST_GOOD_PATH):
+        try:
+            with open(LAST_GOOD_PATH) as f:
+                result["last_good_tpu"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

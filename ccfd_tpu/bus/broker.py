@@ -167,6 +167,57 @@ class Broker:
             self._data_ready.notify_all()
             return rec
 
+    def produce_batch(
+        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+    ) -> int:
+        """Append many records under ONE lock acquisition (the producer's
+        hot path; same surface as RemoteBroker.produce_batch).
+
+        Failure contract: encode errors fail the WHOLE batch before any
+        state mutates (payloads are built up front). An I/O error from the
+        durable log mid-batch commits the prefix 0..k-1 — to both disk and
+        memory, consistently — and raises; that is the same
+        prefix-committed outcome as k individual ``produce`` calls. The log
+        write precedes the in-memory append per record, so memory never
+        holds a record the log would lose across a restart."""
+        values = list(values)
+        key_list = list(keys) if keys is not None else [None] * len(values)
+        if len(key_list) != len(values):
+            raise ValueError("keys and values must have equal length")
+        if not values:
+            return 0
+        with self._lock:
+            t = self._topic(topic)
+            now = time.time()
+            payloads = None
+            if self._log is not None:
+                from ccfd_tpu.bus.log import encode_entry
+
+                payloads = [
+                    encode_entry(k, now, v) for k, v in zip(key_list, values)
+                ]
+            appended = 0
+            try:
+                for i, (v, k) in enumerate(zip(values, key_list)):
+                    part = t.route(k)
+                    if payloads is not None:
+                        self._log.append_payload(topic, part, payloads[i])
+                    t.partitions[part].append(
+                        Record(
+                            topic=topic,
+                            partition=part,
+                            offset=len(t.partitions[part]),
+                            key=k,
+                            value=v,
+                            timestamp=now,
+                        )
+                    )
+                    appended += 1
+            finally:
+                if appended:
+                    self._data_ready.notify_all()
+            return len(values)
+
     # -- consume ----------------------------------------------------------
     def consumer(self, group_id: str, topics: Iterable[str]) -> "Consumer":
         with self._lock:

@@ -40,7 +40,11 @@ class SeldonClient:
             self._pool.put(self._connect())
 
     def _connect(self) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(self._host, self._port, timeout=self._timeout)
+        # Nagle off: headers+body ride separate segments, and a delayed ACK
+        # would stall the predict hop ~40 ms (see utils/httpclient.py)
+        from ccfd_tpu.utils.httpclient import _NodelayHTTPConnection
+
+        return _NodelayHTTPConnection(self._host, self._port, timeout=self._timeout)
 
     def _request(self, body: dict[str, Any]) -> dict[str, Any]:
         """POST with per-attempt SELDON_TIMEOUT and bounded retries.

@@ -22,8 +22,23 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import socket
 import urllib.parse
 from typing import Any
+
+
+class _NodelayHTTPConnection(http.client.HTTPConnection):
+    """http.client sends headers and body as separate segments; with Nagle
+    on, a delayed ACK from the server stalls the body ~40 ms. Every client
+    hop in the framework disables Nagle (servers do too — see
+    utils/httpserver.py)."""
+
+    def connect(self) -> None:
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover
+            pass
 
 
 class PooledHTTPClient:
@@ -48,7 +63,7 @@ class PooledHTTPClient:
             self._pool.put(self._connect())
 
     def _connect(self) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(self.host, self.port, timeout=self._timeout)
+        return _NodelayHTTPConnection(self.host, self.port, timeout=self._timeout)
 
     def request(
         self, method: str, path: str, body: Any = None, idempotent: bool = True
