@@ -321,20 +321,12 @@ class Consumer:
         self.close()
 
 
-class KafkaAdapter:  # pragma: no cover - requires a real cluster
-    """Same interface backed by ``kafka-python``, when available.
+def __getattr__(name: str):
+    # KafkaAdapter lives in its own module (it pulls in the json/base64
+    # wire codec); re-exported here because this is where callers expect
+    # the real-cluster seam to be.
+    if name == "KafkaAdapter":
+        from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
 
-    Instantiate with a bootstrap string (reference
-    deploy/kafka/ProducerDeployment.yaml:96-97). Kept as a thin seam so the
-    in-process broker and a real cluster are interchangeable.
-    """
-
-    def __init__(self, bootstrap: str):
-        try:
-            import kafka  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "kafka-python is not installed; use the in-process Broker"
-            ) from e
-        self.bootstrap = bootstrap
-        raise NotImplementedError("real-cluster adapter lands with deployment support")
+        return KafkaAdapter
+    raise AttributeError(name)

@@ -183,7 +183,17 @@ class RemoteConsumer:
 
 
 def broker_from_url(broker_url: str, **local_kwargs):
-    """The one seam components use: BROKER_URL decides local vs remote."""
+    """The one seam components use: BROKER_URL decides local vs remote.
+
+    ``http://host:port`` → networked bus server client;
+    ``kafka://bootstrap`` → real-cluster kafka-python adapter
+    (reference ProducerDeployment.yaml:96-97 passes the bootstrap the
+    same way); anything else → caller builds the in-process Broker.
+    """
     if broker_url.startswith("http://"):
         return RemoteBroker(broker_url)
+    if broker_url.startswith("kafka://"):
+        from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
+
+        return KafkaAdapter(broker_url[len("kafka://"):])
     return None  # caller builds the in-process Broker (with its own options)

@@ -1,0 +1,174 @@
+"""In-process emulation of the kafka-python API surface KafkaAdapter uses.
+
+Backed by ``ccfd_tpu.bus.broker.Broker`` (one shared broker per bootstrap
+string, like one cluster per bootstrap), faithful to the parts of
+kafka-python's contract the adapter depends on:
+
+- KafkaProducer applies value/key serializers and returns a future whose
+  ``get()`` yields RecordMetadata(topic, partition, offset);
+- KafkaConsumer applies deserializers, ``poll`` returns
+  ``{TopicPartition: [ConsumerRecord, ...]}`` with epoch-MS timestamps,
+  and records are only redelivered-after-crash if ``commit`` was not
+  called (the fake records commit calls so tests can assert the
+  adapter's commit-after-poll discipline);
+- admin.KafkaAdminClient.create_topics raises TopicAlreadyExistsError on
+  duplicates.
+
+This is a test double for adapter-logic coverage, not a broker
+reimplementation — a real cluster exercises the identical adapter code
+through the real library.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import namedtuple
+from types import SimpleNamespace
+from typing import Any, Iterable
+
+from ccfd_tpu.bus.broker import Broker
+
+_clusters: dict[str, Broker] = {}
+_lock = threading.Lock()
+
+
+def _cluster(bootstrap: str) -> Broker:
+    with _lock:
+        if bootstrap not in _clusters:
+            _clusters[bootstrap] = Broker()
+        return _clusters[bootstrap]
+
+
+def reset() -> None:
+    with _lock:
+        _clusters.clear()
+
+
+TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
+RecordMetadata = namedtuple("RecordMetadata", ["topic", "partition", "offset"])
+ConsumerRecord = namedtuple(
+    "ConsumerRecord", ["topic", "partition", "offset", "key", "value", "timestamp"]
+)
+
+
+class TopicAlreadyExistsError(Exception):
+    pass
+
+
+class _Future:
+    def __init__(self, md: RecordMetadata):
+        self._md = md
+
+    def get(self, timeout: float | None = None) -> RecordMetadata:
+        return self._md
+
+
+class KafkaProducer:
+    def __init__(self, bootstrap_servers: str, value_serializer=None, key_serializer=None):
+        self._broker = _cluster(bootstrap_servers)
+        self._vs = value_serializer or (lambda v: v)
+        self._ks = key_serializer or (lambda k: k)
+        self.flush_calls = 0
+
+    def send(self, topic: str, value: Any = None, key: Any = None) -> _Future:
+        rec = self._broker.produce(topic, self._vs(value), key=self._ks(key))
+        return _Future(RecordMetadata(rec.topic, rec.partition, rec.offset))
+
+    def flush(self, timeout: float | None = None) -> None:
+        self.flush_calls += 1
+
+    def close(self) -> None:
+        pass
+
+
+class KafkaConsumer:
+    def __init__(
+        self,
+        *topics: str,
+        bootstrap_servers: str = "",
+        group_id: str | None = None,
+        enable_auto_commit: bool = True,
+        auto_offset_reset: str = "latest",
+        value_deserializer=None,
+        key_deserializer=None,
+    ):
+        self._broker = _cluster(bootstrap_servers)
+        self._vd = value_deserializer or (lambda v: v)
+        self._kd = key_deserializer or (lambda k: k)
+        self.enable_auto_commit = enable_auto_commit
+        self.commit_calls = 0
+        self._inner = (
+            self._broker.consumer(group_id, topics) if topics and group_id else None
+        )
+
+    def poll(self, timeout_ms: int = 0, max_records: int = 500) -> dict:
+        assert self._inner is not None, "metadata-only consumer cannot poll"
+        recs = self._inner.poll(max_records=max_records, timeout_s=timeout_ms / 1000.0)
+        out: dict[TopicPartition, list[ConsumerRecord]] = {}
+        for r in recs:
+            out.setdefault(TopicPartition(r.topic, r.partition), []).append(
+                ConsumerRecord(
+                    topic=r.topic,
+                    partition=r.partition,
+                    offset=r.offset,
+                    key=self._kd(r.key),
+                    value=self._vd(r.value),
+                    timestamp=int(r.timestamp * 1000),
+                )
+            )
+        return out
+
+    def commit(self) -> None:
+        self.commit_calls += 1
+
+    # -- metadata surface (used by end_offsets) ---------------------------
+    def partitions_for_topic(self, topic: str) -> set[int] | None:
+        ends = self._broker.end_offsets(topic)
+        return set(range(len(ends))) if ends else None
+
+    def end_offsets(self, tps: Iterable[TopicPartition]) -> dict[TopicPartition, int]:
+        out = {}
+        for tp in tps:
+            out[tp] = self._broker.end_offsets(tp.topic)[tp.partition]
+        return out
+
+    def close(self) -> None:
+        if self._inner is not None:
+            self._inner.close()
+
+
+class NewTopic:
+    def __init__(self, name: str, num_partitions: int, replication_factor: int):
+        self.name = name
+        self.num_partitions = num_partitions
+        self.replication_factor = replication_factor
+
+
+class KafkaAdminClient:
+    def __init__(self, bootstrap_servers: str):
+        self._broker = _cluster(bootstrap_servers)
+        self._created: set[str] = set()
+
+    def create_topics(self, topics: list[NewTopic]) -> None:
+        for t in topics:
+            if t.name in self._created:
+                raise TopicAlreadyExistsError(t.name)
+            self._created.add(t.name)
+            self._broker.create_topic(t.name, t.num_partitions)
+
+    def close(self) -> None:
+        pass
+
+
+def module() -> SimpleNamespace:
+    """A module-shaped namespace matching what KafkaAdapter imports."""
+    ns = SimpleNamespace(
+        KafkaProducer=KafkaProducer,
+        KafkaConsumer=KafkaConsumer,
+        TopicPartition=TopicPartition,
+        admin=SimpleNamespace(KafkaAdminClient=KafkaAdminClient, NewTopic=NewTopic),
+        errors=SimpleNamespace(TopicAlreadyExistsError=TopicAlreadyExistsError),
+    )
+    ns.__name__ = "fake_kafka"
+    return ns

@@ -1,0 +1,133 @@
+"""KafkaAdapter: the real-cluster seam, exercised against the in-process
+kafka-python emulation (tests/fake_kafka.py).
+
+What's under test is the ADAPTER's translation logic — serialization of
+the bus value domain onto Kafka's byte wire, poll-shape flattening,
+timestamp units, commit-after-poll discipline, group resume — the code a
+real cluster would run through the real library
+(reference deploy/frauddetection_cr.yaml:73-77).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import tests.fake_kafka as fk
+from ccfd_tpu.bus.broker import Record
+from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
+
+
+@pytest.fixture(autouse=True)
+def _fresh_clusters():
+    fk.reset()
+    yield
+    fk.reset()
+
+
+def adapter(bootstrap="test:9092", **kw):
+    return KafkaAdapter(bootstrap, kafka_module=fk.module(), **kw)
+
+
+def test_produce_and_poll_round_trip():
+    a = adapter()
+    meta = a.produce("odh-demo", {"Amount": 12.5, "V1": -1.0}, key="card-1")
+    assert meta["topic"] == "odh-demo" and meta["offset"] == 0
+    with a.consumer("router", ["odh-demo"]) as c:
+        recs = c.poll(timeout_s=1.0)
+    assert len(recs) == 1
+    r = recs[0]
+    assert isinstance(r, Record)
+    assert r.value == {"Amount": 12.5, "V1": -1.0}
+    assert r.key == "card-1"
+    assert r.topic == "odh-demo" and r.offset == 0
+    # epoch seconds, not kafka's epoch millis
+    assert 1e9 < r.timestamp < 1e10
+    a.close()
+
+
+def test_bytes_values_ride_byte_exact():
+    # CSV lines travel as bytes end to end (producer reads raw S3 rows)
+    a = adapter()
+    line = b"0.0,-1.359807,...,149.62\n"
+    a.produce("odh-demo", line)
+    with a.consumer("g", ["odh-demo"]) as c:
+        [r] = c.poll(timeout_s=1.0)
+    assert r.value == line and isinstance(r.value, bytes)
+
+
+def test_produce_batch_counts_and_orders_within_partition():
+    a = adapter(default_partitions=1)
+    a.create_topic("t1", 1)
+    n = a.produce_batch("t1", [{"i": i} for i in range(20)])
+    assert n == 20
+    with a.consumer("g", ["t1"]) as c:
+        recs = c.poll(max_records=100, timeout_s=1.0)
+    assert [r.value["i"] for r in recs] == list(range(20))
+
+
+def test_keyed_records_land_in_one_partition():
+    a = adapter()
+    a.create_topic("keyed", 3)
+    a.produce_batch("keyed", [{"i": i} for i in range(10)], keys=["k"] * 10)
+    with a.consumer("g", ["keyed"]) as c:
+        recs = c.poll(max_records=100, timeout_s=1.0)
+    assert len({r.partition for r in recs}) == 1
+    assert [r.value["i"] for r in recs] == list(range(10))
+
+
+def test_commit_after_poll_discipline():
+    a = adapter()
+    a.produce("t", {"x": 1})
+    c = a.consumer("g", ["t"])
+    assert c._kc.enable_auto_commit is False
+    assert c._kc.commit_calls == 0
+    recs = c.poll(timeout_s=1.0)
+    assert recs and c._kc.commit_calls == 1
+    # empty poll commits nothing
+    c.poll(timeout_s=0.0)
+    assert c._kc.commit_calls == 1
+    c.close()
+
+
+def test_group_offsets_survive_consumer_reopen():
+    a = adapter()
+    a.produce_batch("t", [{"i": i} for i in range(4)])
+    with a.consumer("g", ["t"]) as c:
+        got = {r.value["i"] for r in c.poll(max_records=100, timeout_s=1.0)}
+    assert got == {0, 1, 2, 3}
+    a.produce("t", {"i": 99})
+    with a.consumer("g", ["t"]) as c2:
+        recs = c2.poll(max_records=100, timeout_s=1.0)
+    assert [r.value["i"] for r in recs] == [99]
+
+
+def test_end_offsets_and_create_topic_idempotent():
+    a = adapter()
+    a.create_topic("t", 3)
+    a.create_topic("t", 3)  # TopicAlreadyExists swallowed
+    a.produce_batch("t", [{"i": i} for i in range(7)], keys=[str(i) for i in range(7)])
+    ends = a.end_offsets("t")
+    assert len(ends) == 3 and sum(ends) == 7
+    # unknown topic: empty (no metadata) or all-zero (broker auto-create)
+    assert sum(a.end_offsets("missing")) == 0
+
+
+def test_closed_consumer_polls_empty():
+    a = adapter()
+    a.produce("t", {"x": 1})
+    c = a.consumer("g", ["t"])
+    c.close()
+    assert c.poll(timeout_s=0.5) == []
+
+
+def test_broker_from_url_kafka_scheme_needs_library():
+    from ccfd_tpu.bus.client import broker_from_url
+
+    with pytest.raises(RuntimeError, match="kafka-python is not installed"):
+        broker_from_url("kafka://host:9092")
+
+
+def test_broker_reexport():
+    from ccfd_tpu.bus import broker
+
+    assert broker.KafkaAdapter is KafkaAdapter
