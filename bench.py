@@ -235,10 +235,12 @@ def _bench_pipeline(scorer_params, seconds):
     feeder = threading.Thread(target=feed, daemon=True)
     feeder.start()
     t0 = time.perf_counter()
-    total = 0
-    while time.perf_counter() - t0 < seconds:
-        total += router.step(poll_timeout_s=0.05)
+    th = router.start(poll_timeout_s=0.05, pipeline=True)
+    time.sleep(seconds)
+    router.stop()
+    th.join(timeout=60)
     elapsed = time.perf_counter() - t0
+    total = router._c_in.value()
     stop.set()
     feeder.join(timeout=5)
     out = reg.counter("transaction_outgoing_total")

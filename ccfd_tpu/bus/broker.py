@@ -25,12 +25,13 @@ import binascii
 import itertools
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Any, Iterable
+from typing import Any, Iterable, NamedTuple
 
 
-@dataclass(frozen=True)
-class Record:
+class Record(NamedTuple):
+    # NamedTuple, not a frozen dataclass: construction shows up on the
+    # produce hot path (one Record per transaction at wire rate), and a
+    # frozen dataclass pays object.__setattr__ per field
     topic: str
     partition: int
     offset: int

@@ -95,6 +95,48 @@ class BrokerServer:
         self._c_produced = r.counter("bus_records_produced_total", "records in")
         self._c_delivered = r.counter("bus_records_delivered_total", "records out")
         self._g_consumers = r.gauge("bus_consumers", "live remote consumers")
+        # broker-health surface, the analog of the reference Kafka board's
+        # messages-in-per-topic and partition-health stats
+        # (reference deploy/grafana/Kafka.json broker/partition panels)
+        self._c_topic_in = r.counter(
+            "bus_topic_records_in_total", "records in by topic"
+        )
+        self._g_end_offset = r.gauge(
+            "bus_topic_end_offset", "log end offset by topic/partition"
+        )
+        self._g_backlog = r.gauge(
+            "bus_topic_backlog", "unconsumed records by group/topic"
+        )
+
+    def refresh_health_gauges(self) -> None:
+        """Compute per-topic end offsets and per-group backlog (lag) the way
+        a Kafka exporter does — at scrape time, not on the produce path."""
+        b = self.broker
+        with b._lock:
+            topics = {name: [len(p) for p in t.partitions] for name, t in b._topics.items()}
+            groups = {g: dict(tps) for g, tps in b._groups.items()}
+            # a group that registered but never committed (e.g. a consumer
+            # wedged since startup — exactly what a lag panel exists to
+            # catch) has no _groups entry yet; seed its assigned partitions
+            # at offset 0 so its lag reads as the full log, like Kafka
+            for g, members in b._members.items():
+                tps = groups.setdefault(g, {})
+                for m in members:
+                    for tp in m._assignment:
+                        tps.setdefault(tp, 0)
+        for name, ends in topics.items():
+            for p, end in enumerate(ends):
+                self._g_end_offset.set(end, labels={"topic": name, "partition": str(p)})
+        for g, tps in groups.items():
+            lag_by_topic: dict[str, int] = {}
+            for (tname, p), committed in tps.items():
+                ends = topics.get(tname)
+                if ends is not None and p < len(ends):
+                    lag_by_topic[tname] = lag_by_topic.get(tname, 0) + max(
+                        0, ends[p] - committed
+                    )
+            for tname, lag in lag_by_topic.items():
+                self._g_backlog.set(lag, labels={"group": g, "topic": tname})
 
     # -- consumer registry -------------------------------------------------
     def _register(self, group: str, topics: list[str]) -> int:
@@ -165,6 +207,7 @@ class BrokerServer:
             def do_GET(self):
                 path = self.path.rstrip("/")
                 if path in ("/metrics", "/prometheus"):
+                    server.refresh_health_gauges()
                     body = server.registry.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
@@ -211,6 +254,7 @@ class BrokerServer:
                         )
                         metas.append({"partition": rec.partition, "offset": rec.offset})
                     server._c_produced.inc(len(metas))
+                    server._c_topic_in.inc(len(metas), labels={"topic": m.group(1)})
                     self._send_json(200, {"metas": metas})
                     return
                 if path == "/consumers":

@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -136,6 +137,24 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ds = load_dataset()
         params = fit_mlp(ds.X, ds.y, steps=args.train_steps,
                          tc=TrainConfig(compute_dtype="float32"))
+    elif cfg.model_name == "mlp" and getattr(args, "checkpoint_dir", ""):
+        # serve the newest `train` checkpoint when one exists: training and
+        # serving compose through the checkpoint dir, so `ccfd_tpu train`
+        # followed by `ccfd_tpu serve` serves the trained (AUC-recorded)
+        # params instead of random init
+        from ccfd_tpu.models import mlp as mlp_mod
+        from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.checkpoint_dir)
+        if mgr.latest_step() is not None:
+            import jax
+
+            like = mlp_mod.init(jax.random.PRNGKey(0))
+            restored = mgr.restore(like)
+            if restored is not None:
+                params, step = restored
+                print(f"[serve] restored checkpoint step={step} from "
+                      f"{args.checkpoint_dir}", file=sys.stderr)
     scorer = Scorer(
         model_name=cfg.model_name, params=params, compute_dtype=cfg.compute_dtype,
         batch_sizes=cfg.batch_sizes,
@@ -154,15 +173,72 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
 
 def cmd_train(args: argparse.Namespace) -> int:
-    from ccfd_tpu.data.ccfd import load_dataset
+    """Offline training with the reference's data path: the CSV comes from
+    the object store (reference README.md:303-343 uploads creditcard.csv to
+    S3 and every consumer reads it from there) via ``--from-store``, from a
+    local file via CCFD_CSV, else the synthetic surrogate. Records held-out
+    AUC for the trained MLP AND the sklearn LogReg baseline (the reference's
+    modelfull is a sklearn classifier) so every checkpoint ships with its
+    quality evidence; the checkpoint it writes is what ``serve`` loads by
+    default."""
+    import numpy as np
+
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import load_csv_bytes, load_dataset
+    from ccfd_tpu.models import mlp as mlp_mod
     from ccfd_tpu.parallel.checkpoint import CheckpointManager
     from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+    from ccfd_tpu.utils.metrics_math import roc_auc
 
-    ds = load_dataset()
-    params = fit_mlp(ds.X, ds.y, steps=args.steps,
+    cfg = Config.from_env()
+    source = "synthetic"
+    if args.from_store:
+        from ccfd_tpu.store.client import S3Client
+        from ccfd_tpu.store.objectstore import Credentials
+
+        client = S3Client(
+            args.store_url or cfg.s3_endpoint or "http://127.0.0.1:9000",
+            Credentials(cfg.access_key_id or "ccfd-access",
+                        cfg.secret_access_key or "ccfd-secret"),
+        )
+        ds = load_csv_bytes(client.get(cfg.s3_bucket, cfg.filename))
+        source = f"store:{cfg.s3_bucket}/{cfg.filename}"
+    else:
+        ds = load_dataset()
+        if os.environ.get("CCFD_CSV"):
+            source = os.environ["CCFD_CSV"]
+
+    # held-out split for honest AUC (stratification unnecessary at 284k rows;
+    # the tail is sorted by Time in the real CSV, so shuffle first)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(ds.n)
+    n_test = max(1, int(ds.n * args.test_frac))
+    test, train = order[:n_test], order[n_test:]
+    Xtr, ytr, Xte, yte = ds.X[train], ds.y[train], ds.X[test], ds.y[test]
+
+    params = fit_mlp(Xtr, ytr, steps=args.steps,
                      tc=TrainConfig(compute_dtype="float32"))
+    proba = np.asarray(mlp_mod.apply(params, Xte))
+    auc_mlp = roc_auc(yte, proba)
+
+    auc_ref = None
+    try:
+        from sklearn.linear_model import LogisticRegression
+        from sklearn.preprocessing import StandardScaler
+
+        sc = StandardScaler().fit(Xtr)
+        clf = LogisticRegression(max_iter=1000).fit(sc.transform(Xtr), ytr)
+        auc_ref = roc_auc(yte, clf.predict_proba(sc.transform(Xte))[:, 1])
+    except ImportError:
+        pass  # baseline AUC simply absent without sklearn
+
     path = CheckpointManager(args.checkpoint_dir).save(args.steps, params)
-    print(json.dumps({"checkpoint": path, "rows": ds.n, "steps": args.steps}))
+    print(json.dumps({
+        "checkpoint": path, "rows": int(ds.n), "steps": args.steps,
+        "source": source, "test_rows": int(n_test),
+        "auc_mlp": round(auc_mlp, 5),
+        "auc_sklearn_logreg": round(auc_ref, 5) if auc_ref is not None else None,
+    }))
     return 0
 
 
@@ -260,6 +336,18 @@ def cmd_store(args: argparse.Namespace) -> int:
     elif args.action == "ls":
         print(json.dumps({"bucket": cfg.s3_bucket,
                           "keys": client.list(cfg.s3_bucket)}))
+    return 0
+
+
+def cmd_manifests(args: argparse.Namespace) -> int:
+    """Emit per-service k8s manifests from the platform CR (the reference's
+    deploy/*.yaml topology, generated so it can't drift from the spec)."""
+    from ccfd_tpu.platform.k8s import write_manifests
+    from ccfd_tpu.platform.operator import PlatformSpec
+
+    spec = PlatformSpec.from_yaml(args.file)
+    written = write_manifests(spec, args.out)
+    print(json.dumps({"written": written}))
     return 0
 
 
@@ -392,12 +480,21 @@ def cmd_router(args: argparse.Namespace) -> int:
                               timeout_s=cfg.seldon_timeout_ms / 1000.0,
                               retries=cfg.client_retries)
     router = Router(cfg, broker, score_fn, engine)
-    print(f"[router] consuming {cfg.kafka_topic!r} from {cfg.broker_url}",
-          file=sys.stderr)
+    # the reference scrapes the router on :8091/prometheus
+    # (reference README.md:503-507); the standalone role must expose the
+    # same surface the generated k8s Service/annotations point at
+    from ccfd_tpu.metrics.exporter import MetricsExporter
+
+    exporter = MetricsExporter(
+        {"router": router.registry}, host="0.0.0.0", port=args.metrics_port
+    ).start()
+    print(f"[router] consuming {cfg.kafka_topic!r} from {cfg.broker_url}; "
+          f"metrics on :{args.metrics_port}/prometheus", file=sys.stderr)
     try:
         router.run(poll_timeout_s=0.05)
     except KeyboardInterrupt:
         router.close()
+    exporter.stop()
     return 0
 
 
@@ -409,12 +506,19 @@ def cmd_notify(args: argparse.Namespace) -> int:
     broker = _broker_for(cfg)
     svc = NotificationService(cfg, broker, reply_prob=args.reply_prob,
                               approve_prob=args.approve_prob, seed=args.seed)
+    from ccfd_tpu.metrics.exporter import MetricsExporter
+
+    exporter = MetricsExporter(
+        {"notify": svc.registry}, host="0.0.0.0", port=args.metrics_port
+    ).start()
     print(f"[notify] consuming {cfg.customer_notification_topic!r} from "
-          f"{cfg.broker_url}", file=sys.stderr)
+          f"{cfg.broker_url}; metrics on :{args.metrics_port}/prometheus",
+          file=sys.stderr)
     try:
         svc.run(poll_timeout_s=0.05)
     except KeyboardInterrupt:
         svc.stop()
+    exporter.stop()
     return 0
 
 
@@ -476,11 +580,19 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--train", action="store_true", help="train before serving")
     s.add_argument("--train-steps", type=int, default=300)
+    s.add_argument("--checkpoint-dir", default="./checkpoints",
+                   help="serve the newest `train` checkpoint when present")
     s.set_defaults(fn=cmd_serve)
 
     t = sub.add_parser("train", help="offline-train the flagship MLP")
     t.add_argument("--steps", type=int, default=500)
     t.add_argument("--checkpoint-dir", default="./checkpoints")
+    t.add_argument("--from-store", action="store_true",
+                   help="fetch creditcard.csv from the object store "
+                        "(the reference's S3 data path)")
+    t.add_argument("--store-url", default="",
+                   help="store endpoint (default: s3endpoint env)")
+    t.add_argument("--test-frac", type=float, default=0.2)
     t.set_defaults(fn=cmd_train)
 
     an = sub.add_parser("analyze", help="dataset analytics report (Spark/notebook analog)")
@@ -517,12 +629,14 @@ def main(argv: list[str] | None = None) -> int:
     en.set_defaults(fn=cmd_engine)
 
     ro = sub.add_parser("router", help="standalone decision router")
+    ro.add_argument("--metrics-port", type=int, default=8091)  # README.md:503-507
     ro.set_defaults(fn=cmd_router)
 
     no = sub.add_parser("notify", help="standalone notification service")
     no.add_argument("--reply-prob", type=float, default=0.8)
     no.add_argument("--approve-prob", type=float, default=0.7)
     no.add_argument("--seed", type=int, default=0)
+    no.add_argument("--metrics-port", type=int, default=8080)
     no.set_defaults(fn=cmd_notify)
 
     pr = sub.add_parser("producer", help="standalone transaction producer")
@@ -530,6 +644,11 @@ def main(argv: list[str] | None = None) -> int:
     pr.add_argument("--rate", type=float, default=None)
     pr.add_argument("--wire-format", choices=("dict", "csv"), default="csv")
     pr.set_defaults(fn=cmd_producer)
+
+    mf = sub.add_parser("manifests", help="emit k8s manifests from the CR")
+    mf.add_argument("-f", "--file", default="deploy/platform_cr.yaml")
+    mf.add_argument("-o", "--out", default="deploy/k8s")
+    mf.set_defaults(fn=cmd_manifests)
 
     u = sub.add_parser("up", help="bring up the platform from a CR file")
     u.add_argument("-f", "--file", default="deploy/platform_cr.yaml")

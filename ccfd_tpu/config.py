@@ -83,6 +83,7 @@ class Config:
     compute_dtype: str = "bfloat16"
     batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384)
     batch_deadline_ms: float = 2.0
+    batch_workers: int = 4  # overlapped dispatches (device-RTT pipelining)
     dynamic_batching: bool = True  # serving-side request coalescing
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
@@ -141,6 +142,9 @@ class Config:
             batch_sizes=tuple(int(s) for s in sizes.split(",")) if sizes else Config.batch_sizes,
             batch_deadline_ms=float(
                 e.get("CCFD_BATCH_DEADLINE_MS", str(Config.batch_deadline_ms))
+            ),
+            batch_workers=int(
+                e.get("CCFD_BATCH_WORKERS", str(Config.batch_workers))
             ),
             dynamic_batching=e.get("CCFD_DYNAMIC_BATCHING", "1").strip().lower()
             not in ("0", "false", "no", "off"),

@@ -137,9 +137,21 @@ def seldon_core_dashboard() -> dict:
 
 
 def bus_dashboard() -> dict:
+    # broker-health panels mirror the reference Kafka board's shape:
+    # messages-in rate, per-topic throughput, partition end offsets, and
+    # consumer-group lag in place of under-replicated/offline-partition
+    # stats (the single-log bus has no replication to degrade; lag is its
+    # equivalent health signal) — reference deploy/grafana/Kafka.json
     p = [
-        _panel(0, "Producer rows / s", ["rate(producer_rows_total[5m])"]),
-        _panel(1, "Notifications sent / replies",
+        _panel(0, "Records in / s (cluster)", ["rate(bus_records_produced_total[5m])"]),
+        _panel(1, "Records delivered / s", ["rate(bus_records_delivered_total[5m])"]),
+        _panel(2, "Messages in by topic / s",
+               ["rate(bus_topic_records_in_total[5m])"]),
+        _panel(3, "Log end offset by topic/partition", ["bus_topic_end_offset"]),
+        _panel(4, "Consumer-group backlog (lag)", ["bus_topic_backlog"]),
+        _panel(5, "Live consumers", ["bus_consumers"], "stat"),
+        _panel(6, "Producer rows / s", ["rate(producer_rows_total[5m])"]),
+        _panel(7, "Notifications sent / replies",
                ["rate(notifications_sent_total[5m])",
                 "rate(notifications_replied_total[5m])",
                 "rate(notifications_no_reply_total[5m])"]),

@@ -18,6 +18,14 @@ Policy (adaptive, not a fixed delay):
 - One ``scorer.score`` call serves the whole batch; rows route back to
   their requests' futures. A scorer failure fails exactly the requests in
   that batch, never the worker.
+- ``workers`` > 1 OVERLAPS dispatches: while one batch is on the wire to
+  the device (which can be tens of ms through a tunneled TPU), another
+  worker is already collecting and launching the next. Under continuous
+  load a single worker makes every request wait for the in-flight
+  dispatch *plus* its own (~2x device RTT); overlapping brings the queue
+  wait back down toward one RTT and multiplies throughput by the
+  pipeline depth the device can absorb. XLA dispatch is thread-safe and
+  releases the GIL, so workers genuinely overlap.
 
 This composes with the Scorer's shape bucketing: the batcher decides WHEN
 to dispatch, the scorer pads the result to a compiled bucket.
@@ -40,6 +48,7 @@ class DynamicBatcher:
         max_batch: int = 16384,
         deadline_ms: float = 2.0,
         on_dispatch: Callable[[int], None] | None = None,
+        workers: int = 1,
     ):
         self._score = score_fn
         self.max_batch = max_batch
@@ -50,10 +59,12 @@ class DynamicBatcher:
         self._stop = False
         self.dispatches = 0  # observability: how many TPU launches happened
         self.rows = 0
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="ccfd-batcher"
-        )
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"ccfd-batcher-{i}")
+            for i in range(max(1, workers))
+        ]
+        for t in self._threads:
+            t.start()
 
     # -- client side -------------------------------------------------------
     def submit(self, x: np.ndarray) -> "Future[np.ndarray]":
@@ -131,8 +142,9 @@ class DynamicBatcher:
                     f.set_exception(e)
             return
         n_rows = int(sum(x.shape[0] for x in xs))
-        self.dispatches += 1
-        self.rows += n_rows
+        with self._cv:  # workers share the stats; += alone would race
+            self.dispatches += 1
+            self.rows += n_rows
         if self._on_dispatch is not None:
             self._on_dispatch(n_rows)
         off = 0
@@ -146,7 +158,8 @@ class DynamicBatcher:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        self._thread.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
         # fail anything still queued so no caller blocks forever
         with self._cv:
             leftovers = self._queue

@@ -1,0 +1,175 @@
+"""Generated k8s manifests carry the reference's per-service env contract.
+
+The reference deploys from per-service manifests whose env vars ARE the
+configuration surface (reference deploy/router.yaml:54-70,
+ccd-service.yaml:54-66, notification-service.yaml:50-52,
+kafka/ProducerDeployment.yaml:77-97). These tests pin that contract on
+the generated output and schema-check the k8s shapes.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.platform.k8s import build_manifests, render_yaml, write_manifests
+from ccfd_tpu.platform.operator import PlatformSpec
+
+CR = {
+    "apiVersion": "ccfd.tpu/v1",
+    "kind": "FraudDetectionPlatform",
+    "metadata": {"name": "t"},
+    "spec": {
+        "store": {"enabled": True},
+        "bus": {"partitions": 3},
+        "scorer": {"enabled": True, "model": "mlp", "port": 8000},
+        "engine": {"enabled": True},
+        "notify": {"enabled": True},
+        "router": {"enabled": True},
+        "producer": {"enabled": True},
+        "monitoring": {"enabled": True, "port": 9100},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def manifests():
+    return build_manifests(PlatformSpec.from_cr(CR), Config())
+
+
+def _doc(manifests, fname, kind, name=None):
+    for d in manifests[fname]:
+        if d["kind"] == kind and (name is None or d["metadata"]["name"] == name):
+            return d
+    raise AssertionError(f"{kind}/{name} not in {fname}")
+
+
+def _envmap(dep):
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    return {e["name"]: e.get("value", e.get("valueFrom")) for e in c["env"]}
+
+
+def test_all_services_emitted(manifests):
+    assert set(manifests) == {
+        "bus.yaml", "store.yaml", "scorer.yaml", "engine.yaml",
+        "router.yaml", "notify.yaml", "producer.yaml", "monitoring.yaml",
+    }
+
+
+def test_router_env_contract_verbatim(manifests):
+    # reference deploy/router.yaml:54-70
+    env = _envmap(_doc(manifests, "router.yaml", "Deployment"))
+    assert set(env) >= {
+        "BROKER_URL", "CUSTOMER_NOTIFICATION_TOPIC", "CUSTOMER_RESPONSE_TOPIC",
+        "KAFKA_TOPIC", "KIE_SERVER_URL", "SELDON_ENDPOINT", "SELDON_URL",
+        "FRAUD_THRESHOLD",
+    }
+    assert env["KAFKA_TOPIC"] == "odh-demo"
+    assert env["CUSTOMER_NOTIFICATION_TOPIC"] == "ccd-customer-outgoing"
+    assert env["CUSTOMER_RESPONSE_TOPIC"] == "ccd-customer-response"
+    assert env["FRAUD_THRESHOLD"] == "0.5"
+    assert env["SELDON_URL"].startswith("http://scorer:")
+    assert env["KIE_SERVER_URL"].startswith("http://engine:")
+
+
+def test_engine_env_contract_verbatim(manifests):
+    # reference deploy/ccd-service.yaml:54-66 + README.md:370-402 knobs
+    env = _envmap(_doc(manifests, "engine.yaml", "Deployment"))
+    assert set(env) >= {
+        "BROKER_URL", "CUSTOMER_NOTIFICATION_TOPIC", "SELDON_URL",
+        "SELDON_ENDPOINT", "SELDON_TIMEOUT", "SELDON_POOL_SIZE",
+        "CONFIDENCE_THRESHOLD",
+    }
+
+
+def test_notify_env_contract_verbatim(manifests):
+    # reference deploy/notification-service.yaml:50-52: BROKER_URL only
+    env = _envmap(_doc(manifests, "notify.yaml", "Deployment"))
+    assert set(env) == {"BROKER_URL"}
+
+
+def test_producer_env_contract_verbatim(manifests):
+    # reference deploy/kafka/ProducerDeployment.yaml:77-97 (lowercase names
+    # are the reference's own; creds come from the keysecret Secret)
+    env = _envmap(_doc(manifests, "producer.yaml", "Deployment"))
+    assert set(env) >= {
+        "ACCESS_KEY_ID", "SECRET_ACCESS_KEY", "topic", "s3endpoint",
+        "s3bucket", "filename", "bootstrap",
+    }
+    assert env["ACCESS_KEY_ID"]["secretKeyRef"]["name"] == "keysecret"
+    assert env["ACCESS_KEY_ID"]["secretKeyRef"]["key"] == "accesskey"
+
+
+def test_store_ships_keysecret(manifests):
+    # reference deploy/ceph/s3-secretceph.yaml:1-8
+    sec = _doc(manifests, "store.yaml", "Secret", "keysecret")
+    assert set(sec["stringData"]) == {"accesskey", "secretkey"}
+
+
+def test_scorer_is_the_tpu_pod(manifests):
+    dep = _doc(manifests, "scorer.yaml", "Deployment")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"] == {"google.com/tpu": 1}
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    # reference README.md:292-301: model pod scraped via annotations
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/prometheus"
+
+
+def test_monitoring_configmap_discovers_annotated_pods(manifests):
+    cm = _doc(manifests, "monitoring.yaml", "ConfigMap", "prometheus-config")
+    prom = yaml.safe_load(cm["data"]["prometheus.yml"])
+    [job] = prom["scrape_configs"]
+    assert job["kubernetes_sd_configs"] == [{"role": "pod"}]
+    keep = job["relabel_configs"][0]
+    assert keep["action"] == "keep" and keep["regex"] == "true"
+
+
+def test_scrape_annotations_match_reference_ports(manifests):
+    router = _doc(manifests, "router.yaml", "Deployment")
+    ann = router["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/port"] == "8091"  # README.md:503-507
+    engine = _doc(manifests, "engine.yaml", "Deployment")
+    ann = engine["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/port"] == "8090"  # README.md:509-515
+    assert ann["prometheus.io/path"] == "/rest/metrics"
+
+
+def test_k8s_schema_shapes(manifests):
+    for fname, docs in manifests.items():
+        for d in docs:
+            assert d["apiVersion"] in ("apps/v1", "v1")
+            assert d["kind"] in ("Deployment", "Service", "Secret", "ConfigMap")
+            assert d["metadata"]["name"]
+            if d["kind"] == "Deployment":
+                tmpl = d["spec"]["template"]
+                sel = d["spec"]["selector"]["matchLabels"]
+                assert sel == tmpl["metadata"]["labels"]
+                assert d["spec"]["strategy"]["rollingUpdate"] == {
+                    "maxUnavailable": "25%", "maxSurge": "25%",
+                }  # reference deploy/router.yaml:11-18
+                for c in tmpl["spec"]["containers"]:
+                    assert c["command"][0:3] == ["python", "-m", "ccfd_tpu"]
+            if d["kind"] == "Service":
+                assert d["spec"]["selector"]["app"] == d["metadata"]["name"]
+
+
+def test_render_and_write_round_trip(tmp_path, manifests):
+    docs = manifests["router.yaml"]
+    parsed = list(yaml.safe_load_all(render_yaml(docs)))
+    assert parsed == docs
+    written = write_manifests(PlatformSpec.from_cr(CR), str(tmp_path))
+    assert len(written) == len(manifests)
+    for p in written:
+        loaded = list(yaml.safe_load_all(open(p)))
+        assert all(d for d in loaded)
+
+
+def test_disabled_components_are_omitted():
+    cr = {**CR, "spec": {**CR["spec"], "producer": {"enabled": False},
+                         "engine": {"enabled": False}}}
+    m = build_manifests(PlatformSpec.from_cr(cr))
+    assert "producer.yaml" not in m and "engine.yaml" not in m
+    assert "scorer.yaml" in m

