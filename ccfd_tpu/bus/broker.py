@@ -142,6 +142,27 @@ class Broker:
         with self._lock:
             return [len(p) for p in self._topic(topic).partitions]
 
+    def health_snapshot(self) -> dict:
+        """One consistent view for health/lag exporters: per-topic partition
+        end offsets plus per-group committed offsets, with groups that
+        registered but never committed (e.g. a consumer wedged since
+        startup) seeded at offset 0 over their assigned partitions — their
+        lag reads as the full log, the way Kafka reports it."""
+        with self._lock:
+            topics = {
+                name: [len(p) for p in t.partitions]
+                for name, t in self._topics.items()
+            }
+            groups: dict[str, dict[tuple[str, int], int]] = {
+                g: dict(tps) for g, tps in self._groups.items()
+            }
+            for g, members in self._members.items():
+                tps = groups.setdefault(g, {})
+                for m in members:
+                    for tp in m._assignment:
+                        tps.setdefault(tp, 0)
+        return {"topics": topics, "groups": groups}
+
     # -- produce ----------------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None) -> Record:
         with self._lock:

@@ -109,21 +109,13 @@ class BrokerServer:
         )
 
     def refresh_health_gauges(self) -> None:
-        """Compute per-topic end offsets and per-group backlog (lag) the way
-        a Kafka exporter does — at scrape time, not on the produce path."""
-        b = self.broker
-        with b._lock:
-            topics = {name: [len(p) for p in t.partitions] for name, t in b._topics.items()}
-            groups = {g: dict(tps) for g, tps in b._groups.items()}
-            # a group that registered but never committed (e.g. a consumer
-            # wedged since startup — exactly what a lag panel exists to
-            # catch) has no _groups entry yet; seed its assigned partitions
-            # at offset 0 so its lag reads as the full log, like Kafka
-            for g, members in b._members.items():
-                tps = groups.setdefault(g, {})
-                for m in members:
-                    for tp in m._assignment:
-                        tps.setdefault(tp, 0)
+        """Publish per-topic end offsets and per-group backlog (lag) the way
+        a Kafka exporter does — at scrape time, not on the produce path.
+        The snapshot itself is the broker's job (it owns the lock and the
+        data structures); this layer only turns it into gauges."""
+        snap = self.broker.health_snapshot()
+        topics = snap["topics"]
+        groups = snap["groups"]
         for name, ends in topics.items():
             for p, end in enumerate(ends):
                 self._g_end_offset.set(end, labels={"topic": name, "partition": str(p)})

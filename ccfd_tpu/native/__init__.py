@@ -65,6 +65,15 @@ def _load():
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
         ]
+        lib.ccfd_decode_ndarray.restype = ctypes.c_int
+        lib.ccfd_decode_ndarray.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
         lib.ccfd_pad_batch.restype = None
         lib.ccfd_pad_batch.argtypes = [
             ctypes.POINTER(ctypes.c_float),
@@ -137,6 +146,38 @@ def decode_csv(data: bytes, n_features: int = 30) -> tuple[np.ndarray, int]:
         ctypes.byref(bad),
     )
     return out[:rows], int(bad.value)
+
+
+def decode_ndarray_json(
+    body: bytes, n_features: int = 30, max_rows: int = 1 << 16
+) -> np.ndarray | None:
+    """Parse a canonical Seldon predict payload's ``data.ndarray`` matrix
+    (reference request shape README.md:454-459) natively into (B, F)
+    float32. Returns None when the payload needs the Python JSON path — a
+    ``names`` key (column remapping), non-numeric cells, rows wider than
+    the schema, oversize batches, malformed JSON, or no native toolchain.
+    Short rows zero-pad, matching the Python decoder's semantics."""
+    lib = _load()
+    if lib is None or not body:
+        return None
+    # '[' count bounds the row count tightly (outer bracket + one per row),
+    # so the scratch buffer is sized to the request, not the global cap
+    max_rows = min(max_rows, body.count(b"["))
+    if max_rows <= 0:
+        return None
+    out = np.empty((max_rows, n_features), np.float32)
+    width = ctypes.c_int(0)
+    rows = lib.ccfd_decode_ndarray(
+        body,
+        len(body),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_rows,
+        n_features,
+        ctypes.byref(width),
+    )
+    if rows < 0:
+        return None
+    return out[:rows]
 
 
 def frame_records(payloads: list[bytes]) -> bytes:

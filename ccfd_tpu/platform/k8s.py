@@ -56,6 +56,7 @@ def _deployment(
     annotations: Mapping[str, str] | None = None,
     probe_path: str | None = None,
     resources: Mapping[str, Any] | None = None,
+    data_volume: str | None = None,
 ) -> dict[str, Any]:
     container: dict[str, Any] = {
         "name": name,
@@ -78,6 +79,24 @@ def _deployment(
     pod_meta: dict[str, Any] = {"labels": {"app": name}}
     if annotations:
         pod_meta["annotations"] = dict(annotations)
+    pod_spec: dict[str, Any] = {"restartPolicy": "Always", "containers": [container]}
+    if data_volume is not None:
+        # stateful singleton: its log/objects live on a PVC, and two pods
+        # must NEVER serve the one state behind one Service — Recreate
+        # tears the old pod down before the new one starts (a rolling
+        # surge would split-brain the broker/store/engine)
+        container["volumeMounts"] = [{"name": "data", "mountPath": "/data"}]
+        pod_spec["volumes"] = [
+            {"name": "data", "persistentVolumeClaim": {"claimName": data_volume}}
+        ]
+        strategy: dict[str, Any] = {"type": "Recreate"}
+    else:
+        # the reference rolls stateless updates 25%/25%
+        # (reference deploy/router.yaml:11-18)
+        strategy = {
+            "type": "RollingUpdate",
+            "rollingUpdate": {"maxUnavailable": "25%", "maxSurge": "25%"},
+        }
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -85,15 +104,20 @@ def _deployment(
         "spec": {
             "replicas": replicas,
             "selector": {"matchLabels": {"app": name}},
-            # the reference rolls updates 25%/25% (deploy/router.yaml:11-18)
-            "strategy": {
-                "type": "RollingUpdate",
-                "rollingUpdate": {"maxUnavailable": "25%", "maxSurge": "25%"},
-            },
-            "template": {
-                "metadata": pod_meta,
-                "spec": {"restartPolicy": "Always", "containers": [container]},
-            },
+            "strategy": strategy,
+            "template": {"metadata": pod_meta, "spec": pod_spec},
+        },
+    }
+
+
+def _pvc(name: str, size: str = "10Gi") -> dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": size}},
         },
     }
 
@@ -131,6 +155,7 @@ def build_manifests(
     # --- bus (Strimzi Kafka cluster role; reference frauddetection_cr.yaml:73-77)
     parts = int(spec.component("bus").opt("partitions", 3))
     out["bus.yaml"] = [
+        _pvc("bus-data"),
         _deployment(
             "bus",
             command=["python", "-m", "ccfd_tpu", "bus",
@@ -139,6 +164,7 @@ def build_manifests(
             env={},
             port=9092,
             probe_path="/healthz",
+            data_volume="bus-data",
         ),
         _service("bus", 9092),
     ]
@@ -155,11 +181,13 @@ def build_manifests(
                 "type": "Opaque",
                 "stringData": {"accesskey": "ccfd-access", "secretkey": "ccfd-secret"},
             },
+            _pvc("store-data"),
             _deployment(
                 "store",
                 command=["python", "-m", "ccfd_tpu", "store", "serve",
                          "--host", "0.0.0.0", "--port", "9000",
                          "--root", "/data/store"],
+                data_volume="store-data",
                 env={
                     "ACCESS_KEY_ID": {
                         "valueFrom": {"secretKeyRef": {"name": "keysecret", "key": "accesskey"}}
@@ -200,10 +228,13 @@ def build_manifests(
     #     + optional knobs README.md:370-402)
     if spec.component("engine").enabled:
         out["engine.yaml"] = [
+            _pvc("engine-data"),
             _deployment(
                 "engine",
                 command=["python", "-m", "ccfd_tpu", "engine",
-                         "--host", "0.0.0.0", "--port", "8090"],
+                         "--host", "0.0.0.0", "--port", "8090",
+                         "--state-file", "/data/engine-state.json"],
+                data_volume="engine-data",
                 env={
                     "BROKER_URL": bus_url,
                     "CUSTOMER_NOTIFICATION_TOPIC": cfg.customer_notification_topic,

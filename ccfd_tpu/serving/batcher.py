@@ -113,9 +113,15 @@ class DynamicBatcher:
                 return
             size = sum(x.shape[0] for x, _ in batch)
             # company in the queue at grab time = concurrency: keep
-            # collecting to the deadline. Lone request: dispatch now.
+            # collecting toward the deadline. Lone request: dispatch now.
             if len(batch) > 1 and self.deadline_s > 0:
                 deadline = time.perf_counter() + self.deadline_s
+                # grace: how long to wait for the NEXT arrival before
+                # giving up. Waiting out the whole deadline after arrivals
+                # dry up just parks every merged request for the residual —
+                # with a bounded client pool the queue drains in one sweep
+                # and nothing else is coming for a full round trip.
+                grace = self.deadline_s / 8.0
                 with self._cv:
                     while size < self.max_batch and not self._stop:
                         more = self._drain_locked(self.max_batch - size)
@@ -126,9 +132,11 @@ class DynamicBatcher:
                         if self._queue:
                             break  # head doesn't fit: give it its own dispatch
                         remaining = deadline - time.perf_counter()
-                        # wait wakes on submit's notify or the deadline —
-                        # no busy polling on the scoring hot path
-                        if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                        # wait wakes on submit's notify, else the grace
+                        # lapses and the batch goes — no busy polling
+                        if remaining <= 0 or not self._cv.wait(
+                            timeout=min(grace, remaining)
+                        ):
                             break
             self._dispatch(batch)
 

@@ -21,28 +21,34 @@ deploy/model/modelfull-route.yaml:1-12) with one process:
   ``V10`` (reference deploy/grafana/ModelPrediction.json:96-104).
 - ``GET /health/status`` — Seldon-style readiness.
 
-Implementation is a threaded stdlib HTTP server: no web framework is
-needed for a fixed four-route contract, and keeping the handler thin
-matters more for p99 than any framework feature. The GIL is released
-during the XLA dispatch, so scoring threads overlap host work.
+Implementation: a lean socket-level HTTP server (utils/fasthttp.py) —
+no web framework is needed for a fixed four-route contract, and the
+per-request parse cost is most of the REST latency budget once scoring
+is fast. The canonical predict payload's matrix decodes NATIVELY (C++
+strtof into float32, ccfd_tpu/native/decode.cpp) without touching
+json.loads; the Python JSON path remains for names-remapped or unusual
+payloads. The GIL is released during the XLA dispatch, so scoring
+threads overlap host work.
 """
 
 from __future__ import annotations
 
 import json
-import threading
 import time
-from http.server import BaseHTTPRequestHandler
 from typing import Any
-
-from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 import numpy as np
 
 from ccfd_tpu.config import Config
 from ccfd_tpu.data.ccfd import FEATURE_NAMES
 from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.native import decode_ndarray_json as native_decode_ndarray
 from ccfd_tpu.serving.scorer import Scorer
+from ccfd_tpu.utils.fasthttp import FastHTTPServer
+
+_AMOUNT_COL = FEATURE_NAMES.index("Amount")
+_V17_COL = FEATURE_NAMES.index("V17")
+_V10_COL = FEATURE_NAMES.index("V10")
 
 
 class PredictionServer:
@@ -70,7 +76,7 @@ class PredictionServer:
         self._g_amount = r.gauge("Amount", "last scored transaction amount")
         self._g_v17 = r.gauge("V17", "last scored V17")
         self._g_v10 = r.gauge("V10", "last scored V10")
-        self._httpd: FrameworkHTTPServer | None = None
+        self._httpd: FastHTTPServer | None = None
         # dynamic batching (SURVEY.md §7 stage 2: request -> micro-batch
         # queue -> TPU): concurrent requests coalesce into one dispatch;
         # the adaptive policy adds no latency for a lone sequential client
@@ -100,6 +106,30 @@ class PredictionServer:
         )
 
     # -- scoring ----------------------------------------------------------
+    def _score_matrix(self, x: np.ndarray) -> np.ndarray:
+        if self.batcher is not None:
+            proba = self.batcher.score(x)
+        else:
+            proba = self.scorer.score(x)
+        if x.shape[0]:
+            self._g_proba.set(float(proba[-1]))
+            self._g_amount.set(float(x[-1, _AMOUNT_COL]))
+            self._g_v17.set(float(x[-1, _V17_COL]))
+            self._g_v10.set(float(x[-1, _V10_COL]))
+        return np.asarray(proba, np.float64)
+
+    @staticmethod
+    def _response_dict(proba: np.ndarray, model: str) -> dict:
+        return {
+            "data": {
+                "names": ["proba_0", "proba_1"],
+                # one vectorized build + tolist(): ~10x over per-element
+                # float() pairs at typical request sizes
+                "ndarray": np.stack([1.0 - proba, proba], axis=1).tolist(),
+            },
+            "meta": {"model": model},
+        }
+
     def predict_ndarray(self, names: list[str], rows: list[list[float]]) -> dict:
         nf = self.scorer.num_features
         if names and names != list(FEATURE_NAMES):
@@ -123,102 +153,68 @@ class PredictionServer:
                 x = np.zeros((len(rows), nf), np.float32)
                 for i, row in enumerate(rows):
                     x[i, : len(row)] = np.asarray(row, np.float32)[:nf]
-        if self.batcher is not None:
-            proba = self.batcher.score(x)
+        proba = self._score_matrix(x)
+        return self._response_dict(proba, self.scorer.spec.name)
+
+    # -- HTTP plumbing (FastHTTPServer handler contract) -------------------
+    def _json(self, code: int, obj: Any) -> tuple[int, str, bytes]:
+        self._c_requests.inc(labels={"code": str(code)})
+        return code, "application/json", json.dumps(obj).encode()
+
+    def _authorized(self, headers: dict) -> bool:
+        token = self.cfg.seldon_token
+        if not token:
+            return True
+        auth = headers.get(b"authorization", b"").decode("latin-1")
+        return auth == f"Bearer {token}"
+
+    def _http_handler(
+        self, method: str, path: str, headers: dict, body: bytes
+    ) -> tuple[int, str, bytes]:
+        if method == "GET":
+            if path in ("/prometheus", "/metrics"):
+                self._c_requests.inc(labels={"code": "200"})
+                return 200, "text/plain", self.registry.render().encode()
+            if path in ("/health/status", "/health", "/healthz"):
+                return self._json(
+                    200, {"status": "ok", "model": self.scorer.spec.name}
+                )
+            return self._json(404, {"error": "not found"})
+        if method != "POST":
+            return self._json(405, {"error": "method not allowed"})
+
+        t0 = time.perf_counter()
+        if not self._authorized(headers):
+            return self._json(401, {"error": "unauthorized"})
+        path = path.rstrip("/")
+        if not (path.endswith("/predictions") or path == "/predict"):
+            return self._json(404, {"error": "not found"})
+
+        # hot path: the canonical payload's matrix parses natively
+        # (C++ strtof straight into float32, no json.loads); anything
+        # unusual — a names header, ragged rows, no toolchain — falls
+        # back to the Python JSON route below
+        x = native_decode_ndarray(body, self.scorer.num_features)
+        if x is not None:
+            proba = self._score_matrix(x)
+            out = self._response_dict(proba, self.scorer.spec.name)
         else:
-            proba = self.scorer.score(x)
-        if len(rows):
-            self._g_proba.set(float(proba[-1]))
-            self._g_amount.set(float(x[-1, FEATURE_NAMES.index("Amount")]))
-            self._g_v17.set(float(x[-1, FEATURE_NAMES.index("V17")]))
-            self._g_v10.set(float(x[-1, FEATURE_NAMES.index("V10")]))
-        proba = np.asarray(proba, np.float64)
-        return {
-            "data": {
-                "names": ["proba_0", "proba_1"],
-                # one vectorized build + tolist(): ~10x over per-element
-                # float() pairs at typical request sizes
-                "ndarray": np.stack([1.0 - proba, proba], axis=1).tolist(),
-            },
-            "meta": {"model": self.scorer.spec.name},
-        }
-
-    # -- HTTP plumbing ----------------------------------------------------
-    def _handler_class(self):
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):  # quiet
-                pass
-
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                server._c_requests.inc(labels={"code": str(code)})
-
-            def _send_json(self, code: int, obj: Any) -> None:
-                self._send(code, json.dumps(obj).encode(), "application/json")
-
-            def _authorized(self) -> bool:
-                token = server.cfg.seldon_token
-                if not token:
-                    return True
-                auth = self.headers.get("Authorization", "")
-                return auth == f"Bearer {token}"
-
-            def do_GET(self):
-                if self.path in ("/prometheus", "/metrics"):
-                    self._send(200, server.registry.render().encode(), "text/plain")
-                elif self.path in ("/health/status", "/health", "/healthz"):
-                    self._send_json(200, {"status": "ok", "model": server.scorer.spec.name})
-                else:
-                    self._send_json(404, {"error": "not found"})
-
-            def do_POST(self):
-                t0 = time.perf_counter()
-                # Always drain the body first: on HTTP/1.1 keep-alive an
-                # unread body would be parsed as the next request line by the
-                # reused connection (pooled clients hit this on 401/404).
-                try:
-                    length = int(self.headers.get("Content-Length", "0"))
-                except ValueError:
-                    length = 0
-                raw = self.rfile.read(length) if length else b"{}"
-                if not self._authorized():
-                    self._send_json(401, {"error": "unauthorized"})
-                    return
-                try:
-                    payload = json.loads(raw or b"{}")
-                except (ValueError, json.JSONDecodeError):
-                    self._send_json(400, {"error": "malformed JSON body"})
-                    return
-                path = self.path.rstrip("/")
-                if path.endswith("/predictions") or path == "/predict":
-                    data = payload.get("data", {})
-                    rows = data.get("ndarray")
-                    if rows is None or not isinstance(rows, list):
-                        self._send_json(
-                            400, {"error": "missing data.ndarray in request"}
-                        )
-                        return
-                    try:
-                        out = server.predict_ndarray(data.get("names") or [], rows)
-                    except (TypeError, ValueError) as e:
-                        self._send_json(400, {"error": f"bad ndarray: {e}"})
-                        return
-                    server._h_latency.observe(
-                        time.perf_counter() - t0, labels={"endpoint": path}
-                    )
-                    self._send_json(200, out)
-                else:
-                    self._send_json(404, {"error": "not found"})
-
-        return Handler
+            try:
+                payload = json.loads(body or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                return self._json(400, {"error": "malformed JSON body"})
+            data = payload.get("data", {})
+            rows = data.get("ndarray")
+            if rows is None or not isinstance(rows, list):
+                return self._json(400, {"error": "missing data.ndarray in request"})
+            try:
+                out = self.predict_ndarray(data.get("names") or [], rows)
+            except (TypeError, ValueError) as e:
+                return self._json(400, {"error": f"bad ndarray: {e}"})
+        self._h_latency.observe(
+            time.perf_counter() - t0, labels={"endpoint": path}
+        )
+        return self._json(200, out)
 
     def start(self, host: str | None = None, port: int | None = None) -> int:
         """Start serving on a background thread; returns the bound port."""
@@ -228,17 +224,14 @@ class PredictionServer:
             self.batcher = self._make_batcher()
         host = host if host is not None else self.cfg.serve_host
         port = port if port is not None else self.cfg.serve_port
-        self._httpd = FrameworkHTTPServer((host, port), self._handler_class())
-        t = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True, name="ccfd-serving"
-        )
-        t.start()
+        self._httpd = FastHTTPServer(
+            (host, port), self._http_handler, name="ccfd-serving"
+        ).start()
         return self._httpd.server_address[1]
 
     def stop(self) -> None:
         if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+            self._httpd.stop()
             self._httpd = None
         if self.batcher is not None:
             self.batcher.stop()

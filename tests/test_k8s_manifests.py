@@ -141,15 +141,31 @@ def test_k8s_schema_shapes(manifests):
     for fname, docs in manifests.items():
         for d in docs:
             assert d["apiVersion"] in ("apps/v1", "v1")
-            assert d["kind"] in ("Deployment", "Service", "Secret", "ConfigMap")
+            assert d["kind"] in (
+                "Deployment", "Service", "Secret", "ConfigMap",
+                "PersistentVolumeClaim",
+            )
             assert d["metadata"]["name"]
             if d["kind"] == "Deployment":
                 tmpl = d["spec"]["template"]
                 sel = d["spec"]["selector"]["matchLabels"]
                 assert sel == tmpl["metadata"]["labels"]
-                assert d["spec"]["strategy"]["rollingUpdate"] == {
-                    "maxUnavailable": "25%", "maxSurge": "25%",
-                }  # reference deploy/router.yaml:11-18
+                name = d["metadata"]["name"]
+                if name in ("bus", "store", "engine"):
+                    # stateful singletons: a rolling surge would run two
+                    # pods against one state (split-brain); their state
+                    # must outlive the pod on a PVC
+                    assert d["spec"]["strategy"] == {"type": "Recreate"}, name
+                    [vol] = tmpl["spec"]["volumes"]
+                    assert vol["persistentVolumeClaim"]["claimName"].endswith("-data")
+                    [c] = tmpl["spec"]["containers"]
+                    assert c["volumeMounts"] == [
+                        {"name": "data", "mountPath": "/data"}
+                    ]
+                else:
+                    assert d["spec"]["strategy"]["rollingUpdate"] == {
+                        "maxUnavailable": "25%", "maxSurge": "25%",
+                    }  # reference deploy/router.yaml:11-18
                 for c in tmpl["spec"]["containers"]:
                     assert c["command"][0:3] == ["python", "-m", "ccfd_tpu"]
             if d["kind"] == "Service":

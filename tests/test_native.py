@@ -80,3 +80,106 @@ def test_crlf_rows_ok_both_paths():
     x, bad = decode_csv(data, 2)
     assert bad == 0
     np.testing.assert_allclose(x, [[1, 2], [3, 4]])
+
+
+def test_decode_ndarray_json_canonical():
+    from ccfd_tpu.native import decode_ndarray_json, native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    body = b'{"data": {"ndarray": [[1.0, 2.5, -3e2], [4, 5, 6]]}}'
+    x = decode_ndarray_json(body, n_features=3)
+    assert x is not None and x.shape == (2, 3)
+    assert x[0].tolist() == [1.0, 2.5, -300.0]
+    assert x[1].tolist() == [4.0, 5.0, 6.0]
+    # short rows zero-pad to the schema (Python-path semantics)
+    x = decode_ndarray_json(b'{"data":{"ndarray":[[7.0]]}}', n_features=3)
+    assert x.tolist() == [[7.0, 0.0, 0.0]]
+    # whitespace variants parse
+    x = decode_ndarray_json(
+        b'{ "data" : { "ndarray" : [ [ 1 , 2 ] , [ 3 , 4 ] ] } }', n_features=2
+    )
+    assert x.tolist() == [[1.0, 2.0], [3.0, 4.0]]
+    # empty matrix is a valid zero-row decode
+    x = decode_ndarray_json(b'{"data":{"ndarray":[]}}', n_features=3)
+    assert x is not None and x.shape == (0, 3)
+
+
+def test_decode_ndarray_json_bails_to_python_path():
+    from ccfd_tpu.native import decode_ndarray_json, native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    nf = 3
+    # a names key anywhere -> column remapping is the Python path's job
+    assert decode_ndarray_json(
+        b'{"data":{"names":["Amount"],"ndarray":[[1]]}}', nf
+    ) is None
+    # non-numeric cells, rows wider than the schema, malformed JSON, no key
+    assert decode_ndarray_json(b'{"data":{"ndarray":[["x"]]}}', nf) is None
+    assert decode_ndarray_json(b'{"data":{"ndarray":[[1,2,3,4]]}}', nf) is None
+    assert decode_ndarray_json(b'{"data":{"ndarray":[[1,2', nf) is None
+    assert decode_ndarray_json(b'{"data":{}}', nf) is None
+    assert decode_ndarray_json(b"", nf) is None
+
+
+def test_fast_server_http_contract():
+    """FastHTTPServer speaks enough HTTP/1.1 for stdlib clients: keep-alive
+    round trips, explicit close, 400 on garbage."""
+    import http.client
+    import json as _json
+
+    from ccfd_tpu.utils.fasthttp import FastHTTPServer
+
+    def handler(method, path, headers, body):
+        if path == "/echo":
+            return 200, "application/json", _json.dumps(
+                {"method": method, "n": len(body)}
+            ).encode()
+        return 404, "text/plain", b"nope"
+
+    srv = FastHTTPServer(("127.0.0.1", 0), handler).start()
+    try:
+        port = srv.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        for i in range(3):  # same connection: keep-alive works
+            conn.request("POST", "/echo", b"x" * (10 + i))
+            r = conn.getresponse()
+            assert r.status == 200
+            assert _json.loads(r.read()) == {"method": "POST", "n": 10 + i}
+        conn.request("GET", "/missing", headers={"Connection": "close"})
+        r = conn.getresponse()
+        assert r.status == 404 and r.read() == b"nope"
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_decode_ndarray_json_rejects_truncated_and_unwrapped():
+    """Structurally invalid bodies must 400 via the Python path, not score
+    natively (code-review r2 finding)."""
+    from ccfd_tpu.native import decode_ndarray_json, native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    nf = 3
+    # truncated after the matrix: invalid JSON
+    assert decode_ndarray_json(b'{"data":{"ndarray":[[1,2,3]]', nf) is None
+    assert decode_ndarray_json(b'{"data":{"ndarray":[[1,2,3]]}', nf) is None
+    # no "data" wrapper: contract violation the JSON route 400s
+    assert decode_ndarray_json(b'{"ndarray":[[1,2,3]]}', nf) is None
+    # over-closed
+    assert decode_ndarray_json(b'{"data":{"ndarray":[[1]]}}}', nf) is None
+    # trailing keys after the matrix -> python path (it must still 200)
+    assert decode_ndarray_json(
+        b'{"data":{"ndarray":[[1,2,3]]},"meta":{"x":1}}', nf
+    ) is None
+    # but meta BEFORE data still decodes natively
+    x = decode_ndarray_json(b'{"meta":{},"data":{"ndarray":[[1,2,3]]}}', nf)
+    assert x is not None and x.tolist() == [[1.0, 2.0, 3.0]]
