@@ -1,6 +1,6 @@
 """Benchmark: transaction-scoring throughput + latency, end to end.
 
-Four timed surfaces, matching the hops the reference instruments on its
+Six timed surfaces, matching the hops the reference instruments on its
 SeldonCore/Router dashboards (SURVEY.md §3 stack A, §6):
 
 1. **Scorer hop** — host feature matrix -> bucketed jit dispatch
@@ -16,6 +16,10 @@ SeldonCore/Router dashboards (SURVEY.md §3 stack A, §6):
 4. **Pipeline loop** — producer -> bus -> router (micro-batch + rules) ->
    engine (batched process starts) sustained tx/s with the real fraud
    process at a realistic fired mix.
+5. **Mesh scoring** — batch sharded over the data axis of a device mesh
+   (runs when >1 device is visible; SURVEY.md §7 stage 6).
+6. **Online retrain** — SGD steps/s and labels/s for the loop the engine's
+   label topic feeds (BASELINE.json configs[4]); sharded when >1 device.
 
 Prints ONE JSON line; primary fields:
   {"metric": ..., "value": tx/s, "unit": "tx/s", "vs_baseline": ratio,
@@ -43,7 +47,8 @@ CCFD_BENCH_LATENCY_BATCH (default 4096), CCFD_BENCH_PLATFORM=cpu to force
 CPU, CCFD_BENCH_PROBE_S (per-attempt probe timeout, default 90),
 CCFD_BENCH_PROBE_ATTEMPTS (default 3), CCFD_BENCH_PROBE_BACKOFF_S (default
 30), CCFD_BENCH_REST_CLIENTS (default 8), CCFD_BENCH_REST_ROWS (rows per
-request, default 16), CCFD_BENCH_SKIP=rest,pipeline,ab to skip sections.
+request, default 16), CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain to
+skip sections.
 """
 
 from __future__ import annotations
@@ -251,6 +256,79 @@ def _bench_pipeline(scorer_params, seconds):
     }
 
 
+def _bench_mesh(params, batch, seconds, depth):
+    """Mesh-sharded scoring over every available device (SURVEY.md §7
+    stage 6): the batch splits over the data axis, params replicated. Runs
+    when >1 device is visible (or a virtual CPU mesh is forced)."""
+    import jax
+
+    from ccfd_tpu.parallel.mesh import make_mesh
+    from ccfd_tpu.serving.scorer import Scorer
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return None
+    mesh = make_mesh(model_parallel=1)
+    scorer = Scorer(
+        model_name="mlp", params=params, batch_sizes=(batch,),
+        compute_dtype="bfloat16", mesh=mesh, use_fused=False,
+    )
+    scorer.warmup()
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+
+    x = synthetic_dataset(n=batch, fraud_rate=0.01, seed=2).X
+    n_rows = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        scorer.score_pipelined(x, depth=depth)
+        n_rows += batch
+    return {"devices": n_dev, "tx_s": round(n_rows / (time.perf_counter() - t0), 1)}
+
+
+def _bench_retrain(seconds):
+    """Online-retrain throughput (BASELINE.json configs[4]): labels -> one
+    SGD step per batch, the loop the engine's label topic feeds — sharded
+    over a data mesh when more than one device is visible, single-device
+    otherwise (the ``devices`` field records which)."""
+    import jax
+    import numpy as np
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import mlp
+    from ccfd_tpu.parallel.train import TrainConfig, init_state, make_train_step
+
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1:
+        from ccfd_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(model_parallel=1)
+    ds = synthetic_dataset(n=4096, fraud_rate=0.2, seed=3)
+    tc = TrainConfig(compute_dtype="bfloat16")
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    state = init_state(params, tc)
+    step = make_train_step(tc, mesh=mesh)
+    x = ds.X[:1024]
+    y = ds.y[:1024].astype(np.float32)
+    state, loss = step(state, x, y)  # compile
+    jax.block_until_ready(loss)
+    steps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        state, loss = step(state, x, y)
+        steps += 1
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    return {
+        "steps_s": round(steps / elapsed, 1),
+        "labels_s": round(steps * 1024 / elapsed, 1),
+        "batch": 1024,
+        "devices": n_dev,
+        "final_loss": round(float(loss), 4),
+    }
+
+
 def main() -> None:
     platform_forced = os.environ.get("CCFD_BENCH_PLATFORM", "")
     fellback = False
@@ -337,6 +415,16 @@ def main() -> None:
     if "pipeline" not in skip:
         pipeline = _bench_pipeline(pipe_params, max(2.0, seconds))
 
+    mesh_res = None
+    if "mesh" not in skip:
+        mesh_res = _bench_mesh(
+            params, min(batch, 65536), max(1.0, seconds / 2), depth
+        )
+
+    retrain_res = None
+    if "retrain" not in skip:
+        retrain_res = _bench_retrain(max(1.0, seconds / 2))
+
     # the e2e p99 the north star talks about is the REST predict hop when
     # measured; the raw scorer-hop p99 otherwise (also when the REST
     # section errored — its numbers are then absent, not zero)
@@ -361,6 +449,10 @@ def main() -> None:
         result["rest"] = rest
     if pipeline is not None:
         result["pipeline"] = pipeline
+    if mesh_res is not None:
+        result["mesh"] = mesh_res
+    if retrain_res is not None:
+        result["retrain"] = retrain_res
 
     if on_tpu:
         # cache this as the round's last-good TPU number: later fallback

@@ -183,3 +183,82 @@ def test_decode_ndarray_json_rejects_truncated_and_unwrapped():
     # but meta BEFORE data still decodes natively
     x = decode_ndarray_json(b'{"meta":{},"data":{"ndarray":[[1,2,3]]}}', nf)
     assert x is not None and x.tolist() == [[1.0, 2.0, 3.0]]
+
+
+def test_fast_server_pipelined_and_split_requests():
+    """Two requests arriving in one TCP segment, and a body split across
+    segments, both parse correctly off the connection buffer."""
+    import json as _json
+    import socket
+    import time
+
+    from ccfd_tpu.utils.fasthttp import FastHTTPServer
+
+    def handler(method, path, headers, body):
+        return 200, "application/json", _json.dumps({"n": len(body)}).encode()
+
+    srv = FastHTTPServer(("127.0.0.1", 0), handler).start()
+    try:
+        port = srv.server_address[1]
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        # two complete requests in ONE send
+        req = b"POST /a HTTP/1.1\r\nContent-Length: 3\r\n\r\nabc"
+        s.sendall(req + req)
+        buf = b""
+        deadline = time.time() + 5
+        while buf.count(b'{"n": 3}') < 2 and time.time() < deadline:
+            buf += s.recv(4096)
+        assert buf.count(b'{"n": 3}') == 2, buf
+        # body split across two sends (flush forced by a second sendall)
+        s.sendall(b"POST /b HTTP/1.1\r\nContent-Length: 10\r\n\r\n12345")
+        time.sleep(0.05)
+        s.sendall(b"67890")
+        buf = b""
+        deadline = time.time() + 5  # fresh budget for this sub-case
+        while b'{"n": 10}' not in buf and time.time() < deadline:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        assert b'{"n": 10}' in buf
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_fast_server_rejects_oversize_head_and_bad_length():
+    import socket
+    import time
+
+    from ccfd_tpu.utils.fasthttp import FastHTTPServer
+
+    srv = FastHTTPServer(
+        ("127.0.0.1", 0), lambda m, p, h, b: (200, "text/plain", b"ok")
+    ).start()
+    try:
+        port = srv.server_address[1]
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(b"POST / HTTP/1.1\r\nContent-Length: zebra\r\n\r\n")
+        buf = b""
+        deadline = time.time() + 5
+        while b"400" not in buf and time.time() < deadline:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        assert b"400" in buf
+        s.close()
+        # oversize head: server answers 400 and closes instead of buffering
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(b"POST / HTTP/1.1\r\nX-Junk: " + b"a" * (70 * 1024))
+        buf = b""
+        deadline = time.time() + 5
+        while b"400" not in buf and time.time() < deadline:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        assert b"400" in buf
+        s.close()
+    finally:
+        srv.stop()
