@@ -281,7 +281,11 @@ class DriftMonitor:
         # seconds; built on the supervised thread, not platform bring-up
         self._reference_builder = reference_builder
         self.window = int(window)
-        self._consumer = broker.consumer("ccfd-analytics", (cfg.kafka_topic,))
+        self._broker = broker
+        self._group = "ccfd-analytics"
+        self._topic = cfg.kafka_topic
+        self._consumer = broker.consumer(self._group, (self._topic,))
+        self._consumer_closed = False
         self._buf: list[np.ndarray] = []
         self._buffered = 0
         self._stop = threading.Event()
@@ -323,6 +327,16 @@ class DriftMonitor:
                 self._g_max.set(float(scores.max()))
         return int(rows.shape[0])
 
+    def reset(self) -> None:
+        """Re-arm after stop(); called by the supervisor before respawn.
+        stop() closed the consumer (to unblock a blocking poll), so
+        re-subscribe here — the group's committed offsets make the new
+        consumer resume where the old one left off."""
+        self._stop.clear()
+        if self._consumer_closed:
+            self._consumer = self._broker.consumer(self._group, (self._topic,))
+            self._consumer_closed = False
+
     def run(self, interval_s: float = 0.25) -> None:
         while not self._stop.is_set():
             if self.step(poll_timeout_s=interval_s) == 0:
@@ -331,3 +345,4 @@ class DriftMonitor:
     def stop(self) -> None:
         self._stop.set()
         self._consumer.close()
+        self._consumer_closed = True

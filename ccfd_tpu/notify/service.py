@@ -70,6 +70,11 @@ class NotificationService:
             )
         return len(records)
 
+    def reset(self) -> None:
+        """Re-arm after stop(); called by the supervisor before respawn
+        (clearing inside run() would race a concurrent stop())."""
+        self._stop.clear()
+
     def run(self, poll_timeout_s: float = 0.05) -> None:
         while not self._stop.is_set():
             self.step(poll_timeout_s=poll_timeout_s)

@@ -133,12 +133,18 @@ class OnlineTrainer:
         return True
 
     # -- daemon ------------------------------------------------------------
+    def reset(self) -> None:
+        """Re-arm after stop(); called by the supervisor before respawn
+        (clearing inside run() would race a concurrent stop())."""
+        self._stop.clear()
+
     def run(self, interval_s: float = 1.0) -> None:
         while not self._stop.is_set():
             if not self.step():
                 self._stop.wait(interval_s)
 
     def start(self, interval_s: float = 1.0) -> threading.Thread:
+        self.reset()
         t = threading.Thread(
             target=self.run, args=(interval_s,), daemon=True, name="ccfd-retrain"
         )

@@ -45,6 +45,7 @@ _COMPONENTS = (
                   # reference frauddetection_cr.yaml:7-53)
     "monitoring", # Prometheus exporter (L7)
     "health",     # runtime probes (platform)
+    "chaos",      # seeded fault injection (new; no reference analog)
 )
 
 
@@ -65,7 +66,14 @@ class PlatformSpec:
             if isinstance(block, bool):
                 block = {"enabled": block}
             comps[name] = ComponentSpec(
-                enabled=bool(block.get("enabled", name not in ("producer", "store"))),
+                # absent blocks default on, EXCEPT: producer/store (traffic
+                # and data sources are explicit choices) and chaos (fault
+                # injection must always be opt-in)
+                enabled=bool(
+                    block.get(
+                        "enabled", name not in ("producer", "store", "chaos")
+                    )
+                ),
                 options={k: v for k, v in block.items() if k != "enabled"},
             )
         return PlatformSpec(components=comps, cfg=cfg or Config.from_env())
@@ -101,6 +109,7 @@ class Platform:
         self.prediction_port = 0
         self.exporter = None
         self.health_server = None
+        self.chaos = None
         self._producer_done = threading.Event()
         self._up = False
 
@@ -195,6 +204,22 @@ class Platform:
         # 9. producer last (README.md:461-485) — starts the traffic
         if spec.component("producer").enabled:
             self._up_producer()
+
+        # 10. chaos (opt-in; no reference analog): seeded fault injection
+        # over the supervised services, so recovery machinery is exercised
+        # continuously instead of trusted
+        if spec.component("chaos").enabled:
+            from ccfd_tpu.runtime.chaos import ChaosMonkey
+
+            c = spec.component("chaos")
+            targets = c.opt("targets", None)
+            self.chaos = ChaosMonkey(
+                self.supervisor,
+                interval_s=float(c.opt("interval_s", 30.0)),
+                seed=int(c.opt("seed", 0)),
+                targets=list(targets) if targets else None,
+                registry=self._registry("chaos"),
+            ).start()
 
         self._up = True
         return self
@@ -324,7 +349,7 @@ class Platform:
 
             self.supervisor.add_thread_service(
                 "engine-persist", checkpoint_loop, stop.set,
-                policy=RestartPolicy.ALWAYS,
+                policy=RestartPolicy.ALWAYS, reset=stop.clear,
             )
         if c.opt("rest", False):
             # KIE-shaped REST surface (reference :8090, README.md:509-515).
@@ -352,6 +377,7 @@ class Platform:
             lambda: notify.run(poll_timeout_s=0.02),
             notify.stop,
             policy=RestartPolicy.ALWAYS,
+            reset=notify.reset,
         )
 
     def _up_router(self) -> None:
@@ -382,6 +408,7 @@ class Platform:
             lambda: router.run(poll_timeout_s=0.02),
             router.stop,
             policy=RestartPolicy.ALWAYS,
+            reset=router.reset,
         )
 
     def _up_retrain(self) -> None:
@@ -399,6 +426,7 @@ class Platform:
             lambda: trainer.run(interval_s=interval),
             trainer.stop,
             policy=RestartPolicy.ALWAYS,
+            reset=trainer.reset,
         )
 
     def _up_analytics(self) -> None:
@@ -434,6 +462,7 @@ class Platform:
             lambda: monitor.run(interval_s=interval),
             monitor.stop,
             policy=RestartPolicy.ALWAYS,
+            reset=monitor.reset,
         )
 
     def _up_producer(self) -> None:
@@ -504,6 +533,10 @@ class Platform:
                 )
 
     def down(self) -> None:
+        # chaos first: injecting failures into services that are being torn
+        # down would race the orderly shutdown
+        if self.chaos is not None:
+            self.chaos.stop()
         if self.supervisor:
             self.supervisor.stop()
         if self.engine is not None and (

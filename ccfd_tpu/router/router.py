@@ -363,6 +363,12 @@ class Router:
         return len(txs)
 
     # -- daemon loop -------------------------------------------------------
+    def reset(self) -> None:
+        """Re-arm after stop() so the next run() actually loops. Called by
+        the supervisor before each respawn (NOT inside run(): clearing on
+        the service thread would race a concurrent stop() and erase it)."""
+        self._stop.clear()
+
     def run(self, poll_timeout_s: float = 0.05, pipeline: bool = True) -> None:
         if pipeline:
             self._run_pipelined(poll_timeout_s)
@@ -433,9 +439,8 @@ class Router:
     def start(
         self, poll_timeout_s: float = 0.05, pipeline: bool = True
     ) -> threading.Thread:
-        # a stopped router restarts cleanly (supervisor restart, tests):
-        # the loop exits on stop() via the event, so re-arm it here
-        self._stop.clear()
+        # direct (unsupervised) start: re-arm here, before the thread exists
+        self.reset()
         t = threading.Thread(
             target=self.run, args=(poll_timeout_s, pipeline),
             daemon=True, name="ccfd-router",

@@ -48,6 +48,11 @@ class ManagedService:
     ready: Callable[[], bool] = lambda: True
     policy: RestartPolicy = RestartPolicy.ALWAYS
     max_restarts: int | None = None  # None = unbounded (k8s semantics)
+    # called by the supervisor BEFORE each (re)spawn, on the supervisor's
+    # thread under its lock — the place to clear a stop flag so a restart
+    # doesn't exit instantly. Services must NOT clear their own stop flag
+    # inside run(): that races a concurrent stop() and can erase it.
+    reset: Callable[[], None] = lambda: None
 
     # runtime state (managed by Supervisor)
     state: ServiceState = ServiceState.PENDING
@@ -57,6 +62,7 @@ class ManagedService:
     _next_start: float = 0.0
     _streak: int = 0  # consecutive crashes since last stable run (backoff input)
     _started_at: float = 0.0
+    _chaos: str = ""  # non-empty: a clean exit counts as an injected FAILURE
 
 
 class Supervisor:
@@ -101,11 +107,12 @@ class Supervisor:
         ready: Callable[[], bool] = lambda: True,
         policy: RestartPolicy = RestartPolicy.ALWAYS,
         max_restarts: int | None = None,
+        reset: Callable[[], None] = lambda: None,
     ) -> ManagedService:
         return self.add(
             ManagedService(
                 name=name, run=run, stop=stop, ready=ready,
-                policy=policy, max_restarts=max_restarts,
+                policy=policy, max_restarts=max_restarts, reset=reset,
             )
         )
 
@@ -118,11 +125,27 @@ class Supervisor:
                 with self._lock:
                     svc.last_error = f"{type(e).__name__}: {e}"
                     svc.state = ServiceState.FAILED
+                    svc._chaos = ""
             else:
                 with self._lock:
-                    if svc.state == ServiceState.RUNNING:
+                    if svc._chaos:
+                        # injected failure: the service was stopped BY the
+                        # chaos surface, so its clean return is a simulated
+                        # crash — FAILED engages ON_FAILURE restart policies
+                        svc.last_error = f"injected: {svc._chaos}"
+                        svc.state = ServiceState.FAILED
+                        svc._chaos = ""
+                    elif svc.state == ServiceState.RUNNING:
                         svc.state = ServiceState.SUCCEEDED
 
+        try:
+            svc.reset()  # re-arm stop flags BEFORE the thread exists: a
+            # stop()/inject_failure arriving after this point is honored
+            # because nothing clears the flag once the thread runs
+        except Exception as e:  # noqa: BLE001 - a broken reset is a crash
+            svc.last_error = f"reset failed: {type(e).__name__}: {e}"
+            svc.state = ServiceState.FAILED
+            return
         t = threading.Thread(target=runner, daemon=True, name=f"svc-{svc.name}")
         svc._thread = t
         svc.state = ServiceState.RUNNING
@@ -196,6 +219,26 @@ class Supervisor:
             with self._lock:
                 svc.state = ServiceState.STOPPED
 
+    # --- failure injection ------------------------------------------------
+    def inject_failure(self, name: str, reason: str = "chaos") -> bool:
+        """Force-crash a RUNNING service: its loop is stopped and the exit
+        recorded as FAILED (so ON_FAILURE policies restart too), then the
+        normal crash-loop/backoff machinery takes over. This is the fault-
+        injection surface the reference platform lacks entirely (SURVEY.md
+        §5 'Failure detection: k8s-level only') — recovery behavior becomes
+        testable instead of theoretical. Returns False if the service isn't
+        currently RUNNING."""
+        with self._lock:
+            svc = self._services.get(name)
+            if svc is None or svc.state != ServiceState.RUNNING:
+                return False
+            svc._chaos = reason
+        try:
+            svc.stop()
+        except Exception:  # noqa: BLE001 - a broken stop() is itself a crash
+            pass
+        return True
+
     # --- probes ----------------------------------------------------------
     def status(self) -> dict[str, dict]:
         with self._lock:
@@ -205,6 +248,7 @@ class Supervisor:
                     "restarts": svc.restarts,
                     "ready": self._ready_of(svc),
                     "last_error": svc.last_error,
+                    "policy": svc.policy.value,
                 }
                 for name, svc in self._services.items()
             }
