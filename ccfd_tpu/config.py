@@ -85,6 +85,7 @@ class Config:
     batch_deadline_ms: float = 2.0
     batch_workers: int = 4  # overlapped dispatches (device-RTT pipelining)
     dynamic_batching: bool = True  # serving-side request coalescing
+    native_front: bool = True  # C++ HTTP front when the toolchain allows
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
 
@@ -147,6 +148,8 @@ class Config:
                 e.get("CCFD_BATCH_WORKERS", str(Config.batch_workers))
             ),
             dynamic_batching=e.get("CCFD_DYNAMIC_BATCHING", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            native_front=e.get("CCFD_NATIVE_FRONT", "1").strip().lower()
             not in ("0", "false", "no", "off"),
             serve_host=e.get("CCFD_SERVE_HOST", Config.serve_host),
             serve_port=int(e.get("CCFD_SERVE_PORT", str(Config.serve_port))),

@@ -21,7 +21,11 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRCS = [os.path.join(_HERE, "decode.cpp"), os.path.join(_HERE, "log.cpp")]
+_SRCS = [
+    os.path.join(_HERE, "decode.cpp"),
+    os.path.join(_HERE, "log.cpp"),
+    os.path.join(_HERE, "httpfront.cpp"),
+]
 _SO = os.path.join(_HERE, "_ccfd_native.so")
 
 _lib = None
@@ -36,7 +40,8 @@ def _build() -> str | None:
         return _SO
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", *_SRCS, "-o", _SO],
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
+             *_SRCS, "-o", _SO],
             check=True,
             capture_output=True,
             timeout=120,
@@ -82,6 +87,44 @@ def _load():
             ctypes.POINTER(ctypes.c_float),
             ctypes.c_int,
         ]
+        lib.ccfd_front_create.restype = ctypes.c_void_p
+        lib.ccfd_front_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ccfd_front_take.restype = ctypes.c_int
+        lib.ccfd_front_take.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.ccfd_front_respond.restype = None
+        lib.ccfd_front_respond.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_char_p,
+        ]
+        lib.ccfd_front_take_misc.restype = ctypes.c_int
+        lib.ccfd_front_take_misc.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        lib.ccfd_front_free.restype = None
+        lib.ccfd_front_free.argtypes = [ctypes.c_void_p]
+        lib.ccfd_front_respond_misc.restype = None
+        lib.ccfd_front_respond_misc.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.ccfd_front_stats.restype = None
+        lib.ccfd_front_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)
+        ]
+        lib.ccfd_front_stop.restype = None
+        lib.ccfd_front_stop.argtypes = [ctypes.c_void_p]
+        lib.ccfd_front_destroy.restype = None
+        lib.ccfd_front_destroy.argtypes = [ctypes.c_void_p]
         lib.ccfd_log_frame.restype = ctypes.c_size_t
         lib.ccfd_log_frame.argtypes = [
             ctypes.c_char_p,

@@ -74,13 +74,16 @@ class ChaosMonkey:
         return name
 
     def run(self) -> None:
-        self._stop.clear()
         while not self._stop.is_set():
             if self._stop.wait(self.interval_s):
                 return
             self.kill_one()
 
     def start(self) -> "ChaosMonkey":
+        # re-arm BEFORE the thread exists: clearing inside run() would
+        # race a stop() issued right after start() and erase it — the
+        # same rule ManagedService.reset codifies for supervised services
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self.run, daemon=True, name="ccfd-chaos"
         )

@@ -1,0 +1,209 @@
+"""Python half of the native HTTP serving front (native/httpfront.cpp).
+
+The C++ side owns sockets, HTTP parsing, auth, canonical-payload decode,
+and response formatting; this module runs the only parts that need
+Python — scoring and the rare non-canonical routes:
+
+- N scorer threads: ``ccfd_front_take`` hands over MANY requests as ONE
+  concatenated float32 row block (the C++ queue IS the dynamic batcher);
+  one ``scorer.score`` per block; ``ccfd_front_respond`` fans results
+  back out per request. N > 1 overlaps device round trips exactly like
+  DynamicBatcher's workers.
+- one misc thread: GET /prometheus, health, and payloads the native
+  decoder bailed on (names remapping, ragged rows, bad JSON) flow
+  through the SAME ``PredictionServer._http_handler`` routing as the
+  pure-Python server — identical contract, different fast path.
+
+Metrics parity with serving/server.py: per-request latency lands in the
+seldon histogram using the C++ enqueue timestamp (CLOCK_MONOTONIC, the
+same clock as time.monotonic), request counters by code, and the
+ModelPrediction gauges from the last scored row. C++-side 401s are
+reconciled into the counter at scrape time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+import numpy as np
+
+from ccfd_tpu.native import _load
+
+
+class NativeFront:
+    def __init__(
+        self,
+        server,  # PredictionServer (duck-typed: scorer, cfg, registry, ...)
+        max_batch_rows: int = 16384,
+        max_reqs_per_take: int = 1024,
+    ):
+        self._server = server
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native toolchain unavailable")
+        self._handle = None
+        self._threads: list[threading.Thread] = []
+        self._stopping = threading.Event()
+        self._max_rows = max_batch_rows
+        self._max_reqs = max_reqs_per_take
+        self._auth_fail_synced = 0
+        self.server_address = ("0.0.0.0", 0)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, port: int = 0, host: str = "0.0.0.0") -> int:
+        srv = self._server
+        port_out = ctypes.c_int(0)
+        handle = self._lib.ccfd_front_create(
+            (host or "0.0.0.0").encode(),
+            int(port),
+            srv.scorer.num_features,
+            (srv.cfg.seldon_token or "").encode(),
+            ctypes.byref(port_out),
+        )
+        if not handle:
+            raise OSError(f"native front failed to bind {host}:{port}")
+        self._handle = handle
+        self.server_address = (host or "0.0.0.0", int(port_out.value))
+        workers = max(1, getattr(srv.cfg, "batch_workers", 2))
+        for i in range(workers):
+            t = threading.Thread(
+                target=self._score_loop, daemon=True, name=f"ccfd-front-score-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(
+            target=self._misc_loop, daemon=True, name="ccfd-front-misc"
+        )
+        t.start()
+        self._threads.append(t)
+        return int(port_out.value)
+
+    def stop(self) -> None:
+        if self._handle is None:
+            return
+        self._stopping.set()
+        # stop: wakes takers (-1) + joins the C++ IO thread; the handle
+        # stays VALID until every Python worker that may be inside
+        # take()/take_misc() has joined — only then destroy frees it
+        self._lib.ccfd_front_stop(self._handle)
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        self._lib.ccfd_front_destroy(self._handle)
+        self._handle = None
+
+    # -- predict hot path --------------------------------------------------
+    def _score_loop(self) -> None:
+        srv = self._server
+        nf = srv.scorer.num_features
+        rows_buf = np.empty((self._max_rows, nf), np.float32)
+        rows_ptr = rows_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        meta = (ctypes.c_int * (3 * self._max_reqs))()
+        enq = (ctypes.c_double * self._max_reqs)()
+        model = srv.scorer.spec.name.encode()
+        while not self._stopping.is_set():
+            handle = self._handle
+            if handle is None:
+                return
+            n_reqs = self._lib.ccfd_front_take(
+                handle, rows_ptr, self._max_rows, meta, enq, self._max_reqs, 200
+            )
+            if n_reqs <= 0:
+                if n_reqs < 0:
+                    return  # stopping
+                continue
+            ids = (ctypes.c_int * n_reqs)()
+            counts = (ctypes.c_int * n_reqs)()
+            tags = [0] * n_reqs
+            total = 0
+            for i in range(n_reqs):
+                ids[i] = meta[3 * i]
+                counts[i] = meta[3 * i + 1]
+                tags[i] = meta[3 * i + 2]
+                total += meta[3 * i + 1]
+            x = rows_buf[:total]
+            try:
+                proba = np.ascontiguousarray(
+                    np.asarray(srv.scorer.score(x)), np.float32
+                )
+            except Exception:  # noqa: BLE001 - fail the requests, not the loop
+                err = b'{"error": "scoring failed"}'
+                for i in range(n_reqs):
+                    self._lib.ccfd_front_respond_misc(
+                        handle, ids[i], 500, b"application/json", err, len(err)
+                    )
+                    srv._c_requests.inc(labels={"code": "500"})
+                continue
+            self._lib.ccfd_front_respond(
+                handle, ids, counts, n_reqs,
+                proba.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), model,
+            )
+            # metrics parity with the Python server path (same endpoint
+            # labels the Python transport records)
+            now_ms = time.monotonic() * 1e3
+            for i in range(n_reqs):
+                srv._h_latency.observe(
+                    max(0.0, (now_ms - enq[i]) / 1e3),
+                    labels={"endpoint": "/predict" if tags[i]
+                            else "/api/v0.1/predictions"},
+                )
+            srv._c_requests.inc(n_reqs, labels={"code": "200"})
+            if total:
+                srv._g_proba.set(float(proba[total - 1]))
+                from ccfd_tpu.serving.server import _AMOUNT_COL, _V10_COL, _V17_COL
+
+                srv._g_amount.set(float(x[total - 1, _AMOUNT_COL]))
+                srv._g_v17.set(float(x[total - 1, _V17_COL]))
+                srv._g_v10.set(float(x[total - 1, _V10_COL]))
+
+    # -- everything else ---------------------------------------------------
+    def _misc_loop(self) -> None:
+        srv = self._server
+        method_buf = ctypes.create_string_buffer(16)
+        path_buf = ctypes.create_string_buffer(512)
+        body_ptr = ctypes.c_void_p()
+        body_len = ctypes.c_int(0)
+        # C++ validated the bearer token before queueing, but it does not
+        # forward headers; re-synthesize the authorization the Python
+        # routing re-checks so valid requests don't double-401
+        auth_hdr = {}
+        if srv.cfg.seldon_token:
+            auth_hdr = {b"authorization": f"Bearer {srv.cfg.seldon_token}".encode()}
+        while not self._stopping.is_set():
+            handle = self._handle
+            if handle is None:
+                return
+            req_id = self._lib.ccfd_front_take_misc(
+                handle, method_buf, 16, path_buf, 512,
+                ctypes.byref(body_ptr), ctypes.byref(body_len), 200,
+            )
+            if req_id < 0:
+                return
+            if req_id == 0:
+                continue
+            body = ctypes.string_at(body_ptr, body_len.value)
+            self._lib.ccfd_front_free(body_ptr)
+            method = method_buf.value.decode("latin-1")
+            path = path_buf.value.decode("latin-1")
+            if path in ("/prometheus", "/metrics"):
+                self._sync_native_counters(handle)
+            try:
+                status, ctype, resp = srv._http_handler(
+                    method, path, auth_hdr, body
+                )
+            except Exception:  # noqa: BLE001
+                status, ctype, resp = 500, "text/plain", b"internal error"
+            self._lib.ccfd_front_respond_misc(
+                handle, req_id, status, ctype.encode(), resp, len(resp)
+            )
+
+    def _sync_native_counters(self, handle) -> None:
+        """Fold C++-side 401 counts into the registry before a scrape."""
+        stats = (ctypes.c_long * 4)()
+        self._lib.ccfd_front_stats(handle, stats)
+        delta = int(stats[3]) - self._auth_fail_synced
+        if delta > 0:
+            self._server._c_requests.inc(delta, labels={"code": "401"})
+            self._auth_fail_synced += delta

@@ -217,13 +217,30 @@ class PredictionServer:
         return self._json(200, out)
 
     def start(self, host: str | None = None, port: int | None = None) -> int:
-        """Start serving on a background thread; returns the bound port."""
+        """Start serving on a background thread; returns the bound port.
+
+        Transport selection: the C++ front (native/httpfront.cpp — epoll
+        parsing + native payload decode + native response format; Python
+        only scores batches) when the toolchain allows and
+        ``cfg.native_front`` is on; the lean Python server otherwise.
+        Same contract either way.
+        """
         if self.cfg.dynamic_batching and self.batcher is None:
             # stop() tears the batcher down; a restarted server needs a
             # fresh one or every predict would fail on the stopped worker
             self.batcher = self._make_batcher()
         host = host if host is not None else self.cfg.serve_host
         port = port if port is not None else self.cfg.serve_port
+        if self.cfg.native_front:
+            try:
+                from ccfd_tpu.serving.native_front import NativeFront
+
+                front = NativeFront(self)
+                bound = front.start(port, host=host)
+                self._httpd = front
+                return bound
+            except (RuntimeError, OSError):
+                pass  # no toolchain / bind conflict: Python transport below
         self._httpd = FastHTTPServer(
             (host, port), self._http_handler, name="ccfd-serving"
         ).start()

@@ -1,0 +1,171 @@
+"""Native C++ HTTP front: transport selection, contract parity, teardown.
+
+The serving endpoint has two transports — the C++ epoll front
+(native/httpfront.cpp + serving/native_front.py) and the lean Python
+server (utils/fasthttp.py). The Seldon contract must be identical through
+both; these tests pin selection, parity, and the native-specific paths
+(C++-side auth, misc fallthrough, connection-close accounting).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import synthetic_dataset
+from ccfd_tpu.models import mlp
+from ccfd_tpu.native import native_available
+from ccfd_tpu.serving.scorer import Scorer
+from ccfd_tpu.serving.server import PredictionServer
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no native toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def scorer():
+    ds = synthetic_dataset(n=512, fraud_rate=0.05, seed=0)
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    s = Scorer(model_name="mlp", params=params, batch_sizes=(16, 128),
+               compute_dtype="bfloat16")
+    s.warmup()
+    return s
+
+
+def _post(port, path, payload, token=None, raw=None):
+    hdr = {"Content-Type": "application/json"}
+    if token:
+        hdr["Authorization"] = f"Bearer {token}"
+    body = raw if raw is not None else json.dumps(payload).encode()
+    try:
+        r = urllib.request.urlopen(
+            urllib.request.Request(f"http://127.0.0.1:{port}{path}", body, hdr),
+            timeout=10,
+        )
+        return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_native_front_selected_and_python_fallback(scorer):
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        assert type(srv._httpd).__name__ == "NativeFront"
+    finally:
+        srv.stop()
+    srv = PredictionServer(scorer, Config(native_front=False))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        assert type(srv._httpd).__name__ == "FastHTTPServer"
+        code, out = _post(port, "/predict", {"data": {"ndarray": [[0.0] * 30]}})
+        assert code == 200 and len(out["data"]["ndarray"]) == 1
+    finally:
+        srv.stop()
+
+
+def test_transport_parity_same_probabilities(scorer):
+    """Identical rows through both transports give identical probabilities
+    and the same response shape."""
+    rows = synthetic_dataset(n=8, fraud_rate=0.5, seed=3).X.tolist()
+    results = {}
+    for native in (True, False):
+        srv = PredictionServer(scorer, Config(native_front=native))
+        port = srv.start("127.0.0.1", 0)
+        try:
+            code, out = _post(port, "/api/v0.1/predictions",
+                              {"data": {"ndarray": rows}})
+            assert code == 200
+            assert out["data"]["names"] == ["proba_0", "proba_1"]
+            assert out["meta"]["model"] == "mlp"
+            results[native] = [r[1] for r in out["data"]["ndarray"]]
+            for p0, p1 in out["data"]["ndarray"]:
+                assert abs(p0 + p1 - 1.0) < 1e-9
+        finally:
+            srv.stop()
+    assert np.allclose(results[True], results[False], atol=1e-6)
+
+
+def test_native_auth_401_and_counter_reconciliation(scorer):
+    srv = PredictionServer(scorer, Config(native_front=True, seldon_token="tk"))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        code, _ = _post(port, "/predict", {"data": {"ndarray": [[0.0] * 30]}})
+        assert code == 401  # C++-side bearer check
+        code, out = _post(port, "/predict", {"data": {"ndarray": [[0.0] * 30]}},
+                          token="tk")
+        assert code == 200
+        # names-remapped payload exercises the misc path WITH auth: the
+        # synthesized header must not double-401 a C++-validated request
+        code, out = _post(
+            port, "/predict",
+            {"data": {"names": ["Amount"], "ndarray": [[5.0]]}}, token="tk",
+        )
+        assert code == 200
+        prom = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/prometheus", timeout=10
+        ).read().decode()
+        assert 'code="401"' in prom  # C++ 401s reconciled at scrape time
+        assert 'code="200"' in prom
+    finally:
+        srv.stop()
+
+
+def test_native_misc_contract_errors(scorer):
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        code, _ = _post(port, "/predict", None, raw=b"{not json")
+        assert code == 400
+        code, _ = _post(port, "/predict", {"data": {}})
+        assert code == 400
+        code, _ = _post(port, "/api/v9/bogus", {})
+        assert code == 404
+        # ragged rows: native decoder bails, Python lenient path 200s
+        code, out = _post(port, "/predict",
+                          {"data": {"ndarray": [[1.0, 2.0], [3.0] * 40]}})
+        assert code == 200 and len(out["data"]["ndarray"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_native_front_concurrent_close_clients(scorer):
+    """urllib sends Connection: close — responses must still arrive even
+    though the conn is marked for teardown at parse time (pending-request
+    accounting in the IO loop)."""
+    import threading
+
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start("127.0.0.1", 0)
+    errs = []
+
+    def worker(n):
+        try:
+            for i in range(n):
+                code, out = _post(port, "/api/v0.1/predictions",
+                                  {"data": {"ndarray": [[float(i)] * 30] * 4}})
+                assert code == 200 and len(out["data"]["ndarray"]) == 4
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+
+    try:
+        ths = [threading.Thread(target=worker, args=(20,)) for _ in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert not errs, errs[:3]
+        assert srv.registry.counter(
+            "seldon_api_executor_server_requests_total"
+        ).value(labels={"code": "200"}) >= 160
+    finally:
+        srv.stop()
