@@ -199,6 +199,10 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req):
         "tx_s": round(rate * rows_per_req, 1),
         "p50_ms": round(float(np.percentile(lat_a, 50)), 3),
         "p99_ms": round(float(np.percentile(lat_a, 99)), 3),
+        # transparency: small request batches may score on the serving
+        # host tier (numpy) instead of paying the device RTT — by design
+        "host_tier_rows": scorer.host_tier_rows,
+        "transport": type(srv._httpd).__name__,
     }
 
 

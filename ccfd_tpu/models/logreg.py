@@ -46,6 +46,16 @@ def apply(params: Params, x: jax.Array, compute_dtype=jnp.float32) -> jax.Array:
     return jax.nn.sigmoid(logits(params, x, compute_dtype))
 
 
+def apply_numpy(params: Params, x: np.ndarray) -> np.ndarray:
+    """Pure-numpy forward (f32) for the serving host tier: small request
+    batches skip the device round trip entirely (see mlp.apply_numpy)."""
+    from ccfd_tpu.utils.metrics_math import stable_sigmoid
+
+    z = np.asarray(x, np.float32) @ np.asarray(params["w"], np.float32)
+    z = (z + np.float32(params["b"])).reshape(x.shape[0])
+    return stable_sigmoid(z)
+
+
 def fold_standardizer(
     w: np.ndarray, b: float, mean: np.ndarray, scale: np.ndarray
 ) -> Params:

@@ -21,6 +21,9 @@ class ModelSpec:
     apply: Callable[..., jax.Array]  # (params, x) -> proba_1 (B,)
     logits: Callable[..., jax.Array]
     trainable: bool
+    # optional pure-numpy forward: enables the serving host latency tier
+    # (small batches skip the device round trip on high-RTT attachments)
+    apply_numpy: Callable[..., Any] | None = None
 
 
 _REGISTRY: dict[str, ModelSpec] = {}
@@ -38,12 +41,15 @@ def get_model(name: str) -> ModelSpec:
 
 
 register_model(
-    ModelSpec("logreg", logreg.init, logreg.apply, logreg.logits, trainable=True)
+    ModelSpec("logreg", logreg.init, logreg.apply, logreg.logits,
+              trainable=True, apply_numpy=logreg.apply_numpy)
 )
 register_model(
-    ModelSpec("modelfull", logreg.init, logreg.apply, logreg.logits, trainable=True)
+    ModelSpec("modelfull", logreg.init, logreg.apply, logreg.logits,
+              trainable=True, apply_numpy=logreg.apply_numpy)
 )  # reference alias: the Seldon graph node name (modelfull.json:38)
-register_model(ModelSpec("mlp", mlp.init, mlp.apply, mlp.logits, trainable=True))
+register_model(ModelSpec("mlp", mlp.init, mlp.apply, mlp.logits,
+                         trainable=True, apply_numpy=mlp.apply_numpy))
 register_model(
     ModelSpec(
         "gbt",

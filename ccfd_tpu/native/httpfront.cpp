@@ -216,7 +216,17 @@ bool handle_one_request(Front* f, int fd, Conn* c) {
         ++vstart;
       std::string val = c->in.substr(vstart, eol - vstart);
       if (key == "content-length") {
-        content_length = strtoul(val.c_str(), nullptr, 10);
+        // a non-numeric length silently read as 0 would leave the body
+        // bytes in the buffer to be parsed as the NEXT request line —
+        // reject like the Python transport does
+        char* endp = nullptr;
+        content_length = strtoul(val.c_str(), &endp, 10);
+        if (val.empty() || endp == val.c_str() || *endp != '\0') {
+          queue_write(f, fd,
+                      make_response(400, "text/plain", "bad content-length", 18));
+          c->want_close = true;
+          return false;
+        }
       } else if (key == "authorization") {
         auth_header = val;
       } else if (key == "connection") {
@@ -414,7 +424,21 @@ void io_loop(Front* f) {
         }
         if (peer_closed) {
           std::lock_guard<std::mutex> lk(f->mu);
-          close_conn(f, fd);
+          auto itc = f->conns.find(fd);
+          if (itc == f->conns.end()) continue;
+          // a half-closing client (shutdown(SHUT_WR) after the request)
+          // still expects its response: defer teardown to the pending/
+          // flush machinery; stop watching EPOLLIN so the permanently
+          // readable EOF doesn't spin the loop
+          itc->second.want_close = true;
+          if (itc->second.pending == 0 && itc->second.out.empty()) {
+            close_conn(f, fd);
+          } else {
+            struct epoll_event ev;
+            ev.events = EPOLLOUT;
+            ev.data.fd = fd;
+            epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+          }
           continue;
         }
       }

@@ -89,9 +89,14 @@ class NativeFront:
         # take()/take_misc() has joined — only then destroy frees it
         self._lib.ccfd_front_stop(self._handle)
         for t in self._threads:
-            t.join(timeout=5.0)
+            t.join(timeout=10.0)
+        still_alive = [t for t in self._threads if t.is_alive()]
         self._threads = []
-        self._lib.ccfd_front_destroy(self._handle)
+        if not still_alive:
+            self._lib.ccfd_front_destroy(self._handle)
+        # else: a worker is wedged inside a device dispatch (e.g. a stuck
+        # accelerator tunnel) and may still touch the handle — LEAK the
+        # Front rather than free memory a live thread will poke
         self._handle = None
 
     # -- predict hot path --------------------------------------------------

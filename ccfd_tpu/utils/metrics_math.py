@@ -11,6 +11,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def stable_sigmoid(z: np.ndarray) -> np.ndarray:
+    """Overflow-safe numpy sigmoid (f32), shared by the host-tier model
+    forwards (mlp/logreg apply_numpy)."""
+    z = np.asarray(z, np.float32)
+    out = np.empty_like(z, np.float32)
+    pos = z >= 0
+    out[pos] = 1.0 / (1.0 + np.exp(-z[pos]))
+    ez = np.exp(z[~pos])
+    out[~pos] = ez / (1.0 + ez)
+    return out
+
+
 def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     """ROC AUC via the rank statistic (Mann-Whitney U), handling score ties
     with midranks — equivalent to sklearn.roc_auc_score. O(n log n)."""

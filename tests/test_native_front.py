@@ -169,3 +169,39 @@ def test_native_front_concurrent_close_clients(scorer):
         ).value(labels={"code": "200"}) >= 160
     finally:
         srv.stop()
+
+
+def test_native_half_close_client_still_gets_response(scorer):
+    """shutdown(SHUT_WR) after the request is legal HTTP/1.1 — the reply
+    must still arrive (deferred teardown, code-review r2 finding)."""
+    import json as _json
+    import socket
+
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        body = _json.dumps({"data": {"ndarray": [[0.25] * 30] * 3}}).encode()
+        s.sendall(b"POST /predict HTTP/1.1\r\nContent-Length: %d\r\n\r\n" % len(body) + body)
+        s.shutdown(socket.SHUT_WR)
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        assert b"200 OK" in buf and b"proba_1" in buf, buf[:200]
+        s.close()
+        # bad content-length rejects cleanly instead of desyncing
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(b"POST /predict HTTP/1.1\r\nContent-Length: zebra\r\n\r\n{}")
+        buf = b""
+        while b"400" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        assert b"400" in buf, buf[:200]
+        s.close()
+    finally:
+        srv.stop()

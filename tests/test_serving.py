@@ -235,3 +235,75 @@ class TestFusedScorerPath:
             np.testing.assert_allclose(
                 scorer.score_pipelined(x, depth=3), scorer.score(x), atol=1e-6
             )
+
+
+def test_host_tier_parity_and_routing(scorer_params=None):
+    """Small batches score on the host tier (numpy, no device dispatch);
+    results match the device path within bf16 tolerance; bulk stays on
+    the device path."""
+    import jax as _jax
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import mlp
+    from ccfd_tpu.serving.scorer import Scorer
+
+    ds = synthetic_dataset(n=1024, fraud_rate=0.2, seed=5)
+    params = mlp.init(_jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    s = Scorer(model_name="mlp", params=params, batch_sizes=(16, 128, 1024),
+               compute_dtype="bfloat16", host_tier_rows=256)
+    s.warmup()
+    assert s.host_tier_rows == 256
+    x = ds.X[:64]
+    # host tier result vs forced-device result
+    host = s.score(x)
+    device = s.score_pipelined(x, depth=1)
+    assert host.shape == (64,)
+    assert np.allclose(host, device, atol=2e-2), np.abs(host - device).max()
+    # routing: above the threshold the device path runs (spy on it)
+    calls = {"device": 0}
+    orig = s.score_pipelined
+
+    def spy(xx, depth=2):
+        calls["device"] += 1
+        return orig(xx, depth=depth)
+
+    s.score_pipelined = spy
+    s.score(ds.X[:64])
+    assert calls["device"] == 0  # host tier
+    s.score(ds.X[:512])
+    assert calls["device"] == 1  # device path
+    s.score_pipelined = orig
+
+    # swap_params publishes to the host tier too
+    import jax.numpy as _jnp
+
+    p2 = dict(params)
+    p2["layers"] = [dict(l) for l in params["layers"]]
+    p2["layers"][-1] = dict(p2["layers"][-1])
+    p2["layers"][-1]["b"] = _jnp.asarray([9.0], _jnp.float32)
+    s.swap_params(p2)
+    shifted = s.score(x)
+    assert (shifted > host).all()  # +9 logit bias must show through the tier
+
+
+def test_host_tier_auto_off_on_cpu_backend():
+    from ccfd_tpu.serving.scorer import Scorer
+
+    s = Scorer(model_name="mlp", batch_sizes=(16,))
+    assert s.host_tier_rows == 0  # default backend here is cpu
+
+
+def test_host_tier_logreg_numpy_matches_jax():
+    import jax as _jax
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import logreg
+
+    ds = synthetic_dataset(n=128, fraud_rate=0.3, seed=2)
+    params = logreg.init(_jax.random.PRNGKey(1))
+    a = np.asarray(logreg.apply(params, ds.X))
+    b = logreg.apply_numpy(
+        {"w": np.asarray(params["w"]), "b": np.asarray(params["b"])}, ds.X
+    )
+    assert np.allclose(a, b, atol=1e-6)
