@@ -155,6 +155,7 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req):
     scorer.warmup()
     srv = PredictionServer(scorer, Config(dynamic_batching=True))
     port = srv.start(host="127.0.0.1", port=0)
+    transport = type(srv._httpd).__name__  # read before stop() nulls it
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _REST_CLIENT_SCRIPT,
@@ -202,7 +203,7 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req):
         # transparency: small request batches may score on the serving
         # host tier (numpy) instead of paying the device RTT — by design
         "host_tier_rows": scorer.host_tier_rows,
-        "transport": type(srv._httpd).__name__,
+        "transport": transport,
     }
 
 

@@ -77,6 +77,7 @@ struct Conn {
   std::string out;
   uint64_t gen = 0;
   bool want_close = false;
+  bool read_closed = false;  // peer half-closed: EOF is permanently readable
   int pending = 0;  // requests enqueued to Python, response not yet queued
 };
 
@@ -215,6 +216,8 @@ bool handle_one_request(Front* f, int fd, Conn* c) {
       while (vstart < eol && (c->in[vstart] == ' ' || c->in[vstart] == '\t'))
         ++vstart;
       std::string val = c->in.substr(vstart, eol - vstart);
+      while (!val.empty() && (val.back() == ' ' || val.back() == '\t'))
+        val.pop_back();  // trailing OWS is legal in a field line (RFC 9110)
       if (key == "content-length") {
         // a non-numeric length silently read as 0 would leave the body
         // bytes in the buffer to be parsed as the NEXT request line —
@@ -326,7 +329,9 @@ void flush_conn(Front* f, int fd, Conn* c) {
     }
   }
   struct epoll_event ev;
-  ev.events = EPOLLIN;
+  // a half-closed conn must NOT re-arm EPOLLIN: its EOF is permanently
+  // readable and would spin the loop until teardown
+  ev.events = c->read_closed ? 0 : EPOLLIN;
   ev.data.fd = fd;
   epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
 }
@@ -431,11 +436,16 @@ void io_loop(Front* f) {
           // flush machinery; stop watching EPOLLIN so the permanently
           // readable EOF doesn't spin the loop
           itc->second.want_close = true;
+          itc->second.read_closed = true;
           if (itc->second.pending == 0 && itc->second.out.empty()) {
             close_conn(f, fd);
           } else {
+            // stop monitoring entirely while the response is produced:
+            // EPOLLIN would fire forever on the EOF, and EPOLLOUT fires
+            // immediately on an empty out buffer — either way a busy
+            // spin. The resp-drain flush sweep delivers the answer.
             struct epoll_event ev;
-            ev.events = EPOLLOUT;
+            ev.events = 0;
             ev.data.fd = fd;
             epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
           }
@@ -693,11 +703,18 @@ void ccfd_front_stop(void* h) {
     f->conns.clear();
   }
   close(f->listen_fd);
-  close(f->epoll_fd);
-  close(f->wake_fd);
+  // epoll_fd/wake_fd stay OPEN until destroy: a worker wedged inside a
+  // device dispatch may still call respond() after stop(), and writing
+  // the wake token to a closed (possibly REUSED) fd would inject bytes
+  // into an unrelated stream. An unread eventfd write is harmless.
 }
 
-void ccfd_front_destroy(void* h) { delete static_cast<Front*>(h); }
+void ccfd_front_destroy(void* h) {
+  Front* f = static_cast<Front*>(h);
+  close(f->epoll_fd);
+  close(f->wake_fd);
+  delete f;
+}
 
 }  // extern "C"
 

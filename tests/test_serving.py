@@ -237,7 +237,7 @@ class TestFusedScorerPath:
             )
 
 
-def test_host_tier_parity_and_routing(scorer_params=None):
+def test_host_tier_parity_and_routing():
     """Small batches score on the host tier (numpy, no device dispatch);
     results match the device path within bf16 tolerance; bulk stays on
     the device path."""

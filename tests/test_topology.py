@@ -1,0 +1,155 @@
+"""Multi-process topology: the k8s-shaped deployment proven end to end.
+
+deploy/k8s/ runs each service as its own pod wired ONLY by the reference
+env contract (BROKER_URL, SELDON_URL, KIE_SERVER_URL, topics,
+FRAUD_THRESHOLD — reference deploy/router.yaml:54-70 et al.). This test
+runs that exact topology as real OS processes — bus server, scorer REST,
+engine REST, notification service, router, producer — each launched via
+``python -m ccfd_tpu <service>`` with env-var wiring, and asserts the
+full transaction flow crosses every process boundary:
+
+    producer -> bus -> router -> scorer REST -> engine REST
+                 ^                                   |
+                 +--- notify <- customer topics <----+
+
+Slow by unit-test standards (7 interpreter boot-ups, two of them
+importing jax) but it is the ONE test that proves the deployment shape
+works outside a single process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _wait_http(url, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return _get(url, timeout=3)
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.3)
+    raise TimeoutError(f"{url} never came up: {last!r}")
+
+
+def _metric(text: str, name: str) -> float:
+    total = 0.0
+    found = False
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            try:
+                total += float(line.split()[-1])
+                found = True
+            except ValueError:
+                pass
+    return total if found else -1.0
+
+
+def test_multiprocess_topology_end_to_end(tmp_path):
+    n_tx = 400
+    bus_port, scorer_port, engine_port, router_metrics = (
+        _free_port(), _free_port(), _free_port(), _free_port()
+    )
+    base_env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BROKER_URL=f"http://127.0.0.1:{bus_port}",
+        KAFKA_TOPIC="odh-demo",
+        CUSTOMER_NOTIFICATION_TOPIC="ccd-customer-outgoing",
+        CUSTOMER_RESPONSE_TOPIC="ccd-customer-response",
+        SELDON_URL=f"http://127.0.0.1:{scorer_port}",
+        SELDON_ENDPOINT="api/v0.1/predictions",
+        KIE_SERVER_URL=f"http://127.0.0.1:{engine_port}",
+        FRAUD_THRESHOLD="0.5",
+        CCFD_REPLY_TIMEOUT_S="1.0",
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    logs = {}
+    procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(name: str, *args: str) -> None:
+        logs[name] = open(tmp_path / f"{name}.log", "wb")
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "ccfd_tpu", *args],
+            env=base_env, cwd=repo,
+            stdout=logs[name], stderr=subprocess.STDOUT,
+        )
+
+    try:
+        # run-book order (SURVEY.md §3 D): bus -> scorer -> engine ->
+        # notify -> router -> producer last
+        spawn("bus", "bus", "--host", "127.0.0.1", "--port", str(bus_port))
+        _wait_http(f"http://127.0.0.1:{bus_port}/healthz")
+
+        spawn("scorer", "serve", "--host", "127.0.0.1", "--port", str(scorer_port))
+        spawn("engine", "engine", "--host", "127.0.0.1", "--port", str(engine_port))
+        _wait_http(f"http://127.0.0.1:{scorer_port}/health/status", timeout_s=180)
+        _wait_http(f"http://127.0.0.1:{engine_port}/healthz", timeout_s=60)
+
+        spawn("notify", "notify", "--metrics-port", "0")
+        spawn("router", "router", "--metrics-port", str(router_metrics))
+        _wait_http(f"http://127.0.0.1:{router_metrics}/prometheus", timeout_s=180)
+
+        spawn("producer", "producer", "--limit", str(n_tx), "--wire-format", "csv")
+        assert procs["producer"].wait(timeout=120) == 0
+
+        # the full flow must cross every boundary: router consumed all tx...
+        deadline = time.monotonic() + 120
+        routed = -1.0
+        while time.monotonic() < deadline:
+            prom = _get(f"http://127.0.0.1:{router_metrics}/prometheus")
+            routed = _metric(prom, "transaction_incoming_total")
+            if routed >= n_tx:
+                break
+            time.sleep(0.5)
+        assert routed >= n_tx, f"router consumed {routed}/{n_tx}"
+        assert _metric(prom, "transaction_outgoing_total") >= n_tx * 0.95
+
+        # ...the scorer REST hop really served it (request counters moved)...
+        sprom = _get(f"http://127.0.0.1:{scorer_port}/prometheus")
+        assert _metric(sprom, "seldon_api_executor_server_requests_total") > 0
+        assert _metric(sprom, "proba_1") >= 0.0
+
+        # ...and the engine really started processes over REST
+        inst = json.loads(_get(f"http://127.0.0.1:{engine_port}/rest/instances"))
+        n_started = inst if isinstance(inst, int) else len(inst)
+        assert n_started >= n_tx * 0.95, n_started
+
+        # every service is still alive (nothing crashed mid-flow)
+        for name, p in procs.items():
+            if name == "producer":
+                continue
+            assert p.poll() is None, f"{name} died: see {tmp_path}/{name}.log"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for fh in logs.values():
+            fh.close()
