@@ -1,6 +1,6 @@
 """Benchmark: transaction-scoring throughput + latency, end to end.
 
-Six timed surfaces, matching the hops the reference instruments on its
+Seven timed surfaces, matching the hops the reference instruments on its
 SeldonCore/Router dashboards (SURVEY.md §3 stack A, §6):
 
 1. **Scorer hop** — host feature matrix -> bucketed jit dispatch
@@ -20,12 +20,14 @@ SeldonCore/Router dashboards (SURVEY.md §3 stack A, §6):
    (runs when >1 device is visible; SURVEY.md §7 stage 6).
 6. **Online retrain** — SGD steps/s and labels/s for the loop the engine's
    label topic feeds (BASELINE.json configs[4]); sharded when >1 device.
+7. **Sequence scoring** — the per-customer history transformer
+   (long-context family; ring attention over the mesh when >1 device).
 
 Prints ONE JSON line; primary fields:
   {"metric": ..., "value": tx/s, "unit": "tx/s", "vs_baseline": ratio,
    "p99_ms": ..., "platform": ...}
 plus sections ``rest`` / ``pipeline`` / ``fused_ab`` / ``mesh`` /
-``retrain``.
+``retrain`` / ``seq``.
 
 ``vs_baseline`` is the ratio against the 50,000 tx/s north-star target
 (BASELINE.json; the reference publishes no numbers of its own). ``p99_ms``
@@ -48,7 +50,7 @@ CCFD_BENCH_LATENCY_BATCH (default 4096), CCFD_BENCH_PLATFORM=cpu to force
 CPU, CCFD_BENCH_PROBE_S (per-attempt probe timeout, default 90),
 CCFD_BENCH_PROBE_ATTEMPTS (default 5), CCFD_BENCH_PROBE_BACKOFF_S (default
 45), CCFD_BENCH_REST_CLIENTS (default 8), CCFD_BENCH_REST_ROWS (rows per
-request, default 16), CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain to
+request, default 16), CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq to
 skip sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
 a tunnel that wedges MID-run would otherwise hang the bench forever;
 on expiry the newest cached TPU result is printed and the process exits 3).
@@ -137,11 +139,14 @@ print(json.dumps({"lat": lat, "loop_s": time.perf_counter() - t_loop}))
 """
 
 
-def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req):
+def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
+                native=True):
     """HTTP clients -> PredictionServer -> DynamicBatcher -> scorer: the full
     REST round trip. Clients run in SUBPROCESSES — in-process client threads
     would share the GIL with the server handlers and pollute the p99 with
-    client-side scheduling, which is not the hop under test."""
+    client-side scheduling, which is not the hop under test. ``native``
+    selects the C++ front vs the Python transport (the A/B records the
+    native front's win as a number)."""
     import numpy as np
 
     from ccfd_tpu.config import Config
@@ -153,7 +158,8 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req):
         batch_sizes=(16, 128, 1024, lat_batch), compute_dtype="bfloat16",
     )
     scorer.warmup()
-    srv = PredictionServer(scorer, Config(dynamic_batching=True))
+    srv = PredictionServer(scorer, Config(dynamic_batching=True,
+                                          native_front=native))
     port = srv.start(host="127.0.0.1", port=0)
     transport = type(srv._httpd).__name__  # read before stop() nulls it
     procs = [
@@ -388,6 +394,56 @@ def _arm_watchdog() -> None:
     t.start()
 
 
+def _bench_seq(seconds):
+    """Long-context member of the model zoo: the per-customer history
+    transformer (models/seq.py). Scores (B, L, 30) histories; when >1
+    device is visible the histories shard over the mesh and attention
+    runs as ring attention (ops/ring_attention.py) over the model axis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ccfd_tpu.models import seq
+
+    n_dev = len(jax.devices())
+    B, L = 256, 64
+    params = seq.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, L, 30)), jnp.float32)
+
+    attn = None
+    mesh = None
+    if n_dev > 1 and n_dev % 2 == 0:
+        from ccfd_tpu.ops.ring_attention import ring_attention
+        from ccfd_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(model_parallel=2)
+        attn = lambda q, k, v: ring_attention(q, k, v, mesh, "model")  # noqa: E731
+
+    @jax.jit
+    def step(p, xx):
+        return jax.nn.sigmoid(
+            seq.logits(p, xx, jnp.bfloat16, attention_fn=attn)
+        )
+
+    out = step(params, x)
+    jax.block_until_ready(out)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        out = step(params, x)
+        n += B
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return {
+        "histories_s": round(n / elapsed, 1),
+        "batch": B,
+        "seq_len": L,
+        "ring_attention": attn is not None,
+        "devices": n_dev,
+    }
+
+
 def main() -> None:
     _arm_watchdog()
     platform_forced = os.environ.get("CCFD_BENCH_PLATFORM", "")
@@ -464,12 +520,22 @@ def main() -> None:
         fused_ab = ab
 
     rest = None
+    rest_python = None
     if "rest" not in skip:
         rest = _bench_rest(
             params, lat_batch, max(2.0, seconds),
             int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "8")),
             int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
         )
+        if rest.get("transport") == "NativeFront":
+            # transport A/B: the same load through the Python server, so
+            # the native front's effect is a recorded number
+            rest_python = _bench_rest(
+                params, lat_batch, max(2.0, seconds / 2),
+                int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "8")),
+                int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
+                native=False,
+            )
 
     pipeline = None
     if "pipeline" not in skip:
@@ -484,6 +550,10 @@ def main() -> None:
     retrain_res = None
     if "retrain" not in skip:
         retrain_res = _bench_retrain(max(1.0, seconds / 2))
+
+    seq_res = None
+    if "seq" not in skip:
+        seq_res = _bench_seq(max(1.0, seconds / 2))
 
     # the e2e p99 the north star talks about is the REST predict hop when
     # measured; the raw scorer-hop p99 otherwise (also when the REST
@@ -507,12 +577,16 @@ def main() -> None:
         result["fused_ab"] = fused_ab
     if rest is not None:
         result["rest"] = rest
+    if rest_python is not None:
+        result["rest_python_transport"] = rest_python
     if pipeline is not None:
         result["pipeline"] = pipeline
     if mesh_res is not None:
         result["mesh"] = mesh_res
     if retrain_res is not None:
         result["retrain"] = retrain_res
+    if seq_res is not None:
+        result["seq"] = seq_res
 
     if on_tpu:
         # cache this as the round's last-good TPU number: later fallback

@@ -317,9 +317,10 @@ void flush_conn(Front* f, int fd, Conn* c) {
     if (n > 0) {
       c->out.erase(0, n);
     } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-      // wait for EPOLLOUT
+      // wait for EPOLLOUT; a half-closed conn must not re-arm EPOLLIN
+      // here either (its EOF level-triggers forever -> busy spin)
       struct epoll_event ev;
-      ev.events = EPOLLIN | EPOLLOUT;
+      ev.events = EPOLLOUT | (c->read_closed ? 0 : EPOLLIN);
       ev.data.fd = fd;
       epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
       return;

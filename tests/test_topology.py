@@ -30,6 +30,10 @@ import urllib.request
 
 
 def _free_port() -> int:
+    # allocate-then-release: there is a window before the slow-booting
+    # services bind these (the scorer imports jax first), so a busy shared
+    # host could steal one — acceptable flake risk on this dedicated box;
+    # a failure surfaces as "never came up" with the service's log path
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
