@@ -262,3 +262,63 @@ def test_fast_server_rejects_oversize_head_and_bad_length():
         s.close()
     finally:
         srv.stop()
+
+
+def test_decode_ndarray_fuzz_never_crashes():
+    """The C++ payload decoder parses attacker-controlled bytes in-process:
+    mutations of valid payloads and random garbage must either decode or
+    bail (None) — never corrupt memory or crash the interpreter."""
+    import random
+
+    from ccfd_tpu.native import decode_ndarray_json, native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    rng = random.Random(0)
+    base = b'{"data": {"ndarray": [[1.5, -2.5, 3e10], [4, 5, 6]]}}'
+    charset = b'[]{}",:.0123456789eE+-na '
+    for trial in range(3000):
+        b = bytearray(base)
+        for _ in range(rng.randint(1, 6)):
+            op = rng.random()
+            pos = rng.randrange(len(b)) if b else 0
+            if op < 0.4 and b:
+                b[pos] = rng.choice(charset)
+            elif op < 0.7 and b:
+                del b[pos]
+            else:
+                b.insert(pos, rng.choice(charset))
+        out = decode_ndarray_json(bytes(b), n_features=3)
+        if out is not None:
+            assert out.ndim == 2 and out.shape[1] == 3
+            assert np.isfinite(out).all() or True  # nan/inf tolerated, no UB
+    # pure garbage
+    for trial in range(500):
+        n = rng.randint(0, 200)
+        junk = bytes(rng.randrange(256) for _ in range(n))
+        out = decode_ndarray_json(junk, n_features=3)
+        assert out is None or (out.ndim == 2 and out.shape[1] == 3)
+    # pathological nesting / hugeness
+    assert decode_ndarray_json(b'{"data":{"ndarray":' + b"[" * 10000, 3) is None
+    deep = b'{"data":{"ndarray":[' + b"[1]," * 5000 + b"[1]]}}"
+    out = decode_ndarray_json(deep, n_features=3)
+    assert out is None or out.shape[0] == 5001
+
+
+def test_decode_csv_fuzz_never_crashes():
+    import random
+
+    from ccfd_tpu.native import decode_csv, native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    rng = random.Random(1)
+    for trial in range(1500):
+        n = rng.randint(0, 300)
+        junk = bytes(rng.randrange(256) for _ in range(n))
+        x, bad = decode_csv(junk, n_features=30)
+        assert x.shape[1] == 30 and bad >= 0
