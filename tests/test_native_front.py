@@ -211,3 +211,31 @@ def test_native_half_close_client_still_gets_response(scorer):
         s.close()
     finally:
         srv.stop()
+
+
+def test_graph_cr_serves_through_native_front():
+    """A SeldonDeployment-shaped inference graph (compiled to one jitted
+    callable) serves behind the native front like any model."""
+    import os
+
+    from ccfd_tpu.serving.graph import load_graph_cr
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = load_graph_cr(os.path.join(repo, "deploy", "model",
+                                      "graph_ensemble.json"))
+    s = Scorer(model_name=spec.name, batch_sizes=(16, 128),
+               compute_dtype="bfloat16")
+    s.warmup()
+    srv = PredictionServer(s, Config(native_front=True))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        assert type(srv._httpd).__name__ == "NativeFront"
+        rows = synthetic_dataset(n=8, fraud_rate=0.5, seed=1).X.tolist()
+        code, out = _post(port, "/api/v0.1/predictions",
+                          {"data": {"ndarray": rows}})
+        assert code == 200
+        assert out["meta"]["model"] == spec.name
+        for p0, p1 in out["data"]["ndarray"]:
+            assert 0.0 <= p1 <= 1.0 and abs(p0 + p1 - 1.0) < 1e-6
+    finally:
+        srv.stop()
