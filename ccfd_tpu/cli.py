@@ -158,6 +158,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     scorer = Scorer(
         model_name=cfg.model_name, params=params, compute_dtype=cfg.compute_dtype,
         batch_sizes=cfg.batch_sizes,
+        host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
     )
     scorer.warmup()
     srv = PredictionServer(scorer, cfg)

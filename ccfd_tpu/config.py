@@ -86,6 +86,7 @@ class Config:
     batch_workers: int = 4  # overlapped dispatches (device-RTT pipelining)
     dynamic_batching: bool = True  # serving-side request coalescing
     native_front: bool = True  # C++ HTTP front when the toolchain allows
+    host_tier_rows: int = -1  # -1 = auto (256 on accelerator backends); 0 = off
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
 
@@ -151,6 +152,9 @@ class Config:
             not in ("0", "false", "no", "off"),
             native_front=e.get("CCFD_NATIVE_FRONT", "1").strip().lower()
             not in ("0", "false", "no", "off"),
+            host_tier_rows=int(
+                e.get("CCFD_HOST_TIER_ROWS", str(Config.host_tier_rows))
+            ),
             serve_host=e.get("CCFD_SERVE_HOST", Config.serve_host),
             serve_port=int(e.get("CCFD_SERVE_PORT", str(Config.serve_port))),
         )

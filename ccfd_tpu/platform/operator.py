@@ -285,6 +285,7 @@ class Platform:
             params=params,
             compute_dtype=c.opt("dtype", cfg.compute_dtype),
             batch_sizes=cfg.batch_sizes,
+            host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
         )
         self.scorer.warmup()
         if c.opt("rest", False):
