@@ -189,3 +189,24 @@ def test_disabled_components_are_omitted():
     m = build_manifests(PlatformSpec.from_cr(cr))
     assert "producer.yaml" not in m and "engine.yaml" not in m
     assert "scorer.yaml" in m
+
+
+def test_checked_in_manifests_match_generator():
+    """deploy/k8s/ is generated output; drift from the generator means a
+    hand-edit or a forgotten regeneration (same guard as deploy/grafana)."""
+    import os
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    cr_path = os.path.join(repo, "deploy", "platform_cr.yaml")
+    out_dir = os.path.join(repo, "deploy", "k8s")
+    spec = PlatformSpec.from_cr(yaml.safe_load(open(cr_path)), Config())
+    fresh = build_manifests(spec, Config())
+    assert sorted(os.listdir(out_dir)) == sorted(fresh), (
+        "deploy/k8s/ file set drifted — regenerate with "
+        "python -m ccfd_tpu manifests"
+    )
+    for fname, docs in fresh.items():
+        with open(os.path.join(out_dir, fname)) as f:
+            assert list(yaml.safe_load_all(
+                f.read().split("\n", 2)[2]  # skip the GENERATED header
+            )) == docs, f"deploy/k8s/{fname} is stale — regenerate"
