@@ -137,24 +137,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         ds = load_dataset()
         params = fit_mlp(ds.X, ds.y, steps=args.train_steps,
                          tc=TrainConfig(compute_dtype="float32"))
-    elif cfg.model_name == "mlp" and getattr(args, "checkpoint_dir", ""):
+    elif cfg.model_name == "mlp":
         # serve the newest `train` checkpoint when one exists: training and
         # serving compose through the checkpoint dir, so `ccfd_tpu train`
         # followed by `ccfd_tpu serve` serves the trained (AUC-recorded)
         # params instead of random init
-        from ccfd_tpu.models import mlp as mlp_mod
-        from ccfd_tpu.parallel.checkpoint import CheckpointManager
-
-        mgr = CheckpointManager(args.checkpoint_dir)
-        if mgr.latest_step() is not None:
-            import jax
-
-            like = mlp_mod.init(jax.random.PRNGKey(0))
-            restored = mgr.restore(like)
-            if restored is not None:
-                params, step = restored
-                print(f"[serve] restored checkpoint step={step} from "
-                      f"{args.checkpoint_dir}", file=sys.stderr)
+        params = _restore_mlp_checkpoint(getattr(args, "checkpoint_dir", ""))
     scorer = Scorer(
         model_name=cfg.model_name, params=params, compute_dtype=cfg.compute_dtype,
         batch_sizes=cfg.batch_sizes,
@@ -239,6 +227,84 @@ def cmd_train(args: argparse.Namespace) -> int:
         "source": source, "test_rows": int(n_test),
         "auc_mlp": round(auc_mlp, 5),
         "auc_sklearn_logreg": round(auc_ref, 5) if auc_ref is not None else None,
+    }))
+    return 0
+
+
+def _restore_mlp_checkpoint(checkpoint_dir: str):
+    """Latest `train` checkpoint as MLP params, or None. The checkpoint
+    format is the MLP's pytree, so callers must only apply this when the
+    configured model is the MLP (serve and score share this guard)."""
+    if not checkpoint_dir:
+        return None
+    import jax
+
+    from ccfd_tpu.models import mlp as mlp_mod
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(checkpoint_dir)
+    if mgr.latest_step() is None:
+        return None
+    restored = mgr.restore(mlp_mod.init(jax.random.PRNGKey(0)))
+    if restored is None:
+        return None
+    params, step = restored
+    print(f"[checkpoint] restored step={step} from {checkpoint_dir}",
+          file=sys.stderr)
+    return params
+
+
+def cmd_score(args: argparse.Namespace) -> int:
+    """Offline bulk scoring: CSV in -> probabilities out, through the same
+    pipelined bucketed dispatch the serving path uses. The batch analog of
+    the REST hop for notebook/backfill workflows (the reference would loop
+    single Seldon requests; here one command rides score_pipelined).
+    Honors CCFD_GRAPH_CR and CCFD_MODEL exactly like `serve`, so a backfill
+    scores with the SAME model the REST endpoint serves."""
+    import numpy as np
+
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import load_dataset
+    from ccfd_tpu.serving.scorer import Scorer
+
+    cfg = Config.from_env()
+    if cfg.graph_cr:
+        from ccfd_tpu.serving.graph import load_graph_cr
+
+        spec = load_graph_cr(cfg.graph_cr)
+        cfg = dataclasses.replace(cfg, model_name=spec.name)
+    ds = load_dataset(path=args.input or None)
+    # checkpoints hold the MLP pytree: restoring into any other model
+    # would mis-shape its params (same guard as `serve`)
+    params = (
+        _restore_mlp_checkpoint(args.checkpoint_dir)
+        if cfg.model_name == "mlp"
+        else None
+    )
+    scorer = Scorer(
+        model_name=cfg.model_name, params=params,
+        compute_dtype=cfg.compute_dtype, batch_sizes=cfg.batch_sizes,
+    )
+    scorer.warmup()
+    t0 = time.time()
+    proba = scorer.score_pipelined(ds.X, depth=args.depth)
+    elapsed = time.time() - t0
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write("proba_1\n")
+            f.write("\n".join(repr(float(p)) for p in proba) + "\n")
+    frauds = int((proba >= cfg.fraud_threshold).sum())
+    print(json.dumps({
+        "rows": int(ds.n),
+        "seconds": round(elapsed, 3),
+        "tx_s": round(ds.n / max(elapsed, 1e-9), 1),
+        "flagged_fraud": frauds,
+        "fraud_threshold": cfg.fraud_threshold,
+        # 0-row input (e.g. a filtered-to-header CSV): mean of nothing is
+        # NaN, which json.dumps would emit as invalid JSON
+        "mean_proba": round(float(np.mean(proba)), 6) if ds.n else None,
+        "output": args.output or None,
+        "checkpoint": bool(params is not None),
     }))
     return 0
 
@@ -394,6 +460,23 @@ def _broker_for(cfg):
     from ccfd_tpu.bus.broker import Broker
 
     return Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync)
+
+
+def _install_sigterm_as_interrupt() -> None:
+    """k8s stops pods with SIGTERM (the generated manifests run these
+    commands as containers); Python's default handler would kill the
+    process without running any of the KeyboardInterrupt cleanup paths
+    below (server stop, engine state save). Map SIGTERM to the same
+    graceful path SIGINT takes."""
+    import signal
+
+    def raise_interrupt(signum, frame):  # noqa: ARG001
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, raise_interrupt)
+    except (ValueError, OSError):  # pragma: no cover - non-main thread
+        pass
 
 
 def _serve_forever() -> int:
@@ -556,13 +639,18 @@ def _honor_platform_env() -> None:
 
 # commands whose code path imports jax; the others (bus, notify, producer,
 # store, engine) stay jax-free and must not pay the import at startup
-_JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up"}
+_JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up", "score"}
+
+
+_SERVICE_CMDS = {"serve", "bus", "engine", "router", "notify", "store", "up"}
 
 
 def main(argv: list[str] | None = None) -> int:
     args_list = list(sys.argv[1:] if argv is None else argv)
     if args_list and args_list[0] in _JAX_CMDS:
         _honor_platform_env()
+    if args_list and args_list[0] in _SERVICE_CMDS:
+        _install_sigterm_as_interrupt()
     p = argparse.ArgumentParser(prog="ccfd_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -595,6 +683,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="store endpoint (default: s3endpoint env)")
     t.add_argument("--test-frac", type=float, default=0.2)
     t.set_defaults(fn=cmd_train)
+
+    sc = sub.add_parser("score", help="offline bulk scoring: CSV -> probabilities")
+    sc.add_argument("--input", default="", help="creditcard.csv path (default: CCFD_CSV/synthetic)")
+    sc.add_argument("--output", default="", help="write proba_1 CSV here")
+    sc.add_argument("--depth", type=int, default=2, help="pipelined dispatch depth")
+    sc.add_argument("--checkpoint-dir", default="./checkpoints")
+    sc.set_defaults(fn=cmd_score)
 
     an = sub.add_parser("analyze", help="dataset analytics report (Spark/notebook analog)")
     an.add_argument("--nbins", type=int, default=32)

@@ -82,3 +82,30 @@ def test_train_from_store_records_auc_and_serve_restores(tmp_path, capsys):
         assert proba.shape == (512,) and np.all((proba >= 0) & (proba <= 1))
     finally:
         srv.stop()
+
+
+def test_cmd_score_bulk_csv(tmp_path, capsys):
+    """Offline bulk scoring: train -> checkpoint -> score a CSV with it."""
+    import numpy as np
+
+    from ccfd_tpu.cli import main
+    from ccfd_tpu.data.ccfd import load_dataset, to_csv_bytes
+
+    csv_path = tmp_path / "creditcard.csv"
+    csv_path.write_bytes(to_csv_bytes(load_dataset(n_synthetic=2000)))
+    ckpt = str(tmp_path / "ckpt")
+    rc = main(["train", "--steps", "40", "--checkpoint-dir", ckpt])
+    assert rc == 0
+    capsys.readouterr()
+    out_path = tmp_path / "scores.csv"
+    rc = main(["score", "--input", str(csv_path), "--output", str(out_path),
+               "--checkpoint-dir", ckpt])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["rows"] == 2000 and summary["checkpoint"] is True
+    lines = out_path.read_text().strip().splitlines()
+    assert lines[0] == "proba_1" and len(lines) == 2001
+    probs = np.asarray([float(v) for v in lines[1:]])
+    assert ((probs >= 0) & (probs <= 1)).all()
+    # a trained checkpoint separates the classes at least somewhat
+    assert summary["flagged_fraud"] < 2000
