@@ -465,6 +465,10 @@ def main() -> None:
     import jax
     import numpy as np
 
+    from ccfd_tpu.utils.compile_cache import enable as _enable_compile_cache
+
+    _enable_compile_cache()  # repeat bench runs skip tunnel-side compiles
+
     from ccfd_tpu.data.ccfd import synthetic_dataset
     from ccfd_tpu.models import mlp
     from ccfd_tpu.serving.scorer import Scorer

@@ -649,6 +649,11 @@ def main(argv: list[str] | None = None) -> int:
     args_list = list(sys.argv[1:] if argv is None else argv)
     if args_list and args_list[0] in _JAX_CMDS:
         _honor_platform_env()
+        # persistent XLA compilation cache: service restarts and repeat
+        # runs skip the 20-40s-per-shape first compile on the TPU tunnel
+        from ccfd_tpu.utils.compile_cache import enable as _enable_cache
+
+        _enable_cache()
     if args_list and args_list[0] in _SERVICE_CMDS:
         _install_sigterm_as_interrupt()
     p = argparse.ArgumentParser(prog="ccfd_tpu")

@@ -1,0 +1,39 @@
+"""Persistent XLA compilation cache for every jax entry point.
+
+On the TPU attachment a first compile costs ~20-40s per (executable,
+shape) — the scorer's bucket set alone is several of those, paid again on
+every service restart, bench run, and retrain bring-up. JAX's persistent
+compilation cache keeps compiled executables on disk keyed by HLO +
+compile options + platform, so only the FIRST process ever pays.
+
+``enable()`` is called by the CLI for jax-using commands and by bench.py;
+CCFD_COMPILE_CACHE overrides the location, ``0``/``off`` disables.
+Failures (read-only fs, old jax) degrade silently to no caching — the
+cache is an optimization, never a requirement.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(path: str | None = None) -> str | None:
+    """Point jax at a persistent on-disk compilation cache; returns the
+    directory in use, or None when disabled/unavailable."""
+    env = os.environ.get("CCFD_COMPILE_CACHE", "")
+    if env.strip().lower() in ("0", "off", "false", "no"):
+        return None
+    target = path or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "ccfd_tpu", "xla"
+    )
+    try:
+        os.makedirs(target, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", target)
+        # cache even quick compiles: the tunnel round trip dominates, and
+        # the scorer's small buckets compile fast but re-run often
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        return target
+    except Exception:  # noqa: BLE001 - optimization only, never required
+        return None
