@@ -14,7 +14,29 @@ cache is an optimization, never a requirement.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def _host_fingerprint() -> str:
+    """Short stable id for this host's CPU. XLA:CPU persists AOT machine
+    code compiled for the build host's exact feature set; loading it on a
+    host with different features risks SIGILL (cpu_aot_loader warns about
+    exactly this). Keying the cache dir by CPU identity makes a different
+    host start clean instead of loading incompatible artifacts. TPU
+    executables are unaffected either way — same-host reruns (the case the
+    cache exists for) still hit."""
+    material = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    material += line
+                    break
+    except OSError:
+        material += platform.processor()
+    return hashlib.sha256(material.encode()).hexdigest()[:12]
 
 
 def enable(path: str | None = None) -> str | None:
@@ -23,9 +45,12 @@ def enable(path: str | None = None) -> str | None:
     env = os.environ.get("CCFD_COMPILE_CACHE", "")
     if env.strip().lower() in ("0", "off", "false", "no"):
         return None
-    target = path or env or os.path.join(
+    base = path or env or os.path.join(
         os.path.expanduser("~"), ".cache", "ccfd_tpu", "xla"
     )
+    # fingerprint under overridden bases too: a shared CCFD_COMPILE_CACHE
+    # on a heterogeneous fleet is exactly where cross-host AOT reuse bites
+    target = os.path.join(base, _host_fingerprint())
     try:
         os.makedirs(target, exist_ok=True)
         import jax

@@ -1,0 +1,63 @@
+"""Persistent-compile-cache plumbing (ccfd_tpu/utils/compile_cache.py).
+
+The cache itself is XLA's; what we own — and test — is the keying and the
+kill switch. The host fingerprint matters because XLA:CPU persists AOT
+machine code for the build host's exact CPU features; a different host
+loading those artifacts risks SIGILL (cpu_aot_loader warns about this),
+so each CPU identity must get its own directory — including under an
+operator-overridden base, where cross-host sharing is most likely.
+"""
+
+import os
+from unittest import mock
+
+import jax
+import pytest
+
+from ccfd_tpu.utils import compile_cache
+
+
+@pytest.fixture()
+def _restore_jax_cache_config():
+    """enable() mutates process-global jax config; put it back so later
+    tests in the session don't write cache artifacts into stale tmp dirs."""
+    before_dir = jax.config.jax_compilation_cache_dir
+    before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", before_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", before_min)
+
+
+def test_fingerprint_stable_and_short():
+    a = compile_cache._host_fingerprint()
+    b = compile_cache._host_fingerprint()
+    assert a == b
+    assert len(a) == 12
+    assert all(c in "0123456789abcdef" for c in a)
+
+
+def test_enable_uses_fingerprinted_dir(tmp_path, _restore_jax_cache_config):
+    with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": ""}), \
+         mock.patch("os.path.expanduser", return_value=str(tmp_path)):
+        target = compile_cache.enable()
+    assert target is not None
+    assert os.path.basename(target) == compile_cache._host_fingerprint()
+    assert os.path.isdir(target)
+
+
+def test_enable_off_switch():
+    with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": "off"}):
+        assert compile_cache.enable() is None
+
+
+def test_enable_fingerprints_under_overridden_base(
+    tmp_path, _restore_jax_cache_config
+):
+    base = str(tmp_path / "shared")
+    with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": ""}):
+        target = compile_cache.enable(base)
+    assert target == os.path.join(base, compile_cache._host_fingerprint())
+    assert os.path.isdir(target)
+    # env-var override gets the same treatment
+    with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": base}):
+        assert compile_cache.enable() == target
