@@ -117,23 +117,39 @@ def _bench_scorer(scorer, X, batch, lat_batch, seconds, depth):
 
 
 _REST_CLIENT_SCRIPT = r"""
-import http.client, json, socket, sys, time
+# Lean load generator: raw socket + pre-serialized request bytes. On a
+# small host the clients share cores with the server under test; an
+# http.client loop burns several hundred us of CPU per request on header
+# objects and buffered-IO plumbing, which pollutes the measured latency
+# with load-generator overhead. This loop is sendall + recv-until-length.
+import json, socket, sys, time
 port, rows_n, seconds = int(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3])
 row = [float(j % 7) for j in range(30)]
-payload = json.dumps({"data": {"ndarray": [row] * rows_n}})
-headers = {"Content-Type": "application/json"}
-conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
-conn.connect()
-conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+payload = json.dumps({"data": {"ndarray": [row] * rows_n}}).encode()
+req = (b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+       b"Host: 127.0.0.1\r\nContent-Type: application/json\r\n"
+       b"Content-Length: " + str(len(payload)).encode() + b"\r\n\r\n" + payload)
+sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 lat = []
+buf = b""
 stop_at = time.perf_counter() + seconds
 t_loop = time.perf_counter()
 while time.perf_counter() < stop_at:
     t1 = time.perf_counter()
-    conn.request("POST", "/api/v0.1/predictions", payload, headers)
-    resp = conn.getresponse()
-    body = resp.read()
-    assert resp.status == 200, body[:200]
+    sock.sendall(req)
+    while True:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end >= 0:
+            head = buf[:head_end].lower()
+            cl = int(head.split(b"content-length:", 1)[1].split(b"\r\n", 1)[0])
+            if len(buf) >= head_end + 4 + cl:
+                assert buf.startswith(b"HTTP/1.1 200"), buf[:200]
+                buf = buf[head_end + 4 + cl:]
+                break
+        chunk = sock.recv(1 << 16)
+        assert chunk, "server closed connection"
+        buf += chunk
     lat.append((time.perf_counter() - t1) * 1e3)
 print(json.dumps({"lat": lat, "loop_s": time.perf_counter() - t_loop}))
 """

@@ -142,6 +142,32 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
 
+    def merge_counts(
+        self,
+        bucket_counts: Sequence[int],
+        sum_: float,
+        labels: Mapping[str, str] | None = None,
+    ) -> None:
+        """Fold externally-observed cumulative le-counts into this series.
+
+        For native-code observers (the C++ serving front scores requests
+        without touching Python) that accumulate in the SAME bucket layout:
+        the caller passes per-bucket DELTAS since its last fold plus the
+        matching latency-sum delta. Layout mismatch is a programming error
+        and raises rather than corrupting the series.
+        """
+        if len(bucket_counts) != len(self.buckets):
+            raise ValueError(
+                f"bucket layout mismatch: got {len(bucket_counts)} counts "
+                f"for {len(self.buckets)} buckets"
+            )
+        key = _labelkey(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, c in enumerate(bucket_counts):
+                counts[i] += int(c)
+            self._sums[key] = self._sums.get(key, 0.0) + float(sum_)
+
     def count(self, labels: Mapping[str, str] | None = None) -> int:
         with self._lock:
             counts = self._counts.get(_labelkey(labels))

@@ -121,6 +121,23 @@ def _load():
         lib.ccfd_front_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)
         ]
+        lib.ccfd_front_set_host_model.restype = None
+        lib.ccfd_front_set_host_model.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ccfd_front_set_latency_buckets.restype = None
+        lib.ccfd_front_set_latency_buckets.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        lib.ccfd_front_host_stats.restype = ctypes.c_long
+        lib.ccfd_front_host_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_double),
+        ]
         lib.ccfd_front_stop.restype = None
         lib.ccfd_front_stop.argtypes = [ctypes.c_void_p]
         lib.ccfd_front_destroy.restype = None

@@ -44,6 +44,7 @@
 #include <cctype>
 #include <cerrno>
 #include <chrono>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -106,6 +107,24 @@ struct Response {
   std::string data;
 };
 
+// In-front host-tier model: a small dense stack (relu hidden layers,
+// sigmoid head) scored directly in the IO thread for requests at or under
+// max_rows. This is the zero-handoff hot path: on a small host (the bench
+// box has ONE core) the C++->Python->C++ queue round trip per batch costs
+// more in context switches and GIL handoffs than the forward itself —
+// ~100k MACs for 16 rows of the flagship MLP, a few microseconds at -O3.
+// Larger requests still flow to the Python takers (device path).
+struct HostModel {
+  int n_layers = 0;
+  std::vector<int> dims;                 // n_layers+1: in, h1, ..., out(=1)
+  std::vector<std::vector<float>> w;     // w[l]: (dims[l+1] x dims[l]) row-major
+  std::vector<std::vector<float>> b;     // b[l]: dims[l+1]
+  std::vector<float> mu, inv_sigma;      // normalizer (identity if empty)
+  int max_rows = 0;
+  std::string model_name;
+  int gauge_cols[3] = {-1, -1, -1};      // Amount, V17, V10 column indices
+};
+
 struct Front {
   int listen_fd = -1;
   int epoll_fd = -1;
@@ -131,6 +150,17 @@ struct Front {
   long n_predict = 0;
   long n_misc = 0;
   long n_auth_fail = 0;
+
+  // host-tier model + its metrics (read via ccfd_front_host_stats; Python
+  // folds cumulative values into the registry at scrape time). Latency
+  // bucket layout mirrors the registry histogram: cumulative le counts.
+  HostModel* host = nullptr;
+  std::vector<double> lat_ubs;           // upper bounds, last is +inf
+  std::vector<long> host_hist[2];        // per endpoint tag, len(lat_ubs)
+  double host_sum[2] = {0.0, 0.0};
+  long n_host = 0;
+  float last_gauges[4] = {0, 0, 0, 0};   // proba_1, Amount, V17, V10
+  double last_gauge_ms = 0.0;            // CLOCK_MONOTONIC ms of last update
 };
 
 double now_ms() {
@@ -154,6 +184,127 @@ const char* reason_of(int status) {
     case 405: return "Method Not Allowed";
     case 413: return "Payload Too Large";
     default: return "Internal Server Error";
+  }
+}
+
+// Seldon predict response body: {"data": {...}, "meta": {...}} — the wire
+// format serving/server.py and ccfd_front_respond produce, byte-compatible.
+std::string format_predict_body(const float* probas, int rows,
+                                const char* model) {
+  std::string body;
+  body.reserve(64 + static_cast<size_t>(rows) * 48);
+  body += "{\"data\": {\"names\": [\"proba_0\", \"proba_1\"], \"ndarray\": [";
+  char num[64];
+  for (int r = 0; r < rows; ++r) {
+    double p = static_cast<double>(probas[r]);
+    if (r) body += ", ";
+    snprintf(num, sizeof(num), "[%.17g, %.17g]", 1.0 - p, p);
+    body += num;
+  }
+  body += "]}, \"meta\": {\"model\": \"";
+  body += model;
+  body += "\"}}";
+  return body;
+}
+
+float stable_sigmoid(float z) {
+  // overflow-safe in both tails (same shape as utils/metrics_math.py)
+  if (z >= 0.0f) return 1.0f / (1.0f + expf(-z));
+  float e = expf(z);
+  return e / (1.0f + e);
+}
+
+// Dense forward: normalize -> relu hidden layers -> sigmoid head.
+//
+// Layout + explicit SIMD are the whole game here. Lessons baked in (each
+// measured on the 30->256->256->1 flagship MLP, 1-vCPU serving host):
+// - a per-row scalar loop runs ~2 GFLOP/s (latency-bound accumulator
+//   chain): ~60us/row — 10x WORSE than numpy+BLAS;
+// - rows therefore process in tiles of kTile with activations TRANSPOSED
+//   (feature-major: act[j] is one 16-lane vector over the tile's rows),
+//   so every op vectorizes over rows the way BLAS kernels do;
+// - gcc-12's autovectorizer scalarizes this loop in context (it only
+//   vectorizes it as an isolated function), so the kernel uses explicit
+//   GCC vector extensions (v16) — lowered to zmm on AVX512, 2x ymm on
+//   AVX2 — instead of hoping;
+// - each activation lane load must feed SEVERAL outputs' FMAs (register
+//   blocking of 4) or the kernel is load-bound re-streaming the tile.
+// Result: ~1.4us/row, ~4x faster than the numpy host tier, ~45x over
+// the naive loop.
+typedef float v16 __attribute__((vector_size(64)));
+constexpr int kTile = 16;
+
+inline v16 splat(float s) { return ((v16){} + 1.0f) * s; }
+
+void dense_layer_tile(const float* __restrict W, const float* __restrict B,
+                      const v16* __restrict in, v16* __restrict out,
+                      int in_d, int out_d, bool relu) {
+  const v16 zero = {};
+  int o = 0;
+  for (; o + 4 <= out_d; o += 4) {
+    const float* __restrict w0 = W + static_cast<size_t>(o) * in_d;
+    const float* __restrict w1 = w0 + in_d;
+    const float* __restrict w2 = w1 + in_d;
+    const float* __restrict w3 = w2 + in_d;
+    v16 a0 = splat(B[o]), a1 = splat(B[o + 1]), a2 = splat(B[o + 2]),
+        a3 = splat(B[o + 3]);
+    for (int j = 0; j < in_d; ++j) {
+      const v16 lane = in[j];
+      a0 += w0[j] * lane;
+      a1 += w1[j] * lane;
+      a2 += w2[j] * lane;
+      a3 += w3[j] * lane;
+    }
+    if (relu) {
+      a0 = a0 > zero ? a0 : zero;
+      a1 = a1 > zero ? a1 : zero;
+      a2 = a2 > zero ? a2 : zero;
+      a3 = a3 > zero ? a3 : zero;
+    }
+    out[o] = a0;
+    out[o + 1] = a1;
+    out[o + 2] = a2;
+    out[o + 3] = a3;
+  }
+  for (; o < out_d; ++o) {
+    const float* __restrict wr = W + static_cast<size_t>(o) * in_d;
+    v16 acc = splat(B[o]);
+    for (int j = 0; j < in_d; ++j) acc += wr[j] * in[j];
+    if (relu) acc = acc > zero ? acc : zero;
+    out[o] = acc;
+  }
+}
+
+void host_model_score(const HostModel* m, const float* rows, int n_rows,
+                      int n_features, float* proba_out) {
+  int max_d = 0;
+  for (int d : m->dims) max_d = d > max_d ? d : max_d;
+  std::vector<v16> buf0(max_d), buf1(max_d);  // v16 allocations are aligned
+  for (int start = 0; start < n_rows; start += kTile) {
+    const int tr = n_rows - start < kTile ? n_rows - start : kTile;
+    v16* cur = buf0.data();
+    // load transposed (+normalize); pad lanes beyond tr with zeros
+    for (int j = 0; j < m->dims[0]; ++j) {
+      float* lane = reinterpret_cast<float*>(cur + j);
+      const float muj = m->mu.empty() ? 0.0f : m->mu[j];
+      const float isj = m->mu.empty() ? 1.0f : m->inv_sigma[j];
+      for (int t = 0; t < tr; ++t)
+        lane[t] =
+            (rows[static_cast<size_t>(start + t) * n_features + j] - muj) *
+            isj;
+      for (int t = tr; t < kTile; ++t) lane[t] = 0.0f;
+    }
+    v16* nxt = buf1.data();
+    for (int l = 0; l < m->n_layers; ++l) {
+      dense_layer_tile(m->w[l].data(), m->b[l].data(), cur, nxt, m->dims[l],
+                       m->dims[l + 1], l != m->n_layers - 1);
+      v16* tmp = cur;
+      cur = nxt;
+      nxt = tmp;
+    }
+    const float* z = reinterpret_cast<const float*>(cur);
+    for (int t = 0; t < tr; ++t)
+      proba_out[start + t] = stable_sigmoid(z[t]);
   }
 }
 
@@ -270,9 +421,11 @@ bool handle_one_request(Front* f, int fd, Conn* c) {
     if (p == "/predict") path_tag = 1;
   }
   if (method == "POST" && is_predict_path) {
-    // canonical payload -> native decode -> predict queue; anything odd
-    // (and anything over the native row cap) falls through to Python via
-    // the misc queue (exact-contract replies)
+    // canonical payload -> native decode -> host-tier score in THIS thread
+    // (small request + host model set) or the predict queue for Python/
+    // device scoring; anything odd (and anything over the native row cap)
+    // falls through to Python via the misc queue (exact-contract replies)
+    double t0 = now_ms();
     std::vector<float> rows;
     int est = 0;
     for (char ch : body)
@@ -283,11 +436,41 @@ bool handle_one_request(Front* f, int fd, Conn* c) {
       int n = ccfd_decode_ndarray(body.data(), body.size(), rows.data(), est,
                                   f->n_features, &width);
       if (n >= 0 && n <= kNativeMaxRows) {
+        if (f->host != nullptr && n <= f->host->max_rows) {
+          // zero-handoff path: parse -> forward -> format, one thread
+          std::vector<float> proba(n > 0 ? n : 1);
+          host_model_score(f->host, rows.data(), n, f->n_features,
+                           proba.data());
+          std::string body_out = format_predict_body(
+              proba.data(), n, f->host->model_name.c_str());
+          queue_write(f, fd, make_response(200, "application/json",
+                                           body_out.data(), body_out.size()));
+          ++f->n_host;
+          double lat_s = (now_ms() - t0) / 1e3;
+          int tag = path_tag ? 1 : 0;
+          if (!f->host_hist[tag].empty()) {
+            f->host_sum[tag] += lat_s;
+            for (size_t i = 0; i < f->lat_ubs.size(); ++i)
+              if (lat_s <= f->lat_ubs[i]) ++f->host_hist[tag][i];
+          }
+          if (n > 0) {
+            const float* lastrow =
+                rows.data() + static_cast<size_t>(n - 1) * f->n_features;
+            f->last_gauges[0] = proba[n - 1];
+            for (int g = 0; g < 3; ++g) {
+              int col = f->host->gauge_cols[g];
+              if (col >= 0 && col < f->n_features)
+                f->last_gauges[g + 1] = lastrow[col];
+            }
+            f->last_gauge_ms = now_ms();
+          }
+          return true;
+        }
         rows.resize(static_cast<size_t>(n) * f->n_features);
         int id = f->next_id++;
         f->req_route[id] = {c->gen, fd};
         f->predict_q.push_back(
-            {id, fd, c->gen, n, path_tag, std::move(rows), now_ms()});
+            {id, fd, c->gen, n, path_tag, std::move(rows), t0});
         ++f->n_predict;
         ++c->pending;  // a Connection:close conn must outlive its answers
         f->cv.notify_all();
@@ -591,20 +774,8 @@ void ccfd_front_respond(void* h, const int* req_ids, const int* row_counts,
   ready.reserve(n_reqs);
   for (int i = 0; i < n_reqs; ++i) {
     int rows = row_counts[i];
-    std::string body;
-    body.reserve(64 + static_cast<size_t>(rows) * 48);
-    body += "{\"data\": {\"names\": [\"proba_0\", \"proba_1\"], \"ndarray\": [";
-    char num[64];
-    for (int r = 0; r < rows; ++r) {
-      double p = static_cast<double>(probas[off + r]);
-      if (r) body += ", ";
-      snprintf(num, sizeof(num), "[%.17g, %.17g]", 1.0 - p, p);
-      body += num;
-    }
+    std::string body = format_predict_body(probas + off, rows, model);
     off += rows;
-    body += "]}, \"meta\": {\"model\": \"";
-    body += model;
-    body += "\"}}";
     Response resp;
     resp.data = make_response(200, "application/json", body.data(), body.size());
     ready.push_back(std::move(resp));
@@ -683,6 +854,86 @@ void ccfd_front_stats(void* h, long* out4) {
   out4[3] = f->n_auth_fail;
 }
 
+// Install/replace the in-front host-tier model. weights holds the layers
+// concatenated, each (dims[l+1] x dims[l]) ROW-MAJOR — i.e. transposed
+// from the Python (in x out) layout so every output neuron's weights are
+// contiguous. biases likewise concatenated. mean/inv_std are n_features
+// normalizer vectors (both null = identity). gauge_cols: column indices
+// for the Amount/V17/V10 gauges (-1 = absent). n_layers <= 0 or
+// max_rows <= 0 clears the model (requests flow to the Python takers).
+void ccfd_front_set_host_model(void* h, int n_layers, const int* dims,
+                               const float* weights, const float* biases,
+                               const float* mean, const float* inv_std,
+                               int max_rows, const char* model_name,
+                               const int* gauge_cols) {
+  Front* f = static_cast<Front*>(h);
+  HostModel* m = nullptr;
+  if (n_layers > 0 && max_rows > 0) {
+    m = new HostModel();
+    m->n_layers = n_layers;
+    m->dims.assign(dims, dims + n_layers + 1);
+    size_t w_off = 0;
+    size_t b_off = 0;
+    for (int l = 0; l < n_layers; ++l) {
+      size_t w_n = static_cast<size_t>(m->dims[l]) * m->dims[l + 1];
+      m->w.emplace_back(weights + w_off, weights + w_off + w_n);
+      w_off += w_n;
+      m->b.emplace_back(biases + b_off, biases + b_off + m->dims[l + 1]);
+      b_off += m->dims[l + 1];
+    }
+    if (mean != nullptr && inv_std != nullptr) {
+      m->mu.assign(mean, mean + m->dims[0]);
+      m->inv_sigma.assign(inv_std, inv_std + m->dims[0]);
+    }
+    m->max_rows = max_rows;
+    m->model_name = model_name != nullptr ? model_name : "model";
+    if (gauge_cols != nullptr)
+      for (int g = 0; g < 3; ++g) m->gauge_cols[g] = gauge_cols[g];
+  }
+  HostModel* old;
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    old = f->host;
+    f->host = m;
+  }
+  delete old;
+}
+
+// Latency-histogram bucket layout for host-scored requests; must match the
+// Python registry's histogram so cumulative counts fold 1:1 at scrape.
+void ccfd_front_set_latency_buckets(void* h, const double* ubs, int n) {
+  Front* f = static_cast<Front*>(h);
+  std::lock_guard<std::mutex> lk(f->mu);
+  f->lat_ubs.assign(ubs, ubs + n);
+  for (int tag = 0; tag < 2; ++tag) {
+    f->host_hist[tag].assign(static_cast<size_t>(n), 0);
+    f->host_sum[tag] = 0.0;
+  }
+}
+
+// Cumulative host-scored metrics: out_counts = 2 x n_buckets le-counts
+// (tag 0 then tag 1), out_sums = 2 latency sums, gauges = last
+// proba_1/Amount/V17/V10. Returns n_host; *last_gauge_ms_out is the
+// CLOCK_MONOTONIC ms of the newest host-scored gauge update so the
+// scraper can order it against Python-path gauge writes (same clock as
+// Python's time.monotonic) instead of overwriting newer values.
+long ccfd_front_host_stats(void* h, long* out_counts, double* out_sums,
+                           float* gauges, double* last_gauge_ms_out) {
+  Front* f = static_cast<Front*>(h);
+  std::lock_guard<std::mutex> lk(f->mu);
+  size_t nb = f->lat_ubs.size();
+  for (int tag = 0; tag < 2; ++tag) {
+    for (size_t i = 0; i < nb; ++i)
+      out_counts[tag * nb + i] = f->host_hist[tag].empty()
+                                     ? 0
+                                     : f->host_hist[tag][i];
+    out_sums[tag] = f->host_sum[tag];
+  }
+  for (int g = 0; g < 4; ++g) gauges[g] = f->last_gauges[g];
+  if (last_gauge_ms_out != nullptr) *last_gauge_ms_out = f->last_gauge_ms;
+  return f->n_host;
+}
+
 // Stop serving: wakes takers (they return -1) and joins the IO thread,
 // but does NOT free the Front — Python threads may still be inside
 // take()/take_misc() on this pointer. The caller joins its worker
@@ -714,6 +965,7 @@ void ccfd_front_destroy(void* h) {
   Front* f = static_cast<Front*>(h);
   close(f->epoll_fd);
   close(f->wake_fd);
+  delete f->host;
   delete f;
 }
 
@@ -738,6 +990,13 @@ void ccfd_front_free(char*) {}
 void ccfd_front_respond_misc(void*, int, int, const char*, const char*, int) {}
 void ccfd_front_stats(void*, long* out4) {
   out4[0] = out4[1] = out4[2] = out4[3] = 0;
+}
+void ccfd_front_set_host_model(void*, int, const int*, const float*,
+                               const float*, const float*, const float*, int,
+                               const char*, const int*) {}
+void ccfd_front_set_latency_buckets(void*, const double*, int) {}
+long ccfd_front_host_stats(void*, long*, double*, float*, double*) {
+  return 0;
 }
 void ccfd_front_stop(void*) {}
 void ccfd_front_destroy(void*) {}

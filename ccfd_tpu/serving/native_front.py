@@ -32,6 +32,41 @@ import numpy as np
 from ccfd_tpu.native import _load
 
 
+def extract_dense_model(spec_name: str, params) -> tuple | None:
+    """Flatten a scorer's host params into the C++ front's dense layout.
+
+    Returns ``(dims, weights, biases, mean, inv_std)`` — weights per layer
+    TRANSPOSED to (out x in) row-major and concatenated, so each output
+    neuron's weights are contiguous for the C++ inner loop — or None when
+    the model has no dense form (e.g. trees), in which case the front
+    keeps routing predict requests to the Python takers.
+    """
+    try:
+        if spec_name == "mlp":
+            layers = params["layers"]
+            dims = [int(np.asarray(layers[0]["w"]).shape[0])] + [
+                int(np.asarray(layer["w"]).shape[1]) for layer in layers
+            ]
+            weights = np.concatenate(
+                [np.asarray(layer["w"], np.float32).T.ravel() for layer in layers]
+            )
+            biases = np.concatenate(
+                [np.asarray(layer["b"], np.float32).ravel() for layer in layers]
+            )
+            mean = np.asarray(params["norm"]["mu"], np.float32)
+            sigma = np.asarray(params["norm"]["sigma"], np.float32)
+            inv_std = np.where(sigma == 0.0, 1.0, 1.0 / sigma).astype(np.float32)
+            return dims, weights, biases, mean, inv_std
+        if spec_name == "logreg":
+            w = np.asarray(params["w"], np.float32).reshape(-1)
+            b = np.asarray(params["b"], np.float32).reshape(-1)[:1]
+            # standardizer already folded into (w, b) by from_sklearn/fit
+            return [int(w.shape[0]), 1], w.copy(), b.copy(), None, None
+    except (KeyError, TypeError, IndexError, ValueError):
+        return None
+    return None
+
+
 class NativeFront:
     def __init__(
         self,
@@ -50,6 +85,18 @@ class NativeFront:
         self._max_reqs = max_reqs_per_take
         self._auth_fail_synced = 0
         self.server_address = ("0.0.0.0", 0)
+        # host-model scrape-fold state (see _sync_native_counters)
+        self._n_buckets = 0
+        self._host_synced_counts: np.ndarray | None = None
+        self._host_synced_sums = np.zeros(2, np.float64)
+        self._host_synced_n = 0
+        self._gauge_synced_ms = 0.0
+        self._swap_listener = None
+        # serializes host-model pushes (swap_params listener thread) against
+        # stop(): a push in flight must complete before the handle is torn
+        # down, or ctypes hands C++ a null/freed Front*
+        self._push_lock = threading.Lock()
+        self.host_model_active = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, port: int = 0, host: str = "0.0.0.0") -> int:
@@ -78,12 +125,82 @@ class NativeFront:
         )
         t.start()
         self._threads.append(t)
+        self._install_host_model()
         return int(port_out.value)
+
+    # -- in-front host-tier model ------------------------------------------
+    def _install_host_model(self) -> None:
+        """Push the scorer's host-tier params into the C++ front so small
+        canonical requests score in the IO thread with ZERO Python handoffs
+        (the decisive path on a small serving host: the queue round trip
+        costs more in context switches than the forward itself). Re-pushed
+        on every ``swap_params`` so online retrain reaches the front."""
+        srv = self._server
+        if srv.scorer.host_tier_rows <= 0:
+            return
+        host_params = getattr(srv.scorer, "_host_params", None)
+        if host_params is None:
+            return
+        h = srv._h_latency
+        ubs = (ctypes.c_double * len(h.buckets))(*h.buckets)
+        self._n_buckets = len(h.buckets)
+        self._lib.ccfd_front_set_latency_buckets(
+            self._handle, ubs, len(h.buckets)
+        )
+        self._host_synced_counts = np.zeros((2, self._n_buckets), np.int64)
+        self._host_synced_sums = np.zeros(2, np.float64)
+        if self._push_host_model(host_params):
+            self._swap_listener = self._push_host_model
+            srv.scorer.add_swap_listener(self._swap_listener)
+
+    def _push_host_model(self, host_params) -> bool:
+        extracted = extract_dense_model(self._server.scorer.spec.name, host_params)
+        if extracted is None:
+            return False
+        with self._push_lock:
+            if self._handle is None or self._stopping.is_set():
+                return False
+            return self._push_host_model_locked(extracted)
+
+    def _push_host_model_locked(self, extracted) -> bool:
+        dims, weights, biases, mean, inv_std = extracted
+        from ccfd_tpu.serving.server import _AMOUNT_COL, _V10_COL, _V17_COL
+
+        dims_c = (ctypes.c_int * len(dims))(*dims)
+        gcols = (ctypes.c_int * 3)(_AMOUNT_COL, _V17_COL, _V10_COL)
+        # locals keep the arrays alive across the ctypes call
+        w = np.ascontiguousarray(weights, np.float32)
+        b = np.ascontiguousarray(biases, np.float32)
+        m = None if mean is None else np.ascontiguousarray(mean, np.float32)
+        s = None if inv_std is None else np.ascontiguousarray(inv_std, np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        self._lib.ccfd_front_set_host_model(
+            self._handle,
+            len(dims) - 1,
+            dims_c,
+            w.ctypes.data_as(fp),
+            b.ctypes.data_as(fp),
+            None if m is None else m.ctypes.data_as(fp),
+            None if s is None else s.ctypes.data_as(fp),
+            int(self._server.scorer.host_tier_rows),
+            self._server.scorer.spec.name.encode(),
+            gcols,
+        )
+        self.host_model_active = True
+        return True
 
     def stop(self) -> None:
         if self._handle is None:
             return
+        if self._swap_listener is not None:
+            self._server.scorer.remove_swap_listener(self._swap_listener)
+            self._swap_listener = None
         self._stopping.set()
+        # barrier: a swap-listener push snapshotted before the removal
+        # above may still be inside the ctypes call — wait it out before
+        # tearing the handle down (it re-checks _stopping under this lock)
+        with self._push_lock:
+            pass
         # stop: wakes takers (-1) + joins the C++ IO thread; the handle
         # stays VALID until every Python worker that may be inside
         # take()/take_misc() has joined — only then destroy frees it
@@ -162,6 +279,7 @@ class NativeFront:
                 srv._g_amount.set(float(x[total - 1, _AMOUNT_COL]))
                 srv._g_v17.set(float(x[total - 1, _V17_COL]))
                 srv._g_v10.set(float(x[total - 1, _V10_COL]))
+                srv._gauges_set_ms = time.monotonic() * 1e3
 
     # -- everything else ---------------------------------------------------
     def _misc_loop(self) -> None:
@@ -205,10 +323,58 @@ class NativeFront:
             )
 
     def _sync_native_counters(self, handle) -> None:
-        """Fold C++-side 401 counts into the registry before a scrape."""
+        """Fold C++-side counts into the registry before a scrape: 401s,
+        plus everything the in-front host model scored without touching
+        Python — request counts, the seldon latency histogram (bucket
+        layout pushed at install matches 1:1), and the ModelPrediction
+        gauges from the last host-scored row."""
+        srv = self._server
         stats = (ctypes.c_long * 4)()
         self._lib.ccfd_front_stats(handle, stats)
         delta = int(stats[3]) - self._auth_fail_synced
         if delta > 0:
-            self._server._c_requests.inc(delta, labels={"code": "401"})
+            srv._c_requests.inc(delta, labels={"code": "401"})
             self._auth_fail_synced += delta
+
+        if self._host_synced_counts is None:
+            return
+        nb = self._n_buckets
+        counts = (ctypes.c_long * (2 * nb))()
+        sums = (ctypes.c_double * 2)()
+        gauges = (ctypes.c_float * 4)()
+        gauge_ms = ctypes.c_double(0.0)
+        n_host = int(
+            self._lib.ccfd_front_host_stats(
+                handle, counts, sums, gauges, ctypes.byref(gauge_ms)
+            )
+        )
+        d_n = n_host - self._host_synced_n
+        if d_n > 0:
+            srv._c_requests.inc(d_n, labels={"code": "200"})
+            self._host_synced_n = n_host
+        cur = np.frombuffer(counts, np.int64).reshape(2, nb).copy()
+        cur_sums = np.frombuffer(sums, np.float64).copy()
+        endpoints = ("/api/v0.1/predictions", "/predict")
+        for tag in (0, 1):
+            d_counts = cur[tag] - self._host_synced_counts[tag]
+            d_sum = cur_sums[tag] - self._host_synced_sums[tag]
+            if d_counts.any() or d_sum:
+                srv._h_latency.merge_counts(
+                    d_counts.tolist(), float(d_sum),
+                    labels={"endpoint": endpoints[tag]},
+                )
+        self._host_synced_counts = cur
+        self._host_synced_sums = cur_sums
+        # the "last scored" gauges must reflect whichever path scored most
+        # recently: fold the C++ values only when they are BOTH new since
+        # the last fold AND newer than the Python path's last write (same
+        # CLOCK_MONOTONIC as time.monotonic, ms)
+        host_ms = float(gauge_ms.value)
+        if host_ms > self._gauge_synced_ms and host_ms > getattr(
+            srv, "_gauges_set_ms", 0.0
+        ):
+            self._gauge_synced_ms = host_ms
+            srv._g_proba.set(float(gauges[0]))
+            srv._g_amount.set(float(gauges[1]))
+            srv._g_v17.set(float(gauges[2]))
+            srv._g_v10.set(float(gauges[3]))

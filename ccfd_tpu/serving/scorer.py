@@ -154,6 +154,10 @@ class Scorer:
             )
         self.host_tier_rows = int(host_tier_rows)
         self._host_params = None
+        # swap listeners: components holding a derived copy of the params
+        # (e.g. the C++ serving front's in-process host model) register to
+        # be re-fed on every swap_params so online retrain reaches them too
+        self._swap_listeners: list[Any] = []
         if self.host_tier_rows > 0 and self.spec.apply_numpy is not None:
             self._host_params = jax.tree.map(
                 lambda a: np.asarray(a, np.float32),
@@ -302,6 +306,26 @@ class Scorer:
             self._fused_params = staged_fused
             if staged_host is not None:
                 self._host_params = staged_host
+            listeners = list(self._swap_listeners)
+        if listeners:
+            host_tree = staged_host if staged_host is not None else jax.tree.map(
+                lambda a: np.asarray(a, np.float32), new_params
+            )
+            for fn in listeners:  # outside the lock: listeners may be slow
+                try:
+                    fn(host_tree)
+                except Exception:  # noqa: BLE001 - must not break swaps
+                    pass
+
+    def add_swap_listener(self, fn: Any) -> None:
+        """``fn(host_params_numpy_tree)`` runs after every ``swap_params``."""
+        with self._lock:
+            self._swap_listeners.append(fn)
+
+    def remove_swap_listener(self, fn: Any) -> None:
+        with self._lock:
+            if fn in self._swap_listeners:
+                self._swap_listeners.remove(fn)
 
     def score_pipelined(self, x: np.ndarray, depth: int = 2) -> np.ndarray:
         """Bulk scoring with ``depth`` dispatches in flight.

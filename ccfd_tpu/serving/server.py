@@ -77,6 +77,7 @@ class PredictionServer:
         self._g_v17 = r.gauge("V17", "last scored V17")
         self._g_v10 = r.gauge("V10", "last scored V10")
         self._httpd: FastHTTPServer | None = None
+        self._gauges_set_ms = 0.0  # last Python-path gauge write (monotonic ms)
         # dynamic batching (SURVEY.md §7 stage 2: request -> micro-batch
         # queue -> TPU): concurrent requests coalesce into one dispatch;
         # the adaptive policy adds no latency for a lone sequential client
@@ -116,6 +117,9 @@ class PredictionServer:
             self._g_amount.set(float(x[-1, _AMOUNT_COL]))
             self._g_v17.set(float(x[-1, _V17_COL]))
             self._g_v10.set(float(x[-1, _V10_COL]))
+            # recency stamp: the native front's scrape fold orders its
+            # host-scored gauge values against this (ms, CLOCK_MONOTONIC)
+            self._gauges_set_ms = time.monotonic() * 1e3
         return np.asarray(proba, np.float64)
 
     @staticmethod

@@ -1,0 +1,211 @@
+"""In-front C++ host-tier scoring (native/httpfront.cpp HostModel).
+
+Small canonical predict requests score INSIDE the C++ IO thread — decode,
+dense forward, response format — with zero Python handoffs; larger
+requests keep the Python taker/device path. These tests pin:
+
+- numeric parity of the C++ forward vs the model's numpy forward,
+- routing (small -> host model, large -> Python takers),
+- metrics folding at scrape time (histogram/counter/gauges),
+- param swaps propagating to the C++ copy (online-retrain path).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, synthetic_dataset
+from ccfd_tpu.models import logreg, mlp
+from ccfd_tpu.native import native_available
+from ccfd_tpu.serving.native_front import NativeFront, extract_dense_model
+from ccfd_tpu.serving.scorer import Scorer
+from ccfd_tpu.serving.server import PredictionServer
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no native toolchain"
+)
+
+
+def _mlp_params():
+    ds = synthetic_dataset(n=512, fraud_rate=0.05, seed=0)
+    params = mlp.init(jax.random.PRNGKey(0))
+    return mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0)), ds
+
+
+def _post_rows(port, rows):
+    body = json.dumps({"data": {"ndarray": rows}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+        body,
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.load(r)
+
+
+@pytest.fixture()
+def served():
+    params, ds = _mlp_params()
+    # host_tier_rows explicit: the auto policy disables the tier on a CPU
+    # backend, but the C++ path itself must be testable everywhere
+    scorer = Scorer(
+        model_name="mlp", params=params, batch_sizes=(16, 128),
+        compute_dtype="bfloat16", host_tier_rows=64,
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    front = srv._httpd
+    if not isinstance(front, NativeFront):
+        srv.stop()
+        pytest.skip("native front unavailable on this platform")
+    yield srv, front, scorer, ds, port
+    srv.stop()
+
+
+def test_host_model_active_and_parity(served):
+    srv, front, scorer, ds, port = served
+    assert front.host_model_active
+    rows = ds.X[:16].astype(float).tolist()
+    status, out = _post_rows(port, rows)
+    assert status == 200
+    got = np.asarray(out["data"]["ndarray"], np.float64)
+    want = scorer.spec.apply_numpy(scorer._host_params, ds.X[:16])
+    np.testing.assert_allclose(got[:, 1], want, atol=1e-5)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, atol=1e-6)
+    assert out["meta"]["model"] == "mlp"
+
+
+def test_small_requests_never_reach_python_takers(served):
+    srv, front, scorer, ds, port = served
+    import ctypes
+
+    for i in range(5):
+        _post_rows(port, ds.X[i : i + 8].astype(float).tolist())
+    stats = (ctypes.c_long * 4)()
+    front._lib.ccfd_front_stats(front._handle, stats)
+    assert stats[1] == 0  # n_predict: nothing queued to Python
+    # ...but a request over the tier threshold takes the Python path
+    _post_rows(port, ds.X[:128].astype(float).tolist())
+    front._lib.ccfd_front_stats(front._handle, stats)
+    assert stats[1] == 1
+
+
+def test_large_request_parity_through_python_path(served):
+    srv, front, scorer, ds, port = served
+    rows = ds.X[:128].astype(float).tolist()
+    status, out = _post_rows(port, rows)
+    assert status == 200
+    got = np.asarray(out["data"]["ndarray"], np.float64)[:, 1]
+    want = np.asarray(scorer.score(ds.X[:128]), np.float64)
+    np.testing.assert_allclose(got, want, atol=2e-2)  # bf16 device path
+
+
+def test_scrape_folds_host_metrics(served):
+    srv, front, scorer, ds, port = served
+    n = 7
+    for i in range(n):
+        _post_rows(port, ds.X[i : i + 4].astype(float).tolist())
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/prometheus", timeout=10
+    ) as r:
+        text = r.read().decode()
+    assert srv._h_latency.count(
+        labels={"endpoint": "/api/v0.1/predictions"}
+    ) == n
+    assert (
+        srv._c_requests.value(labels={"code": "200"}) >= n
+    )
+    # gauges carry the last host-scored row
+    amt_col = FEATURE_NAMES.index("Amount")
+    assert srv._g_amount.value() == pytest.approx(
+        float(np.float32(ds.X[n - 1 + 3, amt_col])), rel=1e-6
+    )
+    assert 0.0 <= srv._g_proba.value() <= 1.0
+    assert "seldon_api_executor_client_requests_seconds_bucket" in text
+    # double scrape must not double-fold (deltas, not cumulative re-adds)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/prometheus", timeout=10
+    ):
+        pass
+    assert srv._h_latency.count(
+        labels={"endpoint": "/api/v0.1/predictions"}
+    ) == n
+
+
+def test_mixed_traffic_gauges_keep_newest(served):
+    # host-scored small request first, then a Python-path large request:
+    # the scrape fold must NOT regress the "last scored" gauges to the
+    # older host-scored row (recency is ordered by monotonic timestamps)
+    srv, front, scorer, ds, port = served
+    amt_col = FEATURE_NAMES.index("Amount")
+    _post_rows(port, ds.X[:4].astype(float).tolist())          # host path
+    _post_rows(port, ds.X[4:132].astype(float).tolist())        # python path
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/prometheus", timeout=10
+    ):
+        pass
+    assert srv._g_amount.value() == pytest.approx(
+        float(np.float32(ds.X[131, amt_col])), rel=1e-6
+    )
+
+
+def test_swap_params_reaches_cpp_copy(served):
+    srv, front, scorer, ds, port = served
+    x = ds.X[:4]
+    _, out_before = _post_rows(port, x.astype(float).tolist())
+    p_before = np.asarray(out_before["data"]["ndarray"], np.float64)[:, 1]
+    # push the head bias way positive: probabilities must jump toward 1
+    new_params = jax.tree.map(lambda a: a, scorer._host_params)
+    new_params = {
+        "norm": dict(new_params["norm"]),
+        "layers": [dict(l) for l in new_params["layers"]],
+    }
+    new_params["layers"][-1]["b"] = np.asarray([25.0], np.float32)
+    scorer.swap_params(new_params)
+    _, out_after = _post_rows(port, x.astype(float).tolist())
+    p_after = np.asarray(out_after["data"]["ndarray"], np.float64)[:, 1]
+    assert (p_after > 0.99).all()
+    assert not (p_before > 0.99).all()
+
+
+def test_logreg_host_model_parity():
+    ds = synthetic_dataset(n=256, fraud_rate=0.1, seed=3)
+    params = logreg.fit_numpy(ds.X, ds.y)
+    scorer = Scorer(
+        model_name="logreg", params=params, batch_sizes=(16, 128),
+        compute_dtype="float32", host_tier_rows=64,
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        front = srv._httpd
+        if not isinstance(front, NativeFront):
+            pytest.skip("native front unavailable")
+        assert front.host_model_active
+        status, out = _post_rows(port, ds.X[:16].astype(float).tolist())
+        assert status == 200
+        got = np.asarray(out["data"]["ndarray"], np.float64)[:, 1]
+        want = logreg.apply_numpy(scorer._host_params, ds.X[:16])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_extract_dense_model_shapes():
+    params, _ = _mlp_params()
+    host = jax.tree.map(lambda a: np.asarray(a, np.float32), params)
+    dims, w, b, mean, inv_std = extract_dense_model("mlp", host)
+    assert dims[0] == 30 and dims[-1] == 1
+    assert w.shape[0] == sum(dims[i] * dims[i + 1] for i in range(len(dims) - 1))
+    assert b.shape[0] == sum(dims[1:])
+    assert mean.shape == (30,) and inv_std.shape == (30,)
+    assert extract_dense_model("trees", {"whatever": 1}) is None
