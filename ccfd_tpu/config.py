@@ -86,7 +86,9 @@ class Config:
     batch_workers: int = 4  # overlapped dispatches (device-RTT pipelining)
     dynamic_batching: bool = True  # serving-side request coalescing
     native_front: bool = True  # C++ HTTP front when the toolchain allows
-    host_tier_rows: int = -1  # -1 = auto (256 on accelerator backends); 0 = off
+    host_tier_rows: int = -1  # -1 = auto: measured at scorer warmup (host
+    # forward rate vs device dispatch RTT, crossover at RTT/2, <=8192;
+    # 256 provisionally until warmup runs); 0 = off; >0 = fixed threshold
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
 

@@ -68,6 +68,15 @@ def extract_dense_model(spec_name: str, params) -> tuple | None:
 
 
 class NativeFront:
+    # In-IO-thread scoring cap, SEPARATE from the scorer's host-tier
+    # threshold: the epoll thread serializes all connections, so an inline
+    # score must stay well under a millisecond (~512 rows at ~1.4 us/row)
+    # or one big request head-of-line blocks every other client. Requests
+    # between this cap and host_tier_rows still avoid the device — they
+    # flow to the Python takers where scorer.score applies the numpy host
+    # tier on a worker thread.
+    INLINE_MAX_ROWS = 512
+
     def __init__(
         self,
         server,  # PredictionServer (duck-typed: scorer, cfg, registry, ...)
@@ -182,7 +191,7 @@ class NativeFront:
             b.ctypes.data_as(fp),
             None if m is None else m.ctypes.data_as(fp),
             None if s is None else s.ctypes.data_as(fp),
-            int(self._server.scorer.host_tier_rows),
+            min(int(self._server.scorer.host_tier_rows), self.INLINE_MAX_ROWS),
             self._server.scorer.spec.name.encode(),
             gcols,
         )

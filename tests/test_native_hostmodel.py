@@ -140,6 +140,36 @@ def test_scrape_folds_host_metrics(served):
     ) == n
 
 
+def test_inline_cap_independent_of_host_tier():
+    # a large autotuned host tier must NOT widen the in-IO-thread scoring
+    # cap: above INLINE_MAX_ROWS requests go to the Python takers (where
+    # the numpy host tier still applies), keeping the epoll loop unblocked
+    import ctypes
+
+    params, ds = _mlp_params()
+    scorer = Scorer(
+        model_name="mlp", params=params, batch_sizes=(16, 1024),
+        compute_dtype="bfloat16", host_tier_rows=2048,
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        front = srv._httpd
+        if not isinstance(front, NativeFront):
+            pytest.skip("native front unavailable")
+        big = np.tile(ds.X, (2, 1))  # the fixture dataset is only 512 rows
+        _post_rows(port, big[:512].astype(float).tolist())  # at the cap
+        stats = (ctypes.c_long * 4)()
+        front._lib.ccfd_front_stats(front._handle, stats)
+        assert stats[1] == 0  # inline-scored
+        _post_rows(port, big[:513].astype(float).tolist())  # over the cap
+        front._lib.ccfd_front_stats(front._handle, stats)
+        assert stats[1] == 1  # python takers (host tier, off the IO thread)
+    finally:
+        srv.stop()
+
+
 def test_mixed_traffic_gauges_keep_newest(served):
     # host-scored small request first, then a Python-path large request:
     # the scrape fold must NOT regress the "last scored" gauges to the

@@ -294,6 +294,27 @@ def test_host_tier_auto_off_on_cpu_backend():
     assert s.host_tier_rows == 0  # default backend here is cpu
 
 
+def test_host_tier_autotune_measures_crossover():
+    """The auto threshold is a measured property of the attachment: rows
+    where host forward cost reaches half the device dispatch RTT. An
+    explicit host_tier_rows must never be adapted away."""
+    import jax as _jax
+
+    from ccfd_tpu.serving.scorer import Scorer
+
+    s = Scorer(model_name="mlp", batch_sizes=(16,), host_tier_rows=256)
+    s.warmup()
+    assert not s._host_tier_auto
+    assert s.host_tier_rows == 256  # explicit value survives warmup
+
+    thr = s._autotune_host_tier()
+    assert 0 <= thr <= 8192
+    # on this CPU backend the "device" and host run the same silicon, so
+    # the crossover must be modest (RTT/2 of a 16-row dispatch cannot
+    # justify thousands of host rows)
+    assert thr < 8192
+
+
 def test_host_tier_logreg_numpy_matches_jax():
     import jax as _jax
 
