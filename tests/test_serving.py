@@ -298,8 +298,6 @@ def test_host_tier_autotune_measures_crossover():
     """The auto threshold is a measured property of the attachment: rows
     where host forward cost reaches half the device dispatch RTT. An
     explicit host_tier_rows must never be adapted away."""
-    import jax as _jax
-
     from ccfd_tpu.serving.scorer import Scorer
 
     s = Scorer(model_name="mlp", batch_sizes=(16,), host_tier_rows=256)
