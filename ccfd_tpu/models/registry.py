@@ -57,5 +57,6 @@ register_model(
         trees.apply,
         trees.logits,
         trainable=False,
+        apply_numpy=trees.apply_numpy,
     )
 )

@@ -73,6 +73,33 @@ def apply(params: Params, x: jax.Array) -> jax.Array:
     return jax.nn.sigmoid(logits(params, x))
 
 
+def apply_numpy(params: Params, x: np.ndarray) -> np.ndarray:
+    """Pure-numpy forward, semantically `apply` without a device.
+
+    Enables the serving host latency tier for the tree family (the
+    reference's actual model class — sklearn `modelfull`): same lockstep
+    descent as `logits`, with numpy gathers. Params must be host arrays.
+    """
+    from ccfd_tpu.utils.metrics_math import stable_sigmoid
+
+    feat = np.asarray(params["feature"])
+    thr = np.asarray(params["threshold"])
+    leaf = np.asarray(params["leaf"])
+    x = np.asarray(x, np.float32)
+    n_trees = leaf.shape[0]
+    depth = depth_of(params)
+    tree_ids = np.arange(n_trees)[None, :]
+    idx = np.zeros((x.shape[0], n_trees), np.int32)
+    for _ in range(depth):
+        node_feat = feat[tree_ids, idx]  # (B, T)
+        node_thr = thr[tree_ids, idx]
+        xv = np.take_along_axis(x, node_feat, axis=1)
+        idx = 2 * idx + 1 + (xv > node_thr).astype(np.int32)
+    leaf_idx = idx - num_internal(depth)
+    z = float(params["base"]) + leaf[tree_ids, leaf_idx].sum(axis=-1)
+    return stable_sigmoid(z.astype(np.float32))
+
+
 def _embed_tree(
     children_left: np.ndarray,
     children_right: np.ndarray,

@@ -128,6 +128,13 @@ def _load():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
         ]
+        lib.ccfd_front_set_host_trees.restype = None
+        lib.ccfd_front_set_host_trees.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_float, ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ]
         lib.ccfd_front_set_latency_buckets.restype = None
         lib.ccfd_front_set_latency_buckets.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,

@@ -115,11 +115,21 @@ struct Response {
 // ~100k MACs for 16 rows of the flagship MLP, a few microseconds at -O3.
 // Larger requests still flow to the Python takers (device path).
 struct HostModel {
+  // dense stack (n_layers > 0) ...
   int n_layers = 0;
   std::vector<int> dims;                 // n_layers+1: in, h1, ..., out(=1)
   std::vector<std::vector<float>> w;     // w[l]: (dims[l+1] x dims[l]) row-major
   std::vector<std::vector<float>> b;     // b[l]: dims[l+1]
   std::vector<float> mu, inv_sigma;      // normalizer (identity if empty)
+  // ... or a boosted tree ensemble (n_trees > 0): complete binary trees
+  // of depth tree_depth in heap layout, the same dense embedding the XLA
+  // path uses (models/trees.py)
+  int n_trees = 0;
+  int tree_depth = 0;
+  std::vector<int32_t> t_feat;           // (T x 2^D-1) split feature ids
+  std::vector<float> t_thr;              // (T x 2^D-1) split thresholds
+  std::vector<float> t_leaf;             // (T x 2^D) leaf values
+  float t_base = 0.0f;
   int max_rows = 0;
   std::string model_name;
   int gauge_cols[3] = {-1, -1, -1};      // Amount, V17, V10 column indices
@@ -275,8 +285,38 @@ void dense_layer_tile(const float* __restrict W, const float* __restrict B,
   }
 }
 
+// Boosted-ensemble eval: per row, every tree descends its D levels in a
+// tight scalar loop over tiny resident arrays (a 100-tree depth-4
+// ensemble is ~400 compare+index steps ≈ 1-2us/row — the gathers don't
+// vectorize with portable vector extensions, and don't need to).
+void host_trees_score(const HostModel* m, const float* rows, int n_rows,
+                      int n_features, float* proba_out) {
+  const int n_int = (1 << m->tree_depth) - 1;
+  const int n_leaf = 1 << m->tree_depth;
+  for (int r = 0; r < n_rows; ++r) {
+    const float* x = rows + static_cast<size_t>(r) * n_features;
+    float acc = m->t_base;
+    for (int t = 0; t < m->n_trees; ++t) {
+      const int32_t* feat = m->t_feat.data() + static_cast<size_t>(t) * n_int;
+      const float* thr = m->t_thr.data() + static_cast<size_t>(t) * n_int;
+      int idx = 0;
+      for (int level = 0; level < m->tree_depth; ++level) {
+        const int32_t f = feat[idx];
+        const float xv = (f >= 0 && f < n_features) ? x[f] : 0.0f;
+        idx = 2 * idx + 1 + (xv > thr[idx] ? 1 : 0);
+      }
+      acc += m->t_leaf[static_cast<size_t>(t) * n_leaf + (idx - n_int)];
+    }
+    proba_out[r] = stable_sigmoid(acc);
+  }
+}
+
 void host_model_score(const HostModel* m, const float* rows, int n_rows,
                       int n_features, float* proba_out) {
+  if (m->n_trees > 0) {
+    host_trees_score(m, rows, n_rows, n_features, proba_out);
+    return;
+  }
   int max_d = 0;
   for (int d : m->dims) max_d = d > max_d ? d : max_d;
   std::vector<v16> buf0(max_d), buf1(max_d);  // v16 allocations are aligned
@@ -854,6 +894,28 @@ void ccfd_front_stats(void* h, long* out4) {
   out4[3] = f->n_auth_fail;
 }
 
+namespace {
+// Shared install protocol for every host-model family: fill the common
+// fields and swap the pointer under the front's mutex. One copy of the
+// swap discipline — the per-family setters only build their payload.
+void install_host_model(Front* f, HostModel* m, int max_rows,
+                        const char* model_name, const int* gauge_cols) {
+  if (m != nullptr) {
+    m->max_rows = max_rows;
+    m->model_name = model_name != nullptr ? model_name : "model";
+    if (gauge_cols != nullptr)
+      for (int g = 0; g < 3; ++g) m->gauge_cols[g] = gauge_cols[g];
+  }
+  HostModel* old;
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    old = f->host;
+    f->host = m;
+  }
+  delete old;
+}
+}  // namespace
+
 // Install/replace the in-front host-tier model. weights holds the layers
 // concatenated, each (dims[l+1] x dims[l]) ROW-MAJOR — i.e. transposed
 // from the Python (in x out) layout so every output neuron's weights are
@@ -885,18 +947,33 @@ void ccfd_front_set_host_model(void* h, int n_layers, const int* dims,
       m->mu.assign(mean, mean + m->dims[0]);
       m->inv_sigma.assign(inv_std, inv_std + m->dims[0]);
     }
-    m->max_rows = max_rows;
-    m->model_name = model_name != nullptr ? model_name : "model";
-    if (gauge_cols != nullptr)
-      for (int g = 0; g < 3; ++g) m->gauge_cols[g] = gauge_cols[g];
   }
-  HostModel* old;
-  {
-    std::lock_guard<std::mutex> lk(f->mu);
-    old = f->host;
-    f->host = m;
+  install_host_model(f, m, max_rows, model_name, gauge_cols);
+}
+
+// Install/replace an in-front boosted-tree ensemble (the tree analog of
+// ccfd_front_set_host_model): feat/thr are (n_trees x 2^depth-1), leaf is
+// (n_trees x 2^depth), heap layout, identical semantics to the XLA
+// evaluator in models/trees.py. n_trees <= 0 or max_rows <= 0 clears.
+void ccfd_front_set_host_trees(void* h, int n_trees, int depth,
+                               const int32_t* feat, const float* thr,
+                               const float* leaf, float base, int max_rows,
+                               const char* model_name,
+                               const int* gauge_cols) {
+  Front* f = static_cast<Front*>(h);
+  HostModel* m = nullptr;
+  if (n_trees > 0 && depth > 0 && max_rows > 0) {
+    m = new HostModel();
+    m->n_trees = n_trees;
+    m->tree_depth = depth;
+    const size_t n_int = (static_cast<size_t>(1) << depth) - 1;
+    const size_t n_leaf = static_cast<size_t>(1) << depth;
+    m->t_feat.assign(feat, feat + n_trees * n_int);
+    m->t_thr.assign(thr, thr + n_trees * n_int);
+    m->t_leaf.assign(leaf, leaf + n_trees * n_leaf);
+    m->t_base = base;
   }
-  delete old;
+  install_host_model(f, m, max_rows, model_name, gauge_cols);
 }
 
 // Latency-histogram bucket layout for host-scored requests; must match the
@@ -974,6 +1051,7 @@ void ccfd_front_destroy(void* h) {
 #else  // !__linux__: stubs — native front unavailable, Python transport used
 
 #include <cstddef>
+#include <cstdint>
 
 extern "C" {
 
@@ -994,6 +1072,9 @@ void ccfd_front_stats(void*, long* out4) {
 void ccfd_front_set_host_model(void*, int, const int*, const float*,
                                const float*, const float*, const float*, int,
                                const char*, const int*) {}
+void ccfd_front_set_host_trees(void*, int, int, const int32_t*, const float*,
+                               const float*, float, int, const char*,
+                               const int*) {}
 void ccfd_front_set_latency_buckets(void*, const double*, int) {}
 long ccfd_front_host_stats(void*, long*, double*, float*, double*) {
   return 0;

@@ -57,7 +57,7 @@ def extract_dense_model(spec_name: str, params) -> tuple | None:
             sigma = np.asarray(params["norm"]["sigma"], np.float32)
             inv_std = np.where(sigma == 0.0, 1.0, 1.0 / sigma).astype(np.float32)
             return dims, weights, biases, mean, inv_std
-        if spec_name == "logreg":
+        if spec_name in ("logreg", "modelfull"):
             w = np.asarray(params["w"], np.float32).reshape(-1)
             b = np.asarray(params["b"], np.float32).reshape(-1)[:1]
             # standardizer already folded into (w, b) by from_sklearn/fit
@@ -65,6 +65,26 @@ def extract_dense_model(spec_name: str, params) -> tuple | None:
     except (KeyError, TypeError, IndexError, ValueError):
         return None
     return None
+
+
+def extract_tree_model(params) -> tuple | None:
+    """Flatten a tree-ensemble param tree (models/trees.py dense embedding)
+    into the C++ front's layout: ``(n_trees, depth, feat, thr, leaf, base)``
+    with feat/thr/leaf as flat contiguous arrays in heap order."""
+    from ccfd_tpu.models import trees
+
+    try:
+        feat = np.ascontiguousarray(params["feature"], np.int32)
+        thr = np.ascontiguousarray(params["threshold"], np.float32)
+        leaf = np.ascontiguousarray(params["leaf"], np.float32)
+        n_trees = int(leaf.shape[0])
+        depth = trees.depth_of(params)
+        if feat.shape != (n_trees, trees.num_internal(depth)) or \
+                thr.shape != feat.shape:
+            return None
+        return n_trees, depth, feat, thr, leaf, float(params["base"])
+    except (KeyError, TypeError, IndexError, ValueError):
+        return None
 
 
 class NativeFront:
@@ -163,20 +183,51 @@ class NativeFront:
             srv.scorer.add_swap_listener(self._swap_listener)
 
     def _push_host_model(self, host_params) -> bool:
-        extracted = extract_dense_model(self._server.scorer.spec.name, host_params)
+        spec_name = self._server.scorer.spec.name
+        if spec_name == "gbt":
+            extracted = extract_tree_model(host_params)
+            pusher = self._push_host_trees_locked
+        else:
+            extracted = extract_dense_model(spec_name, host_params)
+            pusher = self._push_host_model_locked
         if extracted is None:
             return False
+        # one guarded call for every model family: the stop()-vs-push
+        # interlock (handle/stopping re-check under the lock) must not be
+        # duplicated per branch
         with self._push_lock:
             if self._handle is None or self._stopping.is_set():
                 return False
-            return self._push_host_model_locked(extracted)
+            return pusher(extracted)
+
+    def _gauge_cols(self):
+        from ccfd_tpu.serving.server import _AMOUNT_COL, _V10_COL, _V17_COL
+
+        return (ctypes.c_int * 3)(_AMOUNT_COL, _V17_COL, _V10_COL)
+
+    def _push_host_trees_locked(self, trees) -> bool:
+        n_trees, depth, feat, thr, leaf, base = trees
+        fp = ctypes.POINTER(ctypes.c_float)
+        self._lib.ccfd_front_set_host_trees(
+            self._handle,
+            n_trees,
+            depth,
+            feat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            thr.ctypes.data_as(fp),
+            leaf.ctypes.data_as(fp),
+            base,
+            min(int(self._server.scorer.host_tier_rows), self.INLINE_MAX_ROWS),
+            self._server.scorer.spec.name.encode(),
+            self._gauge_cols(),
+        )
+        self.host_model_active = True
+        return True
 
     def _push_host_model_locked(self, extracted) -> bool:
         dims, weights, biases, mean, inv_std = extracted
-        from ccfd_tpu.serving.server import _AMOUNT_COL, _V10_COL, _V17_COL
 
         dims_c = (ctypes.c_int * len(dims))(*dims)
-        gcols = (ctypes.c_int * 3)(_AMOUNT_COL, _V17_COL, _V10_COL)
+        gcols = self._gauge_cols()
         # locals keep the arrays alive across the ctypes call
         w = np.ascontiguousarray(weights, np.float32)
         b = np.ascontiguousarray(biases, np.float32)

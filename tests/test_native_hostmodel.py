@@ -230,6 +230,58 @@ def test_logreg_host_model_parity():
         srv.stop()
 
 
+def test_gbt_host_model_parity():
+    """The C++ tree kernel == the XLA/numpy evaluators on a REAL fitted
+    sklearn ensemble (the reference's actual model family)."""
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from ccfd_tpu.models import trees
+
+    ds = synthetic_dataset(n=600, fraud_rate=0.15, seed=4)
+    clf = GradientBoostingClassifier(
+        n_estimators=20, max_depth=3, random_state=0
+    ).fit(ds.X, ds.y)
+    params = trees.from_sklearn_gbt(clf)
+    scorer = Scorer(
+        model_name="gbt", params=params, batch_sizes=(16, 128),
+        host_tier_rows=64,
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        front = srv._httpd
+        if not isinstance(front, NativeFront):
+            pytest.skip("native front unavailable")
+        assert front.host_model_active
+        status, out = _post_rows(port, ds.X[:32].astype(float).tolist())
+        assert status == 200
+        got = np.asarray(out["data"]["ndarray"], np.float64)[:, 1]
+        want_np = trees.apply_numpy(
+            jax.tree.map(np.asarray, params), ds.X[:32]
+        )
+        want_sk = clf.predict_proba(ds.X[:32])[:, 1]
+        np.testing.assert_allclose(got, want_np, atol=1e-5)
+        np.testing.assert_allclose(got, want_sk, atol=1e-4)
+    finally:
+        srv.stop()
+
+
+def test_trees_apply_numpy_matches_jax():
+    from ccfd_tpu.models import trees
+
+    ds = synthetic_dataset(n=256, fraud_rate=0.2, seed=6)
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    clf = GradientBoostingClassifier(
+        n_estimators=10, max_depth=4, random_state=1
+    ).fit(ds.X, ds.y)
+    params = trees.from_sklearn_gbt(clf)
+    want = np.asarray(trees.apply(params, ds.X[:100]))
+    got = trees.apply_numpy(jax.tree.map(np.asarray, params), ds.X[:100])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
 def test_extract_dense_model_shapes():
     params, _ = _mlp_params()
     host = jax.tree.map(lambda a: np.asarray(a, np.float32), params)
