@@ -27,7 +27,9 @@ Prints ONE JSON line; primary fields:
   {"metric": ..., "value": tx/s, "unit": "tx/s", "vs_baseline": ratio,
    "p99_ms": ..., "platform": ...}
 plus sections ``rest`` / ``pipeline`` / ``fused_ab`` / ``mesh`` /
-``retrain`` / ``seq``.
+``retrain`` / ``seq`` / ``zoo`` (logreg + GBT scorer hop) /
+``quant_int8`` (int8 vs the bf16 headline on the same hop; TPU-gated,
+force with CCFD_BENCH_QUANT=1).
 
 ``vs_baseline`` is the ratio against the 50,000 tx/s north-star target
 (BASELINE.json; the reference publishes no numbers of its own). ``p99_ms``
@@ -50,8 +52,9 @@ CCFD_BENCH_LATENCY_BATCH (default 4096), CCFD_BENCH_PLATFORM=cpu to force
 CPU, CCFD_BENCH_PROBE_S (per-attempt probe timeout, default 90),
 CCFD_BENCH_PROBE_ATTEMPTS (default 5), CCFD_BENCH_PROBE_BACKOFF_S (default
 45), CCFD_BENCH_REST_CLIENTS (default 8), CCFD_BENCH_REST_ROWS (rows per
-request, default 16), CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq to
-skip sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
+request, default 16),
+CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq,zoo,quant to skip
+sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
 a tunnel that wedges MID-run would otherwise hang the bench forever;
 on expiry the newest cached TPU result is printed and the process exits 3).
 """
@@ -369,6 +372,77 @@ def _bench_retrain(seconds):
     }
 
 
+def _scorer_hop_rate(name, params, x, seconds):
+    """Time the REAL scorer hop for one model: numpy in, probabilities on
+    host out, full H2D + dispatch + D2H per call through the Scorer (host
+    tier forced off so the number is the device path) — the same surface
+    the headline MLP metric measures, so the zoo ranks comparably."""
+    from ccfd_tpu.serving.scorer import Scorer
+
+    s = Scorer(model_name=name, params=params, batch_sizes=(x.shape[0],),
+               host_tier_rows=0, use_fused=False)
+    s.warmup()
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        s.score(x)
+        n += x.shape[0]
+    return round(n / (time.perf_counter() - t0), 1)
+
+
+def _bench_zoo(seconds, batch=16384):
+    """Scorer-hop throughput for the rest of the model zoo (the headline
+    number is the flagship MLP): logreg (reference modelfull parity family)
+    and the tensorized GBT ensemble, each through the same Scorer hop as
+    the headline."""
+    import jax
+    import numpy as np
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import logreg, trees
+
+    ds = synthetic_dataset(n=batch, fraud_rate=0.01, seed=4)
+    gbt_params = trees.init_empty(n_trees=100, depth=4)
+    # randomized splits so gathers hit varied nodes (an all-inf threshold
+    # ensemble would descend one hot path and flatter the number)
+    rng = np.random.default_rng(0)
+    gbt_params = {
+        "feature": jax.numpy.asarray(
+            rng.integers(0, 30, gbt_params["feature"].shape), "int32"
+        ),
+        "threshold": jax.numpy.asarray(
+            rng.normal(size=gbt_params["threshold"].shape), "float32"
+        ),
+        "leaf": jax.numpy.asarray(
+            rng.normal(scale=0.05, size=gbt_params["leaf"].shape), "float32"
+        ),
+        "base": gbt_params["base"],
+    }
+    out = {}
+    for name, params in (
+        ("logreg", logreg.fit_numpy(ds.X[:2048], ds.y[:2048])),
+        ("gbt", gbt_params),
+    ):
+        out[name] = {"tx_s": _scorer_hop_rate(name, params, ds.X, seconds),
+                     "batch": batch}
+    return out
+
+
+def _bench_quant(params, x, seconds):
+    """Int8 vs the bf16 headline on the SAME Scorer hop: per-channel int8
+    weights + per-row dynamic activations ride the MXU at twice the bf16
+    rate and halve the wire bytes (ops/quant.py); measuring through the
+    full H2D/D2H round trip is what lets the wire half show."""
+    from ccfd_tpu.ops import quant as quantlib
+
+    qp = quantlib.quantize_mlp(params)
+    return {
+        "tx_s": _scorer_hop_rate("mlp_q8", qp, x, seconds),
+        "batch": int(x.shape[0]),
+        "dtype": "int8",
+    }
+
+
 def _arm_watchdog() -> None:
     """The tunnel can wedge MID-bench (after a successful probe), leaving a
     device wait blocked forever inside XLA — unkillable from Python. If the
@@ -590,6 +664,14 @@ def main() -> None:
     if "seq" not in skip:
         seq_res = _bench_seq(max(1.0, seconds / 2))
 
+    zoo_res = None
+    if "zoo" not in skip:
+        zoo_res = _bench_zoo(max(1.0, seconds / 3))
+
+    quant_res = None
+    if "quant" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_QUANT")):
+        quant_res = _bench_quant(params, ds.X[:batch], max(1.0, seconds / 2))
+
     # the e2e p99 the north star talks about is the REST predict hop when
     # measured; the raw scorer-hop p99 otherwise (also when the REST
     # section errored — its numbers are then absent, not zero)
@@ -622,6 +704,10 @@ def main() -> None:
         result["retrain"] = retrain_res
     if seq_res is not None:
         result["seq"] = seq_res
+    if zoo_res is not None:
+        result["zoo"] = zoo_res
+    if quant_res is not None:
+        result["quant_int8"] = quant_res
 
     if on_tpu:
         # cache this as the round's last-good TPU number: later fallback

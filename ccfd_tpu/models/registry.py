@@ -60,3 +60,10 @@ register_model(
         apply_numpy=trees.apply_numpy,
     )
 )
+
+# int8 quantized serving graph: registered here so CCFD_MODEL=mlp_q8 is a
+# working drop-in everywhere models resolve by name (quant.py's imports of
+# this module are all deferred inside register(), so no cycle)
+from ccfd_tpu.ops import quant as _quant  # noqa: E402
+
+_quant.register()
