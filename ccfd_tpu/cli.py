@@ -143,6 +143,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
         # followed by `ccfd_tpu serve` serves the trained (AUC-recorded)
         # params instead of random init
         params = _restore_mlp_checkpoint(getattr(args, "checkpoint_dir", ""))
+    elif cfg.model_name == "mlp_q8":
+        # int8 lifecycle: `train` -> `quantize` -> CCFD_MODEL=mlp_q8 serve
+        params = _restore_q8_checkpoint(getattr(args, "quantized_dir", ""))
     scorer = Scorer(
         model_name=cfg.model_name, params=params, compute_dtype=cfg.compute_dtype,
         batch_sizes=cfg.batch_sizes,
@@ -231,27 +234,96 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
-def _restore_mlp_checkpoint(checkpoint_dir: str):
-    """Latest `train` checkpoint as MLP params, or None. The checkpoint
-    format is the MLP's pytree, so callers must only apply this when the
-    configured model is the MLP (serve and score share this guard)."""
+def _restore_checkpoint(checkpoint_dir: str, like):
+    """Latest checkpoint structured like ``like``, or None."""
     if not checkpoint_dir:
         return None
-    import jax
-
-    from ccfd_tpu.models import mlp as mlp_mod
     from ccfd_tpu.parallel.checkpoint import CheckpointManager
 
     mgr = CheckpointManager(checkpoint_dir)
     if mgr.latest_step() is None:
         return None
-    restored = mgr.restore(mlp_mod.init(jax.random.PRNGKey(0)))
+    restored = mgr.restore(like)
     if restored is None:
         return None
     params, step = restored
     print(f"[checkpoint] restored step={step} from {checkpoint_dir}",
           file=sys.stderr)
     return params
+
+
+_Q8_DIR = "./checkpoints_q8"  # quantize writes here; serve/score read it
+
+
+def _restore_mlp_checkpoint(checkpoint_dir: str):
+    """Latest `train` checkpoint as MLP params, or None. The checkpoint
+    format is the MLP's pytree, so callers must only apply this when the
+    configured model is the MLP (serve and score share this guard)."""
+    import jax
+
+    from ccfd_tpu.models import mlp as mlp_mod
+
+    return _restore_checkpoint(
+        checkpoint_dir, mlp_mod.init(jax.random.PRNGKey(0))
+    )
+
+
+def _restore_q8_checkpoint(quantized_dir: str):
+    """Latest `quantize` checkpoint as mlp_q8 params, or None."""
+    from ccfd_tpu.models.registry import get_model
+
+    return _restore_checkpoint(quantized_dir or _Q8_DIR,
+                               get_model("mlp_q8").init())
+
+
+def cmd_quantize(args: argparse.Namespace) -> int:
+    """Model-lifecycle step between `train` and `serve`: load the newest
+    f32 MLP checkpoint, emit int8 params (ops/quant.py) plus evidence
+    that quantization kept the model's quality. The evidence is the
+    f32-to-int8 DELTA (AUC and probability) on a sampled evaluation set —
+    both models score identical rows, so the delta is valid even if this
+    run's dataset/sample differs from the train run's held-out split;
+    absolute held-out AUC is `train`'s claim, recorded at training time."""
+    import jax
+    import numpy as np
+
+    from ccfd_tpu.data.ccfd import load_dataset
+    from ccfd_tpu.models import mlp as mlp_mod
+    from ccfd_tpu.ops import quant
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.utils.metrics_math import roc_auc
+
+    mgr = CheckpointManager(args.checkpoint_dir)
+    step = mgr.latest_step()
+    if step is None:
+        print(
+            f"[quantize] no checkpoint in {args.checkpoint_dir!r}; "
+            "run `ccfd_tpu train` first",
+            file=sys.stderr,
+        )
+        return 2
+    params, step = mgr.restore(mlp_mod.init(jax.random.PRNGKey(0)))
+    qp = quant.quantize_mlp(params)
+
+    ds = load_dataset()
+    rng = np.random.default_rng(0)
+    te = rng.permutation(ds.n)[: max(1, int(ds.n * args.test_frac))]
+    p32 = np.asarray(mlp_mod.apply(params, ds.X[te]))
+    p8 = quant.apply_numpy(jax.tree.map(np.asarray, qp), ds.X[te])
+    path = CheckpointManager(args.out_dir).save(step, qp)
+    print(json.dumps({
+        "source_step": step,
+        "eval_rows": int(len(te)),
+        "auc_f32": round(roc_auc(ds.y[te], p32), 6),
+        "auc_int8": round(roc_auc(ds.y[te], p8), 6),
+        "max_prob_delta": round(float(np.abs(p8 - p32).max()), 6),
+        # the claim: f32 vs int8 on IDENTICAL rows (quantization delta);
+        # absolute held-out AUC lives in the train command's record
+        "evidence": "f32-to-int8 delta on a sampled evaluation set",
+        "checkpoint": path,
+        "serve_with": "CCFD_MODEL=mlp_q8 ccfd_tpu serve",
+    }))
+    return 0
 
 
 def cmd_score(args: argparse.Namespace) -> int:
@@ -274,13 +346,15 @@ def cmd_score(args: argparse.Namespace) -> int:
         spec = load_graph_cr(cfg.graph_cr)
         cfg = dataclasses.replace(cfg, model_name=spec.name)
     ds = load_dataset(path=args.input or None)
-    # checkpoints hold the MLP pytree: restoring into any other model
-    # would mis-shape its params (same guard as `serve`)
-    params = (
-        _restore_mlp_checkpoint(args.checkpoint_dir)
-        if cfg.model_name == "mlp"
-        else None
-    )
+    # checkpoints hold a model-specific pytree: restore only into the
+    # matching model (same guard as `serve`), so backfills score with the
+    # SAME params the REST endpoint serves
+    if cfg.model_name == "mlp":
+        params = _restore_mlp_checkpoint(args.checkpoint_dir)
+    elif cfg.model_name == "mlp_q8":
+        params = _restore_q8_checkpoint(getattr(args, "quantized_dir", ""))
+    else:
+        params = None
     scorer = Scorer(
         model_name=cfg.model_name, params=params,
         compute_dtype=cfg.compute_dtype, batch_sizes=cfg.batch_sizes,
@@ -639,7 +713,8 @@ def _honor_platform_env() -> None:
 
 # commands whose code path imports jax; the others (bus, notify, producer,
 # store, engine) stay jax-free and must not pay the import at startup
-_JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up", "score"}
+_JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up",
+             "score", "quantize"}
 
 
 _SERVICE_CMDS = {"serve", "bus", "engine", "router", "notify", "store", "up"}
@@ -676,6 +751,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--train-steps", type=int, default=300)
     s.add_argument("--checkpoint-dir", default="./checkpoints",
                    help="serve the newest `train` checkpoint when present")
+    s.add_argument("--quantized-dir", default=_Q8_DIR,
+                   help="int8 checkpoint dir used when CCFD_MODEL=mlp_q8")
     s.set_defaults(fn=cmd_serve)
 
     t = sub.add_parser("train", help="offline-train the flagship MLP")
@@ -689,11 +766,21 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--test-frac", type=float, default=0.2)
     t.set_defaults(fn=cmd_train)
 
+    q = sub.add_parser(
+        "quantize", help="int8-quantize the newest train checkpoint (mlp_q8)"
+    )
+    q.add_argument("--checkpoint-dir", default="./checkpoints")
+    q.add_argument("--out-dir", default=_Q8_DIR)
+    q.add_argument("--test-frac", type=float, default=0.2)
+    q.set_defaults(fn=cmd_quantize)
+
     sc = sub.add_parser("score", help="offline bulk scoring: CSV -> probabilities")
     sc.add_argument("--input", default="", help="creditcard.csv path (default: CCFD_CSV/synthetic)")
     sc.add_argument("--output", default="", help="write proba_1 CSV here")
     sc.add_argument("--depth", type=int, default=2, help="pipelined dispatch depth")
     sc.add_argument("--checkpoint-dir", default="./checkpoints")
+    sc.add_argument("--quantized-dir", default=_Q8_DIR,
+                    help="int8 checkpoint dir used when CCFD_MODEL=mlp_q8")
     sc.set_defaults(fn=cmd_score)
 
     an = sub.add_parser("analyze", help="dataset analytics report (Spark/notebook analog)")

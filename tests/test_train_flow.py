@@ -84,6 +84,53 @@ def test_train_from_store_records_auc_and_serve_restores(tmp_path, capsys):
         srv.stop()
 
 
+def test_quantize_lifecycle(tmp_path, capsys):
+    """train -> quantize -> int8 checkpoint restorable as mlp_q8 params,
+    with the AUC evidence recorded by the quantize command."""
+    import jax
+
+    from ccfd_tpu.cli import main
+    from ccfd_tpu.models.registry import get_model
+    from ccfd_tpu.ops import quant
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    ckpt = str(tmp_path / "ckpt")
+    q8 = str(tmp_path / "q8")
+    assert main(["train", "--steps", "50", "--checkpoint-dir", ckpt]) == 0
+    capsys.readouterr()
+    rc = main(["quantize", "--checkpoint-dir", ckpt, "--out-dir", q8])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["source_step"] == 50
+    assert abs(out["auc_f32"] - out["auc_int8"]) < 2e-3
+    assert out["max_prob_delta"] < 0.03
+    assert out["checkpoint"].startswith(q8)
+
+    like = get_model("mlp_q8").init()
+    qp, step = CheckpointManager(q8).restore(like)
+    assert step == 50
+    for layer in qp["layers"]:
+        assert np.asarray(layer["wq"]).dtype == np.int8
+    from ccfd_tpu.data.ccfd import load_dataset
+
+    ds = load_dataset(n_synthetic=128)
+    p = np.asarray(quant.apply(qp, jax.numpy.asarray(ds.X)))
+    assert p.shape == (128,) and np.all((p >= 0) & (p <= 1))
+
+    # backfill scoring uses the SAME int8 params the REST endpoint serves
+    import os
+    from unittest import mock
+
+    with mock.patch.dict(os.environ, {"CCFD_MODEL": "mlp_q8"}):
+        rc = main(["score", "--quantized-dir", q8])
+    assert rc == 0
+    score_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert score_out["checkpoint"] is True
+
+    # quantize without a checkpoint fails loudly, not silently
+    assert main(["quantize", "--checkpoint-dir", str(tmp_path / "none")]) == 2
+
+
 def test_cmd_score_bulk_csv(tmp_path, capsys):
     """Offline bulk scoring: train -> checkpoint -> score a CSV with it."""
     import numpy as np
