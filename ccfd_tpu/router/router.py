@@ -131,12 +131,14 @@ def decode_records(records) -> tuple[np.ndarray, list[Mapping[str, Any]], int]:
     csv_lines: list[bytes] = []
     for i, rec in enumerate(records):
         v = rec.value
-        # dict-first: typing/ABC __instancecheck__ costs ~1us and this
-        # runs per record at wire rate; real traffic is always dicts
-        if type(v) is dict or isinstance(v, Mapping):
+        # exact-type checks first: typing/ABC __instancecheck__ costs ~1us
+        # and this runs per record at wire rate — a CSV record must not
+        # pay a failed Mapping protocol check before its cheap bytes test
+        tv = type(v)
+        if tv is dict:
             dict_rows.append(i)
             dict_vals.append(v)
-        elif isinstance(v, (bytes, str)):
+        elif tv is bytes or tv is str or isinstance(v, (bytes, str)):
             raw = v.encode() if isinstance(v, str) else v
             # one record == one CSV row; embedded newlines would desync
             # the joined decode below, so keep only the first line and
@@ -146,6 +148,9 @@ def decode_records(records) -> tuple[np.ndarray, list[Mapping[str, Any]], int]:
                 bad += len(lines) - 1
             csv_rows.append(i)
             csv_lines.append(lines[0])
+        elif isinstance(v, Mapping):  # non-dict mappings: same dict path
+            dict_rows.append(i)
+            dict_vals.append(v)
         else:  # poison pill: score as all-zeros rather than crash the loop
             bad += 1
     if dict_vals:

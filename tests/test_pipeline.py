@@ -48,6 +48,21 @@ def test_decode_features_schema_order():
     assert x[1, 28] == 9.0
 
 
+def test_non_dict_mapping_record_takes_dict_path():
+    """A Mapping that isn't a plain dict (e.g. an OrderedDict subclass or a
+    MappingProxy off a deserializer) must decode like a dict, not fall to
+    the poison-pill branch — the type-dispatch order is perf-tuned and
+    this pins its semantics."""
+    import types
+
+    broker, clock, engine, router, notify, reg_r, reg_k = build()
+    proxy = types.MappingProxyType({"id": 7, "Amount": 123.0, "V1": 1.5})
+    broker.produce(CFG.kafka_topic, proxy)
+    assert router.step() == 1
+    assert reg_r.counter("transaction_decode_errors_total").value() == 0
+    assert reg_r.counter("transaction_incoming_total").value() == 1
+
+
 def test_poison_pill_does_not_crash_router():
     broker, clock, engine, router, notify, reg_r, reg_k = build()
     broker.produce(CFG.kafka_topic, {"id": 1, "Amount": "not-a-number"})
