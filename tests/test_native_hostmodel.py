@@ -230,6 +230,47 @@ def test_logreg_host_model_parity():
         srv.stop()
 
 
+def test_pipelined_mixed_paths_keep_response_order(served):
+    """HTTP/1.1 pipelining with requests that alternate between the
+    inline C++ path (small) and the Python takers (large): responses must
+    come back in request order with the right row counts, even though the
+    two paths complete at wildly different speeds."""
+    import socket
+
+    srv, front, scorer, ds, port = served
+    sizes = [4, 128, 8, 128, 1, 16, 128, 2]  # >64 rows -> Python path
+    reqs = []
+    for n in sizes:
+        body = json.dumps(
+            {"data": {"ndarray": ds.X[:n].astype(float).tolist()}}
+        ).encode()
+        reqs.append(
+            b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\nContent-Length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.sendall(b"".join(reqs))  # the whole pipeline in one write
+    buf = b""
+    got_counts = []
+    while len(got_counts) < len(sizes):
+        he = buf.find(b"\r\n\r\n")
+        if he >= 0:
+            cl = int(buf[:he].lower().split(b"content-length:", 1)[1]
+                     .split(b"\r\n", 1)[0])
+            if len(buf) >= he + 4 + cl:
+                assert buf.startswith(b"HTTP/1.1 200"), buf[:100]
+                payload = json.loads(buf[he + 4 : he + 4 + cl])
+                got_counts.append(len(payload["data"]["ndarray"]))
+                buf = buf[he + 4 + cl:]
+                continue
+        chunk = sock.recv(1 << 16)
+        assert chunk, "server closed mid-pipeline"
+        buf += chunk
+    sock.close()
+    assert got_counts == sizes  # order AND per-request row counts
+
+
 def test_gbt_host_model_parity():
     """The C++ tree kernel == the XLA/numpy evaluators on a REAL fitted
     sklearn ensemble (the reference's actual model family)."""
