@@ -193,17 +193,73 @@ def test_swap_params_reaches_cpp_copy(served):
     _, out_before = _post_rows(port, x.astype(float).tolist())
     p_before = np.asarray(out_before["data"]["ndarray"], np.float64)[:, 1]
     # push the head bias way positive: probabilities must jump toward 1
-    new_params = jax.tree.map(lambda a: a, scorer._host_params)
-    new_params = {
-        "norm": dict(new_params["norm"]),
-        "layers": [dict(l) for l in new_params["layers"]],
-    }
-    new_params["layers"][-1]["b"] = np.asarray([25.0], np.float32)
-    scorer.swap_params(new_params)
+    scorer.swap_params(_params_with_head_bias(scorer._host_params, 25.0))
     _, out_after = _post_rows(port, x.astype(float).tolist())
     p_after = np.asarray(out_after["data"]["ndarray"], np.float64)[:, 1]
     assert (p_after > 0.99).all()
     assert not (p_before > 0.99).all()
+
+
+def _params_with_head_bias(base, bias):
+    """Fresh param tree = ``base`` with the head bias pinned to ``bias``."""
+    p = {
+        "norm": dict(base["norm"]),
+        "layers": [dict(l) for l in base["layers"]],
+    }
+    p["layers"][-1]["b"] = np.asarray([bias], np.float32)
+    return p
+
+
+def test_swap_params_under_live_fire(served):
+    """Online-retrain publish (scorer.swap_params -> C++ model swap) racing
+    live traffic: every response must be a valid probability row from
+    EITHER the old or the new params — never a torn mix, an error, or a
+    crash. Exercises the install-under-mutex swap against the IO thread's
+    inline scoring."""
+    import threading
+
+    srv, front, scorer, ds, port = served
+    base = jax.tree.map(np.asarray, scorer._host_params)
+
+    stop = threading.Event()
+    swap_err = []
+
+    def swapper():
+        flip = False
+        while not stop.is_set():
+            try:
+                scorer.swap_params(
+                    _params_with_head_bias(base, 25.0 if flip else -25.0)
+                )
+            except Exception as e:  # noqa: BLE001
+                swap_err.append(e)
+                return
+            flip = not flip
+
+    # pin the FIRST extreme before any request: the original params score
+    # mid-range and would trip the one-sidedness assertion below
+    scorer.swap_params(_params_with_head_bias(base, 25.0))
+
+    t = threading.Thread(target=swapper, daemon=True)
+    t.start()
+    try:
+        rows = ds.X[:8].astype(float).tolist()
+        for _ in range(200):
+            status, out = _post_rows(port, rows)
+            assert status == 200
+            got = np.asarray(out["data"]["ndarray"], np.float64)
+            assert got.shape == (8, 2)
+            assert np.isfinite(got).all()
+            np.testing.assert_allclose(got.sum(axis=1), 1.0, atol=1e-6)
+            p1 = got[:, 1]
+            # params are pinned to an extreme bias either way: every row
+            # must be decisively one-sided, never a torn in-between mix
+            assert (p1 > 0.95).all() or (p1 < 0.05).all(), p1
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not t.is_alive(), "swapper thread hung (swap_params deadlock?)"
+    assert not swap_err, swap_err
 
 
 def test_logreg_host_model_parity():
