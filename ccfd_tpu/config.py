@@ -74,6 +74,8 @@ class Config:
 
     # --- online retrain (new; BASELINE.json configs[4]) ---
     labels_topic: str = "ccd-labels"
+    audit_topic: str = ""  # "" = audit stream off; a topic name enables the
+    # engine's jBPM-AuditService-analog lifecycle event stream onto the bus
     retrain_batch: int = 1024
     retrain_min_labels: int = 256
 
@@ -136,6 +138,7 @@ class Config:
                 e.get("CCFD_LOW_PROBA", str(Config.low_proba_threshold))
             ),
             labels_topic=e.get("CCFD_LABELS_TOPIC", Config.labels_topic),
+            audit_topic=e.get("CCFD_AUDIT_TOPIC", Config.audit_topic),
             retrain_batch=int(e.get("CCFD_RETRAIN_BATCH", str(Config.retrain_batch))),
             retrain_min_labels=int(
                 e.get("CCFD_RETRAIN_MIN_LABELS", str(Config.retrain_min_labels))

@@ -173,6 +173,7 @@ class Engine:
         confidence_threshold: float = 1.0,
         task_listener: Callable[[Task], None] | None = None,
         completed_retention: int = 10_000,
+        audit_sink: Callable[[dict[str, Any]], None] | None = None,
     ):
         self.clock: Clock = clock or RealClock()
         self.registry = registry or Registry()
@@ -183,6 +184,20 @@ class Engine:
         # decisions only — learning from its own auto-closures would be
         # feedback, not supervision
         self.task_listener = task_listener
+        # Audit stream (jBPM's AuditService analog): lifecycle events —
+        # process_started/process_completed, task_created/task_completed,
+        # signal, timer_fired — reach this sink in state-change order.
+        # Events BUFFER under the state lock and deliver after it releases
+        # (public entry points flush), so a slow sink (a remote bus hop)
+        # never stalls the engine's lock; the flush lock serializes
+        # deliveries so per-pid order still matches state-change order.
+        # A sink exposing a ``batch`` attribute gets each flush in ONE
+        # call. None (default) costs nothing on the hot path. The runtime
+        # store evicts completed instances (retention cap below); the
+        # audit stream is where full history durably lives.
+        self._audit = audit_sink
+        self._audit_buffer: list[dict[str, Any]] = []
+        self._audit_flush_lock = threading.Lock()
         self._definitions: dict[str, ProcessDefinition] = {}
         self._instances: dict[int, Instance] = {}
         self._tasks: dict[int, Task] = {}
@@ -207,6 +222,49 @@ class Engine:
         self._completed = self.registry.counter(
             "process_instances_completed_total", "process completions by status"
         )
+
+    def _emit(self, event: str, pid: int, process: str, **extra: Any) -> None:
+        """Buffer one audit event; caller holds the state lock and has
+        checked ``self._audit is not None`` (so the off case builds no
+        dicts). Delivery happens in ``_flush_audit`` after lock release."""
+        self._audit_buffer.append({
+            "event": event, "pid": pid, "process": process,
+            "ts": self.clock.now(), **extra,
+        })
+
+    def _flush_audit(self) -> None:
+        """Deliver buffered audit events OUTSIDE the state lock.
+
+        The flush lock serializes concurrent flushers, and the buffer swap
+        happens under the state lock inside it — so delivery order equals
+        state-change order even when two API calls race to flush. A sink
+        exposing a ``batch`` attribute gets the whole flush in one call
+        (the bus sink maps it to produce_batch); otherwise events deliver
+        one at a time with per-event failure isolation."""
+        if self._audit is None:
+            return
+        with self._audit_flush_lock:
+            with self._lock:
+                events = self._audit_buffer
+                self._audit_buffer = []
+            if not events:
+                return
+            batch_fn = getattr(self._audit, "batch", None)
+            if callable(batch_fn):
+                try:
+                    batch_fn(events)
+                except Exception:  # noqa: BLE001 - never break the flow
+                    import logging
+
+                    logging.getLogger(__name__).exception("audit sink failed")
+                return
+            for ev in events:
+                try:
+                    self._audit(ev)
+                except Exception:  # noqa: BLE001 - drop THIS event only
+                    import logging
+
+                    logging.getLogger(__name__).exception("audit sink failed")
 
     @property
     def state_lock(self) -> threading.RLock:
@@ -258,8 +316,12 @@ class Engine:
             inst = Instance(pid=next(self._pid), definition=d, vars=dict(variables))
             self._instances[inst.pid] = inst
             self._started.inc(labels={"process": def_id})
+            if self._audit is not None:
+                self._emit("process_started", inst.pid, def_id)
             self._run_from(inst, d.start)
-            return inst.pid
+            pid = inst.pid
+        self._flush_audit()
+        return pid
 
     def start_process_batch(
         self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
@@ -284,6 +346,7 @@ class Engine:
             d = self._definitions[def_id]
             chain = self._static_chains.get(def_id)
             pids: list[int | None] = []
+            audit_on = self._audit is not None
             if chain is None:
                 for variables in variables_list:
                     try:
@@ -297,51 +360,65 @@ class Engine:
                         continue
                     self._instances[inst.pid] = inst
                     self._started.inc(labels={"process": def_id})
+                    if audit_on:
+                        self._emit("process_started", inst.pid, def_id)
                     try:
                         self._run_from(inst, d.start)
                     except Exception:
                         inst.status = "aborted"
+                        if audit_on:
+                            self._emit("process_completed", inst.pid, def_id,
+                                       status="aborted")
                         self._note_completed(inst.pid)
                         pids.append(None)
                         continue
                     pids.append(inst.pid)
-                return pids
-            services, end, history = chain
-            n_ok = 0
-            n_started = 0
-            for variables in variables_list:
-                try:
-                    inst = Instance(
-                        pid=next(self._pid), definition=d, vars=dict(variables)
-                    )
-                except (TypeError, ValueError):
-                    pids.append(None)
-                    continue
-                self._instances[inst.pid] = inst
-                n_started += 1
-                try:
-                    for si, svc in enumerate(services):
-                        inst.node = svc.name
-                        svc.fn(self, inst)
-                except Exception:
-                    inst.history = list(history[: si + 1])
-                    inst.status = "aborted"
+            else:
+                services, end, history = chain
+                n_ok = 0
+                n_started = 0
+                for variables in variables_list:
+                    try:
+                        inst = Instance(
+                            pid=next(self._pid), definition=d, vars=dict(variables)
+                        )
+                    except (TypeError, ValueError):
+                        pids.append(None)
+                        continue
+                    self._instances[inst.pid] = inst
+                    n_started += 1
+                    if audit_on:
+                        self._emit("process_started", inst.pid, def_id)
+                    try:
+                        for si, svc in enumerate(services):
+                            inst.node = svc.name
+                            svc.fn(self, inst)
+                    except Exception:
+                        inst.history = list(history[: si + 1])
+                        inst.status = "aborted"
+                        if audit_on:
+                            self._emit("process_completed", inst.pid, def_id,
+                                       status="aborted")
+                        self._note_completed(inst.pid)
+                        pids.append(None)
+                        continue
+                    inst.node = end.name
+                    inst.history = list(history)
+                    inst.status = end.status
+                    if audit_on:
+                        self._emit("process_completed", inst.pid, def_id,
+                                   status=end.status)
+                    pids.append(inst.pid)
                     self._note_completed(inst.pid)
-                    pids.append(None)
-                    continue
-                inst.node = end.name
-                inst.history = list(history)
-                inst.status = end.status
-                pids.append(inst.pid)
-                self._note_completed(inst.pid)
-                n_ok += 1
-            if n_started:
-                self._started.inc(n_started, labels={"process": def_id})
-            if n_ok:
-                self._completed.inc(
-                    n_ok, labels={"process": def_id, "status": end.status}
-                )
-            return pids
+                    n_ok += 1
+                if n_started:
+                    self._started.inc(n_started, labels={"process": def_id})
+                if n_ok:
+                    self._completed.inc(
+                        n_ok, labels={"process": def_id, "status": end.status}
+                    )
+        self._flush_audit()
+        return pids
 
     def signal(self, pid: int, name: str, payload: Any = None) -> bool:
         """Deliver a signal; returns True iff it was consumed by a wait."""
@@ -353,8 +430,11 @@ class Engine:
             assert isinstance(node, EventNode)
             self._consume_wait(inst)
             inst.vars["signal_payload"] = payload
+            if self._audit is not None:
+                self._emit("signal", pid, inst.definition.id, name=name)
             self._run_from(inst, node.on_signal)
-            return True
+        self._flush_audit()
+        return True
 
     def instance(self, pid: int) -> Instance:
         with self._lock:
@@ -387,7 +467,11 @@ class Engine:
             node = inst.definition.nodes[inst.node]
             assert isinstance(node, UserTaskNode)
             inst.vars["task_outcome"] = outcome
+            if self._audit is not None:
+                self._emit("task_completed", t.pid, inst.definition.id,
+                           task_id=t.task_id, by="human", outcome=outcome)
             self._run_from(inst, node.next)
+        self._flush_audit()
         if self.task_listener is not None:
             try:
                 self.task_listener(t)
@@ -602,7 +686,11 @@ class Engine:
             node = inst.definition.nodes[inst.node]
             assert isinstance(node, EventNode)
             self._consume_wait(inst)
+            if self._audit is not None:
+                self._emit("timer_fired", pid, inst.definition.id,
+                           node=inst.node)
             self._run_from(inst, node.on_timeout)
+        self._flush_audit()
 
     def _run_from(self, inst: Instance, node_name: str) -> None:
         """Advance the instance until it blocks (event/user task) or ends."""
@@ -640,6 +728,9 @@ class Engine:
                 )
                 self._tasks[task.task_id] = task
                 self._tasks_by_pid.setdefault(inst.pid, []).append(task.task_id)
+                if self._audit is not None:
+                    self._emit("task_created", inst.pid, inst.definition.id,
+                               task_id=task.task_id, name=node.task_name)
                 if self.prediction_service is not None:
                     outcome, confidence = self.prediction_service.predict(task)
                     task.prediction_confidence = confidence
@@ -649,6 +740,12 @@ class Engine:
                         task.outcome = outcome
                         inst.vars["task_outcome"] = outcome
                         inst.vars["task_auto_completed"] = True
+                        if self._audit is not None:
+                            self._emit(
+                                "task_completed", inst.pid,
+                                inst.definition.id, task_id=task.task_id,
+                                by="prediction_service", outcome=outcome,
+                            )
                         node_name = node.next
                         continue
                     task.suggested_outcome = outcome  # pre-fill only (README.md:581)
@@ -658,6 +755,9 @@ class Engine:
                 self._completed.inc(
                     labels={"process": inst.definition.id, "status": node.status}
                 )
+                if self._audit is not None:
+                    self._emit("process_completed", inst.pid,
+                               inst.definition.id, status=node.status)
                 self._note_completed(inst.pid)
                 return
             else:  # pragma: no cover

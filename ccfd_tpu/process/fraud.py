@@ -56,12 +56,29 @@ def build_engine(
     task_listener=None,
 ) -> Engine:
     registry = registry or Registry()
+    # CCFD_AUDIT_TOPIC enables the engine's audit stream onto the bus:
+    # full lifecycle history survives the runtime store's retention
+    # eviction (jBPM's audit-log-vs-runtime separation)
+    audit_sink = None
+    if cfg.audit_topic:
+        # key by pid: one instance's whole history lands on one partition,
+        # so consumers replay it in state-change order (cross-instance
+        # interleaving is unordered, as in any partitioned audit log).
+        # The `batch` attribute lets the engine's batched start path flush
+        # a whole micro-batch of events in one produce_batch call.
+        def audit_sink(ev):
+            broker.produce(cfg.audit_topic, ev, key=ev["pid"])
+
+        audit_sink.batch = lambda evs: broker.produce_batch(
+            cfg.audit_topic, evs, keys=[e["pid"] for e in evs]
+        )
     engine = Engine(
         clock=clock,
         registry=registry,
         prediction_service=prediction_service,
         confidence_threshold=cfg.confidence_threshold,
         task_listener=task_listener,
+        audit_sink=audit_sink,
     )
 
     h_invest = registry.histogram(

@@ -239,3 +239,89 @@ def test_completed_instances_evicted_past_retention():
     fraud_pid = engine.start_process("fraud", {"transaction": tx(9000.0), "proba": 0.9})
     engine.start_process_batch("standard", [{"transaction": tx(1.0)} for _ in range(100)])
     assert engine.instance(fraud_pid).status == "active"
+
+
+# ---------------------------------------------------------------------------
+# Audit stream (jBPM AuditService analog)
+
+
+def _audit_make(cfg=None, prediction_service=None):
+    cfg = cfg or Config(
+        customer_reply_timeout_s=30.0, low_amount_threshold=200.0,
+        low_proba_threshold=0.75, confidence_threshold=1.0,
+        audit_topic="ccd-audit",
+    )
+    broker = Broker()
+    clock = ManualClock()
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, clock, prediction_service)
+    consumer = broker.consumer("audit-reader", (cfg.audit_topic,))
+    return broker, clock, engine, consumer
+
+
+def _events(consumer):
+    return [r.value for r in consumer.poll(1000, 0.0)]
+
+
+def test_audit_stream_standard_process():
+    _, _, engine, consumer = _audit_make()
+    pid = engine.start_process("standard", {"transaction": tx(10.0)})
+    evs = _events(consumer)
+    assert [e["event"] for e in evs] == ["process_started", "process_completed"]
+    assert all(e["pid"] == pid and e["process"] == "standard" for e in evs)
+    assert evs[-1]["status"] == "completed"
+    assert evs[0]["ts"] <= evs[-1]["ts"]
+
+
+def test_audit_stream_fraud_full_history_with_timer_and_task():
+    broker, clock, engine, consumer = _audit_make()
+    pid = engine.start_process(
+        "fraud", {"transaction": tx(5000.0), "proba": 0.95}
+    )
+    clock.advance(31.0)  # no reply: timer -> DMN -> investigation task
+    task = engine.tasks("open")[0]
+    engine.complete_task(task.task_id, False)  # is_fraud=False -> approved
+    names = [e["event"] for e in _events(consumer)]
+    assert names == [
+        "process_started", "timer_fired", "task_created",
+        "task_completed", "process_completed",
+    ]
+    assert engine.instance(pid).status == "completed"
+
+
+def test_audit_stream_signal_and_batch():
+    _, _, engine, consumer = _audit_make()
+    pid = engine.start_process(
+        "fraud", {"transaction": tx(500.0), "proba": 0.9}
+    )
+    engine.signal(pid, CUSTOMER_RESPONSE_SIGNAL, {"approved": True})
+    evs = _events(consumer)
+    assert [e["event"] for e in evs] == [
+        "process_started", "signal", "process_completed",
+    ]
+    assert evs[1]["name"] == CUSTOMER_RESPONSE_SIGNAL
+
+    # batch fast path emits per-instance start/complete pairs
+    pids = engine.start_process_batch(
+        "standard", [{"transaction": tx(1.0, i)} for i in range(3)]
+    )
+    evs = _events(consumer)
+    assert len([e for e in evs if e["event"] == "process_started"]) == 3
+    assert len([e for e in evs if e["event"] == "process_completed"]) == 3
+    assert {e["pid"] for e in evs} == set(pids)
+
+
+def test_audit_off_by_default_and_broken_sink_harmless():
+    # default config: no audit topic, engine must not emit anywhere
+    broker, clock, reg, engine = make()
+    engine.start_process("standard", {"transaction": tx(1.0)})
+    assert engine._audit is None
+
+    # a raising sink must never break the business flow
+    bad = Engine(audit_sink=lambda ev: (_ for _ in ()).throw(RuntimeError("x")))
+    bad.register(ProcessDefinition(
+        id="p", start="end",
+        nodes={"end": EndNode(name="end", status="completed")},
+    ))
+    pid = bad.start_process("p", {})
+    assert bad.instance(pid).status == "completed"
