@@ -326,6 +326,46 @@ def cmd_quantize(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_audit(args: argparse.Namespace) -> int:
+    """Tail the engine's audit stream (CCFD_AUDIT_TOPIC): one JSON event
+    per line — the operator view of jBPM's process-instance history.
+    ``--follow`` keeps consuming; otherwise drains what's there and exits."""
+    from ccfd_tpu.config import Config
+
+    cfg = Config.from_env()
+    topic = args.topic or cfg.audit_topic
+    if not topic:
+        # surface the misconfiguration instead of an empty-but-successful
+        # tail: without CCFD_AUDIT_TOPIC the engine emits nothing
+        print(
+            "[audit] CCFD_AUDIT_TOPIC is unset (the engine's audit stream "
+            "is OFF); tailing the default topic 'ccd-audit'",
+            file=sys.stderr,
+        )
+        topic = "ccd-audit"
+    broker = _broker_for(cfg)
+    consumer = broker.consumer(args.group, (topic,))
+    printed = 0
+    try:
+        while True:
+            # cap the fetch at the remaining limit: poll auto-commits what
+            # it returns, and over-fetching would silently skip events the
+            # group never printed
+            want = min(1024, args.limit - printed) if args.limit else 1024
+            recs = consumer.poll(want, 0.5 if args.follow else 0.0)
+            for rec in recs:
+                print(json.dumps(rec.value))
+                printed += 1
+                if args.limit and printed >= args.limit:
+                    return 0
+            if not recs and not args.follow:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        consumer.close()
+
+
 def cmd_score(args: argparse.Namespace) -> int:
     """Offline bulk scoring: CSV in -> probabilities out, through the same
     pipelined bucketed dispatch the serving path uses. The batch analog of
@@ -773,6 +813,14 @@ def main(argv: list[str] | None = None) -> int:
     q.add_argument("--out-dir", default=_Q8_DIR)
     q.add_argument("--test-frac", type=float, default=0.2)
     q.set_defaults(fn=cmd_quantize)
+
+    au = sub.add_parser("audit", help="tail the engine's audit event stream")
+    au.add_argument("--topic", default="", help="default: CCFD_AUDIT_TOPIC")
+    au.add_argument("--group", default="audit-tail",
+                    help="consumer group (offsets persist per group)")
+    au.add_argument("--follow", action="store_true", help="keep consuming")
+    au.add_argument("--limit", type=int, default=0, help="stop after N events")
+    au.set_defaults(fn=cmd_audit)
 
     sc = sub.add_parser("score", help="offline bulk scoring: CSV -> probabilities")
     sc.add_argument("--input", default="", help="creditcard.csv path (default: CCFD_CSV/synthetic)")

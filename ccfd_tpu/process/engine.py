@@ -243,6 +243,15 @@ class Engine:
         one at a time with per-event failure isolation."""
         if self._audit is None:
             return
+        # Reentrancy guard: a ServiceNode/GatewayNode may call back into a
+        # public engine API (fn(engine, inst)), whose exit would flush
+        # WHILE the outer frame still owns the state RLock — acquiring the
+        # flush lock there inverts the flush->state lock order (AB-BA
+        # deadlock against a concurrent flusher) and would deliver to the
+        # sink under the state lock. The outermost frame flushes instead.
+        # (_is_owned is RLock private API, stable across CPython.)
+        if self._lock._is_owned():
+            return
         with self._audit_flush_lock:
             with self._lock:
                 events = self._audit_buffer
@@ -311,17 +320,22 @@ class Engine:
 
     # -- public API (KIE-server-shaped: start / signal / tasks) -----------
     def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
-        with self._lock:
-            d = self._definitions[def_id]
-            inst = Instance(pid=next(self._pid), definition=d, vars=dict(variables))
-            self._instances[inst.pid] = inst
-            self._started.inc(labels={"process": def_id})
-            if self._audit is not None:
-                self._emit("process_started", inst.pid, def_id)
-            self._run_from(inst, d.start)
-            pid = inst.pid
-        self._flush_audit()
-        return pid
+        try:
+            with self._lock:
+                d = self._definitions[def_id]
+                inst = Instance(
+                    pid=next(self._pid), definition=d, vars=dict(variables)
+                )
+                self._instances[inst.pid] = inst
+                self._started.inc(labels={"process": def_id})
+                if self._audit is not None:
+                    self._emit("process_started", inst.pid, def_id)
+                self._run_from(inst, d.start)
+                return inst.pid
+        finally:
+            # finally, not fallthrough: a raising service node documented
+            # to propagate must still get its buffered events delivered
+            self._flush_audit()
 
     def start_process_batch(
         self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
@@ -342,6 +356,14 @@ class Engine:
         ``aborted``, and the rest of the batch still starts. One poisoned
         transaction must not drop a whole micro-batch of process starts.
         """
+        try:
+            return self._start_process_batch_locked(def_id, variables_list)
+        finally:
+            self._flush_audit()
+
+    def _start_process_batch_locked(
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+    ) -> list[int | None]:
         with self._lock:
             d = self._definitions[def_id]
             chain = self._static_chains.get(def_id)
@@ -417,24 +439,29 @@ class Engine:
                     self._completed.inc(
                         n_ok, labels={"process": def_id, "status": end.status}
                     )
-        self._flush_audit()
         return pids
 
     def signal(self, pid: int, name: str, payload: Any = None) -> bool:
         """Deliver a signal; returns True iff it was consumed by a wait."""
-        with self._lock:
-            inst = self._instances.get(pid)
-            if inst is None or inst.status != "active" or inst.wait_signal != name:
-                return False
-            node = inst.definition.nodes[inst.node]
-            assert isinstance(node, EventNode)
-            self._consume_wait(inst)
-            inst.vars["signal_payload"] = payload
-            if self._audit is not None:
-                self._emit("signal", pid, inst.definition.id, name=name)
-            self._run_from(inst, node.on_signal)
-        self._flush_audit()
-        return True
+        try:
+            with self._lock:
+                inst = self._instances.get(pid)
+                if (
+                    inst is None
+                    or inst.status != "active"
+                    or inst.wait_signal != name
+                ):
+                    return False
+                node = inst.definition.nodes[inst.node]
+                assert isinstance(node, EventNode)
+                self._consume_wait(inst)
+                inst.vars["signal_payload"] = payload
+                if self._audit is not None:
+                    self._emit("signal", pid, inst.definition.id, name=name)
+                self._run_from(inst, node.on_signal)
+                return True
+        finally:
+            self._flush_audit()
 
     def instance(self, pid: int) -> Instance:
         with self._lock:
@@ -457,21 +484,23 @@ class Engine:
             return self._tasks[task_id]
 
     def complete_task(self, task_id: int, outcome: Any) -> None:
-        with self._lock:
-            t = self._tasks[task_id]
-            if t.status != "open":
-                raise ValueError(f"task {task_id} already {t.status}")
-            t.status = "completed"
-            t.outcome = outcome
-            inst = self._instances[t.pid]
-            node = inst.definition.nodes[inst.node]
-            assert isinstance(node, UserTaskNode)
-            inst.vars["task_outcome"] = outcome
-            if self._audit is not None:
-                self._emit("task_completed", t.pid, inst.definition.id,
-                           task_id=t.task_id, by="human", outcome=outcome)
-            self._run_from(inst, node.next)
-        self._flush_audit()
+        try:
+            with self._lock:
+                t = self._tasks[task_id]
+                if t.status != "open":
+                    raise ValueError(f"task {task_id} already {t.status}")
+                t.status = "completed"
+                t.outcome = outcome
+                inst = self._instances[t.pid]
+                node = inst.definition.nodes[inst.node]
+                assert isinstance(node, UserTaskNode)
+                inst.vars["task_outcome"] = outcome
+                if self._audit is not None:
+                    self._emit("task_completed", t.pid, inst.definition.id,
+                               task_id=t.task_id, by="human", outcome=outcome)
+                self._run_from(inst, node.next)
+        finally:
+            self._flush_audit()
         if self.task_listener is not None:
             try:
                 self.task_listener(t)
@@ -674,23 +703,25 @@ class Engine:
             inst.timer = None
 
     def _timer_fired(self, pid: int, gen: int) -> None:
-        with self._lock:
-            inst = self._instances.get(pid)
-            if (
-                inst is None
-                or inst.status != "active"
-                or inst.wait_signal is None
-                or inst.wait_gen != gen
-            ):
-                return  # a signal won the race; timer is a no-op
-            node = inst.definition.nodes[inst.node]
-            assert isinstance(node, EventNode)
-            self._consume_wait(inst)
-            if self._audit is not None:
-                self._emit("timer_fired", pid, inst.definition.id,
-                           node=inst.node)
-            self._run_from(inst, node.on_timeout)
-        self._flush_audit()
+        try:
+            with self._lock:
+                inst = self._instances.get(pid)
+                if (
+                    inst is None
+                    or inst.status != "active"
+                    or inst.wait_signal is None
+                    or inst.wait_gen != gen
+                ):
+                    return  # a signal won the race; timer is a no-op
+                node = inst.definition.nodes[inst.node]
+                assert isinstance(node, EventNode)
+                self._consume_wait(inst)
+                if self._audit is not None:
+                    self._emit("timer_fired", pid, inst.definition.id,
+                               node=inst.node)
+                self._run_from(inst, node.on_timeout)
+        finally:
+            self._flush_audit()
 
     def _run_from(self, inst: Instance, node_name: str) -> None:
         """Advance the instance until it blocks (event/user task) or ends."""

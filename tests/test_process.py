@@ -325,3 +325,73 @@ def test_audit_off_by_default_and_broken_sink_harmless():
     ))
     pid = bad.start_process("p", {})
     assert bad.instance(pid).status == "completed"
+
+
+def test_audit_reentrant_service_node_no_deadlock_and_flush():
+    """A ServiceNode calling back into a public engine API (by design:
+    fn(engine, inst)) must neither deadlock on the audit flush lock nor
+    deliver under the state lock — the outermost frame flushes all
+    buffered events in order."""
+    sink_events = []
+
+    def sink(ev):
+        sink_events.append(ev["event"])
+
+    engine = Engine(audit_sink=sink)
+    engine.register(ProcessDefinition(
+        id="inner", start="end",
+        nodes={"end": EndNode(name="end", status="completed")},
+    ))
+
+    def spawn_inner(eng, inst):
+        eng.start_process("inner", {})  # reentrant public API call
+
+    engine.register(ProcessDefinition(
+        id="outer", start="svc",
+        nodes={
+            "svc": ServiceNode(name="svc", fn=spawn_inner, next="end"),
+            "end": EndNode(name="end", status="completed"),
+        },
+    ))
+    import threading
+
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            engine.start_process("outer", {})
+        except Exception as e:  # noqa: BLE001
+            err.append(e)
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert done.wait(timeout=20), "deadlocked: reentrant start never returned"
+    assert not err, err
+    # 2 instances x (started, completed), delivered after the outer call
+    assert sorted(sink_events) == [
+        "process_completed", "process_completed",
+        "process_started", "process_started",
+    ]
+
+
+def test_audit_flushes_on_exception_paths():
+    """A raising service node propagates (documented), but its buffered
+    process_started event must still reach the sink."""
+    sink_events = []
+    engine = Engine(audit_sink=lambda ev: sink_events.append(ev["event"]))
+
+    def boom(eng, inst):
+        raise RuntimeError("service exploded")
+
+    engine.register(ProcessDefinition(
+        id="bad", start="svc",
+        nodes={
+            "svc": ServiceNode(name="svc", fn=boom, next="end"),
+            "end": EndNode(name="end", status="completed"),
+        },
+    ))
+    with pytest.raises(RuntimeError):
+        engine.start_process("bad", {})
+    assert sink_events == ["process_started"]

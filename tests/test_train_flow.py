@@ -156,3 +156,28 @@ def test_cmd_score_bulk_csv(tmp_path, capsys):
     assert ((probs >= 0) & (probs <= 1)).all()
     # a trained checkpoint separates the classes at least somewhat
     assert summary["flagged_fraud"] < 2000
+
+
+def test_cmd_audit_tails_event_stream(tmp_path, capsys):
+    """`ccfd_tpu audit` drains the audit topic from the durable bus log —
+    the operator's cross-process view of process-instance history."""
+    import os
+    from unittest import mock
+
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.cli import main
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.process.fraud import build_engine
+
+    busdir = str(tmp_path / "bus")
+    env = {"CCFD_AUDIT_TOPIC": "ccd-audit", "CCFD_BUS_DIR": busdir}
+    with mock.patch.dict(os.environ, env):
+        cfg = Config.from_env()
+        broker = Broker(log_dir=busdir, fsync=False)
+        engine = build_engine(cfg, broker, Registry(), None)
+        engine.start_process("standard", {"transaction": {"id": 1, "Amount": 2.0}})
+        broker.close()
+        assert main(["audit", "--limit", "2"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert [e["event"] for e in lines] == ["process_started", "process_completed"]
