@@ -421,7 +421,8 @@ def _bench_zoo(seconds, batch=16384):
     out = {}
     for name, params in (
         ("logreg", logreg.fit_numpy(ds.X[:2048], ds.y[:2048])),
-        ("gbt", gbt_params),
+        ("gbt", gbt_params),        # lockstep-descent gathers
+        ("gbt_mxu", gbt_params),    # gather-free one-hot-matmul eval
     ):
         out[name] = {"tx_s": _scorer_hop_rate(name, params, ds.X, seconds),
                      "batch": batch}

@@ -61,6 +61,17 @@ register_model(
     )
 )
 
+register_model(
+    ModelSpec(
+        "gbt_mxu",
+        lambda key=None, n_trees=50, depth=4: trees.init_empty(n_trees, depth),
+        trees.apply_mxu,
+        trees.logits_mxu,
+        trainable=False,
+        apply_numpy=trees.apply_numpy,
+    )
+)  # gather-free MXU evaluation of the SAME tree params (trees.logits_mxu)
+
 # int8 quantized serving graph: registered here so CCFD_MODEL=mlp_q8 is a
 # working drop-in everywhere models resolve by name (quant.py's imports of
 # this module are all deferred inside register(), so no cycle)

@@ -73,6 +73,62 @@ def apply(params: Params, x: jax.Array) -> jax.Array:
     return jax.nn.sigmoid(logits(params, x))
 
 
+def logits_mxu(params: Params, x: jax.Array) -> jax.Array:
+    """Gather-free ensemble evaluation: feature selection as ONE matmul.
+
+    The lockstep descent in :func:`logits` does two gathers per level
+    (``feat/thr`` by node index, then ``x`` by feature id) — VPU-bound
+    dynamic addressing that leaves the MXU idle. TPU-first alternative:
+
+    1. Pre-gather EVERY node's feature value for every row with one
+       matmul against a static one-hot matrix:
+       ``xv = x @ onehot(feat)`` — (B, F) x (F, T*nI) rides the MXU.
+    2. Compare against all thresholds at once -> (B, T, nI) decisions.
+    3. Walk the D levels with ``one_hot(idx) * dec`` sums — dense
+       elementwise VPU work, no dynamic indexing anywhere.
+
+    FLOP cost grows (every node evaluates, not just the D on the path),
+    but the work is MXU-shaped and gather-free — the same trade the
+    dense tree embedding itself makes. Exact same semantics as
+    :func:`logits` (parity-tested); choose per backend via the
+    ``gbt_mxu`` registry entry.
+    """
+    feat, thr, leaf = params["feature"], params["threshold"], params["leaf"]
+    n_trees = leaf.shape[0]
+    depth = depth_of(params)
+    n_int = num_internal(depth)
+    # Non-finite features would poison the select-by-matmul (inf * 0 = NaN
+    # spreads to EVERY node of the row); map them to huge finite values
+    # that preserve the gather path's comparison outcomes: NaN compares
+    # False against any finite threshold (like -big), +/-inf compare like
+    # +/-big. Dead slots (thr=+inf) stay always-left either way.
+    big = jnp.asarray(3.0e38, x.dtype)
+    x_safe = jnp.nan_to_num(x, nan=-big, posinf=big, neginf=-big)
+    # (F, T*nI) one-hot of each node's split feature. Params are traced
+    # jit arguments, so this small build (F x T*nI) runs per call — it is
+    # a few percent of the matmul it feeds, not a folded constant.
+    onehot = jax.nn.one_hot(
+        feat.reshape(-1), x.shape[1], dtype=x.dtype
+    ).T  # (F, T*nI)
+    xv = (x_safe @ onehot).reshape(x.shape[0], n_trees, n_int)
+    dec = (xv > thr[None]).astype(jnp.int32)  # (B, T, nI)
+    idx = jnp.zeros((x.shape[0], n_trees), jnp.int32)
+    for _ in range(depth):
+        # d = dec[b, t, idx[b, t]] without a gather: one-hot mask + sum
+        mask = jax.nn.one_hot(idx, n_int, dtype=dec.dtype)
+        d = (dec * mask).sum(axis=-1)
+        idx = 2 * idx + 1 + d
+    leaf_idx = idx - n_int
+    leaf_mask = jax.nn.one_hot(leaf_idx, 1 << depth, dtype=leaf.dtype)
+    return params["base"] + (leaf[None] * leaf_mask).sum(axis=(-1, -2))
+
+
+@jax.jit
+def apply_mxu(params: Params, x: jax.Array) -> jax.Array:
+    """proba_1 per row via the gather-free MXU evaluation."""
+    return jax.nn.sigmoid(logits_mxu(params, x))
+
+
 def apply_numpy(params: Params, x: np.ndarray) -> np.ndarray:
     """Pure-numpy forward, semantically `apply` without a device.
 

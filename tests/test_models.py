@@ -122,3 +122,57 @@ def test_models_jit_static_shapes():
     x = jnp.zeros((8, NUM_FEATURES))
     lowered = jax.jit(lambda p, xx: mlp.apply(p, xx)).lower(params, x)
     assert "while" not in lowered.as_text().lower()
+
+
+def test_gbt_mxu_matches_gather_eval(dataset):
+    """The gather-free MXU tree evaluation == the lockstep-descent one on a
+    REAL fitted sklearn ensemble, and both match sklearn itself."""
+    clf = GradientBoostingClassifier(
+        n_estimators=15, max_depth=3, random_state=3
+    ).fit(dataset.X[:800], dataset.y[:800])
+    params = trees.from_sklearn_gbt(clf)
+    x = jnp.asarray(dataset.X[:200])
+    p_gather = np.asarray(trees.apply(params, x))
+    p_mxu = np.asarray(trees.apply_mxu(params, x))
+    np.testing.assert_allclose(p_mxu, p_gather, atol=1e-6)
+    np.testing.assert_allclose(
+        p_mxu, clf.predict_proba(dataset.X[:200])[:, 1], atol=1e-4
+    )
+    assert get_model("gbt_mxu").apply is trees.apply_mxu
+
+
+def test_gbt_mxu_tie_semantics_on_threshold_boundary():
+    """x == threshold goes LEFT in both evaluators (sklearn's <= right-
+    branch inversion) — the one-hot comparison must not flip ties."""
+    p = {
+        "feature": jnp.zeros((1, 1), jnp.int32),
+        "threshold": jnp.asarray([[1.5]], jnp.float32),
+        "leaf": jnp.asarray([[10.0, 20.0]], jnp.float32),
+        "base": jnp.asarray(0.0, jnp.float32),
+    }
+    x = jnp.asarray([[1.5] + [0.0] * 29, [1.6] + [0.0] * 29], jnp.float32)
+    za = np.asarray(trees.logits(p, x))
+    zb = np.asarray(trees.logits_mxu(p, x))
+    np.testing.assert_allclose(za, [10.0, 20.0])
+    np.testing.assert_allclose(zb, za)
+
+
+def test_gbt_mxu_nonfinite_rows_match_gather_eval():
+    """NaN/inf features must not poison the select-by-matmul: both
+    evaluators agree on rows carrying non-finite values (NaN compares
+    False like the gather path; +/-inf branch like huge finite values)."""
+    p = {
+        "feature": jnp.asarray([[1, 0, 2]], jnp.int32),  # depth 2
+        "threshold": jnp.asarray([[0.5, -1.0, 2.0]], jnp.float32),
+        "leaf": jnp.asarray([[1.0, 2.0, 3.0, 4.0]], jnp.float32),
+        "base": jnp.asarray(0.0, jnp.float32),
+    }
+    rows = np.zeros((4, 30), np.float32)
+    rows[0, 1] = np.nan       # NaN at the root's split feature
+    rows[1, 1] = np.inf       # +inf at the root's split feature
+    rows[2, 0] = -np.inf      # -inf on the left child's feature
+    rows[3, 2] = np.inf       # +inf on the right child's feature
+    x = jnp.asarray(rows)
+    za = np.asarray(trees.logits(p, x))
+    zb = np.asarray(trees.logits_mxu(p, x))
+    np.testing.assert_allclose(zb, za)
