@@ -56,7 +56,9 @@ request, default 16),
 CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq,zoo,quant to skip
 sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
 a tunnel that wedges MID-run would otherwise hang the bench forever;
-on expiry the newest cached TPU result is printed and the process exits 3).
+on expiry every section that COMPLETED before the wedge is printed,
+clearly labeled partial, with the newest cached TPU result attached,
+and the process exits 3).
 """
 
 from __future__ import annotations
@@ -70,6 +72,11 @@ import time
 NORTH_STAR_TX_S = 50_000.0  # BASELINE.json north_star: >=50k tx/s on v5e-1
 NORTH_STAR_P99_MS = 10.0  # BASELINE.json north_star: p99 e2e predict <10ms
 LAST_GOOD_PATH = os.path.join(os.path.dirname(__file__), "BENCH_TPU_LAST_GOOD.json")
+
+# Sections append here as they complete so a mid-run wedge (watchdog fire)
+# still reports every number that was actually measured, clearly labeled,
+# instead of discarding the whole run.
+_PARTIAL: dict = {}
 
 
 def _probe_backend(timeout_s: float, attempts: int, backoff_s: float) -> bool:
@@ -470,21 +477,36 @@ def _arm_watchdog() -> None:
         budget = probe_window + 10 * max(seconds, 3.0) + 120 + 600
 
     def fire() -> None:
-        out = {
-            "metric": "end_to_end_scoring_throughput_mlp_bf16",
-            "value": 0.0,
-            "unit": "tx/s",
-            "vs_baseline": 0.0,
-            "platform": "none (bench watchdog: accelerator wedged mid-run "
-            f"after {budget:.0f}s)",
-        }
+        # os._exit(3) must run NO MATTER WHAT: an exception here (e.g. the
+        # snapshot racing a concurrent _PARTIAL.update) would disarm the
+        # watchdog and leave the wedged bench hanging forever
         try:
-            with open(LAST_GOOD_PATH) as f:
-                out["last_good_tpu"] = json.load(f)
-        except (OSError, ValueError):
-            pass
-        print(json.dumps(out), flush=True)
-        os._exit(3)
+            snap = dict(_PARTIAL)
+            if snap:
+                label = ("partial (bench watchdog: accelerator wedged "
+                         f"mid-run after {budget:.0f}s; sections below "
+                         "completed before the wedge)")
+            else:
+                label = ("none (bench watchdog: accelerator wedged before "
+                         f"any section completed, after {budget:.0f}s)")
+            out = {
+                "metric": "end_to_end_scoring_throughput_mlp_bf16",
+                "value": float(snap.get("value", 0.0)),
+                "unit": "tx/s",
+                "vs_baseline": round(
+                    float(snap.get("value", 0.0)) / NORTH_STAR_TX_S, 3
+                ),
+                "platform": label,
+            }
+            out.update({k: v for k, v in snap.items() if k != "value"})
+            try:
+                with open(LAST_GOOD_PATH) as f:
+                    out["last_good_tpu"] = json.load(f)
+            except (OSError, ValueError):
+                pass
+            print(json.dumps(out), flush=True)
+        finally:
+            os._exit(3)
 
     t = threading.Timer(budget, fire)
     t.daemon = True
@@ -606,6 +628,11 @@ def main() -> None:
     )
     scorer.warmup()
     tx_per_s, p50, p99 = _bench_scorer(scorer, ds.X, batch, lat_batch, seconds, depth)
+    _PARTIAL.update({
+        "value": round(tx_per_s, 1), "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3), "fused_active": scorer.fused,
+        "platform_measured": jax.default_backend(),
+    })
 
     fused_ab = None
     if "ab" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_AB")):
@@ -628,6 +655,7 @@ def main() -> None:
             ab[label] = {"tx_s": round(r_tx, 1), "p50_ms": round(r_p50, 3),
                          "p99_ms": round(r_p99, 3)}
         fused_ab = ab
+        _PARTIAL["fused_ab"] = fused_ab
 
     rest = None
     rest_python = None
@@ -637,6 +665,7 @@ def main() -> None:
             int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "8")),
             int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
         )
+        _PARTIAL["rest"] = rest
         if rest.get("transport") == "NativeFront":
             # transport A/B: the same load through the Python server, so
             # the native front's effect is a recorded number
@@ -646,32 +675,40 @@ def main() -> None:
                 int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
                 native=False,
             )
+            _PARTIAL["rest_python_transport"] = rest_python
 
     pipeline = None
     if "pipeline" not in skip:
         pipeline = _bench_pipeline(pipe_params, max(2.0, seconds))
+        _PARTIAL["pipeline"] = pipeline
 
     mesh_res = None
     if "mesh" not in skip:
         mesh_res = _bench_mesh(
             params, min(batch, 65536), max(1.0, seconds / 2), depth
         )
+        if mesh_res is not None:
+            _PARTIAL["mesh"] = mesh_res
 
     retrain_res = None
     if "retrain" not in skip:
         retrain_res = _bench_retrain(max(1.0, seconds / 2))
+        _PARTIAL["retrain"] = retrain_res
 
     seq_res = None
     if "seq" not in skip:
         seq_res = _bench_seq(max(1.0, seconds / 2))
+        _PARTIAL["seq"] = seq_res
 
     zoo_res = None
     if "zoo" not in skip:
         zoo_res = _bench_zoo(max(1.0, seconds / 3))
+        _PARTIAL["zoo"] = zoo_res
 
     quant_res = None
     if "quant" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_QUANT")):
         quant_res = _bench_quant(params, ds.X[:batch], max(1.0, seconds / 2))
+        _PARTIAL["quant_int8"] = quant_res
 
     # the e2e p99 the north star talks about is the REST predict hop when
     # measured; the raw scorer-hop p99 otherwise (also when the REST
@@ -691,24 +728,14 @@ def main() -> None:
         "platform": jax.default_backend()
         + (" (fallback: accelerator probe failed)" if fellback else ""),
     }
-    if fused_ab is not None:
-        result["fused_ab"] = fused_ab
-    if rest is not None:
-        result["rest"] = rest
-    if rest_python is not None:
-        result["rest_python_transport"] = rest_python
-    if pipeline is not None:
-        result["pipeline"] = pipeline
-    if mesh_res is not None:
-        result["mesh"] = mesh_res
-    if retrain_res is not None:
-        result["retrain"] = retrain_res
-    if seq_res is not None:
-        result["seq"] = seq_res
-    if zoo_res is not None:
-        result["zoo"] = zoo_res
-    if quant_res is not None:
-        result["quant_int8"] = quant_res
+    # section results flow through _PARTIAL (written as each completes for
+    # the watchdog); the final result picks them up from ONE place instead
+    # of re-enumerating every section
+    headline_only = {"value", "p50_ms", "p99_ms", "fused_active",
+                     "platform_measured"}
+    result.update(
+        {k: v for k, v in _PARTIAL.items() if k not in headline_only}
+    )
 
     if on_tpu:
         # cache this as the round's last-good TPU number: later fallback
