@@ -138,7 +138,13 @@ def apply_numpy(params: Params, x: np.ndarray) -> np.ndarray:
     """
     from ccfd_tpu.utils.metrics_math import stable_sigmoid
 
+    # callers holding a uniformly-float32 host copy of the params (e.g. a
+    # scorer host tier) would otherwise feed float indices into
+    # take_along_axis, which raises; already-integer arrays pass through
+    # uncopied (this is the per-request host latency path)
     feat = np.asarray(params["feature"])
+    if not np.issubdtype(feat.dtype, np.integer):
+        feat = feat.astype(np.int64)
     thr = np.asarray(params["threshold"])
     leaf = np.asarray(params["leaf"])
     x = np.asarray(x, np.float32)

@@ -412,8 +412,10 @@ class NativeFront:
         if d_n > 0:
             srv._c_requests.inc(d_n, labels={"code": "200"})
             self._host_synced_n = n_host
-        cur = np.frombuffer(counts, np.int64).reshape(2, nb).copy()
-        cur_sums = np.frombuffer(sums, np.float64).copy()
+        # as_array derives the dtype from the ctypes type: c_long is 8 bytes
+        # on LP64 but 4 on other ABIs, so a hardcoded int64 would misparse
+        cur = np.ctypeslib.as_array(counts).astype(np.int64).reshape(2, nb)
+        cur_sums = np.ctypeslib.as_array(sums).astype(np.float64)
         endpoints = ("/api/v0.1/predictions", "/predict")
         for tag in (0, 1):
             d_counts = cur[tag] - self._host_synced_counts[tag]

@@ -48,6 +48,17 @@ _DTYPES = {
 }
 
 
+def _host_cast(a: Any) -> np.ndarray:
+    """Host copy of one param leaf for the numpy tier: floating leaves go to
+    f32, integer leaves (tree feature indices) keep an integer dtype — a
+    uniform f32 cast would turn gather indices into floats and crash
+    ``apply_numpy`` for the tree family."""
+    a = np.asarray(a)
+    if np.issubdtype(a.dtype, np.floating):
+        return np.asarray(a, np.float32)
+    return a
+
+
 class Scorer:
     def __init__(
         self,
@@ -161,12 +172,17 @@ class Scorer:
         self._host_params = None
         # swap listeners: components holding a derived copy of the params
         # (e.g. the C++ serving front's in-process host model) register to
-        # be re-fed on every swap_params so online retrain reaches them too
+        # be re-fed on every swap_params so online retrain reaches them too.
+        # Delivery is serialized under _notify_lock and ordered by a swap
+        # generation so two concurrent swap_params calls can't install their
+        # listeners' copies in reverse order (stale params winning).
         self._swap_listeners: list[Any] = []
+        self._notify_lock = threading.Lock()
+        self._swap_gen = 0
+        self._swap_delivered_gen = 0
         if self.host_tier_rows > 0 and self.spec.apply_numpy is not None:
             self._host_params = jax.tree.map(
-                lambda a: np.asarray(a, np.float32),
-                params if params is not None else self._params,
+                _host_cast, params if params is not None else self._params
             )
         else:
             self.host_tier_rows = 0
@@ -352,9 +368,7 @@ class Scorer:
                 staged_fused = None  # incompatible layout: drop to XLA path
         staged_host = None
         if self._host_params is not None:
-            staged_host = jax.tree.map(
-                lambda a: np.asarray(a, np.float32), new_params
-            )
+            staged_host = jax.tree.map(_host_cast, new_params)
         with self._lock:
             self._params = staged
             # never keep serving stale fused weights: an unfoldable tree
@@ -363,15 +377,26 @@ class Scorer:
             if staged_host is not None:
                 self._host_params = staged_host
             listeners = list(self._swap_listeners)
+            self._swap_gen += 1
+            gen = self._swap_gen
         if listeners:
-            host_tree = staged_host if staged_host is not None else jax.tree.map(
-                lambda a: np.asarray(a, np.float32), new_params
+            host_tree = (
+                staged_host
+                if staged_host is not None
+                else jax.tree.map(_host_cast, new_params)
             )
-            for fn in listeners:  # outside the lock: listeners may be slow
-                try:
-                    fn(host_tree)
-                except Exception:  # noqa: BLE001 - must not break swaps
-                    pass
+            # outside the params lock (listeners may be slow), but serialized
+            # and generation-checked: if a newer swap already delivered, this
+            # older tree must not overwrite the listeners' copies
+            with self._notify_lock:
+                if gen <= self._swap_delivered_gen:
+                    return
+                self._swap_delivered_gen = gen
+                for fn in listeners:
+                    try:
+                        fn(host_tree)
+                    except Exception:  # noqa: BLE001 - must not break swaps
+                        pass
 
     def add_swap_listener(self, fn: Any) -> None:
         """``fn(host_params_numpy_tree)`` runs after every ``swap_params``."""

@@ -313,6 +313,81 @@ def test_host_tier_autotune_measures_crossover():
     assert thr < 8192
 
 
+def test_host_tier_gbt_small_batch_scores():
+    """ADVICE r2 (high): the host-params copy must keep the tree family's
+    integer gather indices integer — a uniform f32 cast made
+    ``trees.apply_numpy`` raise IndexError on any host-tier batch, crashing
+    serve/router warmup for CCFD_MODEL=gbt on accelerator backends. Calls the
+    Scorer directly (no native front) so the numpy path itself is exercised."""
+    import jax as _jax
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import trees
+    from ccfd_tpu.serving.scorer import Scorer
+
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    ds = synthetic_dataset(n=512, fraud_rate=0.2, seed=7)
+    clf = GradientBoostingClassifier(
+        n_estimators=8, max_depth=3, random_state=3
+    ).fit(ds.X, ds.y)
+    params = trees.from_sklearn_gbt(clf)
+    for name in ("gbt", "gbt_mxu"):
+        s = Scorer(model_name=name, params=params,
+                   batch_sizes=(16, 128), host_tier_rows=64)
+        assert s._host_params is not None
+        feat = s._host_params["feature"]
+        assert np.issubdtype(np.asarray(feat).dtype, np.integer)
+        small = s.score(ds.X[:16])  # <= host_tier_rows: numpy path
+        dev = s.score_pipelined(ds.X[:16], depth=1)
+        assert small.shape == (16,)
+        assert np.allclose(small, dev, atol=2e-2)
+        # swap keeps the tier alive (and integer) too
+        clf2 = GradientBoostingClassifier(
+            n_estimators=8, max_depth=3, random_state=4
+        ).fit(ds.X, 1 - ds.y)
+        s.swap_params(trees.from_sklearn_gbt(clf2))
+        assert np.issubdtype(
+            np.asarray(s._host_params["feature"]).dtype, np.integer
+        )
+        s.score(ds.X[:16])
+
+
+def test_swap_listener_ordering_under_concurrent_swaps():
+    """ADVICE r2 (low): listener delivery is generation-ordered — a slower,
+    older swap must not overwrite a newer swap's params in listener copies."""
+    import jax as _jax
+
+    from ccfd_tpu.models import mlp
+    from ccfd_tpu.serving.scorer import Scorer
+
+    params = mlp.init(_jax.random.PRNGKey(0))
+    s = Scorer(model_name="mlp", params=params, batch_sizes=(16,),
+               host_tier_rows=16)
+    seen = []
+    s.add_swap_listener(lambda tree: seen.append(float(tree["layers"][-1]["b"][0])))
+
+    def bumped(v):
+        p = dict(params)
+        p["layers"] = [dict(l) for l in params["layers"]]
+        p["layers"][-1] = dict(p["layers"][-1])
+        p["layers"][-1]["b"] = np.asarray([v], np.float32)
+        return p
+
+    # simulate the race: swap A claims its generation, then swap B fully
+    # lands (newer gen, delivered); A's delivery must then be skipped
+    with s._lock:
+        s._swap_gen += 1
+        gen_a = s._swap_gen
+    s.swap_params(bumped(2.0))  # B: newer generation, delivers
+    assert seen == [2.0]
+    # replay A's delivery attempt the way swap_params would
+    with s._notify_lock:
+        stale = gen_a <= s._swap_delivered_gen
+    assert stale  # A would be (correctly) dropped
+    assert float(s._host_params["layers"][-1]["b"][0]) == 2.0
+
+
 def test_host_tier_logreg_numpy_matches_jax():
     import jax as _jax
 
