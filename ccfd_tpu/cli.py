@@ -54,7 +54,8 @@ def cmd_demo(args: argparse.Namespace) -> int:
     reg_router, reg_kie, reg_notify, reg_retrain = (
         Registry(), Registry(), Registry(), Registry(),
     )
-    scorer = Scorer(model_name="mlp", params=params, compute_dtype=cfg.compute_dtype)
+    scorer = Scorer(model_name="mlp", params=params, compute_dtype=cfg.compute_dtype,
+                    dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms())
     scorer.warmup()
     engine = build_engine(
         cfg, broker, reg_kie,
@@ -150,6 +151,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         model_name=cfg.model_name, params=params, compute_dtype=cfg.compute_dtype,
         batch_sizes=cfg.batch_sizes,
         host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
+        dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms(),
     )
     scorer.warmup()
     srv = PredictionServer(scorer, cfg)
@@ -669,7 +671,8 @@ def cmd_router(args: argparse.Namespace) -> int:
         from ccfd_tpu.serving.scorer import Scorer
 
         scorer = Scorer(model_name=cfg.model_name, compute_dtype=cfg.compute_dtype,
-                        batch_sizes=cfg.batch_sizes)
+                        batch_sizes=cfg.batch_sizes,
+                        dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms())
         scorer.warmup()
         score_fn = scorer.score
     from ccfd_tpu.process.client import EngineRestClient

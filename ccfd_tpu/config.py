@@ -91,8 +91,29 @@ class Config:
     host_tier_rows: int = -1  # -1 = auto: measured at scorer warmup (host
     # forward rate vs device dispatch RTT, crossover at RTT/2, <=8192;
     # 256 provisionally until warmup runs); 0 = off; >0 = fixed threshold
+    dispatch_deadline_ms: float = -1.0  # server-side device-dispatch bound
+    # (the reference's SELDON_TIMEOUT applied inside the server): -1 = auto
+    # (accelerator backends: seldon_timeout_ms; cpu/mesh: off), 0 = off,
+    # >0 = explicit deadline
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
+
+    def scorer_dispatch_deadline_ms(self) -> float | None:
+        """The value serving code passes to ``Scorer(dispatch_deadline_ms=)``.
+
+        Explicit (>= 0) wins; auto (-1) resolves to the SELDON_TIMEOUT bound
+        so the server-side deadline tracks the client-side knob, and returns
+        it as a number so a programmatically-built Config is honored (the
+        scorer still disables the guard itself on cpu/mesh backends when
+        handed None — which only happens for scorers built without a Config).
+        """
+        if self.dispatch_deadline_ms >= 0:
+            return self.dispatch_deadline_ms
+        import jax
+
+        if jax.default_backend() in ("cpu",):
+            return 0.0
+        return float(self.seldon_timeout_ms)
 
     @staticmethod
     def from_env(env: Mapping[str, str] | None = None) -> "Config":
@@ -119,6 +140,9 @@ class Config:
                 e.get("CONFIDENCE_THRESHOLD", str(Config.confidence_threshold))
             ),
             seldon_timeout_ms=int(e.get("SELDON_TIMEOUT", str(Config.seldon_timeout_ms))),
+            dispatch_deadline_ms=float(
+                e.get("CCFD_DISPATCH_DEADLINE_MS", str(Config.dispatch_deadline_ms))
+            ),
             seldon_pool_size=int(e.get("SELDON_POOL_SIZE", str(Config.seldon_pool_size))),
             client_retries=int(e.get("CCFD_CLIENT_RETRIES", str(Config.client_retries))),
             producer_topic=e.get("topic", Config.producer_topic),

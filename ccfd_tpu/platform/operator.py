@@ -286,6 +286,7 @@ class Platform:
             compute_dtype=c.opt("dtype", cfg.compute_dtype),
             batch_sizes=cfg.batch_sizes,
             host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
+            dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms(),
         )
         self.scorer.warmup()
         if c.opt("rest", False):

@@ -24,12 +24,14 @@ reconciled into the counter at scrape time.
 from __future__ import annotations
 
 import ctypes
+import json
 import threading
 import time
 
 import numpy as np
 
 from ccfd_tpu.native import _load
+from ccfd_tpu.serving.dispatch import ScorerTimeout
 
 
 def extract_dense_model(spec_name: str, params) -> tuple | None:
@@ -310,6 +312,16 @@ class NativeFront:
                 proba = np.ascontiguousarray(
                     np.asarray(srv.scorer.score(x)), np.float32
                 )
+            except ScorerTimeout as e:
+                # wedged device, no host fallback: bounded 503 (server-side
+                # SELDON_TIMEOUT) instead of a taker thread stuck forever
+                err = json.dumps({"error": f"scoring unavailable: {e}"}).encode()
+                for i in range(n_reqs):
+                    self._lib.ccfd_front_respond_misc(
+                        handle, ids[i], 503, b"application/json", err, len(err)
+                    )
+                    srv._c_requests.inc(labels={"code": "503"})
+                continue
             except Exception:  # noqa: BLE001 - fail the requests, not the loop
                 err = b'{"error": "scoring failed"}'
                 for i in range(n_reqs):

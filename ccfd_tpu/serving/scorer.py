@@ -72,6 +72,7 @@ class Scorer:
         mesh: Any = None,
         param_partition: str = "replicated",
         host_tier_rows: int | None = None,
+        dispatch_deadline_ms: float | None = None,
     ):
         self.spec: ModelSpec = get_model(model_name)
         self.num_features = num_features
@@ -180,12 +181,50 @@ class Scorer:
         self._notify_lock = threading.Lock()
         self._swap_gen = 0
         self._swap_delivered_gen = 0
-        if self.host_tier_rows > 0 and self.spec.apply_numpy is not None:
+        # Dispatch deadline (server-side SELDON_TIMEOUT analog,
+        # /root/reference/README.md:386-393): the serving ``score`` path
+        # bounds its device round trip; a wedged attachment (tunnel hang
+        # inside a device sync) times out, marks the device wedged, and
+        # serving continues on the host tier until a probe sees recovery.
+        # None = auto: SELDON_TIMEOUT ms on accelerator backends, off on CPU
+        # (no attachment to wedge) and on meshes (the dryrun/virtual path).
+        if dispatch_deadline_ms is None:
+            if mesh is None and jax.default_backend() not in ("cpu",):
+                from ccfd_tpu.config import Config
+
+                # env-backed Config is the single parser for both knobs;
+                # callers holding a programmatic Config pass
+                # cfg.scorer_dispatch_deadline_ms() instead of None
+                dispatch_deadline_ms = Config.from_env().scorer_dispatch_deadline_ms()
+            else:
+                dispatch_deadline_ms = 0.0
+        self.dispatch_deadline_s = float(dispatch_deadline_ms) / 1e3
+        self._dispatcher = None
+        self._wedge = None
+        self.dispatch_timeouts = 0
+        self.host_fallback_scores = 0
+        keep_host = self.host_tier_rows > 0 or (
+            self.dispatch_deadline_s > 0 and self.spec.apply_numpy is not None
+        )
+        if keep_host and self.spec.apply_numpy is not None:
+            # the wedge fallback needs host params even when the latency
+            # tier is off — they cannot be pulled from a wedged device later
             self._host_params = jax.tree.map(
                 _host_cast, params if params is not None else self._params
             )
-        else:
+        if self.host_tier_rows > 0 and self._host_params is None:
             self.host_tier_rows = 0
+        if self.dispatch_deadline_s > 0:
+            from ccfd_tpu.serving.dispatch import DeviceDispatcher, WedgeMonitor
+
+            self._dispatcher = DeviceDispatcher()
+            probe_rows = min(self.batch_sizes)
+            probe_x = np.zeros((probe_rows, self.num_features), np.float32)
+            self._wedge = WedgeMonitor(
+                self._dispatcher,
+                lambda: self.score_pipelined(probe_x, depth=1),
+                deadline_s=self.dispatch_deadline_s,
+            )
         if use_fused:
             from ccfd_tpu.ops import fused_mlp
 
@@ -271,6 +310,28 @@ class Scorer:
         return self._fused_params is not None
 
     def warmup(self) -> None:
+        """Compile every bucket (and measure the host-tier crossover).
+
+        Deadline-aware when the dispatch guard is on: a wedged attachment at
+        startup (the failure ADVICE r2 flagged for serve/router bring-up)
+        marks the device wedged after ``CCFD_WARMUP_DEADLINE_S`` (default
+        180 s — first XLA compile through a tunnel runs tens of seconds) and
+        serving starts in host-fallback mode instead of hanging."""
+        if self._dispatcher is None:
+            self._warmup_body()
+            return
+        import os as _os
+
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+        budget_s = float(_os.environ.get("CCFD_WARMUP_DEADLINE_S", "180"))
+        try:
+            self._dispatcher.call(self._warmup_body, budget_s)
+        except ScorerTimeout:
+            self.dispatch_timeouts += 1
+            self._wedge.mark_wedged()
+
+    def _warmup_body(self) -> None:
         for b in self.batch_sizes:
             if self._fused_params is not None:
                 jax.block_until_ready(
@@ -467,4 +528,34 @@ class Scorer:
             return np.asarray(
                 self.spec.apply_numpy(host_params, x), np.float32
             )
-        return self.score_pipelined(x, depth=1)
+        if self._dispatcher is None:
+            return self.score_pipelined(x, depth=1)
+        return self._device_score_deadline(x)
+
+    def _device_score_deadline(self, x: np.ndarray) -> np.ndarray:
+        """Device path with a bounded round trip (serving latency path only;
+        ``score_pipelined`` called directly — bulk/bench — is unbounded by
+        design). Timeout => host fallback at ANY batch size, or
+        :class:`~ccfd_tpu.serving.dispatch.ScorerTimeout` for the fronts to
+        map to 503 when the model has no host forward."""
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+        if not self._wedge.wedged:
+            try:
+                return self._dispatcher.call(
+                    lambda: self.score_pipelined(x, depth=1),
+                    self.dispatch_deadline_s,
+                )
+            except ScorerTimeout:
+                self.dispatch_timeouts += 1
+                self._wedge.mark_wedged()
+        # wedged (now or already): no new device work queues behind the hang
+        with self._lock:
+            host_params = self._host_params
+        if host_params is None or self.spec.apply_numpy is None:
+            raise ScorerTimeout(
+                f"device wedged for {self._wedge.wedged_for_s:.1f}s and "
+                f"model {self.spec.name!r} has no host forward"
+            )
+        self.host_fallback_scores += 1
+        return np.asarray(self.spec.apply_numpy(host_params, x), np.float32)

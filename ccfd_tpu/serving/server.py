@@ -71,6 +71,21 @@ class PredictionServer:
         self._c_requests = r.counter(
             "seldon_api_executor_server_requests_total", "requests by code"
         )
+        # Dispatch-health series (wedged-attachment visibility; the Serving
+        # board alerts on these): wedged flag + timeout/fallback counters
+        # folded from the scorer at scrape time.
+        self._g_wedged = r.gauge(
+            "ccfd_device_wedged", "1 while the device attachment is wedged"
+        )
+        self._c_dispatch_timeouts = r.counter(
+            "ccfd_dispatch_timeouts_total", "device dispatches past deadline"
+        )
+        self._c_host_fallbacks = r.counter(
+            "ccfd_host_fallback_scores_total",
+            "requests scored on the host because the device was unavailable",
+        )
+        self._dispatch_timeouts_synced = 0
+        self._host_fallbacks_synced = 0
         # ModelPrediction board: per-request feature/probability gauges.
         self._g_proba = r.gauge("proba_1", "last scored fraud probability")
         self._g_amount = r.gauge("Amount", "last scored transaction amount")
@@ -105,6 +120,21 @@ class PredictionServer:
             on_dispatch=on_dispatch,
             workers=self.cfg.batch_workers,
         )
+
+    def _sync_dispatch_health(self) -> None:
+        """Fold the scorer's dispatch-health counters into the registry
+        (scrape-time pull keeps the hot path free of extra metric writes)."""
+        s = self.scorer
+        wedge = getattr(s, "_wedge", None)
+        self._g_wedged.set(1.0 if (wedge is not None and wedge.wedged) else 0.0)
+        d = int(getattr(s, "dispatch_timeouts", 0)) - self._dispatch_timeouts_synced
+        if d > 0:
+            self._c_dispatch_timeouts.inc(d)
+            self._dispatch_timeouts_synced += d
+        d = int(getattr(s, "host_fallback_scores", 0)) - self._host_fallbacks_synced
+        if d > 0:
+            self._c_host_fallbacks.inc(d)
+            self._host_fallbacks_synced += d
 
     # -- scoring ----------------------------------------------------------
     def _score_matrix(self, x: np.ndarray) -> np.ndarray:
@@ -178,6 +208,7 @@ class PredictionServer:
         if method == "GET":
             if path in ("/prometheus", "/metrics"):
                 self._c_requests.inc(labels={"code": "200"})
+                self._sync_dispatch_health()
                 return 200, "text/plain", self.registry.render().encode()
             if path in ("/health/status", "/health", "/healthz"):
                 return self._json(
@@ -198,9 +229,17 @@ class PredictionServer:
         # (C++ strtof straight into float32, no json.loads); anything
         # unusual — a names header, ragged rows, no toolchain — falls
         # back to the Python JSON route below
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
         x = native_decode_ndarray(body, self.scorer.num_features)
         if x is not None:
-            proba = self._score_matrix(x)
+            try:
+                proba = self._score_matrix(x)
+            except ScorerTimeout as e:
+                # wedged attachment, no host fallback for this model:
+                # bounded failure (503) instead of a hung connection — the
+                # server-side twin of the reference's SELDON_TIMEOUT
+                return self._json(503, {"error": f"scoring unavailable: {e}"})
             out = self._response_dict(proba, self.scorer.spec.name)
         else:
             try:
@@ -215,6 +254,8 @@ class PredictionServer:
                 out = self.predict_ndarray(data.get("names") or [], rows)
             except (TypeError, ValueError) as e:
                 return self._json(400, {"error": f"bad ndarray: {e}"})
+            except ScorerTimeout as e:
+                return self._json(503, {"error": f"scoring unavailable: {e}"})
         self._h_latency.observe(
             time.perf_counter() - t0, labels={"endpoint": path}
         )
