@@ -166,6 +166,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _training_dataset():
+    """The dataset model-lifecycle commands (train/quantize) run on: the
+    real Kaggle table when CCFD_CSV points at it, else the committed
+    deterministic Kaggle-shaped surrogate (data/surrogate.py) — never the
+    small test synthetic, so shipped checkpoints always carry full-scale
+    quality evidence."""
+    from ccfd_tpu.data.ccfd import load_dataset
+
+    if os.environ.get("CCFD_CSV"):
+        return load_dataset(), os.environ["CCFD_CSV"]
+    from ccfd_tpu.data.surrogate import SURROGATE_VERSION, kaggle_surrogate
+
+    # CCFD_SURROGATE_ROWS shrinks the dataset for fast CI/unit runs; the
+    # default (full 284,807 rows) is what shipped artifacts train on
+    rows = int(os.environ.get("CCFD_SURROGATE_ROWS", "0") or 0)
+    if rows > 0:
+        return kaggle_surrogate(n=rows), f"surrogate:{SURROGATE_VERSION}:n={rows}"
+    return kaggle_surrogate(), f"surrogate:{SURROGATE_VERSION}"
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     """Offline training with the reference's data path: the CSV comes from
     the object store (reference README.md:303-343 uploads creditcard.csv to
@@ -178,7 +198,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     import numpy as np
 
     from ccfd_tpu.config import Config
-    from ccfd_tpu.data.ccfd import load_csv_bytes, load_dataset
+    from ccfd_tpu.data.ccfd import load_csv_bytes
     from ccfd_tpu.models import mlp as mlp_mod
     from ccfd_tpu.parallel.checkpoint import CheckpointManager
     from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
@@ -198,9 +218,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         ds = load_csv_bytes(client.get(cfg.s3_bucket, cfg.filename))
         source = f"store:{cfg.s3_bucket}/{cfg.filename}"
     else:
-        ds = load_dataset()
-        if os.environ.get("CCFD_CSV"):
-            source = os.environ["CCFD_CSV"]
+        ds, source = _training_dataset()
 
     # held-out split for honest AUC (stratification unnecessary at 284k rows;
     # the tail is sorted by Time in the real CSV, so shuffle first)
@@ -289,7 +307,6 @@ def cmd_quantize(args: argparse.Namespace) -> int:
     import jax
     import numpy as np
 
-    from ccfd_tpu.data.ccfd import load_dataset
     from ccfd_tpu.models import mlp as mlp_mod
     from ccfd_tpu.ops import quant
     from ccfd_tpu.parallel.checkpoint import CheckpointManager
@@ -307,7 +324,7 @@ def cmd_quantize(args: argparse.Namespace) -> int:
     params, step = mgr.restore(mlp_mod.init(jax.random.PRNGKey(0)))
     qp = quant.quantize_mlp(params)
 
-    ds = load_dataset()
+    ds, _source = _training_dataset()
     rng = np.random.default_rng(0)
     te = rng.permutation(ds.n)[: max(1, int(ds.n * args.test_frac))]
     p32 = np.asarray(mlp_mod.apply(params, ds.X[te]))
@@ -754,6 +771,65 @@ def _honor_platform_env() -> None:
             pass
 
 
+def _probe_backend_or_fallback() -> None:
+    """Bound CLI startup against a wedged accelerator attachment.
+
+    The TPU tunnel can wedge so hard that ``jax.devices()`` blocks forever —
+    before any Scorer (whose own dispatch deadline can't help yet) exists.
+    Probe the default backend in a SUBPROCESS with a timeout (the same
+    discipline bench.py uses); on a dead probe, force CPU and say so, rather
+    than hanging `train`/`serve`/`router` bring-up indefinitely. Operators
+    opt out with CCFD_NO_PROBE=1 (e.g. to wait out a flaky attachment) and
+    tune the timeout with CCFD_PROBE_S."""
+    import os
+    import subprocess
+
+    if os.environ.get("CCFD_NO_PROBE") or os.environ.get("JAX_PLATFORMS"):
+        return  # explicit platform choice already bounded/bypassed the dial
+    timeout_s = float(os.environ.get("CCFD_PROBE_S", "45"))
+    # a healthy probe is cached briefly so back-to-back CLI invocations on
+    # a healthy attachment don't pay accelerator bring-up twice per call
+    cache = os.path.join(
+        os.path.expanduser("~"), ".cache", "ccfd_tpu", "probe_ok"
+    )
+    ttl_s = float(os.environ.get("CCFD_PROBE_CACHE_S", "300"))
+    try:
+        import time as _time
+
+        if ttl_s > 0 and _time.time() - os.path.getmtime(cache) < ttl_s:
+            return
+    except OSError:
+        pass
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True,
+        )
+        if r.returncode == 0:
+            try:
+                os.makedirs(os.path.dirname(cache), exist_ok=True)
+                with open(cache, "w"):
+                    pass
+                os.utime(cache, None)
+            except OSError:
+                pass
+            return
+    except (subprocess.SubprocessError, OSError):
+        pass
+    print(
+        f"[ccfd_tpu] accelerator probe failed within {timeout_s:.0f}s "
+        "(wedged attachment?); falling back to CPU — set CCFD_NO_PROBE=1 "
+        "to wait for the accelerator instead",
+        file=sys.stderr,
+    )
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - jax absent/odd build
+        pass
+
+
 # commands whose code path imports jax; the others (bus, notify, producer,
 # store, engine) stay jax-free and must not pay the import at startup
 _JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up",
@@ -767,6 +843,7 @@ def main(argv: list[str] | None = None) -> int:
     args_list = list(sys.argv[1:] if argv is None else argv)
     if args_list and args_list[0] in _JAX_CMDS:
         _honor_platform_env()
+        _probe_backend_or_fallback()
         # persistent XLA compilation cache: service restarts and repeat
         # runs skip the 20-40s-per-shape first compile on the TPU tunnel
         from ccfd_tpu.utils.compile_cache import enable as _enable_cache

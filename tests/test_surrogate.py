@@ -1,0 +1,86 @@
+"""Canonical committed dataset: determinism, fingerprint, and stats parity
+with the real Kaggle table's published summary statistics (the artifact is
+committed as generator code + this fingerprint, not a 30 MB blob)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.data.surrogate import (
+    KAGGLE_FRAUDS,
+    KAGGLE_ROWS,
+    SURROGATE_SEED,
+    fingerprint,
+    kaggle_surrogate,
+)
+
+# Pinned content hash of kaggle_surrogate() at defaults. If this fails, the
+# generator (or numpy's Generator bit-stream) changed: bump
+# SURROGATE_VERSION, re-train the committed checkpoint, update BASELINE.md's
+# AUC table, and re-pin — a silent dataset change must never ship.
+CANONICAL_FINGERPRINT = (
+    "a7d6cff5202f715bf28f9e936b2b5f62df15be0ce8a755f0becfa62a74c6df74"
+)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return kaggle_surrogate()
+
+
+def test_canonical_fingerprint(ds):
+    assert fingerprint(ds) == CANONICAL_FINGERPRINT
+
+
+def test_shape_and_class_balance(ds):
+    assert ds.n == KAGGLE_ROWS == 284_807
+    assert int(ds.y.sum()) == KAGGLE_FRAUDS == 492
+    assert ds.X.dtype == np.float32 and ds.X.shape == (KAGGLE_ROWS, 30)
+
+
+def test_determinism_and_seed_sensitivity():
+    a = kaggle_surrogate(n=5000, seed=SURROGATE_SEED)
+    b = kaggle_surrogate(n=5000, seed=SURROGATE_SEED)
+    c = kaggle_surrogate(n=5000, seed=SURROGATE_SEED + 1)
+    assert fingerprint(a) == fingerprint(b)
+    assert fingerprint(a) != fingerprint(c)
+
+
+def test_stats_match_published_kaggle_profile(ds):
+    licit, fraud = ds.y == 0, ds.y == 1
+    t, amount = ds.X[:, 0], ds.X[:, 29]
+    # Time: two days, sorted like the real table
+    assert 0 <= t.min() and t.max() < 2 * 86_400
+    assert (np.diff(t) >= 0).all()
+    # Amount: heavy-tailed licit body (median ~22, real max), small frauds
+    assert 18 < np.median(amount[licit]) < 28
+    assert 50 < amount[licit].mean() < 110
+    assert amount.max() <= 25_691.17
+    assert np.median(amount[fraud]) < 15
+    # PCA variance ladder: descending stds, endpoints near the real values
+    stds = ds.X[licit][:, 1:29].std(axis=0)
+    assert 1.8 < stds[0] < 2.3 and 0.28 < stds[27] < 0.42
+    assert stds[0] > stds[9] > stds[18] > stds[27]
+    # fraud shifts carry the real signs on the strongest components
+    fm = ds.X[fraud][:, 1:29].mean(axis=0)
+    assert fm[13] < -2.0 and fm[16] < -2.0 and fm[11] < -2.0  # V14,V17,V12
+    assert fm[3] > 1.5 and fm[10] > 1.0                        # V4, V11
+
+
+def test_not_linearly_separable_toy(ds):
+    """AUC must land in the realistic band, not 1.0 — the stealth-fraud
+    mode exists so models have something honest to learn. (LogReg on a 20%
+    split; matches the ~0.970 recorded in BASELINE.md.)"""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.preprocessing import StandardScaler
+
+    from ccfd_tpu.utils.metrics_math import roc_auc
+
+    rng = np.random.default_rng(0)
+    order = rng.permutation(ds.n)
+    n_test = int(ds.n * 0.2)
+    te, tr = order[:n_test], order[n_test:]
+    sc = StandardScaler().fit(ds.X[tr])
+    clf = LogisticRegression(max_iter=500).fit(sc.transform(ds.X[tr]), ds.y[tr])
+    auc = roc_auc(ds.y[te], clf.predict_proba(sc.transform(ds.X[te]))[:, 1])
+    assert 0.95 < auc < 0.995, auc

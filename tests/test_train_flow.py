@@ -84,7 +84,7 @@ def test_train_from_store_records_auc_and_serve_restores(tmp_path, capsys):
         srv.stop()
 
 
-def test_quantize_lifecycle(tmp_path, capsys):
+def test_quantize_lifecycle(tmp_path, capsys, monkeypatch):
     """train -> quantize -> int8 checkpoint restorable as mlp_q8 params,
     with the AUC evidence recorded by the quantize command."""
     import jax
@@ -96,6 +96,9 @@ def test_quantize_lifecycle(tmp_path, capsys):
 
     ckpt = str(tmp_path / "ckpt")
     q8 = str(tmp_path / "q8")
+    # unit test exercises the LIFECYCLE, not full-scale quality: shrink the
+    # canonical surrogate so train+quantize stay seconds-fast
+    monkeypatch.setenv("CCFD_SURROGATE_ROWS", "20000")
     assert main(["train", "--steps", "50", "--checkpoint-dir", ckpt]) == 0
     capsys.readouterr()
     rc = main(["quantize", "--checkpoint-dir", ckpt, "--out-dir", q8])
@@ -103,7 +106,10 @@ def test_quantize_lifecycle(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["source_step"] == 50
     assert abs(out["auc_f32"] - out["auc_int8"]) < 2e-3
-    assert out["max_prob_delta"] < 0.03
+    # pointwise probability delta: the canonical surrogate's wide dynamic
+    # range (Time 0..172800, heavy-tailed Amount) costs int8 more than the
+    # old narrow synthetic did; ranking quality is the AUC bound above
+    assert out["max_prob_delta"] < 0.1
     assert out["checkpoint"].startswith(q8)
 
     like = get_model("mlp_q8").init()
@@ -131,7 +137,7 @@ def test_quantize_lifecycle(tmp_path, capsys):
     assert main(["quantize", "--checkpoint-dir", str(tmp_path / "none")]) == 2
 
 
-def test_cmd_score_bulk_csv(tmp_path, capsys):
+def test_cmd_score_bulk_csv(tmp_path, capsys, monkeypatch):
     """Offline bulk scoring: train -> checkpoint -> score a CSV with it."""
     import numpy as np
 
@@ -141,6 +147,7 @@ def test_cmd_score_bulk_csv(tmp_path, capsys):
     csv_path = tmp_path / "creditcard.csv"
     csv_path.write_bytes(to_csv_bytes(load_dataset(n_synthetic=2000)))
     ckpt = str(tmp_path / "ckpt")
+    monkeypatch.setenv("CCFD_SURROGATE_ROWS", "20000")  # lifecycle, not scale
     rc = main(["train", "--steps", "40", "--checkpoint-dir", ckpt])
     assert rc == 0
     capsys.readouterr()
