@@ -195,5 +195,9 @@ def broker_from_url(broker_url: str, **local_kwargs):
     if broker_url.startswith("kafka://"):
         from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
 
-        return KafkaAdapter(broker_url[len("kafka://"):])
+        # registry= flows through so the adapter's health counters
+        # (kafka_adapter_records_produced_total / _send_errors_total, the
+        # KafkaCluster board's adapter panels) exist in real deployments,
+        # not just tests
+        return KafkaAdapter(broker_url[len("kafka://"):], **local_kwargs)
     return None  # caller builds the in-process Broker (with its own options)

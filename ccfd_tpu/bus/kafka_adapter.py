@@ -16,10 +16,13 @@ hash-on-key-bytes partition routing is stable on content, matching the
 in-process broker's crc32-on-key-bytes intent.
 
 Delivery semantics mirror the in-process ``Consumer`` ("offsets
-auto-commit on poll"): the adapter's consumer polls with
-``enable_auto_commit=False`` and commits synchronously after each
-non-empty poll, so a crash between poll and commit redelivers —
-at-least-once, the stronger side of the in-process contract.
+auto-commit on poll", bus/broker.py — at-most-once hand-off): the
+adapter's consumer polls with ``enable_auto_commit=False`` and commits
+synchronously INSIDE each non-empty poll, so a successor in the group
+resumes after the delivered batch; a crash mid-handling drops that batch
+rather than redelivering it, identically on both transports. (Only a
+crash in the narrow window between the broker fetch and the commit call
+itself redelivers.)
 
 ``kafka-python`` is not in the baked image; construction degrades to a
 clear RuntimeError without it. The ``kafka_module`` seam lets tests run
@@ -71,6 +74,7 @@ class KafkaAdapter:
         default_partitions: int = 3,
         kafka_module: Any = None,
         timeout_s: float = 30.0,
+        registry: Any = None,
     ):
         if kafka_module is None:
             try:
@@ -92,6 +96,19 @@ class KafkaAdapter:
         )
         self._meta_consumer = None  # lazy: only needed for end_offsets
         self._admin = None  # lazy: only needed for create_topic
+        # adapter-side health series for the KafkaCluster board (broker
+        # internals come from the JMX exporter; the adapter contributes its
+        # own produce/send-failure view of cluster health)
+        self._c_produced = self._c_send_errors = None
+        if registry is not None:
+            self._c_produced = registry.counter(
+                "kafka_adapter_records_produced_total",
+                "records acknowledged by the cluster",
+            )
+            self._c_send_errors = registry.counter(
+                "kafka_adapter_send_errors_total",
+                "sends that failed or timed out",
+            )
 
     # -- admin ------------------------------------------------------------
     def create_topic(self, name: str, n_partitions: int | None = None) -> None:
@@ -128,7 +145,14 @@ class KafkaAdapter:
     # -- produce ----------------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None) -> dict[str, Any]:
         fut = self._producer.send(topic, value=value, key=key)
-        md = fut.get(timeout=self._timeout_s)
+        try:
+            md = fut.get(timeout=self._timeout_s)
+        except Exception:
+            if self._c_send_errors is not None:
+                self._c_send_errors.inc()
+            raise
+        if self._c_produced is not None:
+            self._c_produced.inc()
         return {"topic": md.topic, "partition": md.partition, "offset": md.offset}
 
     def produce_batch(
@@ -146,8 +170,25 @@ class KafkaAdapter:
             for v, k in zip(values, key_list)
         ]
         self._producer.flush(timeout=self._timeout_s)
+        # per-record accounting even on partial failure: futures that the
+        # cluster acknowledged count as produced (their records ARE in the
+        # log, visible to consumers), each failed future counts one error,
+        # and the call still fails afterward (prefix-committed semantics)
+        n_ok = 0
+        first_err: Exception | None = None
         for f in futures:
-            f.get(timeout=self._timeout_s)
+            try:
+                f.get(timeout=self._timeout_s)
+                n_ok += 1
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                if self._c_send_errors is not None:
+                    self._c_send_errors.inc()
+                if first_err is None:
+                    first_err = e
+        if self._c_produced is not None and n_ok:
+            self._c_produced.inc(n_ok)
+        if first_err is not None:
+            raise first_err
         return len(values)
 
     # -- consume ----------------------------------------------------------
@@ -174,9 +215,11 @@ class KafkaAdapter:
 class KafkaConsumerAdapter:
     """``bus.broker.Consumer`` surface over a kafka-python KafkaConsumer.
 
-    Commit discipline: commit AFTER the poll that delivered the batch (the
-    in-process Consumer's "auto-commit on poll"), not before the next one —
-    a crash mid-handling redelivers the batch to the group on restart.
+    Commit discipline mirrors the in-process Consumer (bus/broker.py:
+    "auto-commit on poll", at-most-once hand-off): the batch a poll()
+    delivers is committed as part of that poll, so a successor consumer in
+    the group resumes AFTER it — a crash mid-handling drops that batch
+    rather than redelivering it, identically on both transports.
     """
 
     def __init__(self, kc: Any, group_id: str, topics: tuple[str, ...]):

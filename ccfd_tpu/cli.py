@@ -581,13 +581,19 @@ def cmd_up(args: argparse.Namespace) -> int:
     return 0
 
 
-def _broker_for(cfg):
+def _broker_for(cfg, registry=None):
     """BROKER_URL decides the transport: http:// -> RemoteBroker against a
-    `bus serve` process; anything else -> in-process Broker (durable when
-    CCFD_BUS_DIR is set)."""
+    `bus serve` process; kafka:// -> real-cluster adapter (health counters
+    into ``registry`` when given); anything else -> in-process Broker
+    (durable when CCFD_BUS_DIR is set)."""
     from ccfd_tpu.bus.client import broker_from_url
 
-    remote = broker_from_url(cfg.broker_url)
+    kwargs = (
+        {"registry": registry}
+        if registry is not None and cfg.broker_url.startswith("kafka://")
+        else {}
+    )
+    remote = broker_from_url(cfg.broker_url, **kwargs)
     if remote is not None:
         return remote
     from ccfd_tpu.bus.broker import Broker
@@ -679,7 +685,12 @@ def cmd_router(args: argparse.Namespace) -> int:
         print("[router] standalone mode needs KIE_SERVER_URL=http://... "
               "(run `python -m ccfd_tpu engine`)", file=sys.stderr)
         return 2
-    broker = _broker_for(cfg)
+    from ccfd_tpu.metrics.prom import Registry
+
+    router_registry = Registry()
+    # the adapter's produce/send-error counters land in the router's
+    # scraped registry (the KafkaCluster board's adapter panels)
+    broker = _broker_for(cfg, registry=router_registry)
     if cfg.seldon_url.startswith("http"):
         from ccfd_tpu.serving.client import SeldonClient
 
@@ -697,7 +708,7 @@ def cmd_router(args: argparse.Namespace) -> int:
     engine = EngineRestClient(cfg.kie_server_url,
                               timeout_s=cfg.seldon_timeout_ms / 1000.0,
                               retries=cfg.client_retries)
-    router = Router(cfg, broker, score_fn, engine)
+    router = Router(cfg, broker, score_fn, engine, registry=router_registry)
     # the reference scrapes the router on :8091/prometheus
     # (reference README.md:503-507); the standalone role must expose the
     # same surface the generated k8s Service/annotations point at

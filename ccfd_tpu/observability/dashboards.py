@@ -47,6 +47,36 @@ def _panel(panel_id: int, title: str, exprs: list[str], panel_type: str = "times
     }
 
 
+def _alert_stat(
+    panel_id: int, title: str, exprs: list[str],
+    red_above: float | None = None, red_below: float | None = None,
+) -> dict:
+    """Stat panel with alert-style threshold coloring — the shape the
+    reference's Kafka board uses for its broker-health stats (Brokers
+    Online / Under Replicated Partitions / Offline Partitions,
+    reference deploy/grafana/Kafka.json singlestat panels): green when
+    healthy, red past the threshold, so the operational signal reads at a
+    glance instead of needing a query."""
+    p = _panel(panel_id, title, exprs, "stat")
+    if red_above is not None:
+        steps = [
+            {"color": "green", "value": None},
+            {"color": "red", "value": red_above},
+        ]
+    elif red_below is not None:
+        steps = [
+            {"color": "red", "value": None},
+            {"color": "green", "value": red_below},
+        ]
+    else:  # pragma: no cover - callers always pick a direction
+        steps = [{"color": "green", "value": None}]
+    p["fieldConfig"] = {
+        "defaults": {"thresholds": {"mode": "absolute", "steps": steps}},
+        "overrides": [],
+    }
+    return p
+
+
 def _dashboard(title: str, uid: str, panels: list[dict]) -> dict:
     return {
         "title": title,
@@ -133,6 +163,13 @@ def seldon_core_dashboard() -> dict:
             _panel(2 + i, f"Latency p{int(q*100)}",
                    [f"histogram_quantile({q}, rate({h}_bucket[5m]))"])
         )
+    # dispatch-health alerts: wedged attachment / deadline hits / requests
+    # the host tier absorbed while the device was out (serving/dispatch.py)
+    p.append(_alert_stat(7, "Device wedged", ["ccfd_device_wedged"], red_above=1))
+    p.append(_alert_stat(8, "Dispatch timeouts",
+                         ["rate(ccfd_dispatch_timeouts_total[5m])"], red_above=0.1))
+    p.append(_panel(9, "Host-fallback scores / s",
+                    ["rate(ccfd_host_fallback_scores_total[5m])"]))
     return _dashboard("CCFD Serving (SeldonCore)", "ccfd-seldon", p)
 
 
@@ -149,14 +186,59 @@ def bus_dashboard() -> dict:
                ["rate(bus_topic_records_in_total[5m])"]),
         _panel(3, "Log end offset by topic/partition", ["bus_topic_end_offset"]),
         _panel(4, "Consumer-group backlog (lag)", ["bus_topic_backlog"]),
-        _panel(5, "Live consumers", ["bus_consumers"], "stat"),
-        _panel(6, "Producer rows / s", ["rate(producer_rows_total[5m])"]),
-        _panel(7, "Notifications sent / replies",
+        # alert-depth health stats (the operational point of the reference
+        # Kafka board): red when no consumer is attached, when backlog
+        # grows past a stall-scale threshold, or when the serving side has
+        # marked its device wedged
+        _alert_stat(5, "Live consumers", ["bus_consumers"], red_below=1),
+        _alert_stat(6, "Max consumer lag", ["max(bus_topic_backlog)"],
+                    red_above=100_000),
+        _alert_stat(7, "Scorer device wedged", ["max(ccfd_device_wedged)"],
+                    red_above=1),
+        _panel(8, "Producer rows / s", ["rate(producer_rows_total[5m])"]),
+        _panel(9, "Notifications sent / replies",
                ["rate(notifications_sent_total[5m])",
                 "rate(notifications_replied_total[5m])",
                 "rate(notifications_no_reply_total[5m])"]),
     ]
     return _dashboard("CCFD Bus", "ccfd-bus", p)
+
+
+def kafka_cluster_dashboard() -> dict:
+    """Broker-health board for the REAL-Kafka deployment mode.
+
+    When `bus/kafka_adapter.py` points the pipeline at an actual cluster
+    (the reference's 3-broker Strimzi, frauddetection_cr.yaml:73-77), the
+    in-proc Bus board's series don't exist — the cluster is scraped via the
+    Kafka JMX exporter instead. This board carries the reference Kafka
+    board's operational stat panels with the same JMX metric names and
+    alert thresholds (reference deploy/grafana/Kafka.json: Brokers Online /
+    Online Partitions / Under Replicated Partitions / Offline Partitions
+    Count) plus throughput/lag views.
+    """
+    p = [
+        _alert_stat(0, "Brokers Online",
+                    ["count(kafka_server_replicamanager_leadercount)"],
+                    red_below=3),
+        _alert_stat(1, "Online Partitions",
+                    ["sum(kafka_server_replicamanager_partitioncount)"],
+                    red_below=1),
+        _alert_stat(2, "Under Replicated Partitions",
+                    ["sum(kafka_server_replicamanager_underreplicatedpartitions)"],
+                    red_above=1),
+        _alert_stat(3, "Offline Partitions Count",
+                    ["sum(kafka_controller_kafkacontroller_offlinepartitionscount)"],
+                    red_above=1),
+        _panel(4, "Messages in / s",
+               ["sum(rate(kafka_server_brokertopicmetrics_messagesin_total[5m]))"]),
+        _panel(5, "Bytes in / out per second",
+               ["sum(rate(kafka_server_brokertopicmetrics_bytesin_total[5m]))",
+                "sum(rate(kafka_server_brokertopicmetrics_bytesout_total[5m]))"]),
+        _panel(6, "Consumer group lag", ["sum(kafka_consumergroup_lag) by (consumergroup)"]),
+        _alert_stat(7, "Adapter send failures",
+                    ["rate(kafka_adapter_send_errors_total[5m])"], red_above=1),
+    ]
+    return _dashboard("CCFD Kafka Cluster", "ccfd-kafka", p)
 
 
 def analytics_dashboard() -> dict:
@@ -192,6 +274,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "ModelPrediction": model_prediction_dashboard(),
         "SeldonCore": seldon_core_dashboard(),
         "Bus": bus_dashboard(),
+        "KafkaCluster": kafka_cluster_dashboard(),
         "Analytics": analytics_dashboard(),
         "Retrain": retrain_dashboard(),
     }

@@ -146,9 +146,12 @@ def fit_mlp(
     (25% positive) plus an exact log-odds recalibration of the output bias
     for the sampling ratio, so ranking quality comes from a strong gradient
     signal while ``proba_1`` stays calibrated to the true base rate (the
-    FRAUD_THRESHOLD contract reads absolute probabilities). Kicks in only
-    when the positive rate is under ``balance_below``; balanced or
-    synthetic-heavy datasets train exactly as before.
+    FRAUD_THRESHOLD contract reads absolute probabilities). Kicks in
+    whenever the positive rate is under ``balance_below`` (5%) — which
+    includes the 1%-positive default synthetic stream, so demo and
+    serve-``--train`` flows serve base-rate-calibrated probabilities now
+    (previously their proba_1 ran ~pos_weight-inflated against
+    FRAUD_THRESHOLD); datasets at or above 5% positives train as before.
     """
     tc = tc or TrainConfig()
     key = jax.random.PRNGKey(seed)

@@ -134,6 +134,52 @@ def _service(name: str, port: int) -> dict[str, Any]:
     }
 
 
+def _ingress(
+    name: str, service: str, port: int, path: str = "/",
+    class_name: str | None = None,
+) -> dict[str, Any]:
+    """External exposure for a Service — the portable analog of the
+    reference's OpenShift Route (reference deploy/model/modelfull-route.yaml:
+    1-12 exposes the Seldon model the same way: route -> service -> http
+    port). networking.k8s.io/v1 Ingress so it applies on any conformant
+    cluster; an OpenShift install can still `oc expose service <name>`.
+
+    ``class_name`` (CR opt ``ingress_class``): clusters with no default
+    IngressClass silently never reconcile class-less Ingresses — set it
+    there (e.g. ``nginx``) or the object is accepted but never routed.
+    """
+    spec_extra: dict[str, Any] = (
+        {"ingressClassName": class_name} if class_name else {}
+    )
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": {"name": name, "labels": {"app": service}},
+        "spec": {
+            **spec_extra,
+            "rules": [
+                {
+                    "host": f"{name}.ccfd.local",
+                    "http": {
+                        "paths": [
+                            {
+                                "path": path,
+                                "pathType": "Prefix",
+                                "backend": {
+                                    "service": {
+                                        "name": service,
+                                        "port": {"number": port},
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                }
+            ]
+        },
+    }
+
+
 def _scrape(port: int, path: str) -> dict[str, str]:
     # reference wires Prometheus by pod annotation (README.md:292-301)
     return {
@@ -222,6 +268,10 @@ def build_manifests(
             resources={"limits": {"google.com/tpu": 1}},
         ),
         _service("scorer", scorer_port),
+        # external exposure (reference modelfull-route.yaml exposes the
+        # model service the same way)
+        _ingress("scorer", "scorer", scorer_port,
+                 class_name=sc.opt("ingress_class", "") or None),
     ]
 
     # --- engine (KIE server role; env contract deploy/ccd-service.yaml:54-66
@@ -251,6 +301,11 @@ def build_manifests(
                 probe_path="/healthz",
             ),
             _service("engine", 8090),
+            # KIE-shaped REST is operator-facing (process inspection,
+            # signals) — exposed like the reference's service routes
+            _ingress("engine", "engine", 8090,
+                     class_name=spec.component("engine").opt("ingress_class", "")
+                     or None),
         ]
 
     # --- router (ccd-fuse role; env contract deploy/router.yaml:54-70)

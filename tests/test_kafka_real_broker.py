@@ -1,0 +1,95 @@
+"""KafkaAdapter against a REAL broker, gated on one being reachable.
+
+This build environment cannot host a broker (no JVM, no kafka-python, no
+network egress — VERDICT r2 next-step #6 documents the gap), so the adapter
+is normally validated against the in-process protocol fake
+(tests/fake_kafka.py). On any machine that has both `pip install
+kafka-python` and a reachable cluster (a single-node container is enough):
+
+    CCFD_KAFKA_BOOTSTRAP=localhost:9092 python -m pytest tests/test_kafka_real_broker.py -v
+
+and this module runs the same adapter surface — produce, pipelined batch
+produce, group consume with manual commit, end_offsets, resume-after-close —
+against the real implementation, no component changes.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+
+import pytest
+
+BOOTSTRAP = os.environ.get("CCFD_KAFKA_BOOTSTRAP", "")
+
+kafka = pytest.importorskip(
+    "kafka", reason="kafka-python not installed (expected in this image)"
+)
+pytestmark = pytest.mark.skipif(
+    not BOOTSTRAP,
+    reason="set CCFD_KAFKA_BOOTSTRAP=host:9092 to run against a real broker",
+)
+
+
+@pytest.fixture()
+def adapter():
+    from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
+    from ccfd_tpu.metrics.prom import Registry
+
+    a = KafkaAdapter(BOOTSTRAP, registry=Registry())
+    yield a
+    a.close()
+
+
+@pytest.fixture()
+def topic(adapter):
+    name = f"ccfd-it-{uuid.uuid4().hex[:12]}"
+    adapter.create_topic(name, n_partitions=3)
+    return name
+
+
+def test_produce_consume_roundtrip(adapter, topic):
+    md = adapter.produce(topic, {"id": 1, "Amount": 9.25}, key="k1")
+    assert md["topic"] == topic and md["offset"] >= 0
+    c = adapter.consumer(f"g-{uuid.uuid4().hex[:8]}", [topic])
+    got = []
+    for _ in range(20):
+        got.extend(c.poll(timeout_s=1.0))
+        if got:
+            break
+    assert any(r.value == {"id": 1, "Amount": 9.25} for r in got)
+    c.close()
+
+
+def test_batch_produce_and_end_offsets(adapter, topic):
+    n = adapter.produce_batch(topic, [{"i": i} for i in range(100)])
+    assert n == 100
+    assert sum(adapter.end_offsets(topic)) == 100
+
+
+def test_commit_resume_discipline(adapter, topic):
+    """Auto-commit-on-poll (the in-process Consumer's contract,
+    bus/broker.py): a batch delivered by poll() is committed, so a NEW
+    consumer in the same group resumes after it instead of replaying — and
+    records produced after the handoff reach the successor exactly like a
+    router restart under the supervisor."""
+    adapter.produce_batch(topic, [{"i": i} for i in range(10)])
+    group = f"g-{uuid.uuid4().hex[:8]}"
+    c1 = adapter.consumer(group, [topic])
+    seen = []
+    for _ in range(20):
+        seen.extend(c1.poll(timeout_s=1.0))
+        if len(seen) >= 10:
+            break
+    assert len(seen) >= 10
+    c1.close()
+
+    adapter.produce_batch(topic, [{"i": i} for i in range(10, 15)])
+    c2 = adapter.consumer(group, [topic])
+    seen2 = []
+    for _ in range(20):
+        seen2.extend(c2.poll(timeout_s=1.0))
+        if len(seen2) >= 5:
+            break
+    values = sorted(r.value["i"] for r in seen2)
+    assert values == [10, 11, 12, 13, 14]  # resumed, no replay of 0..9
+    c2.close()

