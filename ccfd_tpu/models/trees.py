@@ -92,6 +92,12 @@ def logits_mxu(params: Params, x: jax.Array) -> jax.Array:
     dense tree embedding itself makes. Exact same semantics as
     :func:`logits` (parity-tested); choose per backend via the
     ``gbt_mxu`` registry entry.
+
+    Measured regimes (BASELINE.md "Model variants"): on CPU the gather
+    path wins decisively (221k vs 79k tx/s, BENCH_r02 zoo) — extra FLOPs
+    with no systolic array to feed them to. The MXU inversion is the
+    HYPOTHESIS this variant exists to test; treat ``gbt_mxu`` as
+    experimental until an on-TPU zoo capture records it winning.
     """
     feat, thr, leaf = params["feature"], params["threshold"], params["leaf"]
     n_trees = leaf.shape[0]

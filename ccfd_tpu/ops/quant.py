@@ -1,9 +1,14 @@
-"""Int8 quantized MLP serving: double MXU throughput at fraud-scorer accuracy.
+"""Int8 quantized MLP serving for fraud-scorer accuracy at reduced precision.
 
-TPU MXUs execute int8 x int8 -> int32 matmuls at twice the bf16 rate, and
-int8 weights/activations halve the HBM and H2D bytes again over bf16 — on
-a wire-bound attachment that is the larger win. This module quantizes the
-flagship MLP (models/mlp.py) for inference:
+Architectural rationale: TPU MXUs execute int8 x int8 -> int32 matmuls at
+up to twice the bf16 rate, and int8 weights/activations halve the HBM and
+H2D bytes again over bf16 — on a wire-bound attachment that is the larger
+win. NOTE these are the hardware's numbers, not this model's: ``mlp_q8``
+has no recorded on-TPU throughput yet (the bench's ``quant_int8`` section
+is TPU-gated; accuracy IS measured — see below and BASELINE.md "Model
+variants"). Until a capture lands, the claim this module makes is accuracy
+preservation, not speed. This module quantizes the flagship MLP
+(models/mlp.py) for inference:
 
 - **Weights**: symmetric per-output-channel int8 at quantization time
   (``quantize_mlp``): scale_o = max|W[:, o]| / 127. Per-channel keeps the
