@@ -19,6 +19,7 @@ import json
 import os
 import sys
 import time
+from typing import Any
 
 from ccfd_tpu.config import Config
 
@@ -765,6 +766,121 @@ def cmd_producer(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """One-shot operational health report, built for the failure mode this
+    stack actually sees: an accelerator attachment that wedges so hard
+    ``jax.devices()`` never returns. Everything that could hang runs in a
+    SUBPROCESS with a timeout; the report is one JSON object on stdout and
+    the exit code is 0 only when the accelerator answered.
+
+    Sections: accelerator (platform, device count, measured dispatch RTT),
+    native toolchain, bus/store reachability for the configured URLs,
+    checkpoint presence, and the env-contract values in effect.
+    """
+    import subprocess
+    import time as _time
+
+    from ccfd_tpu.config import Config
+
+    cfg = Config.from_env()
+    report: dict[str, Any] = {"ok": True}
+
+    # --- accelerator (subprocess probe + tiny-dispatch RTT) ---------------
+    probe_code = (
+        "import json, os, time, jax\n"
+        # operator-exported JAX_PLATFORMS wins over the site hook, same
+        # contract as _honor_platform_env
+        "w = os.environ.get('JAX_PLATFORMS', '')\n"
+        "w and jax.config.update('jax_platforms', w)\n"
+        "d = jax.devices()\n"
+        "import jax.numpy as jnp\n"
+        "x = jnp.zeros((16, 30), jnp.float32)\n"
+        "(x @ x.T).block_until_ready()  # compile\n"
+        "t0 = time.perf_counter()\n"
+        "for _ in range(5): (x @ x.T).block_until_ready()\n"
+        "rtt_ms = (time.perf_counter() - t0) / 5 * 1e3\n"
+        "print(json.dumps({'platform': jax.default_backend(),"
+        " 'devices': len(d), 'dispatch_rtt_ms': round(rtt_ms, 3)}))\n"
+    )
+    t0 = _time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe_code],
+            timeout=args.probe_s, capture_output=True, text=True,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            report["accelerator"] = json.loads(r.stdout.strip().splitlines()[-1])
+            report["accelerator"]["probe_s"] = round(
+                _time.perf_counter() - t0, 2
+            )
+        else:
+            report["accelerator"] = {
+                "error": (r.stderr or "probe failed").strip()[-300:],
+            }
+            report["ok"] = False
+    except subprocess.TimeoutExpired:
+        report["accelerator"] = {
+            "error": f"WEDGED: no answer within {args.probe_s:.0f}s "
+            "(jax.devices() hang — the attachment is stuck; serving falls "
+            "back to the host tier, see serving/dispatch.py)",
+        }
+        report["ok"] = False
+
+    # --- native toolchain -------------------------------------------------
+    try:
+        from ccfd_tpu.native import native_available
+
+        report["native_toolchain"] = bool(native_available())
+    except Exception as e:  # noqa: BLE001 - report, don't crash the doctor
+        report["native_toolchain"] = f"error: {e}"
+
+    # --- bus / store reachability (only for networked URLs) ---------------
+    def _tcp_check(url: str) -> str:
+        import socket
+        from urllib.parse import urlparse
+
+        if not url.startswith(("http://", "kafka://")):
+            return "in-process (nothing to dial)"
+        p = urlparse(url)
+        # scheme-correct default ports: 9092 is Kafka's, not HTTP's
+        port = p.port or (9092 if url.startswith("kafka://") else 80)
+        try:
+            with socket.create_connection((p.hostname, port), timeout=3):
+                return "reachable"
+        except OSError as e:
+            return f"unreachable: {e}"
+
+    report["bus"] = {"url": cfg.broker_url, "status": _tcp_check(cfg.broker_url)}
+    if cfg.s3_endpoint:
+        report["store"] = {
+            "url": cfg.s3_endpoint, "status": _tcp_check(cfg.s3_endpoint),
+        }
+
+    # --- model artifacts --------------------------------------------------
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    for label, d in (("checkpoint", args.checkpoint_dir),
+                     ("quantized", args.quantized_dir)):
+        try:
+            step = CheckpointManager(d).latest_step()
+        except Exception:  # noqa: BLE001 - unreadable dir reads as absent
+            step = None
+        report[label] = {"dir": d, "latest_step": step}
+
+    # --- config in effect -------------------------------------------------
+    report["config"] = {
+        "model": cfg.model_name,
+        "compute_dtype": cfg.compute_dtype,
+        "fraud_threshold": cfg.fraud_threshold,
+        "seldon_timeout_ms": cfg.seldon_timeout_ms,
+        "dispatch_deadline_ms": cfg.dispatch_deadline_ms,
+        "host_tier_rows": cfg.host_tier_rows,
+        "batch_sizes": list(cfg.batch_sizes),
+    }
+    print(json.dumps(report))
+    return 0 if report["ok"] else 3
+
+
 def _honor_platform_env() -> None:
     """A site hook may force its own jax platform (e.g. a TPU tunnel plugin)
     over the environment; an operator who exported JAX_PLATFORMS explicitly
@@ -982,6 +1098,15 @@ def main(argv: list[str] | None = None) -> int:
     u.add_argument("--exit-after-producer", action="store_true")
     u.add_argument("--drain-s", type=float, default=120.0)
     u.set_defaults(fn=cmd_up)
+
+    dr = sub.add_parser(
+        "doctor", help="environment/attachment health report (JSON)"
+    )
+    dr.add_argument("--probe-s", type=float, default=30.0,
+                    help="accelerator probe timeout (subprocess)")
+    dr.add_argument("--checkpoint-dir", default="./checkpoints")
+    dr.add_argument("--quantized-dir", default=_Q8_DIR)
+    dr.set_defaults(fn=cmd_doctor)
 
     args = p.parse_args(argv)
     return args.fn(args)

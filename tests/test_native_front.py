@@ -239,3 +239,68 @@ def test_graph_cr_serves_through_native_front():
             assert 0.0 <= p1 <= 1.0 and abs(p0 + p1 - 1.0) < 1e-6
     finally:
         srv.stop()
+
+
+def test_native_front_wedged_device_bounded():
+    """A wedged device behind the native front: taker-thread requests above
+    the in-front row cap stay BOUNDED — host fallback (200) for models with
+    a host forward, 503 otherwise — instead of hanging the taker forever
+    (VERDICT r2 weak #7, server-side SELDON_TIMEOUT)."""
+    import dataclasses
+    import threading
+    import time
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset as _sd
+
+    ds = _sd(n=128, fraud_rate=0.05, seed=3)
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    s = Scorer(model_name="mlp", params=params, batch_sizes=(16, 128),
+               compute_dtype="bfloat16", host_tier_rows=16,
+               dispatch_deadline_ms=250.0)
+    wedged, release = threading.Event(), threading.Event()
+    orig = s._apply
+
+    def gated(p, xx):
+        if wedged.is_set():
+            release.wait(timeout=30.0)
+        return orig(p, xx)
+
+    s._apply = gated
+    s.warmup()
+    srv = PredictionServer(s, Config(native_front=True))
+    port = srv.start("127.0.0.1", 0)
+    try:
+        assert type(srv._httpd).__name__ == "NativeFront"
+        rows = ds.X[:64].tolist()  # 64 > host_tier_rows: taker -> device path
+        code, out = _post(port, "/api/v0.1/predictions",
+                          {"data": {"ndarray": rows}})
+        assert code == 200
+        want = [p1 for _, p1 in out["data"]["ndarray"]]
+
+        wedged.set()
+        t0 = time.perf_counter()
+        code, out = _post(port, "/api/v0.1/predictions",
+                          {"data": {"ndarray": rows}})
+        dt = time.perf_counter() - t0
+        assert dt < 5.0, dt  # bounded by the deadline, not the hang
+        assert code == 200  # host fallback carried it
+        got = [p1 for _, p1 in out["data"]["ndarray"]]
+        assert np.allclose(got, want, atol=2e-2)
+        assert s._wedge.wedged
+
+        # no host forward => bounded 503 through the taker loop
+        s.spec = dataclasses.replace(s.spec, apply_numpy=None)
+        with s._lock:
+            s._host_params = None
+        s.host_tier_rows = 0
+        t0 = time.perf_counter()
+        code, out = _post(port, "/api/v0.1/predictions",
+                          {"data": {"ndarray": rows}})
+        assert time.perf_counter() - t0 < 5.0
+        assert code == 503
+        assert "unavailable" in out.get("error", "")
+    finally:
+        release.set()
+        time.sleep(0.1)
+        srv.stop()

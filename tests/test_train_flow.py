@@ -188,3 +188,19 @@ def test_cmd_audit_tails_event_stream(tmp_path, capsys):
         assert main(["audit", "--limit", "2"]) == 0
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert [e["event"] for e in lines] == ["process_started", "process_completed"]
+
+
+def test_cmd_doctor_reports_health(capsys, monkeypatch):
+    """`ccfd_tpu doctor`: one JSON health report; on this CPU test backend
+    the accelerator probe must answer with a measured dispatch RTT, and the
+    committed model artifacts must be visible."""
+    from ccfd_tpu.cli import main
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    rc = main(["doctor", "--probe-s", "60"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["ok"] is True
+    assert out["accelerator"]["platform"] == "cpu"
+    assert out["accelerator"]["dispatch_rtt_ms"] > 0
+    assert out["checkpoint"]["latest_step"] is not None  # shipped artifact
+    assert out["config"]["fraud_threshold"] == 0.5
