@@ -778,9 +778,6 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     checkpoint presence, and the env-contract values in effect.
     """
     import subprocess
-    import time as _time
-
-    from ccfd_tpu.config import Config
 
     cfg = Config.from_env()
     report: dict[str, Any] = {"ok": True}
@@ -802,7 +799,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         "print(json.dumps({'platform': jax.default_backend(),"
         " 'devices': len(d), 'dispatch_rtt_ms': round(rtt_ms, 3)}))\n"
     )
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     try:
         r = subprocess.run(
             [sys.executable, "-c", probe_code],
@@ -811,7 +808,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         if r.returncode == 0 and r.stdout.strip():
             report["accelerator"] = json.loads(r.stdout.strip().splitlines()[-1])
             report["accelerator"]["probe_s"] = round(
-                _time.perf_counter() - t0, 2
+                time.perf_counter() - t0, 2
             )
         else:
             report["accelerator"] = {
@@ -839,11 +836,13 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         import socket
         from urllib.parse import urlparse
 
-        if not url.startswith(("http://", "kafka://")):
+        if not url.startswith(("http://", "https://", "kafka://")):
             return "in-process (nothing to dial)"
         p = urlparse(url)
         # scheme-correct default ports: 9092 is Kafka's, not HTTP's
-        port = p.port or (9092 if url.startswith("kafka://") else 80)
+        port = p.port or {
+            "kafka": 9092, "https": 443
+        }.get(p.scheme, 80)
         try:
             with socket.create_connection((p.hostname, port), timeout=3):
                 return "reachable"
@@ -874,6 +873,24 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         "fraud_threshold": cfg.fraud_threshold,
         "seldon_timeout_ms": cfg.seldon_timeout_ms,
         "dispatch_deadline_ms": cfg.dispatch_deadline_ms,
+        # the resolved value serving would arm (-1 above = auto). Computed
+        # from the SUBPROCESS probe's platform — Config's own helper calls
+        # jax.default_backend(), which would initialize a backend in THIS
+        # process and hang on the exact wedge the doctor diagnoses
+        "dispatch_deadline_ms_effective": (
+            cfg.dispatch_deadline_ms
+            if cfg.dispatch_deadline_ms >= 0
+            else (
+                f"unknown (probe failed; accelerator backends arm "
+                f"{cfg.seldon_timeout_ms})"
+                if "platform" not in report["accelerator"]
+                else (
+                    0.0
+                    if report["accelerator"]["platform"] == "cpu"
+                    else float(cfg.seldon_timeout_ms)
+                )
+            )
+        ),
         "host_tier_rows": cfg.host_tier_rows,
         "batch_sizes": list(cfg.batch_sizes),
     }

@@ -194,13 +194,22 @@ def test_cmd_doctor_reports_health(capsys, monkeypatch):
     """`ccfd_tpu doctor`: one JSON health report; on this CPU test backend
     the accelerator probe must answer with a measured dispatch RTT, and the
     committed model artifacts must be visible."""
+    import os
+
     from ccfd_tpu.cli import main
 
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    rc = main(["doctor", "--probe-s", "60"])
+    # hermetic against ambient env (a leftover FRAUD_THRESHOLD export must
+    # not fail the test) and against CWD (committed artifact dir is
+    # repo-relative)
+    monkeypatch.delenv("FRAUD_THRESHOLD", raising=False)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = main(["doctor", "--probe-s", "60",
+               "--checkpoint-dir", os.path.join(repo, "checkpoints")])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and out["ok"] is True
     assert out["accelerator"]["platform"] == "cpu"
     assert out["accelerator"]["dispatch_rtt_ms"] > 0
     assert out["checkpoint"]["latest_step"] is not None  # shipped artifact
     assert out["config"]["fraud_threshold"] == 0.5
+    assert out["config"]["dispatch_deadline_ms_effective"] is not None

@@ -1,0 +1,178 @@
+"""Chaos soak: full in-process pipeline under router kills AND a device wedge.
+
+Round-2 soaked router kills only; this round's dispatch deadline
+(serving/dispatch.py) adds the other failure domain — the accelerator
+attachment wedging mid-run. This driver runs the real pipeline
+(producer feed -> bus -> router micro-batches -> scorer -> process engine)
+with a supervisor + seeded ChaosMonkey killing the router, and at the soak
+midpoint wedges the scorer's device path for ``--wedge-s`` seconds (every
+device dispatch hangs, exactly like the tunnel failure this host actually
+exhibits). The pipeline must keep draining: scoring fails over to the host
+tier, the deadline bounds the one dispatch that hits the wedge, and the
+device path resumes after the heal.
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --seconds 240
+
+Prints one JSON line; record it in BASELINE.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # hermetic: never dial a tunnel
+
+import numpy as np  # noqa: E402
+
+from ccfd_tpu.bus.broker import Broker  # noqa: E402
+from ccfd_tpu.config import Config  # noqa: E402
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, synthetic_dataset  # noqa: E402
+from ccfd_tpu.metrics.prom import Registry  # noqa: E402
+from ccfd_tpu.models import mlp  # noqa: E402
+from ccfd_tpu.process.fraud import build_engine  # noqa: E402
+from ccfd_tpu.router.router import Router  # noqa: E402
+from ccfd_tpu.runtime.chaos import ChaosMonkey  # noqa: E402
+from ccfd_tpu.runtime.supervisor import Supervisor  # noqa: E402
+from ccfd_tpu.serving.scorer import Scorer  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=240.0)
+    ap.add_argument("--wedge-s", type=float, default=20.0,
+                    help="device-wedge duration at the soak midpoint")
+    def _positive_ms(v: str) -> float:
+        f = float(v)
+        if f <= 0:
+            raise argparse.ArgumentTypeError(
+                "the soak exercises the dispatch deadline; it must be > 0"
+            )
+        return f
+
+    ap.add_argument("--deadline-ms", type=_positive_ms, default=250.0)
+    ap.add_argument("--feed-batch", type=int, default=2000)
+    args = ap.parse_args()
+
+    cfg = Config(confidence_threshold=1.0)
+    broker = Broker()
+    reg_r, reg_k, reg_c = Registry(), Registry(), Registry()
+    engine = build_engine(cfg, broker, reg_k, None)
+
+    ds = synthetic_dataset(n=4096, fraud_rate=0.002, seed=0)
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    scorer = Scorer(model_name="mlp", params=params,
+                    batch_sizes=(128, 1024, 4096), host_tier_rows=64,
+                    dispatch_deadline_ms=args.deadline_ms)
+    wedged, release = threading.Event(), threading.Event()
+    orig_apply = scorer._apply
+
+    def gated(p, xx):
+        if wedged.is_set():
+            release.wait(timeout=120.0)
+        return orig_apply(p, xx)
+
+    scorer._apply = gated
+    scorer.warmup()
+    scorer._wedge._probe_interval_s = 2.0  # tight recovery for the soak
+
+    router = Router(cfg, broker, scorer.score, engine, reg_r, max_batch=4096)
+    sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
+    sup.add_thread_service(
+        "router", lambda: router.run(poll_timeout_s=0.02), router.stop,
+        reset=router.reset,
+    )
+    sup.start()
+    monkey = ChaosMonkey(sup, seed=11, targets=["router"],
+                         registry=reg_c, interval_s=20.0)
+    monkey.start()
+
+    # feeder: keep the topic loaded without unbounded backlog
+    rows = [
+        {FEATURE_NAMES[j]: float(ds.X[i, j]) for j in range(30)} | {"id": i}
+        for i in range(args.feed_batch)
+    ]
+    stop_feed = threading.Event()
+    produced = [0]
+
+    def feed() -> None:
+        while not stop_feed.is_set():
+            done = router._c_in.value()
+            if produced[0] - done < 200_000:
+                broker.produce_batch(cfg.kafka_topic, rows)
+                produced[0] += len(rows)
+            else:
+                time.sleep(0.01)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+
+    t0 = time.time()
+    t_wedge = t0 + args.seconds / 2
+    wedge_done = False
+    wedge_info = {}
+    last_progress, last_in = time.time(), 0
+    max_stall_s = 0.0
+    while time.time() - t0 < args.seconds:
+        time.sleep(1.0)
+        cur = router._c_in.value()
+        if cur > last_in:
+            last_in, last_progress = cur, time.time()
+        max_stall_s = max(max_stall_s, time.time() - last_progress)
+        if not wedge_done and time.time() >= t_wedge:
+            wedge_info["wedged_at_tx"] = cur
+            wedged.set()
+            time.sleep(args.wedge_s)
+            wedged.clear()
+            release.set()
+            wedge_done = True
+            wedge_info["healed_at_tx"] = router._c_in.value()
+            # recovery: the probe should clear the wedge promptly
+            t_rec = time.time()
+            while scorer._wedge.wedged and time.time() - t_rec < 60:
+                time.sleep(0.5)
+            wedge_info["recovered_s_after_heal"] = round(time.time() - t_rec, 1)
+            wedge_info["device_path_recovered"] = not scorer._wedge.wedged
+
+    stop_feed.set()
+    monkey.stop()
+    elapsed = time.time() - t0
+    total = router._c_in.value()
+    out_std = reg_r.counter("transaction_outgoing_total").value(
+        labels={"type": "standard"}
+    )
+    out_fraud = reg_r.counter("transaction_outgoing_total").value(
+        labels={"type": "fraud"}
+    )
+    result = {
+        "seconds": round(elapsed, 1),
+        "tx_total": int(total),
+        "tx_s": round(total / elapsed, 1),
+        "router_kills": len(monkey.history),
+        "supervisor_restarts": sup.status()["router"]["restarts"],
+        "max_progress_stall_s": round(max_stall_s, 1),
+        "wedge": wedge_info,
+        "dispatch_timeouts": scorer.dispatch_timeouts,
+        "host_fallback_scores": scorer.host_fallback_scores,
+        "process_starts": int(out_std + out_fraud),
+    }
+    sup.stop()
+    print(json.dumps(result))
+    ok = (
+        total > 0
+        and wedge_info.get("device_path_recovered", False)
+        and wedge_info.get("healed_at_tx", 0) > wedge_info.get("wedged_at_tx", 0)
+    )
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
