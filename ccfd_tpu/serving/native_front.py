@@ -160,14 +160,48 @@ class NativeFront:
         return int(port_out.value)
 
     # -- in-front host-tier model ------------------------------------------
+    def _inline_rows_cap(self) -> int:
+        """Row cap for in-IO-thread scoring. The host latency TIER's
+        threshold (measured device RTT vs numpy rate) governs where it is
+        armed; where it is off (CPU backends auto-disable it — there is no
+        attachment RTT to hide), the C++ SIMD forward still beats a jax
+        dispatch for small requests (~1.4 us/row vs hundreds of us of
+        dispatch+queue overhead), so the front keeps a default 256-row cap
+        there. CCFD_INLINE_ROWS overrides; 0 disables."""
+        import os
+
+        env = os.environ.get("CCFD_INLINE_ROWS", "").strip()
+        if env:
+            try:
+                return min(int(env), self.INLINE_MAX_ROWS)  # explicit wins
+            except ValueError:
+                import sys
+
+                print(
+                    f"[native-front] ignoring non-integer "
+                    f"CCFD_INLINE_ROWS={env!r}",
+                    file=sys.stderr,
+                )
+        htr = int(self._server.scorer.host_tier_rows)
+        if htr > 0:
+            cap = htr
+        else:
+            import jax
+
+            # tier auto-off on cpu (no attachment RTT to hide) still wants
+            # in-front scoring; tier explicitly off on an accelerator is an
+            # operator choice — respect it
+            cap = 256 if jax.default_backend() == "cpu" else 0
+        return min(cap, self.INLINE_MAX_ROWS)
+
     def _install_host_model(self) -> None:
-        """Push the scorer's host-tier params into the C++ front so small
+        """Push the scorer's host params into the C++ front so small
         canonical requests score in the IO thread with ZERO Python handoffs
         (the decisive path on a small serving host: the queue round trip
         costs more in context switches than the forward itself). Re-pushed
         on every ``swap_params`` so online retrain reaches the front."""
         srv = self._server
-        if srv.scorer.host_tier_rows <= 0:
+        if self._inline_rows_cap() <= 0:
             return
         host_params = getattr(srv.scorer, "_host_params", None)
         if host_params is None:
@@ -218,7 +252,7 @@ class NativeFront:
             thr.ctypes.data_as(fp),
             leaf.ctypes.data_as(fp),
             base,
-            min(int(self._server.scorer.host_tier_rows), self.INLINE_MAX_ROWS),
+            self._inline_rows_cap(),
             self._server.scorer.spec.name.encode(),
             self._gauge_cols(),
         )
@@ -244,7 +278,7 @@ class NativeFront:
             b.ctypes.data_as(fp),
             None if m is None else m.ctypes.data_as(fp),
             None if s is None else s.ctypes.data_as(fp),
-            min(int(self._server.scorer.host_tier_rows), self.INLINE_MAX_ROWS),
+            self._inline_rows_cap(),
             self._server.scorer.spec.name.encode(),
             gcols,
         )

@@ -203,12 +203,14 @@ class Scorer:
         self._wedge = None
         self.dispatch_timeouts = 0
         self.host_fallback_scores = 0
-        keep_host = self.host_tier_rows > 0 or (
-            self.dispatch_deadline_s > 0 and self.spec.apply_numpy is not None
-        )
-        if keep_host and self.spec.apply_numpy is not None:
-            # the wedge fallback needs host params even when the latency
-            # tier is off — they cannot be pulled from a wedged device later
+        # Host params are kept whenever the family has a host forward: the
+        # latency tier routes by host_tier_rows, the wedge fallback needs
+        # them armed BEFORE a wedge (they cannot be pulled from a hung
+        # device later), and the C++ front's in-IO-thread model derives its
+        # copy from them on every backend (its SIMD forward beats even a
+        # local jax dispatch for small requests). One numpy copy of the
+        # params; refreshed on every swap.
+        if self.spec.apply_numpy is not None:
             self._host_params = jax.tree.map(
                 _host_cast, params if params is not None else self._params
             )
@@ -349,7 +351,15 @@ class Scorer:
                         self._put_batch(np.zeros((b, self.num_features), np.float32)),
                     )
                 )
-        if self._host_tier_auto and self._host_params is not None:
+        # autotune refines an ARMED auto tier (provisional 256 until
+        # measured); host_tier_rows == 0 means the auto policy resolved the
+        # tier OFF (cpu backend / mesh) — host params may still exist for
+        # the wedge fallback and the C++ front, and must not re-arm it here
+        if (
+            self._host_tier_auto
+            and self.host_tier_rows > 0
+            and self._host_params is not None
+        ):
             self.host_tier_rows = self._autotune_host_tier()
 
     def _autotune_host_tier(self) -> int:
