@@ -627,6 +627,11 @@ def main() -> None:
         compute_dtype="bfloat16",
     )
     scorer.warmup()
+    # services tune gc AFTER warmup (cli.py) so compiled executables/params
+    # land in the frozen permanent generation; the bench mirrors that
+    from ccfd_tpu.utils.gctune import tune_for_service
+
+    tune_for_service()
     tx_per_s, p50, p99 = _bench_scorer(scorer, ds.X, batch, lat_batch, seconds, depth)
     _PARTIAL.update({
         "value": round(tx_per_s, 1), "p50_ms": round(p50, 3),

@@ -128,6 +128,9 @@ class NativeFront:
         # down, or ctypes hands C++ a null/freed Front*
         self._push_lock = threading.Lock()
         self.host_model_active = False
+        # computed once at install (re-parsing the env per swap-push would
+        # spam the malformed-value warning at swap frequency)
+        self._inline_cap_cached: int | None = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, port: int = 0, host: str = "0.0.0.0") -> int:
@@ -170,10 +173,13 @@ class NativeFront:
         there. CCFD_INLINE_ROWS overrides; 0 disables."""
         import os
 
+        if self._inline_cap_cached is not None:
+            return self._inline_cap_cached
         env = os.environ.get("CCFD_INLINE_ROWS", "").strip()
         if env:
             try:
-                return min(int(env), self.INLINE_MAX_ROWS)  # explicit wins
+                self._inline_cap_cached = min(int(env), self.INLINE_MAX_ROWS)
+                return self._inline_cap_cached  # explicit wins
             except ValueError:
                 import sys
 
@@ -192,7 +198,8 @@ class NativeFront:
             # in-front scoring; tier explicitly off on an accelerator is an
             # operator choice — respect it
             cap = 256 if jax.default_backend() == "cpu" else 0
-        return min(cap, self.INLINE_MAX_ROWS)
+        self._inline_cap_cached = min(cap, self.INLINE_MAX_ROWS)
+        return self._inline_cap_cached
 
     def _install_host_model(self) -> None:
         """Push the scorer's host params into the C++ front so small

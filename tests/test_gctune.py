@@ -1,0 +1,58 @@
+"""GC tuning for service loops (utils/gctune.py): thresholds apply, the
+env opt-out works, and frozen startup objects stay collectable-correct."""
+from __future__ import annotations
+
+import gc
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _restore_gc():
+    thr = gc.get_threshold()
+    yield
+    gc.unfreeze()
+    gc.set_threshold(*thr)
+    gc.enable()
+
+
+def test_tune_sets_gen0_threshold(monkeypatch):
+    from ccfd_tpu.utils.gctune import tune_for_service
+
+    monkeypatch.delenv("CCFD_GC_THRESHOLD", raising=False)
+    assert tune_for_service() is True
+    assert gc.get_threshold()[0] == 100_000
+    assert gc.isenabled()  # tuned, not disabled: cycles still collect
+
+
+def test_env_overrides_and_disables(monkeypatch):
+    from ccfd_tpu.utils.gctune import tune_for_service
+
+    monkeypatch.setenv("CCFD_GC_THRESHOLD", "5000")
+    assert tune_for_service() is True
+    assert gc.get_threshold()[0] == 5000
+
+    monkeypatch.setenv("CCFD_GC_THRESHOLD", "0")
+    before = gc.get_threshold()
+    assert tune_for_service() is False
+    assert gc.get_threshold() == before  # untouched
+
+    monkeypatch.setenv("CCFD_GC_THRESHOLD", "not-a-number")
+    assert tune_for_service() is True  # malformed -> default applies
+    assert gc.get_threshold()[0] == 100_000
+
+
+def test_cycles_still_collect_after_tuning(monkeypatch):
+    from ccfd_tpu.utils.gctune import tune_for_service
+
+    monkeypatch.delenv("CCFD_GC_THRESHOLD", raising=False)
+    tune_for_service()
+
+    class Node:
+        def __init__(self):
+            self.ref = None
+
+    a, b = Node(), Node()
+    a.ref, b.ref = b, a
+    del a, b
+    assert gc.collect() >= 2  # the cycle is found by an explicit pass

@@ -82,6 +82,9 @@ def main() -> int:
 
     scorer._apply = gated
     scorer.warmup()
+    from ccfd_tpu.utils.gctune import tune_for_service
+
+    tune_for_service()  # match the gc config services run with
     scorer._wedge._probe_interval_s = 2.0  # tight recovery for the soak
 
     router = Router(cfg, broker, scorer.score, engine, reg_r, max_batch=4096)
