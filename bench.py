@@ -126,43 +126,15 @@ def _bench_scorer(scorer, X, batch, lat_batch, seconds, depth):
     return tx_per_s, float(np.percentile(lat_a, 50)), float(np.percentile(lat_a, 99))
 
 
-_REST_CLIENT_SCRIPT = r"""
-# Lean load generator: raw socket + pre-serialized request bytes. On a
-# small host the clients share cores with the server under test; an
-# http.client loop burns several hundred us of CPU per request on header
-# objects and buffered-IO plumbing, which pollutes the measured latency
-# with load-generator overhead. This loop is sendall + recv-until-length.
-import json, socket, sys, time
-port, rows_n, seconds = int(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3])
-row = [float(j % 7) for j in range(30)]
-payload = json.dumps({"data": {"ndarray": [row] * rows_n}}).encode()
-req = (b"POST /api/v0.1/predictions HTTP/1.1\r\n"
-       b"Host: 127.0.0.1\r\nContent-Type: application/json\r\n"
-       b"Content-Length: " + str(len(payload)).encode() + b"\r\n\r\n" + payload)
-sock = socket.create_connection(("127.0.0.1", port), timeout=10)
-sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-lat = []
-buf = b""
-stop_at = time.perf_counter() + seconds
-t_loop = time.perf_counter()
-while time.perf_counter() < stop_at:
-    t1 = time.perf_counter()
-    sock.sendall(req)
-    while True:
-        head_end = buf.find(b"\r\n\r\n")
-        if head_end >= 0:
-            head = buf[:head_end].lower()
-            cl = int(head.split(b"content-length:", 1)[1].split(b"\r\n", 1)[0])
-            if len(buf) >= head_end + 4 + cl:
-                assert buf.startswith(b"HTTP/1.1 200"), buf[:200]
-                buf = buf[head_end + 4 + cl:]
-                break
-        chunk = sock.recv(1 << 16)
-        assert chunk, "server closed connection"
-        buf += chunk
-    lat.append((time.perf_counter() - t1) * 1e3)
-print(json.dumps({"lat": lat, "loop_s": time.perf_counter() - t_loop}))
-"""
+# The REST client lives in ccfd_tpu/utils/loadgen.py (_CLIENT): ONE copy
+# shared with `ccfd_tpu loadgen`, so operator-side numbers against a
+# deployed scorer compare directly with the bench's rest section.
+
+
+def _loadgen_client() -> str:
+    from ccfd_tpu.utils.loadgen import _CLIENT
+
+    return _CLIENT
 
 
 def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
@@ -190,8 +162,9 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
     transport = type(srv._httpd).__name__  # read before stop() nulls it
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _REST_CLIENT_SCRIPT,
-             str(port), str(rows_per_req), str(seconds)],
+            [sys.executable, "-c", _loadgen_client(),
+             "127.0.0.1", str(port), "/api/v0.1/predictions",
+             str(rows_per_req), str(seconds)],
             stdout=subprocess.PIPE,
         )
         for _ in range(n_clients)
@@ -199,6 +172,7 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
     lat: list[float] = []
     rate = 0.0
     ok = 0
+    errors = 0
     try:
         for p in procs:
             # throughput aggregates per-client measured windows: the
@@ -216,6 +190,7 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
                     continue
                 lat.extend(r["lat"])
                 rate += len(r["lat"]) / max(r["loop_s"], 1e-9)
+                errors += int(r.get("errors", 0))
                 ok += 1
     finally:
         for p in procs:
@@ -236,6 +211,8 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
         # host tier (numpy) instead of paying the device RTT — by design
         "host_tier_rows": scorer.host_tier_rows,
         "transport": transport,
+        # non-200s during the run (the shared client counts, never dies)
+        "errors": errors,
     }
 
 

@@ -773,6 +773,22 @@ def cmd_producer(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_loadgen(args: argparse.Namespace) -> int:
+    """Benchmark a RUNNING scorer endpoint (local or remote) with the same
+    lean client the in-tree bench uses, so operator numbers compare
+    directly against BASELINE.md's rest section. Exits non-zero when any
+    request errored — usable as a smoke gate in deploy pipelines."""
+    from ccfd_tpu.utils.loadgen import run_loadgen
+
+    cfg = Config.from_env()
+    report = run_loadgen(
+        args.url, clients=args.clients, rows_per_request=args.rows,
+        seconds=args.seconds, path=args.path, token=cfg.seldon_token,
+    )
+    print(json.dumps(report))
+    return 0 if report["errors"] == 0 and report["failed_clients"] == 0 else 3
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """One-shot operational health report, built for the failure mode this
     stack actually sees: an accelerator attachment that wedges so hard
@@ -1133,6 +1149,18 @@ def main(argv: list[str] | None = None) -> int:
     u.add_argument("--exit-after-producer", action="store_true")
     u.add_argument("--drain-s", type=float, default=120.0)
     u.set_defaults(fn=cmd_up)
+
+    lg = sub.add_parser(
+        "loadgen", help="drive a deployed scorer's REST endpoint (JSON report)"
+    )
+    lg.add_argument("--url", default="http://127.0.0.1:8000")
+    lg.add_argument("--clients", type=int, default=8)
+    lg.add_argument("--rows", type=int, default=16)
+    lg.add_argument("--seconds", type=float, default=10.0)
+    lg.add_argument("--path", default=None,
+                    help="request path (default: the URL's own path, else "
+                         "/api/v0.1/predictions)")
+    lg.set_defaults(fn=cmd_loadgen)
 
     dr = sub.add_parser(
         "doctor", help="environment/attachment health report (JSON)"

@@ -213,3 +213,30 @@ def test_cmd_doctor_reports_health(capsys, monkeypatch):
     assert out["checkpoint"]["latest_step"] is not None  # shipped artifact
     assert out["config"]["fraud_threshold"] == 0.5
     assert out["config"]["dispatch_deadline_ms_effective"] is not None
+
+
+def test_cmd_loadgen_against_live_server(capsys):
+    """`ccfd_tpu loadgen` drives a running endpoint and reports the same
+    shape as the bench's rest section (operators compare directly)."""
+    import jax as _jax
+
+    from ccfd_tpu.cli import main
+    from ccfd_tpu.models import mlp as mlp_mod
+    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.serving.server import PredictionServer
+
+    s = Scorer(model_name="mlp", params=mlp_mod.init(_jax.random.PRNGKey(0)),
+               batch_sizes=(16, 128))
+    s.warmup()
+    srv = PredictionServer(s)
+    port = srv.start("127.0.0.1", 0)
+    try:
+        rc = main(["loadgen", "--url", f"http://127.0.0.1:{port}",
+                   "--clients", "2", "--rows", "4", "--seconds", "1.5"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert out["errors"] == 0 and out["failed_clients"] == 0
+        assert out["tx_s"] > 0 and out["p99_ms"] > 0
+        assert out["rows_per_request"] == 4 and out["clients"] == 2
+    finally:
+        srv.stop()
