@@ -773,6 +773,56 @@ def cmd_producer(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_tasks(args: argparse.Namespace) -> int:
+    """The investigator's CLI: list and complete user tasks on the engine
+    (reference: KIE console user-task workflow, README.md:571-605 /
+    docs/images/events-3 — the investigation branch's human decisions).
+    Completing with --outcome approved/rejected is exactly the decision
+    the user-task prediction model learns from (process/usertask_model)."""
+    from ccfd_tpu.process.client import EngineRestClient
+
+    cfg = Config.from_env()
+    url = args.engine_url or cfg.kie_server_url
+    if not url.startswith("http"):
+        print(
+            f"[tasks] KIE_SERVER_URL={url!r} is not an http engine endpoint; "
+            "start one with `ccfd_tpu engine` and point --engine-url at it",
+            file=sys.stderr,
+        )
+        return 2
+    client = EngineRestClient(
+        url,
+        timeout_s=cfg.seldon_timeout_ms / 1000.0,
+        retries=cfg.client_retries,
+    )
+    if args.complete is not None:
+        # the engine's completion payload is the boolean is_fraud verdict
+        # (fraud.py task_outcome gateway: truthy => cancel the transaction);
+        # the CLI speaks the investigator's words and maps them explicitly —
+        # passing the raw string through would make "approved" truthy and
+        # CANCEL the transaction
+        verdicts = {"approved": False, "rejected": True,
+                    "false": False, "true": True}
+        if args.outcome is None or args.outcome.lower() not in verdicts:
+            print(
+                "[tasks] --complete requires --outcome approved|rejected "
+                "(approved = legitimate transaction, rejected = confirmed "
+                "fraud)",
+                file=sys.stderr,
+            )
+            return 2
+        is_fraud = verdicts[args.outcome.lower()]
+        client.complete_task(args.complete, is_fraud)
+        print(json.dumps({"completed": args.complete,
+                          "outcome": args.outcome.lower(),
+                          "is_fraud": is_fraud}))
+        return 0
+    views = client.tasks(args.status)
+    print(json.dumps({"status": args.status, "count": len(views),
+                      "tasks": views}))
+    return 0
+
+
 def cmd_loadgen(args: argparse.Namespace) -> int:
     """Benchmark a RUNNING scorer endpoint (local or remote) with the same
     lean client the in-tree bench uses, so operator numbers compare
@@ -1149,6 +1199,17 @@ def main(argv: list[str] | None = None) -> int:
     u.add_argument("--exit-after-producer", action="store_true")
     u.add_argument("--drain-s", type=float, default=120.0)
     u.set_defaults(fn=cmd_up)
+
+    tk = sub.add_parser(
+        "tasks", help="investigator workflow: list/complete engine user tasks"
+    )
+    tk.add_argument("--engine-url", default="",
+                    help="engine REST base (default: KIE_SERVER_URL)")
+    tk.add_argument("--status", default="open")
+    tk.add_argument("--complete", type=int, default=None, metavar="TASK_ID")
+    tk.add_argument("--outcome", default=None,
+                    help="approved | rejected (with --complete)")
+    tk.set_defaults(fn=cmd_tasks)
 
     lg = sub.add_parser(
         "loadgen", help="drive a deployed scorer's REST endpoint (JSON report)"

@@ -240,3 +240,59 @@ def test_cmd_loadgen_against_live_server(capsys):
         assert out["rows_per_request"] == 4 and out["clients"] == 2
     finally:
         srv.stop()
+
+
+def test_cmd_tasks_investigator_workflow(capsys):
+    """`ccfd_tpu tasks`: the investigator lists an open investigation and
+    completes it with an outcome through the engine's KIE-shaped REST —
+    the reference's user-task console workflow as a CLI."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.cli import main
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.process.clock import ManualClock
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.process.server import EngineServer
+
+    cfg = Config()
+    clock = ManualClock()
+    reg = Registry()
+    engine = build_engine(cfg, Broker(), reg, clock)
+    # high-amount fraud + no customer reply => timer -> investigation task
+    pid = engine.start_process(
+        "fraud", {"transaction": {"id": 1, "Amount": 5000.0}, "proba": 0.9}
+    )
+    clock.advance(cfg.customer_reply_timeout_s + 1)
+    assert len(engine.tasks("open")) == 1
+    srv = EngineServer(engine)
+    port = srv.start("127.0.0.1", 0)
+    try:
+        url = f"http://127.0.0.1:{port}"
+        rc = main(["tasks", "--engine-url", url])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0 and out["count"] == 1
+        tid = out["tasks"][0]["task_id"]
+        assert out["tasks"][0]["name"] == "fraud-investigation"
+
+        rc = main(["tasks", "--engine-url", url,
+                   "--complete", str(tid), "--outcome", "approved"])
+        comp = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert comp["is_fraud"] is False  # "approved" = legitimate, NOT fraud
+        rc = main(["tasks", "--engine-url", url])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0 and out["count"] == 0  # task closed
+        assert engine.instance(pid).status != "active"
+        # the SEMANTICS must hold: approving routes to the approve branch
+        # (a truthy outcome passed through raw would have cancelled it)
+        assert reg.histogram("fraud_approved_amount").count() == 1
+        assert reg.histogram("fraud_rejected_amount").count() == 0
+
+        # --complete without a valid --outcome is a loud usage error
+        assert main(["tasks", "--engine-url", url, "--complete", "1"]) == 2
+        assert main(["tasks", "--engine-url", url, "--complete", "1",
+                     "--outcome", "maybe"]) == 2
+        # non-http engine endpoint: clean exit 2, not a traceback
+        assert main(["tasks", "--engine-url", "inproc://engine"]) == 2
+    finally:
+        srv.stop()
