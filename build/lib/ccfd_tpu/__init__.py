@@ -1,0 +1,23 @@
+"""ccfd_tpu — a TPU-native credit-card fraud-detection framework.
+
+A ground-up JAX/XLA re-design of the capability surface of the
+``ccfd-demo-summit`` reference (see /root/repo/SURVEY.md): a streaming
+fraud-scoring pipeline (producer -> bus -> router -> TPU scorer -> process
+engine -> notification loop) with Prometheus-compatible observability,
+online retraining, and multi-chip sharding via ``jax.sharding``.
+
+Layer map (reference layer -> ccfd_tpu module):
+
+  L1 producer        -> ccfd_tpu.producer   (CSV/S3 stream -> bus topic)
+  L2 Kafka           -> ccfd_tpu.bus        (in-process broker, Kafka-shaped API)
+  L3 Camel router    -> ccfd_tpu.router     (micro-batching decision router)
+  L4 Seldon model    -> ccfd_tpu.models + ccfd_tpu.serving (jit/pjit scorer, REST)
+  L5 KIE/jBPM        -> ccfd_tpu.process    (BPMN-style engine, DMN, user tasks)
+  L6 notification    -> ccfd_tpu.notify     (simulated customer round-trip)
+  L7 Prometheus      -> ccfd_tpu.metrics    (text-format registry, dashboard parity)
+  scale-out/retrain  -> ccfd_tpu.parallel   (mesh, shardings, sharded train step)
+"""
+
+__version__ = "0.1.0"
+
+from ccfd_tpu.config import Config  # noqa: F401

@@ -1,0 +1,3 @@
+from ccfd_tpu.cli import main
+
+raise SystemExit(main())

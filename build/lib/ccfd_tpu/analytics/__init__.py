@@ -1,0 +1,8 @@
+"""Batch analytics + drift monitoring (the Spark/notebook-cluster analog)."""
+
+from ccfd_tpu.analytics.engine import (  # noqa: F401
+    AnalyticsEngine,
+    DriftMonitor,
+    Report,
+    psi,
+)

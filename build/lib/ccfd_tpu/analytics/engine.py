@@ -1,0 +1,348 @@
+"""TPU-native batch analytics: the Spark / notebook-cluster analog.
+
+The reference platform CR provisions JupyterHub notebooks backed by a
+2-worker Spark cluster (3 cpu / 4Gi each) for exploratory dataset analytics
+and offline model work (reference deploy/frauddetection_cr.yaml:7-42,
+spark-operator 44-53), observable on a dedicated executor-metrics Grafana
+board (reference deploy/grafana/SparkMetrics.json). This module re-designs
+that capability TPU-first: instead of a JVM executor cluster shuffling rows,
+a dataset summary is a pair of jitted XLA programs — moments, extrema, class
+aggregates and the feature Gram matrix fuse into one pass over rows sharded
+across the device mesh's data axis (XLA's psum over ICI replaces Spark's
+shuffle), and per-feature histograms run a second fused pass once the
+extrema fix the bin edges. The Gram matrix rides the MXU; everything else is
+HBM-bandwidth-bound and fuses into the surrounding reduction.
+
+Built-in "jobs" (what the reference notebooks do by hand):
+
+- ``AnalyticsEngine.summarize`` — per-feature mean/std/min/max + histograms,
+  class balance, per-class amount aggregates, feature correlation matrix.
+- ``AnalyticsEngine.drift`` — population-stability-index per feature between
+  a reference :class:`Report` (the training distribution) and a serving
+  window — the drift question the ModelPrediction board exists to answer
+  (reference deploy/grafana/ModelPrediction.json:96-322 plots raw feature
+  streams for exactly this).
+- :class:`DriftMonitor` — a supervised service consuming the live
+  transaction topic (the analytics consumer group sits beside the router's,
+  reference deploy/router.yaml:61-62) and exporting PSI gauges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, NUM_FEATURES
+from ccfd_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+DEFAULT_NBINS = 32
+_EPS = 1e-6
+
+
+class Report(NamedTuple):
+    """Replicated output of one summarize job (all arrays host numpy)."""
+
+    n: int
+    mean: np.ndarray          # (F,)
+    std: np.ndarray           # (F,)
+    min: np.ndarray           # (F,)
+    max: np.ndarray           # (F,)
+    hist: np.ndarray          # (F, nbins) counts
+    edges: np.ndarray         # (F, nbins + 1) shared-binning edges
+    corr: np.ndarray          # (F, F) Pearson correlation
+    class_counts: np.ndarray  # (2,) rows per Class label
+    amount_sum_by_class: np.ndarray  # (2,)
+
+    def to_dict(self) -> dict[str, Any]:
+        n1 = float(max(self.class_counts[1], 0.0))
+        return {
+            "rows": self.n,
+            "fraud_rate": n1 / max(self.n, 1),
+            "class_counts": self.class_counts.tolist(),
+            "amount_mean_by_class": [
+                float(s / max(c, 1.0))
+                for s, c in zip(self.amount_sum_by_class, self.class_counts)
+            ],
+            "features": {
+                name: {
+                    "mean": float(self.mean[i]),
+                    "std": float(self.std[i]),
+                    "min": float(self.min[i]),
+                    "max": float(self.max[i]),
+                }
+                for i, name in enumerate(FEATURE_NAMES)
+            },
+        }
+
+
+def _moments_job(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray):
+    """One fused pass: moments + extrema + Gram + class aggregates.
+
+    ``x`` is (N, F) sharded on rows; every output is a full reduction over
+    the sharded axis, so under ``jit`` XLA lowers the cross-shard combine to
+    psums over ICI — the collective layout Spark's shuffle becomes on TPU.
+    """
+    m = mask[:, None].astype(jnp.float32)
+    xm = x * m
+    n = jnp.sum(mask.astype(jnp.float32))
+    s = jnp.sum(xm, axis=0)
+    sq = jnp.sum(xm * x, axis=0)
+    lo = jnp.min(jnp.where(m > 0, x, jnp.inf), axis=0)
+    hi = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=0)
+    # Gram matrix on the MXU; f32 accumulation keeps corr numerically sane.
+    gram = jnp.einsum(
+        "nf,ng->fg", xm, x, precision=jax.lax.Precision.HIGHEST
+    )
+    y1 = (y > 0).astype(jnp.float32) * mask.astype(jnp.float32)
+    y0 = mask.astype(jnp.float32) - y1
+    amount = x[:, NUM_FEATURES - 1]
+    return {
+        "n": n,
+        "sum": s,
+        "sumsq": sq,
+        "min": lo,
+        "max": hi,
+        "gram": gram,
+        "class_counts": jnp.stack([jnp.sum(y0), jnp.sum(y1)]),
+        "amount_sum_by_class": jnp.stack(
+            [jnp.sum(y0 * amount), jnp.sum(y1 * amount)]
+        ),
+    }
+
+
+def _hist_job(x: jnp.ndarray, mask: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray, nbins: int):
+    """Second fused pass: per-feature counts against [lo, hi) linear bins."""
+    width = jnp.maximum(hi - lo, _EPS)
+    idx = jnp.clip(
+        jnp.floor((x - lo[None, :]) / width[None, :] * nbins).astype(jnp.int32),
+        0,
+        nbins - 1,
+    )
+    onehot = (idx[:, :, None] == jnp.arange(nbins)[None, None, :])
+    return jnp.sum(
+        onehot * mask[:, None, None].astype(jnp.float32), axis=0
+    )
+
+
+def psi(p_hist: np.ndarray, q_hist: np.ndarray) -> np.ndarray:
+    """Population stability index per feature between two (F, B) histograms.
+
+    Standard fraud-ops drift score: PSI < 0.1 stable, 0.1–0.25 drifting,
+    > 0.25 action needed. Counts are eps-smoothed so empty bins don't blow
+    up the log ratio.
+    """
+    p = np.asarray(p_hist, np.float64) + _EPS
+    q = np.asarray(q_hist, np.float64) + _EPS
+    p /= p.sum(axis=-1, keepdims=True)
+    q /= q.sum(axis=-1, keepdims=True)
+    return np.sum((p - q) * np.log(p / q), axis=-1)
+
+
+class AnalyticsEngine:
+    """Mesh-sharded batch analytics over CCFD feature matrices."""
+
+    def __init__(self, mesh=None, nbins: int = DEFAULT_NBINS, registry=None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.nbins = int(nbins)
+        self._rows = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        self._vec = NamedSharding(self.mesh, P(DATA_AXIS))
+        rep = NamedSharding(self.mesh, P())
+        self._moments = jax.jit(
+            _moments_job,
+            in_shardings=(self._rows, self._vec, self._vec),
+            out_shardings=rep,
+        )
+        self._hist = jax.jit(
+            _hist_job,
+            static_argnames=("nbins",),
+            in_shardings=(self._rows, self._vec, rep, rep),
+            out_shardings=rep,
+        )
+        self._c_jobs = self._h_job_s = self._c_rows = None
+        if registry is not None:
+            self._c_jobs = registry.counter(
+                "analytics_jobs_completed_total", "batch analytics jobs run"
+            )
+            self._h_job_s = registry.histogram(
+                "analytics_job_seconds", "analytics job wall time"
+            )
+            self._c_rows = registry.counter(
+                "analytics_rows_processed_total", "rows aggregated"
+            )
+            registry.gauge(
+                "analytics_workers", "devices in the analytics mesh"
+            ).set(self.mesh.size)
+
+    # -- sharding helpers --------------------------------------------------
+    def _pad(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        n = x.shape[0]
+        shards = self.mesh.shape[DATA_AXIS]
+        pad = (-n) % shards
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        mask = np.zeros(n + pad, np.float32)
+        mask[:n] = 1.0
+        return x, mask
+
+    def _account(self, job: str, n_rows: int, t0: float) -> None:
+        if self._c_jobs is not None:
+            self._c_jobs.inc(labels={"job": job})
+            self._h_job_s.observe(time.perf_counter() - t0)
+            self._c_rows.inc(n_rows)
+
+    # -- jobs --------------------------------------------------------------
+    def summarize(self, x: np.ndarray, y: np.ndarray | None = None) -> Report:
+        t0 = time.perf_counter()
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        if y is None:
+            y = np.zeros(n, np.int32)
+        xp, mask = self._pad(x)
+        yp, _ = self._pad(np.asarray(y, np.int32))
+        mom = jax.device_get(self._moments(xp, yp, mask))
+        mean = mom["sum"] / max(float(mom["n"]), 1.0)
+        var = np.maximum(mom["sumsq"] / max(float(mom["n"]), 1.0) - mean**2, 0.0)
+        std = np.sqrt(var)
+        lo, hi = mom["min"], mom["max"]
+        hist = np.asarray(
+            jax.device_get(self._hist(xp, mask, lo, hi, self.nbins))
+        )
+        edges = lo[:, None] + (hi - lo)[:, None] * np.linspace(
+            0.0, 1.0, self.nbins + 1
+        )[None, :].astype(np.float32)
+        cov = mom["gram"] / max(float(mom["n"]), 1.0) - np.outer(mean, mean)
+        denom = np.outer(std, std)
+        corr = cov / np.maximum(denom, _EPS)
+        np.fill_diagonal(corr, 1.0)
+        self._account("summarize", n, t0)
+        return Report(
+            n=int(mom["n"]),
+            mean=mean,
+            std=std,
+            min=lo,
+            max=hi,
+            hist=hist,
+            edges=edges.astype(np.float32),
+            corr=corr,
+            class_counts=mom["class_counts"],
+            amount_sum_by_class=mom["amount_sum_by_class"],
+        )
+
+    def window_hist(self, reference: Report, x: np.ndarray) -> np.ndarray:
+        """Histogram a serving window on the reference's bin edges."""
+        xp, mask = self._pad(np.asarray(x, np.float32))
+        return np.asarray(
+            jax.device_get(
+                self._hist(xp, mask, reference.min, reference.max, self.nbins)
+            )
+        )
+
+    def drift(self, reference: Report, x: np.ndarray) -> np.ndarray:
+        """Per-feature PSI of a serving window vs the reference distribution."""
+        t0 = time.perf_counter()
+        scores = psi(self.window_hist(reference, x), reference.hist)
+        self._account("drift", int(np.asarray(x).shape[0]), t0)
+        return scores
+
+
+class DriftMonitor:
+    """Supervised service: live-topic windows scored for drift vs training.
+
+    Subscribes to the transaction topic in its own consumer group (beside
+    the router's, reference deploy/router.yaml:61-62), accumulates a window
+    of decoded feature rows, and on each full window exports per-feature PSI
+    gauges — the online half of the notebook workflow the reference leaves
+    to a human staring at the ModelPrediction board.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        broker,
+        reference: Report | None,
+        engine: AnalyticsEngine | None = None,
+        registry=None,
+        window: int = 4096,
+        reference_builder: Callable[[], Report] | None = None,
+    ):
+        if reference is None and reference_builder is None:
+            raise ValueError("need a reference Report or a reference_builder")
+        self.cfg = cfg
+        self.engine = engine if engine is not None else AnalyticsEngine(registry=registry)
+        self.reference = reference
+        # deferred: dataset load + summarize compile can take tens of
+        # seconds; built on the supervised thread, not platform bring-up
+        self._reference_builder = reference_builder
+        self.window = int(window)
+        self._broker = broker
+        self._group = "ccfd-analytics"
+        self._topic = cfg.kafka_topic
+        self._consumer = broker.consumer(self._group, (self._topic,))
+        self._consumer_closed = False
+        self._buf: list[np.ndarray] = []
+        self._buffered = 0
+        self._stop = threading.Event()
+        self.windows_scored = 0
+        self._g_psi = self._g_max = None
+        if registry is not None:
+            self._g_psi = registry.gauge(
+                "analytics_drift_psi", "per-feature PSI vs training distribution"
+            )
+            self._g_max = registry.gauge(
+                "analytics_drift_max_psi", "worst-feature PSI"
+            )
+
+    def step(self, poll_timeout_s: float = 0.0) -> int:
+        """Consume one poll; score a window when one fills. Returns rows seen."""
+        if self.reference is None:
+            self.reference = self._reference_builder()
+        records = self._consumer.poll(self.window, poll_timeout_s)
+        if not records:
+            return 0
+        # the router's decoder, so drift windows see exactly the rows the
+        # scorer saw (poison pills included, as all-zero rows)
+        from ccfd_tpu.router.router import decode_records
+
+        rows, _, _ = decode_records(records)
+        if rows.shape[0]:
+            self._buf.append(rows)
+            self._buffered += rows.shape[0]
+        while self._buffered >= self.window:
+            allrows = np.concatenate(self._buf, axis=0)
+            win, rest = allrows[: self.window], allrows[self.window :]
+            self._buf = [rest] if rest.shape[0] else []
+            self._buffered = rest.shape[0]
+            scores = self.engine.drift(self.reference, win)
+            self.windows_scored += 1
+            if self._g_psi is not None:
+                for i, name in enumerate(FEATURE_NAMES):
+                    self._g_psi.set(float(scores[i]), labels={"feature": name})
+                self._g_max.set(float(scores.max()))
+        return int(rows.shape[0])
+
+    def reset(self) -> None:
+        """Re-arm after stop(); called by the supervisor before respawn.
+        stop() closed the consumer (to unblock a blocking poll), so
+        re-subscribe here — the group's committed offsets make the new
+        consumer resume where the old one left off."""
+        self._stop.clear()
+        if self._consumer_closed:
+            self._consumer = self._broker.consumer(self._group, (self._topic,))
+            self._consumer_closed = False
+
+    def run(self, interval_s: float = 0.25) -> None:
+        while not self._stop.is_set():
+            if self.step(poll_timeout_s=interval_s) == 0:
+                self._stop.wait(interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._consumer.close()
+        self._consumer_closed = True

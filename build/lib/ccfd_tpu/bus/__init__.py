@@ -1,0 +1,1 @@
+from ccfd_tpu.bus.broker import Broker, Consumer, Record  # noqa: F401

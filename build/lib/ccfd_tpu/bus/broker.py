@@ -1,0 +1,354 @@
+"""In-process message bus with Kafka-shaped semantics.
+
+The reference's transport is a Strimzi Kafka cluster with 3 brokers and the
+topics ``odh-demo``, ``ccd-customer-outgoing``, ``ccd-customer-response``
+(reference deploy/frauddetection_cr.yaml:73-77, deploy/router.yaml:55-62).
+This module provides the same *semantics* — partitioned topics, keyed
+partitioning, consumer groups with per-group committed offsets, blocking
+polls — as a zero-dependency in-process broker, so every component of the
+framework is written against a Kafka-shaped API and can swap in a real
+``kafka-python`` client via the same interface when a cluster exists
+(see ``KafkaAdapter`` stub at the bottom).
+
+Semantics kept faithful to Kafka:
+- total order *within* a partition, none across partitions;
+- hash(key) % n_partitions routing, round-robin for keyless records;
+- consumer groups: each partition is owned by exactly one live member;
+  offsets are committed per (group, topic, partition) and survive consumer
+  close/reopen (resume-from-offset is the reference's de-facto recovery
+  mechanism, SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+import binascii
+import itertools
+import threading
+import time
+from typing import Any, Iterable, NamedTuple
+
+
+class Record(NamedTuple):
+    # NamedTuple, not a frozen dataclass: construction shows up on the
+    # produce hot path (one Record per transaction at wire rate), and a
+    # frozen dataclass pays object.__setattr__ per field
+    topic: str
+    partition: int
+    offset: int
+    key: Any
+    value: Any
+    timestamp: float
+
+
+class _Topic:
+    def __init__(self, name: str, n_partitions: int):
+        self.name = name
+        self.partitions: list[list[Record]] = [[] for _ in range(n_partitions)]
+        self._rr = itertools.count()
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.partitions)
+
+    def route(self, key: Any) -> int:
+        if key is None:
+            return next(self._rr) % self.n_partitions
+        # stable across processes (Python's str hash is per-process salted;
+        # a durable log replayed into a new process must keep key->partition
+        # ordering, like Kafka's murmur2-on-key-bytes)
+        data = key if isinstance(key, bytes) else str(key).encode()
+        return binascii.crc32(data) % self.n_partitions
+
+
+class Broker:
+    """Thread-safe in-process broker. One instance == one cluster.
+
+    With ``log_dir`` set, every record and committed offset also lands in
+    an on-disk segment log (ccfd_tpu/bus/log.py): reopening a Broker on the
+    same directory replays topics, records, and group offsets, so consumers
+    resume exactly where the crashed process left off — the reference's
+    Kafka recovery semantics (SURVEY.md §5).
+    """
+
+    def __init__(
+        self,
+        default_partitions: int = 3,
+        log_dir: str | None = None,
+        fsync: bool = False,
+    ):
+        self._default_partitions = default_partitions
+        self._topics: dict[str, _Topic] = {}
+        self._groups: dict[str, dict[tuple[str, int], int]] = {}  # group -> {(t,p): offset}
+        self._members: dict[str, list["Consumer"]] = {}
+        self._lock = threading.Lock()
+        self._data_ready = threading.Condition(self._lock)
+        self._log = None
+        if log_dir is not None:
+            from ccfd_tpu.bus.log import BusLog
+
+            self._log = BusLog(log_dir, fsync=fsync)
+            for name, n_parts in self._log.replay_topics().items():
+                t = _Topic(name, n_parts)
+                self._topics[name] = t
+                for p in range(n_parts):
+                    for key, ts, value in self._log.replay_partition(name, p):
+                        t.partitions[p].append(
+                            Record(
+                                topic=name,
+                                partition=p,
+                                offset=len(t.partitions[p]),
+                                key=key,
+                                value=value,
+                                timestamp=ts,
+                            )
+                        )
+            # Clamp replayed offsets to the replayed log: a torn-tail
+            # truncation may have dropped records whose consumption was
+            # already committed; an out-of-range offset would silently skip
+            # every record produced at those slots after restart (Kafka
+            # resets out-of-range offsets the same way).
+            for g, tps in self._log.replay_offsets().items():
+                mine = self._groups.setdefault(g, {})
+                for (tname, p), off in tps.items():
+                    t = self._topics.get(tname)
+                    if t is None or p >= t.n_partitions:
+                        continue  # topic/partition lost with the meta log
+                    mine[(tname, p)] = min(off, len(t.partitions[p]))
+
+    # -- admin ------------------------------------------------------------
+    def create_topic(self, name: str, n_partitions: int | None = None) -> None:
+        with self._lock:
+            if name not in self._topics:
+                n = n_partitions or self._default_partitions
+                self._topics[name] = _Topic(name, n)
+                if self._log is not None:
+                    self._log.add_topic(name, n)
+
+    def _topic(self, name: str) -> _Topic:
+        t = self._topics.get(name)
+        if t is None:
+            self._topics[name] = t = _Topic(name, self._default_partitions)
+            if self._log is not None:
+                self._log.add_topic(name, t.n_partitions)
+        return t
+
+    def close(self) -> None:
+        """Flush and close segment files (no-op for a memory-only broker)."""
+        with self._lock:
+            if self._log is not None:
+                self._log.close()
+
+    def end_offsets(self, topic: str) -> list[int]:
+        with self._lock:
+            return [len(p) for p in self._topic(topic).partitions]
+
+    def health_snapshot(self) -> dict:
+        """One consistent view for health/lag exporters: per-topic partition
+        end offsets plus per-group committed offsets, with groups that
+        registered but never committed (e.g. a consumer wedged since
+        startup) seeded at offset 0 over their assigned partitions — their
+        lag reads as the full log, the way Kafka reports it."""
+        with self._lock:
+            topics = {
+                name: [len(p) for p in t.partitions]
+                for name, t in self._topics.items()
+            }
+            groups: dict[str, dict[tuple[str, int], int]] = {
+                g: dict(tps) for g, tps in self._groups.items()
+            }
+            for g, members in self._members.items():
+                tps = groups.setdefault(g, {})
+                for m in members:
+                    for tp in m._assignment:
+                        tps.setdefault(tp, 0)
+        return {"topics": topics, "groups": groups}
+
+    # -- produce ----------------------------------------------------------
+    def produce(self, topic: str, value: Any, key: Any = None) -> Record:
+        with self._lock:
+            t = self._topic(topic)
+            part = t.route(key)
+            rec = Record(
+                topic=topic,
+                partition=part,
+                offset=len(t.partitions[part]),
+                key=key,
+                value=value,
+                timestamp=time.time(),
+            )
+            payload = None
+            if self._log is not None:
+                # encode BEFORE the in-memory append: an unencodable record
+                # must fail cleanly, not leave memory and disk diverged
+                from ccfd_tpu.bus.log import encode_entry
+
+                payload = encode_entry(key, rec.timestamp, value)
+            t.partitions[part].append(rec)
+            if self._log is not None:
+                self._log.append_payload(topic, part, payload)
+            self._data_ready.notify_all()
+            return rec
+
+    def produce_batch(
+        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+    ) -> int:
+        """Append many records under ONE lock acquisition (the producer's
+        hot path; same surface as RemoteBroker.produce_batch).
+
+        Failure contract: encode errors fail the WHOLE batch before any
+        state mutates (payloads are built up front). An I/O error from the
+        durable log mid-batch commits the prefix 0..k-1 — to both disk and
+        memory, consistently — and raises; that is the same
+        prefix-committed outcome as k individual ``produce`` calls. The log
+        write precedes the in-memory append per record, so memory never
+        holds a record the log would lose across a restart."""
+        values = list(values)
+        key_list = list(keys) if keys is not None else [None] * len(values)
+        if len(key_list) != len(values):
+            raise ValueError("keys and values must have equal length")
+        if not values:
+            return 0
+        with self._lock:
+            t = self._topic(topic)
+            now = time.time()
+            payloads = None
+            if self._log is not None:
+                from ccfd_tpu.bus.log import encode_entry
+
+                payloads = [
+                    encode_entry(k, now, v) for k, v in zip(key_list, values)
+                ]
+            appended = 0
+            try:
+                for i, (v, k) in enumerate(zip(values, key_list)):
+                    part = t.route(k)
+                    if payloads is not None:
+                        self._log.append_payload(topic, part, payloads[i])
+                    t.partitions[part].append(
+                        Record(
+                            topic=topic,
+                            partition=part,
+                            offset=len(t.partitions[part]),
+                            key=k,
+                            value=v,
+                            timestamp=now,
+                        )
+                    )
+                    appended += 1
+            finally:
+                if appended:
+                    self._data_ready.notify_all()
+            return len(values)
+
+    # -- consume ----------------------------------------------------------
+    def consumer(self, group_id: str, topics: Iterable[str]) -> "Consumer":
+        with self._lock:
+            for t in topics:
+                self._topic(t)
+            c = Consumer(self, group_id, tuple(topics))
+            self._members.setdefault(group_id, []).append(c)
+            self._rebalance(group_id)
+            return c
+
+    def _close(self, consumer: "Consumer") -> None:
+        with self._lock:
+            members = self._members.get(consumer.group_id, [])
+            if consumer in members:
+                members.remove(consumer)
+                self._rebalance(consumer.group_id)
+
+    def _rebalance(self, group_id: str) -> None:
+        """Round-robin partition assignment over live group members."""
+        members = self._members.get(group_id, [])
+        if not members:
+            return
+        all_parts: list[tuple[str, int]] = []
+        topics = sorted({t for m in members for t in m.topics})
+        for tname in topics:
+            t = self._topic(tname)
+            all_parts.extend((tname, p) for p in range(t.n_partitions))
+        for m in members:
+            m._assignment = []
+        for i, tp in enumerate(all_parts):
+            owner = members[i % len(members)]
+            if tp[0] in owner.topics:
+                owner._assignment.append(tp)
+            else:  # partition of a topic this member didn't subscribe to
+                for m in members:
+                    if tp[0] in m.topics:
+                        m._assignment.append(tp)
+                        break
+
+    def _committed(self, group_id: str, tp: tuple[str, int]) -> int:
+        return self._groups.setdefault(group_id, {}).get(tp, 0)
+
+    def _commit(self, group_id: str, tp: tuple[str, int], offset: int) -> None:
+        g = self._groups.setdefault(group_id, {})
+        if offset > g.get(tp, 0):
+            g[tp] = offset
+            if self._log is not None:
+                self._log.commit_offset(group_id, tp[0], tp[1], offset)
+
+    def _fetch(
+        self, consumer: "Consumer", max_records: int
+    ) -> list[Record]:
+        out: list[Record] = []
+        for tname, p in consumer._assignment:
+            if len(out) >= max_records:
+                break
+            t = self._topic(tname)
+            start = self._committed(consumer.group_id, (tname, p))
+            log = t.partitions[p]
+            take = log[start : start + (max_records - len(out))]
+            if take:
+                out.extend(take)
+                self._commit(consumer.group_id, (tname, p), start + len(take))
+        return out
+
+
+class Consumer:
+    """Poll-based consumer. Offsets auto-commit on poll (at-most-once hand-off
+    inside one process; the in-process broker never loses the log, so replay
+    is available by resetting the group offset)."""
+
+    def __init__(self, broker: Broker, group_id: str, topics: tuple[str, ...]):
+        self._broker = broker
+        self.group_id = group_id
+        self.topics = topics
+        self._assignment: list[tuple[str, int]] = []
+        self._closed = False
+
+    def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[Record]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._broker._lock:
+                if self._closed:
+                    return []
+                recs = self._broker._fetch(self, max_records)
+                if recs:
+                    return recs
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._broker._data_ready.wait(timeout=min(remaining, 0.05))
+
+    def close(self) -> None:
+        self._closed = True
+        self._broker._close(self)
+
+    def __enter__(self) -> "Consumer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def __getattr__(name: str):
+    # KafkaAdapter lives in its own module (it pulls in the json/base64
+    # wire codec); re-exported here because this is where callers expect
+    # the real-cluster seam to be.
+    if name == "KafkaAdapter":
+        from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
+
+        return KafkaAdapter
+    raise AttributeError(name)

@@ -1,0 +1,203 @@
+"""Remote broker client: the Broker/Consumer surface over HTTP.
+
+Components take a broker object and never care whether it is the
+in-process ``Broker`` or this client pointed at a ``BrokerServer``
+(``BROKER_URL=http://host:port`` — the reference's services get their
+Kafka bootstrap the same way, reference deploy/router.yaml:55-56,
+notification-service.yaml:50-52). Poll long-polls server-side, so idle
+remote consumers don't spin.
+
+Delivery semantics across transport failures:
+
+- ``produce``/``produce_batch`` never blind-retry after the request may
+  have reached the server (a re-send would duplicate records and start
+  duplicate fraud cases downstream); only a refused connection retries.
+- ``poll`` carries a client-side sequence number. The server caches the
+  last delivered batch per (consumer, seq); a retry after a lost response
+  re-sends the SAME seq and gets the SAME batch back instead of the next
+  one — at-least-once delivery instead of silent loss, without giving up
+  the broker's auto-commit fetch path.
+
+``broker_from_url`` is the one seam: ``inproc://`` (or empty) builds a
+local Broker, ``http://`` builds this client.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+from ccfd_tpu.bus.server import decode_value, encode_value
+from ccfd_tpu.utils.httpclient import PooledHTTPClient
+
+
+class RemoteBusError(ConnectionError):
+    pass
+
+
+class RemoteBroker:
+    def __init__(
+        self,
+        base_url: str,
+        pool_size: int = 4,
+        timeout_s: float = 40.0,  # > max server-side long-poll (30s)
+        retries: int = 2,
+    ):
+        self._http = PooledHTTPClient(
+            base_url, default_port=9092, pool_size=pool_size,
+            timeout_s=timeout_s, retries=retries,
+            scheme_error="RemoteBroker needs an http:// URL",
+        )
+
+    def _request(
+        self, method: str, path: str, body: Any = None, idempotent: bool = True
+    ) -> tuple[int, Any]:
+        try:
+            return self._http.request(method, path, body, idempotent=idempotent)
+        except ConnectionError as e:
+            raise RemoteBusError(str(e)) from e
+
+    # -- Broker surface ----------------------------------------------------
+    def produce(self, topic: str, value: Any, key: Any = None) -> dict[str, Any]:
+        code, body = self._request(
+            "POST", f"/topics/{topic}/produce",
+            {"records": [{"value": encode_value(value), "key": encode_value(key)}]},
+            idempotent=False,
+        )
+        if code != 200:
+            raise RemoteBusError(f"produce to {topic!r} failed: {code} {body}")
+        return body["metas"][0]
+
+    def produce_batch(
+        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+    ) -> int:
+        """One HTTP round-trip for many records (the producer's hot path)."""
+        if keys is None:
+            records = [{"value": encode_value(v), "key": None} for v in values]
+        else:
+            records = [
+                {"value": encode_value(v), "key": encode_value(k)}
+                for v, k in zip(values, keys)
+            ]
+        if not records:
+            return 0
+        code, body = self._request(
+            "POST", f"/topics/{topic}/produce", {"records": records},
+            idempotent=False,
+        )
+        if code != 200:
+            raise RemoteBusError(f"produce to {topic!r} failed: {code} {body}")
+        return len(body["metas"])
+
+    def end_offsets(self, topic: str) -> list[int]:
+        code, body = self._request("GET", f"/topics/{topic}/offsets")
+        if code != 200:
+            raise RemoteBusError(f"offsets for {topic!r} failed: {code}")
+        return body
+
+    def consumer(self, group_id: str, topics: Iterable[str]) -> "RemoteConsumer":
+        code, body = self._request(
+            "POST", "/consumers", {"group": group_id, "topics": list(topics)}
+        )
+        if code != 201:
+            raise RemoteBusError(f"consumer create failed: {code} {body}")
+        return RemoteConsumer(self, int(body["consumer_id"]), group_id, tuple(topics))
+
+    def close(self) -> None:
+        self._http.close()
+
+
+class _RemoteRecord:
+    """Record view over the wire: same attribute surface as bus.broker.Record."""
+
+    __slots__ = ("topic", "partition", "offset", "key", "value", "timestamp")
+
+    def __init__(self, d: dict[str, Any]):
+        self.topic = d["topic"]
+        self.partition = d["partition"]
+        self.offset = d["offset"]
+        self.key = decode_value(d["key"])
+        self.value = decode_value(d["value"])
+        self.timestamp = d["timestamp"]
+
+
+class RemoteConsumer:
+    def __init__(
+        self, broker: RemoteBroker, cid: int, group_id: str, topics: tuple[str, ...]
+    ):
+        self._broker = broker
+        self._cid = cid
+        self.group_id = group_id
+        self.topics = topics
+        self._seq = 0
+        self._closed = False
+
+    def _poll_once(
+        self, seq: int, max_records: int, timeout_s: float
+    ) -> tuple[int, Any]:
+        # idempotent BECAUSE of the seq: a retry re-requests the same batch
+        return self._broker._request(
+            "POST", f"/consumers/{self._cid}/poll",
+            {"max_records": max_records, "timeout_s": timeout_s, "seq": seq},
+        )
+
+    def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[_RemoteRecord]:
+        if self._closed:
+            return []
+        # advance seq only AFTER a successful response: if transport retries
+        # are exhausted and RemoteBusError propagates, the next poll() call
+        # re-sends the SAME seq, so a batch the broker consumed and
+        # auto-committed under the failed seq is redelivered from the
+        # server-side cache instead of silently lost (at-least-once across
+        # application-level retries, not just in-request transport retries)
+        seq = self._seq + 1
+        code, body = self._poll_once(seq, max_records, timeout_s)
+        if code == 404:  # reaped by session timeout: re-register and retry once
+            fresh = self._broker.consumer(self.group_id, self.topics)
+            self._cid = fresh._cid
+            code, body = self._poll_once(seq, max_records, timeout_s)
+        if code != 200:
+            raise RemoteBusError(f"poll failed: {code} {body}")
+        # decode BEFORE advancing seq: a decode error (version-skewed server)
+        # must leave the seq un-advanced so the retry still hits the cache —
+        # and surface as RemoteBusError so callers' bus error handling engages
+        try:
+            records = [_RemoteRecord(r) for r in body["records"]]
+        except (KeyError, ValueError, TypeError) as e:
+            raise RemoteBusError(f"undecodable poll batch: {e}") from e
+        self._seq = seq
+        return records
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._broker._request("POST", f"/consumers/{self._cid}/close", {})
+            except RemoteBusError:  # pragma: no cover - server already gone
+                pass
+
+    def __enter__(self) -> "RemoteConsumer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def broker_from_url(broker_url: str, **local_kwargs):
+    """The one seam components use: BROKER_URL decides local vs remote.
+
+    ``http://host:port`` → networked bus server client;
+    ``kafka://bootstrap`` → real-cluster kafka-python adapter
+    (reference ProducerDeployment.yaml:96-97 passes the bootstrap the
+    same way); anything else → caller builds the in-process Broker.
+    """
+    if broker_url.startswith("http://"):
+        return RemoteBroker(broker_url)
+    if broker_url.startswith("kafka://"):
+        from ccfd_tpu.bus.kafka_adapter import KafkaAdapter
+
+        # registry= flows through so the adapter's health counters
+        # (kafka_adapter_records_produced_total / _send_errors_total, the
+        # KafkaCluster board's adapter panels) exist in real deployments,
+        # not just tests
+        return KafkaAdapter(broker_url[len("kafka://"):], **local_kwargs)
+    return None  # caller builds the in-process Broker (with its own options)

@@ -1,0 +1,264 @@
+"""Real-cluster adapter: the framework's Broker/Consumer surface over
+``kafka-python``.
+
+The reference's transport is a 3-broker Strimzi cluster reached by a
+bootstrap string (reference deploy/frauddetection_cr.yaml:73-77,
+deploy/kafka/ProducerDeployment.yaml:96-97). Every component here is
+written against the Kafka-shaped API of ``bus.broker.Broker``; this module
+fills the one remaining seam so ``BROKER_URL=kafka://bootstrap:9092``
+swaps a real cluster in with zero component changes.
+
+Wire format: values/keys are arbitrary JSON-able Python objects (the same
+domain the networked bus server carries); they ride Kafka as UTF-8 JSON of
+the bus wire form (``encode_value`` — bytes payloads ride base64, so CSV
+lines stay byte-exact end to end). Keys serialize the same way, so
+hash-on-key-bytes partition routing is stable on content, matching the
+in-process broker's crc32-on-key-bytes intent.
+
+Delivery semantics mirror the in-process ``Consumer`` ("offsets
+auto-commit on poll", bus/broker.py — at-most-once hand-off): the
+adapter's consumer polls with ``enable_auto_commit=False`` and commits
+synchronously INSIDE each non-empty poll, so a successor in the group
+resumes after the delivered batch; a crash mid-handling drops that batch
+rather than redelivering it, identically on both transports. (Only a
+crash in the narrow window between the broker fetch and the commit call
+itself redelivers.)
+
+``kafka-python`` is not in the baked image; construction degrades to a
+clear RuntimeError without it. The ``kafka_module`` seam lets tests run
+the full adapter logic against an in-process emulation of the
+kafka-python API (tests/fake_kafka.py), which is also the recipe for any
+other client library.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from typing import Any, Iterable
+
+from ccfd_tpu.bus.broker import Record
+from ccfd_tpu.bus.server import decode_value, encode_value
+
+
+def _dumps(v: Any) -> bytes | None:
+    if v is None:
+        return None
+    return json.dumps(encode_value(v), separators=(",", ":")).encode()
+
+
+def _loads(b: bytes | None) -> Any:
+    if b is None:
+        return None
+    return decode_value(json.loads(b.decode()))
+
+
+class KafkaAdapter:
+    """``bus.broker.Broker`` surface backed by a real Kafka cluster.
+
+    Parameters
+    ----------
+    bootstrap: broker bootstrap string, e.g. ``host:9092`` (reference
+        ProducerDeployment.yaml:96-97).
+    default_partitions: partition count for topics this adapter creates
+        (the reference cluster runs 3 brokers; 3 partitions is its
+        parallelism unit, frauddetection_cr.yaml:76).
+    kafka_module: dependency seam — anything exposing the kafka-python
+        surface (KafkaProducer/KafkaConsumer/TopicPartition, .admin,
+        .errors). Defaults to ``import kafka``.
+    """
+
+    def __init__(
+        self,
+        bootstrap: str,
+        default_partitions: int = 3,
+        kafka_module: Any = None,
+        timeout_s: float = 30.0,
+        registry: Any = None,
+    ):
+        if kafka_module is None:
+            try:
+                kafka_module = importlib.import_module("kafka")
+            except ImportError as e:
+                raise RuntimeError(
+                    "kafka-python is not installed; use the in-process Broker "
+                    "(BROKER_URL=inproc://) or the networked bus server "
+                    "(BROKER_URL=http://host:9092)"
+                ) from e
+        self._kafka = kafka_module
+        self.bootstrap = bootstrap
+        self._default_partitions = default_partitions
+        self._timeout_s = timeout_s
+        self._producer = kafka_module.KafkaProducer(
+            bootstrap_servers=bootstrap,
+            value_serializer=_dumps,
+            key_serializer=_dumps,
+        )
+        self._meta_consumer = None  # lazy: only needed for end_offsets
+        self._admin = None  # lazy: only needed for create_topic
+        # adapter-side health series for the KafkaCluster board (broker
+        # internals come from the JMX exporter; the adapter contributes its
+        # own produce/send-failure view of cluster health)
+        self._c_produced = self._c_send_errors = None
+        if registry is not None:
+            self._c_produced = registry.counter(
+                "kafka_adapter_records_produced_total",
+                "records acknowledged by the cluster",
+            )
+            self._c_send_errors = registry.counter(
+                "kafka_adapter_send_errors_total",
+                "sends that failed or timed out",
+            )
+
+    # -- admin ------------------------------------------------------------
+    def create_topic(self, name: str, n_partitions: int | None = None) -> None:
+        admin_mod = importlib.import_module(
+            self._kafka.__name__ + ".admin"
+        ) if not hasattr(self._kafka, "admin") else self._kafka.admin
+        errors_mod = importlib.import_module(
+            self._kafka.__name__ + ".errors"
+        ) if not hasattr(self._kafka, "errors") else self._kafka.errors
+        if self._admin is None:
+            self._admin = admin_mod.KafkaAdminClient(bootstrap_servers=self.bootstrap)
+        topic = admin_mod.NewTopic(
+            name=name,
+            num_partitions=n_partitions or self._default_partitions,
+            replication_factor=1,
+        )
+        try:
+            self._admin.create_topics([topic])
+        except errors_mod.TopicAlreadyExistsError:
+            pass
+
+    def end_offsets(self, topic: str) -> list[int]:
+        if self._meta_consumer is None:
+            self._meta_consumer = self._kafka.KafkaConsumer(
+                bootstrap_servers=self.bootstrap
+            )
+        parts = self._meta_consumer.partitions_for_topic(topic)
+        if not parts:
+            return []
+        tps = [self._kafka.TopicPartition(topic, p) for p in sorted(parts)]
+        eo = self._meta_consumer.end_offsets(tps)
+        return [eo[tp] for tp in tps]
+
+    # -- produce ----------------------------------------------------------
+    def produce(self, topic: str, value: Any, key: Any = None) -> dict[str, Any]:
+        fut = self._producer.send(topic, value=value, key=key)
+        try:
+            md = fut.get(timeout=self._timeout_s)
+        except Exception:
+            if self._c_send_errors is not None:
+                self._c_send_errors.inc()
+            raise
+        if self._c_produced is not None:
+            self._c_produced.inc()
+        return {"topic": md.topic, "partition": md.partition, "offset": md.offset}
+
+    def produce_batch(
+        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+    ) -> int:
+        """Pipelined sends + one flush (the producer's hot path). A send
+        error fails the call after the flush resolves every in-flight
+        future — the prefix-committed outcome of the in-process broker."""
+        values = list(values)
+        key_list = list(keys) if keys is not None else [None] * len(values)
+        if len(key_list) != len(values):
+            raise ValueError("keys and values must have equal length")
+        futures = [
+            self._producer.send(topic, value=v, key=k)
+            for v, k in zip(values, key_list)
+        ]
+        self._producer.flush(timeout=self._timeout_s)
+        # per-record accounting even on partial failure: futures that the
+        # cluster acknowledged count as produced (their records ARE in the
+        # log, visible to consumers), each failed future counts one error,
+        # and the call still fails afterward (prefix-committed semantics)
+        n_ok = 0
+        first_err: Exception | None = None
+        for f in futures:
+            try:
+                f.get(timeout=self._timeout_s)
+                n_ok += 1
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                if self._c_send_errors is not None:
+                    self._c_send_errors.inc()
+                if first_err is None:
+                    first_err = e
+        if self._c_produced is not None and n_ok:
+            self._c_produced.inc(n_ok)
+        if first_err is not None:
+            raise first_err
+        return len(values)
+
+    # -- consume ----------------------------------------------------------
+    def consumer(self, group_id: str, topics: Iterable[str]) -> "KafkaConsumerAdapter":
+        kc = self._kafka.KafkaConsumer(
+            *topics,
+            bootstrap_servers=self.bootstrap,
+            group_id=group_id,
+            enable_auto_commit=False,
+            auto_offset_reset="earliest",
+            value_deserializer=_loads,
+            key_deserializer=_loads,
+        )
+        return KafkaConsumerAdapter(kc, group_id, tuple(topics))
+
+    def close(self) -> None:
+        self._producer.close()
+        if self._meta_consumer is not None:
+            self._meta_consumer.close()
+        if self._admin is not None:
+            self._admin.close()
+
+
+class KafkaConsumerAdapter:
+    """``bus.broker.Consumer`` surface over a kafka-python KafkaConsumer.
+
+    Commit discipline mirrors the in-process Consumer (bus/broker.py:
+    "auto-commit on poll", at-most-once hand-off): the batch a poll()
+    delivers is committed as part of that poll, so a successor consumer in
+    the group resumes AFTER it — a crash mid-handling drops that batch
+    rather than redelivering it, identically on both transports.
+    """
+
+    def __init__(self, kc: Any, group_id: str, topics: tuple[str, ...]):
+        self._kc = kc
+        self.group_id = group_id
+        self.topics = topics
+        self._closed = False
+
+    def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[Record]:
+        if self._closed:
+            return []
+        by_tp = self._kc.poll(
+            timeout_ms=max(0, int(timeout_s * 1000)), max_records=max_records
+        )
+        out: list[Record] = []
+        for tp, recs in sorted(by_tp.items(), key=lambda kv: (kv[0].topic, kv[0].partition)):
+            for r in recs:
+                out.append(
+                    Record(
+                        topic=r.topic,
+                        partition=r.partition,
+                        offset=r.offset,
+                        key=r.key,
+                        value=r.value,
+                        # kafka timestamps are epoch-ms; bus records use epoch-s
+                        timestamp=(r.timestamp or 0) / 1000.0,
+                    )
+                )
+        if out:
+            self._kc.commit()
+        return out
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._kc.close()
+
+    def __enter__(self) -> "KafkaConsumerAdapter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

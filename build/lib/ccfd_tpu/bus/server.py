@@ -1,0 +1,316 @@
+"""Networked broker: the bus as its own service, like the reference's Kafka.
+
+The reference's message plane is a Strimzi Kafka cluster reached over the
+network at ``odh-message-bus-kafka-brokers:9092`` (reference
+deploy/router.yaml:55-56); every other service — producer, router, KIE
+server, notification service — is a separate pod speaking to it. The
+in-process ``Broker`` (ccfd_tpu/bus/broker.py) carries the semantics; this
+server puts them behind HTTP so the same per-service topology deploys here:
+one ``python -m ccfd_tpu bus serve`` process (optionally durable via
+``--dir``), and N components connecting with ``BROKER_URL=http://host:port``
+through ``RemoteBroker`` (ccfd_tpu/bus/client.py).
+
+Contract (JSON bodies; bytes values ride base64 under ``{"__b64__": ...}``):
+
+    POST /topics/{topic}/produce     {records: [{value, key?}...]} -> metas
+    GET  /topics/{topic}/offsets                                   -> [int]
+    POST /consumers                  {group, topics[]}   -> {consumer_id}
+    POST /consumers/{id}/poll        {max_records, timeout_s} -> {records}
+    POST /consumers/{id}/close                                      -> {}
+    GET  /metrics | /health/status
+
+Long-polling maps straight onto ``Consumer.poll(timeout_s=...)`` — the
+handler thread parks on the broker's condition variable, so an idle
+consumer costs a blocked thread, not a busy loop (the threaded server gives
+each request its own thread). Consumers that stop polling for
+``consumer_ttl_s`` are reaped so their partitions rebalance to live group
+members — Kafka's session-timeout behavior.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Any
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
+
+from ccfd_tpu.bus.broker import Broker, Consumer, Record
+from ccfd_tpu.metrics.prom import Registry
+
+_PRODUCE = re.compile(r"^/topics/([\w.-]+)/produce$")
+_OFFSETS = re.compile(r"^/topics/([\w.-]+)/offsets$")
+_POLL = re.compile(r"^/consumers/(\d+)/poll$")
+_CLOSE = re.compile(r"^/consumers/(\d+)/close$")
+
+
+def encode_value(v: Any) -> Any:
+    """JSON-safe wire form; bytes ride base64 (CSV lines stay byte-exact)."""
+    if isinstance(v, bytes):
+        return {"__b64__": base64.b64encode(v).decode()}
+    return v
+
+
+def decode_value(v: Any) -> Any:
+    if isinstance(v, dict) and set(v) == {"__b64__"}:
+        return base64.b64decode(v["__b64__"])
+    return v
+
+
+def record_view(r: Record) -> dict[str, Any]:
+    return {
+        "topic": r.topic,
+        "partition": r.partition,
+        "offset": r.offset,
+        "key": encode_value(r.key),
+        "value": encode_value(r.value),
+        "timestamp": r.timestamp,
+    }
+
+
+class BrokerServer:
+    def __init__(
+        self,
+        broker: Broker | None = None,
+        registry: Registry | None = None,
+        consumer_ttl_s: float = 60.0,
+    ):
+        self.broker = broker or Broker()
+        self.registry = registry or Registry()
+        self.consumer_ttl_s = consumer_ttl_s
+        self._consumers: dict[int, Consumer] = {}
+        self._last_poll: dict[int, float] = {}
+        # last delivered batch per consumer, keyed by the client's poll seq:
+        # a retry after a lost response re-sends the same seq and gets the
+        # same records back (at-least-once) instead of the next batch
+        self._delivered: dict[int, tuple[int, list[dict[str, Any]]]] = {}
+        self._cid = 0
+        self._lock = threading.Lock()
+        self._httpd: FrameworkHTTPServer | None = None
+        r = self.registry
+        self._c_produced = r.counter("bus_records_produced_total", "records in")
+        self._c_delivered = r.counter("bus_records_delivered_total", "records out")
+        self._g_consumers = r.gauge("bus_consumers", "live remote consumers")
+        # broker-health surface, the analog of the reference Kafka board's
+        # messages-in-per-topic and partition-health stats
+        # (reference deploy/grafana/Kafka.json broker/partition panels)
+        self._c_topic_in = r.counter(
+            "bus_topic_records_in_total", "records in by topic"
+        )
+        self._g_end_offset = r.gauge(
+            "bus_topic_end_offset", "log end offset by topic/partition"
+        )
+        self._g_backlog = r.gauge(
+            "bus_topic_backlog", "unconsumed records by group/topic"
+        )
+
+    def refresh_health_gauges(self) -> None:
+        """Publish per-topic end offsets and per-group backlog (lag) the way
+        a Kafka exporter does — at scrape time, not on the produce path.
+        The snapshot itself is the broker's job (it owns the lock and the
+        data structures); this layer only turns it into gauges."""
+        snap = self.broker.health_snapshot()
+        topics = snap["topics"]
+        groups = snap["groups"]
+        for name, ends in topics.items():
+            for p, end in enumerate(ends):
+                self._g_end_offset.set(end, labels={"topic": name, "partition": str(p)})
+        for g, tps in groups.items():
+            lag_by_topic: dict[str, int] = {}
+            for (tname, p), committed in tps.items():
+                ends = topics.get(tname)
+                if ends is not None and p < len(ends):
+                    lag_by_topic[tname] = lag_by_topic.get(tname, 0) + max(
+                        0, ends[p] - committed
+                    )
+            for tname, lag in lag_by_topic.items():
+                self._g_backlog.set(lag, labels={"group": g, "topic": tname})
+
+    # -- consumer registry -------------------------------------------------
+    def _register(self, group: str, topics: list[str]) -> int:
+        with self._lock:
+            self._reap_locked()
+            self._cid += 1
+            cid = self._cid
+            self._consumers[cid] = self.broker.consumer(group, tuple(topics))
+            self._last_poll[cid] = time.monotonic()
+            self._g_consumers.set(len(self._consumers))
+            return cid
+
+    def _consumer(self, cid: int) -> Consumer | None:
+        with self._lock:
+            # reap here too: registration alone would let a dead group
+            # member pin its partitions forever while survivors keep polling
+            self._reap_locked(keep=cid)
+            self._last_poll[cid] = time.monotonic()
+            return self._consumers.get(cid)
+
+    def _close_consumer(self, cid: int) -> bool:
+        with self._lock:
+            c = self._consumers.pop(cid, None)
+            self._last_poll.pop(cid, None)
+            self._delivered.pop(cid, None)
+            self._g_consumers.set(len(self._consumers))
+        if c is None:
+            return False
+        c.close()
+        return True
+
+    def _reap_locked(self, keep: int | None = None) -> None:
+        """Close consumers that stopped polling (Kafka session timeout):
+        their partitions rebalance to surviving group members."""
+        now = time.monotonic()
+        dead = [
+            cid
+            for cid, t in self._last_poll.items()
+            if cid != keep and now - t > self.consumer_ttl_s
+        ]
+        for cid in dead:
+            c = self._consumers.pop(cid, None)
+            self._last_poll.pop(cid, None)
+            self._delivered.pop(cid, None)
+            if c is not None:
+                c.close()
+        if dead:
+            self._g_consumers.set(len(self._consumers))
+
+    # -- HTTP ----------------------------------------------------------------
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send_json(self, code: int, obj: Any) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/")
+                if path in ("/metrics", "/prometheus"):
+                    server.refresh_health_gauges()
+                    body = server.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path in ("/health/status", "/health", "/healthz"):
+                    self._send_json(200, {"status": "ok"})
+                    return
+                m = _OFFSETS.match(path)
+                if m:
+                    self._send_json(200, server.broker.end_offsets(m.group(1)))
+                    return
+                self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    length = 0
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(raw or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._send_json(400, {"error": "malformed JSON body"})
+                    return
+                if not isinstance(payload, dict):
+                    self._send_json(400, {"error": "JSON body must be an object"})
+                    return
+                path = self.path.rstrip("/")
+                m = _PRODUCE.match(path)
+                if m:
+                    records = payload.get("records")
+                    if not isinstance(records, list):
+                        self._send_json(400, {"error": "need records: [...]"})
+                        return
+                    metas = []
+                    for r in records:
+                        rec = server.broker.produce(
+                            m.group(1),
+                            decode_value(r.get("value")),
+                            key=decode_value(r.get("key")),
+                        )
+                        metas.append({"partition": rec.partition, "offset": rec.offset})
+                    server._c_produced.inc(len(metas))
+                    server._c_topic_in.inc(len(metas), labels={"topic": m.group(1)})
+                    self._send_json(200, {"metas": metas})
+                    return
+                if path == "/consumers":
+                    group = payload.get("group")
+                    topics = payload.get("topics")
+                    if not group or not isinstance(topics, list) or not topics:
+                        self._send_json(400, {"error": "need group and topics[]"})
+                        return
+                    cid = server._register(str(group), [str(t) for t in topics])
+                    self._send_json(201, {"consumer_id": cid})
+                    return
+                m = _POLL.match(path)
+                if m:
+                    cid = int(m.group(1))
+                    c = server._consumer(cid)
+                    if c is None:
+                        self._send_json(404, {"error": "no such consumer"})
+                        return
+                    seq = payload.get("seq")
+                    if seq is not None:
+                        with server._lock:
+                            cached = server._delivered.get(cid)
+                        if cached is not None and cached[0] == seq:
+                            # response to this seq was lost in transit:
+                            # redeliver, don't advance past the batch
+                            self._send_json(200, {"records": cached[1]})
+                            return
+                    timeout = min(float(payload.get("timeout_s", 0.0)), 30.0)
+                    recs = c.poll(
+                        max_records=int(payload.get("max_records", 500)),
+                        timeout_s=timeout,
+                    )
+                    views = [record_view(r) for r in recs]
+                    if seq is not None and recs:
+                        with server._lock:
+                            server._delivered[cid] = (seq, views)
+                    server._c_delivered.inc(len(recs))
+                    self._send_json(200, {"records": views})
+                    return
+                m = _CLOSE.match(path)
+                if m:
+                    ok = server._close_consumer(int(m.group(1)))
+                    self._send_json(200 if ok else 404, {})
+                    return
+                self._send_json(404, {"error": "not found"})
+
+        return Handler
+
+    def start(self, host: str = "0.0.0.0", port: int = 9092) -> int:
+        self._httpd = FrameworkHTTPServer((host, port), self._handler_class())
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="ccfd-bus"
+        ).start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        with self._lock:
+            consumers = list(self._consumers.values())
+            self._consumers.clear()
+            self._last_poll.clear()
+        for c in consumers:
+            c.close()
+        self.broker.close()

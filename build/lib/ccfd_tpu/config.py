@@ -1,0 +1,189 @@
+"""12-factor env-var configuration surface.
+
+Keeps the reference's environment-variable contract verbatim so a user of the
+reference can drop in this framework with the same manifests:
+
+- router vars: reference deploy/router.yaml:54-70 (BROKER_URL, KAFKA_TOPIC,
+  CUSTOMER_NOTIFICATION_TOPIC, CUSTOMER_RESPONSE_TOPIC, KIE_SERVER_URL,
+  SELDON_URL, SELDON_ENDPOINT, FRAUD_THRESHOLD) plus optional SELDON_TOKEN
+  (reference README.md:447-451).
+- KIE-server vars: reference deploy/ccd-service.yaml:54-66 and
+  README.md:370-402 (SELDON_TIMEOUT, SELDON_POOL_SIZE, CONFIDENCE_THRESHOLD).
+- producer vars: reference deploy/kafka/ProducerDeployment.yaml:77-97
+  (topic, s3endpoint, s3bucket, filename, bootstrap).
+- notification var: reference deploy/notification-service.yaml:50-52
+  (BROKER_URL).
+
+TPU-side knobs (CCFD_*) are new: they configure micro-batching, model choice
+and compute dtype for the XLA scorer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # --- bus / topics (reference router.yaml:54-62) ---
+    broker_url: str = "inproc://local"
+    bus_log_dir: str = ""  # durable segment-log dir (CCFD_BUS_DIR); "" = memory
+    bus_fsync: bool = False  # fsync per append (CCFD_BUS_FSYNC=1)
+    kafka_topic: str = "odh-demo"
+    customer_notification_topic: str = "ccd-customer-outgoing"
+    customer_response_topic: str = "ccd-customer-response"
+
+    # --- service endpoints (reference router.yaml:63-68) ---
+    kie_server_url: str = "inproc://engine"
+    seldon_url: str = "inproc://scorer"
+    # URL path suffix, as in the reference manifests (router.yaml:65-68) —
+    # NOT a model name; model selection is CCFD_MODEL / model_name below.
+    seldon_endpoint: str = "api/v0.1/predictions"
+    seldon_token: str = ""
+
+    # --- decision thresholds (reference router.yaml:69-70, README.md:395-402) ---
+    fraud_threshold: float = 0.5
+    rules_file: str = ""  # JSON rule base (CCFD_RULES) -> router/rules.py
+    confidence_threshold: float = 1.0
+
+    # --- HTTP client knobs (reference README.md:386-393) ---
+    seldon_timeout_ms: int = 5000
+    seldon_pool_size: int = 5
+    # new: bounded retries on transport failure (reference's only failure
+    # knob is the timeout; retries keep the pipeline up across scorer
+    # restarts under the supervisor)
+    client_retries: int = 2
+
+    # --- producer (reference ProducerDeployment.yaml:88-97) ---
+    producer_topic: str = "odh-demo"
+    s3_endpoint: str = ""
+    s3_bucket: str = "ccdata"
+    filename: str = "creditcard.csv"
+    bootstrap: str = "odh-message-bus-kafka-brokers:9092"
+    # secret-ref pair from the reference's `keysecret`
+    # (ProducerDeployment.yaml:78-87, deploy/ceph/s3-secretceph.yaml:4-7)
+    access_key_id: str = ""
+    secret_access_key: str = ""
+
+    # --- process engine (reference README.md:554-605 semantics) ---
+    customer_reply_timeout_s: float = 30.0
+    low_amount_threshold: float = 200.0
+    low_proba_threshold: float = 0.75
+
+    # --- online retrain (new; BASELINE.json configs[4]) ---
+    labels_topic: str = "ccd-labels"
+    audit_topic: str = ""  # "" = audit stream off; a topic name enables the
+    # engine's jBPM-AuditService-analog lifecycle event stream onto the bus
+    retrain_batch: int = 1024
+    retrain_min_labels: int = 256
+
+    # --- TPU scorer knobs (new) ---
+    model_name: str = "mlp"
+    graph_cr: str = ""  # SeldonDeployment-shaped CR file -> serving/graph.py
+    compute_dtype: str = "bfloat16"
+    batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384)
+    batch_deadline_ms: float = 2.0
+    batch_workers: int = 4  # overlapped dispatches (device-RTT pipelining)
+    dynamic_batching: bool = True  # serving-side request coalescing
+    native_front: bool = True  # C++ HTTP front when the toolchain allows
+    host_tier_rows: int = -1  # -1 = auto: measured at scorer warmup (host
+    # forward rate vs device dispatch RTT, crossover at RTT/2, <=8192;
+    # 256 provisionally until warmup runs); 0 = off; >0 = fixed threshold
+    dispatch_deadline_ms: float = -1.0  # server-side device-dispatch bound
+    # (the reference's SELDON_TIMEOUT applied inside the server): -1 = auto
+    # (accelerator backends: seldon_timeout_ms; cpu/mesh: off), 0 = off,
+    # >0 = explicit deadline
+    serve_host: str = "0.0.0.0"
+    serve_port: int = 8000
+
+    def scorer_dispatch_deadline_ms(self) -> float | None:
+        """The value serving code passes to ``Scorer(dispatch_deadline_ms=)``.
+
+        Explicit (>= 0) wins; auto (-1) resolves to the SELDON_TIMEOUT bound
+        so the server-side deadline tracks the client-side knob, and returns
+        it as a number so a programmatically-built Config is honored (the
+        scorer still disables the guard itself on cpu/mesh backends when
+        handed None — which only happens for scorers built without a Config).
+        """
+        if self.dispatch_deadline_ms >= 0:
+            return self.dispatch_deadline_ms
+        import jax
+
+        if jax.default_backend() in ("cpu",):
+            return 0.0
+        return float(self.seldon_timeout_ms)
+
+    @staticmethod
+    def from_env(env: Mapping[str, str] | None = None) -> "Config":
+        e = dict(os.environ if env is None else env)
+        sizes = e.get("CCFD_BATCH_SIZES", "")
+        return Config(
+            broker_url=e.get("BROKER_URL", Config.broker_url),
+            bus_log_dir=e.get("CCFD_BUS_DIR", Config.bus_log_dir),
+            bus_fsync=e.get("CCFD_BUS_FSYNC", "") in ("1", "true", "yes"),
+            kafka_topic=e.get("KAFKA_TOPIC", Config.kafka_topic),
+            customer_notification_topic=e.get(
+                "CUSTOMER_NOTIFICATION_TOPIC", Config.customer_notification_topic
+            ),
+            customer_response_topic=e.get(
+                "CUSTOMER_RESPONSE_TOPIC", Config.customer_response_topic
+            ),
+            kie_server_url=e.get("KIE_SERVER_URL", Config.kie_server_url),
+            seldon_url=e.get("SELDON_URL", Config.seldon_url),
+            seldon_endpoint=e.get("SELDON_ENDPOINT", Config.seldon_endpoint),
+            seldon_token=e.get("SELDON_TOKEN", Config.seldon_token),
+            fraud_threshold=float(e.get("FRAUD_THRESHOLD", str(Config.fraud_threshold))),
+            rules_file=e.get("CCFD_RULES", Config.rules_file),
+            confidence_threshold=float(
+                e.get("CONFIDENCE_THRESHOLD", str(Config.confidence_threshold))
+            ),
+            seldon_timeout_ms=int(e.get("SELDON_TIMEOUT", str(Config.seldon_timeout_ms))),
+            dispatch_deadline_ms=float(
+                e.get("CCFD_DISPATCH_DEADLINE_MS", str(Config.dispatch_deadline_ms))
+            ),
+            seldon_pool_size=int(e.get("SELDON_POOL_SIZE", str(Config.seldon_pool_size))),
+            client_retries=int(e.get("CCFD_CLIENT_RETRIES", str(Config.client_retries))),
+            producer_topic=e.get("topic", Config.producer_topic),
+            s3_endpoint=e.get("s3endpoint", Config.s3_endpoint),
+            s3_bucket=e.get("s3bucket", Config.s3_bucket),
+            filename=e.get("filename", Config.filename),
+            bootstrap=e.get("bootstrap", Config.bootstrap),
+            access_key_id=e.get("ACCESS_KEY_ID", Config.access_key_id),
+            secret_access_key=e.get("SECRET_ACCESS_KEY", Config.secret_access_key),
+            customer_reply_timeout_s=float(
+                e.get("CCFD_REPLY_TIMEOUT_S", str(Config.customer_reply_timeout_s))
+            ),
+            low_amount_threshold=float(
+                e.get("CCFD_LOW_AMOUNT", str(Config.low_amount_threshold))
+            ),
+            low_proba_threshold=float(
+                e.get("CCFD_LOW_PROBA", str(Config.low_proba_threshold))
+            ),
+            labels_topic=e.get("CCFD_LABELS_TOPIC", Config.labels_topic),
+            audit_topic=e.get("CCFD_AUDIT_TOPIC", Config.audit_topic),
+            retrain_batch=int(e.get("CCFD_RETRAIN_BATCH", str(Config.retrain_batch))),
+            retrain_min_labels=int(
+                e.get("CCFD_RETRAIN_MIN_LABELS", str(Config.retrain_min_labels))
+            ),
+            model_name=e.get("CCFD_MODEL", Config.model_name),
+            graph_cr=e.get("CCFD_GRAPH_CR", Config.graph_cr),
+            compute_dtype=e.get("CCFD_DTYPE", Config.compute_dtype),
+            batch_sizes=tuple(int(s) for s in sizes.split(",")) if sizes else Config.batch_sizes,
+            batch_deadline_ms=float(
+                e.get("CCFD_BATCH_DEADLINE_MS", str(Config.batch_deadline_ms))
+            ),
+            batch_workers=int(
+                e.get("CCFD_BATCH_WORKERS", str(Config.batch_workers))
+            ),
+            dynamic_batching=e.get("CCFD_DYNAMIC_BATCHING", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            native_front=e.get("CCFD_NATIVE_FRONT", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            host_tier_rows=int(
+                e.get("CCFD_HOST_TIER_ROWS", str(Config.host_tier_rows))
+            ),
+            serve_host=e.get("CCFD_SERVE_HOST", Config.serve_host),
+            serve_port=int(e.get("CCFD_SERVE_PORT", str(Config.serve_port))),
+        )

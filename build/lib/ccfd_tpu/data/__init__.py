@@ -1,0 +1,1 @@
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, NUM_FEATURES, load_dataset, synthetic_dataset  # noqa: F401

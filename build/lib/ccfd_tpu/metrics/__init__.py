@@ -1,0 +1,1 @@
+from ccfd_tpu.metrics.prom import Counter, Gauge, Histogram, Registry  # noqa: F401

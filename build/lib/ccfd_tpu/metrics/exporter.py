@@ -1,0 +1,90 @@
+"""Prometheus scrape endpoint over the framework's metric registries.
+
+The reference wires Prometheus to each service by pod annotation — model
+``/prometheus`` (reference README.md:292-301), router ``:8091/prometheus``
+(README.md:503-507), KIE ``:8090/rest/metrics`` (README.md:509-515). When
+the pipeline runs in one process under the platform operator, this exporter
+serves every component registry from one port, preserving the per-service
+paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
+1:1:
+
+    GET /prometheus            all registries concatenated
+    GET /prometheus/<name>     one component (router, kie, notify, ...)
+    GET /rest/metrics          alias for the KIE registry (reference path)
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
+
+from ccfd_tpu.metrics.prom import Registry
+
+
+class MetricsExporter:
+    def __init__(self, registries: dict[str, Registry],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._registries = dict(registries)
+        self._lock = threading.Lock()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args) -> None:
+                pass
+
+            def do_GET(self) -> None:
+                path = self.path.split("?")[0].rstrip("/")
+                body = exporter.render_path(path)
+                if body is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = FrameworkHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    def add(self, name: str, registry: Registry) -> None:
+        with self._lock:
+            self._registries[name] = registry
+
+    def render_path(self, path: str) -> str | None:
+        with self._lock:
+            regs = dict(self._registries)
+        if path in ("", "/prometheus", "/metrics"):
+            return "\n".join(r.render() for r in regs.values())
+        if path == "/rest/metrics":  # reference KIE scrape path
+            kie = regs.get("kie")
+            return kie.render() if kie else None
+        if path.startswith("/prometheus/"):
+            r = regs.get(path[len("/prometheus/"):])
+            return r.render() if r else None
+        return None
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "MetricsExporter":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="ccfd-metrics"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,248 @@
+"""Minimal thread-safe Prometheus metrics with text exposition.
+
+The reference's observability contract is the union of six Grafana boards
+(reference deploy/grafana/*.json) scraping Prometheus endpoints exposed per
+service (reference README.md:487-537). This module reimplements exactly what
+those boards need — Counter, Gauge, Histogram with labels, rendered in the
+Prometheus text format — with no global state (each service owns a Registry,
+so tests can run many pipelines in one process).
+
+Metric names used across the framework mirror the reference:
+- router counters ``transaction_incoming_total``,
+  ``transaction_outgoing_total{type=...}``, ``notifications_outgoing_total``,
+  ``notifications_incoming_total{response=...}`` (README.md:522-530,
+  Router.json:88,163,250,326)
+- KIE amount histograms ``fraud_investigation_amount`` etc. (README.md:532-537)
+- model gauges ``proba_1``/``Amount``/``V17``/``V10`` (ModelPrediction.json:96-104)
+- Seldon-style request/latency series (SeldonCore.json:119-531).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, Mapping, Sequence
+
+LabelKey = tuple[tuple[str, str], ...]
+
+
+def _labelkey(labels: Mapping[str, str] | None) -> LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return repr(float(v))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+    def render(self) -> Iterable[str]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class _ScalarMetric(_Metric):
+    """Shared labeled-scalar storage for Counter and Gauge."""
+
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_)
+        self._values: dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, labels: Mapping[str, str] | None = None) -> None:
+        key = _labelkey(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, labels: Mapping[str, str] | None = None) -> float:
+        with self._lock:
+            return self._values.get(_labelkey(labels), 0.0)
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            yield f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}"
+
+
+class Counter(_ScalarMetric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, labels: Mapping[str, str] | None = None) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        super().inc(amount, labels)
+
+
+class Gauge(_ScalarMetric):
+    kind = "gauge"
+
+    def set(self, value: float, labels: Mapping[str, str] | None = None) -> None:
+        with self._lock:
+            self._values[_labelkey(labels)] = float(value)
+
+
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, math.inf,
+)
+
+# Amount histograms on the KIE board span transaction amounts, not seconds
+# (reference KIE.json bucket panels; README.md:532-537).
+AMOUNT_BUCKETS = (
+    1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0,
+    5000.0, 10000.0, math.inf,
+)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_)
+        b = sorted(set(float(x) for x in buckets))
+        if not b or b[-1] != math.inf:
+            b.append(math.inf)
+        self.buckets = tuple(b)
+        self._counts: dict[LabelKey, list[int]] = {}
+        self._sums: dict[LabelKey, float] = {}
+
+    def observe(self, value: float, labels: Mapping[str, str] | None = None) -> None:
+        key = _labelkey(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + float(value)
+
+    def merge_counts(
+        self,
+        bucket_counts: Sequence[int],
+        sum_: float,
+        labels: Mapping[str, str] | None = None,
+    ) -> None:
+        """Fold externally-observed cumulative le-counts into this series.
+
+        For native-code observers (the C++ serving front scores requests
+        without touching Python) that accumulate in the SAME bucket layout:
+        the caller passes per-bucket DELTAS since its last fold plus the
+        matching latency-sum delta. Layout mismatch is a programming error
+        and raises rather than corrupting the series.
+        """
+        if len(bucket_counts) != len(self.buckets):
+            raise ValueError(
+                f"bucket layout mismatch: got {len(bucket_counts)} counts "
+                f"for {len(self.buckets)} buckets"
+            )
+        key = _labelkey(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, c in enumerate(bucket_counts):
+                counts[i] += int(c)
+            self._sums[key] = self._sums.get(key, 0.0) + float(sum_)
+
+    def count(self, labels: Mapping[str, str] | None = None) -> int:
+        with self._lock:
+            counts = self._counts.get(_labelkey(labels))
+            return counts[-1] if counts else 0
+
+    def sum(self, labels: Mapping[str, str] | None = None) -> float:
+        with self._lock:
+            return self._sums.get(_labelkey(labels), 0.0)
+
+    def quantile(self, q: float, labels: Mapping[str, str] | None = None) -> float:
+        """Bucket-interpolated quantile (what histogram_quantile() computes)."""
+        with self._lock:
+            counts = list(self._counts.get(_labelkey(labels), []))
+        if not counts or counts[-1] == 0:
+            return float("nan")
+        total = counts[-1]
+        rank = q * total
+        prev_ub, prev_c = 0.0, 0
+        for ub, c in zip(self.buckets, counts):
+            if c >= rank:
+                if ub == math.inf:
+                    return prev_ub
+                span = c - prev_c
+                frac = (rank - prev_c) / span if span else 1.0
+                return prev_ub + (ub - prev_ub) * frac
+            prev_ub, prev_c = ub, c
+        return prev_ub
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            for ub, c in zip(self.buckets, counts):
+                lk = key + (("le", _fmt_value(ub)),)
+                yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {c}"
+            yield f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(sums.get(key, 0.0))}"
+            yield f"{self.name}_count{_fmt_labels(key)} {counts[-1]}"
+
+
+class Registry:
+    """Per-service metric registry; renders the /prometheus scrape body."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(
+        self, name: str, help_: str = "", buckets: Sequence[float] = DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get_or_make(name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_make(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"

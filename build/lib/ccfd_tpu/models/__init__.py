@@ -1,0 +1,2 @@
+from ccfd_tpu.models import logreg, mlp, trees  # noqa: F401
+from ccfd_tpu.models.registry import get_model, register_model, ModelSpec  # noqa: F401

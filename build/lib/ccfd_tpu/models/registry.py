@@ -1,0 +1,80 @@
+"""Model registry: name -> (init, apply) the way the reference selects its
+Seldon graph node by image name (reference deploy/model/modelfull.json:37-44,
+``{"name": "modelfull", "type": "MODEL"}``). The serving layer and router look
+models up here by the ``CCFD_MODEL`` / ``SELDON_ENDPOINT`` name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+
+from ccfd_tpu.models import logreg, mlp, trees
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    init: Callable[..., Any]
+    apply: Callable[..., jax.Array]  # (params, x) -> proba_1 (B,)
+    logits: Callable[..., jax.Array]
+    trainable: bool
+    # optional pure-numpy forward: enables the serving host latency tier
+    # (small batches skip the device round trip on high-RTT attachments)
+    apply_numpy: Callable[..., Any] | None = None
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register_model(spec: ModelSpec) -> None:
+    _REGISTRY[spec.name] = spec
+
+
+def get_model(name: str) -> ModelSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+register_model(
+    ModelSpec("logreg", logreg.init, logreg.apply, logreg.logits,
+              trainable=True, apply_numpy=logreg.apply_numpy)
+)
+register_model(
+    ModelSpec("modelfull", logreg.init, logreg.apply, logreg.logits,
+              trainable=True, apply_numpy=logreg.apply_numpy)
+)  # reference alias: the Seldon graph node name (modelfull.json:38)
+register_model(ModelSpec("mlp", mlp.init, mlp.apply, mlp.logits,
+                         trainable=True, apply_numpy=mlp.apply_numpy))
+register_model(
+    ModelSpec(
+        "gbt",
+        lambda key=None, n_trees=50, depth=4: trees.init_empty(n_trees, depth),
+        trees.apply,
+        trees.logits,
+        trainable=False,
+        apply_numpy=trees.apply_numpy,
+    )
+)
+
+register_model(
+    ModelSpec(
+        "gbt_mxu",
+        lambda key=None, n_trees=50, depth=4: trees.init_empty(n_trees, depth),
+        trees.apply_mxu,
+        trees.logits_mxu,
+        trainable=False,
+        apply_numpy=trees.apply_numpy,
+    )
+)  # gather-free MXU evaluation of the SAME tree params (trees.logits_mxu)
+
+# int8 quantized serving graph: registered here so CCFD_MODEL=mlp_q8 is a
+# working drop-in everywhere models resolve by name (quant.py's imports of
+# this module are all deferred inside register(), so no cycle)
+from ccfd_tpu.ops import quant as _quant  # noqa: E402
+
+_quant.register()

@@ -1,0 +1,1087 @@
+// Native HTTP serving front: request parsing, payload decode, and response
+// writing in C++ threads; Python touches only whole scoring batches.
+//
+// Why: the REST hop's per-request Python cost (~650us: header parse, JSON,
+// future/condvar hand-off, response build) is GIL-serialized, capping the
+// Seldon-contract endpoint at a few thousand req/s regardless of how fast
+// the TPU scores (SURVEY.md §7 "hard parts (a)": p99 <10ms with Python on
+// the hot path needs a native decode/batch shim). This front moves the
+// whole per-request path into C++:
+//
+//   epoll IO thread: accept, parse HTTP/1.1 keep-alive, auth-check,
+//     decode the canonical Seldon ndarray payload (ccfd_decode_ndarray,
+//     decode.cpp) into a float32 row block, enqueue.
+//   Python scorer threads: ccfd_front_take() -> ONE batch of concatenated
+//     rows across many requests -> scorer.score -> ccfd_front_respond().
+//   C++ formats the {"data":{"names":...,"ndarray":[[p0,p1],...]}} body
+//     and the IO thread writes it back.
+//
+// Requests C++ can't finish (non-canonical payloads, GET /prometheus,
+// bad JSON) queue as "misc" and a Python thread answers them through the
+// same routing logic the pure-Python server uses — identical contract,
+// different fast path. The wire format matches serving/server.py exactly.
+//
+// Concurrency model: ONE IO thread owns every socket (no per-socket
+// locking); scorer/misc threads only touch the two queues + response
+// queue, all under one mutex; an eventfd wakes the IO thread to flush
+// responses. Connection death with in-flight requests is handled by a
+// (fd, generation) check at response time.
+
+// epoll/eventfd are Linux-only; on other platforms the front degrades to
+// stubs (create returns nullptr -> Python falls back to its own server)
+// WITHOUT poisoning the shared .so build for decode/log acceleration.
+#ifdef __linux__
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cerrno>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" int ccfd_decode_ndarray(const char* buf, size_t len, float* out,
+                                   int max_rows, int n_features,
+                                   int* width_out);
+
+namespace {
+
+constexpr size_t kMaxHead = 64 * 1024;
+constexpr size_t kMaxBody = 256 * 1024 * 1024;
+// Native-path row cap per request: anything larger routes to the misc
+// (Python) queue so one giant request can never exceed the taker's batch
+// buffer and wedge the predict queue head. The Python taker's buffer
+// (serving/native_front.py max_batch_rows) must be >= this.
+constexpr int kNativeMaxRows = 8192;
+
+struct Conn {
+  std::string in;
+  std::string out;
+  uint64_t gen = 0;
+  bool want_close = false;
+  bool read_closed = false;  // peer half-closed: EOF is permanently readable
+  int pending = 0;  // requests enqueued to Python, response not yet queued
+};
+
+struct PredictReq {
+  int id;
+  int fd;
+  uint64_t gen;
+  int n_rows;
+  int path_tag;  // 0 = .../predictions, 1 = /predict (metrics label)
+  std::vector<float> rows;
+  double enq_monotonic_ms;
+};
+
+struct MiscReq {
+  int id;
+  int fd;
+  uint64_t gen;
+  std::string method;
+  std::string path;
+  std::string body;
+};
+
+struct Response {
+  int fd;
+  uint64_t gen;
+  std::string data;
+};
+
+// In-front host-tier model: a small dense stack (relu hidden layers,
+// sigmoid head) scored directly in the IO thread for requests at or under
+// max_rows. This is the zero-handoff hot path: on a small host (the bench
+// box has ONE core) the C++->Python->C++ queue round trip per batch costs
+// more in context switches and GIL handoffs than the forward itself —
+// ~100k MACs for 16 rows of the flagship MLP, a few microseconds at -O3.
+// Larger requests still flow to the Python takers (device path).
+struct HostModel {
+  // dense stack (n_layers > 0) ...
+  int n_layers = 0;
+  std::vector<int> dims;                 // n_layers+1: in, h1, ..., out(=1)
+  std::vector<std::vector<float>> w;     // w[l]: (dims[l+1] x dims[l]) row-major
+  std::vector<std::vector<float>> b;     // b[l]: dims[l+1]
+  std::vector<float> mu, inv_sigma;      // normalizer (identity if empty)
+  // ... or a boosted tree ensemble (n_trees > 0): complete binary trees
+  // of depth tree_depth in heap layout, the same dense embedding the XLA
+  // path uses (models/trees.py)
+  int n_trees = 0;
+  int tree_depth = 0;
+  std::vector<int32_t> t_feat;           // (T x 2^D-1) split feature ids
+  std::vector<float> t_thr;              // (T x 2^D-1) split thresholds
+  std::vector<float> t_leaf;             // (T x 2^D) leaf values
+  float t_base = 0.0f;
+  int max_rows = 0;
+  std::string model_name;
+  int gauge_cols[3] = {-1, -1, -1};      // Amount, V17, V10 column indices
+};
+
+struct Front {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;
+  int port = 0;
+  int n_features = 30;
+  std::string auth;  // "Bearer <token>"; empty = no auth
+  std::thread io_thread;
+  bool stopping = false;
+
+  std::mutex mu;
+  std::condition_variable cv;  // signals scorer/misc threads
+  std::deque<PredictReq> predict_q;
+  std::deque<MiscReq> misc_q;
+  std::deque<Response> resp_q;  // drained by the IO thread
+  std::unordered_map<int, std::pair<uint64_t, int>> req_route;  // id -> (gen, fd)
+  int next_id = 1;
+  uint64_t gen_counter = 1;
+  std::unordered_map<int, Conn> conns;
+
+  // stats (read via ccfd_front_stats)
+  long n_requests = 0;
+  long n_predict = 0;
+  long n_misc = 0;
+  long n_auth_fail = 0;
+
+  // host-tier model + its metrics (read via ccfd_front_host_stats; Python
+  // folds cumulative values into the registry at scrape time). Latency
+  // bucket layout mirrors the registry histogram: cumulative le counts.
+  HostModel* host = nullptr;
+  std::vector<double> lat_ubs;           // upper bounds, last is +inf
+  std::vector<long> host_hist[2];        // per endpoint tag, len(lat_ubs)
+  double host_sum[2] = {0.0, 0.0};
+  long n_host = 0;
+  float last_gauges[4] = {0, 0, 0, 0};   // proba_1, Amount, V17, V10
+  double last_gauge_ms = 0.0;            // CLOCK_MONOTONIC ms of last update
+};
+
+double now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1e3 + ts.tv_nsec / 1e6;
+}
+
+void set_nonblock(int fd) {
+  // O_NONBLOCK via ioctl-free fcntl
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+const char* reason_of(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 401: return "Unauthorized";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 413: return "Payload Too Large";
+    default: return "Internal Server Error";
+  }
+}
+
+// Seldon predict response body: {"data": {...}, "meta": {...}} — the wire
+// format serving/server.py and ccfd_front_respond produce, byte-compatible.
+std::string format_predict_body(const float* probas, int rows,
+                                const char* model) {
+  std::string body;
+  body.reserve(64 + static_cast<size_t>(rows) * 48);
+  body += "{\"data\": {\"names\": [\"proba_0\", \"proba_1\"], \"ndarray\": [";
+  char num[64];
+  for (int r = 0; r < rows; ++r) {
+    double p = static_cast<double>(probas[r]);
+    if (r) body += ", ";
+    snprintf(num, sizeof(num), "[%.17g, %.17g]", 1.0 - p, p);
+    body += num;
+  }
+  body += "]}, \"meta\": {\"model\": \"";
+  body += model;
+  body += "\"}}";
+  return body;
+}
+
+float stable_sigmoid(float z) {
+  // overflow-safe in both tails (same shape as utils/metrics_math.py)
+  if (z >= 0.0f) return 1.0f / (1.0f + expf(-z));
+  float e = expf(z);
+  return e / (1.0f + e);
+}
+
+// Dense forward: normalize -> relu hidden layers -> sigmoid head.
+//
+// Layout + explicit SIMD are the whole game here. Lessons baked in (each
+// measured on the 30->256->256->1 flagship MLP, 1-vCPU serving host):
+// - a per-row scalar loop runs ~2 GFLOP/s (latency-bound accumulator
+//   chain): ~60us/row — 10x WORSE than numpy+BLAS;
+// - rows therefore process in tiles of kTile with activations TRANSPOSED
+//   (feature-major: act[j] is one 16-lane vector over the tile's rows),
+//   so every op vectorizes over rows the way BLAS kernels do;
+// - gcc-12's autovectorizer scalarizes this loop in context (it only
+//   vectorizes it as an isolated function), so the kernel uses explicit
+//   GCC vector extensions (v16) — lowered to zmm on AVX512, 2x ymm on
+//   AVX2 — instead of hoping;
+// - each activation lane load must feed SEVERAL outputs' FMAs (register
+//   blocking of 4) or the kernel is load-bound re-streaming the tile.
+// Result: ~1.4us/row, ~4x faster than the numpy host tier, ~45x over
+// the naive loop.
+typedef float v16 __attribute__((vector_size(64)));
+constexpr int kTile = 16;
+
+inline v16 splat(float s) { return ((v16){} + 1.0f) * s; }
+
+void dense_layer_tile(const float* __restrict W, const float* __restrict B,
+                      const v16* __restrict in, v16* __restrict out,
+                      int in_d, int out_d, bool relu) {
+  const v16 zero = {};
+  int o = 0;
+  for (; o + 4 <= out_d; o += 4) {
+    const float* __restrict w0 = W + static_cast<size_t>(o) * in_d;
+    const float* __restrict w1 = w0 + in_d;
+    const float* __restrict w2 = w1 + in_d;
+    const float* __restrict w3 = w2 + in_d;
+    v16 a0 = splat(B[o]), a1 = splat(B[o + 1]), a2 = splat(B[o + 2]),
+        a3 = splat(B[o + 3]);
+    for (int j = 0; j < in_d; ++j) {
+      const v16 lane = in[j];
+      a0 += w0[j] * lane;
+      a1 += w1[j] * lane;
+      a2 += w2[j] * lane;
+      a3 += w3[j] * lane;
+    }
+    if (relu) {
+      a0 = a0 > zero ? a0 : zero;
+      a1 = a1 > zero ? a1 : zero;
+      a2 = a2 > zero ? a2 : zero;
+      a3 = a3 > zero ? a3 : zero;
+    }
+    out[o] = a0;
+    out[o + 1] = a1;
+    out[o + 2] = a2;
+    out[o + 3] = a3;
+  }
+  for (; o < out_d; ++o) {
+    const float* __restrict wr = W + static_cast<size_t>(o) * in_d;
+    v16 acc = splat(B[o]);
+    for (int j = 0; j < in_d; ++j) acc += wr[j] * in[j];
+    if (relu) acc = acc > zero ? acc : zero;
+    out[o] = acc;
+  }
+}
+
+// Boosted-ensemble eval: per row, every tree descends its D levels in a
+// tight scalar loop over tiny resident arrays (a 100-tree depth-4
+// ensemble is ~400 compare+index steps ≈ 1-2us/row — the gathers don't
+// vectorize with portable vector extensions, and don't need to).
+void host_trees_score(const HostModel* m, const float* rows, int n_rows,
+                      int n_features, float* proba_out) {
+  const int n_int = (1 << m->tree_depth) - 1;
+  const int n_leaf = 1 << m->tree_depth;
+  for (int r = 0; r < n_rows; ++r) {
+    const float* x = rows + static_cast<size_t>(r) * n_features;
+    float acc = m->t_base;
+    for (int t = 0; t < m->n_trees; ++t) {
+      const int32_t* feat = m->t_feat.data() + static_cast<size_t>(t) * n_int;
+      const float* thr = m->t_thr.data() + static_cast<size_t>(t) * n_int;
+      int idx = 0;
+      for (int level = 0; level < m->tree_depth; ++level) {
+        const int32_t f = feat[idx];
+        const float xv = (f >= 0 && f < n_features) ? x[f] : 0.0f;
+        idx = 2 * idx + 1 + (xv > thr[idx] ? 1 : 0);
+      }
+      acc += m->t_leaf[static_cast<size_t>(t) * n_leaf + (idx - n_int)];
+    }
+    proba_out[r] = stable_sigmoid(acc);
+  }
+}
+
+void host_model_score(const HostModel* m, const float* rows, int n_rows,
+                      int n_features, float* proba_out) {
+  if (m->n_trees > 0) {
+    host_trees_score(m, rows, n_rows, n_features, proba_out);
+    return;
+  }
+  int max_d = 0;
+  for (int d : m->dims) max_d = d > max_d ? d : max_d;
+  std::vector<v16> buf0(max_d), buf1(max_d);  // v16 allocations are aligned
+  for (int start = 0; start < n_rows; start += kTile) {
+    const int tr = n_rows - start < kTile ? n_rows - start : kTile;
+    v16* cur = buf0.data();
+    // load transposed (+normalize); pad lanes beyond tr with zeros
+    for (int j = 0; j < m->dims[0]; ++j) {
+      float* lane = reinterpret_cast<float*>(cur + j);
+      const float muj = m->mu.empty() ? 0.0f : m->mu[j];
+      const float isj = m->mu.empty() ? 1.0f : m->inv_sigma[j];
+      for (int t = 0; t < tr; ++t)
+        lane[t] =
+            (rows[static_cast<size_t>(start + t) * n_features + j] - muj) *
+            isj;
+      for (int t = tr; t < kTile; ++t) lane[t] = 0.0f;
+    }
+    v16* nxt = buf1.data();
+    for (int l = 0; l < m->n_layers; ++l) {
+      dense_layer_tile(m->w[l].data(), m->b[l].data(), cur, nxt, m->dims[l],
+                       m->dims[l + 1], l != m->n_layers - 1);
+      v16* tmp = cur;
+      cur = nxt;
+      nxt = tmp;
+    }
+    const float* z = reinterpret_cast<const float*>(cur);
+    for (int t = 0; t < tr; ++t)
+      proba_out[start + t] = stable_sigmoid(z[t]);
+  }
+}
+
+std::string make_response(int status, const char* ctype, const char* body,
+                          size_t body_len) {
+  char head[256];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\n\r\n",
+                   status, reason_of(status), ctype, body_len);
+  std::string out;
+  out.reserve(n + body_len);
+  out.append(head, n);
+  out.append(body, body_len);
+  return out;
+}
+
+void queue_write(Front* f, int fd, std::string data);  // fwd
+
+// Locking discipline: every function below (handle_one_request,
+// queue_write, flush_conn, close_conn) REQUIRES f->mu held by the caller
+// — std::mutex is non-recursive, so nothing here may lock it again.
+
+// Parse one complete request out of c->in; returns false if incomplete.
+bool handle_one_request(Front* f, int fd, Conn* c) {
+  size_t head_end = c->in.find("\r\n\r\n");
+  if (head_end == std::string::npos) {
+    if (c->in.size() > kMaxHead) {
+      queue_write(f, fd, make_response(400, "text/plain", "head too large", 14));
+      c->want_close = true;
+    }
+    return false;
+  }
+  // request line
+  size_t line_end = c->in.find("\r\n");
+  std::string line = c->in.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos) {
+    queue_write(f, fd, make_response(400, "text/plain", "bad request line", 16));
+    c->want_close = true;
+    return false;
+  }
+  std::string method = line.substr(0, sp1);
+  std::string path = sp2 == std::string::npos ? line.substr(sp1 + 1)
+                                              : line.substr(sp1 + 1, sp2 - sp1 - 1);
+  // headers we care about: content-length, authorization, connection
+  size_t content_length = 0;
+  std::string auth_header;
+  bool close_conn = false;
+  size_t pos = line_end + 2;
+  while (pos < head_end) {
+    size_t eol = c->in.find("\r\n", pos);
+    if (eol == std::string::npos || eol > head_end) eol = head_end;
+    size_t colon = c->in.find(':', pos);
+    if (colon != std::string::npos && colon < eol) {
+      std::string key = c->in.substr(pos, colon - pos);
+      for (auto& ch : key) ch = tolower(ch);
+      size_t vstart = colon + 1;
+      while (vstart < eol && (c->in[vstart] == ' ' || c->in[vstart] == '\t'))
+        ++vstart;
+      std::string val = c->in.substr(vstart, eol - vstart);
+      while (!val.empty() && (val.back() == ' ' || val.back() == '\t'))
+        val.pop_back();  // trailing OWS is legal in a field line (RFC 9110)
+      if (key == "content-length") {
+        // a non-numeric length silently read as 0 would leave the body
+        // bytes in the buffer to be parsed as the NEXT request line —
+        // reject like the Python transport does
+        char* endp = nullptr;
+        content_length = strtoul(val.c_str(), &endp, 10);
+        if (val.empty() || endp == val.c_str() || *endp != '\0') {
+          queue_write(f, fd,
+                      make_response(400, "text/plain", "bad content-length", 18));
+          c->want_close = true;
+          return false;
+        }
+      } else if (key == "authorization") {
+        auth_header = val;
+      } else if (key == "connection") {
+        for (auto& ch : val) ch = tolower(ch);
+        close_conn = (val == "close");
+      }
+    }
+    pos = eol + 2;
+  }
+  if (content_length > kMaxBody) {
+    queue_write(f, fd, make_response(413, "text/plain", "body too large", 14));
+    c->want_close = true;
+    return false;
+  }
+  size_t total = head_end + 4 + content_length;
+  if (c->in.size() < total) return false;  // body incomplete
+  std::string body = c->in.substr(head_end + 4, content_length);
+  c->in.erase(0, total);
+  if (close_conn) c->want_close = true;
+  ++f->n_requests;
+
+  // auth gate (Seldon bearer token, reference README.md:372-384)
+  if (!f->auth.empty() && method == "POST" && auth_header != f->auth) {
+    ++f->n_auth_fail;
+    const char* msg = "{\"error\": \"unauthorized\"}";
+    queue_write(f, fd, make_response(401, "application/json", msg, strlen(msg)));
+    return true;
+  }
+
+  bool is_predict_path = false;
+  int path_tag = 0;
+  {
+    std::string p = path;
+    while (!p.empty() && p.back() == '/') p.pop_back();
+    is_predict_path =
+        (p.size() >= 12 && p.compare(p.size() - 12, 12, "/predictions") == 0) ||
+        p == "/predict";
+    if (p == "/predict") path_tag = 1;
+  }
+  if (method == "POST" && is_predict_path) {
+    // canonical payload -> native decode -> host-tier score in THIS thread
+    // (small request + host model set) or the predict queue for Python/
+    // device scoring; anything odd (and anything over the native row cap)
+    // falls through to Python via the misc queue (exact-contract replies)
+    double t0 = now_ms();
+    std::vector<float> rows;
+    int est = 0;
+    for (char ch : body)
+      if (ch == '[') ++est;
+    if (est > 0 && est <= kNativeMaxRows + 1) {
+      rows.resize(static_cast<size_t>(est) * f->n_features);
+      int width = 0;
+      int n = ccfd_decode_ndarray(body.data(), body.size(), rows.data(), est,
+                                  f->n_features, &width);
+      if (n >= 0 && n <= kNativeMaxRows) {
+        if (f->host != nullptr && n <= f->host->max_rows) {
+          // zero-handoff path: parse -> forward -> format, one thread
+          std::vector<float> proba(n > 0 ? n : 1);
+          host_model_score(f->host, rows.data(), n, f->n_features,
+                           proba.data());
+          std::string body_out = format_predict_body(
+              proba.data(), n, f->host->model_name.c_str());
+          queue_write(f, fd, make_response(200, "application/json",
+                                           body_out.data(), body_out.size()));
+          ++f->n_host;
+          double lat_s = (now_ms() - t0) / 1e3;
+          int tag = path_tag ? 1 : 0;
+          if (!f->host_hist[tag].empty()) {
+            f->host_sum[tag] += lat_s;
+            for (size_t i = 0; i < f->lat_ubs.size(); ++i)
+              if (lat_s <= f->lat_ubs[i]) ++f->host_hist[tag][i];
+          }
+          if (n > 0) {
+            const float* lastrow =
+                rows.data() + static_cast<size_t>(n - 1) * f->n_features;
+            f->last_gauges[0] = proba[n - 1];
+            for (int g = 0; g < 3; ++g) {
+              int col = f->host->gauge_cols[g];
+              if (col >= 0 && col < f->n_features)
+                f->last_gauges[g + 1] = lastrow[col];
+            }
+            f->last_gauge_ms = now_ms();
+          }
+          return true;
+        }
+        rows.resize(static_cast<size_t>(n) * f->n_features);
+        int id = f->next_id++;
+        f->req_route[id] = {c->gen, fd};
+        f->predict_q.push_back(
+            {id, fd, c->gen, n, path_tag, std::move(rows), t0});
+        ++f->n_predict;
+        ++c->pending;  // a Connection:close conn must outlive its answers
+        f->cv.notify_all();
+        return true;
+      }
+    }
+  }
+  // misc: Python answers through the shared routing logic
+  int id = f->next_id++;
+  f->req_route[id] = {c->gen, fd};
+  f->misc_q.push_back({id, fd, c->gen, method, path, std::move(body)});
+  ++f->n_misc;
+  ++c->pending;
+  f->cv.notify_all();
+  return true;
+}
+
+void queue_write(Front* f, int fd, std::string data) {
+  auto it = f->conns.find(fd);
+  if (it == f->conns.end()) return;
+  it->second.out += data;
+}
+
+void flush_conn(Front* f, int fd, Conn* c) {
+  while (!c->out.empty()) {
+    ssize_t n = send(fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
+    if (n > 0) {
+      c->out.erase(0, n);
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      // wait for EPOLLOUT; a half-closed conn must not re-arm EPOLLIN
+      // here either (its EOF level-triggers forever -> busy spin)
+      struct epoll_event ev;
+      ev.events = EPOLLOUT | (c->read_closed ? 0 : EPOLLIN);
+      ev.data.fd = fd;
+      epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+      return;
+    } else {
+      c->want_close = true;
+      return;
+    }
+  }
+  struct epoll_event ev;
+  // a half-closed conn must NOT re-arm EPOLLIN: its EOF is permanently
+  // readable and would spin the loop until teardown
+  ev.events = c->read_closed ? 0 : EPOLLIN;
+  ev.data.fd = fd;
+  epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+}
+
+void close_conn(Front* f, int fd) {
+  f->conns.erase(fd);
+  epoll_ctl(f->epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+}
+
+void io_loop(Front* f) {
+  struct epoll_event evs[128];
+  while (true) {
+    int n = epoll_wait(f->epoll_fd, evs, 128, 200);
+    {
+      std::lock_guard<std::mutex> lk(f->mu);
+      if (f->stopping) return;
+      // drain responses queued by scorer/misc threads
+      while (!f->resp_q.empty()) {
+        Response r = std::move(f->resp_q.front());
+        f->resp_q.pop_front();
+        auto it = f->conns.find(r.fd);
+        if (it == f->conns.end() || it->second.gen != r.gen) continue;
+        it->second.out += r.data;
+        if (it->second.pending > 0) --it->second.pending;
+        // the connection is serialized (one Python-bound request in
+        // flight keeps HTTP/1.1 responses in request order): now that
+        // its answer is queued, parse any requests buffered behind it
+        while (it->second.pending == 0 &&
+               handle_one_request(f, r.fd, &it->second)) {
+        }
+      }
+    }
+    for (int i = 0; i < n; ++i) {
+      int fd = evs[i].data.fd;
+      if (fd == f->wake_fd) {
+        uint64_t junk;
+        while (read(f->wake_fd, &junk, 8) == 8) {
+        }
+        continue;
+      }
+      if (fd == f->listen_fd) {
+        while (true) {
+          int cfd = accept(f->listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          struct epoll_event ev;
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(f->epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+          std::lock_guard<std::mutex> lk(f->mu);
+          Conn c;
+          c.gen = f->gen_counter++;
+          f->conns.emplace(cfd, std::move(c));
+        }
+        continue;
+      }
+      auto it = f->conns.find(fd);
+      if (it == f->conns.end()) continue;
+      Conn* c = &it->second;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        std::lock_guard<std::mutex> lk(f->mu);
+        close_conn(f, fd);
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) {
+        char buf[1 << 16];
+        bool peer_closed = false;
+        while (true) {
+          ssize_t r = recv(fd, buf, sizeof(buf), 0);
+          if (r > 0) {
+            c->in.append(buf, r);
+            if (c->in.size() > kMaxBody + kMaxHead) {
+              c->want_close = true;
+              break;
+            }
+          } else if (r == 0) {
+            peer_closed = true;
+            break;
+          } else {
+            break;  // EAGAIN or error
+          }
+        }
+        {
+          std::lock_guard<std::mutex> lk(f->mu);
+          // serialize per connection: HTTP/1.1 requires responses in
+          // request order, and Python-bound requests complete out of
+          // order across the scorer/misc queues — so at most ONE is in
+          // flight per connection; buffered pipelined requests parse
+          // when its response drains (see resp_q loop)
+          while (c->pending == 0 && handle_one_request(f, fd, c)) {
+          }
+        }
+        if (peer_closed) {
+          std::lock_guard<std::mutex> lk(f->mu);
+          auto itc = f->conns.find(fd);
+          if (itc == f->conns.end()) continue;
+          // a half-closing client (shutdown(SHUT_WR) after the request)
+          // still expects its response: defer teardown to the pending/
+          // flush machinery; stop watching EPOLLIN so the permanently
+          // readable EOF doesn't spin the loop
+          itc->second.want_close = true;
+          itc->second.read_closed = true;
+          if (itc->second.pending == 0 && itc->second.out.empty()) {
+            close_conn(f, fd);
+          } else {
+            // stop monitoring entirely while the response is produced:
+            // EPOLLIN would fire forever on the EOF, and EPOLLOUT fires
+            // immediately on an empty out buffer — either way a busy
+            // spin. The resp-drain flush sweep delivers the answer.
+            struct epoll_event ev;
+            ev.events = 0;
+            ev.data.fd = fd;
+            epoll_ctl(f->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+          }
+          continue;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(f->mu);
+        auto it2 = f->conns.find(fd);
+        if (it2 == f->conns.end()) continue;
+        flush_conn(f, fd, &it2->second);
+        if (it2->second.want_close && it2->second.out.empty() &&
+            it2->second.pending == 0)
+          close_conn(f, fd);
+      }
+    }
+    // flush conns that got responses but no epoll event this round, and
+    // retire Connection:close conns whose last pending answer just left
+    std::lock_guard<std::mutex> lk(f->mu);
+    std::vector<int> done;
+    for (auto& kv : f->conns) {
+      if (!kv.second.out.empty()) flush_conn(f, kv.first, &kv.second);
+      if (kv.second.want_close && kv.second.out.empty() &&
+          kv.second.pending == 0)
+        done.push_back(kv.first);
+    }
+    for (int fd : done) close_conn(f, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ccfd_front_create(const char* host, int port, int n_features,
+                        const char* auth_token, int* port_out) {
+  Front* f = new Front();
+  f->n_features = n_features;
+  if (auth_token != nullptr && auth_token[0] != '\0')
+    f->auth = std::string("Bearer ") + auth_token;
+  f->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (f->listen_fd < 0) {
+    delete f;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(f->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host != nullptr && host[0] != '\0' &&
+      strcmp(host, "0.0.0.0") != 0) {
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      close(f->listen_fd);
+      delete f;
+      return nullptr;  // unparseable bind host: caller falls back
+    }
+  }
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(f->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(f->listen_fd, 256) < 0) {
+    close(f->listen_fd);
+    delete f;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(f->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  f->port = ntohs(addr.sin_port);
+  if (port_out != nullptr) *port_out = f->port;
+  set_nonblock(f->listen_fd);
+  f->epoll_fd = epoll_create1(0);
+  f->wake_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.fd = f->listen_fd;
+  epoll_ctl(f->epoll_fd, EPOLL_CTL_ADD, f->listen_fd, &ev);
+  ev.data.fd = f->wake_fd;
+  epoll_ctl(f->epoll_fd, EPOLL_CTL_ADD, f->wake_fd, &ev);
+  f->io_thread = std::thread(io_loop, f);
+  return f;
+}
+
+// Dequeue up to max_reqs predict requests / max_rows total rows as ONE
+// concatenated row block. meta_out: [id, n_rows, path_tag] per request;
+// enq_ms_out: per-request enqueue timestamps (CLOCK_MONOTONIC ms).
+// Returns the number of requests (0 on timeout, -1 when stopping).
+int ccfd_front_take(void* h, float* rows_out, int max_rows, int* meta_out,
+                    double* enq_ms_out, int max_reqs, int timeout_ms) {
+  Front* f = static_cast<Front*>(h);
+  std::unique_lock<std::mutex> lk(f->mu);
+  if (f->predict_q.empty()) {
+    f->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                   [f] { return f->stopping || !f->predict_q.empty(); });
+  }
+  if (f->stopping) return -1;
+  int n_reqs = 0;
+  int rows_used = 0;
+  while (!f->predict_q.empty() && n_reqs < max_reqs) {
+    PredictReq& r = f->predict_q.front();
+    if (rows_used + r.n_rows > max_rows) {
+      if (n_reqs == 0) {
+        // defensive: a request bigger than the taker's whole buffer
+        // (impossible while kNativeMaxRows <= the taker's max_rows, but
+        // a misconfigured caller must not wedge the queue head) — fail
+        // it rather than starve everything behind it
+        const char* msg = "{\"error\": \"request exceeds native batch\"}";
+        Response resp;
+        resp.data = make_response(500, "application/json", msg, strlen(msg));
+        auto it = f->req_route.find(r.id);
+        if (it != f->req_route.end()) {
+          resp.gen = it->second.first;
+          resp.fd = it->second.second;
+          f->req_route.erase(it);
+          f->resp_q.push_back(std::move(resp));
+        }
+        f->predict_q.pop_front();
+        continue;
+      }
+      break;
+    }
+    memcpy(rows_out + static_cast<size_t>(rows_used) * f->n_features,
+           r.rows.data(), r.rows.size() * sizeof(float));
+    meta_out[3 * n_reqs] = r.id;
+    meta_out[3 * n_reqs + 1] = r.n_rows;
+    meta_out[3 * n_reqs + 2] = r.path_tag;
+    enq_ms_out[n_reqs] = r.enq_monotonic_ms;
+    rows_used += r.n_rows;
+    ++n_reqs;
+    f->predict_q.pop_front();
+  }
+  return n_reqs;
+}
+
+// Respond to previously taken predict requests: probas holds one float per
+// row in take() order; C++ formats the Seldon response body per request.
+void ccfd_front_respond(void* h, const int* req_ids, const int* row_counts,
+                        int n_reqs, const float* probas, const char* model) {
+  Front* f = static_cast<Front*>(h);
+  int off = 0;
+  std::vector<Response> ready;
+  ready.reserve(n_reqs);
+  for (int i = 0; i < n_reqs; ++i) {
+    int rows = row_counts[i];
+    std::string body = format_predict_body(probas + off, rows, model);
+    off += rows;
+    Response resp;
+    resp.data = make_response(200, "application/json", body.data(), body.size());
+    ready.push_back(std::move(resp));
+  }
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    for (int i = 0; i < n_reqs; ++i) {
+      auto it = f->req_route.find(req_ids[i]);
+      if (it == f->req_route.end()) continue;
+      ready[i].gen = it->second.first;
+      ready[i].fd = it->second.second;
+      f->req_route.erase(it);
+      f->resp_q.push_back(std::move(ready[i]));
+    }
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(f->wake_fd, &one, 8);
+  (void)ignored;
+}
+
+// Nonblocking take of one misc request (GET /prometheus, non-canonical
+// POST bodies, ...). Returns req id (>0), 0 if none, -1 when stopping.
+// method/path copy into fixed buffers; body via a malloc'd pointer the
+// caller frees with ccfd_front_free.
+int ccfd_front_take_misc(void* h, char* method_out, int method_cap,
+                         char* path_out, int path_cap, char** body_out,
+                         int* body_len_out, int timeout_ms) {
+  Front* f = static_cast<Front*>(h);
+  std::unique_lock<std::mutex> lk(f->mu);
+  if (f->misc_q.empty()) {
+    f->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                   [f] { return f->stopping || !f->misc_q.empty(); });
+  }
+  if (f->stopping) return -1;
+  if (f->misc_q.empty()) return 0;
+  MiscReq r = std::move(f->misc_q.front());
+  f->misc_q.pop_front();
+  snprintf(method_out, method_cap, "%s", r.method.c_str());
+  snprintf(path_out, path_cap, "%s", r.path.c_str());
+  char* body = static_cast<char*>(malloc(r.body.size() + 1));
+  memcpy(body, r.body.data(), r.body.size());
+  body[r.body.size()] = '\0';
+  *body_out = body;
+  *body_len_out = static_cast<int>(r.body.size());
+  return r.id;
+}
+
+void ccfd_front_free(char* p) { free(p); }
+
+void ccfd_front_respond_misc(void* h, int req_id, int status,
+                             const char* ctype, const char* body,
+                             int body_len) {
+  Front* f = static_cast<Front*>(h);
+  Response resp;
+  resp.data = make_response(status, ctype, body, body_len);
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    auto it = f->req_route.find(req_id);
+    if (it == f->req_route.end()) return;
+    resp.gen = it->second.first;
+    resp.fd = it->second.second;
+    f->req_route.erase(it);
+    f->resp_q.push_back(std::move(resp));
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(f->wake_fd, &one, 8);
+  (void)ignored;
+}
+
+void ccfd_front_stats(void* h, long* out4) {
+  Front* f = static_cast<Front*>(h);
+  std::lock_guard<std::mutex> lk(f->mu);
+  out4[0] = f->n_requests;
+  out4[1] = f->n_predict;
+  out4[2] = f->n_misc;
+  out4[3] = f->n_auth_fail;
+}
+
+namespace {
+// Shared install protocol for every host-model family: fill the common
+// fields and swap the pointer under the front's mutex. One copy of the
+// swap discipline — the per-family setters only build their payload.
+void install_host_model(Front* f, HostModel* m, int max_rows,
+                        const char* model_name, const int* gauge_cols) {
+  if (m != nullptr) {
+    m->max_rows = max_rows;
+    m->model_name = model_name != nullptr ? model_name : "model";
+    if (gauge_cols != nullptr)
+      for (int g = 0; g < 3; ++g) m->gauge_cols[g] = gauge_cols[g];
+  }
+  HostModel* old;
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    old = f->host;
+    f->host = m;
+  }
+  delete old;
+}
+}  // namespace
+
+// Install/replace the in-front host-tier model. weights holds the layers
+// concatenated, each (dims[l+1] x dims[l]) ROW-MAJOR — i.e. transposed
+// from the Python (in x out) layout so every output neuron's weights are
+// contiguous. biases likewise concatenated. mean/inv_std are n_features
+// normalizer vectors (both null = identity). gauge_cols: column indices
+// for the Amount/V17/V10 gauges (-1 = absent). n_layers <= 0 or
+// max_rows <= 0 clears the model (requests flow to the Python takers).
+void ccfd_front_set_host_model(void* h, int n_layers, const int* dims,
+                               const float* weights, const float* biases,
+                               const float* mean, const float* inv_std,
+                               int max_rows, const char* model_name,
+                               const int* gauge_cols) {
+  Front* f = static_cast<Front*>(h);
+  HostModel* m = nullptr;
+  if (n_layers > 0 && max_rows > 0) {
+    m = new HostModel();
+    m->n_layers = n_layers;
+    m->dims.assign(dims, dims + n_layers + 1);
+    size_t w_off = 0;
+    size_t b_off = 0;
+    for (int l = 0; l < n_layers; ++l) {
+      size_t w_n = static_cast<size_t>(m->dims[l]) * m->dims[l + 1];
+      m->w.emplace_back(weights + w_off, weights + w_off + w_n);
+      w_off += w_n;
+      m->b.emplace_back(biases + b_off, biases + b_off + m->dims[l + 1]);
+      b_off += m->dims[l + 1];
+    }
+    if (mean != nullptr && inv_std != nullptr) {
+      m->mu.assign(mean, mean + m->dims[0]);
+      m->inv_sigma.assign(inv_std, inv_std + m->dims[0]);
+    }
+  }
+  install_host_model(f, m, max_rows, model_name, gauge_cols);
+}
+
+// Install/replace an in-front boosted-tree ensemble (the tree analog of
+// ccfd_front_set_host_model): feat/thr are (n_trees x 2^depth-1), leaf is
+// (n_trees x 2^depth), heap layout, identical semantics to the XLA
+// evaluator in models/trees.py. n_trees <= 0 or max_rows <= 0 clears.
+void ccfd_front_set_host_trees(void* h, int n_trees, int depth,
+                               const int32_t* feat, const float* thr,
+                               const float* leaf, float base, int max_rows,
+                               const char* model_name,
+                               const int* gauge_cols) {
+  Front* f = static_cast<Front*>(h);
+  HostModel* m = nullptr;
+  if (n_trees > 0 && depth > 0 && max_rows > 0) {
+    m = new HostModel();
+    m->n_trees = n_trees;
+    m->tree_depth = depth;
+    const size_t n_int = (static_cast<size_t>(1) << depth) - 1;
+    const size_t n_leaf = static_cast<size_t>(1) << depth;
+    m->t_feat.assign(feat, feat + n_trees * n_int);
+    m->t_thr.assign(thr, thr + n_trees * n_int);
+    m->t_leaf.assign(leaf, leaf + n_trees * n_leaf);
+    m->t_base = base;
+  }
+  install_host_model(f, m, max_rows, model_name, gauge_cols);
+}
+
+// Latency-histogram bucket layout for host-scored requests; must match the
+// Python registry's histogram so cumulative counts fold 1:1 at scrape.
+void ccfd_front_set_latency_buckets(void* h, const double* ubs, int n) {
+  Front* f = static_cast<Front*>(h);
+  std::lock_guard<std::mutex> lk(f->mu);
+  f->lat_ubs.assign(ubs, ubs + n);
+  for (int tag = 0; tag < 2; ++tag) {
+    f->host_hist[tag].assign(static_cast<size_t>(n), 0);
+    f->host_sum[tag] = 0.0;
+  }
+}
+
+// Cumulative host-scored metrics: out_counts = 2 x n_buckets le-counts
+// (tag 0 then tag 1), out_sums = 2 latency sums, gauges = last
+// proba_1/Amount/V17/V10. Returns n_host; *last_gauge_ms_out is the
+// CLOCK_MONOTONIC ms of the newest host-scored gauge update so the
+// scraper can order it against Python-path gauge writes (same clock as
+// Python's time.monotonic) instead of overwriting newer values.
+long ccfd_front_host_stats(void* h, long* out_counts, double* out_sums,
+                           float* gauges, double* last_gauge_ms_out) {
+  Front* f = static_cast<Front*>(h);
+  std::lock_guard<std::mutex> lk(f->mu);
+  size_t nb = f->lat_ubs.size();
+  for (int tag = 0; tag < 2; ++tag) {
+    for (size_t i = 0; i < nb; ++i)
+      out_counts[tag * nb + i] = f->host_hist[tag].empty()
+                                     ? 0
+                                     : f->host_hist[tag][i];
+    out_sums[tag] = f->host_sum[tag];
+  }
+  for (int g = 0; g < 4; ++g) gauges[g] = f->last_gauges[g];
+  if (last_gauge_ms_out != nullptr) *last_gauge_ms_out = f->last_gauge_ms;
+  return f->n_host;
+}
+
+// Stop serving: wakes takers (they return -1) and joins the IO thread,
+// but does NOT free the Front — Python threads may still be inside
+// take()/take_misc() on this pointer. The caller joins its worker
+// threads and then calls ccfd_front_destroy.
+void ccfd_front_stop(void* h) {
+  Front* f = static_cast<Front*>(h);
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    f->stopping = true;
+    f->cv.notify_all();
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(f->wake_fd, &one, 8);
+  (void)ignored;
+  if (f->io_thread.joinable()) f->io_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    for (auto& kv : f->conns) close(kv.first);
+    f->conns.clear();
+  }
+  close(f->listen_fd);
+  // epoll_fd/wake_fd stay OPEN until destroy: a worker wedged inside a
+  // device dispatch may still call respond() after stop(), and writing
+  // the wake token to a closed (possibly REUSED) fd would inject bytes
+  // into an unrelated stream. An unread eventfd write is harmless.
+}
+
+void ccfd_front_destroy(void* h) {
+  Front* f = static_cast<Front*>(h);
+  close(f->epoll_fd);
+  close(f->wake_fd);
+  delete f->host;
+  delete f;
+}
+
+}  // extern "C"
+
+#else  // !__linux__: stubs — native front unavailable, Python transport used
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+void* ccfd_front_create(const char*, int, int, const char*, int*) {
+  return nullptr;
+}
+int ccfd_front_take(void*, float*, int, int*, double*, int, int) { return -1; }
+void ccfd_front_respond(void*, const int*, const int*, int, const float*,
+                        const char*) {}
+int ccfd_front_take_misc(void*, char*, int, char*, int, char**, int*, int) {
+  return -1;
+}
+void ccfd_front_free(char*) {}
+void ccfd_front_respond_misc(void*, int, int, const char*, const char*, int) {}
+void ccfd_front_stats(void*, long* out4) {
+  out4[0] = out4[1] = out4[2] = out4[3] = 0;
+}
+void ccfd_front_set_host_model(void*, int, const int*, const float*,
+                               const float*, const float*, const float*, int,
+                               const char*, const int*) {}
+void ccfd_front_set_host_trees(void*, int, int, const int32_t*, const float*,
+                               const float*, float, int, const char*,
+                               const int*) {}
+void ccfd_front_set_latency_buckets(void*, const double*, int) {}
+long ccfd_front_host_stats(void*, long*, double*, float*, double*) {
+  return 0;
+}
+void ccfd_front_stop(void*) {}
+void ccfd_front_destroy(void*) {}
+
+}  // extern "C"
+
+#endif  // __linux__

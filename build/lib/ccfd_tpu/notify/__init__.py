@@ -1,0 +1,1 @@
+from ccfd_tpu.notify.service import NotificationService  # noqa: F401

@@ -1,0 +1,1 @@
+from ccfd_tpu.ops.fused_mlp import fold_for_kernel, fused_mlp_score  # noqa: F401

@@ -1,0 +1,3 @@
+from ccfd_tpu.parallel.mesh import make_mesh  # noqa: F401
+from ccfd_tpu.parallel.sharding import batch_spec, mlp_param_spec  # noqa: F401
+from ccfd_tpu.parallel.train import TrainConfig, fit_mlp, make_train_step  # noqa: F401

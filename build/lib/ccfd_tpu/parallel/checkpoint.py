@@ -1,0 +1,107 @@
+"""Model checkpointing (orbax-backed with a numpy fallback).
+
+The reference has no model checkpointing — the classifier is trained
+offline and baked into a container image (SURVEY.md §5, reference
+deploy/model/modelfull.json:24). Online retraining makes checkpoints
+necessary: the serving scorer must survive restarts with its latest
+retrained weights, and retraining must resume from the last step.
+
+Uses ``orbax.checkpoint`` when importable (the production path — async,
+sharding-aware) and falls back to a plain ``.npz`` of the flattened pytree
+otherwise, so checkpointing never becomes an install-time dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _step_dirs(root: str) -> list[tuple[int, str]]:
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for name in os.listdir(root):
+        m = re.fullmatch(r"step_(\d+)", name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+class CheckpointManager:
+    def __init__(self, root: str, keep: int = 3, use_orbax: bool | None = None):
+        self.root = root
+        self.keep = keep
+        os.makedirs(root, exist_ok=True)
+        if use_orbax is None:
+            try:
+                import orbax.checkpoint  # noqa: F401
+
+                use_orbax = True
+            except ImportError:  # pragma: no cover
+                use_orbax = False
+        self.use_orbax = use_orbax
+
+    # -- save -------------------------------------------------------------
+    def save(self, step: int, params: Any) -> str:
+        path = os.path.join(self.root, f"step_{step}")
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.abspath(path), jax.tree.map(np.asarray, params),
+                       force=True)
+        else:
+            os.makedirs(path, exist_ok=True)
+            leaves, treedef = jax.tree.flatten(params)
+            np.savez(
+                os.path.join(path, "params.npz"),
+                **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+            )
+            with open(os.path.join(path, "treedef.json"), "w") as f:
+                json.dump({"n_leaves": len(leaves)}, f)
+        self._gc()
+        return path
+
+    # -- restore ----------------------------------------------------------
+    def latest_step(self) -> int | None:
+        dirs = _step_dirs(self.root)
+        return dirs[-1][0] if dirs else None
+
+    def restore(self, like: Any, step: int | None = None) -> tuple[Any, int] | None:
+        """Restore params structured like ``like``; returns (params, step)."""
+        dirs = _step_dirs(self.root)
+        if not dirs:
+            return None
+        if step is None:
+            step, path = dirs[-1]
+        else:
+            match = [d for d in dirs if d[0] == step]
+            if not match:
+                raise FileNotFoundError(f"no checkpoint for step {step} in {self.root}")
+            step, path = match[0]
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            restored = ckptr.restore(os.path.abspath(path))
+            # orbax returns plain nested containers; rebuild like's structure
+            leaves = jax.tree.leaves(restored)
+            treedef = jax.tree.structure(like)
+            return jax.tree.unflatten(treedef, leaves), step
+        data = np.load(os.path.join(path, "params.npz"))
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        treedef = jax.tree.structure(like)
+        return jax.tree.unflatten(treedef, leaves), step
+
+    def _gc(self) -> None:
+        dirs = _step_dirs(self.root)
+        for _, path in dirs[: -self.keep] if self.keep else []:
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,39 @@
+"""Device-mesh construction for sharded scoring and retraining.
+
+The reference scales by Kafka partitions and k8s replicas (SURVEY.md §2,
+"Parallelism strategies"); the TPU-native analog is a 2-D
+``jax.sharding.Mesh`` over the pod:
+
+- axis ``"data"`` — batch shards (data parallelism): each chip scores or
+  trains on its slice of the micro-batch; gradient psum rides the ICI.
+- axis ``"model"`` — hidden-dimension shards (tensor parallelism) for wide
+  models; matmul partials reduce over ICI.
+
+For the tabular CCFD models the data axis does nearly all the work
+(BASELINE.json configs[4]: "SGD on TPU, pmap over v5e-4" — here expressed
+as pjit over the data axis); the model axis exists so the same code drives
+wide-MLP experiments and validates the collective layout.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+import jax
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: list | None = None, model_parallel: int = 1
+) -> Mesh:
+    """(n/model_parallel) x model_parallel mesh over the given devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallel={model_parallel}"
+        )
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,127 @@
+"""Multi-host (multi-process) distributed runtime: DCN x ICI meshes.
+
+The reference's only distributed backend is Kafka + REST across pods
+(SURVEY.md §2 "Distributed communication backend"); its scale-out story is
+k8s replicas. The TPU-native equivalent is a *single logical program* over
+a multi-host TPU slice: one JAX process per host, `jax.distributed`
+coordination over DCN, and XLA collectives over ICI within the slice. This
+module owns that bring-up:
+
+- ``initialize()`` — idempotent ``jax.distributed.initialize`` wrapper,
+  driven by env (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID, the
+  k8s-operator shape) or explicit args. No-op for single-process runs, so
+  every entry point can call it unconditionally.
+- ``make_global_mesh()`` — (hosts*local) devices arranged so the data axis
+  spans hosts (gradient all-reduce crosses DCN once per step, the cheap
+  direction) and the model axis stays *inside* a host's ICI domain (tensor-
+  parallel collectives every matmul must never cross DCN).
+- ``process_local_batch_to_global()`` — wraps
+  ``jax.make_array_from_process_local_data``: each host feeds its own
+  Kafka-partition slice, and the result is one global jit argument. This is
+  the bridge between the per-host streaming plane (bus consumers) and the
+  single-program TPU plane.
+
+Design note: axis order follows the scaling-book recipe — outermost mesh
+axis = slowest network (DCN), innermost = fastest (ICI) — so XLA's
+collective lowering matches the physical topology.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ccfd_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host job if configured; returns True if distributed.
+
+    Env contract (matching the 12-factor surface of the rest of the
+    framework): COORDINATOR_ADDRESS (host:port of process 0),
+    NUM_PROCESSES, PROCESS_ID. All three unset -> single-process no-op.
+    Safe to call more than once.
+    """
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS", ""
+    )
+    if num_processes is None:
+        num_processes = int(os.environ.get("NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        process_id = int(os.environ.get("PROCESS_ID", "-1") or -1)
+
+    if not coordinator_address or num_processes <= 1:
+        return False
+    if _initialized:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id if process_id >= 0 else None,
+    )
+    _initialized = True
+    return True
+
+
+def make_global_mesh(model_parallel: int = 1, devices: list | None = None) -> Mesh:
+    """Global (data, model) mesh over every device in the job.
+
+    The device grid is laid out host-major: reshaping
+    ``(num_hosts, local_count)`` then splitting the *local* factor into
+    (local_data, model) keeps each model-parallel group entirely within one
+    host's ICI domain, while the data axis tiles across hosts over DCN.
+    With one host this reduces exactly to ``mesh.make_mesh``.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+
+    # sort host-major so contiguous rows share a host (jax.devices() already
+    # groups by process; be explicit for safety)
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    counts: dict[int, int] = {}
+    for d in devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    local = min(counts.values()) if counts else n
+    if local % model_parallel != 0:
+        raise ValueError(
+            f"model_parallel={model_parallel} does not divide per-host device "
+            f"count {local}; tensor-parallel groups must not span DCN"
+        )
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-sharded batch over the data axis (features replicated)."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def process_local_batch_to_global(mesh: Mesh, local_batch: np.ndarray) -> jax.Array:
+    """Assemble each host's local rows into one globally-sharded array.
+
+    Per-host Kafka consumers each decode their partitions into
+    ``local_batch``; the returned array is a valid argument to a jitted
+    step sharded with ``batch_sharding(mesh)``. The global batch dimension
+    is ``num_processes * local_rows`` — all hosts must pad their poll to the
+    same bucket size (the scorer's fixed-shape contract already does this).
+    """
+    return jax.make_array_from_process_local_data(
+        batch_sharding(mesh), np.asarray(local_batch)
+    )
+
+
+def global_batch_size(mesh: Mesh, per_device_rows: int) -> int:
+    """Rows per jit dispatch across the whole job (static-shape planning)."""
+    return per_device_rows * mesh.devices.shape[0]

@@ -1,0 +1,159 @@
+"""Online retraining loop: process-engine labels -> sharded SGD -> hot swap.
+
+BASELINE.json configs[4]: "Online retrain from jBPM human-task labels (SGD
+on TPU, pmap over v5e-4)". The loop:
+
+1. consume label events from the bus (published by the fraud process on
+   resolution — ccfd_tpu/process/fraud.py ``record``),
+2. accumulate a replay buffer; once ``retrain_min_labels`` are available,
+   run train steps on ``retrain_batch``-row batches through the
+   mesh-sharded train step (ccfd_tpu/parallel/train.make_train_step),
+3. checkpoint and publish the new params into the serving scorer with
+   ``Scorer.swap_params`` — double-buffered, serving never pauses.
+
+Labels are rare relative to traffic (only resolved fraud processes emit
+them), so the buffer is a reservoir over the last ``buffer_size`` labels
+and every retrain epoch resamples from it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.parallel.checkpoint import CheckpointManager
+from ccfd_tpu.parallel.train import TrainConfig, init_state, make_train_step
+from ccfd_tpu.serving.scorer import Scorer
+
+
+class OnlineTrainer:
+    def __init__(
+        self,
+        cfg: Config,
+        broker: Broker,
+        scorer: Scorer,
+        params: Any,
+        tc: TrainConfig | None = None,
+        mesh=None,
+        registry: Registry | None = None,
+        checkpoints: CheckpointManager | None = None,
+        buffer_size: int = 65536,
+        steps_per_round: int = 8,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.broker = broker
+        self.scorer = scorer
+        self.tc = tc or TrainConfig()
+        self.mesh = mesh
+        self.registry = registry or Registry()
+        self.checkpoints = checkpoints
+        self.buffer_size = buffer_size
+        self.steps_per_round = steps_per_round
+        self._rng = np.random.default_rng(seed)
+
+        self._consumer = broker.consumer("online-trainer", (cfg.labels_topic,))
+        self._X = np.zeros((0, len(FEATURE_NAMES)), np.float32)
+        self._y = np.zeros((0,), np.float32)
+        # fresh buffers: the train step donates its state, so it must never
+        # alias the pytree the serving scorer holds
+        self._state = init_state(jax.tree.map(lambda a: jnp.array(a, copy=True), params), self.tc)
+        self._new_labels = 0
+        self._step_fn = make_train_step(self.tc, mesh=mesh)
+        self._stop = threading.Event()
+
+        r = self.registry
+        self._c_labels = r.counter("retrain_labels_total", "labels consumed by class")
+        self._c_steps = r.counter("retrain_steps_total", "optimizer steps run")
+        self._c_swaps = r.counter("retrain_param_swaps_total", "serving hot swaps")
+        self._g_loss = r.gauge("retrain_last_loss", "loss of last retrain step")
+
+    # -- label ingestion ---------------------------------------------------
+    def _ingest(self, max_records: int = 4096) -> int:
+        records = self._consumer.poll(max_records, 0.0)
+        if not records:
+            return 0
+        rows, labels = [], []
+        for rec in records:
+            msg = rec.value or {}
+            tx = msg.get("transaction") or {}
+            try:  # parse the full record before appending anything: a partial
+                # failure must not desynchronize the (X, y) pairing
+                row = [float(tx.get(n, 0.0) or 0.0) for n in FEATURE_NAMES]
+                label = float(msg.get("label", 0))
+            except (TypeError, ValueError):
+                continue
+            rows.append(row)
+            labels.append(label)
+            self._c_labels.inc(
+                labels={"class": "fraud" if label > 0.5 else "legit"}
+            )
+        if not rows:
+            return 0
+        self._X = np.concatenate([self._X, np.asarray(rows, np.float32)])[
+            -self.buffer_size :
+        ]
+        self._y = np.concatenate([self._y, np.asarray(labels, np.float32)])[
+            -self.buffer_size :
+        ]
+        return len(rows)
+
+    # -- one retrain round -------------------------------------------------
+    def step(self) -> bool:
+        """Ingest labels; train + swap only when NEW labels arrived and the
+        buffer is warm. Returns whether a swap happened (so the run loop
+        sleeps instead of re-training a stale buffer in a tight loop)."""
+        self._new_labels += self._ingest()
+        if len(self._y) < self.cfg.retrain_min_labels or self._new_labels == 0:
+            return False
+        self._new_labels = 0
+        batch = min(self.cfg.retrain_batch, len(self._y))
+        loss = None
+        for _ in range(self.steps_per_round):
+            idx = self._rng.integers(0, len(self._y), size=batch)
+            x = jnp.asarray(self._X[idx])
+            y = jnp.asarray(self._y[idx])
+            self._state, loss = self._step_fn(self._state, x, y)
+            self._c_steps.inc()
+        if loss is not None:
+            self._g_loss.set(float(loss))
+        new_params = self._state["params"]
+        self.scorer.swap_params(new_params)
+        self._c_swaps.inc()
+        if self.checkpoints is not None:
+            self.checkpoints.save(int(self._state["step"]), new_params)
+        return True
+
+    # -- daemon ------------------------------------------------------------
+    def reset(self) -> None:
+        """Re-arm after stop(); called by the supervisor before respawn
+        (clearing inside run() would race a concurrent stop())."""
+        self._stop.clear()
+
+    def run(self, interval_s: float = 1.0) -> None:
+        while not self._stop.is_set():
+            if not self.step():
+                self._stop.wait(interval_s)
+
+    def start(self, interval_s: float = 1.0) -> threading.Thread:
+        self.reset()
+        t = threading.Thread(
+            target=self.run, args=(interval_s,), daemon=True, name="ccfd-retrain"
+        )
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.stop()
+        self._consumer.close()

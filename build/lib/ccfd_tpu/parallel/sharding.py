@@ -1,0 +1,77 @@
+"""Sharding specs: how model params and batches lay out over the mesh.
+
+Scaling-book-style megatron layout for the MLP
+(x -> relu(x W1) -> relu(h W2) -> h W3):
+
+- W1 (F, H): column-sharded  P(None, "model") — each chip owns H/tp columns,
+  activations come out sharded on the hidden dim; no collective needed.
+- W2 (H, H): row+column -> keep hidden sharded: P("model", None) makes each
+  chip contract its hidden slice; XLA inserts the psum (reduce over ICI),
+  and the result is resharded to P(..., "model") for the next layer by the
+  output constraint.
+- W3 (H, 1): row-sharded P("model", None) — final psum produces replicated
+  logits.
+- biases on hidden dims follow their activation sharding; scalars replicate.
+- batches shard over "data": P("data", None).
+
+The specs are *constraints*; XLA's SPMD partitioner chooses the collective
+schedule (all-gather vs reduce-scatter fusion) — exactly the "annotate and
+let XLA insert collectives" recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ccfd_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def batch_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def label_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mlp_param_spec(params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedSharding matching ccfd_tpu.models.mlp param structure."""
+
+    def spec_for_layer(i: int, n_layers: int, leaf_name: str) -> P:
+        if leaf_name == "w":
+            if i == 0:
+                return P(None, MODEL_AXIS)  # column-parallel in
+            if i == n_layers - 1:
+                return P(MODEL_AXIS, None)  # row-parallel out
+            return P(MODEL_AXIS, None)  # contract sharded hidden
+        # biases: hidden-dim biases follow activation sharding; final tiny
+        # bias replicates.
+        if i == n_layers - 1:
+            return P()
+        return P(MODEL_AXIS) if i == 0 else P()
+
+    n_layers = len(params["layers"])
+    layers = [
+        {
+            "w": NamedSharding(mesh, spec_for_layer(i, n_layers, "w")),
+            "b": NamedSharding(mesh, spec_for_layer(i, n_layers, "b")),
+        }
+        for i in range(n_layers)
+    ]
+    rep = NamedSharding(mesh, P())
+    return {
+        "norm": {"mu": rep, "sigma": rep},
+        "layers": layers,
+    }
+
+
+def shard_params(params: Any, spec: Any) -> Any:
+    """device_put the param pytree with the given sharding pytree."""
+    return jax.tree.map(jax.device_put, params, spec)

@@ -1,0 +1,203 @@
+"""Sharded training: the online-retrain capability (BASELINE.json configs[4]).
+
+The reference never trains in-cluster — its model is trained offline and
+baked into a container (SURVEY.md §5 "Checkpoint / resume"). The TPU build
+upgrades this to first-class online retraining: SGD on process-engine
+labels, pjit-sharded over the device mesh (data-parallel gradients psum
+over ICI; optional tensor-parallel hidden dims), with the optimizer state
+sharded like the params so nothing is replicated that doesn't have to be.
+
+``make_train_step`` builds ONE jitted step covering forward + weighted-BCE
+loss + backward + optax update, with explicit NamedShardings in/out and
+donated state buffers — the whole step is a single XLA executable per batch
+shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ccfd_tpu.models import mlp
+from ccfd_tpu.parallel.mesh import DATA_AXIS
+from ccfd_tpu.parallel.sharding import batch_spec, label_spec, mlp_param_spec
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 1e-3
+    momentum: float = 0.9
+    pos_weight: float = 8.0  # up-weight the rare fraud class
+    compute_dtype: str = "bfloat16"
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    return optax.sgd(tc.learning_rate, momentum=tc.momentum)
+
+
+def init_state(params: Any, tc: TrainConfig) -> dict[str, Any]:
+    return {
+        "params": params,
+        "opt_state": make_optimizer(tc).init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def make_train_step(
+    tc: TrainConfig,
+    mesh: Mesh | None = None,
+    loss_fn: Callable[..., jax.Array] | None = None,
+) -> Callable[[dict, jax.Array, jax.Array], tuple[dict, jax.Array]]:
+    """Jitted (state, x, y) -> (state, loss). With a mesh, the step is pjit-
+    sharded: batch over "data", params/opt-state per mlp_param_spec, donated
+    state. Without a mesh, a plain single-device jit."""
+    dtype = jnp.bfloat16 if tc.compute_dtype == "bfloat16" else jnp.float32
+    base_loss = loss_fn or (
+        lambda p, x, y: mlp.loss_fn(p, x, y, pos_weight=tc.pos_weight, compute_dtype=dtype)
+    )
+    optimizer = make_optimizer(tc)
+
+    def step(state: dict, x: jax.Array, y: jax.Array) -> tuple[dict, jax.Array]:
+        loss, grads = jax.value_and_grad(base_loss)(state["params"], x, y)
+        updates, opt_state = optimizer.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    def state_shardings(state: dict) -> dict:
+        pspec = mlp_param_spec(state["params"], mesh)
+        return {
+            "params": pspec,
+            # optimizer state embeds param-shaped leaves (momentum traces):
+            # shard those like their params, replicate scalars/counters
+            "opt_state": _opt_spec_like(state["opt_state"], state["params"], pspec, mesh),
+            "step": NamedSharding(mesh, P()),
+        }
+
+    compiled: dict[str, Callable] = {}
+
+    def wrapped(state: dict, x: jax.Array, y: jax.Array):
+        if "fn" not in compiled:
+            shardings = state_shardings(state)
+            compiled["fn"] = jax.jit(
+                step,
+                in_shardings=(shardings, batch_spec(mesh), label_spec(mesh)),
+                out_shardings=(shardings, NamedSharding(mesh, P())),
+                donate_argnums=(0,),
+            )
+        return compiled["fn"](state, x, y)
+
+    return wrapped
+
+
+def _opt_spec_like(opt_state: Any, params: Any, pspec: Any, mesh: Mesh) -> Any:
+    """Optax states embed param-*structured* subtrees (momentum traces);
+    shard those exactly like the params, replicate everything else
+    (step counters, scalars). Matching is structural, not by shape — two
+    same-shaped params can have different shardings."""
+    ptree = jax.tree.structure(params)
+    rep = NamedSharding(mesh, P())
+
+    def is_param_like(node: Any) -> bool:
+        try:
+            return jax.tree.structure(node) == ptree
+        except TypeError:  # pragma: no cover - unhashable exotic nodes
+            return False
+
+    return jax.tree.map(
+        lambda node: pspec if is_param_like(node) else rep,
+        opt_state,
+        is_leaf=is_param_like,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Convenience offline trainer (model prep for serving/bench)
+
+
+def fit_mlp(
+    X: np.ndarray,
+    y: np.ndarray,
+    hidden: int = mlp.DEFAULT_HIDDEN,
+    steps: int = 500,
+    batch: int = 1024,
+    tc: TrainConfig | None = None,
+    seed: int = 0,
+    mesh: Mesh | None = None,
+    balance_below: float = 0.05,
+) -> Any:
+    """Train the flagship MLP on (X, y); returns trained params.
+
+    Heavily-imbalanced data (the real table runs 0.17% positive — a uniform
+    1024-row batch carries ~1.7 frauds) trains with CLASS-BALANCED batches
+    (25% positive) plus an exact log-odds recalibration of the output bias
+    for the sampling ratio, so ranking quality comes from a strong gradient
+    signal while ``proba_1`` stays calibrated to the true base rate (the
+    FRAUD_THRESHOLD contract reads absolute probabilities). Kicks in
+    whenever the positive rate is under ``balance_below`` (5%) — which
+    includes the 1%-positive default synthetic stream, so demo and
+    serve-``--train`` flows serve base-rate-calibrated probabilities now
+    (previously their proba_1 ran ~pos_weight-inflated against
+    FRAUD_THRESHOLD); datasets at or above 5% positives train as before.
+    """
+    tc = tc or TrainConfig()
+    key = jax.random.PRNGKey(seed)
+    params = mlp.init(key, num_features=X.shape[1], hidden=hidden)
+    params = mlp.set_normalizer(params, X.mean(0), X.std(0))
+    state = init_state(params, tc)
+    step_fn = make_train_step(tc, mesh=mesh)
+    rng = np.random.default_rng(seed)
+    n = X.shape[0]
+    bsz = min(batch, n)
+    pos_idx = np.flatnonzero(y == 1)
+    p_true = len(pos_idx) / max(1, n)
+    balanced = 0 < p_true < balance_below and len(pos_idx) >= 2
+    q = 0.25  # positive fraction per balanced batch
+    n_pos_b = max(1, int(bsz * q))
+    neg_idx = np.flatnonzero(y == 0) if balanced else None
+    for _ in range(steps):
+        if balanced:
+            idx = np.concatenate([
+                rng.choice(pos_idx, size=n_pos_b, replace=True),
+                rng.choice(neg_idx, size=bsz - n_pos_b, replace=True),
+            ])
+        else:
+            idx = rng.integers(0, n, size=bsz)
+        state, _ = step_fn(
+            state, jnp.asarray(X[idx], jnp.float32), jnp.asarray(y[idx], jnp.float32)
+        )
+    params = jax.tree.map(lambda a: a, state["params"])  # detach from donation
+    if balanced:
+        # exact prior correction for logistic models trained at sampling
+        # rate q but deployed at base rate p: shift the output logit by
+        # -[logit(q) - logit(p)] (King & Zeng 2001 rare-events correction).
+        # The loss's pos_weight multiplies positive-class odds the same
+        # multiplicative way, so it folds into the same offset — without
+        # the log(w) term, proba_1 would serve ~w-times-inflated odds
+        # against the FRAUD_THRESHOLD absolute-probability contract.
+        q_eff = n_pos_b / bsz
+        off = float(
+            np.log(max(1e-9, tc.pos_weight))
+            + np.log(q_eff / (1 - q_eff))
+            - np.log(p_true / (1 - p_true))
+        )
+        layers = list(params["layers"])
+        last = dict(layers[-1])
+        last["b"] = last["b"] - off
+        layers[-1] = last
+        params = dict(params)
+        params["layers"] = layers
+    return params

@@ -1,0 +1,1 @@
+from ccfd_tpu.platform.operator import Platform, PlatformSpec  # noqa: F401

@@ -1,0 +1,561 @@
+"""Platform operator: CR-shaped spec -> running pipeline, in run-book order.
+
+The reference is deployed by an OpenDataHub operator CR whose spec toggles
+each platform component (Seldon, Kafka, monitoring, notebooks — reference
+deploy/frauddetection_cr.yaml:1-89) followed by a 600-line run-book whose
+step order is a dependency sort (reference README.md:44-537; SURVEY.md §3 D:
+project → operator → Kafka → Ceph/S3 → model → data → KIE → notification →
+router → producer → monitoring). This module is both: a declarative spec
+(`PlatformSpec`, loadable from a CR-shaped YAML) and the operator that
+brings components up in that topological order with readiness gates between
+steps, running every long-lived service under the runtime Supervisor
+(restart-on-crash) with health probes and a single Prometheus exporter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Any, Mapping
+
+from ccfd_tpu.config import Config
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentSpec:
+    enabled: bool = True
+    options: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def opt(self, key: str, default: Any = None) -> Any:
+        return self.options.get(key, default)
+
+
+_COMPONENTS = (
+    "store",      # Ceph/S3 analog (L0)
+    "bus",        # Strimzi Kafka analog (L2)
+    "scorer",     # Seldon model serving (L4)
+    "engine",     # KIE server (L5)
+    "notify",     # notification service (L6)
+    "router",     # Camel router (L3)
+    "producer",   # Kafka producer (L1) — one-shot job semantics
+    "retrain",    # online retrain (new; BASELINE.json configs[4])
+    "analytics",  # batch analytics + drift (JupyterHub/Spark analog,
+                  # reference frauddetection_cr.yaml:7-53)
+    "monitoring", # Prometheus exporter (L7)
+    "health",     # runtime probes (platform)
+    "chaos",      # seeded fault injection (new; no reference analog)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlatformSpec:
+    components: Mapping[str, ComponentSpec]
+    cfg: Config
+
+    @staticmethod
+    def from_cr(cr: Mapping[str, Any], cfg: Config | None = None) -> "PlatformSpec":
+        """Parse a CR-shaped mapping: top-level ``spec`` holds one block per
+        component (the frauddetection_cr.yaml shape), each with ``enabled``
+        plus free-form options."""
+        spec = cr.get("spec", cr)
+        comps: dict[str, ComponentSpec] = {}
+        for name in _COMPONENTS:
+            block = spec.get(name, {})
+            if isinstance(block, bool):
+                block = {"enabled": block}
+            comps[name] = ComponentSpec(
+                # absent blocks default on, EXCEPT: producer/store (traffic
+                # and data sources are explicit choices) and chaos (fault
+                # injection must always be opt-in)
+                enabled=bool(
+                    block.get(
+                        "enabled", name not in ("producer", "store", "chaos")
+                    )
+                ),
+                options={k: v for k, v in block.items() if k != "enabled"},
+            )
+        return PlatformSpec(components=comps, cfg=cfg or Config.from_env())
+
+    @staticmethod
+    def from_yaml(path: str, cfg: Config | None = None) -> "PlatformSpec":
+        import yaml
+
+        with open(path) as f:
+            return PlatformSpec.from_cr(yaml.safe_load(f) or {}, cfg=cfg)
+
+    def component(self, name: str) -> ComponentSpec:
+        return self.components.get(name, ComponentSpec(enabled=False))
+
+
+class Platform:
+    """Brings a PlatformSpec up/down; owns every component's lifecycle."""
+
+    def __init__(self, spec: PlatformSpec):
+        self.spec = spec
+        self.cfg = spec.cfg
+        self.registries: dict[str, Any] = {}
+        self.supervisor = None
+        self.broker = None
+        self.scorer = None
+        self.engine = None
+        self.usertask_model = None
+        self.engine_server = None
+        self.engine_port = None
+        self.store_server = None
+        self.prediction_server = None
+        self.prediction_host = "127.0.0.1"
+        self.prediction_port = 0
+        self.exporter = None
+        self.health_server = None
+        self.chaos = None
+        self._producer_done = threading.Event()
+        self._up = False
+
+    # -- bring-up, in the run-book's dependency order ---------------------
+    def up(self, wait_ready_s: float = 30.0) -> "Platform":
+        from ccfd_tpu.runtime.supervisor import Supervisor
+
+        if self._up:
+            return self
+        spec, cfg = self.spec, self.cfg
+        self.supervisor = Supervisor()
+
+        # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
+        if spec.component("store").enabled:
+            self._up_store()
+
+        # 2. bus (Kafka, README.md:87-134)
+        if spec.component("bus").enabled:
+            from ccfd_tpu.bus.broker import Broker
+
+            bus_spec = spec.component("bus")
+            log_dir = bus_spec.opt("log_dir", "") or None
+            self.broker = Broker(
+                default_partitions=int(bus_spec.opt("partitions", 3)),
+                log_dir=log_dir,
+                fsync=bool(bus_spec.opt("fsync", False)),
+            )
+        else:
+            needs_bus = [
+                n for n in ("engine", "notify", "router", "retrain",
+                            "analytics", "producer")
+                if spec.component(n).enabled
+            ]
+            if needs_bus:
+                raise ValueError(
+                    f"bus disabled in CR but required by: {needs_bus}"
+                )
+
+        # 3. model serving (Seldon, README.md:271-301)
+        if spec.component("scorer").enabled:
+            self._up_scorer()
+
+        # 4. process engine (KIE, README.md:345-408)
+        if spec.component("engine").enabled:
+            self._up_engine()
+
+        # 5. notification service (README.md:410-422)
+        if spec.component("notify").enabled:
+            self._up_notify()
+
+        # 6. router (README.md:424-459)
+        if spec.component("router").enabled:
+            self._up_router()
+
+        # 7. online retrain (new capability; BASELINE.json configs[4])
+        if spec.component("retrain").enabled and self.scorer is not None:
+            self._up_retrain()
+
+        # 7b. analytics / drift monitor (notebooks+spark analog,
+        #     reference frauddetection_cr.yaml:7-53)
+        if spec.component("analytics").enabled:
+            self._up_analytics()
+
+        # 8. monitoring (README.md:487-537)
+        if spec.component("monitoring").enabled:
+            from ccfd_tpu.metrics.exporter import MetricsExporter
+
+            mon = spec.component("monitoring")
+            self.exporter = MetricsExporter(
+                self.registries,
+                host=mon.opt("host", "127.0.0.1"),
+                port=int(mon.opt("port", 0)),
+            ).start()
+
+        if spec.component("health").enabled:
+            from ccfd_tpu.runtime.health import HealthServer
+
+            h = spec.component("health")
+            self.health_server = HealthServer(
+                self.supervisor,
+                host=h.opt("host", "127.0.0.1"),
+                port=int(h.opt("port", 0)),
+            ).start()
+
+        self.supervisor.start()
+        if not self.supervisor.wait_ready(timeout_s=wait_ready_s):
+            raise TimeoutError(
+                f"platform not ready after {wait_ready_s}s: "
+                f"{self.supervisor.status()}"
+            )
+
+        # 9. producer last (README.md:461-485) — starts the traffic
+        if spec.component("producer").enabled:
+            self._up_producer()
+
+        # 10. chaos (opt-in; no reference analog): seeded fault injection
+        # over the supervised services, so recovery machinery is exercised
+        # continuously instead of trusted
+        if spec.component("chaos").enabled:
+            from ccfd_tpu.runtime.chaos import ChaosMonkey
+
+            c = spec.component("chaos")
+            targets = c.opt("targets", None)
+            self.chaos = ChaosMonkey(
+                self.supervisor,
+                interval_s=float(c.opt("interval_s", 30.0)),
+                seed=int(c.opt("seed", 0)),
+                targets=list(targets) if targets else None,
+                registry=self._registry("chaos"),
+            ).start()
+
+        self._up = True
+        return self
+
+    # -- per-component builders -------------------------------------------
+    def _registry(self, name: str):
+        from ccfd_tpu.metrics.prom import Registry
+
+        if name not in self.registries:
+            self.registries[name] = Registry()
+            if self.exporter is not None:  # registries created post-start
+                self.exporter.add(name, self.registries[name])
+        return self.registries[name]
+
+    def _up_store(self) -> None:
+        from ccfd_tpu.data.ccfd import load_dataset, to_csv_bytes
+        from ccfd_tpu.store.objectstore import Credentials, ObjectStore
+        from ccfd_tpu.store.server import StoreServer
+
+        c = self.spec.component("store")
+        cfg = self.cfg
+        store = ObjectStore(root=c.opt("root"))
+        store.add_credentials(
+            Credentials(
+                cfg.access_key_id or "ccfd-access",
+                cfg.secret_access_key or "ccfd-secret",
+            )
+        )
+        store.create_bucket(cfg.s3_bucket)
+        if c.opt("seed_dataset", True):
+            try:
+                store.get(cfg.s3_bucket, cfg.filename)
+            except Exception:  # noqa: BLE001 — absent: upload (README.md:303-343)
+                store.put(cfg.s3_bucket, cfg.filename, to_csv_bytes(load_dataset()))
+        self.store_server = StoreServer(
+            store, host=c.opt("host", "127.0.0.1"), port=int(c.opt("port", 0))
+        ).start()
+        # repoint the producer's endpoint at the live store
+        self.cfg = dataclasses.replace(
+            self.cfg,
+            s3_endpoint=self.store_server.endpoint,
+            access_key_id=self.cfg.access_key_id or "ccfd-access",
+            secret_access_key=self.cfg.secret_access_key or "ccfd-secret",
+        )
+
+    def _up_scorer(self) -> None:
+        from ccfd_tpu.serving.scorer import Scorer
+
+        c = self.spec.component("scorer")
+        cfg = self.cfg
+        params = None
+        if c.opt("train_steps", 0):
+            from ccfd_tpu.data.ccfd import load_dataset
+            from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+
+            ds = load_dataset()
+            params = fit_mlp(
+                ds.X, ds.y, steps=int(c.opt("train_steps")),
+                tc=TrainConfig(compute_dtype="float32"),
+            )
+        self.scorer = Scorer(
+            model_name=c.opt("model", cfg.model_name),
+            params=params,
+            compute_dtype=c.opt("dtype", cfg.compute_dtype),
+            batch_sizes=cfg.batch_sizes,
+            host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
+            dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms(),
+        )
+        self.scorer.warmup()
+        if c.opt("rest", False):
+            from ccfd_tpu.serving.server import PredictionServer
+
+            self.prediction_server = PredictionServer(
+                self.scorer, self.cfg, self._registry("seldon")
+            )
+            self.prediction_host = c.opt("host", "127.0.0.1")
+            self.prediction_port = self.prediction_server.start(
+                self.prediction_host, int(c.opt("port", 0))
+            )
+
+    def _up_engine(self) -> None:
+        from ccfd_tpu.process.fraud import build_engine
+        from ccfd_tpu.process.prediction import ScorerPredictionService
+
+        c = self.spec.component("engine")
+        listener = None
+        if c.opt("usertask_model", False):
+            # dedicated learned user-task model (the reference's second
+            # Seldon model, README.md:347-353): trains on investigator
+            # decisions, replaces the fraud-scorer-backed service
+            from ccfd_tpu.process.usertask_model import OnlineUserTaskModel
+
+            self.usertask_model = OnlineUserTaskModel(
+                min_examples=int(c.opt("usertask_min_examples", 32)),
+            )
+            self._usertask_state_file = c.opt("usertask_state_file", "") or None
+            if self._usertask_state_file and os.path.exists(self._usertask_state_file):
+                self.usertask_model.load(self._usertask_state_file)
+            pred = self.usertask_model
+            listener = self.usertask_model.observe
+        else:
+            pred = (
+                ScorerPredictionService(self.scorer.score)
+                if self.scorer is not None
+                else None
+            )
+        self.engine = build_engine(
+            self.cfg, self.broker, self._registry("kie"), prediction_service=pred,
+            task_listener=listener,
+        )
+        # jBPM-style engine persistence: restore process state across
+        # restarts (overdue timers fire promptly after restore)
+        state_file = c.opt("state_file", "")
+        self._engine_state_file = state_file or None
+        if state_file and os.path.exists(state_file):
+            self.engine.load(state_file)
+        if state_file or getattr(self, "_usertask_state_file", None):
+            # periodic checkpoint: a crash between saves loses at most
+            # save_interval_s of process state — save-on-down alone would
+            # lose everything exactly when persistence matters (SIGKILL/OOM)
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            interval = float(c.opt("save_interval_s", 5.0))
+            stop = threading.Event()
+
+            def checkpoint_loop() -> None:
+                while not stop.wait(interval):
+                    self._save_engine_state()
+
+            self.supervisor.add_thread_service(
+                "engine-persist", checkpoint_loop, stop.set,
+                policy=RestartPolicy.ALWAYS, reset=stop.clear,
+            )
+        if c.opt("rest", False):
+            # KIE-shaped REST surface (reference :8090, README.md:509-515).
+            # Started strictly AFTER the snapshot restore: an early remote
+            # start_process would populate the engine and make restore()
+            # refuse ("requires an empty engine").
+            from ccfd_tpu.process.server import EngineServer
+
+            self.engine_server = EngineServer(self.engine)
+            self.engine_port = self.engine_server.start(
+                c.opt("rest_host", "127.0.0.1"), int(c.opt("rest_port", 0))
+            )
+
+    def _up_notify(self) -> None:
+        from ccfd_tpu.notify.service import NotificationService
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("notify")
+        notify = NotificationService(
+            self.cfg, self.broker, self._registry("notify"),
+            seed=int(c.opt("seed", 0)),
+        )
+        self.supervisor.add_thread_service(
+            "notify",
+            lambda: notify.run(poll_timeout_s=0.02),
+            notify.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=notify.reset,
+        )
+
+    def _up_router(self) -> None:
+        from ccfd_tpu.router.router import Router
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        if self.scorer is not None:
+            score_fn = self.scorer.score
+        else:  # remote scorer over the Seldon REST contract
+            from ccfd_tpu.serving.client import SeldonClient
+
+            score_fn = SeldonClient(self.cfg).score
+        engine = self.engine
+        if engine is None and self.cfg.kie_server_url.startswith("http"):
+            # remote engine over the KIE-shaped REST contract
+            from ccfd_tpu.process.client import EngineRestClient
+
+            engine = EngineRestClient(
+                self.cfg.kie_server_url,
+                timeout_s=self.cfg.seldon_timeout_ms / 1000.0,
+                retries=self.cfg.client_retries,
+            )
+        router = Router(
+            self.cfg, self.broker, score_fn, engine, self._registry("router")
+        )
+        self.supervisor.add_thread_service(
+            "router",
+            lambda: router.run(poll_timeout_s=0.02),
+            router.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=router.reset,
+        )
+
+    def _up_retrain(self) -> None:
+        from ccfd_tpu.parallel.online import OnlineTrainer
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("retrain")
+        trainer = OnlineTrainer(
+            self.cfg, self.broker, self.scorer, self.scorer.params,
+            registry=self._registry("retrain"),
+        )
+        interval = float(c.opt("interval_s", 0.5))
+        self.supervisor.add_thread_service(
+            "retrain",
+            lambda: trainer.run(interval_s=interval),
+            trainer.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=trainer.reset,
+        )
+
+    def _up_analytics(self) -> None:
+        from ccfd_tpu.analytics.engine import AnalyticsEngine, DriftMonitor
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("analytics")
+        registry = self._registry("analytics")
+        engine = AnalyticsEngine(
+            nbins=int(c.opt("nbins", 32)), registry=registry
+        )
+
+        def build_reference():
+            # dataset load + two jit compiles: runs on the supervised
+            # thread so bring-up (probes, exporter, producer) isn't blocked
+            from ccfd_tpu.data.ccfd import load_dataset
+
+            ds = load_dataset()
+            return engine.summarize(ds.X, ds.y)
+
+        monitor = DriftMonitor(
+            self.cfg,
+            self.broker,
+            None,
+            engine=engine,
+            registry=registry,
+            window=int(c.opt("window", 4096)),
+            reference_builder=build_reference,
+        )
+        interval = float(c.opt("interval_s", 0.25))
+        self.supervisor.add_thread_service(
+            "analytics",
+            lambda: monitor.run(interval_s=interval),
+            monitor.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=monitor.reset,
+        )
+
+    def _up_producer(self) -> None:
+        from ccfd_tpu.producer.producer import Producer
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("producer")
+        producer = Producer(
+            self.cfg, self.broker, registry=self._registry("producer")
+        )
+        limit = c.opt("transactions")
+        rate = c.opt("rate")
+        wire = c.opt("wire_format", "dict")
+        done = self._producer_done
+
+        def run() -> None:
+            try:
+                producer.run(
+                    limit=int(limit) if limit is not None else None,
+                    rate_per_s=float(rate) if rate else None,
+                    wire_format=wire,
+                )
+            finally:
+                done.set()
+
+        # one-shot job semantics, like the reference's producer pod
+        self.supervisor.add_thread_service(
+            "producer", run, policy=RestartPolicy.NEVER
+        )
+        self.supervisor.start_service("producer")
+
+    # -- status / teardown -------------------------------------------------
+    def wait_producer(self, timeout_s: float = 60.0) -> bool:
+        return self._producer_done.wait(timeout=timeout_s)
+
+    def status(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "services": self.supervisor.status() if self.supervisor else {},
+            "endpoints": {},
+        }
+        if self.store_server:
+            out["endpoints"]["store"] = self.store_server.endpoint
+        if self.prediction_server:
+            out["endpoints"]["scorer"] = (
+                f"http://{self.prediction_host}:{self.prediction_port}"
+            )
+        if self.exporter:
+            out["endpoints"]["metrics"] = self.exporter.endpoint
+        if self.health_server:
+            out["endpoints"]["health"] = self.health_server.endpoint
+        return out
+
+    def _save_engine_state(self) -> None:
+        if self._engine_state_file:
+            try:
+                self.engine.save(self._engine_state_file)
+            except Exception:  # noqa: BLE001 - persistence must not kill the host
+                logging.getLogger(__name__).exception(
+                    "engine state save to %s failed; process state will NOT "
+                    "survive a restart", self._engine_state_file,
+                )
+        if getattr(self, "_usertask_state_file", None) and self.usertask_model:
+            try:
+                self.usertask_model.save(self._usertask_state_file)
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "user-task model save to %s failed", self._usertask_state_file
+                )
+
+    def down(self) -> None:
+        # chaos first: injecting failures into services that are being torn
+        # down would race the orderly shutdown
+        if self.chaos is not None:
+            self.chaos.stop()
+        if self.supervisor:
+            self.supervisor.stop()
+        if self.engine is not None and (
+            getattr(self, "_engine_state_file", None)
+            or getattr(self, "_usertask_state_file", None)
+        ):
+            self._save_engine_state()
+        for srv in (
+            self.prediction_server,
+            self.engine_server,
+            self.exporter,
+            self.health_server,
+            self.store_server,
+        ):
+            if srv is not None:
+                try:
+                    srv.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+        self._up = False

@@ -1,0 +1,3 @@
+from ccfd_tpu.process.clock import Clock, ManualClock, RealClock  # noqa: F401
+from ccfd_tpu.process.engine import Engine, ProcessDefinition, Task  # noqa: F401
+from ccfd_tpu.process.fraud import build_engine  # noqa: F401

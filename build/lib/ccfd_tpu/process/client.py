@@ -1,0 +1,91 @@
+"""REST client for a remote process engine (the router's KIE_SERVER_URL hop).
+
+The reference router drives the KIE server over HTTP
+(``KIE_SERVER_URL``, reference deploy/router.yaml:63-64): process starts
+for scored transactions and signal forwarding for customer responses.
+This client implements the in-process ``EngineClient`` protocol
+(ccfd_tpu/router/router.py) against ccfd_tpu/process/server.py, so the
+router can run on the TPU host while the engine lives elsewhere. Pooled
+connections + bounded retries, mirroring ccfd_tpu/serving/client.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+from ccfd_tpu.utils.httpclient import PooledHTTPClient
+
+
+class EngineRestClient:
+    def __init__(
+        self,
+        base_url: str,
+        pool_size: int = 4,
+        timeout_s: float = 5.0,
+        retries: int = 2,
+    ):
+        self._http = PooledHTTPClient(
+            base_url, default_port=8090, pool_size=pool_size,
+            timeout_s=timeout_s, retries=retries,
+            scheme_error="unsupported scheme in KIE_SERVER_URL",
+        )
+
+    def _request(
+        self, method: str, path: str, body: Any = None, idempotent: bool = True
+    ) -> tuple[int, Any]:
+        # non-idempotent start_process must not blind-retry after the request
+        # may have reached the engine — a re-send would start a duplicate
+        # instance (retry policy lives in PooledHTTPClient)
+        return self._http.request(method, path, body, idempotent=idempotent)
+
+    # -- EngineClient protocol --------------------------------------------
+    def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
+        code, body = self._request(
+            "POST", f"/rest/processes/{def_id}/instances",
+            {"variables": dict(variables)},
+            idempotent=False,
+        )
+        if code != 201:
+            raise RuntimeError(f"start_process {def_id!r} failed: {code} {body}")
+        return int(body["process_id"])
+
+    def start_process_batch(
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+    ) -> list[int | None]:
+        """One HTTP round-trip for a micro-batch of process starts (the
+        router's hot path). ``None`` slots are instances the engine aborted
+        on a service-node error; a transport failure raises instead."""
+        code, body = self._request(
+            "POST", f"/rest/processes/{def_id}/instances/batch",
+            {"variables_list": [dict(v) for v in variables_list]},
+            idempotent=False,
+        )
+        if code != 201:
+            raise RuntimeError(f"start_process_batch {def_id!r} failed: {code} {body}")
+        return [None if p is None else int(p) for p in body["process_ids"]]
+
+    def signal(self, pid: int, name: str, payload: Any = None) -> bool:
+        code, body = self._request(
+            "POST", f"/rest/instances/{pid}/signal/{name}", {"payload": payload}
+        )
+        return code == 200 and bool(body.get("consumed"))
+
+    # -- convenience (investigator tooling) -------------------------------
+    def instance(self, pid: int) -> Mapping[str, Any]:
+        code, body = self._request("GET", f"/rest/instances/{pid}")
+        if code != 200:
+            raise KeyError(pid)
+        return body
+
+    def tasks(self, status: str = "open") -> list[Mapping[str, Any]]:
+        code, body = self._request("GET", f"/rest/tasks?status={status}")
+        if code != 200:
+            raise RuntimeError(f"tasks query failed: {code} {body}")
+        return body or []
+
+    def complete_task(self, task_id: int, outcome: Any) -> None:
+        code, body = self._request(
+            "POST", f"/rest/tasks/{task_id}/complete", {"outcome": outcome}
+        )
+        if code != 200:
+            raise RuntimeError(f"complete_task {task_id} failed: {code} {body}")

@@ -1,0 +1,795 @@
+"""Business-process engine: the jBPM/KIE-server capability, TPU-framework native.
+
+The reference runs fraud/standard processes on a KIE execution server
+(reference deploy/ccd-service.yaml:1-124; semantics README.md:583-605 and
+docs/process-fraud.png): a customer-notification node, a no-reply timer
+racing a customer-response signal, a DMN decision over amount+probability,
+a user task for human investigators, and a Seldon-backed prediction service
+that auto-completes user tasks at high confidence
+(``-Dorg.jbpm.task.prediction.service=SeldonPredictionService``,
+ccd-service.yaml:65-66; confidence semantics README.md:571-581).
+
+This engine re-creates those semantics as an explicit state machine:
+
+- A ``ProcessDefinition`` is a named graph of nodes; node kinds are
+  ``ServiceNode`` (run a function, move on), ``EventNode`` (wait for a
+  signal OR a timer — whichever fires first wins, atomically),
+  ``UserTaskNode`` (open a human task, consult the prediction service),
+  and ``EndNode``.
+- The signal-vs-timer race is resolved under one engine lock with a
+  per-wait generation counter: the first of {matching signal, timer with
+  matching generation} consumes the wait; the loser is a no-op.
+- The prediction service hook mirrors jBPM's: confidence >=
+  ``confidence_threshold`` auto-completes the task with the predicted
+  outcome; below it, the prediction is only pre-filled as
+  ``task.suggested_outcome`` (README.md:580-581).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Protocol, Sequence
+
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.clock import Clock, RealClock, TimerHandle
+
+def _copy_containers(v: Any) -> Any:
+    """Recursive copy of JSON containers (dict/list), leaves shared.
+
+    Snapshots detach from live engine state with this instead of a full
+    ``json.dumps`` under the lock: copying containers is cheap (no string
+    building), and since dicts/lists are the only mutable JSON values, a
+    ServiceNode that mutates NESTED vars (``inst.vars["x"]["y"] = ...``)
+    still can't tear the snapshot serialized after the lock is released.
+    """
+    if isinstance(v, dict):
+        return {k: _copy_containers(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_copy_containers(x) for x in v]
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+
+
+@dataclass(frozen=True)
+class ServiceNode:
+    name: str
+    fn: Callable[["Engine", "Instance"], None]
+    next: str
+
+
+@dataclass(frozen=True)
+class EventNode:
+    """Wait for ``signal`` or a timer of ``timeout_s`` — first one wins."""
+
+    name: str
+    signal: str
+    timeout_s: float | Callable[["Instance"], float]
+    on_signal: str
+    on_timeout: str
+
+
+@dataclass(frozen=True)
+class UserTaskNode:
+    name: str
+    task_name: str
+    next: str  # node run after completion; outcome in vars["task_outcome"]
+
+
+@dataclass(frozen=True)
+class GatewayNode:
+    """Exclusive (XOR) gateway: choose() names the next node."""
+
+    name: str
+    choose: Callable[["Engine", "Instance"], str]
+
+
+@dataclass(frozen=True)
+class EndNode:
+    name: str
+    status: str = "completed"
+
+
+Node = ServiceNode | EventNode | GatewayNode | UserTaskNode | EndNode
+
+
+@dataclass(frozen=True)
+class ProcessDefinition:
+    id: str
+    start: str
+    nodes: Mapping[str, Node]
+
+    def __post_init__(self) -> None:
+        for n in self.nodes.values():
+            targets = [
+                t
+                for t in (
+                    getattr(n, "next", None),
+                    getattr(n, "on_signal", None),
+                    getattr(n, "on_timeout", None),
+                )
+                if t is not None
+            ]
+            for t in targets:
+                if t not in self.nodes:
+                    raise ValueError(f"{self.id}:{n.name} -> unknown node {t!r}")
+        if self.start not in self.nodes:
+            raise ValueError(f"{self.id}: unknown start node {self.start!r}")
+
+
+# ---------------------------------------------------------------------------
+# Runtime state
+
+
+@dataclass(slots=True)
+class Instance:
+    pid: int
+    definition: ProcessDefinition
+    vars: dict[str, Any]
+    status: str = "active"  # active | completed | aborted
+    node: str = ""
+    wait_signal: str | None = None
+    wait_gen: int = 0
+    timer: TimerHandle | None = None
+    timer_deadline: float | None = None  # clock.now()-relative; for snapshots
+    history: list[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class Task:
+    task_id: int
+    pid: int
+    name: str
+    vars: dict[str, Any]
+    status: str = "open"  # open | completed
+    suggested_outcome: Any = None
+    prediction_confidence: float | None = None
+    outcome: Any = None
+
+
+class PredictionService(Protocol):
+    """jBPM prediction-service shape: predict a user-task outcome."""
+
+    def predict(self, task: Task) -> tuple[Any, float]: ...
+
+
+# ---------------------------------------------------------------------------
+# Engine
+
+
+class Engine:
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        registry: Registry | None = None,
+        prediction_service: PredictionService | None = None,
+        confidence_threshold: float = 1.0,
+        task_listener: Callable[[Task], None] | None = None,
+        completed_retention: int = 10_000,
+        audit_sink: Callable[[dict[str, Any]], None] | None = None,
+    ):
+        self.clock: Clock = clock or RealClock()
+        self.registry = registry or Registry()
+        self.prediction_service = prediction_service
+        self.confidence_threshold = confidence_threshold
+        # fired once per HUMAN complete_task (never for prediction-service
+        # auto-completions): the user-task model trains on investigator
+        # decisions only — learning from its own auto-closures would be
+        # feedback, not supervision
+        self.task_listener = task_listener
+        # Audit stream (jBPM's AuditService analog): lifecycle events —
+        # process_started/process_completed, task_created/task_completed,
+        # signal, timer_fired — reach this sink in state-change order.
+        # Events BUFFER under the state lock and deliver after it releases
+        # (public entry points flush), so a slow sink (a remote bus hop)
+        # never stalls the engine's lock; the flush lock serializes
+        # deliveries so per-pid order still matches state-change order.
+        # A sink exposing a ``batch`` attribute gets each flush in ONE
+        # call. None (default) costs nothing on the hot path. The runtime
+        # store evicts completed instances (retention cap below); the
+        # audit stream is where full history durably lives.
+        self._audit = audit_sink
+        self._audit_buffer: list[dict[str, Any]] = []
+        self._audit_flush_lock = threading.Lock()
+        self._definitions: dict[str, ProcessDefinition] = {}
+        self._instances: dict[int, Instance] = {}
+        self._tasks: dict[int, Task] = {}
+        self._pid = itertools.count(1)
+        self._tid = itertools.count(1)
+        self._lock = threading.RLock()
+        # Completed instances are evicted FIFO past this cap (jBPM likewise
+        # drops finished instances from the runtime store, keeping history in
+        # the audit log — here, in metrics): a pipeline starting a process
+        # per scored transaction would otherwise grow ``_instances`` without
+        # bound at tens of thousands of entries per second.
+        self._completed_retention = completed_retention
+        self._completed_order: deque[int] = deque()
+        self._tasks_by_pid: dict[int, list[int]] = {}
+        # def_id -> (service_nodes, end_node, history) for straight-through
+        # definitions (ServiceNode chain into an EndNode, no waits/gateways/
+        # tasks): the hot batch path runs these without per-node dispatch
+        self._static_chains: dict[str, tuple[list[ServiceNode], EndNode, list[str]]] = {}
+        self._started = self.registry.counter(
+            "process_instances_started_total", "process starts by definition"
+        )
+        self._completed = self.registry.counter(
+            "process_instances_completed_total", "process completions by status"
+        )
+
+    def _emit(self, event: str, pid: int, process: str, **extra: Any) -> None:
+        """Buffer one audit event; caller holds the state lock and has
+        checked ``self._audit is not None`` (so the off case builds no
+        dicts). Delivery happens in ``_flush_audit`` after lock release."""
+        self._audit_buffer.append({
+            "event": event, "pid": pid, "process": process,
+            "ts": self.clock.now(), **extra,
+        })
+
+    def _flush_audit(self) -> None:
+        """Deliver buffered audit events OUTSIDE the state lock.
+
+        The flush lock serializes concurrent flushers, and the buffer swap
+        happens under the state lock inside it — so delivery order equals
+        state-change order even when two API calls race to flush. A sink
+        exposing a ``batch`` attribute gets the whole flush in one call
+        (the bus sink maps it to produce_batch); otherwise events deliver
+        one at a time with per-event failure isolation."""
+        if self._audit is None:
+            return
+        # Reentrancy guard: a ServiceNode/GatewayNode may call back into a
+        # public engine API (fn(engine, inst)), whose exit would flush
+        # WHILE the outer frame still owns the state RLock — acquiring the
+        # flush lock there inverts the flush->state lock order (AB-BA
+        # deadlock against a concurrent flusher) and would deliver to the
+        # sink under the state lock. The outermost frame flushes instead.
+        # (_is_owned is RLock private API, stable across CPython.)
+        if self._lock._is_owned():
+            return
+        with self._audit_flush_lock:
+            with self._lock:
+                events = self._audit_buffer
+                self._audit_buffer = []
+            if not events:
+                return
+            batch_fn = getattr(self._audit, "batch", None)
+            if callable(batch_fn):
+                try:
+                    batch_fn(events)
+                except Exception:  # noqa: BLE001 - never break the flow
+                    import logging
+
+                    logging.getLogger(__name__).exception("audit sink failed")
+                return
+            for ev in events:
+                try:
+                    self._audit(ev)
+                except Exception:  # noqa: BLE001 - drop THIS event only
+                    import logging
+
+                    logging.getLogger(__name__).exception("audit sink failed")
+
+    @property
+    def state_lock(self) -> threading.RLock:
+        """The lock guarding instance/task state. External viewers (the REST
+        server) hold it while serializing ``vars`` dicts — the engine mutates
+        them in place, and iterating a live dict during a signal races."""
+        return self._lock
+
+    # -- definitions ------------------------------------------------------
+    def definitions(self) -> tuple[str, ...]:
+        """Registered process-definition ids (the router validates its rule
+        base against these at wiring time)."""
+        with self._lock:
+            return tuple(self._definitions)
+
+    def register(self, definition: ProcessDefinition) -> None:
+        self._definitions[definition.id] = definition
+        chain = self._straight_through_chain(definition)
+        if chain is not None:
+            self._static_chains[definition.id] = chain
+        else:
+            self._static_chains.pop(definition.id, None)
+
+    @staticmethod
+    def _straight_through_chain(
+        definition: ProcessDefinition,
+    ) -> tuple[list[ServiceNode], EndNode, list[str]] | None:
+        """ServiceNode* -> EndNode with no branches? Then the node walk is
+        static and the batch start path can skip per-node dispatch."""
+        services: list[ServiceNode] = []
+        history: list[str] = []
+        name = definition.start
+        for _ in range(len(definition.nodes) + 1):
+            node = definition.nodes[name]
+            history.append(name)
+            if isinstance(node, ServiceNode):
+                services.append(node)
+                name = node.next
+            elif isinstance(node, EndNode):
+                return services, node, history
+            else:
+                return None
+        return None  # cycle of service nodes: not straight-through
+
+    # -- public API (KIE-server-shaped: start / signal / tasks) -----------
+    def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
+        try:
+            with self._lock:
+                d = self._definitions[def_id]
+                inst = Instance(
+                    pid=next(self._pid), definition=d, vars=dict(variables)
+                )
+                self._instances[inst.pid] = inst
+                self._started.inc(labels={"process": def_id})
+                if self._audit is not None:
+                    self._emit("process_started", inst.pid, def_id)
+                self._run_from(inst, d.start)
+                return inst.pid
+        finally:
+            # finally, not fallthrough: a raising service node documented
+            # to propagate must still get its buffered events delivered
+            self._flush_audit()
+
+    def start_process_batch(
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+    ) -> list[int | None]:
+        """Start many instances of one definition under a single lock
+        acquisition — the router's hot path (one start per scored
+        transaction, reference README.md:552) would otherwise pay a lock
+        round-trip and per-label counter bump per transaction.
+
+        Straight-through definitions (a ServiceNode chain into an EndNode —
+        the "standard" process) additionally skip per-node dispatch: the
+        node walk is precomputed at ``register`` time and the metrics
+        counters advance once per batch instead of once per instance.
+
+        Error semantics (unlike single ``start_process``, which propagates):
+        an exception from a service/gateway aborts THAT instance only — its
+        slot in the returned list is ``None``, the instance is left
+        ``aborted``, and the rest of the batch still starts. One poisoned
+        transaction must not drop a whole micro-batch of process starts.
+        """
+        try:
+            return self._start_process_batch_locked(def_id, variables_list)
+        finally:
+            self._flush_audit()
+
+    def _start_process_batch_locked(
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+    ) -> list[int | None]:
+        with self._lock:
+            d = self._definitions[def_id]
+            chain = self._static_chains.get(def_id)
+            pids: list[int | None] = []
+            audit_on = self._audit is not None
+            if chain is None:
+                for variables in variables_list:
+                    try:
+                        # a non-mapping element must poison only its slot:
+                        # dict() belongs inside the isolation boundary too
+                        inst = Instance(
+                            pid=next(self._pid), definition=d, vars=dict(variables)
+                        )
+                    except (TypeError, ValueError):
+                        pids.append(None)
+                        continue
+                    self._instances[inst.pid] = inst
+                    self._started.inc(labels={"process": def_id})
+                    if audit_on:
+                        self._emit("process_started", inst.pid, def_id)
+                    try:
+                        self._run_from(inst, d.start)
+                    except Exception:
+                        inst.status = "aborted"
+                        if audit_on:
+                            self._emit("process_completed", inst.pid, def_id,
+                                       status="aborted")
+                        self._note_completed(inst.pid)
+                        pids.append(None)
+                        continue
+                    pids.append(inst.pid)
+            else:
+                services, end, history = chain
+                n_ok = 0
+                n_started = 0
+                for variables in variables_list:
+                    try:
+                        inst = Instance(
+                            pid=next(self._pid), definition=d, vars=dict(variables)
+                        )
+                    except (TypeError, ValueError):
+                        pids.append(None)
+                        continue
+                    self._instances[inst.pid] = inst
+                    n_started += 1
+                    if audit_on:
+                        self._emit("process_started", inst.pid, def_id)
+                    try:
+                        for si, svc in enumerate(services):
+                            inst.node = svc.name
+                            svc.fn(self, inst)
+                    except Exception:
+                        inst.history = list(history[: si + 1])
+                        inst.status = "aborted"
+                        if audit_on:
+                            self._emit("process_completed", inst.pid, def_id,
+                                       status="aborted")
+                        self._note_completed(inst.pid)
+                        pids.append(None)
+                        continue
+                    inst.node = end.name
+                    inst.history = list(history)
+                    inst.status = end.status
+                    if audit_on:
+                        self._emit("process_completed", inst.pid, def_id,
+                                   status=end.status)
+                    pids.append(inst.pid)
+                    self._note_completed(inst.pid)
+                    n_ok += 1
+                if n_started:
+                    self._started.inc(n_started, labels={"process": def_id})
+                if n_ok:
+                    self._completed.inc(
+                        n_ok, labels={"process": def_id, "status": end.status}
+                    )
+        return pids
+
+    def signal(self, pid: int, name: str, payload: Any = None) -> bool:
+        """Deliver a signal; returns True iff it was consumed by a wait."""
+        try:
+            with self._lock:
+                inst = self._instances.get(pid)
+                if (
+                    inst is None
+                    or inst.status != "active"
+                    or inst.wait_signal != name
+                ):
+                    return False
+                node = inst.definition.nodes[inst.node]
+                assert isinstance(node, EventNode)
+                self._consume_wait(inst)
+                inst.vars["signal_payload"] = payload
+                if self._audit is not None:
+                    self._emit("signal", pid, inst.definition.id, name=name)
+                self._run_from(inst, node.on_signal)
+                return True
+        finally:
+            self._flush_audit()
+
+    def instance(self, pid: int) -> Instance:
+        with self._lock:
+            return self._instances[pid]
+
+    def instances(self, status: str | None = None) -> list[Instance]:
+        with self._lock:
+            return [
+                i
+                for i in self._instances.values()
+                if status is None or i.status == status
+            ]
+
+    def tasks(self, status: str = "open") -> list[Task]:
+        with self._lock:
+            return [t for t in self._tasks.values() if t.status == status]
+
+    def task(self, task_id: int) -> Task:
+        with self._lock:
+            return self._tasks[task_id]
+
+    def complete_task(self, task_id: int, outcome: Any) -> None:
+        try:
+            with self._lock:
+                t = self._tasks[task_id]
+                if t.status != "open":
+                    raise ValueError(f"task {task_id} already {t.status}")
+                t.status = "completed"
+                t.outcome = outcome
+                inst = self._instances[t.pid]
+                node = inst.definition.nodes[inst.node]
+                assert isinstance(node, UserTaskNode)
+                inst.vars["task_outcome"] = outcome
+                if self._audit is not None:
+                    self._emit("task_completed", t.pid, inst.definition.id,
+                               task_id=t.task_id, by="human", outcome=outcome)
+                self._run_from(inst, node.next)
+        finally:
+            self._flush_audit()
+        if self.task_listener is not None:
+            try:
+                self.task_listener(t)
+            except Exception:  # noqa: BLE001
+                # the task is already completed and the process advanced; a
+                # broken observer (bad feature value, training failure) must
+                # not surface as a failed complete_task to the investigator
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "task listener failed for task %d", t.task_id
+                )
+
+    # -- persistence (jBPM keeps process state in its engine store;
+    #    SURVEY.md §5 "jBPM process state (persistent in the engine)") ----
+    def snapshot(self, include_completed: bool = False) -> dict[str, Any]:
+        """Serializable engine state: instances, tasks, id counters.
+
+        Timer waits serialize as *remaining* seconds (clock epochs differ
+        across processes). Process vars must be JSON-able — the same
+        contract jBPM puts on persisted process variables.
+
+        By default only ACTIVE instances and their open tasks are captured
+        (jBPM likewise drops completed instances from the runtime store,
+        keeping history in the audit log — here, in metrics): a long-running
+        pipeline starts a process per flagged transaction, and snapshotting
+        every completed instance forever would grow the state file and the
+        save/restore cost without bound.
+        """
+        with self._lock:
+            now = self.clock.now()
+            live = {
+                pid
+                for pid, i in self._instances.items()
+                if include_completed or i.status == "active"
+            }
+            instances = []
+            for i in self._instances.values():
+                if i.pid not in live:
+                    continue
+                instances.append(
+                    {
+                        "pid": i.pid,
+                        "def": i.definition.id,
+                        "vars": _copy_containers(i.vars),
+                        "status": i.status,
+                        "node": i.node,
+                        "wait_signal": i.wait_signal,
+                        "wait_gen": i.wait_gen,
+                        "timer_remaining_s": (
+                            None
+                            if i.timer_deadline is None
+                            else max(0.0, i.timer_deadline - now)
+                        ),
+                        "history": list(i.history),
+                    }
+                )
+            tasks = [
+                {
+                    "task_id": t.task_id,
+                    "pid": t.pid,
+                    "name": t.name,
+                    "vars": _copy_containers(t.vars),
+                    "status": t.status,
+                    "suggested_outcome": t.suggested_outcome,
+                    "prediction_confidence": t.prediction_confidence,
+                    "outcome": t.outcome,
+                }
+                for t in self._tasks.values()
+                if t.pid in live and (include_completed or t.status == "open")
+            ]
+            snap = {
+                "version": 1,
+                "next_pid": next(self._pid),
+                "next_tid": next(self._tid),
+                "instances": instances,
+                "tasks": tasks,
+            }
+            # the counters advanced to produce the snapshot; keep going from
+            # the recorded values so live allocation stays consistent
+            self._pid = itertools.count(snap["next_pid"])
+            self._tid = itertools.count(snap["next_tid"])
+        # JSON round-trip OUTSIDE the lock: the platform's checkpoint loop
+        # calls snapshot() every few seconds, and serializing every live
+        # instance while holding the lock would periodically stall
+        # start_process/signal/complete_task for time proportional to the
+        # active-instance count. ``_copy_containers`` above already detached
+        # every mutable JSON container under the lock (so even ServiceNodes
+        # that mutate nested vars can't tear this), and the round-trip here
+        # validates serializability now, not at restore time months later.
+        return json.loads(json.dumps(snap))
+
+    def restore(self, snap: Mapping[str, Any]) -> None:
+        """Load a snapshot into an empty engine and re-arm pending timers.
+
+        Definitions are code, not data (like jBPM KJARs): every definition
+        referenced by the snapshot must already be ``register``-ed. Waits
+        whose timers expired while the engine was down are re-armed with
+        zero delay — the timeout path fires promptly after restore, which
+        is jBPM's overdue-timer recovery behavior.
+        """
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
+        with self._lock:
+            if self._instances or self._tasks:
+                raise ValueError("restore requires an empty engine")
+            missing = {i["def"] for i in snap["instances"]} - set(self._definitions)
+            if missing:
+                raise ValueError(f"snapshot needs unregistered definitions {sorted(missing)}")
+            # definitions are code and may have drifted since the snapshot:
+            # an instance parked on a renamed node would pass restore and
+            # then KeyError at signal/timer time, wedging it permanently —
+            # fail here, with names
+            for s in snap["instances"]:
+                d = self._definitions[s["def"]]
+                if s["status"] == "active" and s["node"] not in d.nodes:
+                    raise ValueError(
+                        f"instance {s['pid']}: node {s['node']!r} no longer in "
+                        f"definition {d.id!r} (has {sorted(d.nodes)})"
+                    )
+                if s["status"] == "active" and s["wait_signal"] is not None:
+                    node = d.nodes[s["node"]]
+                    if not isinstance(node, EventNode) or node.signal != s["wait_signal"]:
+                        raise ValueError(
+                            f"instance {s['pid']}: waiting on signal "
+                            f"{s['wait_signal']!r} but node {s['node']!r} is not "
+                            f"an EventNode for it"
+                        )
+            for s in snap["instances"]:
+                inst = Instance(
+                    pid=int(s["pid"]),
+                    definition=self._definitions[s["def"]],
+                    vars=dict(s["vars"]),
+                    status=s["status"],
+                    node=s["node"],
+                    wait_signal=s["wait_signal"],
+                    wait_gen=int(s["wait_gen"]),
+                    history=list(s["history"]),
+                )
+                self._instances[inst.pid] = inst
+                if inst.status != "active":
+                    self._completed_order.append(inst.pid)
+            for s in snap["tasks"]:
+                t = Task(
+                    task_id=int(s["task_id"]),
+                    pid=int(s["pid"]),
+                    name=s["name"],
+                    vars=dict(s["vars"]),
+                    status=s["status"],
+                    suggested_outcome=s["suggested_outcome"],
+                    prediction_confidence=s["prediction_confidence"],
+                    outcome=s["outcome"],
+                )
+                self._tasks[t.task_id] = t
+                self._tasks_by_pid.setdefault(t.pid, []).append(t.task_id)
+            self._pid = itertools.count(int(snap["next_pid"]))
+            self._tid = itertools.count(int(snap["next_tid"]))
+            # re-arm after all state is in place: a zero-delay timer may
+            # fire (RealClock scheduler thread) as soon as we release _lock
+            for s in snap["instances"]:
+                remaining = s["timer_remaining_s"]
+                if s["status"] == "active" and remaining is not None:
+                    inst = self._instances[int(s["pid"])]
+                    inst.timer_deadline = self.clock.now() + remaining
+                    inst.timer = self.clock.call_later(
+                        remaining,
+                        lambda pid=inst.pid, g=inst.wait_gen: self._timer_fired(pid, g),
+                    )
+
+    def save(self, path: str) -> None:
+        """Atomic snapshot-to-file (tmp + rename)."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        with open(path) as f:
+            self.restore(json.load(f))
+
+    # -- internals --------------------------------------------------------
+    def _note_completed(self, pid: int) -> None:
+        """Record a terminal instance and evict past the retention cap.
+        Caller holds the lock. Evicted instances (and their tasks) leave the
+        runtime store; history lives on in the metrics, like jBPM's audit
+        log vs runtime separation."""
+        self._completed_order.append(pid)
+        while len(self._completed_order) > self._completed_retention:
+            old = self._completed_order.popleft()
+            self._instances.pop(old, None)
+            for tid in self._tasks_by_pid.pop(old, ()):
+                self._tasks.pop(tid, None)
+
+    def _consume_wait(self, inst: Instance) -> None:
+        inst.wait_signal = None
+        inst.wait_gen += 1
+        inst.timer_deadline = None
+        if inst.timer is not None:
+            inst.timer.cancel()
+            inst.timer = None
+
+    def _timer_fired(self, pid: int, gen: int) -> None:
+        try:
+            with self._lock:
+                inst = self._instances.get(pid)
+                if (
+                    inst is None
+                    or inst.status != "active"
+                    or inst.wait_signal is None
+                    or inst.wait_gen != gen
+                ):
+                    return  # a signal won the race; timer is a no-op
+                node = inst.definition.nodes[inst.node]
+                assert isinstance(node, EventNode)
+                self._consume_wait(inst)
+                if self._audit is not None:
+                    self._emit("timer_fired", pid, inst.definition.id,
+                               node=inst.node)
+                self._run_from(inst, node.on_timeout)
+        finally:
+            self._flush_audit()
+
+    def _run_from(self, inst: Instance, node_name: str) -> None:
+        """Advance the instance until it blocks (event/user task) or ends."""
+        while True:
+            node = inst.definition.nodes[node_name]
+            inst.node = node_name
+            inst.history.append(node_name)
+            if isinstance(node, ServiceNode):
+                node.fn(self, inst)
+                node_name = node.next
+            elif isinstance(node, GatewayNode):
+                node_name = node.choose(self, inst)
+                if node_name not in inst.definition.nodes:
+                    raise ValueError(
+                        f"{inst.definition.id}:{node.name} chose unknown node "
+                        f"{node_name!r}"
+                    )
+            elif isinstance(node, EventNode):
+                timeout = (
+                    node.timeout_s(inst) if callable(node.timeout_s) else node.timeout_s
+                )
+                inst.wait_signal = node.signal
+                gen = inst.wait_gen
+                inst.timer_deadline = self.clock.now() + timeout
+                inst.timer = self.clock.call_later(
+                    timeout, lambda pid=inst.pid, g=gen: self._timer_fired(pid, g)
+                )
+                return
+            elif isinstance(node, UserTaskNode):
+                task = Task(
+                    task_id=next(self._tid),
+                    pid=inst.pid,
+                    name=node.task_name,
+                    vars=dict(inst.vars),
+                )
+                self._tasks[task.task_id] = task
+                self._tasks_by_pid.setdefault(inst.pid, []).append(task.task_id)
+                if self._audit is not None:
+                    self._emit("task_created", inst.pid, inst.definition.id,
+                               task_id=task.task_id, name=node.task_name)
+                if self.prediction_service is not None:
+                    outcome, confidence = self.prediction_service.predict(task)
+                    task.prediction_confidence = confidence
+                    if confidence >= self.confidence_threshold:
+                        # jBPM semantics: auto-close the task (README.md:580)
+                        task.status = "completed"
+                        task.outcome = outcome
+                        inst.vars["task_outcome"] = outcome
+                        inst.vars["task_auto_completed"] = True
+                        if self._audit is not None:
+                            self._emit(
+                                "task_completed", inst.pid,
+                                inst.definition.id, task_id=task.task_id,
+                                by="prediction_service", outcome=outcome,
+                            )
+                        node_name = node.next
+                        continue
+                    task.suggested_outcome = outcome  # pre-fill only (README.md:581)
+                return
+            elif isinstance(node, EndNode):
+                inst.status = node.status
+                self._completed.inc(
+                    labels={"process": inst.definition.id, "status": node.status}
+                )
+                if self._audit is not None:
+                    self._emit("process_completed", inst.pid,
+                               inst.definition.id, status=node.status)
+                self._note_completed(inst.pid)
+                return
+            else:  # pragma: no cover
+                raise TypeError(f"unknown node type {type(node)}")

@@ -1,0 +1,59 @@
+"""Prediction service for user-task auto-triage (jBPM's SeldonPredictionService).
+
+In the reference, jBPM calls a second Seldon model to predict the outcome of
+an investigation user task; confidence >= CONFIDENCE_THRESHOLD closes the
+task automatically, below it the prediction is pre-filled for the human
+(reference README.md:571-581, ccd-service.yaml:61-66,
+docs/images/events-3.final.png).
+
+Here the prediction service is backed by the same in-tree TPU scorer stack:
+``ScorerPredictionService`` scores the task's transaction features and maps
+probability to (outcome, confidence) — confidence is the scorer's margin
+``max(p, 1-p)``. Any object with ``predict(task) -> (outcome, confidence)``
+plugs in, including a remote REST client.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Sequence
+
+import numpy as np
+
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ccfd_tpu.process.engine import Task
+
+
+def task_features(task: "Task") -> np.ndarray:
+    """(1, 30) feature row from the task's transaction variables."""
+    tx = task.vars.get("transaction", task.vars)
+    return np.asarray(
+        [[float(tx.get(name, 0.0)) for name in FEATURE_NAMES]], dtype=np.float32
+    )
+
+
+class ScorerPredictionService:
+    """Backs the prediction hook with a scorer callable (np (B,30) -> np (B,))."""
+
+    def __init__(self, score_fn: Callable[[np.ndarray], np.ndarray]):
+        self._score = score_fn
+
+    def predict(self, task: "Task") -> tuple[bool, float]:
+        proba = float(np.asarray(self._score(task_features(task)))[0])
+        is_fraud = proba >= 0.5
+        confidence = max(proba, 1.0 - proba)
+        return is_fraud, confidence
+
+
+class FixedPredictionService:
+    """Deterministic stub for tests: returns a preset (outcome, confidence)."""
+
+    def __init__(self, outcome: bool, confidence: float):
+        self.outcome = outcome
+        self.confidence = confidence
+        self.calls: list[int] = []
+
+    def predict(self, task: "Task") -> tuple[bool, float]:
+        self.calls.append(task.task_id)
+        return self.outcome, self.confidence

@@ -1,0 +1,223 @@
+"""KIE-server-shaped REST surface for the process engine.
+
+The reference's jBPM engine is driven over REST on port 8090: the router
+starts processes and forwards customer-response signals via
+``KIE_SERVER_URL`` (reference deploy/router.yaml:63-64, README.md:552,569),
+and Prometheus scrapes ``:8090/rest/metrics`` (README.md:509-515). This
+module gives the in-tree engine the same network surface so the router,
+investigator tooling, and scrapers can live in different processes than
+the engine:
+
+    POST /rest/processes/{def_id}/instances   {variables}      -> {process_id}
+    POST /rest/instances/{pid}/signal/{name}  {payload}        -> {consumed}
+    GET  /rest/instances/{pid}                                 -> instance view
+    GET  /rest/instances?status=active                         -> [instance view]
+    GET  /rest/tasks?status=open                               -> [task view]
+    POST /rest/tasks/{tid}/complete           {outcome}        -> {}
+    GET  /rest/metrics | /metrics              Prometheus scrape (KIE path)
+    GET  /health/status                        readiness
+
+Same threaded stdlib HTTP server approach as the scoring server
+(ccfd_tpu/serving/server.py): a fixed contract needs no framework, and the
+engine does its own locking so handlers stay thin.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Any
+
+from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
+
+from ccfd_tpu.process.engine import Engine, Instance, Task
+
+_INSTANCES = re.compile(r"^/rest/processes/([\w.-]+)/instances$")
+_INSTANCES_BATCH = re.compile(r"^/rest/processes/([\w.-]+)/instances/batch$")
+_SIGNAL = re.compile(r"^/rest/instances/(\d+)/signal/([\w.-]+)$")
+_INSTANCE = re.compile(r"^/rest/instances/(\d+)$")
+_COMPLETE = re.compile(r"^/rest/tasks/(\d+)/complete$")
+
+
+def instance_view(i: Instance) -> dict[str, Any]:
+    return {
+        "process_id": i.pid,
+        "definition": i.definition.id,
+        "status": i.status,
+        "node": i.node,
+        # copy under the caller-held lock: json.dumps runs after release,
+        # and the engine mutates vars keys in place (signal_payload etc.)
+        "vars": dict(i.vars),
+    }
+
+
+def task_view(t: Task) -> dict[str, Any]:
+    return {
+        "task_id": t.task_id,
+        "process_id": t.pid,
+        "name": t.name,
+        "status": t.status,
+        "suggested_outcome": t.suggested_outcome,
+        "prediction_confidence": t.prediction_confidence,
+        "outcome": t.outcome,
+        "vars": dict(t.vars),
+    }
+
+
+class EngineServer:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self._httpd: FrameworkHTTPServer | None = None
+
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send_json(self, code: int, obj: Any) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_text(self, code: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/") or "/"
+                eng = server.engine
+                if path in ("/rest/metrics", "/metrics", "/prometheus"):
+                    self._send_text(200, eng.registry.render())
+                    return
+                if path in ("/health/status", "/health", "/healthz"):
+                    self._send_json(
+                        200, {"status": "ok", "definitions": list(eng.definitions())}
+                    )
+                    return
+                # views serialize live vars dicts: hold the engine lock so a
+                # concurrent signal can't mutate them mid-iteration
+                m = _INSTANCE.match(path)
+                if m:
+                    with eng.state_lock:
+                        try:
+                            view = instance_view(eng.instance(int(m.group(1))))
+                        except KeyError:
+                            view = None
+                    if view is None:
+                        self._send_json(404, {"error": "no such instance"})
+                    else:
+                        self._send_json(200, view)
+                    return
+                if path == "/rest/instances":
+                    status = _param(query, "status")
+                    with eng.state_lock:
+                        views = [instance_view(i) for i in eng.instances(status)]
+                    self._send_json(200, views)
+                    return
+                if path == "/rest/tasks":
+                    status = _param(query, "status") or "open"
+                    with eng.state_lock:
+                        views = [task_view(t) for t in eng.tasks(status)]
+                    self._send_json(200, views)
+                    return
+                self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    length = 0
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(raw or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._send_json(400, {"error": "malformed JSON body"})
+                    return
+                if not isinstance(payload, dict):
+                    self._send_json(400, {"error": "JSON body must be an object"})
+                    return
+                path = self.path.rstrip("/")
+                eng = server.engine
+                m = _INSTANCES_BATCH.match(path)
+                if m:
+                    vlist = payload.get("variables_list")
+                    if not isinstance(vlist, list):
+                        self._send_json(
+                            400, {"error": "variables_list must be a list"}
+                        )
+                        return
+                    try:
+                        pids = eng.start_process_batch(m.group(1), vlist)
+                    except KeyError:
+                        self._send_json(404, {"error": f"no process {m.group(1)!r}"})
+                        return
+                    self._send_json(201, {"process_ids": pids})
+                    return
+                m = _INSTANCES.match(path)
+                if m:
+                    try:
+                        pid = eng.start_process(
+                            m.group(1), payload.get("variables", payload) or {}
+                        )
+                    except KeyError:
+                        self._send_json(404, {"error": f"no process {m.group(1)!r}"})
+                        return
+                    self._send_json(201, {"process_id": pid})
+                    return
+                m = _SIGNAL.match(path)
+                if m:
+                    consumed = eng.signal(
+                        int(m.group(1)), m.group(2), payload.get("payload", payload)
+                    )
+                    self._send_json(200, {"consumed": consumed})
+                    return
+                m = _COMPLETE.match(path)
+                if m:
+                    try:
+                        eng.complete_task(int(m.group(1)), payload.get("outcome"))
+                    except KeyError:
+                        self._send_json(404, {"error": "no such task"})
+                        return
+                    except ValueError as e:
+                        self._send_json(409, {"error": str(e)})
+                        return
+                    self._send_json(200, {})
+                    return
+                self._send_json(404, {"error": "not found"})
+
+        return Handler
+
+    def start(self, host: str = "0.0.0.0", port: int = 8090) -> int:
+        self._httpd = FrameworkHTTPServer((host, port), self._handler_class())
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="ccfd-kie"
+        ).start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def _param(query: str, name: str) -> str | None:
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k == name and v:
+            return v
+    return None

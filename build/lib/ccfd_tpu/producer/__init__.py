@@ -1,0 +1,1 @@
+from ccfd_tpu.producer.producer import Producer  # noqa: F401

@@ -1,0 +1,1 @@
+from ccfd_tpu.router.router import Router  # noqa: F401

@@ -1,0 +1,465 @@
+"""Stream decision router — the Camel/Fuse + Drools capability, TPU-batched.
+
+The reference's ``ccd-fuse`` router consumes transactions from Kafka one
+message at a time, POSTs each to Seldon, applies a Drools rule against
+``FRAUD_THRESHOLD`` and starts a "fraud" or "standard" process on the KIE
+server; it also forwards customer responses from the response topic as
+process signals (reference deploy/router.yaml:54-70, README.md:424-459,
+547-552, 567-569).
+
+The TPU-native difference is the dispatch unit: **the Kafka poll IS the
+micro-batch**. Each ``step()`` drains up to ``max_batch`` records within a
+poll deadline, decodes them into one (B, 30) matrix, and makes a single
+scorer dispatch — one XLA executable launch amortized over the whole batch —
+instead of one HTTP round-trip per transaction. Threshold routing then runs
+vectorized on the returned probability array.
+
+Business counters match the reference metric names (README.md:522-530,
+Router.json:88-326): ``transaction_incoming_total``,
+``transaction_outgoing_total{type}``, ``notifications_outgoing_total``,
+``notifications_incoming_total{response}``.
+"""
+
+from __future__ import annotations
+
+import operator
+import threading
+import time
+from typing import Any, Callable, Mapping, Protocol
+
+import numpy as np
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.native import decode_csv as native_decode_csv
+from ccfd_tpu.process.fraud import CUSTOMER_RESPONSE_SIGNAL
+from ccfd_tpu.router.rules import RuleSet, default_rules
+
+
+class EngineClient(Protocol):
+    """KIE-server-shaped surface the router needs (in-process or REST)."""
+
+    def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int: ...
+
+    def signal(self, pid: int, name: str, payload: Any = None) -> bool: ...
+
+
+_SCHEMA_GETTER = operator.itemgetter(*FEATURE_NAMES)
+_ZERO_ROW = (0.0,) * len(FEATURE_NAMES)
+
+
+def _decode_row_lenient(tx: Any, out_row: np.ndarray) -> int:
+    """Field-by-field decode for rows the fast path rejected; returns #bad."""
+    if not (type(tx) is dict or isinstance(tx, Mapping)):
+        return 1
+    bad = 0
+    for j, name in enumerate(FEATURE_NAMES):
+        v = tx.get(name)
+        if v is None:
+            continue
+        try:
+            out_row[j] = float(v)
+        except (TypeError, ValueError):
+            bad += 1
+    return bad
+
+
+def decode_features(values: list[Mapping[str, Any]]) -> tuple[np.ndarray, int]:
+    """Transaction dicts -> ((B, 30) float32 matrix in schema order, #bad fields).
+
+    Hot path: well-formed transactions carry the full schema, so one
+    ``itemgetter`` call per row pulls all 30 fields in C, and ONE
+    ``np.asarray`` converts the whole batch — ~10x over per-field Python
+    loops, which matters because this runs per micro-batch at wire rate
+    (it was the single largest cost in the router loop profile).
+
+    Malformed rows (missing fields, non-numeric values, non-mappings) fall
+    back to the field-by-field lenient decode: they cost more but decode to
+    0.0 per bad field instead of raising — a poison-pill message must not
+    take down the scoring loop.
+    """
+    n = len(values)
+    rows: list[tuple] = []
+    slow: list[int] = []
+    for i, tx in enumerate(values):
+        try:
+            rows.append(_SCHEMA_GETTER(tx))
+        except (KeyError, TypeError):
+            rows.append(_ZERO_ROW)
+            slow.append(i)
+    try:
+        out = np.asarray(rows, np.float32)
+        if out.shape != (n, len(FEATURE_NAMES)):
+            raise ValueError("ragged rows")
+    except (TypeError, ValueError):
+        # some row carried an unparseable value: redo per row, diverting
+        # failures to the lenient path
+        out = np.zeros((n, len(FEATURE_NAMES)), np.float32)
+        fast_ok = set(range(n)) - set(slow)
+        slow = list(slow)
+        for i in sorted(fast_ok):
+            try:
+                out[i] = np.asarray(rows[i], np.float32)
+            except (TypeError, ValueError):
+                slow.append(i)
+    bad = 0
+    for i in slow:
+        out[i] = 0.0
+        bad += _decode_row_lenient(values[i], out[i])
+    return out, bad
+
+
+def decode_records(records) -> tuple[np.ndarray, list[Mapping[str, Any]], int]:
+    """Bus records -> ((B, 30) matrix, per-row tx dicts, #malformed fields).
+
+    The one decoder for the transaction topic's mixed wire formats — the
+    router's scoring batches and the drift monitor's windows must see the
+    SAME rows. Two formats share the batch: dict transactions (decoded in
+    Python) and raw CSV lines (decoded by the native C++ fast path in one
+    pass). Rows keep their arrival order; a poison pill decodes to an
+    all-zero row rather than crashing the loop.
+    """
+    n = len(records)
+    x = np.zeros((n, len(FEATURE_NAMES)), np.float32)
+    txs: list[Mapping[str, Any]] = [{}] * n
+    bad = 0
+    dict_rows: list[int] = []
+    dict_vals: list[Mapping[str, Any]] = []
+    csv_rows: list[int] = []
+    csv_lines: list[bytes] = []
+    for i, rec in enumerate(records):
+        v = rec.value
+        # exact-type checks first: typing/ABC __instancecheck__ costs ~1us
+        # and this runs per record at wire rate — a CSV record must not
+        # pay a failed Mapping protocol check before its cheap bytes test
+        tv = type(v)
+        if tv is dict:
+            dict_rows.append(i)
+            dict_vals.append(v)
+        elif tv is bytes or tv is str or isinstance(v, (bytes, str)):
+            raw = v.encode() if isinstance(v, str) else v
+            # one record == one CSV row; embedded newlines would desync
+            # the joined decode below, so keep only the first line and
+            # count the rest as malformed
+            lines = raw.splitlines() or [b""]
+            if len(lines) > 1:
+                bad += len(lines) - 1
+            csv_rows.append(i)
+            csv_lines.append(lines[0])
+        elif isinstance(v, Mapping):  # non-dict mappings: same dict path
+            dict_rows.append(i)
+            dict_vals.append(v)
+        else:  # poison pill: score as all-zeros rather than crash the loop
+            bad += 1
+    if dict_vals:
+        xd, bad_fields = decode_features(dict_vals)
+        bad += bad_fields
+        for j, i in enumerate(dict_rows):
+            x[i] = xd[j]
+            txs[i] = dict_vals[j]
+    if csv_lines:
+        xc, bad_csv = native_decode_csv(
+            b"\n".join(csv_lines) + b"\n", len(FEATURE_NAMES)
+        )
+        bad += bad_csv
+        amount_col = FEATURE_NAMES.index("Amount")
+        for j, i in enumerate(csv_rows):
+            if j < xc.shape[0]:
+                x[i] = xc[j]
+            txs[i] = {
+                "id": records[i].key,
+                "Amount": float(x[i, amount_col]),
+            }
+    return x, txs, bad
+
+
+class Router:
+    def __init__(
+        self,
+        cfg: Config,
+        broker: Broker,
+        score_fn: Callable[[np.ndarray], np.ndarray],
+        engine: EngineClient,
+        registry: Registry | None = None,
+        max_batch: int = 4096,
+        rules: RuleSet | None = None,
+    ):
+        self.cfg = cfg
+        self.broker = broker
+        self.score = score_fn
+        self.engine = engine
+        self.registry = registry or Registry()
+        self.max_batch = max_batch
+        # Drools-analog rule base (ccfd_tpu/router/rules.py). Precedence:
+        # explicit arg > CCFD_RULES file > the reference's threshold rule.
+        if rules is None:
+            rules = (
+                RuleSet.from_file(cfg.rules_file)
+                if cfg.rules_file
+                else default_rules(cfg.fraud_threshold)
+            )
+        self.rules = rules
+        # Fail fast on a rule naming a process the engine doesn't have —
+        # discovering it on the first matching transaction would kill the
+        # routing loop mid-batch. Remote (REST) engines don't expose a
+        # definition list; those fall back to the runtime guard in step().
+        list_defs = getattr(engine, "definitions", None)
+        if callable(list_defs):
+            known = set(list_defs())
+            missing = {r.process for r in rules.rules} - known
+            if missing:
+                raise ValueError(
+                    f"rules reference unregistered processes {sorted(missing)}; "
+                    f"engine has {sorted(known)}"
+                )
+
+        # engines (in-process or REST) exposing the batched start API get
+        # one call per (rule, micro-batch) group instead of one per tx
+        self._start_batch = getattr(engine, "start_process_batch", None)
+
+        self._tx_consumer = broker.consumer("router", (cfg.kafka_topic,))
+        self._resp_consumer = broker.consumer(
+            "router-responses", (cfg.customer_response_topic,)
+        )
+        self._notif_watcher = broker.consumer(
+            "router-notifications", (cfg.customer_notification_topic,)
+        )
+
+        r = self.registry
+        self._c_in = r.counter("transaction_incoming_total", "transactions consumed")
+        self._c_out = r.counter(
+            "transaction_outgoing_total", "process starts by type"
+        )
+        self._c_notif_out = r.counter(
+            "notifications_outgoing_total", "customer notifications observed"
+        )
+        self._c_notif_in = r.counter(
+            "notifications_incoming_total", "customer responses by result"
+        )
+        self._h_batch = r.histogram("router_batch_size", "scoring batch sizes",
+                                    buckets=(1, 8, 64, 256, 1024, 4096, 16384))
+        self._c_decode_err = r.counter(
+            "transaction_decode_errors_total", "malformed transaction fields"
+        )
+        self._h_score_s = r.histogram("router_score_seconds", "scorer dispatch latency")
+        self._c_rule = r.counter("router_rule_fired_total", "rule activations")
+        self._c_start_err = r.counter(
+            "router_process_start_errors_total", "failed process starts"
+        )
+        self._c_signal_err = r.counter(
+            "router_signal_errors_total", "failed signal forwards"
+        )
+        self._c_score_err = r.counter(
+            "router_score_errors_total", "transactions dropped by scorer failures"
+        )
+        self._stop = threading.Event()
+
+    # -- loop stages (composed by step() and the pipelined run loop) -------
+    def _drain_signals(self) -> None:
+        """Notification-counter drain + customer-response signal forwarding."""
+        for rec in self._notif_watcher.poll(self.max_batch, 0.0):
+            self._c_notif_out.inc()
+
+        for rec in self._resp_consumer.poll(self.max_batch, 0.0):
+            payload = rec.value or {}
+            approved = bool(payload.get("approved"))
+            self._c_notif_in.inc(
+                labels={"response": "approved" if approved else "non_approved"}
+            )
+            pid = payload.get("process_id")
+            if pid is not None:
+                try:
+                    self.engine.signal(int(pid), CUSTOMER_RESPONSE_SIGNAL, payload)
+                except Exception:
+                    # remote engine briefly unreachable: the rest of the
+                    # already-consumed response batch must still forward
+                    self._c_signal_err.inc()
+
+    def _poll_batch(self, poll_timeout_s: float) -> list:
+        """Size x deadline micro-batching (SURVEY.md §7 stage 3): after the
+        first records arrive, keep accumulating until the batch bucket
+        fills or batch_deadline_ms elapses — under sustained load the TPU
+        dispatch amortizes over a full bucket, while the deadline bounds
+        the latency a lone transaction can be held for."""
+        records = self._tx_consumer.poll(self.max_batch, poll_timeout_s)
+        if not records:
+            return records
+        deadline_s = self.cfg.batch_deadline_ms / 1e3
+        if deadline_s > 0 and len(records) < self.max_batch:
+            deadline = time.perf_counter() + deadline_s
+            while len(records) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                more = self._tx_consumer.poll(
+                    self.max_batch - len(records), remaining
+                )
+                if not more:
+                    break  # poll slept out the remaining deadline
+                records.extend(more)
+        return records
+
+    def _decode_batch(self, records: list) -> tuple[np.ndarray, list]:
+        n = len(records)
+        self._c_in.inc(n)
+        self._h_batch.observe(n)
+        x, txs, bad = decode_records(records)
+        if bad:
+            self._c_decode_err.inc(bad)
+        return x, txs
+
+    # -- one synchronous cycle (used by tests and the run loop) ------------
+    def step(self, poll_timeout_s: float = 0.0) -> int:
+        """Route one poll's worth of work; returns #transactions scored."""
+        self._drain_signals()
+        records = self._poll_batch(poll_timeout_s)
+        if not records:
+            return 0
+        x, txs = self._decode_batch(records)
+        t0 = time.perf_counter()
+        proba = np.asarray(self.score(x))
+        self._h_score_s.observe(time.perf_counter() - t0)
+        return self._route(x, txs, proba)
+
+    def _route(self, x: np.ndarray, txs: list, proba: np.ndarray) -> int:
+        fired = self.rules.evaluate(x, proba)
+        # group the micro-batch by fired rule: one batched process-start per
+        # (rule, process) instead of one engine round-trip per transaction —
+        # the engine amortizes its lock (and the remote client its HTTP hop)
+        # over the group, which is what lets L5 absorb the TPU scorer's
+        # output rate (VERDICT r1: engine throughput >= scorer throughput)
+        groups: dict[int, list[dict]] = {}
+        for tx, p, ridx in zip(txs, proba, fired):
+            rule = self.rules.rules[ridx]
+            variables = {
+                "transaction": tx,
+                "proba": float(p),
+                "customer_id": tx.get("id"),
+            }
+            variables.update(rule.set_vars)
+            groups.setdefault(ridx, []).append(variables)
+        for ridx, vars_list in groups.items():
+            rule = self.rules.rules[ridx]
+            try:
+                if self._start_batch is not None:
+                    pids = self._start_batch(rule.process, vars_list)
+                else:  # engine without the batch API: per-item, isolated
+                    pids = []
+                    for variables in vars_list:
+                        try:
+                            pids.append(
+                                self.engine.start_process(rule.process, variables)
+                            )
+                        except Exception:
+                            pids.append(None)
+            except Exception:
+                # bad rule target or unreachable remote engine: the whole
+                # group failed to start, but the routing loop (and the other
+                # groups in this poll) must keep going
+                self._c_start_err.inc(len(vars_list), labels={"type": rule.process})
+                continue
+            n_err = sum(1 for p in pids if p is None)
+            if n_err:
+                self._c_start_err.inc(n_err, labels={"type": rule.process})
+            n_ok = len(pids) - n_err
+            if n_ok:
+                self._c_out.inc(n_ok, labels={"type": rule.process})
+                self._c_rule.inc(n_ok, labels={"rule": rule.name})
+        return len(txs)
+
+    # -- daemon loop -------------------------------------------------------
+    def reset(self) -> None:
+        """Re-arm after stop() so the next run() actually loops. Called by
+        the supervisor before each respawn (NOT inside run(): clearing on
+        the service thread would race a concurrent stop() and erase it)."""
+        self._stop.clear()
+
+    def run(self, poll_timeout_s: float = 0.05, pipeline: bool = True) -> None:
+        if pipeline:
+            self._run_pipelined(poll_timeout_s)
+        else:
+            while not self._stop.is_set():
+                self.step(poll_timeout_s)
+
+    def _run_pipelined(self, poll_timeout_s: float) -> None:
+        """Overlap the device dispatch with everything else.
+
+        ``step`` blocks the loop for the full scorer round trip — tens of
+        ms through a tunneled TPU — during which no polling, rule eval, or
+        process starts happen. Here batch k's dispatch runs on a dedicated
+        thread (XLA releases the GIL for the device wait) while the loop
+        routes batch k-1's results into the engine and polls batch k+1:
+        the device and the Python/engine work pipeline instead of taking
+        turns. One stage in flight is enough — depth beyond 1 only adds
+        queueing latency because the loop itself is busy between waits.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def timed_score(x: np.ndarray) -> np.ndarray:
+            # time INSIDE the worker so the histogram records the scorer
+            # round trip, not dispatch + however long the loop polled
+            t0 = time.perf_counter()
+            proba = np.asarray(self.score(x))
+            self._h_score_s.observe(time.perf_counter() - t0)
+            return proba
+
+        def finish(pending: tuple) -> None:
+            pfut, px, ptxs = pending
+            try:
+                proba = pfut.result()
+            except Exception:
+                # a transient scorer failure (e.g. remote model timeout)
+                # drops this batch, not the routing loop
+                self._c_score_err.inc(len(ptxs))
+                return
+            self._route(px, ptxs, proba)
+
+        ex = ThreadPoolExecutor(1, thread_name_prefix="ccfd-router-score")
+        pending: tuple | None = None  # (future, x, txs)
+        try:
+            while not self._stop.is_set():
+                self._drain_signals()
+                # with a batch in flight, don't sleep on an empty topic:
+                # grab whatever is already queued and route the in-flight
+                # result promptly — a lone transaction's end-to-end latency
+                # stays ~one scorer round trip instead of round trip +
+                # poll_timeout (sparse-traffic p99)
+                records = self._poll_batch(
+                    0.0 if pending is not None else poll_timeout_s
+                )
+                fut = None
+                if records:
+                    x, txs = self._decode_batch(records)
+                    fut = ex.submit(timed_score, x)
+                if pending is not None:
+                    finish(pending)
+                pending = (fut, x, txs) if fut is not None else None
+        finally:
+            try:
+                if pending is not None:
+                    finish(pending)
+            finally:
+                ex.shutdown()
+
+    def start(
+        self, poll_timeout_s: float = 0.05, pipeline: bool = True
+    ) -> threading.Thread:
+        # direct (unsupervised) start: re-arm here, before the thread exists
+        self.reset()
+        t = threading.Thread(
+            target=self.run, args=(poll_timeout_s, pipeline),
+            daemon=True, name="ccfd-router",
+        )
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.stop()
+        self._tx_consumer.close()
+        self._resp_consumer.close()
+        self._notif_watcher.close()

@@ -1,0 +1,5 @@
+from ccfd_tpu.runtime.supervisor import (  # noqa: F401
+    ManagedService,
+    RestartPolicy,
+    Supervisor,
+)

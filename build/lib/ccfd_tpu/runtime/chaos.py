@@ -1,0 +1,96 @@
+"""Seeded fault injection over a Supervisor: chaos testing for the pipeline.
+
+The reference's failure story is entirely platform-delegated — k8s
+``restartPolicy: Always`` and rolling strategies (SURVEY.md §5: "no
+application-level retry/fault-injection in-tree"). This module makes the
+recovery machinery *testable*: a ``ChaosMonkey`` kills a randomly chosen
+supervised service on a seeded schedule, and the assertions that matter —
+the supervisor restarts it, consumers resume from committed offsets, the
+pipeline keeps scoring — run in CI (tests/test_chaos.py) instead of being
+discovered in production.
+
+Determinism: victim choice and kill times derive from ``seed``, so a chaos
+run is replayable. Every injection lands in ``history`` and, when a
+registry is given, in ``chaos_injections_total{service=...}``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.runtime.supervisor import ServiceState, Supervisor
+
+
+class ChaosMonkey:
+    def __init__(
+        self,
+        supervisor: Supervisor,
+        interval_s: float = 5.0,
+        seed: int = 0,
+        targets: list[str] | None = None,
+        registry: Registry | None = None,
+    ):
+        self._sup = supervisor
+        self.interval_s = interval_s
+        self._rng = random.Random(seed)
+        self._targets = list(targets) if targets is not None else None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.history: list[tuple[float, str]] = []  # (monotonic time, service)
+        self._c_injected = None
+        if registry is not None:
+            self._c_injected = registry.counter(
+                "chaos_injections_total", "injected service failures"
+            )
+
+    def _eligible(self) -> list[str]:
+        status = self._sup.status()
+        names = self._targets if self._targets is not None else sorted(status)
+        return [
+            n
+            for n in names
+            if status.get(n, {}).get("state") == ServiceState.RUNNING.value
+            # a Never-policy service (one-shot jobs like the producer)
+            # can't be restarted: injecting there doesn't test recovery,
+            # it just marks a healthy run FAILED and wedges readiness
+            and status.get(n, {}).get("policy") != "Never"
+        ]
+
+    def kill_one(self) -> str | None:
+        """Inject one failure now; returns the victim's name (or None if
+        nothing was RUNNING to kill)."""
+        victims = self._eligible()
+        if not victims:
+            return None
+        name = self._rng.choice(victims)
+        if not self._sup.inject_failure(name, reason="chaos-monkey"):
+            return None
+        self.history.append((time.monotonic(), name))
+        if self._c_injected is not None:
+            self._c_injected.inc(labels={"service": name})
+        return name
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._stop.wait(self.interval_s):
+                return
+            self.kill_one()
+
+    def start(self) -> "ChaosMonkey":
+        # re-arm BEFORE the thread exists: clearing inside run() would
+        # race a stop() issued right after start() and erase it — the
+        # same rule ManagedService.reset codifies for supervised services
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name="ccfd-chaos"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

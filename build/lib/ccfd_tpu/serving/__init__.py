@@ -1,0 +1,1 @@
+from ccfd_tpu.serving.scorer import Scorer  # noqa: F401

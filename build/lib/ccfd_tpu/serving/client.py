@@ -1,0 +1,102 @@
+"""HTTP client for a remote prediction server (Seldon-contract).
+
+The reference router and KIE server call Seldon over REST with a pooled
+HTTP client configured by ``SELDON_URL``/``SELDON_ENDPOINT``/``SELDON_TOKEN``
+/``SELDON_TIMEOUT``/``SELDON_POOL_SIZE`` (reference deploy/router.yaml:65-68,
+README.md:370-402). This client reproduces that contract over stdlib
+``http.client`` with a bounded connection pool, so the router/process-engine
+can run on a different host than the TPU scorer. Returned as a plain
+``score_fn(np (B,30)) -> np (B,)`` so it is interchangeable with the
+in-process ``Scorer.score`` everywhere.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import time
+import urllib.parse
+from typing import Any
+
+import numpy as np
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+
+
+class SeldonClient:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        u = urllib.parse.urlparse(cfg.seldon_url)
+        if u.scheme not in ("http", ""):
+            raise ValueError(f"unsupported scheme in SELDON_URL: {cfg.seldon_url!r}")
+        self._host = u.hostname or "localhost"
+        self._port = u.port or 80
+        self._path = "/" + cfg.seldon_endpoint.lstrip("/")
+        self._timeout = cfg.seldon_timeout_ms / 1000.0
+        self._pool: "queue.Queue[http.client.HTTPConnection]" = queue.Queue()
+        for _ in range(max(1, cfg.seldon_pool_size)):
+            self._pool.put(self._connect())
+
+    def _connect(self) -> http.client.HTTPConnection:
+        # Nagle off: headers+body ride separate segments, and a delayed ACK
+        # would stall the predict hop ~40 ms (see utils/httpclient.py)
+        from ccfd_tpu.utils.httpclient import _NodelayHTTPConnection
+
+        return _NodelayHTTPConnection(self._host, self._port, timeout=self._timeout)
+
+    def _request(self, body: dict[str, Any]) -> dict[str, Any]:
+        """POST with per-attempt SELDON_TIMEOUT and bounded retries.
+
+        Retries (CCFD_CLIENT_RETRIES, with short linear backoff) cover the
+        window where the supervisor is restarting a crashed scorer — the
+        reference has no app-level retry, only the timeout knob
+        (README.md:386-393), so a scorer restart drops messages there.
+        """
+        conn = self._pool.get()
+        try:
+            payload = json.dumps(body)
+            headers = {"Content-Type": "application/json"}
+            if self.cfg.seldon_token:
+                headers["Authorization"] = f"Bearer {self.cfg.seldon_token}"
+            attempts = max(1, self.cfg.client_retries + 1)
+            last_exc: Exception | None = None
+            for attempt in range(attempts):
+                try:
+                    conn.request("POST", self._path, payload, headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"prediction server returned {resp.status}: {data[:200]!r}"
+                        )
+                    return json.loads(data)
+                except (http.client.HTTPException, OSError) as e:
+                    # stale pooled connection or server mid-restart: reconnect
+                    last_exc = e
+                    conn.close()
+                    if attempt < attempts - 1:
+                        time.sleep(0.05 * (attempt + 1))
+                    conn = self._connect()
+            raise ConnectionError(
+                f"prediction server unreachable after {attempts} attempts"
+            ) from last_exc
+        finally:
+            self._pool.put(conn)
+
+    def score(self, x: np.ndarray) -> np.ndarray:
+        """(B, 30) -> (B,) proba_1 via POST <SELDON_URL>/<SELDON_ENDPOINT>."""
+        x = np.asarray(x, np.float32)
+        out = self._request(
+            {"data": {"names": list(FEATURE_NAMES), "ndarray": x.tolist()}}
+        )
+        nd = out["data"]["ndarray"]
+        return np.asarray([row[1] for row in nd], np.float32)
+
+    def close(self) -> None:
+        while not self._pool.empty():
+            try:
+                self._pool.get_nowait().close()
+            except queue.Empty:  # pragma: no cover
+                break

@@ -1,0 +1,446 @@
+"""Seldon-shaped inference graph, compiled into ONE jitted TPU function.
+
+The reference's model-serving layer is Seldon Core, whose unit of deployment
+is an *inference graph*: a tree of typed nodes declared in a SeldonDeployment
+CR (reference deploy/model/modelfull.json:18-52 — graph at 37-44 is the
+single-node case ``{"name": "modelfull", "type": "MODEL", "endpoint":
+{"type": "REST"}}``). Seldon's engine walks that tree at request time, one
+HTTP hop per node container. Node types (Seldon Core v1 semantics):
+
+- ``MODEL``              — scores the features
+- ``TRANSFORMER``        — rewrites the input before its child sees it
+- ``OUTPUT_TRANSFORMER`` — rewrites its child's output
+- ``COMBINER``           — merges the outputs of >=2 children (ensembles)
+- ``ROUTER``             — sends each request to one of >=2 children (A/B,
+                           canary, bandits)
+
+TPU-first redesign: the graph is *compiled*, not *walked*. ``build()``
+closes the whole tree into a single ``(params, x) -> proba_1`` function that
+runs under one ``jax.jit`` — every transformer/combiner fuses into the model
+matmuls, and there are zero per-node network hops or host round-trips.
+
+Routing is the interesting re-mapping. Seldon routes by picking ONE child
+container per request. Under XLA that would be data-dependent control flow
+with ragged per-branch batches — retrace city. Instead every branch scores
+the full batch on the MXU and the router contributes per-row weights that
+``select`` the result (one-hot for hard routing, arbitrary simplex for
+traffic splits). For fraud-scorer-sized branches the redundant FLOPs are
+noise next to the dispatch overhead they avoid, shapes stay static, and the
+whole ensemble still compiles into one executable.
+
+Params are a ``{node_name: node_params}`` dict, so online retrain can
+hot-swap any node's weights through ``Scorer.swap_params`` unchanged.
+``as_model_spec()`` registers the compiled graph in the model registry,
+which makes a multi-node ensemble a drop-in ``CCFD_MODEL`` for the whole
+serving stack (Scorer bucketing, REST server, warmup).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.models.registry import ModelSpec, get_model, register_model
+
+NODE_TYPES = ("MODEL", "TRANSFORMER", "OUTPUT_TRANSFORMER", "COMBINER", "ROUTER")
+
+_EPS = 1e-6
+
+
+def _logit(p: jax.Array) -> jax.Array:
+    p = jnp.clip(p, _EPS, 1.0 - _EPS)
+    return jnp.log(p) - jnp.log1p(-p)
+
+
+def _feature_index(feature: Any) -> int:
+    if isinstance(feature, int):
+        return feature
+    return FEATURE_NAMES.index(str(feature))
+
+
+# --------------------------------------------------------------------------
+# Component registries: implementation name -> (init, apply).
+#
+# init(key, config) -> params pytree ({} for stateless components).
+# Transformer apply(params, x, config) -> x'              (B,F) -> (B,F)
+# Output-transformer apply(params, p, config) -> p'       (B,)  -> (B,)
+# Combiner apply(params, ps, config) -> p                 [(B,)]*n -> (B,)
+# Router apply(params, x, config) -> weights              (B,) -> (B,n) simplex
+# --------------------------------------------------------------------------
+
+_TRANSFORMERS: dict[str, tuple[Callable, Callable]] = {}
+_OUTPUT_TRANSFORMERS: dict[str, tuple[Callable, Callable]] = {}
+_COMBINERS: dict[str, tuple[Callable, Callable]] = {}
+_ROUTERS: dict[str, tuple[Callable, Callable]] = {}
+
+_KIND_REGISTRY = {
+    "TRANSFORMER": _TRANSFORMERS,
+    "OUTPUT_TRANSFORMER": _OUTPUT_TRANSFORMERS,
+    "COMBINER": _COMBINERS,
+    "ROUTER": _ROUTERS,
+}
+
+
+def register_component(kind: str, name: str, init: Callable, apply: Callable) -> None:
+    _KIND_REGISTRY[kind][name] = (init, apply)
+
+
+def _no_params(key, config):
+    return {}
+
+
+# -- transformers ----------------------------------------------------------
+
+def _standardize_init(key, config):
+    n = len(FEATURE_NAMES)
+    mean = jnp.asarray(config.get("mean", [0.0] * n), jnp.float32)
+    scale = jnp.asarray(config.get("scale", [1.0] * n), jnp.float32)
+    return {"mean": mean, "scale": jnp.where(scale == 0.0, 1.0, scale)}
+
+
+register_component(
+    "TRANSFORMER", "standardize", _standardize_init,
+    lambda p, x, cfg: (x - p["mean"]) / p["scale"],
+)
+register_component(
+    "TRANSFORMER", "identity", _no_params, lambda p, x, cfg: x
+)
+register_component(
+    "TRANSFORMER", "clip", _no_params,
+    lambda p, x, cfg: jnp.clip(
+        x, float(cfg.get("lo", -1e6)), float(cfg.get("hi", 1e6))
+    ),
+)
+
+# -- output transformers ---------------------------------------------------
+
+register_component(
+    "OUTPUT_TRANSFORMER", "identity", _no_params, lambda p, y, cfg: y
+)
+# Platt scaling: recalibrate a scorer's probabilities without retraining it.
+register_component(
+    "OUTPUT_TRANSFORMER", "platt",
+    lambda key, cfg: {
+        "a": jnp.asarray(float(cfg.get("a", 1.0)), jnp.float32),
+        "b": jnp.asarray(float(cfg.get("b", 0.0)), jnp.float32),
+    },
+    lambda p, y, cfg: jax.nn.sigmoid(p["a"] * _logit(y) + p["b"]),
+)
+
+# -- combiners -------------------------------------------------------------
+
+register_component(
+    "COMBINER", "average", _no_params,
+    lambda p, ys, cfg: jnp.mean(jnp.stack(ys), axis=0),
+)
+register_component(
+    "COMBINER", "max", _no_params,
+    lambda p, ys, cfg: jnp.max(jnp.stack(ys), axis=0),
+)
+
+
+def _weighted_init(key, config):
+    w = config.get("weights")
+    if w is None:
+        raise ValueError("combiner 'weighted' needs config weights: [..]")
+    w = jnp.asarray([float(v) for v in w], jnp.float32)
+    return {"w": w / jnp.sum(w)}
+
+
+register_component(
+    "COMBINER", "weighted", _weighted_init,
+    lambda p, ys, cfg: jnp.einsum("n,nb->b", p["w"], jnp.stack(ys)),
+)
+
+# -- routers ---------------------------------------------------------------
+
+
+def _feature_threshold_weights(p, x, cfg):
+    """Hard route: child 1 when feature > threshold else child 0 (one-hot)."""
+    j = _feature_index(cfg.get("feature", "Amount"))
+    hi = (x[:, j] > float(cfg.get("threshold", 0.0))).astype(jnp.float32)
+    return jnp.stack([1.0 - hi, hi], axis=1)
+
+
+register_component(
+    "ROUTER", "feature_threshold", _no_params, _feature_threshold_weights
+)
+
+
+def _hash_split_init(key, config):
+    w = config.get("weights")
+    if w is None:
+        raise ValueError("router 'hash_split' needs config weights: [..]")
+    w = jnp.asarray([float(v) for v in w], jnp.float32)
+    return {"cum": jnp.cumsum(w / jnp.sum(w))}
+
+
+def _hash_split_weights(p, x, cfg):
+    """Deterministic traffic split (A/B, canary): a cheap per-row hash of the
+    features lands each request in a weight bucket, so the same transaction
+    always routes to the same arm — no host RNG, no state, jit-stable."""
+    h = jnp.dot(x, jnp.arange(1.0, x.shape[1] + 1.0, dtype=x.dtype) * 0.61803398875)
+    u = jnp.mod(jnp.abs(h), 1.0)
+    arm = jnp.sum(u[:, None] >= p["cum"][None, :-1], axis=1)
+    return jax.nn.one_hot(arm, p["cum"].shape[0], dtype=jnp.float32)
+
+
+register_component("ROUTER", "hash_split", _hash_split_init, _hash_split_weights)
+
+
+# --------------------------------------------------------------------------
+# Graph spec + compiler
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Node:
+    """One node of the inference tree (reference modelfull.json:37-44 shape)."""
+
+    name: str
+    type: str
+    implementation: str = ""  # component/model name; defaults to node name
+    children: tuple["Node", ...] = ()
+    config: Mapping[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.type not in NODE_TYPES:
+            raise ValueError(f"node {self.name!r}: unknown type {self.type!r}")
+        n = len(self.children)
+        if self.type == "MODEL" and n != 0:
+            # Seldon chains MODEL->child by feeding the response forward; a
+            # (B,) probability is not a feature row, so we require explicit
+            # OUTPUT_TRANSFORMER nodes instead of implicit chaining.
+            raise ValueError(f"MODEL node {self.name!r} must be a leaf")
+        if self.type in ("TRANSFORMER", "OUTPUT_TRANSFORMER") and n != 1:
+            raise ValueError(f"{self.type} node {self.name!r} needs exactly 1 child")
+        if self.type in ("COMBINER", "ROUTER") and n < 2:
+            raise ValueError(f"{self.type} node {self.name!r} needs >=2 children")
+
+    @property
+    def impl(self) -> str:
+        return self.implementation or self.name
+
+
+_GRAPH_NAMES: set[str] = set()  # registry names owned by graphs (re-register ok)
+
+
+class InferenceGraph:
+    """A validated node tree plus its compiled single-dispatch evaluator."""
+
+    def __init__(self, root: Node, name: str | None = None):
+        self.root = root
+        self.name = name or root.name
+        names: list[str] = []
+
+        def walk(n: Node):
+            names.append(n.name)
+            for c in n.children:
+                walk(c)
+
+        walk(root)
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate node names in graph: {sorted(names)}")
+        self.node_names = tuple(names)
+
+        # Fail arity mismatches at load time with the node named, not at
+        # warmup deep inside jit as an anonymous einsum shape error.
+        def check_arity(n: Node):
+            kids = len(n.children)
+            if n.type == "ROUTER" and n.impl == "feature_threshold" and kids != 2:
+                raise ValueError(
+                    f"router {n.name!r} (feature_threshold) needs exactly 2 "
+                    f"children, has {kids}"
+                )
+            w = n.config.get("weights")
+            if (
+                n.type in ("ROUTER", "COMBINER")
+                and w is not None
+                and len(w) != kids
+            ):
+                raise ValueError(
+                    f"{n.type.lower()} {n.name!r}: {len(w)} weights for "
+                    f"{kids} children"
+                )
+            for c in n.children:
+                check_arity(c)
+
+        check_arity(root)
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def from_cr(cr: Mapping[str, Any]) -> "InferenceGraph":
+        """Load from a SeldonDeployment-shaped CR dict (modelfull.json:18-52).
+
+        Reads ``spec.predictors[0].graph``; each node is ``{name, type,
+        children, parameters}`` with Seldon's ``parameters`` list of
+        ``{name, value, type}`` mapped onto the component config.
+        """
+        try:
+            graph = cr["spec"]["predictors"][0]["graph"]
+        except (KeyError, IndexError, TypeError):
+            graph = cr  # allow passing the bare graph dict
+        name = str(
+            cr.get("metadata", {}).get("name", "") if isinstance(cr, Mapping) else ""
+        )
+        return InferenceGraph(InferenceGraph._parse_node(graph), name=name or None)
+
+    @staticmethod
+    def from_cr_file(path: str) -> "InferenceGraph":
+        with open(path) as f:
+            return InferenceGraph.from_cr(json.load(f))
+
+    @staticmethod
+    def _parse_node(d: Mapping[str, Any]) -> Node:
+        config: dict[str, Any] = dict(d.get("config", {}))
+        for p in d.get("parameters", ()) or ():
+            v = p.get("value")
+            t = str(p.get("type", "STRING")).upper()
+            if t == "INT":
+                v = int(v)
+            elif t in ("FLOAT", "DOUBLE"):
+                v = float(v)
+            elif t == "BOOL":
+                v = str(v).lower() in ("1", "true", "yes")
+            elif t == "JSON":
+                v = json.loads(v) if isinstance(v, str) else v
+            config[str(p["name"])] = v
+        return Node(
+            name=str(d["name"]),
+            type=str(d.get("type", "MODEL")).upper(),
+            implementation=str(d.get("implementation", "") or ""),
+            children=tuple(
+                InferenceGraph._parse_node(c) for c in d.get("children", ()) or ()
+            ),
+            config=config,
+        )
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, key: jax.Array) -> dict[str, Any]:
+        """Per-node params keyed by node name (stateless nodes get ``{}``)."""
+        params: dict[str, Any] = {}
+
+        def walk(n: Node, key):
+            key, sub = jax.random.split(key)
+            if n.type == "MODEL":
+                params[n.name] = get_model(n.impl).init(sub)
+            else:
+                init_fn, _ = self._component(n)
+                params[n.name] = init_fn(sub, n.config)
+            for c in n.children:
+                key = walk(c, key)
+            return key
+
+        walk(self.root, key)
+        return params
+
+    @staticmethod
+    def _component(n: Node) -> tuple[Callable, Callable]:
+        reg = _KIND_REGISTRY[n.type]
+        try:
+            return reg[n.impl]
+        except KeyError:
+            raise KeyError(
+                f"no {n.type} component {n.impl!r}; known: {sorted(reg)}"
+            ) from None
+
+    # -- compilation -------------------------------------------------------
+
+    def build(self) -> Callable[..., jax.Array]:
+        """Close the tree into one ``(params, x, compute_dtype=) -> (B,)``.
+
+        Purely functional over the params dict, so it jits, grads, and
+        shards like any model ``apply``.
+        """
+        import inspect
+
+        def compile_node(n: Node) -> Callable[[dict, jax.Array, Any], jax.Array]:
+            if n.type == "MODEL":
+                spec = get_model(n.impl)
+                takes_dtype = "compute_dtype" in inspect.signature(
+                    spec.apply
+                ).parameters
+
+                def run_model(params, x, dtype, _spec=spec, _td=takes_dtype, _n=n):
+                    p = params[_n.name]
+                    return _spec.apply(p, x, compute_dtype=dtype) if _td else _spec.apply(p, x)
+
+                return run_model
+            _, apply_fn = self._component(n)
+            kids = tuple(compile_node(c) for c in n.children)
+            if n.type == "TRANSFORMER":
+                return lambda params, x, dtype, _a=apply_fn, _k=kids[0], _n=n: _k(
+                    params, _a(params[_n.name], x, _n.config), dtype
+                )
+            if n.type == "OUTPUT_TRANSFORMER":
+                return lambda params, x, dtype, _a=apply_fn, _k=kids[0], _n=n: _a(
+                    params[_n.name], _k(params, x, dtype), _n.config
+                )
+            if n.type == "COMBINER":
+                return lambda params, x, dtype, _a=apply_fn, _ks=kids, _n=n: _a(
+                    params[_n.name], [k(params, x, dtype) for k in _ks], _n.config
+                )
+            # ROUTER: every branch scores the full batch; the router's per-row
+            # simplex weights select/blend — static shapes, one executable.
+            def run_router(params, x, dtype, _a=apply_fn, _ks=kids, _n=n):
+                w = _a(params[_n.name], x, _n.config)
+                ys = jnp.stack([k(params, x, dtype) for k in _ks])
+                return jnp.einsum("bn,nb->b", w.astype(jnp.float32), ys)
+
+            return run_router
+
+        root_fn = compile_node(self.root)
+
+        def apply(params, x, compute_dtype=jnp.float32):
+            return root_fn(params, x, compute_dtype)
+
+        return apply
+
+    # -- registry integration ---------------------------------------------
+
+    def as_model_spec(self, register: bool = True) -> ModelSpec:
+        """Expose the compiled graph as a registry model, making an ensemble
+        a drop-in ``CCFD_MODEL`` for Scorer/server/CLI."""
+        graph_apply = self.build()
+        jitted = jax.jit(graph_apply, static_argnames=("compute_dtype",))
+
+        def logits(params, x, compute_dtype=jnp.float32):
+            return _logit(graph_apply(params, x, compute_dtype=compute_dtype))
+
+        spec = ModelSpec(
+            name=self.name,
+            init=self.init,
+            apply=jitted,
+            logits=logits,
+            trainable=False,  # node set may include non-differentiable trees
+        )
+        if register:
+            # Never clobber a built-in model: a CR named "mlp"/"modelfull"
+            # would silently swap graph-shaped params under every later
+            # Scorer(model_name=...). Re-registering a graph name is fine
+            # (reloading a CR is the common case).
+            try:
+                existing = get_model(self.name)
+            except KeyError:
+                existing = None
+            if existing is not None and self.name not in _GRAPH_NAMES:
+                raise ValueError(
+                    f"graph name {self.name!r} collides with a registered "
+                    f"model; set metadata.name in the CR to a unique name"
+                )
+            _GRAPH_NAMES.add(self.name)
+            register_model(spec)
+        return spec
+
+
+def load_graph_cr(path: str, register: bool = True) -> ModelSpec:
+    """CR file -> registered ModelSpec (what ``CCFD_GRAPH_CR`` points at)."""
+    g = InferenceGraph.from_cr_file(path)
+    return g.as_model_spec(register=register)

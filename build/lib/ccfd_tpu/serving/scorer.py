@@ -1,0 +1,571 @@
+"""Compiled TPU scorer: fixed-shape bucketed dispatch + hot-swappable params.
+
+This replaces the reference's Seldon-wrapped CPU model container
+(reference deploy/model/modelfull.json:18-52) as the prediction hop. Design
+follows the latency plan in SURVEY.md §7 "hard parts":
+
+- **Fixed batch shapes.** XLA compiles one executable per input shape; a
+  streaming workload with ragged batch sizes would re-trace constantly. The
+  scorer pads every request batch up to a configured bucket
+  (CCFD_BATCH_SIZES) so steady state reuses a handful of cached executables.
+- **Warmup.** ``warmup()`` runs every bucket once so no request pays the
+  compile cost.
+- **Double-buffered params.** Online retrain (BASELINE.json configs[4])
+  must not pause serving: ``swap_params`` device-puts the new pytree and
+  swaps a reference atomically between dispatches — in-flight calls keep the
+  old buffers alive, the next call picks up the new ones.
+- **Mesh-sharded dispatch.** The reference scales serving by k8s replicas +
+  Kafka partitioning (reference deploy/frauddetection_cr.yaml:76,
+  router.yaml:32); the TPU analog is ONE scorer whose batch shards over the
+  ``"data"`` axis of a ``jax.sharding.Mesh`` (SURVEY.md §7 stage 6).
+  ``Scorer(mesh=...)`` keeps the exact same bucketing/warmup/swap surface:
+  buckets round up to multiples of the data-axis size, inputs are
+  device_put with a NamedSharding so each chip receives only its rows, and
+  params ride replicated (default) or megatron-sharded over the ``"model"``
+  axis (``param_partition="model"``, layout in ccfd_tpu/parallel/sharding.py).
+  The fused Pallas kernel composes via ``shard_map``: every chip runs the
+  single-chip kernel on its shard — collectives only appear if the model
+  axis is used, and XLA schedules those.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ccfd_tpu.data.ccfd import NUM_FEATURES
+from ccfd_tpu.models.registry import ModelSpec, get_model
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def _host_cast(a: Any) -> np.ndarray:
+    """Host copy of one param leaf for the numpy tier: floating leaves go to
+    f32, integer leaves (tree feature indices) keep an integer dtype — a
+    uniform f32 cast would turn gather indices into floats and crash
+    ``apply_numpy`` for the tree family."""
+    a = np.asarray(a)
+    if np.issubdtype(a.dtype, np.floating):
+        return np.asarray(a, np.float32)
+    return a
+
+
+class Scorer:
+    def __init__(
+        self,
+        model_name: str = "mlp",
+        params: Any = None,
+        batch_sizes: Sequence[int] = (16, 128, 1024, 4096, 16384),
+        compute_dtype: str = "bfloat16",
+        num_features: int = NUM_FEATURES,
+        seed: int = 0,
+        use_fused: bool | None = None,
+        mesh: Any = None,
+        param_partition: str = "replicated",
+        host_tier_rows: int | None = None,
+        dispatch_deadline_ms: float | None = None,
+    ):
+        self.spec: ModelSpec = get_model(model_name)
+        self.num_features = num_features
+        self.mesh = mesh
+        if param_partition not in ("replicated", "model"):
+            raise ValueError(f"unknown param_partition {param_partition!r}")
+        if param_partition == "model" and model_name != "mlp":
+            # a silent fallback to replication would hand a caller who needs
+            # the sharded layout (model too big replicated) an OOM later
+            raise ValueError(
+                f"param_partition='model' has a layout only for 'mlp', "
+                f"not {model_name!r}"
+            )
+        self._param_partition = param_partition
+        self._batch_sharding = None
+        self._param_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ccfd_tpu.parallel.mesh import DATA_AXIS
+
+            self._data_size = mesh.shape[DATA_AXIS]
+            # every bucket must split evenly over the data axis
+            batch_sizes = {
+                -(-b // self._data_size) * self._data_size for b in batch_sizes
+            }
+            self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+            self._out_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self.batch_sizes = tuple(sorted(batch_sizes))
+        self._params = params if params is not None else self.spec.init(
+            jax.random.PRNGKey(seed)
+        )
+        if mesh is not None:
+            from ccfd_tpu.parallel import sharding as shardlib
+
+            if param_partition == "model":
+                self._param_sharding = shardlib.mlp_param_spec(self._params, mesh)
+            else:
+                rep = shardlib.replicated(mesh)
+                self._param_sharding = jax.tree.map(lambda _: rep, self._params)
+            self._params = jax.device_put(self._params, self._param_sharding)
+        else:
+            self._params = jax.device_put(self._params)
+        self._lock = threading.Lock()
+        dtype = _DTYPES.get(compute_dtype, jnp.float32)
+        # models without a dtype knob (e.g. trees) take (params, x) only
+        import inspect
+
+        sig = inspect.signature(self.spec.apply)
+        if "compute_dtype" in sig.parameters:
+            self._apply = lambda p, x: self.spec.apply(p, x, compute_dtype=dtype)
+        else:
+            self._apply = self.spec.apply
+        if mesh is not None:
+            # constrain the output to stay data-sharded: the partitioner
+            # must not all-gather probabilities onto one chip before D2H
+            self._apply = jax.jit(self._apply, out_shardings=self._out_sharding)
+
+        # Pallas fused path: the whole MLP in one kernel, weights VMEM-
+        # resident (ccfd_tpu/ops/fused_mlp.py). Auto-on for the flagship MLP
+        # in reduced precision; params are re-folded on every swap so online
+        # retrain keeps working. ``use_fused=False`` forces the XLA path.
+        self._fused_params = None
+        if use_fused is None:
+            # auto only on real TPU: the CPU interpreter runs the same kernel
+            # body but orders of magnitude slower (tests opt in explicitly)
+            use_fused = (
+                self.spec.name == "mlp"
+                and dtype == jnp.bfloat16
+                and jax.default_backend() == "tpu"
+            )
+        # Host latency tier: when the accelerator sits behind a high-RTT
+        # attachment (a tunneled TPU adds tens of ms per dispatch), a small
+        # request batch is faster on the HOST in plain numpy than the wire
+        # round trip — ~50us for this MLP at 16-256 rows vs a full RTT. The
+        # device keeps the throughput work (bulk/pipelined scoring, big
+        # buckets); requests at or under ``host_tier_rows`` score on a host
+        # copy of the params. Auto-on (256 rows) for models with a numpy
+        # forward when the default backend is an accelerator; 0 disables.
+        # Numerical note: the host tier computes f32, the device path
+        # bf16 — within ~1e-2 in probability (asserted by tests).
+        self._host_tier_auto = host_tier_rows is None
+        if host_tier_rows is None:
+            # provisional until warmup() measures the attachment: a tunneled
+            # chip (tens of ms RTT) justifies thousands of host rows, a
+            # local chip only tens — ``_autotune_host_tier`` picks the real
+            # crossover from measured device RTT vs measured host rate
+            host_tier_rows = (
+                256
+                if (
+                    self.spec.apply_numpy is not None
+                    and mesh is None
+                    and jax.default_backend() not in ("cpu",)
+                )
+                else 0
+            )
+        self.host_tier_rows = int(host_tier_rows)
+        self._host_params = None
+        # swap listeners: components holding a derived copy of the params
+        # (e.g. the C++ serving front's in-process host model) register to
+        # be re-fed on every swap_params so online retrain reaches them too.
+        # Delivery is serialized under _notify_lock and ordered by a swap
+        # generation so two concurrent swap_params calls can't install their
+        # listeners' copies in reverse order (stale params winning).
+        self._swap_listeners: list[Any] = []
+        self._notify_lock = threading.Lock()
+        self._swap_gen = 0
+        self._swap_delivered_gen = 0
+        # Dispatch deadline (server-side SELDON_TIMEOUT analog,
+        # /root/reference/README.md:386-393): the serving ``score`` path
+        # bounds its device round trip; a wedged attachment (tunnel hang
+        # inside a device sync) times out, marks the device wedged, and
+        # serving continues on the host tier until a probe sees recovery.
+        # None = auto: SELDON_TIMEOUT ms on accelerator backends, off on CPU
+        # (no attachment to wedge) and on meshes (the dryrun/virtual path).
+        if dispatch_deadline_ms is None:
+            if mesh is None and jax.default_backend() not in ("cpu",):
+                from ccfd_tpu.config import Config
+
+                # env-backed Config is the single parser for both knobs;
+                # callers holding a programmatic Config pass
+                # cfg.scorer_dispatch_deadline_ms() instead of None
+                dispatch_deadline_ms = Config.from_env().scorer_dispatch_deadline_ms()
+            else:
+                dispatch_deadline_ms = 0.0
+        self.dispatch_deadline_s = float(dispatch_deadline_ms) / 1e3
+        self._dispatcher = None
+        self._wedge = None
+        self.dispatch_timeouts = 0
+        self.host_fallback_scores = 0
+        # Host params are kept whenever the family has a host forward: the
+        # latency tier routes by host_tier_rows, the wedge fallback needs
+        # them armed BEFORE a wedge (they cannot be pulled from a hung
+        # device later), and the C++ front's in-IO-thread model derives its
+        # copy from them on every backend (its SIMD forward beats even a
+        # local jax dispatch for small requests). One numpy copy of the
+        # params; refreshed on every swap.
+        if self.spec.apply_numpy is not None:
+            self._host_params = jax.tree.map(
+                _host_cast, params if params is not None else self._params
+            )
+        if self.host_tier_rows > 0 and self._host_params is None:
+            self.host_tier_rows = 0
+        if self.dispatch_deadline_s > 0:
+            from ccfd_tpu.serving.dispatch import DeviceDispatcher, WedgeMonitor
+
+            self._dispatcher = DeviceDispatcher()
+            probe_rows = min(self.batch_sizes)
+            probe_x = np.zeros((probe_rows, self.num_features), np.float32)
+            self._wedge = WedgeMonitor(
+                self._dispatcher,
+                lambda: self.score_pipelined(probe_x, depth=1),
+                deadline_s=self.dispatch_deadline_s,
+            )
+        if use_fused:
+            from ccfd_tpu.ops import fused_mlp
+
+            self._fused_mod = fused_mlp
+            try:
+                self._fused_params = self._put_fused(
+                    fused_mlp.fold_for_kernel(self._params)
+                )
+            except (KeyError, TypeError, ValueError):
+                self._fused_params = None  # incompatible layout: XLA path
+            self._fused_interpret = jax.default_backend() == "cpu"
+            self._fused_sharded_cache: dict[int, Any] = {}
+
+    def _put_fused(self, folded: Any) -> Any:
+        """Fused weights live whole in every chip's VMEM: replicate on mesh."""
+        if self.mesh is None:
+            return folded
+        from ccfd_tpu.parallel.sharding import replicated
+
+        return jax.device_put(folded, replicated(self.mesh))
+
+    def _put_batch(self, chunk: np.ndarray) -> jax.Array:
+        """H2D with placement: on a mesh each chip gets only its row shard."""
+        if self._batch_sharding is None:
+            return jnp.asarray(chunk)
+        return jax.device_put(chunk, self._batch_sharding)
+
+    def _fused_apply(self, fused_params: Any, x: jax.Array) -> jax.Array:
+        rows = x.shape[0] if self.mesh is None else x.shape[0] // self._data_size
+        tile = min(rows, self._fused_mod.DEFAULT_TILE)
+        while rows % tile:  # largest power-of-two-ish divisor <= 512
+            tile //= 2
+        if self.mesh is None:
+            return self._fused_mod.fused_mlp_score(
+                fused_params, x, tile=tile, interpret=self._fused_interpret
+            )
+        return self._fused_sharded(tile)(fused_params, x)
+
+    def _fused_sharded(self, tile: int) -> Any:
+        """SPMD composition of the single-chip Pallas kernel: ``shard_map``
+        over the data axis runs the kernel on each chip's row shard with the
+        full (replicated) weights — the TPU-native form of the reference's
+        "more replicas" scaling (reference deploy/frauddetection_cr.yaml:76).
+        Cached per tile so each bucket compiles once."""
+        fn = self._fused_sharded_cache.get(tile)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ccfd_tpu.parallel.mesh import DATA_AXIS
+
+            def per_chip(p, xs):
+                return self._fused_mod.fused_mlp_score(
+                    p, xs, tile=tile, interpret=self._fused_interpret
+                )
+
+            fn = jax.jit(
+                jax.shard_map(
+                    per_chip,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(DATA_AXIS, None)),
+                    out_specs=P(DATA_AXIS),
+                    # pallas_call emits ShapeDtypeStructs without a vma
+                    # annotation; the kernel is elementwise-per-shard, so
+                    # the varying-across-mesh check adds nothing here
+                    check_vma=False,
+                )
+            )
+            self._fused_sharded_cache[tile] = fn
+        return fn
+
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    def bucket(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return self.batch_sizes[-1]
+
+    @property
+    def fused(self) -> bool:
+        return self._fused_params is not None
+
+    def warmup(self) -> None:
+        """Compile every bucket (and measure the host-tier crossover).
+
+        Deadline-aware when the dispatch guard is on: a wedged attachment at
+        startup (the failure ADVICE r2 flagged for serve/router bring-up)
+        marks the device wedged after ``CCFD_WARMUP_DEADLINE_S`` (default
+        180 s — first XLA compile through a tunnel runs tens of seconds) and
+        serving starts in host-fallback mode instead of hanging."""
+        if self._dispatcher is None:
+            self._warmup_body()
+            return
+        import os as _os
+
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+        budget_s = float(_os.environ.get("CCFD_WARMUP_DEADLINE_S", "180"))
+        try:
+            self._dispatcher.call(self._warmup_body, budget_s)
+        except ScorerTimeout:
+            self.dispatch_timeouts += 1
+            self._wedge.mark_wedged()
+
+    def _warmup_body(self) -> None:
+        for b in self.batch_sizes:
+            if self._fused_params is not None:
+                jax.block_until_ready(
+                    self._fused_apply(
+                        self._fused_params,
+                        self._put_batch(
+                            np.zeros((b, self.num_features), ml_dtypes.bfloat16)
+                        ),
+                    )
+                )
+            else:
+                jax.block_until_ready(
+                    self._apply(
+                        self._params,
+                        self._put_batch(np.zeros((b, self.num_features), np.float32)),
+                    )
+                )
+        # autotune refines an ARMED auto tier (provisional 256 until
+        # measured); host_tier_rows == 0 means the auto policy resolved the
+        # tier OFF (cpu backend / mesh) — host params may still exist for
+        # the wedge fallback and the C++ front, and must not re-arm it here
+        if (
+            self._host_tier_auto
+            and self.host_tier_rows > 0
+            and self._host_params is not None
+        ):
+            self.host_tier_rows = self._autotune_host_tier()
+
+    def _autotune_host_tier(self) -> int:
+        """Measure the crossover between host and device scoring.
+
+        The right host-tier threshold is a property of the ATTACHMENT, not
+        a constant: through a tunneled TPU one dispatch costs tens of ms
+        and the host wins up to thousands of rows; on a locally-attached
+        chip the RTT is sub-ms and the host should only keep tiny
+        requests. Times the smallest compiled bucket's full dispatch
+        (median of 5) against the host forward's per-row rate and returns
+        the row count where host cost reaches half the device RTT —
+        halving keeps latency strictly better on the host side while the
+        device keeps every batch where its bandwidth starts to matter.
+        Clamped to 8192 (the native front's per-request row cap).
+        """
+        import time as _time
+
+        b = self.batch_sizes[0]
+        with self._lock:
+            params = self._params
+            fused = self._fused_params
+            host_params = self._host_params
+        if fused is not None:
+            xb = np.zeros((b, self.num_features), ml_dtypes.bfloat16)
+            dispatch = lambda: self._fused_apply(fused, self._put_batch(xb))  # noqa: E731
+        else:
+            xf = np.zeros((b, self.num_features), np.float32)
+            dispatch = lambda: self._apply(params, self._put_batch(xf))  # noqa: E731
+        rtts = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(dispatch())
+            rtts.append(_time.perf_counter() - t0)
+        rtt_s = sorted(rtts)[len(rtts) // 2]
+
+        probe_rows = 256
+        xh = np.zeros((probe_rows, self.num_features), np.float32)
+        self.spec.apply_numpy(host_params, xh)  # warm the numpy path
+        n = 0
+        t0 = _time.perf_counter()
+        while True:
+            self.spec.apply_numpy(host_params, xh)
+            n += 1
+            elapsed = _time.perf_counter() - t0
+            if elapsed > 0.02 and n >= 3:
+                break
+        host_s_per_row = elapsed / (n * probe_rows)
+        thr = int(rtt_s * 0.5 / max(host_s_per_row, 1e-9))
+        return max(0, min(thr, 8192))
+
+    def swap_params(self, new_params: Any) -> None:
+        """Atomically publish retrained params without pausing serving.
+
+        Copies into fresh buffers: ``device_put`` on already-committed arrays
+        is an aliasing no-op, and aliased buffers would be deleted under us
+        when the trainer's next donated step consumes its argument.
+        """
+        if self._param_sharding is not None:
+            # re-lay the fresh tree onto the mesh with the serving sharding
+            staged = jax.device_put(
+                jax.tree.map(lambda a: np.array(a), new_params),
+                self._param_sharding,
+            )
+        else:
+            staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
+        jax.block_until_ready(staged)
+        staged_fused = None
+        # gate on the fused MODULE, not the current fused params: one
+        # unfoldable swap drops to the XLA path, but a later foldable tree
+        # must re-enable the kernel
+        if getattr(self, "_fused_mod", None) is not None:
+            try:
+                staged_fused = self._put_fused(self._fused_mod.fold_for_kernel(staged))
+                jax.block_until_ready(staged_fused)
+            except (KeyError, TypeError, ValueError):
+                staged_fused = None  # incompatible layout: drop to XLA path
+        staged_host = None
+        if self._host_params is not None:
+            staged_host = jax.tree.map(_host_cast, new_params)
+        with self._lock:
+            self._params = staged
+            # never keep serving stale fused weights: an unfoldable tree
+            # disables the fused path rather than pinning the old params
+            self._fused_params = staged_fused
+            if staged_host is not None:
+                self._host_params = staged_host
+            listeners = list(self._swap_listeners)
+            self._swap_gen += 1
+            gen = self._swap_gen
+        if listeners:
+            host_tree = (
+                staged_host
+                if staged_host is not None
+                else jax.tree.map(_host_cast, new_params)
+            )
+            # outside the params lock (listeners may be slow), but serialized
+            # and generation-checked: if a newer swap already delivered, this
+            # older tree must not overwrite the listeners' copies
+            with self._notify_lock:
+                if gen <= self._swap_delivered_gen:
+                    return
+                self._swap_delivered_gen = gen
+                for fn in listeners:
+                    try:
+                        fn(host_tree)
+                    except Exception:  # noqa: BLE001 - must not break swaps
+                        pass
+
+    def add_swap_listener(self, fn: Any) -> None:
+        """``fn(host_params_numpy_tree)`` runs after every ``swap_params``."""
+        with self._lock:
+            self._swap_listeners.append(fn)
+
+    def remove_swap_listener(self, fn: Any) -> None:
+        with self._lock:
+            if fn in self._swap_listeners:
+                self._swap_listeners.remove(fn)
+
+    def score_pipelined(self, x: np.ndarray, depth: int = 2) -> np.ndarray:
+        """Bulk scoring with ``depth`` dispatches in flight.
+
+        JAX dispatch is async: by enqueuing the next chunk's H2D + kernel
+        before blocking on the previous chunk's D2H, transfer and compute
+        overlap. Wins when the host<->device wire dominates (large offline
+        scoring runs); the synchronous ``score`` stays the latency path.
+        """
+        x = np.asarray(x, dtype=np.float32)
+        n = x.shape[0]
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        with self._lock:
+            params = self._params
+            fused_params = self._fused_params
+        largest = self.batch_sizes[-1]
+        pending: list[tuple[jax.Array, int]] = []
+        chunks: list[np.ndarray] = []
+        start = 0
+        while start < n:
+            take = min(n - start, largest)
+            b = self.bucket(take)
+            chunk = x[start : start + take]
+            if take < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
+                )
+            if fused_params is not None:
+                # ship rows as bf16: the kernel computes in bf16 either way,
+                # and half the bytes ≈ double the H2D-bound throughput
+                out = self._fused_apply(
+                    fused_params, self._put_batch(chunk.astype(ml_dtypes.bfloat16))
+                )
+            else:
+                out = self._apply(params, self._put_batch(chunk))
+            pending.append((out, take))
+            if len(pending) >= depth:
+                done, took = pending.pop(0)
+                chunks.append(np.asarray(done)[:took])
+            start += take
+        for done, took in pending:
+            chunks.append(np.asarray(done)[:took])
+        return np.concatenate(chunks).astype(np.float32)
+
+    def score(self, x: np.ndarray) -> np.ndarray:
+        """(n, F) float32 -> (n,) float32 proba_1, padding to a shape bucket.
+
+        The synchronous latency path: small batches take the host tier
+        (numpy forward, no device round trip — see ``host_tier_rows``);
+        larger ones dispatch with one chunk in flight, same
+        bucketing/padding as the pipelined bulk path.
+        """
+        x = np.asarray(x, dtype=np.float32)
+        if 0 < x.shape[0] <= self.host_tier_rows:
+            with self._lock:
+                host_params = self._host_params
+            return np.asarray(
+                self.spec.apply_numpy(host_params, x), np.float32
+            )
+        if self._dispatcher is None:
+            return self.score_pipelined(x, depth=1)
+        return self._device_score_deadline(x)
+
+    def _device_score_deadline(self, x: np.ndarray) -> np.ndarray:
+        """Device path with a bounded round trip (serving latency path only;
+        ``score_pipelined`` called directly — bulk/bench — is unbounded by
+        design). Timeout => host fallback at ANY batch size, or
+        :class:`~ccfd_tpu.serving.dispatch.ScorerTimeout` for the fronts to
+        map to 503 when the model has no host forward."""
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+        if not self._wedge.wedged:
+            try:
+                return self._dispatcher.call(
+                    lambda: self.score_pipelined(x, depth=1),
+                    self.dispatch_deadline_s,
+                )
+            except ScorerTimeout:
+                self.dispatch_timeouts += 1
+                self._wedge.mark_wedged()
+        # wedged (now or already): no new device work queues behind the hang
+        with self._lock:
+            host_params = self._host_params
+        if host_params is None or self.spec.apply_numpy is None:
+            raise ScorerTimeout(
+                f"device wedged for {self._wedge.wedged_for_s:.1f}s and "
+                f"model {self.spec.name!r} has no host forward"
+            )
+        self.host_fallback_scores += 1
+        return np.asarray(self.spec.apply_numpy(host_params, x), np.float32)

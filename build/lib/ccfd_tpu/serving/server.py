@@ -1,0 +1,301 @@
+"""REST serving layer with the Seldon wire contract, backed by the TPU scorer.
+
+Replaces the reference's Seldon-Core engine + model pod
+(reference deploy/model/modelfull.json:18-52, route
+deploy/model/modelfull-route.yaml:1-12) with one process:
+
+- ``POST /api/v0.1/predictions`` — the Seldon REST contract the router and
+  KIE server call (reference deploy/router.yaml:65-68, README.md:454-459).
+  Request: ``{"data": {"names": [...], "ndarray": [[...], ...]}}``;
+  response mirrors the shape with ``names: ["proba_0", "proba_1"]`` and one
+  probability row per input row.
+- ``POST /predict`` — the jBPM prediction-service endpoint
+  (reference ccd-service.yaml:61-62, README.md:379).
+- Bearer-token auth when ``SELDON_TOKEN`` is configured
+  (reference README.md:372-384, 447-451).
+- ``GET /prometheus`` (and ``/metrics``) — scrape body carrying
+  SeldonCore-dashboard-compatible series (reference
+  deploy/grafana/SeldonCore.json:119-531):
+  ``seldon_api_executor_client_requests_seconds_{count,sum,bucket}`` plus
+  the ModelPrediction per-request gauges ``proba_1``/``Amount``/``V17``/
+  ``V10`` (reference deploy/grafana/ModelPrediction.json:96-104).
+- ``GET /health/status`` — Seldon-style readiness.
+
+Implementation: a lean socket-level HTTP server (utils/fasthttp.py) —
+no web framework is needed for a fixed four-route contract, and the
+per-request parse cost is most of the REST latency budget once scoring
+is fast. The canonical predict payload's matrix decodes NATIVELY (C++
+strtof into float32, ccfd_tpu/native/decode.cpp) without touching
+json.loads; the Python JSON path remains for names-remapped or unusual
+payloads. The GIL is released during the XLA dispatch, so scoring
+threads overlap host work.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+import numpy as np
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.native import decode_ndarray_json as native_decode_ndarray
+from ccfd_tpu.serving.scorer import Scorer
+from ccfd_tpu.utils.fasthttp import FastHTTPServer
+
+_AMOUNT_COL = FEATURE_NAMES.index("Amount")
+_V17_COL = FEATURE_NAMES.index("V17")
+_V10_COL = FEATURE_NAMES.index("V10")
+
+
+class PredictionServer:
+    def __init__(
+        self,
+        scorer: Scorer,
+        cfg: Config | None = None,
+        registry: Registry | None = None,
+    ):
+        self.scorer = scorer
+        self.cfg = cfg or Config()
+        self.registry = registry or Registry()
+        r = self.registry
+        # SeldonCore dashboard series (request rate / success / 4xx / 5xx and
+        # latency quantiles come from this histogram + status-coded counter).
+        self._h_latency = r.histogram(
+            "seldon_api_executor_client_requests_seconds",
+            "request latency by endpoint",
+        )
+        self._c_requests = r.counter(
+            "seldon_api_executor_server_requests_total", "requests by code"
+        )
+        # Dispatch-health series (wedged-attachment visibility; the Serving
+        # board alerts on these): wedged flag + timeout/fallback counters
+        # folded from the scorer at scrape time.
+        self._g_wedged = r.gauge(
+            "ccfd_device_wedged", "1 while the device attachment is wedged"
+        )
+        self._c_dispatch_timeouts = r.counter(
+            "ccfd_dispatch_timeouts_total", "device dispatches past deadline"
+        )
+        self._c_host_fallbacks = r.counter(
+            "ccfd_host_fallback_scores_total",
+            "requests scored on the host because the device was unavailable",
+        )
+        self._dispatch_timeouts_synced = 0
+        self._host_fallbacks_synced = 0
+        # ModelPrediction board: per-request feature/probability gauges.
+        self._g_proba = r.gauge("proba_1", "last scored fraud probability")
+        self._g_amount = r.gauge("Amount", "last scored transaction amount")
+        self._g_v17 = r.gauge("V17", "last scored V17")
+        self._g_v10 = r.gauge("V10", "last scored V10")
+        self._httpd: FastHTTPServer | None = None
+        self._gauges_set_ms = 0.0  # last Python-path gauge write (monotonic ms)
+        # dynamic batching (SURVEY.md §7 stage 2: request -> micro-batch
+        # queue -> TPU): concurrent requests coalesce into one dispatch;
+        # the adaptive policy adds no latency for a lone sequential client
+        self.batcher = None
+        if self.cfg.dynamic_batching:
+            self._c_dispatches = r.counter(
+                "serving_batcher_dispatches_total", "coalesced TPU dispatches"
+            )
+            self._c_batched_rows = r.counter(
+                "serving_batcher_rows_total", "rows through the batcher"
+            )
+            self.batcher = self._make_batcher()
+
+    def _make_batcher(self):
+        from ccfd_tpu.serving.batcher import DynamicBatcher
+
+        def on_dispatch(n_rows: int) -> None:
+            self._c_dispatches.inc()
+            self._c_batched_rows.inc(n_rows)
+
+        return DynamicBatcher(
+            self.scorer.score,
+            max_batch=max(self.scorer.batch_sizes),
+            deadline_ms=self.cfg.batch_deadline_ms,
+            on_dispatch=on_dispatch,
+            workers=self.cfg.batch_workers,
+        )
+
+    def _sync_dispatch_health(self) -> None:
+        """Fold the scorer's dispatch-health counters into the registry
+        (scrape-time pull keeps the hot path free of extra metric writes)."""
+        s = self.scorer
+        wedge = getattr(s, "_wedge", None)
+        self._g_wedged.set(1.0 if (wedge is not None and wedge.wedged) else 0.0)
+        d = int(getattr(s, "dispatch_timeouts", 0)) - self._dispatch_timeouts_synced
+        if d > 0:
+            self._c_dispatch_timeouts.inc(d)
+            self._dispatch_timeouts_synced += d
+        d = int(getattr(s, "host_fallback_scores", 0)) - self._host_fallbacks_synced
+        if d > 0:
+            self._c_host_fallbacks.inc(d)
+            self._host_fallbacks_synced += d
+
+    # -- scoring ----------------------------------------------------------
+    def _score_matrix(self, x: np.ndarray) -> np.ndarray:
+        if self.batcher is not None:
+            proba = self.batcher.score(x)
+        else:
+            proba = self.scorer.score(x)
+        if x.shape[0]:
+            self._g_proba.set(float(proba[-1]))
+            self._g_amount.set(float(x[-1, _AMOUNT_COL]))
+            self._g_v17.set(float(x[-1, _V17_COL]))
+            self._g_v10.set(float(x[-1, _V10_COL]))
+            # recency stamp: the native front's scrape fold orders its
+            # host-scored gauge values against this (ms, CLOCK_MONOTONIC)
+            self._gauges_set_ms = time.monotonic() * 1e3
+        return np.asarray(proba, np.float64)
+
+    @staticmethod
+    def _response_dict(proba: np.ndarray, model: str) -> dict:
+        return {
+            "data": {
+                "names": ["proba_0", "proba_1"],
+                # one vectorized build + tolist(): ~10x over per-element
+                # float() pairs at typical request sizes
+                "ndarray": np.stack([1.0 - proba, proba], axis=1).tolist(),
+            },
+            "meta": {"model": model},
+        }
+
+    def predict_ndarray(self, names: list[str], rows: list[list[float]]) -> dict:
+        nf = self.scorer.num_features
+        if names and names != list(FEATURE_NAMES):
+            idx = {n: j for j, n in enumerate(FEATURE_NAMES)}
+            x = np.zeros((len(rows), nf), np.float32)
+            for i, row in enumerate(rows):
+                for name, v in zip(names, row):
+                    j = idx.get(name)
+                    if j is not None:
+                        x[i, j] = float(v)
+        else:
+            # hot path: uniform canonical-order rows convert in ONE numpy
+            # call; the ragged/odd-width fallback keeps the lenient contract
+            try:
+                x = np.asarray(rows, np.float32)
+            except ValueError:
+                x = None
+            if x is not None and x.ndim == 2 and x.shape[1] == nf:
+                pass
+            else:
+                x = np.zeros((len(rows), nf), np.float32)
+                for i, row in enumerate(rows):
+                    x[i, : len(row)] = np.asarray(row, np.float32)[:nf]
+        proba = self._score_matrix(x)
+        return self._response_dict(proba, self.scorer.spec.name)
+
+    # -- HTTP plumbing (FastHTTPServer handler contract) -------------------
+    def _json(self, code: int, obj: Any) -> tuple[int, str, bytes]:
+        self._c_requests.inc(labels={"code": str(code)})
+        return code, "application/json", json.dumps(obj).encode()
+
+    def _authorized(self, headers: dict) -> bool:
+        token = self.cfg.seldon_token
+        if not token:
+            return True
+        auth = headers.get(b"authorization", b"").decode("latin-1")
+        return auth == f"Bearer {token}"
+
+    def _http_handler(
+        self, method: str, path: str, headers: dict, body: bytes
+    ) -> tuple[int, str, bytes]:
+        if method == "GET":
+            if path in ("/prometheus", "/metrics"):
+                self._c_requests.inc(labels={"code": "200"})
+                self._sync_dispatch_health()
+                return 200, "text/plain", self.registry.render().encode()
+            if path in ("/health/status", "/health", "/healthz"):
+                return self._json(
+                    200, {"status": "ok", "model": self.scorer.spec.name}
+                )
+            return self._json(404, {"error": "not found"})
+        if method != "POST":
+            return self._json(405, {"error": "method not allowed"})
+
+        t0 = time.perf_counter()
+        if not self._authorized(headers):
+            return self._json(401, {"error": "unauthorized"})
+        path = path.rstrip("/")
+        if not (path.endswith("/predictions") or path == "/predict"):
+            return self._json(404, {"error": "not found"})
+
+        # hot path: the canonical payload's matrix parses natively
+        # (C++ strtof straight into float32, no json.loads); anything
+        # unusual — a names header, ragged rows, no toolchain — falls
+        # back to the Python JSON route below
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+        x = native_decode_ndarray(body, self.scorer.num_features)
+        if x is not None:
+            try:
+                proba = self._score_matrix(x)
+            except ScorerTimeout as e:
+                # wedged attachment, no host fallback for this model:
+                # bounded failure (503) instead of a hung connection — the
+                # server-side twin of the reference's SELDON_TIMEOUT
+                return self._json(503, {"error": f"scoring unavailable: {e}"})
+            out = self._response_dict(proba, self.scorer.spec.name)
+        else:
+            try:
+                payload = json.loads(body or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                return self._json(400, {"error": "malformed JSON body"})
+            data = payload.get("data", {})
+            rows = data.get("ndarray")
+            if rows is None or not isinstance(rows, list):
+                return self._json(400, {"error": "missing data.ndarray in request"})
+            try:
+                out = self.predict_ndarray(data.get("names") or [], rows)
+            except (TypeError, ValueError) as e:
+                return self._json(400, {"error": f"bad ndarray: {e}"})
+            except ScorerTimeout as e:
+                return self._json(503, {"error": f"scoring unavailable: {e}"})
+        self._h_latency.observe(
+            time.perf_counter() - t0, labels={"endpoint": path}
+        )
+        return self._json(200, out)
+
+    def start(self, host: str | None = None, port: int | None = None) -> int:
+        """Start serving on a background thread; returns the bound port.
+
+        Transport selection: the C++ front (native/httpfront.cpp — epoll
+        parsing + native payload decode + native response format; Python
+        only scores batches) when the toolchain allows and
+        ``cfg.native_front`` is on; the lean Python server otherwise.
+        Same contract either way.
+        """
+        if self.cfg.dynamic_batching and self.batcher is None:
+            # stop() tears the batcher down; a restarted server needs a
+            # fresh one or every predict would fail on the stopped worker
+            self.batcher = self._make_batcher()
+        host = host if host is not None else self.cfg.serve_host
+        port = port if port is not None else self.cfg.serve_port
+        if self.cfg.native_front:
+            try:
+                from ccfd_tpu.serving.native_front import NativeFront
+
+                front = NativeFront(self)
+                bound = front.start(port, host=host)
+                self._httpd = front
+                return bound
+            except (RuntimeError, OSError):
+                pass  # no toolchain / bind conflict: Python transport below
+        self._httpd = FastHTTPServer(
+            (host, port), self._http_handler, name="ccfd-serving"
+        ).start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.stop()
+            self._httpd = None
+        if self.batcher is not None:
+            self.batcher.stop()
+            self.batcher = None  # start() recreates; direct predict_ndarray
+            # on a stopped server falls back to unbatched scoring

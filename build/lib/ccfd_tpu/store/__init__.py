@@ -1,0 +1,1 @@
+from ccfd_tpu.store.objectstore import Credentials, ObjectStore  # noqa: F401

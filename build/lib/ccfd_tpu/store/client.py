@@ -1,0 +1,95 @@
+"""Object-store client: what the reference's producer + aws-cli do.
+
+The reference producer receives ``s3endpoint``/``s3bucket``/``filename`` and
+``ACCESS_KEY_ID``/``SECRET_ACCESS_KEY`` (from the ``keysecret`` secret) and
+pulls ``creditcard.csv`` over S3 (reference
+deploy/kafka/ProducerDeployment.yaml:77-97, deploy/ceph/s3-secretceph.yaml).
+``S3Client`` reproduces that consumer side against either the HTTP store
+server (v2-signed requests over urllib) or an ``inproc://`` store in the
+same process, chosen by the endpoint scheme — the same dual-transport seam
+the bus uses.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+from ccfd_tpu.store.objectstore import (
+    AccessDenied,
+    Credentials,
+    NoSuchKey,
+    ObjectStore,
+    resolve_inproc,
+)
+from ccfd_tpu.store.server import quote_key, sign_v2
+
+
+class S3Client:
+    def __init__(self, endpoint: str, creds: Credentials, timeout_s: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.creds = creds
+        self.timeout_s = timeout_s
+        self._inproc: ObjectStore | None = None
+        if endpoint.startswith("inproc://"):
+            self._inproc = resolve_inproc(endpoint)
+            self._inproc.check_access(creds.access_key)
+            if self._inproc.secret_for(creds.access_key) != creds.secret_key:
+                raise AccessDenied("secret key mismatch")
+
+    # --- HTTP plumbing ---------------------------------------------------
+    def _request(self, method: str, path: str, data: bytes | None = None) -> bytes:
+        headers = {"Date": email.utils.formatdate(usegmt=True)}
+        if data is not None:
+            # set explicitly so the signed Content-Type matches what urllib
+            # sends (it would otherwise inject x-www-form-urlencoded unsigned)
+            headers["Content-Type"] = "application/octet-stream"
+        sig = sign_v2(self.creds.secret_key, method, path.split("?")[0], headers)
+        headers["Authorization"] = f"AWS {self.creds.access_key}:{sig}"
+        req = urllib.request.Request(
+            self.endpoint + path, data=data, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read().decode("utf-8", "replace")
+            if e.code == 403:
+                raise AccessDenied(body) from None
+            if e.code == 404:
+                raise NoSuchKey(body) from None
+            raise
+
+    # --- API -------------------------------------------------------------
+    def create_bucket(self, bucket: str) -> None:
+        if self._inproc is not None:
+            self._inproc.create_bucket(bucket)
+        else:
+            self._request("PUT", f"/{bucket}")
+
+    def put(self, bucket: str, key: str, data: bytes) -> None:
+        if self._inproc is not None:
+            self._inproc.put(bucket, key, data)
+        else:
+            self._request("PUT", f"/{bucket}/{quote_key(key)}", data=data)
+
+    def get(self, bucket: str, key: str) -> bytes:
+        if self._inproc is not None:
+            return self._inproc.get(bucket, key)
+        return self._request("GET", f"/{bucket}/{quote_key(key)}")
+
+    def delete(self, bucket: str, key: str) -> None:
+        if self._inproc is not None:
+            self._inproc.delete(bucket, key)
+        else:
+            self._request("DELETE", f"/{bucket}/{quote_key(key)}")
+
+    def list(self, bucket: str, prefix: str = "") -> list[str]:
+        """Object keys, the `aws s3 ls` check (reference README.md:320-343)."""
+        if self._inproc is not None:
+            return [o.key for o in self._inproc.list(bucket, prefix=prefix)]
+        body = self._request("GET", f"/{bucket}?prefix={quote_key(prefix)}")
+        root = ET.fromstring(body)
+        return [c.findtext("Key", "") for c in root.iter("Contents")]

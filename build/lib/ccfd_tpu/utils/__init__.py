@@ -1,0 +1,1 @@
+from ccfd_tpu.utils.tracing import Tracer, trace_span  # noqa: F401

@@ -1,0 +1,64 @@
+"""Persistent XLA compilation cache for every jax entry point.
+
+On the TPU attachment a first compile costs ~20-40s per (executable,
+shape) — the scorer's bucket set alone is several of those, paid again on
+every service restart, bench run, and retrain bring-up. JAX's persistent
+compilation cache keeps compiled executables on disk keyed by HLO +
+compile options + platform, so only the FIRST process ever pays.
+
+``enable()`` is called by the CLI for jax-using commands and by bench.py;
+CCFD_COMPILE_CACHE overrides the location, ``0``/``off`` disables.
+Failures (read-only fs, old jax) degrade silently to no caching — the
+cache is an optimization, never a requirement.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+
+def _host_fingerprint() -> str:
+    """Short stable id for this host's CPU. XLA:CPU persists AOT machine
+    code compiled for the build host's exact feature set; loading it on a
+    host with different features risks SIGILL (cpu_aot_loader warns about
+    exactly this). Keying the cache dir by CPU identity makes a different
+    host start clean instead of loading incompatible artifacts. TPU
+    executables are unaffected either way — same-host reruns (the case the
+    cache exists for) still hit."""
+    material = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    material += line
+                    break
+    except OSError:
+        material += platform.processor()
+    return hashlib.sha256(material.encode()).hexdigest()[:12]
+
+
+def enable(path: str | None = None) -> str | None:
+    """Point jax at a persistent on-disk compilation cache; returns the
+    directory in use, or None when disabled/unavailable."""
+    env = os.environ.get("CCFD_COMPILE_CACHE", "")
+    if env.strip().lower() in ("0", "off", "false", "no"):
+        return None
+    base = path or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "ccfd_tpu", "xla"
+    )
+    # fingerprint under overridden bases too: a shared CCFD_COMPILE_CACHE
+    # on a heterogeneous fleet is exactly where cross-host AOT reuse bites
+    target = os.path.join(base, _host_fingerprint())
+    try:
+        os.makedirs(target, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", target)
+        # cache even quick compiles: the tunnel round trip dominates, and
+        # the scorer's small buckets compile fast but re-run often
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        return target
+    except Exception:  # noqa: BLE001 - optimization only, never required
+        return None

@@ -1,0 +1,106 @@
+"""Shared pooled JSON-over-HTTP client for the framework's REST hops.
+
+One implementation of the connection-pool + bounded-retry machinery used by
+every service client (engine REST, networked bus): the reference wires its
+services the same way — pooled HTTP with `SELDON_POOL_SIZE`-style knobs
+(reference README.md:389-393).
+
+Retry policy: idempotent requests retry on any transport error. A
+non-idempotent request (process start, produce) retries ONLY on failures
+that prove the server cannot have processed it: a refused connection, or
+an error raised while SENDING the request (``conn.request`` dying on a
+stale pooled keep-alive with BrokenPipe/ConnectionReset — the request was
+never completely written, so an incomplete HTTP message is all the server
+could have seen and it will not dispatch it). A failure while READING the
+response (timeout, reset after the request was fully sent) may mean the
+server processed it, and re-sending would duplicate the side effect — no
+retry there.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import socket
+import urllib.parse
+from typing import Any
+
+
+class _NodelayHTTPConnection(http.client.HTTPConnection):
+    """http.client sends headers and body as separate segments; with Nagle
+    on, a delayed ACK from the server stalls the body ~40 ms. Every client
+    hop in the framework disables Nagle (servers do too — see
+    utils/httpserver.py)."""
+
+    def connect(self) -> None:
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover
+            pass
+
+
+class PooledHTTPClient:
+    def __init__(
+        self,
+        base_url: str,
+        default_port: int,
+        pool_size: int = 4,
+        timeout_s: float = 5.0,
+        retries: int = 2,
+        scheme_error: str = "unsupported scheme",
+    ):
+        u = urllib.parse.urlparse(base_url)
+        if u.scheme not in ("http", ""):
+            raise ValueError(f"{scheme_error}: {base_url!r}")
+        self.host = u.hostname or "localhost"
+        self.port = u.port or default_port
+        self._timeout = timeout_s
+        self._retries = max(0, retries)
+        self._pool: "queue.Queue[http.client.HTTPConnection]" = queue.Queue()
+        for _ in range(max(1, pool_size)):
+            self._pool.put(self._connect())
+
+    def _connect(self) -> http.client.HTTPConnection:
+        return _NodelayHTTPConnection(self.host, self.port, timeout=self._timeout)
+
+    def request(
+        self, method: str, path: str, body: Any = None, idempotent: bool = True
+    ) -> tuple[int, Any]:
+        """-> (status, parsed JSON body or None). Raises ConnectionError when
+        the server stays unreachable (or a non-idempotent send failed after
+        possibly reaching it)."""
+        payload = json.dumps(body).encode() if body is not None else None
+        last: Exception | None = None
+        for _ in range(self._retries + 1):
+            conn = self._pool.get()
+            sent = False
+            try:
+                conn.request(
+                    method, path, body=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+                self._pool.put(conn)
+                return resp.status, (json.loads(data) if data else None)
+            except (OSError, http.client.HTTPException) as e:
+                last = e
+                conn.close()
+                self._pool.put(self._connect())
+                # send-phase failures (conn.request raised — including a
+                # refused connect — mean the request was never fully written,
+                # so the server can't have dispatched it) are safe to retry
+                # even for non-idempotent requests
+                if not idempotent and sent:
+                    break
+        raise ConnectionError(f"{self.host}:{self.port} unreachable: {last}")
+
+    def close(self) -> None:
+        while True:
+            try:
+                self._pool.get_nowait().close()
+            except queue.Empty:
+                return

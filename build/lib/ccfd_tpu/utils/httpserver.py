@@ -1,0 +1,34 @@
+"""Shared HTTP server base for every service surface in the framework.
+
+``ThreadingHTTPServer``'s socketserver default listen backlog
+(``request_queue_size``) is 5: a burst of concurrent clients — exactly the
+load the dynamic batcher exists to coalesce, or N components dialing the
+bus at bring-up — overflows the accept queue and gets connection resets.
+One subclass fixes it for every server (serving, engine, bus, store,
+metrics, health).
+
+TCP_NODELAY is forced on every accepted connection: a keep-alive JSON
+round trip writes small segments in both directions, and Nagle's
+algorithm interacting with delayed ACKs turns a ~2 ms predict hop into a
+~44 ms one (measured on loopback). The framework's clients
+(utils/httpclient.py, serving/client.py) disable Nagle on their side for
+the same reason — the p99 < 10 ms budget (BASELINE.json) does not survive
+a single 40 ms ACK stall.
+"""
+
+from __future__ import annotations
+
+import socket
+from http.server import ThreadingHTTPServer
+
+
+class FrameworkHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 256
+
+    def process_request(self, request, client_address):
+        try:
+            request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover - non-TCP transports
+            pass
+        super().process_request(request, client_address)

@@ -224,3 +224,36 @@ def test_checked_in_manifests_match_generator():
             assert list(yaml.safe_load_all(
                 f.read().split("\n", 2)[2]  # skip the GENERATED header
             )) == docs, f"deploy/k8s/{fname} is stale — regenerate"
+
+
+def test_containerfile_matches_manifests(manifests):
+    """The image every generated manifest references must be buildable from
+    the in-repo Containerfile, and the build steps must reference paths
+    that exist (drift guard: renaming checkpoints/ or deploy/ must fail
+    here, not at an operator's podman build)."""
+
+    import os
+
+    from ccfd_tpu.platform.k8s import IMAGE
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    raw = open(os.path.join(repo, "Containerfile")).read()
+    # comments satisfy nothing: only real instructions count
+    lines = [l for l in raw.splitlines() if l.strip() and not l.lstrip().startswith("#")]
+    cf = "\n".join(lines)
+    for fname, docs in manifests.items():
+        for d in docs:
+            if d.get("kind") == "Deployment":
+                img = d["spec"]["template"]["spec"]["containers"][0]["image"]
+                assert img == IMAGE, (fname, img)
+    # every COPY the image build depends on exists in-repo, as a real
+    # instruction (deleting `COPY deploy ./deploy` must fail here)
+    for path in ("pyproject.toml", "ccfd_tpu", "checkpoints",
+                 "checkpoints_q8", "deploy"):
+        assert any(l.strip().startswith("COPY") and f" {path} " in l + " "
+                   for l in lines), f"no COPY instruction ships {path!r}"
+        assert os.path.exists(os.path.join(repo, path)), path
+    assert any(l.strip().startswith(("RUN", "CMD")) and "ccfd_tpu" in l
+               for l in lines)  # the image actually runs the package
+    # the native pre-build hook the builder stage calls must exist
+    from ccfd_tpu.native import _load  # noqa: F401
