@@ -34,15 +34,15 @@ _build_failed = False
 
 
 def _build() -> str | None:
-    if os.path.exists(_SO) and all(
-        not os.path.exists(s) for s in _SRCS
-    ):
-        # sources stripped (a wheel built without them): trust the shipped .so
-        return _SO
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= max(
-        os.path.getmtime(s) for s in _SRCS
+    present = [s for s in _SRCS if os.path.exists(s)]
+    if os.path.exists(_SO) and (
+        len(present) < len(_SRCS)  # sources (partially) stripped: a
+        # rebuild is impossible, so trust the shipped .so
+        or os.path.getmtime(_SO) >= max(os.path.getmtime(s) for s in present)
     ):
         return _SO
+    if len(present) < len(_SRCS):
+        return None  # no .so and no complete sources: numpy fallback
     # CCFD_NATIVE_MARCH overrides the target microarchitecture: container
     # images built on one CPU and deployed to another must NOT bake the
     # builder's -march=native (a zmm-tuned .so can SIGILL on the deploy
@@ -70,7 +70,25 @@ def _load():
         if path is None:
             _build_failed = True
             return None
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            # a shipped .so that won't load here (glibc/arch mismatch on a
+            # different deploy node): rebuild from sources when possible,
+            # else degrade to the numpy paths — never hard-fail the caller
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            path = _build()
+            if path is None:
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                _build_failed = True
+                return None
         lib.ccfd_decode_csv.restype = ctypes.c_int
         lib.ccfd_decode_csv.argtypes = [
             ctypes.c_char_p,

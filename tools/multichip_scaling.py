@@ -29,6 +29,7 @@ _CHILD = r"""
 import json, os, time
 import jax
 jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
 import numpy as np
 
 n = int(os.environ["CCFD_SCALE_DEVICES"])
@@ -77,6 +78,62 @@ while (el := time.perf_counter() - t0) < 2.0:
     steps += 1
 out["retrain_steps_s"] = round(steps / el, 2)
 out["retrain_labels_s"] = round(steps * 4096 / el, 1)
+
+# --- long-context: sequence-parallel attention over the mesh -------------
+# ring (ppermute rotation) and ulysses (all-to-all reshard) at sp = n:
+# the curve records how the two strategies behave as the sequence axis
+# shards wider (first-class long-context evidence, SURVEY beyond-reference)
+from ccfd_tpu.models import seq as seq_mod
+
+B, L = 128, 64
+sparams = seq_mod.init(jax.random.PRNGKey(2))
+xs = jnp.asarray(
+    np.random.default_rng(3).standard_normal((B, L, 30)), jnp.float32
+)
+
+def measure_seq(attn, budget_s=2.0):
+    @jax.jit
+    def step(p, xx):
+        return jax.nn.sigmoid(
+            seq_mod.logits(p, xx, jnp.float32, attention_fn=attn)
+        )
+    jax.block_until_ready(step(sparams, xs))
+    count = 0
+    t0 = time.perf_counter()
+    while True:
+        # block every step: dispatch is async, and counting enqueues with
+        # a frozen clock would record dispatch rate, not execution rate
+        jax.block_until_ready(step(sparams, xs))
+        count += B
+        ell = time.perf_counter() - t0
+        if ell >= budget_s:
+            return round(count / ell, 1)
+
+seq_out = {"batch": B, "seq_len": L}
+if n == 1:
+    seq_out["single_histories_s"] = measure_seq(None)
+else:
+    from ccfd_tpu.ops.ring_attention import ring_attention
+    from ccfd_tpu.ops.ulysses import ulysses_attention
+    from ccfd_tpu.parallel.mesh import make_mesh
+
+    sp_mesh = make_mesh(model_parallel=n, devices=devices)
+    seq_out["sp_degree"] = n
+    seq_out["ring_histories_s"] = measure_seq(
+        lambda q, k, v: ring_attention(q, k, v, sp_mesh, "model")
+    )
+    n_heads = seq_mod.N_HEADS
+    if n_heads % n == 0:
+        seq_out["ulysses_histories_s"] = measure_seq(
+            lambda q, k, v: ulysses_attention(q, k, v, sp_mesh, "model")
+        )
+    else:
+        # documented constraint: ulysses reshards heads over the axis and
+        # needs heads % sp == 0; ring has no such bound
+        seq_out["ulysses_histories_s"] = (
+            f"n/a (heads {n_heads} not divisible by sp {n})"
+        )
+out["seq"] = seq_out
 print("RESULT " + json.dumps(out))
 """
 
