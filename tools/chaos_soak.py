@@ -59,9 +59,13 @@ def main() -> int:
 
     ap.add_argument("--deadline-ms", type=_positive_ms, default=250.0)
     ap.add_argument("--feed-batch", type=int, default=2000)
+    ap.add_argument("--audit", action="store_true",
+                    help="run with the jBPM-analog audit stream ON "
+                         "(every instance lifecycle event onto the bus)")
     args = ap.parse_args()
 
-    cfg = Config(confidence_threshold=1.0)
+    cfg = Config(confidence_threshold=1.0,
+                 audit_topic="ccd-audit" if args.audit else "")
     broker = Broker()
     reg_r, reg_k, reg_c = Registry(), Registry(), Registry()
     engine = build_engine(cfg, broker, reg_k, None)
@@ -155,7 +159,12 @@ def main() -> int:
     out_fraud = reg_r.counter("transaction_outgoing_total").value(
         labels={"type": "fraud"}
     )
+    audit_events = None
+    if args.audit:
+        audit_events = sum(broker.end_offsets(cfg.audit_topic))
     result = {
+        "audit": bool(args.audit),
+        "audit_events": audit_events,
         "seconds": round(elapsed, 1),
         "tx_total": int(total),
         "tx_s": round(total / elapsed, 1),
