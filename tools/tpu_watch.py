@@ -1,28 +1,44 @@
-"""Opportunistic TPU bench capture loop.
+"""Opportunistic TPU capture loop with a relay-leg fast path.
 
-The TPU attachment wedges intermittently for hours (see BASELINE.md "tunnel"
-notes); ``jax.devices()`` hangs forever when it does.  This watcher probes the
-backend in a short-timeout subprocess and, the moment a probe succeeds, fires a
-full ``bench.py`` run (which refreshes ``BENCH_TPU_LAST_GOOD.json`` on any
-successful on-device capture).  Run it in the background for the whole round:
+Round-4 triage (tools/tpu_triage.py, TPU_TRIAGE_r04.json) proved the wedge
+is the axon relay's pool-service legs on 127.0.0.1:{8083,8093,8103,8113}
+going refused: the PJRT client retries them forever and ``jax.devices()``
+hangs.  A full jax probe costs ~45 s of subprocess timeout, so the old
+watcher could only afford one every few minutes — but a dead TCP connect
+costs ~100 µs, so this watcher pre-filters: poll the relay legs every
+``--fast-interval`` (default 10 s) and only spend the jax probe when a leg
+actually listens.  Healthy windows historically last minutes
+(BASELINE.md "tunnel" notes); reacting in seconds instead of minutes is
+the difference between a capture and another lost round.
 
-    python tools/tpu_watch.py --interval 240 --max-hours 11
+On a confirmed-healthy probe it fires, in order, each in its own
+subprocess with a watchdog:
 
-It exits 0 after the first successful TPU capture (so a supervisor can notice
-and decide whether to relaunch for a fresher capture later), or 3 when the
-time budget runs out with no healthy window.
+  1. ``bench.py``            — full bench (quant + zoo sections armed),
+                               refreshes BENCH_TPU_LAST_GOOD.json
+  2. ``tools/rest_sweep.py`` — the pre-scripted REST north-star sweep
+  3. ``tools/tpu_triage.py`` — records the healthy-state triage snapshot
+
+It keeps watching after a capture and re-captures at most every
+``--recapture-min`` minutes while the attachment stays healthy, so the
+freshest possible evidence rides the round.  Exit: 0 after at least one
+full TPU capture when the budget ends, 3 if none.
+
+    python tools/tpu_watch.py --fast-interval 10 --max-hours 11 &
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "tpu_watch.log")
+POOL_PORTS = (8083, 8093, 8103, 8113)
 
 
 def log(msg: str) -> None:
@@ -32,12 +48,25 @@ def log(msg: str) -> None:
         f.write(line + "\n")
 
 
+def relay_legs_listening(timeout_s: float = 0.5) -> list[int]:
+    """Which pool-service legs accept a TCP connect right now (~100 us per
+    refused port on loopback — cheap enough for a 10 s cadence)."""
+    alive = []
+    for port in POOL_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=timeout_s):
+                alive.append(port)
+        except OSError:
+            pass
+    return alive
+
+
 def probe(timeout_s: float) -> bool:
     """True iff the accelerator answers inside timeout_s (probed in a child
     process so a wedged tunnel can't hang the watcher itself)."""
-    # Same probe bench.py uses: the site hook supplies the accelerator
-    # platform; an explicit platform list here could name an unregistered
-    # plugin and fail even on a healthy tunnel.
+    # The site hook supplies the accelerator platform; an explicit platform
+    # list here could name an unregistered plugin and fail on a healthy one.
     code = "import jax; d = jax.devices(); import sys; sys.exit(0 if d else 1)"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
@@ -83,32 +112,96 @@ def run_bench(bench_timeout_s: float) -> bool:
     return plat == "tpu"
 
 
+def run_tool(argv: list[str], timeout_s: float, label: str) -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout_s, env=env, cwd=REPO)
+        log(f"{label}: rc={r.returncode}")
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        log(f"{label}: exceeded {timeout_s:.0f}s watchdog")
+        return False
+
+
+def capture_pipeline(bench_timeout_s: float) -> bool:
+    """The whole evidence suite, cheapest-to-lose last."""
+    got_tpu = run_bench(bench_timeout_s)
+    if got_tpu:
+        log("TPU capture secured (BENCH_TPU_LAST_GOOD.json refreshed)")
+    # The sweep runs its own probe and falls back honestly; fire it even if
+    # the bench lost the window mid-run — partial evidence beats none.
+    run_tool([sys.executable, "tools/rest_sweep.py"], 900.0, "rest_sweep")
+    run_tool([sys.executable, "tools/tpu_triage.py", "--no-trace",
+              "--probe-s", "30"], 300.0, "triage snapshot")
+    return got_tpu
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--interval", type=float, default=240.0,
-                    help="seconds between probes while wedged")
+    ap.add_argument("--fast-interval", type=float, default=10.0,
+                    help="seconds between TCP pre-filter polls")
+    ap.add_argument("--slow-every", type=int, default=30,
+                    help="full jax probe anyway every N fast polls, in case "
+                    "the attachment path changes away from the known legs")
     ap.add_argument("--probe-timeout", type=float, default=45.0)
     ap.add_argument("--bench-timeout", type=float, default=2400.0)
+    ap.add_argument("--recapture-min", type=float, default=30.0,
+                    help="minimum minutes between captures after a full "
+                    "TPU capture")
+    ap.add_argument("--retry-min", type=float, default=5.0,
+                    help="minimum minutes before refiring the pipeline "
+                    "after an attempt that did NOT land on TPU (a flash "
+                    "wedge mid-bench must not refire the whole ~hour "
+                    "suite back-to-back on the 1-core host)")
     ap.add_argument("--max-hours", type=float, default=11.0)
     args = ap.parse_args()
 
     deadline = time.time() + args.max_hours * 3600
     attempt = 0
-    log(f"watch started (interval={args.interval}s, budget={args.max_hours}h)")
+    captured = 0
+    last_attempt = 0.0   # any pipeline firing
+    wait_min = 0.0       # minutes to hold off since last_attempt
+    log(f"watch v2 started (fast={args.fast_interval}s, "
+        f"budget={args.max_hours}h, legs={POOL_PORTS})")
     while time.time() < deadline:
         attempt += 1
+        legs = relay_legs_listening()
+        slow_n = max(int(args.slow_every), 1)
+        go_slow = (attempt - 1) % slow_n == 0
+        if not legs and not go_slow:
+            time.sleep(args.fast_interval)
+            continue
+        # Hold off BEFORE spending a jax-import probe subprocess: inside
+        # the window the probe's only possible outcome is "wait more",
+        # and on the 1-core host it costs ~2 s of site hooks per spawn.
+        held_min = (time.time() - last_attempt) / 60.0
+        if last_attempt and held_min < wait_min:
+            if legs:
+                log(f"poll #{attempt}: legs {legs} up; holding "
+                    f"{wait_min - held_min:.0f} more min before refire")
+            time.sleep(args.fast_interval)
+            continue
+        if legs:
+            log(f"poll #{attempt}: relay legs LISTENING {legs} — jax probe")
         if probe(args.probe_timeout):
-            log(f"probe #{attempt}: HEALTHY — firing bench capture")
-            if run_bench(args.bench_timeout):
-                log("TPU capture secured (BENCH_TPU_LAST_GOOD.json refreshed)")
-                return 0
-            log("bench did not land on TPU (wedged mid-run?); continuing")
+            log(f"poll #{attempt}: HEALTHY — firing capture pipeline")
+            last_attempt = time.time()
+            if capture_pipeline(args.bench_timeout):
+                captured += 1
+                wait_min = args.recapture_min
+            else:
+                wait_min = args.retry_min
+        elif legs:
+            log(f"poll #{attempt}: legs listening but probe hung — "
+                f"wedge is beyond the relay (see tpu_triage.py)")
         else:
-            if attempt % 5 == 1:
-                log(f"probe #{attempt}: wedged")
-        time.sleep(args.interval)
-    log("budget exhausted without a TPU capture")
-    return 3
+            # reached at most once per slow_n fast polls (~5 min default)
+            log(f"poll #{attempt}: wedged (legs refused, slow probe hung)")
+        time.sleep(args.fast_interval)
+    log(f"budget exhausted; captures this run: {captured}")
+    return 0 if captured else 3
 
 
 if __name__ == "__main__":
