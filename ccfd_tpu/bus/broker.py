@@ -164,10 +164,23 @@ class Broker:
         return {"topics": topics, "groups": groups}
 
     # -- produce ----------------------------------------------------------
-    def produce(self, topic: str, value: Any, key: Any = None) -> Record:
+    def produce(self, topic: str, value: Any, key: Any = None,
+                partition: int | None = None) -> Record:
+        """Append one record. ``partition`` overrides key routing (the
+        Kafka producer's explicit-partition mode) — control records that
+        must reach EVERY partition, like the recovery coordinator's
+        ``engine_restored`` marker, produce once per partition with it."""
         with self._lock:
             t = self._topic(topic)
-            part = t.route(key)
+            if partition is None:
+                part = t.route(key)
+            else:
+                if not 0 <= partition < t.n_partitions:
+                    raise ValueError(
+                        f"partition {partition} out of range for {topic!r} "
+                        f"({t.n_partitions} partitions)"
+                    )
+                part = partition
             rec = Record(
                 topic=topic,
                 partition=part,
@@ -278,6 +291,45 @@ class Broker:
                     if tp[0] in m.topics:
                         m._assignment.append(tp)
                         break
+
+    def committed_offsets(self, group_id: str, topic: str) -> list[int]:
+        """Committed offset per partition for a consumer group — the
+        ``kafka-consumer-groups --describe`` analog. The checkpoint
+        coordinator (runtime/recovery.py) records these as the
+        consistent-cut position alongside an engine snapshot."""
+        with self._lock:
+            t = self._topic(topic)
+            return [
+                self._committed(group_id, (topic, p))
+                for p in range(t.n_partitions)
+            ]
+
+    def reset_offsets(self, group_id: str, topic: str,
+                      offsets: list[int]) -> None:
+        """Rewind (or advance) a group's committed offsets — Kafka's
+        ``kafka-consumer-groups --reset-offsets --to-offset`` analog.
+
+        Live consumers pick the change up on their next poll (every fetch
+        reads the group offset; consumers hold no position of their own).
+        Out-of-range values clamp to the partition log, like Kafka's
+        auto.offset.reset. With a durable log the reset is recorded, so a
+        broker crash-replay resumes from the reset position, not the old
+        high-water mark (bus/log.py replays offsets last-wins)."""
+        with self._lock:
+            t = self._topic(topic)
+            if len(offsets) != t.n_partitions:
+                raise ValueError(
+                    f"{topic!r} has {t.n_partitions} partitions, "
+                    f"got {len(offsets)} offsets"
+                )
+            g = self._groups.setdefault(group_id, {})
+            for p, off in enumerate(offsets):
+                off = max(0, min(int(off), len(t.partitions[p])))
+                g[(topic, p)] = off
+                if self._log is not None:
+                    self._log.commit_offset(group_id, topic, p, off)
+            # rewound consumers may have records to re-read right now
+            self._data_ready.notify_all()
 
     def _committed(self, group_id: str, tp: tuple[str, int]) -> int:
         return self._groups.setdefault(group_id, {}).get(tp, 0)

@@ -160,7 +160,10 @@ class KafkaAdapter:
     ) -> int:
         """Pipelined sends + one flush (the producer's hot path). A send
         error fails the call after the flush resolves every in-flight
-        future — the prefix-committed outcome of the in-process broker."""
+        future. Unlike the in-process broker's prefix-committed batches,
+        per-record futures across partitions land in any order: an
+        ARBITRARY SUBSET may be acknowledged before the call raises —
+        only the counters are per-record."""
         values = list(values)
         key_list = list(keys) if keys is not None else [None] * len(values)
         if len(key_list) != len(values):
@@ -172,8 +175,9 @@ class KafkaAdapter:
         self._producer.flush(timeout=self._timeout_s)
         # per-record accounting even on partial failure: futures that the
         # cluster acknowledged count as produced (their records ARE in the
-        # log, visible to consumers), each failed future counts one error,
-        # and the call still fails afterward (prefix-committed semantics)
+        # log, visible to consumers — which records that is depends on
+        # partition ordering, not input order), each failed future counts
+        # one error, and the call still fails afterward
         n_ok = 0
         first_err: Exception | None = None
         for f in futures:

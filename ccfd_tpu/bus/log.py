@@ -161,7 +161,12 @@ class BusLog:
             o = json.loads(payload)
             g = groups.setdefault(o["g"], {})
             tp = (o["t"], int(o["p"]))
-            g[tp] = max(g.get(tp, 0), int(o["o"]))
+            # Last-wins, not max: every append happens under the broker
+            # lock, so file order IS logical order — and an administrative
+            # rewind (Broker.reset_offsets, the crash-recovery replay cut)
+            # must survive a broker crash rather than be undone by an
+            # earlier, higher commit on replay.
+            g[tp] = int(o["o"])
         n_unique = sum(len(g) for g in groups.values())
         # offsets.log grows one entry per commit forever; once history
         # dominates (>4x the live key count), rewrite it compacted. Atomic

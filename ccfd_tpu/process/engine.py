@@ -38,6 +38,9 @@ from typing import Any, Callable, Mapping, Protocol, Sequence
 from ccfd_tpu.metrics.prom import Registry
 from ccfd_tpu.process.clock import Clock, RealClock, TimerHandle
 
+# process-wide engine-object sequence for audit-event provenance
+_ENGINE_SEQ = itertools.count(1)
+
 def _copy_containers(v: Any) -> Any:
     """Recursive copy of JSON containers (dict/list), leaves shared.
 
@@ -216,6 +219,12 @@ class Engine:
         # definitions (ServiceNode chain into an EndNode, no waits/gateways/
         # tasks): the hot batch path runs these without per-node dispatch
         self._static_chains: dict[str, tuple[list[ServiceNode], EndNode, list[str]]] = {}
+        # set by shutdown(): a decommissioned engine object must go silent
+        self._dead = False
+        # stamped into every audit event: across crash-recovery swaps
+        # (runtime/recovery.py) multiple engine objects write one stream,
+        # and epoch forensics need to know which object emitted what
+        self._engine_tag = f"e{next(_ENGINE_SEQ)}"
         self._started = self.registry.counter(
             "process_instances_started_total", "process starts by definition"
         )
@@ -229,7 +238,7 @@ class Engine:
         dicts). Delivery happens in ``_flush_audit`` after lock release."""
         self._audit_buffer.append({
             "event": event, "pid": pid, "process": process,
-            "ts": self.clock.now(), **extra,
+            "ts": self.clock.now(), "engine": self._engine_tag, **extra,
         })
 
     def _flush_audit(self) -> None:
@@ -318,10 +327,22 @@ class Engine:
                 return None
         return None  # cycle of service nodes: not straight-through
 
+    def _check_alive(self) -> None:
+        """Caller holds the lock. A decommissioned engine must refuse
+        mutation: after a crash-recovery swap (runtime/recovery.py), a
+        caller that raced the swap — e.g. a router scoring batch that was
+        in flight past the pause timeout — would otherwise write starts
+        and arm timers on the abandoned object. Refusing converts that
+        into the router's normal engine-unreachable error path, and the
+        rewound bus re-delivers the records to the live engine."""
+        if self._dead:
+            raise RuntimeError("engine is shut down (crash-recovery swap)")
+
     # -- public API (KIE-server-shaped: start / signal / tasks) -----------
     def start_process(self, def_id: str, variables: Mapping[str, Any]) -> int:
         try:
             with self._lock:
+                self._check_alive()
                 d = self._definitions[def_id]
                 inst = Instance(
                     pid=next(self._pid), definition=d, vars=dict(variables)
@@ -365,6 +386,7 @@ class Engine:
         self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
     ) -> list[int | None]:
         with self._lock:
+            self._check_alive()
             d = self._definitions[def_id]
             chain = self._static_chains.get(def_id)
             pids: list[int | None] = []
@@ -445,6 +467,7 @@ class Engine:
         """Deliver a signal; returns True iff it was consumed by a wait."""
         try:
             with self._lock:
+                self._check_alive()
                 inst = self._instances.get(pid)
                 if (
                     inst is None
@@ -486,6 +509,7 @@ class Engine:
     def complete_task(self, task_id: int, outcome: Any) -> None:
         try:
             with self._lock:
+                self._check_alive()
                 t = self._tasks[task_id]
                 if t.status != "open":
                     raise ValueError(f"task {task_id} already {t.status}")
@@ -670,6 +694,29 @@ class Engine:
                         lambda pid=inst.pid, g=inst.wait_gen: self._timer_fired(pid, g),
                     )
 
+    def shutdown(self) -> None:
+        """Decommission this engine object after a crash-recovery swap.
+
+        The recovery coordinator (runtime/recovery.py) abandons the live
+        engine and replaces it with a snapshot-restored one; without this,
+        the abandoned object's already-scheduled timer callbacks would
+        keep firing — mutating dead state and, worse, emitting post-epoch
+        audit events through the SHARED bus sink, corrupting the stream's
+        epoch accounting.  Cancels every pending timer, drops buffered
+        audit events, and silences the sink.  Lock order matches
+        ``_flush_audit`` (flush lock, then state lock), so an in-flight
+        flush completes its delivery before the shutdown lands — after
+        return, nothing more reaches the sink."""
+        with self._audit_flush_lock:
+            with self._lock:
+                self._dead = True
+                for inst in self._instances.values():
+                    if inst.timer is not None:
+                        inst.timer.cancel()
+                        inst.timer = None
+                self._audit_buffer.clear()
+                self._audit = None
+
     def save(self, path: str) -> None:
         """Atomic snapshot-to-file (tmp + rename)."""
         tmp = f"{path}.tmp"
@@ -707,7 +754,8 @@ class Engine:
             with self._lock:
                 inst = self._instances.get(pid)
                 if (
-                    inst is None
+                    self._dead
+                    or inst is None
                     or inst.status != "active"
                     or inst.wait_signal is None
                     or inst.wait_gen != gen

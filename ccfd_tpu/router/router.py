@@ -255,6 +255,18 @@ class Router:
             "router_score_errors_total", "transactions dropped by scorer failures"
         )
         self._stop = threading.Event()
+        # checkpoint barrier (runtime/recovery.py): pause() parks the run
+        # loop at a batch boundary — consumed records fully routed into the
+        # engine, nothing in flight — so an engine snapshot plus the
+        # committed offsets form a consistent cut (Flink-style aligned
+        # checkpoint, scaled to one source)
+        self._pause_req = threading.Event()
+        self._pause_ack = threading.Event()
+        # pause is reference-counted: the periodic checkpointer and an
+        # operator drill (or crash restore) may hold the barrier at once,
+        # and one holder's resume() must not release the other's hold
+        self._pause_mu = threading.Lock()
+        self._pause_holders = 0
 
     # -- loop stages (composed by step() and the pipelined run loop) -------
     def _drain_signals(self) -> None:
@@ -369,6 +381,54 @@ class Router:
                 self._c_rule.inc(n_ok, labels={"rule": rule.name})
         return len(txs)
 
+    # -- checkpoint barrier ------------------------------------------------
+    def pause(self, timeout_s: float = 10.0) -> bool:
+        """Request a batch-boundary hold and wait for the loop to ack.
+
+        On True, the loop is parked with every consumed record fully routed
+        (in-flight scoring batch finished and started into the engine) and
+        will stay parked until :meth:`resume` — the window in which an
+        engine snapshot + committed offsets are a consistent cut. Returns
+        False if no ack arrived (router stopped/crashed/not running); the
+        caller decides whether proceeding is safe (a dead router isn't
+        mutating engine state either).
+
+        Holds nest: every pause() needs a matching resume(); the loop
+        stays parked until the last holder releases."""
+        with self._pause_mu:
+            self._pause_holders += 1
+            self._pause_req.set()
+        return self._pause_ack.wait(timeout=timeout_s)
+
+    def resume(self) -> None:
+        with self._pause_mu:
+            if self._pause_holders > 0:
+                self._pause_holders -= 1
+            if self._pause_holders == 0:
+                self._pause_req.clear()
+
+    def _pause_point(self) -> None:
+        """Called by the run loops at a batch boundary."""
+        self._pause_ack.set()
+        while self._pause_req.is_set() and not self._stop.is_set():
+            time.sleep(0.005)
+        self._pause_ack.clear()
+
+    def swap_engine(self, engine: EngineClient) -> None:
+        """Point the router at a replacement engine — crash recovery swaps
+        in a snapshot-restored instance (runtime/recovery.py). The router
+        must be paused or stopped. Re-validates rule targets and rebinds
+        the cached batched-start path."""
+        list_defs = getattr(engine, "definitions", None)
+        if callable(list_defs):
+            missing = {r.process for r in self.rules.rules} - set(list_defs())
+            if missing:
+                raise ValueError(
+                    f"replacement engine lacks processes {sorted(missing)}"
+                )
+        self.engine = engine
+        self._start_batch = getattr(engine, "start_process_batch", None)
+
     # -- daemon loop -------------------------------------------------------
     def reset(self) -> None:
         """Re-arm after stop() so the next run() actually loops. Called by
@@ -381,6 +441,9 @@ class Router:
             self._run_pipelined(poll_timeout_s)
         else:
             while not self._stop.is_set():
+                if self._pause_req.is_set():
+                    self._pause_point()
+                    continue
                 self.step(poll_timeout_s)
 
     def _run_pipelined(self, poll_timeout_s: float) -> None:
@@ -420,6 +483,14 @@ class Router:
         pending: tuple | None = None  # (future, x, txs)
         try:
             while not self._stop.is_set():
+                if self._pause_req.is_set():
+                    # finish the in-flight batch BEFORE acking: the ack
+                    # promises nothing consumed-but-unrouted exists
+                    if pending is not None:
+                        finish(pending)
+                        pending = None
+                    self._pause_point()
+                    continue
                 self._drain_signals()
                 # with a batch in flight, don't sleep on an empty topic:
                 # grab whatever is already queued and route the in-flight

@@ -1,0 +1,253 @@
+"""Crash recovery for the stateful pipeline tier: engine snapshot +
+bus-offset replay as one consistent cut.
+
+The reference's process engine (KIE server, reference deploy/
+ccd-service.yaml:1-124) keeps persistent process state in a database and
+relies on Kafka redelivery after a pod restart.  This module is that
+capability for the in-process runtime: a ``CheckpointCoordinator``
+periodically captures
+
+  - the engine's snapshot (process/engine.py snapshot(): active instances,
+    open tasks, id counters, timer remainders), and
+  - the committed offsets of every consumer group whose records mutate
+    engine state (the router's transaction group and its customer-response
+    signal group),
+
+taken *at a batch boundary* — the router's pause() barrier guarantees no
+consumed-but-unrouted records exist when the cut is read (a Flink-style
+aligned checkpoint with one source).  After an engine crash, ``restore()``
+builds a fresh engine from the registered definitions, loads the last
+snapshot, rewinds the groups to the cut (Broker.reset_offsets — live
+consumers follow, they hold no position of their own), and swaps the new
+engine into the router.
+
+Semantics are at-least-once, like Kafka redelivery into a restarted KIE
+pod before its DB transaction committed: work the dead engine did after
+the last cut is rolled back and re-driven from the bus.  Process ids
+restart from the snapshot's ``next_pid``, so starts the dead engine
+emitted after the cut are void — the coordinator writes an
+``engine_restored`` marker event (with that ``next_pid``) into the audit
+topic, which is exactly the information an audit consumer needs to
+reconcile: any ``process_started`` before the marker with
+``pid >= next_pid`` was rolled back and will be re-driven (possibly
+reusing the pid).  tools/chaos_soak.py asserts this accounting under a
+ChaosMonkey that kills the engine mid-load.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ccfd_tpu.process.engine import Engine
+
+
+class CheckpointCoordinator:
+    """Aligned checkpoints + crash restore for one router/engine pair.
+
+    ``engine_factory`` must return a fresh, fully ``register``-ed engine
+    wired to the same audit sink (definitions are code, not data —
+    process/engine.py restore()).
+    """
+
+    def __init__(
+        self,
+        router,                    # router.Router (pause/resume/swap_engine)
+        broker,                    # bus.broker.Broker
+        engine_factory: Callable[[], Engine],
+        interval_s: float = 5.0,
+        pause_timeout_s: float = 10.0,
+    ):
+        self.router = router
+        self.broker = broker
+        self.engine_factory = engine_factory
+        self.interval_s = interval_s
+        self.pause_timeout_s = pause_timeout_s
+        cfg = router.cfg
+        # every (group, topic) whose consumption mutates engine state
+        self._cut_groups = (
+            ("router", cfg.kafka_topic),
+            ("router-responses", cfg.customer_response_topic),
+        )
+        self._audit_topic = cfg.audit_topic
+        self._last: dict[str, Any] | None = None  # {"snap","offsets","ts"}
+        self._lock = threading.Lock()  # serializes checkpoint vs restore
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.checkpoints = 0
+        self.restores = 0
+        self.skipped = 0
+        self.unacked_restores = 0  # barrier timeout (e.g. wedged scorer):
+        # restore proceeded anyway — safe, because the shut-down engine
+        # refuses the late in-flight batch (Engine._check_alive)
+
+    # -- checkpoint --------------------------------------------------------
+    def checkpoint(self) -> dict[str, Any] | None:
+        """One aligned checkpoint; None if the barrier wasn't acked (router
+        mid-restart — state is then mutating unpredictably, skip rather
+        than record a torn cut)."""
+        with self._lock:
+            acked = self.router.pause(self.pause_timeout_s)
+            try:
+                if not acked and self._router_loop_alive():
+                    self.skipped += 1
+                    return None
+                # barrier holds (or no loop is running to mutate state)
+                cut = {
+                    "snap": self.router.engine.snapshot(),
+                    "offsets": {
+                        f"{g}\x00{t}": self.broker.committed_offsets(g, t)
+                        for g, t in self._cut_groups
+                    },
+                    "ts": time.time(),
+                }
+            finally:
+                self.router.resume()
+            self._last = cut
+            self.checkpoints += 1
+            return cut
+
+    def _router_loop_alive(self) -> bool:
+        """Best effort: is some thread inside the router's run loop?  The
+        stop flag is the only observable; a cleared stop flag with no ack
+        means a live loop that didn't reach the barrier."""
+        return not self.router._stop.is_set()
+
+    def start(self) -> "CheckpointCoordinator":
+        """Periodic checkpoints on a daemon thread."""
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.checkpoint()
+                except Exception:  # noqa: BLE001 - keep checkpointing
+                    import logging
+
+                    logging.getLogger(__name__).exception("checkpoint failed")
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="ccfd-checkpoint"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, reason: str = "crash") -> Engine:
+        """Rebuild the engine from the last cut and rewind the bus to it.
+
+        Safe to call from the supervisor's reset hook while the router is
+        still polling: the router is paused across the swap (its in-flight
+        batch drains into the doomed engine first — those starts are void,
+        their records re-deliver after the rewind).  With no checkpoint
+        yet, recovery is from genesis: empty engine, offsets 0 — the full
+        at-least-once replay of the durable log."""
+        with self._lock:
+            if not self.router.pause(self.pause_timeout_s):
+                self.unacked_restores += 1
+            try:
+                # silence the doomed engine FIRST: its scheduled timers
+                # must not fire into dead state or emit post-marker audit
+                # events through the shared sink (Engine.shutdown)
+                old = self.router.engine
+                if hasattr(old, "shutdown"):
+                    old.shutdown()
+                if self._last is not None:
+                    offsets = self._last["offsets"]
+                    next_pid = self._last["snap"]["next_pid"]
+                    active_pids = [
+                        i["pid"] for i in self._last["snap"]["instances"]
+                        if i["status"] == "active"
+                    ]
+                else:
+                    offsets = {
+                        f"{g}\x00{t}": [0] * len(
+                            self.broker.committed_offsets(g, t)
+                        )
+                        for g, t in self._cut_groups
+                    }
+                    next_pid = 1
+                    active_pids = []
+                if self._audit_topic:
+                    # The marker goes in BEFORE the replacement engine is
+                    # even built: Engine.restore() re-arms overdue timers
+                    # with zero delay, so the new engine can emit its
+                    # first events the instant restore() releases its
+                    # lock — those must land after the epoch boundary.
+                    # (The old engine is already silenced, so nothing
+                    # else can write in between.)
+                    # One marker PER PARTITION: audit events are keyed by
+                    # pid (partition-sticky), so each partition's offset
+                    # order is the ground truth — a consumer of any single
+                    # partition must see the boundary in-stream
+                    # (timestamps can collide within a batch flush and
+                    # cannot order events across it).
+                    # ``active_pids`` is the restored-active set: events
+                    # the dead epoch emitted past the cut for THESE pids
+                    # (e.g. a timer completion) are rolled back too — the
+                    # restored instance is live again and may re-complete.
+                    # An audit consumer needs exactly {next_pid,
+                    # active_pids} to reconcile at-least-once redelivery.
+                    marker = {
+                        "event": "engine_restored",
+                        "reason": reason,
+                        "next_pid": next_pid,
+                        "active_pids": active_pids,
+                        "ts": time.time(),
+                    }
+                    n_parts = len(self.broker.end_offsets(self._audit_topic))
+                    for p in range(n_parts):
+                        self.broker.produce(self._audit_topic, marker,
+                                            partition=p)
+                engine = self.engine_factory()
+                if self._last is not None:
+                    engine.restore(self._last["snap"])
+                # swap BEFORE the rewind: if the pause wasn't acked (router
+                # wedged past the timeout, still looping), a post-rewind
+                # poll would commit the rewound records forward and feed
+                # them to the shut-down engine — permanently lost. Swapped
+                # first, the worst case is a pre-rewind batch landing in
+                # the NEW engine and then re-delivering after the rewind:
+                # duplicates, which is what at-least-once already means.
+                self.router.swap_engine(engine)
+                for key, offs in offsets.items():
+                    g, t = key.split("\x00", 1)
+                    self.broker.reset_offsets(g, t, offs)
+            finally:
+                self.router.resume()
+            self.restores += 1
+            return engine
+
+
+def attach_engine_service(
+    supervisor, coordinator: CheckpointCoordinator, name: str = "engine"
+):
+    """Register the engine as a supervised, chaos-killable service.
+
+    The engine itself is passive (the router calls into it), so the
+    service body is a liveness loop; what makes the kill REAL is the reset
+    hook: the supervisor runs ``coordinator.restore()`` before each
+    respawn, so a ChaosMonkey kill discards the live engine's
+    post-checkpoint state and re-drives it from the bus — the same
+    recovery a KIE pod restart goes through.
+    """
+    stop = threading.Event()
+    first = [True]
+
+    def run() -> None:
+        stop.wait()
+
+    def reset() -> None:
+        stop.clear()
+        if first[0]:
+            # initial spawn is a boot, not a crash: the live engine already
+            # holds the truth and the offsets are wherever the operator put
+            # them — restoring here would discard both
+            first[0] = False
+            return
+        coordinator.restore(reason="supervisor-restart")
+
+    supervisor.add_thread_service(name, run, stop.set, reset=reset)
+    return supervisor

@@ -551,10 +551,16 @@ class Scorer:
         from ccfd_tpu.serving.dispatch import ScorerTimeout
 
         if not self._wedge.wedged:
+            # The deadline is calibrated for one bucketed dispatch; a
+            # legitimately huge request scores as ceil(n/largest_bucket)
+            # sequential chunks, and a healthy device must not be marked
+            # wedged just because the request was big — scale the budget
+            # by the chunk count (ADVICE r3).
+            n_chunks = max(1, -(-len(x) // max(self.batch_sizes)))
             try:
                 return self._dispatcher.call(
                     lambda: self.score_pipelined(x, depth=1),
-                    self.dispatch_deadline_s,
+                    self.dispatch_deadline_s * n_chunks,
                 )
             except ScorerTimeout:
                 self.dispatch_timeouts += 1

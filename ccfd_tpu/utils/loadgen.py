@@ -83,15 +83,26 @@ def read_response(sock, buf):
                     return ok, b"", True
                 rest += chunk
                 line_end = rest.find(b"\r\n")
-            size = int(rest[:line_end], 16)
+            # chunk extensions ("1a;name=val") are legal; size is the part
+            # before any ';'
+            size = int(rest[:line_end].split(b";")[0], 16)
+            if size == 0:
+                # the zero chunk may be followed by trailer headers; the
+                # body ends at the blank line either way
+                term = rest.find(b"\r\n\r\n", line_end)
+                while term < 0:
+                    chunk = sock.recv(1 << 16)
+                    if not chunk:
+                        return ok, b"", True
+                    rest += chunk
+                    term = rest.find(b"\r\n\r\n", line_end)
+                return ok, rest[term + 4:], will_close
             need = line_end + 2 + size + 2
             while len(rest) < need:
                 chunk = sock.recv(1 << 16)
                 if not chunk:
                     return ok, b"", True
                 rest += chunk
-            if size == 0:
-                return ok, rest[need:], will_close
             rest = rest[need:]
     # neither: body is delimited by connection close
     while True:
@@ -102,12 +113,13 @@ def read_response(sock, buf):
 
 
 sock = connect()
-lat, errors = [], 0
+lat, errors, attempts = [], 0, 0
 buf = b""
 stop_at = time.perf_counter() + seconds
 t_loop = time.perf_counter()
 while time.perf_counter() < stop_at:
     t1 = time.perf_counter()
+    attempts += 1
     try:
         sock.sendall(req)
         ok, buf, closed = read_response(sock, buf)
@@ -128,8 +140,12 @@ while time.perf_counter() < stop_at:
         except OSError:
             ok, closed = False, True
     if ok is False or ok is None:
+        # non-200/failed: count it, but keep it OUT of the latency sample
+        # — throughput and percentiles describe SUCCESSFUL requests only,
+        # so a run with many errors can't report healthy-looking numbers
         errors += 1
-    lat.append((time.perf_counter() - t1) * 1e3)
+    else:
+        lat.append((time.perf_counter() - t1) * 1e3)
     if closed:
         try:
             sock.close()
@@ -137,7 +153,7 @@ while time.perf_counter() < stop_at:
             pass
         sock = connect()
         buf = b""
-print(json.dumps({"lat": lat, "errors": errors,
+print(json.dumps({"lat": lat, "errors": errors, "attempts": attempts,
                   "loop_s": time.perf_counter() - t_loop}))
 """
 
@@ -182,6 +198,7 @@ def run_loadgen(
     ]
     lat: list[float] = []
     errors = 0
+    attempts = 0
     loop_s = 0.0
     failed = 0
     try:
@@ -201,14 +218,35 @@ def run_loadgen(
                 continue
             lat.extend(rep["lat"])
             errors += rep["errors"]
+            attempts += rep.get("attempts", len(rep["lat"]))
             loop_s = max(loop_s, rep["loop_s"])
     finally:
         for pr in procs:
             if pr.poll() is None:
                 pr.kill()
     if not lat:
+        if attempts:
+            # every request errored (e.g. the model answers 500 for all):
+            # that is a REPORT, not a client failure — surface the counts
+            # that diagnose it instead of a misleading traceback
+            return {
+                "url": url,
+                "clients": clients,
+                "rows_per_request": rows_per_request,
+                "seconds": round(loop_s, 2),
+                "requests_s": 0.0,
+                "attempts_s": round(attempts / max(loop_s, 1e-9), 1),
+                "tx_s": 0.0,
+                "p50_ms": None,
+                "p99_ms": None,
+                "errors": errors,
+                "failed_clients": failed,
+            }
         raise RuntimeError(f"no client produced results ({failed} failed)")
     lat_a = np.asarray(lat)
+    # successful requests only: the clients exclude errored/retried
+    # attempts from the latency sample, so requests_s/tx_s/percentiles
+    # can't look healthy while the error counter climbs
     n_req = len(lat)
     return {
         "url": url,
@@ -216,6 +254,7 @@ def run_loadgen(
         "rows_per_request": rows_per_request,
         "seconds": round(loop_s, 2),
         "requests_s": round(n_req / loop_s, 1),
+        "attempts_s": round(attempts / loop_s, 1),
         "tx_s": round(n_req * rows_per_request / loop_s, 1),
         "p50_ms": round(float(np.percentile(lat_a, 50)), 3),
         "p99_ms": round(float(np.percentile(lat_a, 99)), 3),

@@ -1,19 +1,31 @@
-"""Chaos soak: full in-process pipeline under router kills AND a device wedge.
+"""Chaos soak: the full pipeline under STATEFUL failures, with accounting.
 
-Round-2 soaked router kills only; this round's dispatch deadline
-(serving/dispatch.py) adds the other failure domain — the accelerator
-attachment wedging mid-run. This driver runs the real pipeline
-(producer feed -> bus -> router micro-batches -> scorer -> process engine)
-with a supervisor + seeded ChaosMonkey killing the router, and at the soak
-midpoint wedges the scorer's device path for ``--wedge-s`` seconds (every
-device dispatch hangs, exactly like the tunnel failure this host actually
-exhibits). The pipeline must keep draining: scoring fails over to the host
-tier, the deadline bounds the one dispatch that hits the wedge, and the
-device path resumes after the heal.
+Round 2 soaked router kills (the one component with no state); round 3
+added a mid-soak device wedge. This round the ChaosMonkey also kills the
+ENGINE — the stateful tier — and every kill is a real crash-recovery:
+the supervisor's reset hook restores the last aligned checkpoint
+(runtime/recovery.py: engine snapshot + bus-offset rewind) and the
+re-driven records flow through the SAME live router.  The durable bus
+(segment log) underpins the replay; at the soak midpoint the scorer's
+device path additionally wedges for ``--wedge-s`` (dispatch-deadline
+failover), and a bus crash-reopen drill verifies a second Broker replayed
+from the same log agrees with the live one on every end offset and
+committed group offset.
+
+At the end, the audit stream (per-partition offset order, with the
+coordinator's per-partition ``engine_restored`` markers) is walked for the
+accounting invariant: within each engine epoch every started instance
+reaches a terminal state exactly once or is still active in the final
+engine; work a dead epoch did past its last checkpoint is counted as
+rolled back (at-least-once redelivery, like Kafka into a restarted KIE
+pod — reference deploy/ccd-service.yaml); nothing else may be lost or
+double-completed.
 
     JAX_PLATFORMS=cpu python tools/chaos_soak.py --seconds 240
 
-Prints one JSON line; record it in BASELINE.md.
+Prints one JSON line; record it in BASELINE.md.  Exit 0 only when the
+pipeline drained, the device path recovered, engine kills happened and
+every accounting check passed.
 """
 from __future__ import annotations
 
@@ -21,6 +33,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -40,8 +53,79 @@ from ccfd_tpu.models import mlp  # noqa: E402
 from ccfd_tpu.process.fraud import build_engine  # noqa: E402
 from ccfd_tpu.router.router import Router  # noqa: E402
 from ccfd_tpu.runtime.chaos import ChaosMonkey  # noqa: E402
+from ccfd_tpu.runtime.recovery import (  # noqa: E402
+    CheckpointCoordinator,
+    attach_engine_service,
+)
 from ccfd_tpu.runtime.supervisor import Supervisor  # noqa: E402
 from ccfd_tpu.serving.scorer import Scorer  # noqa: E402
+
+
+def audit_accounting(broker: Broker, topic: str) -> dict:
+    """Walk the audit stream for the at-least-once accounting invariant.
+
+    Pids are partition-sticky (events keyed by pid) and the restore marker
+    reaches every partition, so each partition's offset order is ground
+    truth — the walk keeps PER-PARTITION state (a marker repeats once per
+    partition and must only affect that partition's pids).  At an
+    ``engine_restored`` marker (runtime/recovery.py) everything the dead
+    epoch did past its last checkpoint rolls back: starts/completions of
+    pids >= next_pid (instances born after the cut) and completions of
+    pids in ``active_pids`` (instances restored as live again, whose
+    post-cut terminal events are undone and may legitimately recur).
+    Anything else lost or double-completed is a violation."""
+    starts = completes = rolled_back = markers = 0
+    violations: list[str] = []
+    c = broker.consumer("soak-audit-check", (topic,))
+    by_part: dict[int, list] = {}
+    while True:
+        recs = c.poll(50_000, timeout_s=0.2)
+        if not recs:
+            break
+        for r in recs:
+            by_part.setdefault(r.partition, []).append(r.value)
+    c.close()
+    open_at_end: set[int] = set()
+    for events in by_part.values():
+        open_p: set[int] = set()
+        done_p: set[int] = set()
+        seen_p: set[int] = set()
+        for ev in events:
+            kind = ev.get("event")
+            if kind == "engine_restored":
+                markers += 1
+                restored = set(ev.get("active_pids", ())) & seen_p
+                void_open = {x for x in open_p if x >= ev["next_pid"]}
+                void_done = {x for x in done_p if x >= ev["next_pid"]}
+                undone = done_p & restored
+                rolled_back += len(void_open) + len(void_done) + len(undone)
+                open_p = restored
+                done_p -= void_done | undone
+            elif kind == "process_started":
+                starts += 1
+                seen_p.add(ev["pid"])
+                if ev["pid"] in open_p:
+                    violations.append(f"double start pid={ev['pid']}")
+                open_p.add(ev["pid"])
+            elif kind == "process_completed":
+                completes += 1
+                if ev["pid"] in done_p:
+                    violations.append(f"double complete pid={ev['pid']}")
+                elif ev["pid"] not in open_p:
+                    violations.append(f"complete without start pid={ev['pid']}")
+                else:
+                    open_p.discard(ev["pid"])
+                    done_p.add(ev["pid"])
+        open_at_end |= open_p
+    return {
+        "starts": starts,
+        "completes": completes,
+        "rolled_back": rolled_back,
+        "restore_markers": markers,
+        "open_at_end": open_at_end,
+        "violations": violations[:20],
+        "violation_count": len(violations),
+    }
 
 
 def main() -> int:
@@ -49,6 +133,7 @@ def main() -> int:
     ap.add_argument("--seconds", type=float, default=240.0)
     ap.add_argument("--wedge-s", type=float, default=20.0,
                     help="device-wedge duration at the soak midpoint")
+
     def _positive_ms(v: str) -> float:
         f = float(v)
         if f <= 0:
@@ -59,16 +144,29 @@ def main() -> int:
 
     ap.add_argument("--deadline-ms", type=_positive_ms, default=250.0)
     ap.add_argument("--feed-batch", type=int, default=2000)
-    ap.add_argument("--audit", action="store_true",
-                    help="run with the jBPM-analog audit stream ON "
-                         "(every instance lifecycle event onto the bus)")
+    ap.add_argument("--checkpoint-s", type=float, default=3.0)
+    ap.add_argument("--chaos-interval-s", type=float, default=15.0)
+    ap.add_argument("--targets", default="router,engine",
+                    help="comma list for the ChaosMonkey")
+    ap.add_argument("--bus-log", default="",
+                    help="durable bus log dir (default: fresh tempdir)")
+    ap.add_argument("--bus-drill-tx", type=int, default=40_000,
+                    help="run the bus crash-reopen drill once this many "
+                    "transactions have flowed (early: replaying the log is "
+                    "O(records), so the drill must run on a bounded log, "
+                    "not the multi-million-record end state)")
     args = ap.parse_args()
 
-    cfg = Config(confidence_threshold=1.0,
-                 audit_topic="ccd-audit" if args.audit else "")
-    broker = Broker()
+    bus_dir = args.bus_log or tempfile.mkdtemp(prefix="ccfd_soak_bus_")
+    # audit ON: it is the accounting ledger this soak asserts over
+    cfg = Config(confidence_threshold=1.0, audit_topic="ccd-audit")
+    broker = Broker(log_dir=bus_dir)
     reg_r, reg_k, reg_c = Registry(), Registry(), Registry()
-    engine = build_engine(cfg, broker, reg_k, None)
+
+    def engine_factory():
+        return build_engine(cfg, broker, reg_k, None)
+
+    engine = engine_factory()
 
     ds = synthetic_dataset(n=4096, fraud_rate=0.002, seed=0)
     params = mlp.init(jax.random.PRNGKey(0))
@@ -92,26 +190,33 @@ def main() -> int:
     scorer._wedge._probe_interval_s = 2.0  # tight recovery for the soak
 
     router = Router(cfg, broker, scorer.score, engine, reg_r, max_batch=4096)
+    coord = CheckpointCoordinator(router, broker, engine_factory,
+                                  interval_s=args.checkpoint_s)
     sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
     sup.add_thread_service(
         "router", lambda: router.run(poll_timeout_s=0.02), router.stop,
         reset=router.reset,
     )
+    attach_engine_service(sup, coord)
     sup.start()
-    monkey = ChaosMonkey(sup, seed=11, targets=["router"],
-                         registry=reg_c, interval_s=20.0)
-    monkey.start()
+    coord.start()
 
-    # feeder: keep the topic loaded without unbounded backlog
+    # feeder: keep the topic loaded without unbounded backlog; the gate
+    # lets the bus drill quiesce production without killing the thread
     rows = [
         {FEATURE_NAMES[j]: float(ds.X[i, j]) for j in range(30)} | {"id": i}
         for i in range(args.feed_batch)
     ]
     stop_feed = threading.Event()
+    feed_gate = threading.Event()
+    feed_gate.set()
     produced = [0]
 
     def feed() -> None:
         while not stop_feed.is_set():
+            feed_gate.wait(timeout=1.0)
+            if not feed_gate.is_set():
+                continue
             done = router._c_in.value()
             if produced[0] - done < 200_000:
                 broker.produce_batch(cfg.kafka_topic, rows)
@@ -122,10 +227,62 @@ def main() -> int:
     feeder = threading.Thread(target=feed, daemon=True)
     feeder.start()
 
+    # -- bus crash-reopen drill (bounded log, under way) -------------------
+    bus_check: dict = {}
+    drill_deadline = time.time() + 60
+    while (router._c_in.value() < args.bus_drill_tx
+           and time.time() < drill_deadline):
+        time.sleep(0.25)
+    feed_gate.clear()
+    acked = router.pause(10.0)
+    try:
+        live_before = {t: broker.end_offsets(t)
+                       for t in (cfg.kafka_topic, cfg.audit_topic)}
+        committed_before = broker.committed_offsets("router", cfg.kafka_topic)
+        # Replay a COPY of the log dir, never the live one: opening a
+        # Broker replays in place — offsets.log compaction would
+        # os.replace() the file out from under the live broker's append
+        # fd (silently killing offset durability for the rest of the
+        # run), and torn-tail truncation would mutate live segments. The
+        # copy is also the honest model: a crashed process's disk as the
+        # restarting process finds it.
+        import shutil
+
+        copy_dir = tempfile.mkdtemp(prefix="ccfd_soak_busdrill_")
+        shutil.rmtree(copy_dir)
+        shutil.copytree(bus_dir, copy_dir)
+        replayed = Broker(log_dir=copy_dir)
+        rep_ends = {t: replayed.end_offsets(t) for t in live_before}
+        rep_committed = replayed.committed_offsets("router", cfg.kafka_topic)
+        replayed.close()
+        shutil.rmtree(copy_dir, ignore_errors=True)
+        live_after = {t: broker.end_offsets(t) for t in live_before}
+        # prefix-consistency: background timers may append between the
+        # live read and the copy, so the replayed view must sit between
+        # the two live reads
+        ends_ok = all(
+            live_before[t][p] <= rep_ends[t][p] <= live_after[t][p]
+            for t in live_before for p in range(len(live_before[t]))
+        )
+        bus_check = {
+            "at_tx": int(router._c_in.value()),
+            "barrier_acked": acked,
+            "end_offsets_equal": ends_ok,
+            "group_offsets_equal": rep_committed == committed_before,
+        }
+    finally:
+        router.resume()
+        feed_gate.set()
+
+    targets = [t for t in args.targets.split(",") if t]
+    monkey = ChaosMonkey(sup, seed=11, targets=targets,
+                         registry=reg_c, interval_s=args.chaos_interval_s)
+    monkey.start()
+
     t0 = time.time()
     t_wedge = t0 + args.seconds / 2
     wedge_done = False
-    wedge_info = {}
+    wedge_info: dict = {}
     last_progress, last_in = time.time(), 0
     max_stall_s = 0.0
     while time.time() - t0 < args.seconds:
@@ -151,37 +308,89 @@ def main() -> int:
 
     stop_feed.set()
     monkey.stop()
+    coord.stop()
     elapsed = time.time() - t0
+    # drain the backlog so the accounting walk sees a settled stream, then
+    # park the router for the final engine-state comparison
+    settle = time.time() + 20
+    prev = -1
+    while time.time() < settle:
+        cur = router._c_in.value()
+        if cur == prev:
+            break
+        prev = cur
+        time.sleep(1.0)
+    router.pause(10.0)
+
     total = router._c_in.value()
-    out_std = reg_r.counter("transaction_outgoing_total").value(
-        labels={"type": "standard"}
-    )
-    out_fraud = reg_r.counter("transaction_outgoing_total").value(
-        labels={"type": "fraud"}
-    )
-    audit_events = None
-    if args.audit:
-        audit_events = sum(broker.end_offsets(cfg.audit_topic))
+    final_engine = router.engine
+    acct = audit_accounting(broker, cfg.audit_topic)
+    with final_engine.state_lock:
+        active_now = {i.pid for i in final_engine.instances("active")}
+    # every audit-open pid must be live in the final engine and vice versa;
+    # a pid open in the walked stream but terminal in the engine is just a
+    # timer completion whose audit event landed after the walk (tail), not
+    # a loss — verify instead of excusing blindly
+    ghost = acct["open_at_end"] - active_now
+    tail_completed = set()
+    for pid in list(ghost):
+        try:
+            if final_engine.instance(pid).status != "active":
+                tail_completed.add(pid)
+        except KeyError:
+            pass  # evicted == long-terminal: still a real ghost
+    ghost -= tail_completed
+    unaudited = active_now - acct["open_at_end"]
+    acct_ok = not acct["violation_count"] and not ghost and not unaudited
+
+    kills: dict[str, int] = {}
+    for _ts, name in monkey.history:
+        kills[name] = kills.get(name, 0) + 1
+    status = sup.status()
     result = {
-        "audit": bool(args.audit),
-        "audit_events": audit_events,
         "seconds": round(elapsed, 1),
         "tx_total": int(total),
         "tx_s": round(total / elapsed, 1),
-        "router_kills": len(monkey.history),
-        "supervisor_restarts": sup.status()["router"]["restarts"],
+        "targets": targets,
+        "kills": kills,
+        "engine_kills": kills.get("engine", 0),
+        "router_kills": kills.get("router", 0),
+        "supervisor_restarts": {n: s["restarts"] for n, s in status.items()},
+        "checkpoints": coord.checkpoints,
+        "checkpoint_skips": coord.skipped,
+        "restores": coord.restores,
         "max_progress_stall_s": round(max_stall_s, 1),
         "wedge": wedge_info,
+        "bus_reopen_check": bus_check,
         "dispatch_timeouts": scorer.dispatch_timeouts,
         "host_fallback_scores": scorer.host_fallback_scores,
-        "process_starts": int(out_std + out_fraud),
+        "accounting": {
+            "starts": acct["starts"],
+            "completes": acct["completes"],
+            "rolled_back": acct["rolled_back"],
+            "restore_markers": acct["restore_markers"],
+            "still_active": len(active_now),
+            "ghost_open": len(ghost),
+            "tail_completions": len(tail_completed),
+            "unaudited_active": len(unaudited),
+            "violations": acct["violations"],
+            "violation_count": acct["violation_count"],
+            "ok": acct_ok,
+        },
     }
+    router.resume()
     sup.stop()
+    broker.close()
     print(json.dumps(result))
     ok = (
         total > 0
         and wedge_info.get("device_path_recovered", False)
         and wedge_info.get("healed_at_tx", 0) > wedge_info.get("wedged_at_tx", 0)
+        and result["engine_kills"] > 0
+        and coord.restores > 0
+        and bus_check.get("end_offsets_equal", False)
+        and bus_check.get("group_offsets_equal", False)
+        and acct_ok
     )
     return 0 if ok else 3
 

@@ -38,7 +38,10 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "tpu_watch.log")
-POOL_PORTS = (8083, 8093, 8103, 8113)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from tpu_triage import POOL_PORTS  # noqa: E402 — triage is the ground
+# truth for the relay's leg set; a drifted copy here would have the
+# watcher pre-filtering dead ports and skipping every healthy window
 
 
 def log(msg: str) -> None:
@@ -187,8 +190,11 @@ def main() -> int:
             log(f"poll #{attempt}: relay legs LISTENING {legs} — jax probe")
         if probe(args.probe_timeout):
             log(f"poll #{attempt}: HEALTHY — firing capture pipeline")
+            got = capture_pipeline(args.bench_timeout)
+            # stamp AFTER the pipeline: it can run ~an hour itself, and a
+            # hold-off measured from its start would already be consumed
             last_attempt = time.time()
-            if capture_pipeline(args.bench_timeout):
+            if got:
                 captured += 1
                 wait_min = args.recapture_min
             else:
