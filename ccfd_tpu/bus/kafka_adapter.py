@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import time
 from typing import Any, Iterable
 
 from ccfd_tpu.bus.broker import Record
@@ -248,8 +249,14 @@ class KafkaConsumerAdapter:
                         offset=r.offset,
                         key=r.key,
                         value=r.value,
-                        # kafka timestamps are epoch-ms; bus records use epoch-s
-                        timestamp=(r.timestamp or 0) / 1000.0,
+                        # kafka timestamps are epoch-ms; bus records use
+                        # epoch-s. A missing broker timestamp falls back
+                        # to consume time, NOT 0: the router's decision-
+                        # latency SLO observes time.time() - timestamp,
+                        # and an epoch-0 stamp would poison the histogram
+                        # with ~1.7e9 s "latencies"
+                        timestamp=(r.timestamp / 1000.0 if r.timestamp
+                                   else time.time()),
                     )
                 )
         if out:

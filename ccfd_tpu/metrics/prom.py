@@ -142,6 +142,25 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
 
+    def observe_many(
+        self, values, labels: Mapping[str, str] | None = None
+    ) -> None:
+        """Vectorized observe: one numpy pass per batch instead of a
+        Python loop per record. The router's decision-latency series
+        observes every transaction in a micro-batch at once — at 100k+
+        tx/s a per-record ``observe`` would be a pipeline bottleneck."""
+        import numpy as np
+
+        arr = np.sort(np.asarray(values, dtype=np.float64))
+        if arr.size == 0:
+            return
+        cums = [
+            int(np.searchsorted(arr, ub, side="right"))
+            if ub != math.inf else int(arr.size)
+            for ub in self.buckets
+        ]
+        self.merge_counts(cums, float(arr.sum()), labels)
+
     def merge_counts(
         self,
         bucket_counts: Sequence[int],

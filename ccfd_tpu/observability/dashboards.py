@@ -116,6 +116,12 @@ def router_dashboard() -> dict:
         _panel(5, "Scorer dispatch latency p99",
                ["histogram_quantile(0.99, rate(router_score_seconds_bucket[5m]))"]),
         _panel(6, "Decode errors / s", ["rate(transaction_decode_errors_total[5m])"]),
+        # business SLO quantiles (the reference tracks these on its
+        # SeldonCore board, reference SeldonCore.json:499-531): wall time
+        # from a record's produce timestamp to its process-start decision
+        _panel(7, "Decision latency p50/p99 (produce → process start)",
+               ["histogram_quantile(0.5, rate(router_decision_seconds_bucket[5m]))",
+                "histogram_quantile(0.99, rate(router_decision_seconds_bucket[5m]))"]),
     ]
     return _dashboard("CCFD Router", "ccfd-router", p)
 

@@ -244,6 +244,14 @@ class Router:
             "transaction_decode_errors_total", "malformed transaction fields"
         )
         self._h_score_s = r.histogram("router_score_seconds", "scorer dispatch latency")
+        # the business SLO the reference's SeldonCore board tracks as
+        # request quantiles (reference deploy/grafana/SeldonCore.json:499):
+        # wall time from a record's PRODUCE timestamp to its process-start
+        # decision — queueing + micro-batching + scoring + rules + engine
+        self._h_decision_s = r.histogram(
+            "router_decision_seconds",
+            "producer->process-start decision latency",
+        )
         self._c_rule = r.counter("router_rule_fired_total", "rule activations")
         self._c_start_err = r.counter(
             "router_process_start_errors_total", "failed process starts"
@@ -313,14 +321,19 @@ class Router:
                 records.extend(more)
         return records
 
-    def _decode_batch(self, records: list) -> tuple[np.ndarray, list]:
+    def _decode_batch(
+        self, records: list
+    ) -> tuple[np.ndarray, list, np.ndarray]:
         n = len(records)
         self._c_in.inc(n)
         self._h_batch.observe(n)
         x, txs, bad = decode_records(records)
         if bad:
             self._c_decode_err.inc(bad)
-        return x, txs
+        # produce timestamps ride along so _route can observe the
+        # end-to-end decision latency (producer -> process start)
+        ts = np.fromiter((r.timestamp for r in records), np.float64, n)
+        return x, txs, ts
 
     # -- one synchronous cycle (used by tests and the run loop) ------------
     def step(self, poll_timeout_s: float = 0.0) -> int:
@@ -329,13 +342,14 @@ class Router:
         records = self._poll_batch(poll_timeout_s)
         if not records:
             return 0
-        x, txs = self._decode_batch(records)
+        x, txs, ts = self._decode_batch(records)
         t0 = time.perf_counter()
         proba = np.asarray(self.score(x))
         self._h_score_s.observe(time.perf_counter() - t0)
-        return self._route(x, txs, proba)
+        return self._route(x, txs, proba, ts)
 
-    def _route(self, x: np.ndarray, txs: list, proba: np.ndarray) -> int:
+    def _route(self, x: np.ndarray, txs: list, proba: np.ndarray,
+               ts: np.ndarray | None = None) -> int:
         fired = self.rules.evaluate(x, proba)
         # group the micro-batch by fired rule: one batched process-start per
         # (rule, process) instead of one engine round-trip per transaction —
@@ -379,6 +393,8 @@ class Router:
             if n_ok:
                 self._c_out.inc(n_ok, labels={"type": rule.process})
                 self._c_rule.inc(n_ok, labels={"rule": rule.name})
+        if ts is not None and len(ts):
+            self._h_decision_s.observe_many(time.time() - ts)
         return len(txs)
 
     # -- checkpoint barrier ------------------------------------------------
@@ -469,7 +485,7 @@ class Router:
             return proba
 
         def finish(pending: tuple) -> None:
-            pfut, px, ptxs = pending
+            pfut, px, ptxs, pts = pending
             try:
                 proba = pfut.result()
             except Exception:
@@ -477,10 +493,10 @@ class Router:
                 # drops this batch, not the routing loop
                 self._c_score_err.inc(len(ptxs))
                 return
-            self._route(px, ptxs, proba)
+            self._route(px, ptxs, proba, pts)
 
         ex = ThreadPoolExecutor(1, thread_name_prefix="ccfd-router-score")
-        pending: tuple | None = None  # (future, x, txs)
+        pending: tuple | None = None  # (future, x, txs, ts)
         try:
             while not self._stop.is_set():
                 if self._pause_req.is_set():
@@ -502,11 +518,11 @@ class Router:
                 )
                 fut = None
                 if records:
-                    x, txs = self._decode_batch(records)
+                    x, txs, ts = self._decode_batch(records)
                     fut = ex.submit(timed_score, x)
                 if pending is not None:
                     finish(pending)
-                pending = (fut, x, txs) if fut is not None else None
+                pending = (fut, x, txs, ts) if fut is not None else None
         finally:
             try:
                 if pending is not None:

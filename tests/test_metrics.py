@@ -76,3 +76,25 @@ def test_config_from_env_roundtrip():
     assert cfg2.customer_notification_topic == "out"
     assert cfg2.customer_response_topic == "in"
     assert cfg2.batch_sizes == (8, 64)
+
+
+def test_histogram_observe_many_matches_observe():
+    from ccfd_tpu.metrics.prom import Histogram
+
+    a = Histogram("a", buckets=(0.01, 0.1, 1.0))
+    b = Histogram("b", buckets=(0.01, 0.1, 1.0))
+    vals = [0.005, 0.05, 0.5, 5.0, 0.1, 0.01]
+    for v in vals:
+        a.observe(v)
+    b.observe_many(vals)
+    assert a._counts == b._counts
+    assert abs(a.sum() - b.sum()) < 1e-9
+    assert a.quantile(0.5) == b.quantile(0.5)
+
+
+def test_histogram_observe_many_empty_noop():
+    from ccfd_tpu.metrics.prom import Histogram
+
+    h = Histogram("h")
+    h.observe_many([])
+    assert h.count() == 0

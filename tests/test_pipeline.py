@@ -246,3 +246,19 @@ def test_pipelined_sparse_traffic_latency_no_poll_stall():
     finally:
         router.stop()
         th.join(timeout=10)
+
+
+def test_decision_latency_histogram_records_per_transaction():
+    """Every routed transaction lands in router_decision_seconds: the
+    produce->process-start SLO series (reference SeldonCore.json:499 is
+    the analogous business-latency surface)."""
+    broker, clock, engine, router, notify, reg_router, reg_kie = build()
+    ds = synthetic_dataset(n=32, seed=7)
+    for i in range(32):
+        broker.produce(CFG.kafka_topic, {
+            FEATURE_NAMES[j]: float(ds.X[i, j]) for j in range(30)
+        } | {"id": i})
+    routed = router.step()
+    h = reg_router.histogram("router_decision_seconds")
+    assert routed == 32 and h.count() == 32
+    assert h.quantile(0.99) >= 0.0
