@@ -255,7 +255,10 @@ class KafkaConsumerAdapter:
                         # latency SLO observes time.time() - timestamp,
                         # and an epoch-0 stamp would poison the histogram
                         # with ~1.7e9 s "latencies"
-                        timestamp=(r.timestamp / 1000.0 if r.timestamp
+                        # (kafka-python reports -1 for
+                        # TIMESTAMP_NOT_AVAILABLE — also a fallback case)
+                        timestamp=(r.timestamp / 1000.0
+                                   if r.timestamp and r.timestamp > 0
                                    else time.time()),
                     )
                 )

