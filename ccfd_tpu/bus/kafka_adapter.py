@@ -97,6 +97,7 @@ class KafkaAdapter:
         )
         self._meta_consumer = None  # lazy: only needed for end_offsets
         self._admin = None  # lazy: only needed for create_topic
+        self._group_admins: dict[str, Any] = {}  # offset-admin consumers
         # adapter-side health series for the KafkaCluster board (broker
         # internals come from the JMX exporter; the adapter contributes its
         # own produce/send-failure view of cluster health)
@@ -142,6 +143,72 @@ class KafkaAdapter:
         tps = [self._kafka.TopicPartition(topic, p) for p in sorted(parts)]
         eo = self._meta_consumer.end_offsets(tps)
         return [eo[tp] for tp in tps]
+
+    # -- offset admin (crash-recovery surface, Broker-parity) -------------
+    def _group_admin(self, group_id: str):
+        """Cached group-scoped consumer for offset admin: the checkpoint
+        coordinator describes every cut group each interval — and while
+        the router's pause barrier is held — so paying consumer
+        construction + coordinator discovery per call would stretch every
+        checkpoint stall."""
+        c = self._group_admins.get(group_id)
+        if c is None:
+            c = self._kafka.KafkaConsumer(
+                bootstrap_servers=self.bootstrap, group_id=group_id,
+                enable_auto_commit=False,
+            )
+            self._group_admins[group_id] = c
+        return c
+
+    def _partition_count(self, topic: str) -> int:
+        if self._meta_consumer is None:
+            self._meta_consumer = self._kafka.KafkaConsumer(
+                bootstrap_servers=self.bootstrap
+            )
+        parts = self._meta_consumer.partitions_for_topic(topic)
+        return len(parts or ())
+
+    def committed_offsets(self, group_id: str, topic: str) -> list[int]:
+        """Committed offset per partition for a consumer group — the
+        ``kafka-consumer-groups --describe`` analog, same surface as
+        ``Broker.committed_offsets`` so the checkpoint coordinator
+        (runtime/recovery.py) records cuts identically against a real
+        cluster. Never-committed partitions read as 0."""
+        c = self._group_admin(group_id)
+        return [
+            int(c.committed(self._kafka.TopicPartition(topic, p)) or 0)
+            for p in range(self._partition_count(topic))
+        ]
+
+    def reset_offsets(self, group_id: str, topic: str,
+                      offsets: list[int]) -> None:
+        """Rewind (or advance) a group's commits — Kafka's
+        ``kafka-consumer-groups --reset-offsets --to-offset`` analog,
+        same surface as ``Broker.reset_offsets``. Kafka's own contract
+        applies: the group must have no ACTIVE members (the CLI tool
+        refuses too) — stop/pause consumers before rewinding, which the
+        recovery coordinator's barrier already guarantees. Out-of-range
+        values clamp to the log end."""
+        ends = self.end_offsets(topic)
+        if len(offsets) != len(ends):
+            raise ValueError(
+                f"{topic!r} has {len(ends)} partitions, "
+                f"got {len(offsets)} offsets"
+            )
+        om_cls = getattr(self._kafka, "OffsetAndMetadata", None)
+        c = self._group_admin(group_id)
+        commit_map = {}
+        for p, off in enumerate(offsets):
+            off = max(0, min(int(off), ends[p]))
+            tp = self._kafka.TopicPartition(topic, p)
+            if om_cls is None:
+                commit_map[tp] = off
+            else:
+                try:
+                    commit_map[tp] = om_cls(off, None)
+                except TypeError:  # kafka-python >= 2.2 adds leader_epoch
+                    commit_map[tp] = om_cls(off, None, -1)
+        c.commit(commit_map)
 
     # -- produce ----------------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None) -> dict[str, Any]:
@@ -215,6 +282,9 @@ class KafkaAdapter:
             self._meta_consumer.close()
         if self._admin is not None:
             self._admin.close()
+        for c in self._group_admins.values():
+            c.close()
+        self._group_admins.clear()
 
 
 class KafkaConsumerAdapter:

@@ -46,6 +46,7 @@ def reset() -> None:
 
 
 TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
+OffsetAndMetadata = namedtuple("OffsetAndMetadata", ["offset", "metadata"])
 RecordMetadata = namedtuple("RecordMetadata", ["topic", "partition", "offset"])
 ConsumerRecord = namedtuple(
     "ConsumerRecord", ["topic", "partition", "offset", "key", "value", "timestamp"]
@@ -97,6 +98,7 @@ class KafkaConsumer:
         self._vd = value_deserializer or (lambda v: v)
         self._kd = key_deserializer or (lambda k: k)
         self.enable_auto_commit = enable_auto_commit
+        self.group_id = group_id
         self.commit_calls = 0
         self._inner = (
             self._broker.consumer(group_id, topics) if topics and group_id else None
@@ -119,8 +121,28 @@ class KafkaConsumer:
             )
         return out
 
-    def commit(self) -> None:
+    def commit(self, offsets: dict | None = None) -> None:
         self.commit_calls += 1
+        if offsets:
+            # admin-style explicit commit (the adapter's reset_offsets):
+            # kafka-python accepts {TopicPartition: OffsetAndMetadata}
+            assert self.group_id, "explicit commit needs a group_id"
+            by_topic: dict[str, dict[int, int]] = {}
+            for tp, om in offsets.items():
+                off = om.offset if hasattr(om, "offset") else int(om)
+                by_topic.setdefault(tp.topic, {})[tp.partition] = off
+            for topic, parts in by_topic.items():
+                cur = self._broker.committed_offsets(self.group_id, topic)
+                for p, off in parts.items():
+                    cur[p] = off
+                self._broker.reset_offsets(self.group_id, topic, cur)
+
+    def committed(self, tp: TopicPartition) -> int | None:
+        assert self.group_id, "committed() needs a group_id"
+        offs = self._broker.committed_offsets(self.group_id, tp.topic)
+        if tp.partition >= len(offs):
+            return None
+        return offs[tp.partition] or None
 
     # -- metadata surface (used by end_offsets) ---------------------------
     def partitions_for_topic(self, topic: str) -> set[int] | None:
@@ -167,6 +189,7 @@ def module() -> SimpleNamespace:
         KafkaProducer=KafkaProducer,
         KafkaConsumer=KafkaConsumer,
         TopicPartition=TopicPartition,
+        OffsetAndMetadata=OffsetAndMetadata,
         admin=SimpleNamespace(KafkaAdminClient=KafkaAdminClient, NewTopic=NewTopic),
         errors=SimpleNamespace(TopicAlreadyExistsError=TopicAlreadyExistsError),
     )

@@ -171,6 +171,16 @@ def main() -> int:
     ds = synthetic_dataset(n=4096, fraud_rate=0.002, seed=0)
     params = mlp.init(jax.random.PRNGKey(0))
     params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    # push probabilities to a trained-model-like range (bench.py does the
+    # same): an untrained MLP fires ~half of all traffic into the fraud
+    # process, which floods the engine with open investigations at a rate
+    # no investigator pool could match and turns the soak into a
+    # snapshot-size stress test instead of a failure drill
+    import jax.numpy as jnp
+
+    params = dict(params)
+    params["layers"] = [dict(l) for l in params["layers"]]
+    params["layers"][-1]["b"] = jnp.asarray([-4.0], jnp.float32)
     scorer = Scorer(model_name="mlp", params=params,
                     batch_sizes=(128, 1024, 4096), host_tier_rows=64,
                     dispatch_deadline_ms=args.deadline_ms)
@@ -226,6 +236,40 @@ def main() -> int:
 
     feeder = threading.Thread(target=feed, daemon=True)
     feeder.start()
+
+    # -- investigators: complete open user tasks under load ----------------
+    # Without them every flagged transaction parks an instance forever and
+    # the aligned-checkpoint cost grows without bound — unrealistic (the
+    # reference demo has humans working the KIE console queue) and it
+    # turns the soak into a snapshot-size benchmark. The loop exercises
+    # complete_task against whatever engine is CURRENT, riding through
+    # restores (a kill mid-call surfaces as the shut-down engine's
+    # RuntimeError — expected, retried on the replacement).
+    stop_invest = threading.Event()
+    completed_tasks = [0]
+
+    def investigate() -> None:
+        while not stop_invest.is_set():
+            engine_now = router.engine
+            try:
+                open_tasks = engine_now.tasks("open")[:500]
+                if not open_tasks:
+                    time.sleep(0.05)
+                    continue
+                for t in open_tasks:
+                    if stop_invest.is_set():
+                        return
+                    # ground truth: V-feature sum is not recoverable here;
+                    # approve (is_fraud=False) like the demo's common case
+                    engine_now.complete_task(t.task_id, False)
+                    completed_tasks[0] += 1
+            except (RuntimeError, KeyError, ValueError):
+                # engine swapped mid-batch / task restored-completed: the
+                # replacement engine's queue is re-read next iteration
+                time.sleep(0.02)
+
+    investigator = threading.Thread(target=investigate, daemon=True)
+    investigator.start()
 
     # -- bus crash-reopen drill (bounded log, under way) -------------------
     bus_check: dict = {}
@@ -307,6 +351,8 @@ def main() -> int:
             wedge_info["device_path_recovered"] = not scorer._wedge.wedged
 
     stop_feed.set()
+    stop_invest.set()
+    investigator.join(timeout=10)
     monkey.stop()
     coord.stop()
     elapsed = time.time() - t0
@@ -364,6 +410,7 @@ def main() -> int:
         "bus_reopen_check": bus_check,
         "dispatch_timeouts": scorer.dispatch_timeouts,
         "host_fallback_scores": scorer.host_fallback_scores,
+        "tasks_completed_by_investigators": completed_tasks[0],
         "accounting": {
             "starts": acct["starts"],
             "completes": acct["completes"],

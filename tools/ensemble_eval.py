@@ -53,7 +53,6 @@ def main() -> int:
     # the exact canonical dataset the committed checkpoint trained on:
     # CCFD_CSV when present, else the full Kaggle-shaped surrogate
     # (cli._training_dataset — NOT the small test synthetic)
-    sys.path.insert(0, REPO)
     from ccfd_tpu.cli import _training_dataset
 
     ds, source = _training_dataset()
@@ -126,8 +125,11 @@ def main() -> int:
 
     auc_mlp = roc_auc(yte, p_mlp_te)
     auc_lr = roc_auc(yte, p_lr_te)
+    # the combiner family, like the weight, is chosen on VALIDATION —
+    # selecting by held-out score would re-introduce the exact test-set
+    # optimism the inner split exists to remove
     best_kind, best = max((("prob_weighted", prob), ("logit_weighted", lgt)),
-                          key=lambda kv: kv[1]["heldout_auc_at_chosen"])
+                          key=lambda kv: kv[1]["val_auc_at_chosen"])
     result = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "dataset": source,
