@@ -186,9 +186,11 @@ class KafkaAdapter:
         ``kafka-consumer-groups --reset-offsets --to-offset`` analog,
         same surface as ``Broker.reset_offsets``. Kafka's own contract
         applies: the group must have no ACTIVE members (the CLI tool
-        refuses too) — stop/pause consumers before rewinding, which the
-        recovery coordinator's barrier already guarantees. Out-of-range
-        values clamp to the log end."""
+        refuses too). NOTE a merely-paused consumer loop does NOT satisfy
+        this — kafka-python heartbeats keep parked consumers as live
+        members — which is why the recovery coordinator recycles the
+        router's consumers (Router.recycle_consumers) before rewinding.
+        Out-of-range values clamp to the log end."""
         ends = self.end_offsets(topic)
         if len(offsets) != len(ends):
             raise ValueError(
@@ -211,8 +213,16 @@ class KafkaAdapter:
         c.commit(commit_map)
 
     # -- produce ----------------------------------------------------------
-    def produce(self, topic: str, value: Any, key: Any = None) -> dict[str, Any]:
-        fut = self._producer.send(topic, value=value, key=key)
+    def produce(self, topic: str, value: Any, key: Any = None,
+                partition: int | None = None) -> dict[str, Any]:
+        """``partition`` overrides key routing (Kafka's explicit-partition
+        mode) — the recovery coordinator's per-partition ``engine_restored``
+        markers require it, same surface as ``Broker.produce``."""
+        if partition is None:
+            fut = self._producer.send(topic, value=value, key=key)
+        else:
+            fut = self._producer.send(topic, value=value, key=key,
+                                      partition=partition)
         try:
             md = fut.get(timeout=self._timeout_s)
         except Exception:

@@ -110,6 +110,9 @@ class Platform:
         self.exporter = None
         self.health_server = None
         self.chaos = None
+        self.router = None
+        self.recovery = None  # CheckpointCoordinator when crash_recovery on
+        self._engine_factory = None
         self._producer_done = threading.Event()
         self._up = False
 
@@ -163,6 +166,16 @@ class Platform:
         # 6. router (README.md:424-459)
         if spec.component("router").enabled:
             self._up_router()
+
+        # 6b. engine crash recovery (engine opt `crash_recovery`): aligned
+        #     checkpoints + bus-offset-rewind restore, the stronger story
+        #     than the file-based `state_file` persistence — crash-
+        #     consistent with the bus, and chaos-killable as a supervised
+        #     service (runtime/recovery.py; drilled by tools/chaos_soak.py)
+        if (spec.component("engine").enabled
+                and spec.component("engine").opt("crash_recovery", False)
+                and self.engine is not None and self.router is not None):
+            self._up_crash_recovery()
 
         # 7. online retrain (new capability; BASELINE.json configs[4])
         if spec.component("retrain").enabled and self.scorer is not None:
@@ -326,10 +339,17 @@ class Platform:
                 if self.scorer is not None
                 else None
             )
-        self.engine = build_engine(
-            self.cfg, self.broker, self._registry("kie"), prediction_service=pred,
-            task_listener=listener,
-        )
+        def engine_factory():
+            # crash recovery rebuilds with the same wiring (definitions are
+            # code; the shared registry keeps counters cumulative across
+            # engine epochs)
+            return build_engine(
+                self.cfg, self.broker, self._registry("kie"),
+                prediction_service=pred, task_listener=listener,
+            )
+
+        self._engine_factory = engine_factory
+        self.engine = engine_factory()
         # jBPM-style engine persistence: restore process state across
         # restarts (overdue timers fire promptly after restore)
         state_file = c.opt("state_file", "")
@@ -405,6 +425,7 @@ class Platform:
         router = Router(
             self.cfg, self.broker, score_fn, engine, self._registry("router")
         )
+        self.router = router
         self.supervisor.add_thread_service(
             "router",
             lambda: router.run(poll_timeout_s=0.02),
@@ -412,6 +433,32 @@ class Platform:
             policy=RestartPolicy.ALWAYS,
             reset=router.reset,
         )
+
+    def _up_crash_recovery(self) -> None:
+        """Aligned checkpoints + engine-as-supervised-service: an engine
+        crash (chaos or real) restores the last cut and re-drives the
+        bus through the LIVE router (runtime/recovery.py). The engine's
+        other referents (this platform object, the KIE REST server)
+        re-point via on_swap inside the barrier."""
+        from ccfd_tpu.runtime.recovery import (
+            CheckpointCoordinator,
+            attach_engine_service,
+        )
+
+        c = self.spec.component("engine")
+
+        def on_swap(engine) -> None:
+            self.engine = engine
+            if self.engine_server is not None:
+                self.engine_server.engine = engine
+
+        self.recovery = CheckpointCoordinator(
+            self.router, self.broker, self._engine_factory,
+            interval_s=float(c.opt("checkpoint_interval_s", 5.0)),
+            on_swap=on_swap,
+        )
+        attach_engine_service(self.supervisor, self.recovery)
+        self.recovery.start()
 
     def _up_retrain(self) -> None:
         from ccfd_tpu.parallel.online import OnlineTrainer
@@ -539,6 +586,8 @@ class Platform:
         # down would race the orderly shutdown
         if self.chaos is not None:
             self.chaos.stop()
+        if self.recovery is not None:
+            self.recovery.stop()
         if self.supervisor:
             self.supervisor.stop()
         if self.engine is not None and (

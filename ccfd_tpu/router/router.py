@@ -430,6 +430,29 @@ class Router:
             time.sleep(0.005)
         self._pause_ack.clear()
 
+    def recycle_consumers(self) -> None:
+        """Close and recreate the bus consumers — with the loop parked at
+        the pause barrier (or stopped). Crash recovery calls this before
+        rewinding group offsets: a parked loop still leaves the old
+        consumers as LIVE group members on a real Kafka cluster
+        (kafka-python heartbeats run on a background thread), and Kafka
+        refuses offset resets for a non-empty group. In-process the same
+        sequence is a cheap rebalance. The recreated consumers resume at
+        the (about-to-be-rewound) committed offsets, like any group
+        member."""
+        for attr, group, topics in (
+            ("_tx_consumer", "router", (self.cfg.kafka_topic,)),
+            ("_resp_consumer", "router-responses",
+             (self.cfg.customer_response_topic,)),
+            ("_notif_watcher", "router-notifications",
+             (self.cfg.customer_notification_topic,)),
+        ):
+            try:
+                getattr(self, attr).close()
+            except Exception:  # noqa: BLE001 - a dead consumer is fine here
+                pass
+            setattr(self, attr, self.broker.consumer(group, topics))
+
     def swap_engine(self, engine: EngineClient) -> None:
         """Point the router at a replacement engine — crash recovery swaps
         in a snapshot-restored instance (runtime/recovery.py). The router

@@ -57,12 +57,16 @@ class CheckpointCoordinator:
         engine_factory: Callable[[], Engine],
         interval_s: float = 5.0,
         pause_timeout_s: float = 10.0,
+        on_swap: Callable[[Engine], None] | None = None,
     ):
         self.router = router
         self.broker = broker
         self.engine_factory = engine_factory
         self.interval_s = interval_s
         self.pause_timeout_s = pause_timeout_s
+        # other holders of an engine reference (the KIE-shaped REST
+        # server, the platform object) re-point here, inside the barrier
+        self.on_swap = on_swap
         cfg = router.cfg
         # every (group, topic) whose consumption mutates engine state
         self._cut_groups = (
@@ -151,7 +155,8 @@ class CheckpointCoordinator:
         yet, recovery is from genesis: empty engine, offsets 0 — the full
         at-least-once replay of the durable log."""
         with self._lock:
-            if not self.router.pause(self.pause_timeout_s):
+            acked = self.router.pause(self.pause_timeout_s)
+            if not acked:
                 self.unacked_restores += 1
             try:
                 # silence the doomed engine FIRST: its scheduled timers
@@ -218,6 +223,16 @@ class CheckpointCoordinator:
                 # the NEW engine and then re-delivering after the rewind:
                 # duplicates, which is what at-least-once already means.
                 self.router.swap_engine(engine)
+                if self.on_swap is not None:
+                    self.on_swap(engine)
+                if acked or not self._router_loop_alive():
+                    # real Kafka refuses offset resets for a group with
+                    # live members: the parked loop's consumers still
+                    # heartbeat, so they are closed and recreated before
+                    # the rewind (in-process: a cheap rebalance). Only
+                    # safe when the loop is provably parked or dead — an
+                    # unacked live loop could be mid-poll on them.
+                    self.router.recycle_consumers()
                 for key, offs in offsets.items():
                     g, t = key.split("\x00", 1)
                     self.broker.reset_offsets(g, t, offs)

@@ -72,8 +72,10 @@ class KafkaProducer:
         self._ks = key_serializer or (lambda k: k)
         self.flush_calls = 0
 
-    def send(self, topic: str, value: Any = None, key: Any = None) -> _Future:
-        rec = self._broker.produce(topic, self._vs(value), key=self._ks(key))
+    def send(self, topic: str, value: Any = None, key: Any = None,
+             partition: int | None = None) -> _Future:
+        rec = self._broker.produce(topic, self._vs(value), key=self._ks(key),
+                                   partition=partition)
         return _Future(RecordMetadata(rec.topic, rec.partition, rec.offset))
 
     def flush(self, timeout: float | None = None) -> None:

@@ -186,3 +186,62 @@ class TestBringUp:
             assert "router" not in platform.status()["services"]
         finally:
             platform.down()
+
+
+class TestCrashRecovery:
+    def test_engine_crash_recovery_through_operator(self):
+        """The CR opt `engine.crash_recovery` wires the aligned-checkpoint
+        coordinator into the run-book bring-up: a chaos kill of the engine
+        service restores the last cut, re-points every engine referent
+        (platform + KIE REST server), and the pipeline keeps flowing."""
+        cr = minimal_cr(
+            engine={"enabled": True, "crash_recovery": True, "rest": True,
+                    "checkpoint_interval_s": 0.5},
+        )
+        cfg = Config(fraud_threshold=2.0)  # all standard: deterministic
+        platform = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(
+            wait_ready_s=20.0
+        )
+        try:
+            assert platform.recovery is not None
+            assert "engine" in platform.supervisor.status()
+            from ccfd_tpu.data.ccfd import FEATURE_NAMES
+
+            rows = [{FEATURE_NAMES[j]: float(j) for j in range(30)}
+                    | {"id": i} for i in range(40)]
+            platform.broker.produce_batch(cfg.kafka_topic, rows)
+            deadline = time.time() + 20
+            while (platform.router._c_in.value() < 40
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            assert platform.router._c_in.value() >= 40
+            # wait for a checkpoint, then kill the engine service
+            deadline = time.time() + 10
+            while platform.recovery.checkpoints == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert platform.recovery.checkpoints > 0
+            old_engine = platform.engine
+            assert platform.supervisor.inject_failure("engine", "test")
+            deadline = time.time() + 15
+            while platform.recovery.restores == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert platform.recovery.restores == 1
+            # give the swap a moment to land, then check the re-pointing
+            deadline = time.time() + 5
+            while platform.engine is old_engine and time.time() < deadline:
+                time.sleep(0.05)
+            assert platform.engine is not old_engine
+            assert platform.engine_server.engine is platform.engine
+            assert platform.router.engine is platform.engine
+            # pipeline still flows through the restored engine
+            platform.broker.produce_batch(
+                cfg.kafka_topic, [dict(r, id=100 + i)
+                                  for i, r in enumerate(rows[:10])]
+            )
+            deadline = time.time() + 20
+            while (platform.router._c_in.value() < 50
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            assert platform.router._c_in.value() >= 50
+        finally:
+            platform.down()
