@@ -294,7 +294,11 @@ def _bench_pipeline(scorer_params, seconds):
     rate = max(5_000.0, min(20_000.0, result["tx_s"] * 0.5))
     th2 = router2.start(poll_timeout_s=0.01, pipeline=True)
     t_end = time.perf_counter() + max(3.0, seconds / 2)
-    chunk = max(1, int(rate * 0.02))
+    # 5 ms production tick: the tick is a floor under every record's
+    # queueing delay (a record waits out the rest of its burst), so a
+    # coarse tick would measure the generator, not the pipeline
+    tick = 0.005
+    chunk = max(1, int(rate * tick))
     i = 0
     while time.perf_counter() < t_end:
         broker.produce_batch(
@@ -302,7 +306,7 @@ def _bench_pipeline(scorer_params, seconds):
             keys[i % 4096:i % 4096 + chunk],
         )
         i += chunk
-        time.sleep(0.02)
+        time.sleep(tick)
     # drain, then read the quantiles
     deadline = time.perf_counter() + 10
     while (router2._c_in.value() < i

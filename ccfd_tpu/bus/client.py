@@ -57,10 +57,20 @@ class RemoteBroker:
             raise RemoteBusError(str(e)) from e
 
     # -- Broker surface ----------------------------------------------------
-    def produce(self, topic: str, value: Any, key: Any = None) -> dict[str, Any]:
+    def produce(self, topic: str, value: Any, key: Any = None,
+                partition: int | None = None) -> dict[str, Any]:
+        """``partition`` overrides key routing — same surface as
+        ``Broker.produce`` / ``KafkaAdapter.produce`` (control records
+        like the recovery coordinator's per-partition markers need it on
+        every transport)."""
+        rec: dict[str, Any] = {
+            "value": encode_value(value), "key": encode_value(key),
+        }
+        if partition is not None:
+            rec["partition"] = int(partition)
         code, body = self._request(
             "POST", f"/topics/{topic}/produce",
-            {"records": [{"value": encode_value(value), "key": encode_value(key)}]},
+            {"records": [rec]},
             idempotent=False,
         )
         if code != 200:

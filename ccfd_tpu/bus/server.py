@@ -243,6 +243,9 @@ class BrokerServer:
                             m.group(1),
                             decode_value(r.get("value")),
                             key=decode_value(r.get("key")),
+                            # explicit-partition mode (control records,
+                            # e.g. recovery's engine_restored markers)
+                            partition=r.get("partition"),
                         )
                         metas.append({"partition": rec.partition, "offset": rec.offset})
                     server._c_produced.inc(len(metas))

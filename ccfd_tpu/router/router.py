@@ -219,13 +219,17 @@ class Router:
         # one call per (rule, micro-batch) group instead of one per tx
         self._start_batch = getattr(engine, "start_process_batch", None)
 
-        self._tx_consumer = broker.consumer("router", (cfg.kafka_topic,))
-        self._resp_consumer = broker.consumer(
-            "router-responses", (cfg.customer_response_topic,)
+        # single source of truth for the consumer wiring: __init__ AND
+        # recycle_consumers (crash recovery) both build from this
+        self._consumer_specs = (
+            ("_tx_consumer", "router", (cfg.kafka_topic,)),
+            ("_resp_consumer", "router-responses",
+             (cfg.customer_response_topic,)),
+            ("_notif_watcher", "router-notifications",
+             (cfg.customer_notification_topic,)),
         )
-        self._notif_watcher = broker.consumer(
-            "router-notifications", (cfg.customer_notification_topic,)
-        )
+        for attr, group, topics in self._consumer_specs:
+            setattr(self, attr, broker.consumer(group, topics))
 
         r = self.registry
         self._c_in = r.counter("transaction_incoming_total", "transactions consumed")
@@ -440,13 +444,7 @@ class Router:
         sequence is a cheap rebalance. The recreated consumers resume at
         the (about-to-be-rewound) committed offsets, like any group
         member."""
-        for attr, group, topics in (
-            ("_tx_consumer", "router", (self.cfg.kafka_topic,)),
-            ("_resp_consumer", "router-responses",
-             (self.cfg.customer_response_topic,)),
-            ("_notif_watcher", "router-notifications",
-             (self.cfg.customer_notification_topic,)),
-        ):
+        for attr, group, topics in self._consumer_specs:
             try:
                 getattr(self, attr).close()
             except Exception:  # noqa: BLE001 - a dead consumer is fine here

@@ -145,52 +145,59 @@ out["retrain"] = {
 }
 
 # --- long-context: ring/ulysses at sp=n vs single-device attention -------
+# Two regimes at equal token count: short windows (L=64) where the ring's
+# per-hop latency dominates, and long windows (L=512) where per-chunk
+# attention compute (O(L^2/sp)) amortizes the same number of hops — the
+# regime sequence parallelism exists for.
 from ccfd_tpu.models import seq as seq_mod
 
-B, L = 128, 64
 sparams = seq_mod.init(jax.random.PRNGKey(2))
-xs = jnp.asarray(
-    np.random.default_rng(3).standard_normal((B, L, 30)), jnp.float32
-)
 
 def seq_step(attn):
     return jax.jit(lambda p, xx: jax.nn.sigmoid(
         seq_mod.logits(p, xx, jnp.float32, attention_fn=attn)
     ))
 
-t_single = timed(seq_step(None), sparams, xs)
-seq_out = {"batch": B, "seq_len": L,
-           "single_ms": round(t_single * 1e3, 3)}
-if n > 1:
-    from ccfd_tpu.ops.ring_attention import ring_attention
-    from ccfd_tpu.ops.ulysses import ulysses_attention
-    from ccfd_tpu.parallel.mesh import make_mesh
+out["seq"] = []
+for B, L in ((128, 64), (16, 512)):
+    xs = jnp.asarray(
+        np.random.default_rng(3).standard_normal((B, L, 30)), jnp.float32
+    )
+    t_single = timed(seq_step(None), sparams, xs)
+    seq_out = {"batch": B, "seq_len": L,
+               "single_ms": round(t_single * 1e3, 3)}
+    if n > 1:
+        from ccfd_tpu.ops.ring_attention import ring_attention
+        from ccfd_tpu.ops.ulysses import ulysses_attention
+        from ccfd_tpu.parallel.mesh import make_mesh
 
-    sp_mesh = make_mesh(model_parallel=n, devices=devices)
-    seq_out["sp_degree"] = n
-    ring_fn = seq_step(lambda q, k, v: ring_attention(q, k, v, sp_mesh, "model"))
-    ring_c = compile_once(ring_fn, sparams, xs)
-    t_ring = timed(ring_c, sparams, xs)
-    seq_out["ring_ms"] = round(t_ring * 1e3, 3)
-    seq_out["ring_overhead_pct"] = round((t_ring / t_single - 1) * 100, 1)
-    seq_out["ring_comm_ops"] = comm_counts(ring_c)
-    n_heads = seq_mod.N_HEADS
-    if n_heads % n == 0:
-        uly_fn = seq_step(
-            lambda q, k, v: ulysses_attention(q, k, v, sp_mesh, "model")
+        sp_mesh = make_mesh(model_parallel=n, devices=devices)
+        seq_out["sp_degree"] = n
+        ring_fn = seq_step(
+            lambda q, k, v: ring_attention(q, k, v, sp_mesh, "model")
         )
-        uly_c = compile_once(uly_fn, sparams, xs)
-        t_uly = timed(uly_c, sparams, xs)
-        seq_out["ulysses_ms"] = round(t_uly * 1e3, 3)
-        seq_out["ulysses_overhead_pct"] = round(
-            (t_uly / t_single - 1) * 100, 1
-        )
-        seq_out["ulysses_comm_ops"] = comm_counts(uly_c)
-    else:
-        # documented constraint: ulysses reshards heads over the axis and
-        # needs heads % sp == 0; ring has no such bound
-        seq_out["ulysses_ms"] = f"n/a (heads {n_heads} % sp {n} != 0)"
-out["seq"] = seq_out
+        ring_c = compile_once(ring_fn, sparams, xs)
+        t_ring = timed(ring_c, sparams, xs)
+        seq_out["ring_ms"] = round(t_ring * 1e3, 3)
+        seq_out["ring_overhead_pct"] = round((t_ring / t_single - 1) * 100, 1)
+        seq_out["ring_comm_ops"] = comm_counts(ring_c)
+        n_heads = seq_mod.N_HEADS
+        if n_heads % n == 0:
+            uly_fn = seq_step(
+                lambda q, k, v: ulysses_attention(q, k, v, sp_mesh, "model")
+            )
+            uly_c = compile_once(uly_fn, sparams, xs)
+            t_uly = timed(uly_c, sparams, xs)
+            seq_out["ulysses_ms"] = round(t_uly * 1e3, 3)
+            seq_out["ulysses_overhead_pct"] = round(
+                (t_uly / t_single - 1) * 100, 1
+            )
+            seq_out["ulysses_comm_ops"] = comm_counts(uly_c)
+        else:
+            # documented constraint: ulysses reshards heads over the axis
+            # and needs heads % sp == 0; ring has no such bound
+            seq_out["ulysses_ms"] = f"n/a (heads {n_heads} % sp {n} != 0)"
+    out["seq"].append(seq_out)
 print("RESULT " + json.dumps(out))
 """
 
