@@ -239,14 +239,26 @@ class BrokerServer:
                         return
                     metas = []
                     for r in records:
-                        rec = server.broker.produce(
-                            m.group(1),
-                            decode_value(r.get("value")),
-                            key=decode_value(r.get("key")),
-                            # explicit-partition mode (control records,
-                            # e.g. recovery's engine_restored markers)
-                            partition=r.get("partition"),
-                        )
+                        # explicit-partition mode (control records, e.g.
+                        # recovery's engine_restored markers) — validated
+                        # here so a bad value gets the JSON error
+                        # contract, not a dropped connection
+                        part = r.get("partition")
+                        if part is not None and not isinstance(part, int):
+                            self._send_json(
+                                400, {"error": "partition must be an int"}
+                            )
+                            return
+                        try:
+                            rec = server.broker.produce(
+                                m.group(1),
+                                decode_value(r.get("value")),
+                                key=decode_value(r.get("key")),
+                                partition=part,
+                            )
+                        except ValueError as e:
+                            self._send_json(400, {"error": str(e)})
+                            return
                         metas.append({"partition": rec.partition, "offset": rec.offset})
                     server._c_produced.inc(len(metas))
                     server._c_topic_in.inc(len(metas), labels={"topic": m.group(1)})

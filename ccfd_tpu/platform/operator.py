@@ -456,7 +456,14 @@ class Platform:
             self.router, self.broker, self._engine_factory,
             interval_s=float(c.opt("checkpoint_interval_s", 5.0)),
             on_swap=on_swap,
+            path=c.opt("checkpoint_file", "") or None,
         )
+        # full-process crash recovery: the services haven't started yet,
+        # so a persisted cut restores cleanly here — engine state from
+        # the cut, the gap re-driven from the (durable) bus after start.
+        # Takes precedence over the file-based `state_file` load (the cut
+        # is crash-consistent with the bus; state_file is not).
+        self.recovery.restore_from_disk()
         attach_engine_service(self.supervisor, self.recovery)
         self.recovery.start()
 

@@ -58,6 +58,7 @@ class CheckpointCoordinator:
         interval_s: float = 5.0,
         pause_timeout_s: float = 10.0,
         on_swap: Callable[[Engine], None] | None = None,
+        path: str | None = None,
     ):
         self.router = router
         self.broker = broker
@@ -74,6 +75,12 @@ class CheckpointCoordinator:
             ("router-responses", cfg.customer_response_topic),
         )
         self._audit_topic = cfg.audit_topic
+        # cut durability: with ``path`` set, every validated cut lands on
+        # disk (tmp+rename), so a FULL-process crash recovers via
+        # restore_from_disk() at the next bring-up — paired with a
+        # durable bus (log_dir), that is the complete crash story:
+        # engine state from the cut, the gap re-driven from the log
+        self.path = path
         self._last: dict[str, Any] | None = None  # {"snap","offsets","ts"}
         self._lock = threading.Lock()  # serializes checkpoint vs restore
         self._stop = threading.Event()
@@ -114,6 +121,15 @@ class CheckpointCoordinator:
                 self.router.resume()
             cut["snap"] = json.loads(json.dumps(cut["snap"]))
             self._last = cut
+            if self.path:
+                import os
+
+                parent = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(parent, exist_ok=True)
+                tmp = f"{self.path}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"version": 1, **cut}, f)
+                os.replace(tmp, self.path)
             self.checkpoints += 1
             return cut
 
@@ -145,7 +161,7 @@ class CheckpointCoordinator:
             self._thread.join(timeout=5.0)
 
     # -- restore -----------------------------------------------------------
-    def restore(self, reason: str = "crash") -> Engine:
+    def restore(self, reason: str = "crash", boot: bool = False) -> Engine:
         """Rebuild the engine from the last cut and rewind the bus to it.
 
         Safe to call from the supervisor's reset hook while the router is
@@ -153,10 +169,17 @@ class CheckpointCoordinator:
         batch drains into the doomed engine first — those starts are void,
         their records re-deliver after the rewind).  With no checkpoint
         yet, recovery is from genesis: empty engine, offsets 0 — the full
-        at-least-once replay of the durable log."""
+        at-least-once replay of the durable log.
+
+        ``boot=True`` (restore_from_disk at bring-up, before any service
+        thread exists): there is no loop to ack the barrier — waiting the
+        pause timeout would just stall bring-up — and recycling the
+        consumers is unconditionally safe."""
         with self._lock:
-            acked = self.router.pause(self.pause_timeout_s)
-            if not acked:
+            acked = self.router.pause(0.0 if boot else self.pause_timeout_s)
+            if not acked and not boot and self._router_loop_alive():
+                # only a LIVE loop missing the barrier is notable; a
+                # stopped router has nothing to ack
                 self.unacked_restores += 1
             try:
                 # silence the doomed engine FIRST: its scheduled timers
@@ -225,13 +248,13 @@ class CheckpointCoordinator:
                 self.router.swap_engine(engine)
                 if self.on_swap is not None:
                     self.on_swap(engine)
-                if acked or not self._router_loop_alive():
+                if boot or acked or not self._router_loop_alive():
                     # real Kafka refuses offset resets for a group with
                     # live members: the parked loop's consumers still
                     # heartbeat, so they are closed and recreated before
                     # the rewind (in-process: a cheap rebalance). Only
-                    # safe when the loop is provably parked or dead — an
-                    # unacked live loop could be mid-poll on them.
+                    # safe when the loop is provably parked, dead, or not
+                    # yet born — an unacked live loop could be mid-poll.
                     self.router.recycle_consumers()
                 for key, offs in offsets.items():
                     g, t = key.split("\x00", 1)
@@ -240,6 +263,38 @@ class CheckpointCoordinator:
                 self.router.resume()
             self.restores += 1
             return engine
+
+
+    # -- full-process crash recovery ---------------------------------------
+    def restore_from_disk(self, reason: str = "boot") -> Engine | None:
+        """Recover from the on-disk cut at bring-up, BEFORE the router's
+        loop starts: loads the last persisted checkpoint, restores it into
+        a fresh engine, rewinds the bus groups to the cut, and swaps it in
+        — the same restore path a live crash takes, minus a barrier to
+        wait for. Returns the restored engine, or None when no usable cut
+        exists (missing/corrupt file reads as a cold start, never a
+        crash)."""
+        import json
+        import os
+
+        if not self.path or not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                cut = json.load(f)
+            if cut.get("version") != 1:
+                raise ValueError(f"unknown cut version {cut.get('version')!r}")
+            last = {"snap": cut["snap"], "offsets": cut["offsets"],
+                    "ts": cut.get("ts", 0.0)}
+        except (OSError, ValueError, KeyError) as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "checkpoint file %s unusable (%s); cold start", self.path, e
+            )
+            return None
+        self._last = last
+        return self.restore(reason=reason, boot=True)
 
 
 def attach_engine_service(

@@ -346,3 +346,63 @@ def test_restore_without_checkpoint_is_genesis_replay():
     finally:
         router.stop()
         t.join(timeout=5)
+
+
+def test_full_process_crash_recovery_from_disk(tmp_path):
+    """The complete crash story: cut persisted to disk + durable bus.
+    'Process 1' checkpoints mid-stream and dies with post-cut work done;
+    'process 2' (new broker replayed from the log, new engine, new
+    router) restores the cut from disk before its loop starts and the
+    rewound bus re-drives exactly the post-cut gap."""
+    bus_dir = str(tmp_path / "buslog")
+    cut_file = str(tmp_path / "cut.json")
+
+    # ---- process 1 ----
+    b1 = Broker(default_partitions=1, log_dir=bus_dir)
+    reg1 = Registry()
+    f1 = lambda: build_engine(CFG, b1, reg1)  # noqa: E731
+    r1 = Router(CFG, b1, amount_score, f1(), Registry())
+    c1 = CheckpointCoordinator(r1, b1, f1, interval_s=999.0, path=cut_file)
+    t1 = r1.start(poll_timeout_s=0.01)
+    try:
+        b1.produce_batch(CFG.kafka_topic, [tx(i, 10.0) for i in range(15)])
+        _drain(r1, 15)
+        assert c1.checkpoint() is not None
+        b1.produce_batch(CFG.kafka_topic,
+                         [tx(i, 10.0) for i in range(15, 25)])
+        _drain(r1, 25)
+    finally:
+        r1.stop()
+        t1.join(timeout=5)
+    b1.close()  # process 1 dies
+
+    # ---- process 2 ----
+    b2 = Broker(default_partitions=1, log_dir=bus_dir)
+    reg2 = Registry()
+    f2 = lambda: build_engine(CFG, b2, reg2)  # noqa: E731
+    r2 = Router(CFG, b2, amount_score, f2(), Registry())
+    c2 = CheckpointCoordinator(r2, b2, f2, interval_s=999.0, path=cut_file)
+    restored = c2.restore_from_disk()
+    assert restored is not None and c2.restores == 1
+    assert r2.engine is restored
+    t2 = r2.start(poll_timeout_s=0.01)
+    try:
+        _drain(r2, 10)  # exactly the post-cut gap re-drives
+        started = reg2.counter("process_instances_started_total").value(
+            labels={"process": "standard"}
+        )
+        assert started == 10
+    finally:
+        r2.stop()
+        t2.join(timeout=5)
+    b2.close()
+
+
+def test_restore_from_disk_tolerates_missing_and_corrupt(tmp_path):
+    broker, router, coord = _pipeline()
+    coord.path = str(tmp_path / "none.json")
+    assert coord.restore_from_disk() is None  # missing: cold start
+    (tmp_path / "bad.json").write_text("{torn")
+    coord.path = str(tmp_path / "bad.json")
+    assert coord.restore_from_disk() is None  # corrupt: cold start
+    assert coord.restores == 0
