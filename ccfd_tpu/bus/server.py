@@ -237,29 +237,47 @@ class BrokerServer:
                     if not isinstance(records, list):
                         self._send_json(400, {"error": "need records: [...]"})
                         return
-                    metas = []
+                    # explicit-partition mode (control records, e.g.
+                    # recovery's engine_restored markers). Validate the
+                    # WHOLE batch before producing anything: a mid-batch
+                    # reject would otherwise leave a silent prefix in the
+                    # log that the counters never saw. (bool is an int
+                    # subclass in Python — JSON true must not route to
+                    # partition 1.)
                     for r in records:
-                        # explicit-partition mode (control records, e.g.
-                        # recovery's engine_restored markers) — validated
-                        # here so a bad value gets the JSON error
-                        # contract, not a dropped connection
                         part = r.get("partition")
-                        if part is not None and not isinstance(part, int):
+                        if part is not None and (
+                            isinstance(part, bool)
+                            or not isinstance(part, int)
+                        ):
                             self._send_json(
                                 400, {"error": "partition must be an int"}
                             )
                             return
-                        try:
+                    metas = []
+                    try:
+                        for r in records:
                             rec = server.broker.produce(
                                 m.group(1),
                                 decode_value(r.get("value")),
                                 key=decode_value(r.get("key")),
-                                partition=part,
+                                partition=r.get("partition"),
                             )
-                        except ValueError as e:
-                            self._send_json(400, {"error": str(e)})
-                            return
-                        metas.append({"partition": rec.partition, "offset": rec.offset})
+                            metas.append({"partition": rec.partition,
+                                          "offset": rec.offset})
+                    except ValueError as e:
+                        # out-of-range partition: records 0..k-1 ARE in
+                        # the log — count them so metrics agree with
+                        # end_offsets, and tell the client how far it got
+                        if metas:
+                            server._c_produced.inc(len(metas))
+                            server._c_topic_in.inc(
+                                len(metas), labels={"topic": m.group(1)}
+                            )
+                        self._send_json(
+                            400, {"error": str(e), "produced": len(metas)}
+                        )
+                        return
                     server._c_produced.inc(len(metas))
                     server._c_topic_in.inc(len(metas), labels={"topic": m.group(1)})
                     self._send_json(200, {"metas": metas})

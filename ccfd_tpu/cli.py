@@ -759,6 +759,42 @@ def cmd_notify(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_investigate(args: argparse.Namespace) -> int:
+    """Investigator simulation working the engine's task queue over the
+    KIE-shaped REST contract (the demo's Business Central humans,
+    reference README.md:547-581) — seeded verdicts, rate-limited, trusts
+    confident console pre-fills; the decisions train the user-task
+    model."""
+    from ccfd_tpu.process.client import EngineRestClient
+    from ccfd_tpu.process.investigator import InvestigatorService
+
+    cfg = Config.from_env()
+    engine = EngineRestClient(
+        args.engine_url or cfg.kie_server_url,
+        timeout_s=cfg.seldon_timeout_ms / 1000.0,
+        retries=cfg.client_retries,
+    )
+    svc = InvestigatorService(
+        engine, rate_per_s=args.rate, trust_threshold=args.trust,
+        base_fraud_rate=args.fraud_rate, seed=args.seed,
+    )
+    from ccfd_tpu.metrics.exporter import MetricsExporter
+
+    exporter = MetricsExporter(
+        {"investigator": svc.registry}, host="0.0.0.0",
+        port=args.metrics_port,
+    ).start()
+    print(f"[investigate] working {args.engine_url or cfg.kie_server_url} "
+          f"at <= {args.rate}/s; metrics on :{args.metrics_port}/prometheus",
+          file=sys.stderr)
+    try:
+        svc.run()
+    except KeyboardInterrupt:
+        svc.stop()
+    exporter.stop()
+    return 0
+
+
 def cmd_producer(args: argparse.Namespace) -> int:
     """Standalone transaction producer (reference ProducerDeployment)."""
     from ccfd_tpu.producer.producer import Producer
@@ -1200,6 +1236,23 @@ def main(argv: list[str] | None = None) -> int:
     no.add_argument("--seed", type=int, default=0)
     no.add_argument("--metrics-port", type=int, default=8080)
     no.set_defaults(fn=cmd_notify)
+
+    inv = sub.add_parser(
+        "investigate",
+        help="investigator simulation over the KIE REST contract",
+    )
+    inv.add_argument("--engine-url", default="",
+                     help="engine REST base (default: KIE_SERVER_URL)")
+    inv.add_argument("--rate", type=float, default=50.0,
+                     help="max task completions per second")
+    inv.add_argument("--trust", type=float, default=0.9,
+                     help="follow the console pre-fill at/above this "
+                          "prediction confidence")
+    inv.add_argument("--fraud-rate", type=float, default=0.05,
+                     help="independent-verdict fraud probability")
+    inv.add_argument("--seed", type=int, default=0)
+    inv.add_argument("--metrics-port", type=int, default=8082)
+    inv.set_defaults(fn=cmd_investigate)
 
     pr = sub.add_parser("producer", help="standalone transaction producer")
     pr.add_argument("--limit", type=int, default=None)

@@ -41,6 +41,9 @@ _COMPONENTS = (
     "router",     # Camel router (L3)
     "producer",   # Kafka producer (L1) — one-shot job semantics
     "retrain",    # online retrain (new; BASELINE.json configs[4])
+    "investigator",  # investigator simulation working the task queue
+                  # (the reference demo's Business Central humans,
+                  # README.md:547-581) — trains the user-task model
     "analytics",  # batch analytics + drift (JupyterHub/Spark analog,
                   # reference frauddetection_cr.yaml:7-53)
     "monitoring", # Prometheus exporter (L7)
@@ -67,11 +70,14 @@ class PlatformSpec:
                 block = {"enabled": block}
             comps[name] = ComponentSpec(
                 # absent blocks default on, EXCEPT: producer/store (traffic
-                # and data sources are explicit choices) and chaos (fault
-                # injection must always be opt-in)
+                # and data sources are explicit choices), chaos (fault
+                # injection is opt-in), and the investigator simulation
+                # (a real deployment has real humans on the console)
                 enabled=bool(
                     block.get(
-                        "enabled", name not in ("producer", "store", "chaos")
+                        "enabled",
+                        name not in ("producer", "store", "chaos",
+                                     "investigator"),
                     )
                 ),
                 options={k: v for k, v in block.items() if k != "enabled"},
@@ -111,6 +117,7 @@ class Platform:
         self.health_server = None
         self.chaos = None
         self.router = None
+        self.investigator = None
         self.recovery = None  # CheckpointCoordinator when crash_recovery on
         self._engine_factory = None
         self._producer_done = threading.Event()
@@ -176,6 +183,13 @@ class Platform:
                 and spec.component("engine").opt("crash_recovery", False)
                 and self.engine is not None and self.router is not None):
             self._up_crash_recovery()
+
+        # 6c. investigator simulation (the demo's Business Central humans,
+        #     reference README.md:547-581) — drains the task queue and
+        #     feeds the user-task model its training labels
+        if (spec.component("investigator").enabled
+                and self.engine is not None):
+            self._up_investigator()
 
         # 7. online retrain (new capability; BASELINE.json configs[4])
         if spec.component("retrain").enabled and self.scorer is not None:
@@ -434,6 +448,24 @@ class Platform:
             reset=router.reset,
         )
 
+    def _up_investigator(self) -> None:
+        from ccfd_tpu.process.investigator import InvestigatorService
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        c = self.spec.component("investigator")
+        svc = InvestigatorService(
+            self.engine, self._registry("investigator"),
+            rate_per_s=float(c.opt("rate_per_s", 50.0)),
+            trust_threshold=float(c.opt("trust_threshold", 0.9)),
+            base_fraud_rate=float(c.opt("base_fraud_rate", 0.05)),
+            seed=int(c.opt("seed", 0)),
+        )
+        self.investigator = svc
+        self.supervisor.add_thread_service(
+            "investigator", svc.run, svc.stop,
+            policy=RestartPolicy.ALWAYS, reset=svc.reset,
+        )
+
     def _up_crash_recovery(self) -> None:
         """Aligned checkpoints + engine-as-supervised-service: an engine
         crash (chaos or real) restores the last cut and re-drives the
@@ -451,6 +483,8 @@ class Platform:
             self.engine = engine
             if self.engine_server is not None:
                 self.engine_server.engine = engine
+            if self.investigator is not None:
+                self.investigator.engine = engine
 
         self.recovery = CheckpointCoordinator(
             self.router, self.broker, self._engine_factory,

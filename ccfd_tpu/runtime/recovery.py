@@ -81,6 +81,12 @@ class CheckpointCoordinator:
         # durable bus (log_dir), that is the complete crash story:
         # engine state from the cut, the gap re-driven from the log
         self.path = path
+        if path:
+            import os
+
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+        self._io_lock = threading.Lock()  # orders cut writes off _lock
         self._last: dict[str, Any] | None = None  # {"snap","offsets","ts"}
         self._lock = threading.Lock()  # serializes checkpoint vs restore
         self._stop = threading.Event()
@@ -121,17 +127,20 @@ class CheckpointCoordinator:
                 self.router.resume()
             cut["snap"] = json.loads(json.dumps(cut["snap"]))
             self._last = cut
-            if self.path:
-                import os
+            self.checkpoints += 1
+        # disk persistence OFF the coordinator lock: a crash restore must
+        # not wait behind a large snapshot's serialize+write. _io_lock
+        # alone orders writers; a slightly stale cut on disk is exactly
+        # as recoverable as a crash a moment earlier.
+        if self.path:
+            import os
 
-                parent = os.path.dirname(os.path.abspath(self.path))
-                os.makedirs(parent, exist_ok=True)
+            with self._io_lock:
                 tmp = f"{self.path}.tmp"
                 with open(tmp, "w") as f:
                     json.dump({"version": 1, **cut}, f)
                 os.replace(tmp, self.path)
-            self.checkpoints += 1
-            return cut
+        return cut
 
     def _router_loop_alive(self) -> bool:
         """Best effort: is some thread inside the router's run loop?  The
@@ -282,11 +291,18 @@ class CheckpointCoordinator:
         try:
             with open(self.path) as f:
                 cut = json.load(f)
-            if cut.get("version") != 1:
-                raise ValueError(f"unknown cut version {cut.get('version')!r}")
+            # valid JSON is not necessarily a valid cut: guard the shape,
+            # not just the parse (null / [] / non-dict snap must all read
+            # as cold starts)
+            if not isinstance(cut, dict) or cut.get("version") != 1:
+                raise ValueError(f"not a v1 cut: {type(cut).__name__}")
             last = {"snap": cut["snap"], "offsets": cut["offsets"],
                     "ts": cut.get("ts", 0.0)}
-        except (OSError, ValueError, KeyError) as e:
+            if not isinstance(last["snap"], dict) or not isinstance(
+                    last["offsets"], dict):
+                raise ValueError("cut fields have wrong shapes")
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as e:
             import logging
 
             logging.getLogger(__name__).warning(

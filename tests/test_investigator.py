@@ -1,0 +1,104 @@
+"""Investigator simulation: the demo's Business Central humans
+(process/investigator.py) — queue drain, pre-fill trust, seeded verdicts,
+rate limit, crash-recovery tolerance, and the closed loop into the
+user-task model's training labels (reference README.md:547-581)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.process.investigator import InvestigatorService
+
+
+CFG = Config(confidence_threshold=1.0, customer_reply_timeout_s=0.05)
+
+
+def _flagged_engine(n: int = 8, registry: Registry | None = None,
+                    task_listener=None):
+    """An engine with ``n`` open investigation tasks (fraud starts whose
+    no-reply timer fired into the investigation path)."""
+    broker = Broker()
+    engine = build_engine(CFG, broker, registry or Registry(),
+                          task_listener=task_listener)
+    for i in range(n):
+        engine.start_process("fraud", {
+            "transaction": {"Amount": 500.0, "id": i}, "proba": 0.99,
+            "customer_id": i,
+        })
+    deadline = time.time() + 10
+    while len(engine.tasks("open")) < n and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(engine.tasks("open")) == n
+    return broker, engine
+
+
+def test_drains_queue_and_counts_outcomes():
+    _, engine = _flagged_engine(8)
+    reg = Registry()
+    svc = InvestigatorService(engine, reg, rate_per_s=0.0,
+                              base_fraud_rate=0.0, seed=1)
+    assert svc.work_once() == 8
+    assert engine.tasks("open") == []
+    done = reg.counter("investigator_tasks_completed_total")
+    assert done.value(labels={"outcome": "approved"}) == 8
+    # every instance reached a terminal state through the approve path
+    assert all(i.status == "completed" for i in engine.instances())
+
+
+def test_trusts_confident_prefill():
+    class T:
+        task_id = 1
+        suggested_outcome = True
+        prediction_confidence = 0.95
+
+    svc = InvestigatorService(engine=None, rate_per_s=0.0,
+                              trust_threshold=0.9, base_fraud_rate=0.0)
+    assert svc.decide(T()) is True          # follows the pre-fill
+    T.prediction_confidence = 0.5
+    assert svc.decide(T()) is False         # independent (fraud_rate=0)
+    # dict-shaped tasks (the REST client surface) work identically
+    assert svc.decide({"task_id": 2, "suggested_outcome": True,
+                       "prediction_confidence": 0.99}) is True
+
+
+def test_seeded_verdicts_are_deterministic():
+    a = InvestigatorService(None, rate_per_s=0.0, base_fraud_rate=0.3, seed=5)
+    b = InvestigatorService(None, rate_per_s=0.0, base_fraud_rate=0.3, seed=5)
+    t = {"task_id": 1, "suggested_outcome": None, "prediction_confidence": 0.0}
+    assert [a.decide(t) for _ in range(50)] == [b.decide(t) for _ in range(50)]
+
+
+def test_rate_limit_bounds_throughput():
+    _, engine = _flagged_engine(10)
+    svc = InvestigatorService(engine, rate_per_s=20.0, base_fraud_rate=0.0)
+    t0 = time.perf_counter()
+    svc.work_once()
+    el = time.perf_counter() - t0
+    assert el >= 10 / 20.0 * 0.8  # ~0.5 s for 10 tasks at 20/s
+
+def test_tolerates_engine_shutdown_mid_pass():
+    _, engine = _flagged_engine(4)
+    svc = InvestigatorService(engine, rate_per_s=0.0, base_fraud_rate=0.0)
+    engine.shutdown()
+    # dead engine: tasks() raises nothing but complete_task refuses —
+    # the pass skips every task rather than crashing the service thread
+    assert svc.work_once() == 0
+
+
+def test_decisions_feed_usertask_model():
+    """The closed loop the reference trains its second Seldon model on:
+    investigator outcomes -> task_listener -> online user-task model."""
+    from ccfd_tpu.process.usertask_model import OnlineUserTaskModel
+
+    model = OnlineUserTaskModel(min_examples=4)
+    _, engine = _flagged_engine(6, task_listener=model.observe)
+    svc = InvestigatorService(engine, rate_per_s=0.0,
+                              base_fraud_rate=0.5, seed=3)
+    assert svc.work_once() == 6
+    assert model._seen >= 6

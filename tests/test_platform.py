@@ -293,3 +293,46 @@ class TestCrashRecovery:
             assert p2.router._c_in.value() >= gap
         finally:
             p2.down()
+
+
+class TestInvestigator:
+    def test_operator_wires_investigator_and_queue_drains(self):
+        """The demo loop closes: flagged transactions become tasks, the
+        investigator component works them, instances reach terminal."""
+        cr = minimal_cr(
+            investigator={"enabled": True, "rate_per_s": 0.0,
+                          "base_fraud_rate": 0.0, "seed": 1},
+            # no customer simulation: every fraud instance must time out
+            # into the investigation queue, not resolve via a reply
+            notify={"enabled": False},
+        )
+        # every record flags as fraud; instant reply-timeout sends each
+        # instance to the investigation queue; confidence threshold is
+        # unreachable so the prediction service NEVER auto-closes (every
+        # task waits for the investigator)
+        cfg = Config(fraud_threshold=0.0, customer_reply_timeout_s=0.05,
+                     confidence_threshold=2.0)
+        from ccfd_tpu.data.ccfd import FEATURE_NAMES
+
+        p = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+        try:
+            assert p.investigator is not None
+            assert "investigator" in p.supervisor.status()
+            rows = [{FEATURE_NAMES[j]: float(j) for j in range(30)}
+                    | {"id": i, "Amount": 500.0} for i in range(12)]
+            p.broker.produce_batch(cfg.kafka_topic, rows)
+            deadline = time.time() + 25
+            while time.time() < deadline:
+                if p.investigator.completed >= 12:
+                    break
+                time.sleep(0.1)
+            assert p.investigator.completed >= 12
+            with p.engine.state_lock:
+                active = p.engine.instances("active")
+            assert active == []
+        finally:
+            p.down()
+
+    def test_investigator_defaults_off(self):
+        spec = PlatformSpec.from_cr({"spec": {}}, cfg=Config())
+        assert not spec.component("investigator").enabled

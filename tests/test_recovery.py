@@ -406,3 +406,17 @@ def test_restore_from_disk_tolerates_missing_and_corrupt(tmp_path):
     coord.path = str(tmp_path / "bad.json")
     assert coord.restore_from_disk() is None  # corrupt: cold start
     assert coord.restores == 0
+
+
+def test_restore_from_disk_tolerates_wrong_shapes(tmp_path):
+    """Valid JSON that is not a valid cut must read as a cold start."""
+    broker, router, coord = _pipeline()
+    for content in ("null", "[]", '"x"', "7",
+                    '{"version": 1}',
+                    '{"version": 1, "snap": [], "offsets": {}}',
+                    '{"version": 2, "snap": {}, "offsets": {}}'):
+        f = tmp_path / "cut.json"
+        f.write_text(content)
+        coord.path = str(f)
+        assert coord.restore_from_disk() is None, content
+    assert coord.restores == 0

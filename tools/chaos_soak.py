@@ -237,39 +237,31 @@ def main() -> int:
     feeder = threading.Thread(target=feed, daemon=True)
     feeder.start()
 
-    # -- investigators: complete open user tasks under load ----------------
+    # -- investigators: the PRODUCT service working the task queue ---------
     # Without them every flagged transaction parks an instance forever and
     # the aligned-checkpoint cost grows without bound — unrealistic (the
     # reference demo has humans working the KIE console queue) and it
-    # turns the soak into a snapshot-size benchmark. The loop exercises
-    # complete_task against whatever engine is CURRENT, riding through
-    # restores (a kill mid-call surfaces as the shut-down engine's
-    # RuntimeError — expected, retried on the replacement).
-    stop_invest = threading.Event()
-    completed_tasks = [0]
+    # turns the soak into a snapshot-size benchmark. The engine reference
+    # follows crash-recovery swaps via the indirection below, and
+    # individual completion failures (task rolled back mid-restore, dead
+    # engine) are the service's normal skip path.
+    from ccfd_tpu.process.investigator import InvestigatorService
 
-    def investigate() -> None:
-        while not stop_invest.is_set():
-            engine_now = router.engine
-            try:
-                open_tasks = engine_now.tasks("open")[:500]
-                if not open_tasks:
-                    time.sleep(0.05)
-                    continue
-                for t in open_tasks:
-                    if stop_invest.is_set():
-                        return
-                    # ground truth: V-feature sum is not recoverable here;
-                    # approve (is_fraud=False) like the demo's common case
-                    engine_now.complete_task(t.task_id, False)
-                    completed_tasks[0] += 1
-            except (RuntimeError, KeyError, ValueError):
-                # engine swapped mid-batch / task restored-completed: the
-                # replacement engine's queue is re-read next iteration
-                time.sleep(0.02)
+    class CurrentEngine:
+        """Resolve the live engine per call (restores swap it)."""
 
-    investigator = threading.Thread(target=investigate, daemon=True)
-    investigator.start()
+        def tasks(self, status="open"):
+            return router.engine.tasks(status)
+
+        def complete_task(self, task_id, outcome):
+            return router.engine.complete_task(task_id, outcome)
+
+    investigator = InvestigatorService(
+        CurrentEngine(), Registry(), rate_per_s=0.0,  # unthrottled: soak
+        trust_threshold=0.9, base_fraud_rate=0.05, seed=7,
+    )
+    invest_thread = threading.Thread(target=investigator.run, daemon=True)
+    invest_thread.start()
 
     # -- bus crash-reopen drill (bounded log, under way) -------------------
     bus_check: dict = {}
@@ -351,8 +343,8 @@ def main() -> int:
             wedge_info["device_path_recovered"] = not scorer._wedge.wedged
 
     stop_feed.set()
-    stop_invest.set()
-    investigator.join(timeout=10)
+    investigator.stop()
+    invest_thread.join(timeout=10)
     monkey.stop()
     coord.stop()
     elapsed = time.time() - t0
@@ -410,7 +402,7 @@ def main() -> int:
         "bus_reopen_check": bus_check,
         "dispatch_timeouts": scorer.dispatch_timeouts,
         "host_fallback_scores": scorer.host_fallback_scores,
-        "tasks_completed_by_investigators": completed_tasks[0],
+        "tasks_completed_by_investigators": investigator.completed,
         "accounting": {
             "starts": acct["starts"],
             "completes": acct["completes"],
