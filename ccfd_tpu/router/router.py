@@ -189,6 +189,15 @@ class Router:
         self.cfg = cfg
         self.broker = broker
         self.score = score_fn
+        # history-aware scorers (serving/history.py SeqScorer) score each
+        # transaction against the customer's history: they expose
+        # score_with_ids(txs, x) and the router feeds them the decoded
+        # records alongside the feature matrix; plain scorers get (x,)
+        score_with_ids = getattr(score_fn, "score_with_ids", None)
+        if callable(score_with_ids):
+            self._score2 = lambda x, txs: np.asarray(score_with_ids(txs, x))
+        else:
+            self._score2 = lambda x, txs: np.asarray(self.score(x))
         self.engine = engine
         self.registry = registry or Registry()
         self.max_batch = max_batch
@@ -348,7 +357,7 @@ class Router:
             return 0
         x, txs, ts = self._decode_batch(records)
         t0 = time.perf_counter()
-        proba = np.asarray(self.score(x))
+        proba = self._score2(x, txs)
         self._h_score_s.observe(time.perf_counter() - t0)
         return self._route(x, txs, proba, ts)
 
@@ -497,11 +506,11 @@ class Router:
         """
         from concurrent.futures import ThreadPoolExecutor
 
-        def timed_score(x: np.ndarray) -> np.ndarray:
+        def timed_score(x: np.ndarray, txs: list) -> np.ndarray:
             # time INSIDE the worker so the histogram records the scorer
             # round trip, not dispatch + however long the loop polled
             t0 = time.perf_counter()
-            proba = np.asarray(self.score(x))
+            proba = self._score2(x, txs)
             self._h_score_s.observe(time.perf_counter() - t0)
             return proba
 
@@ -540,7 +549,7 @@ class Router:
                 fut = None
                 if records:
                     x, txs, ts = self._decode_batch(records)
-                    fut = ex.submit(timed_score, x)
+                    fut = ex.submit(timed_score, x, txs)
                 if pending is not None:
                     finish(pending)
                 pending = (fut, x, txs, ts) if fut is not None else None

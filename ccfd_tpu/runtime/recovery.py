@@ -87,6 +87,12 @@ class CheckpointCoordinator:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
         self._io_lock = threading.Lock()  # orders cut writes off _lock
+        # additional PIPELINE STATE that must ride the cut: anything a
+        # rewound record replay would otherwise double-apply — e.g. the
+        # seq scorer's per-customer histories (serving/history.py).
+        # name -> (snapshot_fn() -> JSONable, restore_fn(snap) -> None)
+        self._extra_state: dict[str, tuple[Callable[[], Any],
+                                           Callable[[Any], None]]] = {}
         self._last: dict[str, Any] | None = None  # {"snap","offsets","ts"}
         self._lock = threading.Lock()  # serializes checkpoint vs restore
         self._stop = threading.Event()
@@ -94,6 +100,14 @@ class CheckpointCoordinator:
         self.checkpoints = 0
         self.restores = 0
         self.skipped = 0
+
+    def register_state(self, name: str, snapshot_fn: Callable[[], Any],
+                       restore_fn: Callable[[Any], None]) -> None:
+        """Attach extra pipeline state to every cut. ``snapshot_fn`` runs
+        under the barrier (keep it copy-only); ``restore_fn`` runs during
+        restore after the engine swap. State registered after checkpoints
+        were already taken simply starts riding the NEXT cut."""
+        self._extra_state[name] = (snapshot_fn, restore_fn)
         self.unacked_restores = 0  # barrier timeout (e.g. wedged scorer):
         # restore proceeded anyway — safe, because the shut-down engine
         # refuses the late in-flight batch (Engine._check_alive)
@@ -120,6 +134,10 @@ class CheckpointCoordinator:
                     "offsets": {
                         f"{g}\x00{t}": self.broker.committed_offsets(g, t)
                         for g, t in self._cut_groups
+                    },
+                    "extra": {
+                        name: snap_fn()
+                        for name, (snap_fn, _) in self._extra_state.items()
                     },
                     "ts": time.time(),
                 }
@@ -257,6 +275,27 @@ class CheckpointCoordinator:
                 self.router.swap_engine(engine)
                 if self.on_swap is not None:
                     self.on_swap(engine)
+                # extra pipeline state resets to the cut too — replayed
+                # records then re-apply onto exactly the state they
+                # already applied to once (e.g. per-customer histories;
+                # without this, replay double-appends). Absent entries
+                # (state registered after the cut, or genesis) reset via
+                # restore_fn(None) semantics only when recorded.
+                extra = (self._last.get("extra", {})
+                         if self._last is not None else {})
+                for name, (_, restore_fn) in self._extra_state.items():
+                    try:
+                        # None = reset-to-empty (genesis restore, or state
+                        # registered after the recorded cut): replay from
+                        # the rewound offsets rebuilds it from scratch
+                        restore_fn(extra.get(name))
+                    except Exception:  # noqa: BLE001 - a state module's
+                        # failure must not abort the engine restore
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "extra state %r restore failed", name
+                        )
                 if boot or acked or not self._router_loop_alive():
                     # real Kafka refuses offset resets for a group with
                     # live members: the parked loop's consumers still

@@ -1,0 +1,281 @@
+"""Per-customer transaction history for the sequence scorer.
+
+The seq model (models/seq.py) scores the NEWEST transaction given the
+customer's recent history (B, L, F). Single-row REST scoring is stateless
+by design (the Seldon contract); history lives where the stream lives —
+in the routing tier, which already sees every transaction in arrival
+order. This module is that state:
+
+- ``HistoryStore`` — fixed-depth ring buffer per customer, bounded total
+  customers (LRU eviction at the cap), thread-safe. Mutation is
+  two-phase: ``prepare()`` stages copies, ``commit()`` publishes them —
+  a failed scorer dispatch must not leave transactions in history that
+  were never routed. The store is CHECKPOINTABLE (snapshot/restore), and
+  the recovery coordinator treats it as pipeline state: after a crash
+  rewind, replayed records re-build exactly the histories the cut had —
+  without this, at-least-once redelivery would append every replayed
+  transaction a second time and silently corrupt every active
+  customer's context.
+- ``SeqScorer`` — the router-facing scorer: takes this poll's rows + ids,
+  assembles the (bucket, L, F) batch (cold customers zero-pad on the
+  LEFT so the newest transaction is always the last token — the readout
+  position), and runs one jitted dispatch per micro-batch over bucketed
+  batch sizes, the same static-shape discipline as the row scorer
+  (serving/scorer.py; the bucketing is intentionally the same shape —
+  single-device serving here, so the row scorer's data-parallel bucket
+  rounding does not apply).
+
+TPU-first notes: histories assemble host-side into one contiguous array
+per micro-batch (one transfer, one dispatch — never per-customer gathers
+on device); L is static so XLA sees a fixed (bucket, L, F) shape; the
+model runs bf16 with f32 accumulation.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+from ccfd_tpu.data.ccfd import NUM_FEATURES
+
+
+class HistoryStore:
+    """Fixed-depth per-customer ring buffers with bounded total keys.
+
+    Memory bound: ``max_customers * length * num_features * 4`` bytes —
+    the default (20k x 64 x 30 x f32) admits ~150 MB resident on the
+    serving host; size the cap to the deployment's live-customer working
+    set, not its total cardinality (LRU keeps the hot set)."""
+
+    def __init__(self, length: int = 64, num_features: int = NUM_FEATURES,
+                 max_customers: int = 20_000):
+        if length < 1:
+            raise ValueError("history length must be >= 1")
+        self.length = int(length)
+        self.num_features = int(num_features)
+        self.max_customers = int(max_customers)
+        self._lock = threading.Lock()
+        # id -> (buffer (L, F) f32, filled count); OrderedDict as LRU:
+        # move_to_end on touch, evict the coldest when over cap
+        self._h: OrderedDict[Any, tuple[np.ndarray, int]] = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._h)
+
+    def prepare(
+        self, ids: list, rows: np.ndarray
+    ) -> tuple[np.ndarray, dict]:
+        """Stage this chunk: return the (B, L, F) batch of post-append
+        histories (newest last) plus the staged buffers, WITHOUT mutating
+        the store. ``commit()`` publishes the staged state only after the
+        scorer dispatch succeeded — a dropped batch (transient scorer
+        failure) must leave histories exactly matching the routed stream.
+
+        A customer appearing twice in one chunk sees its earlier
+        same-chunk rows in the later assembly (arrival order, via the
+        staged copy). ``None`` ids are anonymous: scored against an empty
+        history and NEVER stored — a bounded store must not spend its cap
+        (and evict real customers) on keys no future record can match."""
+        rows = np.ascontiguousarray(rows, np.float32)
+        n = len(rows)
+        out = np.zeros((n, self.length, self.num_features), np.float32)
+        staged: dict[Any, tuple[np.ndarray, int]] = {}
+        with self._lock:
+            for i in range(n):
+                key = ids[i]
+                if key is None:
+                    # anonymous: cold context + this row as the readout
+                    out[i, -1] = rows[i]
+                    continue
+                ent = staged.get(key)
+                if ent is None:
+                    ent = self._h.get(key)
+                    if ent is None:
+                        buf = np.zeros((self.length, self.num_features),
+                                       np.float32)
+                        filled = 0
+                    else:  # copy-on-write: the live buffer stays untouched
+                        buf, filled = ent
+                        buf = buf.copy()
+                else:
+                    buf, filled = ent
+                # shift-left ring: newest transaction is always row L-1
+                # (the seq model's readout token); cold-start zeros stay
+                # on the left until the buffer fills
+                buf[:-1] = buf[1:]
+                buf[-1] = rows[i]
+                filled = min(filled + 1, self.length)
+                staged[key] = (buf, filled)
+                out[i] = buf
+        return out, staged
+
+    def commit(self, staged: dict) -> None:
+        """Publish a prepared chunk (call only after a successful
+        dispatch). Evicts the coldest keys past the cap."""
+        if not staged:
+            return
+        with self._lock:
+            for key, ent in staged.items():
+                if key in self._h:
+                    self._h.move_to_end(key)
+                self._h[key] = ent
+            while len(self._h) > self.max_customers:
+                self._h.popitem(last=False)
+
+    # -- checkpoint surface (pipeline state, like the engine) --------------
+    def snapshot(self) -> dict:
+        """JSON-able state for the recovery coordinator's cut. Keys must
+        be JSON-able (customer ids are); buffers serialize as nested
+        lists — at the default sizes this is bounded by max_customers."""
+        with self._lock:
+            return {
+                "version": 1,
+                "length": self.length,
+                "num_features": self.num_features,
+                "customers": [
+                    [key, buf.tolist(), filled]
+                    for key, (buf, filled) in self._h.items()
+                ],
+            }
+
+    def restore(self, snap: dict | None) -> None:
+        """Replace the store's content with a snapshot's (crash recovery:
+        the rewound bus re-drives post-cut records, re-building exactly
+        the histories the cut had). ``None`` resets to empty (genesis
+        restore — replay from offset 0 rebuilds everything)."""
+        if snap is None:
+            with self._lock:
+                self._h.clear()
+            return
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown history snapshot {snap.get('version')!r}")
+        if (int(snap["length"]) != self.length
+                or int(snap["num_features"]) != self.num_features):
+            raise ValueError("history snapshot shape mismatch")
+        with self._lock:
+            self._h.clear()
+            for key, buf, filled in snap["customers"]:
+                self._h[key] = (
+                    np.asarray(buf, np.float32).reshape(
+                        self.length, self.num_features
+                    ),
+                    int(filled),
+                )
+
+    def snapshot_counts(self) -> dict:
+        with self._lock:
+            return {"customers": len(self._h), "length": self.length}
+
+
+class SeqScorer:
+    """History-aware scorer with the row scorer's serving discipline:
+    bucketed static shapes, one jit dispatch per micro-batch."""
+
+    def __init__(
+        self,
+        params: Any,
+        length: int = 64,
+        batch_sizes: tuple = (16, 128, 1024, 4096),
+        compute_dtype: str = "bfloat16",
+        max_customers: int = 20_000,
+        registry: Any = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ccfd_tpu.models import seq as seq_mod
+
+        self.params = params
+        self.store = HistoryStore(length=length, max_customers=max_customers)
+        self.batch_sizes = tuple(sorted(batch_sizes))
+        dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+
+        @jax.jit
+        def _apply(p, xs):
+            return seq_mod.apply(p, xs, dtype)
+
+        self._apply = _apply
+        self._jax = jax
+        self._params_lock = threading.Lock()
+        self._g_customers = None
+        if registry is not None:
+            self._g_customers = registry.gauge(
+                "seq_history_customers", "customers with live history"
+            )
+
+    def swap_params(self, params: Any) -> None:
+        """Hot-swap model weights (the online-retrain surface the row
+        scorer exposes; same treedef ⇒ the jit cache is reused)."""
+        with self._params_lock:
+            self.params = params
+
+    def warmup(self) -> None:
+        for b in self.batch_sizes:
+            xs = np.zeros((b, self.store.length, self.store.num_features),
+                          np.float32)
+            self._jax.block_until_ready(self._apply(self.params, xs))
+
+    def _bucket(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return self.batch_sizes[-1]
+
+    def score(self, x: np.ndarray, ids: list | None = None) -> np.ndarray:
+        """Router-compatible scorer: (B, F) rows -> (B,) probabilities,
+        each conditioned on that customer's history. Rows with no id
+        (``ids`` absent or None entries) score against an empty history
+        and are not tracked."""
+        n = len(x)
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        if ids is None:
+            ids = [None] * n
+        out = np.empty((n,), np.float32)
+        start = 0
+        largest = self.batch_sizes[-1]
+        while start < n:
+            stop = min(start + largest, n)
+            hist, staged = self.store.prepare(ids[start:stop], x[start:stop])
+            m = stop - start
+            bucket = self._bucket(m)
+            if m < bucket:
+                hist = np.concatenate(
+                    [hist, np.zeros((bucket - m, *hist.shape[1:]),
+                                    np.float32)]
+                )
+            with self._params_lock:
+                params = self.params
+            # dispatch BEFORE committing the staged histories: a failed
+            # dispatch drops the batch (router counts it) and the store
+            # still matches the routed stream exactly
+            proba = np.asarray(self._apply(params, hist))
+            self.store.commit(staged)
+            out[start:stop] = proba[:m]
+            start = stop
+        if self._g_customers is not None:
+            self._g_customers.set(float(len(self.store)))
+        return out
+
+    # Router contract: passing the SeqScorer OBJECT as the router's
+    # score_fn makes it callable for the plain (x,) path, and the router
+    # detects score_with_ids and feeds decoded records alongside x
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        return self.score(x)
+
+    def score_with_ids(self, txs: list, x: np.ndarray) -> np.ndarray:
+        """Batch entry for the router: ids come from each record's
+        ``customer_id``/``id`` field; records with neither are anonymous
+        (scored cold, not tracked)."""
+        ids: list = []
+        for t in txs:
+            key = None
+            if isinstance(t, dict):
+                key = t.get("customer_id")
+                if key is None:
+                    key = t.get("id")
+            ids.append(key)
+        return self.score(x, ids)

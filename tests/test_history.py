@@ -1,0 +1,195 @@
+"""History-aware streaming scoring: HistoryStore semantics and the seq
+scorer through the real router loop (serving/history.py).
+
+The seq model family (models/seq.py) is the long-context member of the
+zoo; this is the PRODUCT path that serves it: per-customer ring-buffer
+histories live in the routing tier (where the stream is), assembled into
+static (bucket, L, F) batches for one jit dispatch per poll."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.models import seq as seq_mod
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.router import Router
+from ccfd_tpu.serving.history import HistoryStore, SeqScorer
+
+
+def test_ring_buffer_newest_last_and_cold_padding():
+    st = HistoryStore(length=4, num_features=3)
+    rows = np.arange(9, dtype=np.float32).reshape(3, 3)
+    out, staged = st.prepare(["a", "a", "a"], rows)
+    st.commit(staged)
+    # after the 3rd append: zeros pad on the LEFT, newest is row L-1
+    assert np.all(out[2, 0] == 0.0)
+    assert np.allclose(out[2, 1], rows[0])
+    assert np.allclose(out[2, 2], rows[1])
+    assert np.allclose(out[2, 3], rows[2])
+    # same-batch earlier rows are visible to later rows (arrival order)
+    assert np.allclose(out[1, 3], rows[1]) and np.allclose(out[1, 2], rows[0])
+
+
+def test_ring_buffer_wraps_and_keeps_depth():
+    st = HistoryStore(length=3, num_features=2)
+    rows = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out, staged = st.prepare(["c"] * 6, rows)
+    st.commit(staged)
+    assert np.allclose(out[-1], rows[3:6])  # only the newest 3 remain
+
+
+def test_customers_are_isolated_and_capped():
+    st = HistoryStore(length=2, num_features=1, max_customers=3)
+    st.commit(st.prepare(list("abcd"), np.ones((4, 1), np.float32))[1])
+    assert len(st) == 3  # coldest ("a") evicted at the cap
+    out, staged = st.prepare(["b"], np.full((1, 1), 5.0, np.float32))
+    st.commit(staged)
+    assert out[0, 0, 0] == 1.0 and out[0, 1, 0] == 5.0  # b kept its history
+
+
+def test_seq_scorer_history_changes_the_score():
+    """The same transaction must score differently for a customer with
+    history than for a cold one — the model actually reads the context."""
+    params = seq_mod.init(jax.random.PRNGKey(0))
+    s = SeqScorer(params, length=8, batch_sizes=(4,),
+                  compute_dtype="float32")
+    rng = np.random.default_rng(0)
+    row = rng.normal(size=(1, 30)).astype(np.float32)
+    history_rows = rng.normal(size=(6, 30)).astype(np.float32) * 3.0
+    cold = s.score(row, ids=["fresh"])
+    s.score(history_rows, ids=["warm"] * 6)
+    warm = s.score(row, ids=["warm"])
+    assert cold.shape == warm.shape == (1,)
+    assert 0.0 <= cold[0] <= 1.0 and 0.0 <= warm[0] <= 1.0
+    assert abs(float(cold[0]) - float(warm[0])) > 1e-6
+
+
+def test_seq_scorer_bucket_padding_matches_unpadded():
+    params = seq_mod.init(jax.random.PRNGKey(1))
+    s = SeqScorer(params, length=4, batch_sizes=(8,),
+                  compute_dtype="float32")
+    x = np.random.default_rng(1).normal(size=(3, 30)).astype(np.float32)
+    got = s.score(x, ids=["p", "q", "r"])
+    s2 = SeqScorer(params, length=4, batch_sizes=(4,),
+                   compute_dtype="float32")
+    want = s2.score(x, ids=["p", "q", "r"])
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_router_serves_the_seq_scorer_end_to_end():
+    """CCFD's streaming tier with a history-aware model: records flow
+    bus -> router -> SeqScorer (per-customer context) -> engine."""
+    cfg = Config(fraud_threshold=0.99)
+    broker = Broker()
+    engine = build_engine(cfg, broker, Registry())
+    params = seq_mod.init(jax.random.PRNGKey(2))
+    scorer = SeqScorer(params, length=8, batch_sizes=(16, 128),
+                       compute_dtype="float32", registry=Registry())
+    router = Router(cfg, broker, scorer, engine, Registry())
+    rows = [
+        {FEATURE_NAMES[j]: float(j % 5) for j in range(30)}
+        | {"id": i % 4, "customer_id": i % 4}
+        for i in range(32)
+    ]
+    broker.produce_batch(cfg.kafka_topic, rows)
+    routed = router.step()
+    assert routed == 32
+    # 4 customers, 8 transactions each: histories accumulated
+    assert len(scorer.store) == 4
+    counts = scorer.store.snapshot_counts()
+    assert counts["customers"] == 4 and counts["length"] == 8
+
+
+def test_prepare_without_commit_leaves_store_untouched():
+    """A failed dispatch drops the batch; the store must keep matching
+    the routed stream exactly."""
+    st = HistoryStore(length=3, num_features=2)
+    st.commit(st.prepare(["k"], np.ones((1, 2), np.float32))[1])
+    before = st.snapshot()
+    st.prepare(["k", "k"], np.full((2, 2), 9.0, np.float32))  # no commit
+    assert st.snapshot() == before
+
+
+def test_anonymous_rows_score_cold_and_are_not_stored():
+    st = HistoryStore(length=3, num_features=2, max_customers=2)
+    out, staged = st.prepare([None, None, "real"],
+                             np.ones((3, 2), np.float32))
+    st.commit(staged)
+    assert len(st) == 1  # only "real" tracked — no cap pollution
+    assert np.all(out[0, :2] == 0.0) and np.all(out[0, 2] == 1.0)
+
+
+def test_snapshot_restore_round_trip_and_reset():
+    st = HistoryStore(length=2, num_features=2)
+    st.commit(st.prepare(["a", "b"], np.ones((2, 2), np.float32))[1])
+    snap = st.snapshot()
+    st.commit(st.prepare(["c"], np.ones((1, 2), np.float32))[1])
+    st.restore(snap)
+    assert len(st) == 2
+    st.restore(None)  # genesis reset
+    assert len(st) == 0
+
+
+def test_history_rides_the_recovery_cut():
+    """The corruption this exists to prevent: after a crash restore, the
+    rewound bus REPLAYS records — without resetting histories to the
+    cut, every replayed transaction would append a second time."""
+    from ccfd_tpu.runtime.recovery import CheckpointCoordinator
+
+    cfg = Config(fraud_threshold=0.99)
+    broker = Broker()
+    reg = Registry()
+    factory = lambda: build_engine(cfg, broker, reg)  # noqa: E731
+    params = seq_mod.init(jax.random.PRNGKey(3))
+    scorer = SeqScorer(params, length=8, batch_sizes=(16,),
+                       compute_dtype="float32")
+    router = Router(cfg, broker, scorer, factory(), Registry())
+    coord = CheckpointCoordinator(router, broker, factory, interval_s=999.0)
+    coord.register_state("history", scorer.store.snapshot,
+                         scorer.store.restore)
+    t = router.start(poll_timeout_s=0.01)
+    try:
+        def feed(lo, hi):
+            # keyed by customer: per-key ordering is the bus's (and
+            # Kafka's) contract, and history order depends on it
+            broker.produce_batch(
+                cfg.kafka_topic,
+                [{FEATURE_NAMES[j]: float(i) for j in range(30)}
+                 | {"id": "cust", "customer_id": "cust"}
+                 for i in range(lo, hi)],
+                keys=["cust"] * (hi - lo),
+            )
+
+        feed(0, 4)
+        deadline = time.time() + 10
+        while router._c_in.value() < 4 and time.time() < deadline:
+            time.sleep(0.02)
+        assert coord.checkpoint() is not None
+        hist_at_cut = scorer.store.snapshot()
+        feed(4, 7)  # post-cut appends (doomed epoch)
+        deadline = time.time() + 10
+        while router._c_in.value() < 7 and time.time() < deadline:
+            time.sleep(0.02)
+        coord.restore(reason="test")
+        deadline = time.time() + 10
+        while router._c_in.value() < 10 and time.time() < deadline:
+            time.sleep(0.02)  # 3 replayed
+        router.pause(5.0)
+        final = scorer.store.snapshot()
+        # exactly ONE copy of each replayed row: depth == 7 appends total
+        (key, buf, filled), = final["customers"]
+        assert key == "cust" and filled == 7
+        # newest-last ordering preserved: last row is transaction 6
+        assert buf[-1][0] == 6.0 and buf[-2][0] == 5.0
+        assert hist_at_cut["customers"][0][2] == 4
+    finally:
+        router.resume()
+        router.stop()
+        t.join(timeout=5)
