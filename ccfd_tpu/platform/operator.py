@@ -191,9 +191,20 @@ class Platform:
                 and self.engine is not None):
             self._up_investigator()
 
-        # 7. online retrain (new capability; BASELINE.json configs[4])
+        # 7. online retrain (new capability; BASELINE.json configs[4]) —
+        #    the trainer's step is the MLP's; a history-aware seq scorer
+        #    cannot consume it (and a hot-swap would publish MLP params
+        #    into the seq jit), so retrain is skipped for model=seq
         if spec.component("retrain").enabled and self.scorer is not None:
-            self._up_retrain()
+            from ccfd_tpu.serving.history import SeqScorer
+
+            if isinstance(self.scorer, SeqScorer):
+                logging.getLogger(__name__).warning(
+                    "retrain enabled but scorer model is 'seq': online "
+                    "retrain targets the MLP family; skipping retrain"
+                )
+            else:
+                self._up_retrain()
 
         # 7b. analytics / drift monitor (notebooks+spark analog,
         #     reference frauddetection_cr.yaml:7-53)
@@ -297,6 +308,31 @@ class Platform:
 
         c = self.spec.component("scorer")
         cfg = self.cfg
+        if c.opt("model", cfg.model_name) == "seq":
+            # history-aware long-context family (serving/history.py):
+            # streamed through the router (history lives where the stream
+            # is); the stateless REST front stays row-based by design
+            import jax
+
+            from ccfd_tpu.data.ccfd import synthetic_dataset
+            from ccfd_tpu.models import seq as seq_mod
+            from ccfd_tpu.serving.history import SeqScorer
+
+            sparams = seq_mod.init(jax.random.PRNGKey(0))
+            ds = synthetic_dataset(n=4096, fraud_rate=0.01, seed=0)
+            sparams = seq_mod.set_normalizer(
+                sparams, ds.X.mean(0), ds.X.std(0)
+            )
+            self.scorer = SeqScorer(
+                sparams,
+                length=int(c.opt("history_length", 64)),
+                batch_sizes=cfg.batch_sizes,
+                compute_dtype=c.opt("dtype", cfg.compute_dtype),
+                max_customers=int(c.opt("max_customers", 20_000)),
+                registry=self._registry("seldon"),
+            )
+            self.scorer.warmup()
+            return
         params = None
         if c.opt("train_steps", 0):
             from ccfd_tpu.data.ccfd import load_dataset
@@ -421,7 +457,12 @@ class Platform:
         from ccfd_tpu.runtime.supervisor import RestartPolicy
 
         if self.scorer is not None:
-            score_fn = self.scorer.score
+            from ccfd_tpu.serving.history import SeqScorer
+
+            # a history-aware scorer goes in as the OBJECT so the router
+            # detects score_with_ids and feeds it the decoded records
+            score_fn = (self.scorer if isinstance(self.scorer, SeqScorer)
+                        else self.scorer.score)
         else:  # remote scorer over the Seldon REST contract
             from ccfd_tpu.serving.client import SeldonClient
 
@@ -492,6 +533,16 @@ class Platform:
             on_swap=on_swap,
             path=c.opt("checkpoint_file", "") or None,
         )
+        from ccfd_tpu.serving.history import SeqScorer
+
+        if isinstance(self.scorer, SeqScorer):
+            # per-customer histories are pipeline state: they must reset
+            # to the cut before a rewind replays records, or replay
+            # double-appends every transaction (serving/history.py)
+            self.recovery.register_state(
+                "history", self.scorer.store.snapshot,
+                self.scorer.store.restore,
+            )
         # full-process crash recovery: the services haven't started yet,
         # so a persisted cut restores cleanly here — engine state from
         # the cut, the gap re-driven from the (durable) bus after start.

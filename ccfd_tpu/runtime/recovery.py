@@ -42,6 +42,14 @@ from typing import Any, Callable
 from ccfd_tpu.process.engine import Engine
 
 
+def _np_jsonable(obj: Any) -> Any:
+    """json.dumps default for cut contents: numpy arrays/scalars (extra-
+    state snapshots return them raw to keep the barrier short)."""
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(f"not JSON-serializable: {type(obj).__name__}")
+
+
 class CheckpointCoordinator:
     """Aligned checkpoints + crash restore for one router/engine pair.
 
@@ -100,17 +108,20 @@ class CheckpointCoordinator:
         self.checkpoints = 0
         self.restores = 0
         self.skipped = 0
+        self.unacked_restores = 0  # barrier timeout (e.g. wedged scorer):
+        # restore proceeded anyway — safe, because the shut-down engine
+        # refuses the late in-flight batch (Engine._check_alive) and
+        # generation-guarded state (HistoryStore) drops late commits
 
     def register_state(self, name: str, snapshot_fn: Callable[[], Any],
                        restore_fn: Callable[[Any], None]) -> None:
         """Attach extra pipeline state to every cut. ``snapshot_fn`` runs
-        under the barrier (keep it copy-only); ``restore_fn`` runs during
-        restore after the engine swap. State registered after checkpoints
-        were already taken simply starts riding the NEXT cut."""
+        under the barrier — keep it COPY-ONLY (return numpy arrays as-is;
+        the coordinator converts to JSON outside the barrier); a
+        ``restore_fn`` runs during restore after the engine swap, with
+        ``None`` meaning reset-to-empty. State registered after
+        checkpoints were already taken starts riding the NEXT cut."""
         self._extra_state[name] = (snapshot_fn, restore_fn)
-        self.unacked_restores = 0  # barrier timeout (e.g. wedged scorer):
-        # restore proceeded anyway — safe, because the shut-down engine
-        # refuses the late in-flight batch (Engine._check_alive)
 
     # -- checkpoint --------------------------------------------------------
     def checkpoint(self) -> dict[str, Any] | None:
@@ -143,7 +154,10 @@ class CheckpointCoordinator:
                 }
             finally:
                 self.router.resume()
-            cut["snap"] = json.loads(json.dumps(cut["snap"]))
+            # whole-cut JSON normalization OUTSIDE the barrier (snapshot
+            # fns return raw numpy for speed under the pause; the
+            # conversion cost lands here, where the pipeline is flowing)
+            cut = json.loads(json.dumps(cut, default=_np_jsonable))
             self._last = cut
             self.checkpoints += 1
         # disk persistence OFF the coordinator lock: a crash restore must

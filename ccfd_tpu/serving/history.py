@@ -60,30 +60,39 @@ class HistoryStore:
         # id -> (buffer (L, F) f32, filled count); OrderedDict as LRU:
         # move_to_end on touch, evict the coldest when over cap
         self._h: OrderedDict[Any, tuple[np.ndarray, int]] = OrderedDict()
+        # epoch generation: restore() bumps it and commit() drops staged
+        # chunks from an older generation — a scorer dispatch that was in
+        # flight across a crash restore (the unacked-barrier path) must
+        # not land its doomed-epoch rows on the restored state (the
+        # engine's equivalent guard is Engine._check_alive)
+        self._gen = 0
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._h)
 
     def prepare(
-        self, ids: list, rows: np.ndarray
-    ) -> tuple[np.ndarray, dict]:
+        self, ids: list, rows: np.ndarray, overlay: dict | None = None
+    ) -> tuple[np.ndarray, tuple[int, dict]]:
         """Stage this chunk: return the (B, L, F) batch of post-append
-        histories (newest last) plus the staged buffers, WITHOUT mutating
-        the store. ``commit()`` publishes the staged state only after the
-        scorer dispatch succeeded — a dropped batch (transient scorer
-        failure) must leave histories exactly matching the routed stream.
+        histories (newest last) plus a staged token, WITHOUT mutating the
+        store. ``commit()`` publishes staged state only after the scorer
+        dispatch succeeded — a dropped batch (transient scorer failure)
+        must leave histories exactly matching the routed stream.
 
         A customer appearing twice in one chunk sees its earlier
-        same-chunk rows in the later assembly (arrival order, via the
-        staged copy). ``None`` ids are anonymous: scored against an empty
-        history and NEVER stored — a bounded store must not spend its cap
-        (and evict real customers) on keys no future record can match."""
+        same-chunk rows in the later assembly; ``overlay`` extends that
+        visibility across the chunks of ONE router batch (the caller
+        accumulates staged dicts and commits once). ``None`` ids are
+        anonymous: scored against an empty history and NEVER stored — a
+        bounded store must not spend its cap (and evict real customers)
+        on keys no future record can match."""
         rows = np.ascontiguousarray(rows, np.float32)
         n = len(rows)
         out = np.zeros((n, self.length, self.num_features), np.float32)
         staged: dict[Any, tuple[np.ndarray, int]] = {}
         with self._lock:
+            gen = self._gen
             for i in range(n):
                 key = ids[i]
                 if key is None:
@@ -91,6 +100,10 @@ class HistoryStore:
                     out[i, -1] = rows[i]
                     continue
                 ent = staged.get(key)
+                if ent is None and overlay is not None:
+                    ent = overlay.get(key)
+                    if ent is not None:  # earlier chunk's staged copy
+                        ent = (ent[0].copy(), ent[1])
                 if ent is None:
                     ent = self._h.get(key)
                     if ent is None:
@@ -110,33 +123,42 @@ class HistoryStore:
                 filled = min(filled + 1, self.length)
                 staged[key] = (buf, filled)
                 out[i] = buf
-        return out, staged
+        return out, (gen, staged)
 
-    def commit(self, staged: dict) -> None:
+    def commit(self, token: tuple[int, dict]) -> bool:
         """Publish a prepared chunk (call only after a successful
-        dispatch). Evicts the coldest keys past the cap."""
+        dispatch). Evicts the coldest keys past the cap. Returns False —
+        and changes nothing — when the store was restored since the
+        prepare (stale generation: the rewound bus will re-drive those
+        records onto the restored state)."""
+        gen, staged = token
         if not staged:
-            return
+            return True
         with self._lock:
+            if gen != self._gen:
+                return False
             for key, ent in staged.items():
                 if key in self._h:
                     self._h.move_to_end(key)
                 self._h[key] = ent
             while len(self._h) > self.max_customers:
                 self._h.popitem(last=False)
+        return True
 
     # -- checkpoint surface (pipeline state, like the engine) --------------
     def snapshot(self) -> dict:
-        """JSON-able state for the recovery coordinator's cut. Keys must
-        be JSON-able (customer ids are); buffers serialize as nested
-        lists — at the default sizes this is bounded by max_customers."""
+        """Copy-only state for the recovery coordinator's cut: runs under
+        the checkpoint barrier, so buffers are returned as numpy COPIES
+        (fast memcpy) — the coordinator JSON-normalizes outside the
+        barrier (recovery.py _np_jsonable); ``restore`` accepts either
+        form."""
         with self._lock:
             return {
                 "version": 1,
                 "length": self.length,
                 "num_features": self.num_features,
                 "customers": [
-                    [key, buf.tolist(), filled]
+                    [key, buf.copy(), filled]
                     for key, (buf, filled) in self._h.items()
                 ],
             }
@@ -149,6 +171,7 @@ class HistoryStore:
         if snap is None:
             with self._lock:
                 self._h.clear()
+                self._gen += 1
             return
         if snap.get("version") != 1:
             raise ValueError(f"unknown history snapshot {snap.get('version')!r}")
@@ -157,6 +180,7 @@ class HistoryStore:
             raise ValueError("history snapshot shape mismatch")
         with self._lock:
             self._h.clear()
+            self._gen += 1  # in-flight prepares become stale commits
             for key, buf, filled in snap["customers"]:
                 self._h[key] = (
                     np.asarray(buf, np.float32).reshape(
@@ -237,9 +261,19 @@ class SeqScorer:
         out = np.empty((n,), np.float32)
         start = 0
         largest = self.batch_sizes[-1]
+        # ONE commit for the whole router batch, after EVERY chunk's
+        # dispatch succeeded: a mid-batch failure drops the batch at the
+        # router, and a half-committed history would diverge from the
+        # routed stream. The overlay keeps same-customer visibility
+        # across chunks; the generation token makes a commit that raced
+        # a crash restore a no-op (the rewind re-drives those records).
+        merged: dict = {}
+        gen = None
         while start < n:
             stop = min(start + largest, n)
-            hist, staged = self.store.prepare(ids[start:stop], x[start:stop])
+            hist, (gen, staged) = self.store.prepare(
+                ids[start:stop], x[start:stop], overlay=merged
+            )
             m = stop - start
             bucket = self._bucket(m)
             if m < bucket:
@@ -249,13 +283,12 @@ class SeqScorer:
                 )
             with self._params_lock:
                 params = self.params
-            # dispatch BEFORE committing the staged histories: a failed
-            # dispatch drops the batch (router counts it) and the store
-            # still matches the routed stream exactly
             proba = np.asarray(self._apply(params, hist))
-            self.store.commit(staged)
+            merged.update(staged)
             out[start:stop] = proba[:m]
             start = stop
+        if gen is not None:
+            self.store.commit((gen, merged))
         if self._g_customers is not None:
             self._g_customers.set(float(len(self.store)))
         return out

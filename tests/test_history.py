@@ -114,7 +114,9 @@ def test_prepare_without_commit_leaves_store_untouched():
     st.commit(st.prepare(["k"], np.ones((1, 2), np.float32))[1])
     before = st.snapshot()
     st.prepare(["k", "k"], np.full((2, 2), 9.0, np.float32))  # no commit
-    assert st.snapshot() == before
+    after = st.snapshot()
+    assert [c[0] for c in after["customers"]] == [c[0] for c in before["customers"]]
+    assert np.allclose(after["customers"][0][1], before["customers"][0][1])
 
 
 def test_anonymous_rows_score_cold_and_are_not_stored():
@@ -193,3 +195,29 @@ def test_history_rides_the_recovery_cut():
         router.resume()
         router.stop()
         t.join(timeout=5)
+
+
+def test_stale_generation_commit_is_dropped():
+    """A dispatch in flight across a restore (unacked-barrier path) must
+    not land doomed-epoch rows on the restored state — the replayed
+    records would then append them a second time."""
+    st = HistoryStore(length=3, num_features=2)
+    st.commit(st.prepare(["k"], np.ones((1, 2), np.float32))[1])
+    snap = st.snapshot()
+    _, token = st.prepare(["k"], np.full((1, 2), 9.0, np.float32))
+    st.restore(snap)  # crash restore lands while the dispatch is in flight
+    assert st.commit(token) is False  # stale: dropped
+    final = st.snapshot()
+    assert final["customers"][0][2] == 1  # still exactly the cut's state
+
+
+def test_multichunk_batch_commits_once_with_cross_chunk_visibility():
+    params = seq_mod.init(jax.random.PRNGKey(4))
+    s = SeqScorer(params, length=8, batch_sizes=(2,), compute_dtype="float32")
+    x = np.arange(5 * 30, dtype=np.float32).reshape(5, 30)
+    s.score(x, ids=["c"] * 5)  # 3 chunks of <=2 rows, one customer
+    snap = s.store.snapshot()
+    (key, buf, filled), = snap["customers"]
+    assert filled == 5  # every chunk's rows landed exactly once, in order
+    assert np.allclose(np.asarray(buf)[-1], x[4])
+    assert np.allclose(np.asarray(buf)[-5], x[0])

@@ -336,3 +336,44 @@ class TestInvestigator:
     def test_investigator_defaults_off(self):
         spec = PlatformSpec.from_cr({"spec": {}}, cfg=Config())
         assert not spec.component("investigator").enabled
+
+
+class TestSeqServing:
+    def test_operator_serves_seq_model_with_recovery_state(self):
+        """CCFD_MODEL=seq through the CR: the router streams through the
+        history-aware scorer, and crash recovery carries the histories."""
+        cr = minimal_cr(
+            scorer={"enabled": True, "model": "seq", "history_length": 8,
+                    "dtype": "float32"},
+            engine={"enabled": True, "crash_recovery": True,
+                    "checkpoint_interval_s": 0.5},
+            notify={"enabled": False},
+        )
+        cfg = Config(fraud_threshold=2.0)
+        from ccfd_tpu.data.ccfd import FEATURE_NAMES
+        from ccfd_tpu.serving.history import SeqScorer
+
+        p = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=30.0)
+        try:
+            assert isinstance(p.scorer, SeqScorer)
+            assert "history" in p.recovery._extra_state
+            rows = [{FEATURE_NAMES[j]: float(j) for j in range(30)}
+                    | {"id": i % 3, "customer_id": i % 3}
+                    for i in range(12)]
+            p.broker.produce_batch(cfg.kafka_topic, rows,
+                                   keys=[i % 3 for i in range(12)])
+            deadline = time.time() + 25
+            while (p.router._c_in.value() < 12 and time.time() < deadline):
+                time.sleep(0.05)
+            assert p.router._c_in.value() >= 12
+            assert len(p.scorer.store) == 3  # per-customer histories live
+            # a checkpoint carries the history state
+            deadline = time.time() + 10
+            while p.recovery.checkpoints == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert p.recovery.checkpoints > 0
+            cut = p.recovery._last
+            assert cut and "history" in cut.get("extra", {})
+            assert len(cut["extra"]["history"]["customers"]) == 3
+        finally:
+            p.down()
