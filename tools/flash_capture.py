@@ -1,0 +1,326 @@
+"""One-dial flash TPU capture for sub-minute healthy windows.
+
+Round-4 field evidence (2026-07-31, first heal in two rounds): the relay's
+pool legs opened at 03:46, a direct ``jax.devices()`` attached in 0.1 s and
+ran a matmul — and by 03:54 the legs were refused again, with the REST
+sweep's MAIN process wedged at backend init because its own probe
+subprocess had already spent an attachment.  Healthy windows can be
+~1 minute and serve very few attachments; every subprocess probe is an
+attachment the measurements never get.
+
+This runner therefore:
+
+- pre-filters with a TCP connect to the relay legs (no attachment cost;
+  ``tpu_triage.POOL_PORTS`` is the ground truth), exiting 4 when none
+  listens;
+- dials EXACTLY ONCE, in-process — there is no probe subprocess; the
+  attach itself is the probe, bounded by a hard watchdog thread that
+  flushes whatever was measured and ``os._exit``\\ s on expiry (a wedged
+  PJRT init is unkillable from Python);
+- runs the bench sections cheapest-fresh-value-first, FLUSHING the
+  artifact after every section, so a mid-run wedge keeps everything
+  measured so far (the persistent compile cache additionally banks every
+  executable compiled before the wedge for the next window);
+- merges completed sections into ``BENCH_TPU_LAST_GOOD.json`` (the file
+  bench.py attaches to fallback runs) without destroying sections an
+  older full capture measured and this flash did not reach.
+
+Exit codes: 0 = attached on TPU and completed the priority sections;
+2 = TPU but wedged mid-run (partial flushed); 3 = attach/section wedge
+before any TPU evidence; 4 = no relay leg listening; 5 = attached but not
+a TPU backend (nothing recorded).
+
+Reference acceptance surface: the Seldon request-rate/latency dashboard
+(/root/reference/deploy/grafana/SeldonCore.json:499-531).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from tpu_triage import legs_listening  # noqa: E402
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "ccfd_bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+class Watchdog:
+    """Deadline the main thread bumps before each section.  On expiry the
+    state flushed so far is final: write it and hard-exit — a wedged device
+    wait inside XLA cannot be interrupted any other way."""
+
+    def __init__(self, flush, state):
+        self._deadline = time.monotonic() + 60.0
+        self._section = "startup"
+        self._flush = flush
+        self._state = state
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def bump(self, section: str, budget_s: float) -> None:
+        self._section = section
+        self._deadline = time.monotonic() + budget_s
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(1.0)
+            if time.monotonic() > self._deadline:
+                try:
+                    self._state["wedged_in_section"] = self._section
+                    self._flush()
+                finally:
+                    code = 2 if self._state.get("sections") else 3
+                    os._exit(code)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "FLASH_TPU_r04.json"))
+    ap.add_argument("--rest-seconds", type=float, default=6.0)
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="measured window for non-REST sections")
+    ap.add_argument("--attach-budget", type=float, default=150.0)
+    ap.add_argument("--skip-extended", action="store_true",
+                    help="stop after the priority sections (no REST grid)")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="record even off-TPU (debugging the runner only)")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (debugging the runner only; "
+                    "default: the site hook's accelerator)")
+    ap.add_argument("--force-dial", action="store_true",
+                    help="skip the relay-leg pre-filter and dial anyway "
+                    "(for a probe-confirmed attachment whose port set "
+                    "moved away from the known legs)")
+    args = ap.parse_args()
+
+    if (not args.force_dial and not legs_listening()
+            and not (args.allow_cpu or args.platform)):
+        print(json.dumps({"flash": "no relay leg listening"}))
+        return 4
+
+    state: dict = {"ts_start": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                   "sections": {}, "result": {}}
+
+    def flush() -> None:
+        state["ts_flush"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, args.out)
+        # merge into the bench's last-good artifact so fallback bench runs
+        # (and the round's BENCH_rNN.json) carry the freshest TPU evidence
+        if state.get("platform") == "tpu" and state["result"]:
+            path = os.path.join(REPO, "BENCH_TPU_LAST_GOOD.json")
+            merged: dict = {}
+            try:
+                with open(path) as f:
+                    merged = json.load(f)
+            except (OSError, ValueError):
+                pass
+            result = merged.get("result", {})
+            result.update(state["result"])
+            merged["result"] = result
+            merged["captured_at"] = state["ts_flush"]
+            merged["flash_sections"] = {
+                **merged.get("flash_sections", {}),
+                **{k: state["ts_flush"] for k in state["sections"]},
+            }
+            with open(path + ".tmp", "w") as f:
+                json.dump(merged, f)
+            os.replace(path + ".tmp", path)
+
+    dog = Watchdog(flush, state)
+    bench = _load_bench()
+
+    # ---- attach: the ONE dial -------------------------------------------
+    dog.bump("attach", args.attach_budget)
+    t0 = time.monotonic()
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    else:
+        os.environ.pop("JAX_PLATFORMS", None)
+    from ccfd_tpu.utils.compile_cache import enable as enable_cache
+
+    enable_cache()
+    import jax
+
+    if args.platform:
+        # env alone is not enough: the site hook pins jax_platforms to
+        # "axon,cpu" at interpreter start, and the axon leg hangs forever
+        # on a dead relay instead of failing over — pin the config too
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    state["platform"] = devs[0].platform
+    state["devices"] = [str(d) for d in devs]
+    y = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    state["attach_s"] = round(time.monotonic() - t0, 2)
+    state["sections"]["attach"] = state["attach_s"]
+    print(json.dumps({"attach": state["attach_s"],
+                      "platform": state["platform"]}), flush=True)
+    if state["platform"] != "tpu" and not args.allow_cpu:
+        return 5
+    flush()
+
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.models import mlp
+    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.utils.gctune import tune_for_service
+
+    batch = 131072
+    lat_batch = 4096
+    ds = synthetic_dataset(n=batch, fraud_rate=0.01, seed=0)
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    tune_for_service()
+
+    def section(name, budget_s, fn):
+        dog.bump(name, budget_s)
+        t = time.monotonic()
+        try:
+            fn()
+            state["sections"][name] = round(time.monotonic() - t, 2)
+        except Exception as e:  # noqa: BLE001 - record, keep capturing
+            state["sections"][name] = f"error: {e!r}"[:300]
+        print(json.dumps({name: state["sections"][name]}), flush=True)
+        flush()
+
+    # ---- priority sections: cheapest fresh value first ------------------
+    def do_scorer():
+        scorer = Scorer(model_name="mlp", params=params,
+                        batch_sizes=(lat_batch, batch),
+                        compute_dtype="bfloat16")
+        scorer.warmup()
+        tx, p50, p99 = bench._bench_scorer(
+            scorer, ds.X, batch, lat_batch, args.seconds, 2)
+        state["result"].update({
+            "metric": "end_to_end_scoring_throughput_mlp_bf16",
+            "value": round(tx, 1), "unit": "tx/s",
+            "vs_baseline": round(tx / bench.NORTH_STAR_TX_S, 3),
+            "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+            "latency_batch": lat_batch, "fused_active": scorer.fused,
+            "platform": "tpu", "capture_mode": "flash",
+        })
+
+    def do_zoo():
+        state["result"]["zoo"] = bench._bench_zoo(max(1.0, args.seconds / 2))
+
+    def do_quant():
+        state["result"]["quant_int8"] = bench._bench_quant(
+            params, ds.X[:batch], max(1.0, args.seconds / 2))
+
+    def do_rest():
+        r = bench._bench_rest(params, lat_batch, args.rest_seconds,
+                              n_clients=4, rows_per_req=128, native=True)
+        state["result"]["rest"] = r
+        if "p99_ms" in r:
+            state["result"]["p99_e2e_ms"] = r["p99_ms"]
+            state["result"]["p99_vs_target"] = round(
+                bench.NORTH_STAR_P99_MS / max(r["p99_ms"], 1e-9), 3)
+
+    def do_rest_python():
+        state["result"]["rest_python_transport"] = bench._bench_rest(
+            params, lat_batch, max(3.0, args.rest_seconds / 2),
+            n_clients=4, rows_per_req=128, native=False)
+
+    def do_seq():
+        state["result"]["seq"] = bench._bench_seq(max(1.0, args.seconds / 2))
+
+    def do_retrain():
+        state["result"]["retrain"] = bench._bench_retrain(
+            max(1.0, args.seconds / 2))
+
+    def do_pipeline():
+        pipe_params = dict(params)
+        pipe_params["layers"] = [dict(l) for l in params["layers"]]
+        pipe_params["layers"][-1] = dict(pipe_params["layers"][-1])
+        pipe_params["layers"][-1]["b"] = jnp.asarray([-4.0], jnp.float32)
+        state["result"]["pipeline"] = bench._bench_pipeline(
+            pipe_params, args.seconds)
+
+    def do_fused_ab():
+        ab = {}
+        for label, use_fused in (("fused", True), ("xla", False)):
+            s = Scorer(model_name="mlp", params=params,
+                       batch_sizes=(lat_batch, batch),
+                       compute_dtype="bfloat16", use_fused=use_fused)
+            if use_fused and not s.fused:
+                ab[label] = None
+                continue
+            s.warmup()
+            tx, p50, p99 = bench._bench_scorer(
+                s, ds.X, batch, lat_batch, max(1.0, args.seconds / 2), 2)
+            ab[label] = {"tx_s": round(tx, 1), "p50_ms": round(p50, 3),
+                         "p99_ms": round(p99, 3)}
+        state["result"]["fused_ab"] = ab
+
+    section("scorer", 300, do_scorer)
+    section("zoo", 300, do_zoo)
+    section("quant_int8", 240, do_quant)
+    section("rest_native", 300 + args.rest_seconds, do_rest)
+    section("rest_python", 240 + args.rest_seconds, do_rest_python)
+    section("seq", 240, do_seq)
+    section("retrain", 240, do_retrain)
+    section("pipeline", 300, do_pipeline)
+    section("fused_ab", 240, do_fused_ab)
+
+    errors = [k for k, v in state["sections"].items()
+              if isinstance(v, str) and v.startswith("error")]
+    state["priority_complete"] = not errors
+
+    # ---- extended: REST grid while the window lasts ---------------------
+    if not args.skip_extended:
+        grid = []
+        for native in (True, False):
+            for n_clients in (4, 8):
+                for rows in (8, 32, 128):
+                    if rows == 128 and n_clients == 4:
+                        continue  # already measured above
+                    name = f"rest_grid_{'nat' if native else 'py'}_c{n_clients}_r{rows}"
+
+                    def do_point(native=native, n_clients=n_clients,
+                                 rows=rows):
+                        p = bench._bench_rest(
+                            params, lat_batch, args.rest_seconds,
+                            n_clients=n_clients, rows_per_req=rows,
+                            native=native)
+                        p.update({"native": native,
+                                  "n_clients_requested": n_clients})
+                        grid.append(p)
+                        state["result"]["rest_grid"] = grid
+
+                    section(name, 180 + args.rest_seconds, do_point)
+
+    dog.bump("done", 60)
+    state["ts_end"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    all_errors = [k for k, v in state["sections"].items()
+                  if isinstance(v, str) and v.startswith("error")]
+    flush()
+    print(json.dumps({"flash": "complete",
+                      "sections": list(state["sections"]),
+                      "errors": all_errors}), flush=True)
+    # exit contract: 0 only when every section measured — a detach that
+    # RAISES (instead of hanging) error-marks sections fast, and the
+    # watcher must not treat that as a full capture
+    return 0 if not all_errors else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,24 @@ int connect(int fd, const struct sockaddr *addr, socklen_t len) {
 """
 
 
+def legs_listening(timeout_s: float = 0.5) -> list[int]:
+    """Which pool-service legs accept a TCP connect right now (~100 us per
+    refused port on loopback).  Shared by the watcher's fast poll and the
+    flash capture's pre-filter so both always agree on what 'window open'
+    means."""
+    import socket
+
+    alive = []
+    for port in POOL_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=timeout_s):
+                alive.append(port)
+        except OSError:
+            pass
+    return alive
+
+
 def tcp_listeners() -> list[dict]:
     """Every TCP LISTEN socket in this netns, from /proc/net/tcp{,6}."""
     out = []

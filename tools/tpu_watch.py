@@ -11,18 +11,24 @@ actually listens.  Healthy windows historically last minutes
 (BASELINE.md "tunnel" notes); reacting in seconds instead of minutes is
 the difference between a capture and another lost round.
 
-On a confirmed-healthy probe it fires, in order, each in its own
-subprocess with a watchdog:
+Legs listening IS the go signal (2026-07-31 field evidence: windows can
+be ~1 minute and serve very few attachments, so a jax probe subprocess
+here would spend one the measurements never get).  On open legs it fires:
 
-  1. ``bench.py``            — full bench (quant + zoo sections armed),
-                               refreshes BENCH_TPU_LAST_GOOD.json
-  2. ``tools/rest_sweep.py`` — the pre-scripted REST north-star sweep
-  3. ``tools/tpu_triage.py`` — records the healthy-state triage snapshot
+  1. ``tools/flash_capture.py`` — single-dial, priority-ordered sections,
+                                  flushes after each, merges completed
+                                  sections into BENCH_TPU_LAST_GOOD.json
+  2. ``bench.py`` + ``tools/tpu_triage.py`` — only when the flash
+                                  completed (rc 0) and the legs still
+                                  listen: the window has proven it can
+                                  afford the full suite's attachments
 
-It keeps watching after a capture and re-captures at most every
-``--recapture-min`` minutes while the attachment stays healthy, so the
-freshest possible evidence rides the round.  Exit: 0 after at least one
-full TPU capture when the budget ends, 3 if none.
+A slow-path jax probe still runs every ``--slow-every`` polls with no
+legs open, in case the relay's port set changes; a success there fires
+the flash with ``--force-dial``.  It keeps watching after a capture and
+re-captures at most every ``--recapture-min`` minutes while the
+attachment stays healthy.  Exit: 0 after at least one TPU capture when
+the budget ends, 3 if none.
 
     python tools/tpu_watch.py --fast-interval 10 --max-hours 11 &
 """
@@ -31,7 +37,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -39,9 +44,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "tpu_watch.log")
 sys.path.insert(0, os.path.join(REPO, "tools"))
-from tpu_triage import POOL_PORTS  # noqa: E402 — triage is the ground
-# truth for the relay's leg set; a drifted copy here would have the
-# watcher pre-filtering dead ports and skipping every healthy window
+# triage owns the relay's leg set AND the probe helper; a drifted copy
+# here would have the watcher and the flash capture disagreeing on what
+# 'window open' means
+from tpu_triage import POOL_PORTS, legs_listening as relay_legs_listening  # noqa: E402
 
 
 def log(msg: str) -> None:
@@ -49,20 +55,6 @@ def log(msg: str) -> None:
     print(line, flush=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
-
-
-def relay_legs_listening(timeout_s: float = 0.5) -> list[int]:
-    """Which pool-service legs accept a TCP connect right now (~100 us per
-    refused port on loopback — cheap enough for a 10 s cadence)."""
-    alive = []
-    for port in POOL_PORTS:
-        try:
-            with socket.create_connection(("127.0.0.1", port),
-                                          timeout=timeout_s):
-                alive.append(port)
-        except OSError:
-            pass
-    return alive
 
 
 def probe(timeout_s: float) -> bool:
@@ -128,16 +120,49 @@ def run_tool(argv: list[str], timeout_s: float, label: str) -> bool:
         return False
 
 
-def capture_pipeline(bench_timeout_s: float) -> bool:
-    """The whole evidence suite, cheapest-to-lose last."""
-    got_tpu = run_bench(bench_timeout_s)
+def run_flash(timeout_s: float, force_dial: bool = False) -> int:
+    """One-dial flash capture (tools/flash_capture.py): the attach IS the
+    probe, sections flush as they complete, and no subprocess probe spends
+    an attachment first.  Returns its exit code (0 full TPU capture,
+    2 partial TPU, 3 wedge, 4 legs closed, 5 non-TPU backend)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    argv = [sys.executable, "tools/flash_capture.py"]
+    if force_dial:
+        argv.append("--force-dial")
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        log("flash capture exceeded outer watchdog")
+        return 3
+    tail = (r.stdout or "").strip().splitlines()
+    log(f"flash capture: rc={r.returncode} last={tail[-1][:200] if tail else ''}")
+    if r.returncode not in (0, 2, 4):
+        log(f"flash stderr tail: {(r.stderr or '')[-300:]}")
+    return r.returncode
+
+
+def capture_pipeline(bench_timeout_s: float,
+                     force_dial: bool = False) -> bool | None:
+    """The whole evidence suite. 2026-07-31 field evidence: healthy windows
+    can be ~1 min and serve very few attachments, so the single-dial flash
+    runs FIRST and banks sections incrementally; the full bench (mesh
+    section + canonical artifact) and triage snapshot only spend further
+    attachments when the flash proves the window is alive."""
+    rc = run_flash(3600.0, force_dial=force_dial)
+    if rc == 4:
+        return None  # legs closed before the dial: not an attempt at all
+    got_tpu = rc in (0, 2)
     if got_tpu:
-        log("TPU capture secured (BENCH_TPU_LAST_GOOD.json refreshed)")
-    # The sweep runs its own probe and falls back honestly; fire it even if
-    # the bench lost the window mid-run — partial evidence beats none.
-    run_tool([sys.executable, "tools/rest_sweep.py"], 900.0, "rest_sweep")
-    run_tool([sys.executable, "tools/tpu_triage.py", "--no-trace",
-              "--probe-s", "30"], 300.0, "triage snapshot")
+        log("flash TPU capture secured (BENCH_TPU_LAST_GOOD.json merged)")
+    if rc == 0 and relay_legs_listening():
+        # window survived the whole flash: afford the full bench suite
+        run_bench(bench_timeout_s)
+        run_tool([sys.executable, "tools/tpu_triage.py", "--no-trace",
+                  "--probe-s", "30"], 300.0, "triage snapshot")
     return got_tpu
 
 
@@ -187,21 +212,26 @@ def main() -> int:
             time.sleep(args.fast_interval)
             continue
         if legs:
-            log(f"poll #{attempt}: relay legs LISTENING {legs} — jax probe")
-        if probe(args.probe_timeout):
-            log(f"poll #{attempt}: HEALTHY — firing capture pipeline")
+            # Legs listening IS the go signal: a jax probe subprocess here
+            # would spend one of the window's few attachments (2026-07-31:
+            # the sweep's probe attached fine and its main process got
+            # nothing) — the flash capture's own attach is the probe.
+            log(f"poll #{attempt}: relay legs LISTENING {legs} — "
+                f"firing capture pipeline")
             got = capture_pipeline(args.bench_timeout)
-            # stamp AFTER the pipeline: it can run ~an hour itself, and a
-            # hold-off measured from its start would already be consumed
-            last_attempt = time.time()
-            if got:
-                captured += 1
-                wait_min = args.recapture_min
-            else:
-                wait_min = args.retry_min
-        elif legs:
-            log(f"poll #{attempt}: legs listening but probe hung — "
-                f"wedge is beyond the relay (see tpu_triage.py)")
+            if got is not None:  # None: legs closed pre-dial, keep polling
+                last_attempt = time.time()
+                wait_min = args.recapture_min if got else args.retry_min
+                captured += bool(got)
+        elif probe(args.probe_timeout):
+            # slow path: attachment healthy without any known leg open —
+            # the relay's port set changed; capture anyway
+            log(f"poll #{attempt}: HEALTHY without legs — firing pipeline")
+            got = capture_pipeline(args.bench_timeout, force_dial=True)
+            if got is not None:
+                last_attempt = time.time()
+                wait_min = args.recapture_min if got else args.retry_min
+                captured += bool(got)
         else:
             # reached at most once per slow_n fast polls (~5 min default)
             log(f"poll #{attempt}: wedged (legs refused, slow probe hung)")
