@@ -398,7 +398,7 @@ def _bench_retrain(seconds):
     }
 
 
-def _scorer_hop_rate(name, params, x, seconds):
+def _scorer_hop_rate(name, params, x, seconds, use_fused=False):
     """Time the REAL scorer hop for one model: numpy in, probabilities on
     host out, full H2D + dispatch + D2H per call through the Scorer (host
     tier forced off so the number is the device path) — the same surface
@@ -406,8 +406,12 @@ def _scorer_hop_rate(name, params, x, seconds):
     from ccfd_tpu.serving.scorer import Scorer
 
     s = Scorer(model_name=name, params=params, batch_sizes=(x.shape[0],),
-               host_tier_rows=0, use_fused=False)
+               host_tier_rows=0, use_fused=use_fused)
     s.warmup()
+    if use_fused and not s.fused:
+        # warmup fell back (lowering failure): recording the XLA rate
+        # under a fused label would corrupt the A/B this exists to settle
+        return None
     n = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < seconds:
@@ -460,14 +464,29 @@ def _bench_quant(params, x, seconds):
     weights + per-row dynamic activations ride the MXU at twice the bf16
     rate and halve the wire bytes (ops/quant.py); measuring through the
     full H2D/D2H round trip is what lets the wire half show."""
+    import jax
+
     from ccfd_tpu.ops import quant as quantlib
 
     qp = quantlib.quantize_mlp(params)
-    return {
+    out = {
         "tx_s": _scorer_hop_rate("mlp_q8", qp, x, seconds),
         "batch": int(x.shape[0]),
         "dtype": "int8",
     }
+    if jax.default_backend() == "tpu":
+        # A/B the fused int8 Pallas kernel (ops/fused_mlp_q8.py) against
+        # the XLA q8 graph above — identical probabilities by contract, so
+        # the delta is pure kernel effect (VMEM-resident weights, no
+        # inter-layer HBM round trips). TPU-only: the CPU interpreter is
+        # orders of magnitude slower and would record noise.
+        fused_rate = _scorer_hop_rate(
+            "mlp_q8", qp, x, seconds, use_fused=True
+        )
+        # None = the kernel failed to lower and warmup fell back — a
+        # recorded fact, distinct from "no effect"
+        out["fused_tx_s"] = fused_rate
+    return out
 
 
 def _arm_watchdog() -> None:
@@ -673,6 +692,9 @@ def main() -> None:
                 ab[label] = None
                 continue
             s.warmup()
+            if use_fused and not s.fused:
+                ab[label] = None  # lowering failed; warmup fell back
+                continue
             r_tx, r_p50, r_p99 = _bench_scorer(
                 s, ds.X, batch, lat_batch, max(1.0, seconds / 2), depth
             )

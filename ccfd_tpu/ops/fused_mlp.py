@@ -32,6 +32,7 @@ from ccfd_tpu.data.ccfd import NUM_FEATURES
 
 LANE = 128  # TPU lane width: last-dim alignment target
 DEFAULT_TILE = 512
+INPUT_DTYPE = "bfloat16"  # wire format for rows: half the H2D bytes
 
 
 def _pad_to(a: np.ndarray, rows: int) -> np.ndarray:
@@ -147,6 +148,11 @@ def fused_mlp_score(
         kernel_params["b3"],
     )
     return out.reshape(batch)
+
+
+# uniform entry point for Scorer's fused-module dispatch (the q8 sibling
+# ccfd_tpu/ops/fused_mlp_q8.py exposes the same name)
+fused_score = fused_mlp_score
 
 
 def make_score_fn(params: Mapping[str, Any], tile: int = DEFAULT_TILE):

@@ -1,0 +1,207 @@
+"""Pallas TPU kernel: fully fused int8-quantized MLP fraud scoring.
+
+The int8 sibling of :mod:`ccfd_tpu.ops.fused_mlp`: same tiny-model/
+huge-batch serving shape (weights resident in VMEM for the whole grid, one
+HBM read of x and one write of the probabilities), but the two hidden
+matmuls run int8 x int8 -> int32 on the MXU — the mode the systolic array
+executes at up to twice the bf16 rate — and the weights sit in VMEM at a
+quarter of f32.
+
+The math is EXACTLY :func:`ccfd_tpu.ops.quant.logits` (the served XLA
+``mlp_q8`` graph): normalize f32 -> per-row symmetric int8 requantization
+before every layer -> int32 accumulate -> f32 dequant + bias (+ relu).
+Differences from the XLA graph are layout only:
+
+- activations never round-trip to HBM between layers (the XLA path
+  materializes each layer's output);
+- the last layer's int math runs elementwise on the VPU in f32: products
+  of two int8 values and their 256-term partial sums are integers below
+  2^24, all exactly representable in f32, so the result equals the XLA
+  path's int32 accumulate bit-for-bit before the final dequant;
+- rows ship as f32, exactly like the XLA path receives them, so the
+  kernel is numerically indistinguishable from the served graph
+  (max prob delta ~1e-7, asserted in tests/test_fused_q8.py).  bf16 rows
+  would halve H2D bytes but double the effective quantization noise
+  (measured 0.058 max prob delta vs the XLA graph) — the int8 path's
+  accuracy budget is already spent on weight+activation quantization, so
+  the wire keeps f32.
+
+On non-TPU backends the kernel runs under ``interpret=True`` so the CPU
+test mesh exercises the identical body (SURVEY.md §4).
+
+Reference parity context: the quantized graph serves the same Seldon
+REST contract as the reference's ``modelfull``
+(/root/reference/deploy/model/modelfull.json:37-44); quantization itself
+has no reference analog — it exists for the TPU serving regime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANE = 128  # TPU lane width: last-dim alignment target
+DEFAULT_TILE = 512
+INPUT_DTYPE = "float32"  # wire format for rows: exact parity with XLA q8
+_EPS = 1e-8
+
+
+def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
+    pad = rows - a.shape[0]
+    if pad <= 0:
+        return a
+    return np.concatenate(
+        [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
+    )
+
+
+def fold_for_kernel(params: Mapping[str, Any]) -> dict[str, jax.Array]:
+    """quantized MLP params (ops/quant.py layout) -> kernel weights.
+
+    The normalizer CANNOT be folded into int8 weights the way the f32
+    kernel folds it (per-input scaling would break the per-output-channel
+    quantization grid), so mu / 1/sigma ride along as f32 vectors and the
+    kernel normalizes explicitly.  Padded feature columns get
+    inv_sigma = 0, so padded features normalize to exactly 0 and the
+    zero-padded rows of w1q contribute exactly 0 to the accumulate.
+    """
+    layers = params["layers"]
+    if len(layers) != 3 or "wq" not in layers[0]:
+        raise KeyError("fused q8 kernel expects a 3-layer quantized MLP")
+    mu = np.asarray(params["norm"]["mu"], np.float32)
+    sigma = np.asarray(params["norm"]["sigma"], np.float32)
+    inv = 1.0 / np.where(sigma == 0.0, 1.0, sigma)
+    n_feat = mu.shape[0]
+    if n_feat > LANE:
+        raise ValueError(f"{n_feat} features > lane width {LANE}")
+    w1q = np.asarray(layers[0]["wq"], np.int8)
+    if w1q.shape[0] != n_feat:
+        raise ValueError("normalizer/layer-0 feature-count mismatch")
+    # w3 as f32: int8 products and their partial sums stay integer-exact
+    # in f32 (< 2^24), see module docstring
+    w3f = np.asarray(layers[2]["wq"], np.float32).reshape(1, -1)
+    return {
+        "mu": jnp.asarray(np.pad(mu, (0, LANE - n_feat))),
+        "inv_sigma": jnp.asarray(np.pad(inv, (0, LANE - n_feat))),
+        "w1q": jnp.asarray(_pad_rows(w1q, LANE)),  # (128, H) int8
+        "s1": jnp.asarray(np.asarray(layers[0]["scale"], np.float32)),
+        "b1": jnp.asarray(np.asarray(layers[0]["b"], np.float32)),
+        "w2q": jnp.asarray(np.asarray(layers[1]["wq"], np.int8)),  # (H, H)
+        "s2": jnp.asarray(np.asarray(layers[1]["scale"], np.float32)),
+        "b2": jnp.asarray(np.asarray(layers[1]["b"], np.float32)),
+        "w3f": jnp.asarray(w3f),  # (1, H) f32 holding int8 values
+        "s3": jnp.asarray(np.asarray(layers[2]["scale"], np.float32)),
+        "b3": jnp.asarray(np.asarray(layers[2]["b"], np.float32)),
+    }
+
+
+def _rowquant(h: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 (same math as quant._quantize_rows)."""
+    amax = jnp.max(jnp.abs(h), axis=1, keepdims=True)
+    s = jnp.maximum(amax / 127.0, _EPS)
+    q = jnp.clip(jnp.rint(h / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _kernel(x_ref, mu_ref, inv_ref, w1_ref, s1_ref, b1_ref,
+            w2_ref, s2_ref, b2_ref, w3_ref, s3_ref, b3_ref, out_ref):
+    x = x_ref[:].astype(jnp.float32)
+    h = (x - mu_ref[:]) * inv_ref[:]
+    # layer 1: int8 MXU matmul, int32 accumulate
+    q, sx = _rowquant(h)
+    acc = jnp.dot(q, w1_ref[:], preferred_element_type=jnp.int32)
+    h = jnp.maximum(acc.astype(jnp.float32) * sx * s1_ref[:] + b1_ref[:], 0.0)
+    # layer 2
+    q, sx = _rowquant(h)
+    acc = jnp.dot(q, w2_ref[:], preferred_element_type=jnp.int32)
+    h = jnp.maximum(acc.astype(jnp.float32) * sx * s2_ref[:] + b2_ref[:], 0.0)
+    # layer 3 as an integer-exact f32 elementwise reduce on the VPU
+    q, sx = _rowquant(h)
+    z = jnp.sum(q.astype(jnp.float32) * w3_ref[:], axis=1, keepdims=True)
+    out_ref[:] = jax.nn.sigmoid(z * sx * s3_ref[:] + b3_ref[:])
+
+
+def pad_features(x: jax.Array) -> jax.Array:
+    """(B, F) -> (B, 128) zero-padded."""
+    b, f = x.shape
+    if f == LANE:
+        return x
+    return jnp.pad(x, ((0, 0), (0, LANE - f)))
+
+
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def fused_mlp_q8_score(
+    kernel_params: Mapping[str, jax.Array],
+    x: jax.Array,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+) -> jax.Array:
+    """(B, F<=128) rows -> (B,) float32 proba.  B must be a tile multiple.
+    f32 rows are the contract (exact parity with the XLA q8 graph); other
+    float dtypes are accepted and widened/rounded to f32 first."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if x.dtype != jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    x = pad_features(x)
+    batch = x.shape[0]
+    if batch % tile != 0:
+        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    hidden = kernel_params["w2q"].shape[0]
+    grid = (batch // tile,)
+
+    def xmap(i):
+        return (i, 0)
+
+    def const2(i):
+        return (0, 0)
+
+    def const1(i):
+        return (0,)
+
+    mem = pltpu.VMEM  # weights resident in VMEM for the whole grid
+
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((batch, 1), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, LANE), xmap, memory_space=mem),
+            pl.BlockSpec((LANE,), const1, memory_space=mem),
+            pl.BlockSpec((LANE,), const1, memory_space=mem),
+            pl.BlockSpec((LANE, hidden), const2, memory_space=mem),
+            pl.BlockSpec((hidden,), const1, memory_space=mem),
+            pl.BlockSpec((hidden,), const1, memory_space=mem),
+            pl.BlockSpec((hidden, hidden), const2, memory_space=mem),
+            pl.BlockSpec((hidden,), const1, memory_space=mem),
+            pl.BlockSpec((hidden,), const1, memory_space=mem),
+            pl.BlockSpec((1, hidden), const2, memory_space=mem),
+            pl.BlockSpec((1,), const1, memory_space=mem),
+            pl.BlockSpec((1,), const1, memory_space=mem),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), xmap, memory_space=mem),
+        interpret=interpret,
+    )(
+        x,
+        kernel_params["mu"],
+        kernel_params["inv_sigma"],
+        kernel_params["w1q"],
+        kernel_params["s1"],
+        kernel_params["b1"],
+        kernel_params["w2q"],
+        kernel_params["s2"],
+        kernel_params["b2"],
+        kernel_params["w3f"],
+        kernel_params["s3"],
+        kernel_params["b3"],
+    )
+    return out.reshape(batch)
+
+
+# uniform entry point for Scorer's fused-module dispatch
+fused_score = fused_mlp_q8_score

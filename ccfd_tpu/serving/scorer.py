@@ -138,11 +138,12 @@ class Scorer:
         self._fused_params = None
         if use_fused is None:
             # auto only on real TPU: the CPU interpreter runs the same kernel
-            # body but orders of magnitude slower (tests opt in explicitly)
-            use_fused = (
-                self.spec.name == "mlp"
-                and dtype == jnp.bfloat16
-                and jax.default_backend() == "tpu"
+            # body but orders of magnitude slower (tests opt in explicitly).
+            # mlp_q8 has its own int8 kernel (ops/fused_mlp_q8.py) whose
+            # compute precision is fixed by quantization, so no dtype gate.
+            use_fused = jax.default_backend() == "tpu" and (
+                (self.spec.name == "mlp" and dtype == jnp.bfloat16)
+                or self.spec.name == "mlp_q8"
             )
         # Host latency tier: when the accelerator sits behind a high-RTT
         # attachment (a tunneled TPU adds tens of ms per dispatch), a small
@@ -228,12 +229,22 @@ class Scorer:
                 deadline_s=self.dispatch_deadline_s,
             )
         if use_fused:
-            from ccfd_tpu.ops import fused_mlp
+            if self.spec.name == "mlp_q8":
+                from ccfd_tpu.ops import fused_mlp_q8 as fused_mod
+            else:
+                from ccfd_tpu.ops import fused_mlp as fused_mod
 
-            self._fused_mod = fused_mlp
+            self._fused_mod = fused_mod
+            # wire dtype is the kernel's call: bf16 halves H2D bytes for
+            # the bf16 kernel; the q8 kernel keeps f32 for exact parity
+            # with the served XLA graph (its docstring has the numbers)
+            self._fused_in_dtype = (
+                ml_dtypes.bfloat16
+                if fused_mod.INPUT_DTYPE == "bfloat16" else np.float32
+            )
             try:
                 self._fused_params = self._put_fused(
-                    fused_mlp.fold_for_kernel(self._params)
+                    fused_mod.fold_for_kernel(self._params)
                 )
             except (KeyError, TypeError, ValueError):
                 self._fused_params = None  # incompatible layout: XLA path
@@ -260,7 +271,7 @@ class Scorer:
         while rows % tile:  # largest power-of-two-ish divisor <= 512
             tile //= 2
         if self.mesh is None:
-            return self._fused_mod.fused_mlp_score(
+            return self._fused_mod.fused_score(
                 fused_params, x, tile=tile, interpret=self._fused_interpret
             )
         return self._fused_sharded(tile)(fused_params, x)
@@ -278,7 +289,7 @@ class Scorer:
             from ccfd_tpu.parallel.mesh import DATA_AXIS
 
             def per_chip(p, xs):
-                return self._fused_mod.fused_mlp_score(
+                return self._fused_mod.fused_score(
                     p, xs, tile=tile, interpret=self._fused_interpret
                 )
 
@@ -334,23 +345,54 @@ class Scorer:
             self._wedge.mark_wedged()
 
     def _warmup_body(self) -> None:
-        for b in self.batch_sizes:
-            if self._fused_params is not None:
-                jax.block_until_ready(
-                    self._fused_apply(
-                        self._fused_params,
-                        self._put_batch(
-                            np.zeros((b, self.num_features), ml_dtypes.bfloat16)
-                        ),
-                    )
+        while True:
+            try:
+                for b in self.batch_sizes:
+                    if self._fused_params is not None:
+                        jax.block_until_ready(
+                            self._fused_apply(
+                                self._fused_params,
+                                self._put_batch(
+                                    np.zeros((b, self.num_features),
+                                             self._fused_in_dtype)
+                                ),
+                            )
+                        )
+                    else:
+                        jax.block_until_ready(
+                            self._apply(
+                                self._params,
+                                self._put_batch(
+                                    np.zeros((b, self.num_features),
+                                             np.float32)
+                                ),
+                            )
+                        )
+                break
+            except Exception as e:  # noqa: BLE001 - see below
+                if self._fused_params is None:
+                    raise
+                # A Mosaic lowering failure surfaces at FIRST call, on the
+                # only backend that can't be exercised in CI (real TPU).
+                # Serving must degrade to the XLA graph — which computes
+                # the same probabilities — not die at boot. Restart the
+                # loop so every bucket gets its XLA executable (buckets
+                # warmed fused-only before the failure would otherwise
+                # compile lazily on the first live request).
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "fused kernel failed at warmup (%r); "
+                    "falling back to the XLA path", e
                 )
-            else:
-                jax.block_until_ready(
-                    self._apply(
-                        self._params,
-                        self._put_batch(np.zeros((b, self.num_features), np.float32)),
-                    )
-                )
+                with self._lock:
+                    self._fused_params = None
+                    # LATCH the disable: swap_params re-folds on every
+                    # retrain publish, and re-enabling a kernel that
+                    # cannot lower would crash the first post-retrain
+                    # request (layout-unfoldable trees, by contrast, may
+                    # re-enable on a later foldable tree)
+                    self._fused_disabled = True
         # autotune refines an ARMED auto tier (provisional 256 until
         # measured); host_tier_rows == 0 means the auto policy resolved the
         # tier OFF (cpu backend / mesh) — host params may still exist for
@@ -384,7 +426,7 @@ class Scorer:
             fused = self._fused_params
             host_params = self._host_params
         if fused is not None:
-            xb = np.zeros((b, self.num_features), ml_dtypes.bfloat16)
+            xb = np.zeros((b, self.num_features), self._fused_in_dtype)
             dispatch = lambda: self._fused_apply(fused, self._put_batch(xb))  # noqa: E731
         else:
             xf = np.zeros((b, self.num_features), np.float32)
@@ -430,8 +472,12 @@ class Scorer:
         staged_fused = None
         # gate on the fused MODULE, not the current fused params: one
         # unfoldable swap drops to the XLA path, but a later foldable tree
-        # must re-enable the kernel
-        if getattr(self, "_fused_mod", None) is not None:
+        # must re-enable the kernel. A warmup LOWERING failure, however,
+        # latches fused off for the Scorer's lifetime (_fused_disabled) —
+        # folding is pure layout and would "succeed" right back into the
+        # broken kernel.
+        if (getattr(self, "_fused_mod", None) is not None
+                and not getattr(self, "_fused_disabled", False)):
             try:
                 staged_fused = self._put_fused(self._fused_mod.fold_for_kernel(staged))
                 jax.block_until_ready(staged_fused)
@@ -507,10 +553,11 @@ class Scorer:
                     [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
                 )
             if fused_params is not None:
-                # ship rows as bf16: the kernel computes in bf16 either way,
-                # and half the bytes ≈ double the H2D-bound throughput
+                # wire dtype per kernel: bf16 rows halve the bytes for the
+                # bf16 kernel (it computes bf16 either way); f32 for q8
                 out = self._fused_apply(
-                    fused_params, self._put_batch(chunk.astype(ml_dtypes.bfloat16))
+                    fused_params,
+                    self._put_batch(chunk.astype(self._fused_in_dtype)),
                 )
             else:
                 out = self._apply(params, self._put_batch(chunk))

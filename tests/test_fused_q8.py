@@ -1,0 +1,107 @@
+"""Fused int8 Pallas kernel (ops/fused_mlp_q8.py): exact parity with the
+served XLA ``mlp_q8`` graph, Scorer integration by name, and the warmup
+fallback that keeps serving alive if Mosaic lowering fails on real TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccfd_tpu.data.ccfd import synthetic_dataset
+from ccfd_tpu.models import mlp
+from ccfd_tpu.ops import fused_mlp_q8, quant
+from ccfd_tpu.serving.scorer import Scorer
+
+
+def _quantized_params(seed=0):
+    ds = synthetic_dataset(n=1024, fraud_rate=0.1, seed=seed)
+    params = mlp.init(jax.random.PRNGKey(seed))
+    params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
+    return quant.quantize_mlp(params), ds
+
+
+def test_kernel_matches_xla_q8_graph_exactly():
+    """f32 rows in both paths -> the kernel re-implements quant.logits'
+    exact integer math; only float-associativity noise remains (~1e-7)."""
+    qp, ds = _quantized_params()
+    kp = fused_mlp_q8.fold_for_kernel(qp)
+    x = jnp.asarray(ds.X[:512])
+    ref = np.asarray(quant.apply(qp, x))
+    out = np.asarray(
+        fused_mlp_q8.fused_mlp_q8_score(kp, x, tile=256, interpret=True)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_padded_features_contribute_nothing():
+    """Zero-padded feature columns (30 -> 128) must not shift any
+    probability: inv_sigma = 0 in padding makes them normalize to 0, and
+    w1q's padded rows are 0."""
+    qp, ds = _quantized_params(seed=1)
+    kp = fused_mlp_q8.fold_for_kernel(qp)
+    assert int(np.asarray(kp["w1q"])[30:].max()) == 0
+    assert float(np.asarray(kp["inv_sigma"])[30:].max()) == 0.0
+    x = jnp.asarray(ds.X[:256])
+    ref = np.asarray(quant.apply(qp, x))
+    out = np.asarray(
+        fused_mlp_q8.fused_mlp_q8_score(kp, x, tile=256, interpret=True)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_fold_rejects_unquantized_or_wrong_depth_trees():
+    params = mlp.init(jax.random.PRNGKey(0))
+    params = mlp.set_normalizer(
+        params, np.zeros(30, np.float32), np.ones(30, np.float32)
+    )
+    with pytest.raises(KeyError):
+        fused_mlp_q8.fold_for_kernel(params)  # f32 tree, no "wq"
+    qp, _ = _quantized_params()
+    two = {"norm": qp["norm"], "layers": list(qp["layers"])[:2]}
+    with pytest.raises(KeyError):
+        fused_mlp_q8.fold_for_kernel(two)
+
+
+def test_scorer_fused_q8_matches_xla_scorer():
+    """Scorer(model_name='mlp_q8', use_fused=True) serves the identical
+    probabilities as the XLA q8 scorer through the full bucket/pad path."""
+    qp, ds = _quantized_params(seed=2)
+    fused = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                   use_fused=True)
+    plain = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                   use_fused=False)
+    assert fused.fused and not plain.fused
+    # the q8 kernel's wire format is f32 — exact parity, unlike bf16
+    assert fused._fused_in_dtype == np.float32
+    x = ds.X[:100]  # full 64 bucket + padded 256 bucket
+    np.testing.assert_allclose(fused.score(x), plain.score(x), atol=1e-5)
+    np.testing.assert_allclose(
+        fused.score_pipelined(x, depth=2), plain.score(x), atol=1e-5
+    )
+
+
+def test_warmup_kernel_failure_falls_back_to_xla(monkeypatch):
+    """A Mosaic lowering error at first call (only reproducible on real
+    TPU) must degrade warmup to the XLA graph, not kill serving."""
+    qp, ds = _quantized_params(seed=3)
+    scorer = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 128),
+                    use_fused=True)
+    assert scorer.fused
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setattr(scorer._fused_mod, "fused_score", boom)
+    scorer.warmup()  # must not raise
+    assert not scorer.fused
+    ref = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 128),
+                 use_fused=False).score(ds.X[:64])
+    np.testing.assert_allclose(scorer.score(ds.X[:64]), ref, atol=1e-6)
+    # the fallback LATCHES: a retrain publish re-folds successfully (fold
+    # is pure layout) but must not resurrect the kernel that cannot lower
+    qp2, _ = _quantized_params(seed=4)
+    scorer.swap_params(qp2)
+    assert not scorer.fused
+    ref2 = Scorer(model_name="mlp_q8", params=qp2, batch_sizes=(64, 128),
+                  use_fused=False).score(ds.X[:64])
+    np.testing.assert_allclose(scorer.score(ds.X[:64]), ref2, atol=1e-6)

@@ -115,8 +115,12 @@ def main() -> int:
     state: dict = {"ts_start": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                              time.gmtime()),
                    "sections": {}, "result": {}}
+    # the watchdog thread also flushes (on expiry, while the main thread
+    # may be mid-flush); without serialization the two writers truncate
+    # each other's .tmp and can publish torn JSON over the last-good merge
+    flush_lock = threading.Lock()
 
-    def flush() -> None:
+    def _flush_locked() -> None:
         state["ts_flush"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         tmp = args.out + ".tmp"
         with open(tmp, "w") as f:
@@ -143,6 +147,10 @@ def main() -> int:
             with open(path + ".tmp", "w") as f:
                 json.dump(merged, f)
             os.replace(path + ".tmp", path)
+
+    def flush() -> None:
+        with flush_lock:
+            _flush_locked()
 
     dog = Watchdog(flush, state)
     bench = _load_bench()
@@ -250,7 +258,6 @@ def main() -> int:
     def do_pipeline():
         pipe_params = dict(params)
         pipe_params["layers"] = [dict(l) for l in params["layers"]]
-        pipe_params["layers"][-1] = dict(pipe_params["layers"][-1])
         pipe_params["layers"][-1]["b"] = jnp.asarray([-4.0], jnp.float32)
         state["result"]["pipeline"] = bench._bench_pipeline(
             pipe_params, args.seconds)
@@ -265,6 +272,9 @@ def main() -> int:
                 ab[label] = None
                 continue
             s.warmup()
+            if use_fused and not s.fused:
+                ab[label] = None  # lowering failed; warmup fell back
+                continue
             tx, p50, p99 = bench._bench_scorer(
                 s, ds.X, batch, lat_batch, max(1.0, args.seconds / 2), 2)
             ab[label] = {"tx_s": round(tx, 1), "p50_ms": round(p50, 3),

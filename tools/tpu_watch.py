@@ -136,6 +136,17 @@ def run_flash(timeout_s: float, force_dial: bool = False) -> int:
             env=env, cwd=REPO,
         )
     except subprocess.TimeoutExpired:
+        # the flash flushes after every section, so classify an outer
+        # timeout from the artifact instead of writing the window off
+        try:
+            with open(os.path.join(REPO, "FLASH_TPU_r04.json")) as f:
+                snap = json.load(f)
+            if snap.get("platform") == "tpu" and snap.get("result"):
+                log("flash exceeded outer watchdog with sections banked "
+                    f"({sorted(snap.get('sections', {}))}) — partial")
+                return 2
+        except (OSError, ValueError):
+            pass
         log("flash capture exceeded outer watchdog")
         return 3
     tail = (r.stdout or "").strip().splitlines()
@@ -146,24 +157,27 @@ def run_flash(timeout_s: float, force_dial: bool = False) -> int:
 
 
 def capture_pipeline(bench_timeout_s: float,
-                     force_dial: bool = False) -> bool | None:
+                     force_dial: bool = False) -> int | None:
     """The whole evidence suite. 2026-07-31 field evidence: healthy windows
     can be ~1 min and serve very few attachments, so the single-dial flash
     runs FIRST and banks sections incrementally; the full bench (mesh
     section + canonical artifact) and triage snapshot only spend further
     attachments when the flash proves the window is alive."""
-    rc = run_flash(3600.0, force_dial=force_dial)
+    # outer cap must exceed the SUM of the flash's internal section budgets
+    # (~2.3k s priority + ~1.7k s grid): a slow-but-progressing run through
+    # a high-RTT attachment is the internal watchdog's job to bound, and
+    # killing it early would misreport a near-complete capture as a wedge
+    rc = run_flash(6000.0, force_dial=force_dial)
     if rc == 4:
         return None  # legs closed before the dial: not an attempt at all
-    got_tpu = rc in (0, 2)
-    if got_tpu:
+    if rc in (0, 2):
         log("flash TPU capture secured (BENCH_TPU_LAST_GOOD.json merged)")
     if rc == 0 and relay_legs_listening():
         # window survived the whole flash: afford the full bench suite
         run_bench(bench_timeout_s)
         run_tool([sys.executable, "tools/tpu_triage.py", "--no-trace",
                   "--probe-s", "30"], 300.0, "triage snapshot")
-    return got_tpu
+    return rc
 
 
 def main() -> int:
@@ -218,20 +232,25 @@ def main() -> int:
             # nothing) — the flash capture's own attach is the probe.
             log(f"poll #{attempt}: relay legs LISTENING {legs} — "
                 f"firing capture pipeline")
-            got = capture_pipeline(args.bench_timeout)
-            if got is not None:  # None: legs closed pre-dial, keep polling
+            rc = capture_pipeline(args.bench_timeout)
+            if rc is not None:  # None: legs closed pre-dial, keep polling
                 last_attempt = time.time()
-                wait_min = args.recapture_min if got else args.retry_min
-                captured += bool(got)
+                # rc 2 (wedged mid-run, sections banked) takes the SHORT
+                # hold-off: the unmeasured sections should fire into the
+                # next window, not wait out the full recapture pause
+                wait_min = (args.recapture_min if rc == 0
+                            else args.retry_min)
+                captured += rc in (0, 2)
         elif probe(args.probe_timeout):
             # slow path: attachment healthy without any known leg open —
             # the relay's port set changed; capture anyway
             log(f"poll #{attempt}: HEALTHY without legs — firing pipeline")
-            got = capture_pipeline(args.bench_timeout, force_dial=True)
-            if got is not None:
+            rc = capture_pipeline(args.bench_timeout, force_dial=True)
+            if rc is not None:
                 last_attempt = time.time()
-                wait_min = args.recapture_min if got else args.retry_min
-                captured += bool(got)
+                wait_min = (args.recapture_min if rc == 0
+                            else args.retry_min)
+                captured += rc in (0, 2)
         else:
             # reached at most once per slow_n fast polls (~5 min default)
             log(f"poll #{attempt}: wedged (legs refused, slow probe hung)")
