@@ -486,7 +486,53 @@ def _bench_quant(params, x, seconds):
         # None = the kernel failed to lower and warmup fell back — a
         # recorded fact, distinct from "no effect"
         out["fused_tx_s"] = fused_rate
+        if fused_rate is not None:
+            out["preq_tx_s"] = _preq_hop_rate(qp, x, seconds)
     return out
+
+
+def _preq_hop_rate(qp, x, seconds):
+    """int8-at-the-edge wire variant: host normalize+rowquant, int8 rows
+    over the wire (34 B/row vs 120 f32), kernel starts at the first MXU
+    matmul. Same numpy-in/probas-out surface as _scorer_hop_rate so the
+    three quant numbers rank comparably; None on any kernel failure."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ccfd_tpu.ops import fused_mlp_q8 as fq
+
+    try:
+        kp = jax.device_put(fq.fold_for_kernel(qp))
+        sigma = np.asarray(qp["norm"]["sigma"], np.float32)
+        host_norm = {"mu": np.asarray(qp["norm"]["mu"], np.float32),
+                     "inv_sigma": 1.0 / np.where(sigma == 0.0, 1.0, sigma)}
+        x = np.asarray(x, np.float32)
+        # adapt the tile to the batch the way Scorer._fused_apply does —
+        # an off-tile CCFD_BENCH_BATCH must not read as a kernel failure
+        tile = min(x.shape[0], fq.DEFAULT_TILE)
+        while x.shape[0] % tile:
+            tile //= 2
+
+        def hop(xb):
+            q, s = fq.prequantize_rows_numpy(host_norm, xb)
+            return np.asarray(
+                fq.fused_mlp_q8_score_preq(
+                    kp, jnp.asarray(q), jnp.asarray(s), tile=tile
+                )
+            )
+
+        hop(x)  # compile + lowering check
+    except Exception as e:  # noqa: BLE001 - record WHY, don't crash the
+        # capture: a lowering failure and a config artifact must be
+        # distinguishable in the artifact
+        return f"error: {type(e).__name__}: {e}"[:200]
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        hop(x)
+        n += x.shape[0]
+    return round(n / (time.perf_counter() - t0), 1)
 
 
 def _arm_watchdog() -> None:

@@ -344,6 +344,37 @@ class Scorer:
             self.dispatch_timeouts += 1
             self._wedge.mark_wedged()
 
+    @staticmethod
+    def _is_lowering_error(e: Exception) -> bool:
+        """Compile/lowering failures are permanent for this (kernel,
+        backend) pair; runtime dispatch errors (attachment hiccups) are
+        not. Classified by message because jax surfaces both through
+        XlaRuntimeError."""
+        text = f"{type(e).__name__}: {e}"
+        return any(m in text for m in (
+            "Mosaic", "lowering", "Unsupported", "NotImplemented",
+            "UNIMPLEMENTED", "INVALID_ARGUMENT",
+        ))
+
+    def _disable_fused(self, e: Exception, where: str) -> None:
+        """Drop to the XLA graph. A lowering-class failure LATCHES fused
+        off for the Scorer's lifetime — swap_params re-folds on every
+        retrain publish, and folding is pure layout, so without the latch
+        the broken kernel would come right back. A transient runtime
+        error only disables until the next swap."""
+        import logging
+
+        latch = self._is_lowering_error(e)
+        logging.getLogger(__name__).warning(
+            "fused kernel failed at %s (%r); falling back to the XLA "
+            "path%s", where, e, " permanently" if latch else " until the "
+            "next params swap"
+        )
+        with self._lock:
+            self._fused_params = None
+            if latch:
+                self._fused_disabled = True
+
     def _warmup_body(self) -> None:
         while True:
             try:
@@ -379,20 +410,7 @@ class Scorer:
                 # loop so every bucket gets its XLA executable (buckets
                 # warmed fused-only before the failure would otherwise
                 # compile lazily on the first live request).
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "fused kernel failed at warmup (%r); "
-                    "falling back to the XLA path", e
-                )
-                with self._lock:
-                    self._fused_params = None
-                    # LATCH the disable: swap_params re-folds on every
-                    # retrain publish, and re-enabling a kernel that
-                    # cannot lower would crash the first post-retrain
-                    # request (layout-unfoldable trees, by contrast, may
-                    # re-enable on a later foldable tree)
-                    self._fused_disabled = True
+                self._disable_fused(e, where="warmup")
         # autotune refines an ARMED auto tier (provisional 256 until
         # measured); host_tier_rows == 0 means the auto policy resolved the
         # tier OFF (cpu backend / mesh) — host params may still exist for
@@ -555,10 +573,21 @@ class Scorer:
             if fused_params is not None:
                 # wire dtype per kernel: bf16 rows halve the bytes for the
                 # bf16 kernel (it computes bf16 either way); f32 for q8
-                out = self._fused_apply(
-                    fused_params,
-                    self._put_batch(chunk.astype(self._fused_in_dtype)),
-                )
+                # (copy=False: the f32->f32 case must not copy the batch)
+                try:
+                    out = self._fused_apply(
+                        fused_params,
+                        self._put_batch(
+                            chunk.astype(self._fused_in_dtype, copy=False)
+                        ),
+                    )
+                except Exception as e:  # noqa: BLE001 - first dispatch of a
+                    # swap-re-enabled kernel compiles HERE, not at warmup;
+                    # a lowering failure must degrade this request to the
+                    # XLA graph, not crash it
+                    self._disable_fused(e, where="dispatch")
+                    fused_params = None
+                    out = self._apply(params, self._put_batch(chunk))
             else:
                 out = self._apply(params, self._put_batch(chunk))
             pending.append((out, take))

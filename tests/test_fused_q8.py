@@ -49,6 +49,27 @@ def test_padded_features_contribute_nothing():
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+def test_preq_wire_path_matches_full_kernel_and_xla():
+    """int8-at-the-edge: host normalize+rowquant (the model's OWN first
+    requantization, moved across the wire) -> kernel starting at the first
+    MXU matmul. Bit-identical to both the full kernel and the XLA graph."""
+    qp, ds = _quantized_params(seed=5)
+    kp = fused_mlp_q8.fold_for_kernel(qp)
+    x = ds.X[:512]
+    q, s = fused_mlp_q8.prequantize_rows_numpy(kp, x)
+    assert q.dtype == np.int8 and q.shape == (512, 30)  # unpadded wire rows
+    assert s.shape == (512, 1)
+    out = np.asarray(fused_mlp_q8.fused_mlp_q8_score_preq(
+        kp, jnp.asarray(q), jnp.asarray(s), tile=256, interpret=True
+    ))
+    ref = np.asarray(quant.apply(qp, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    full = np.asarray(fused_mlp_q8.fused_mlp_q8_score(
+        kp, jnp.asarray(x), tile=256, interpret=True
+    ))
+    np.testing.assert_allclose(out, full, atol=1e-6)
+
+
 def test_fold_rejects_unquantized_or_wrong_depth_trees():
     params = mlp.init(jax.random.PRNGKey(0))
     params = mlp.set_normalizer(
@@ -105,3 +126,27 @@ def test_warmup_kernel_failure_falls_back_to_xla(monkeypatch):
     ref2 = Scorer(model_name="mlp_q8", params=qp2, batch_sizes=(64, 128),
                   use_fused=False).score(ds.X[:64])
     np.testing.assert_allclose(scorer.score(ds.X[:64]), ref2, atol=1e-6)
+
+
+def test_transient_warmup_failure_does_not_latch(monkeypatch):
+    """A non-lowering (attachment-hiccup-shaped) warmup error falls back
+    for availability but must NOT latch: the next retrain swap re-enables
+    the kernel."""
+    qp, ds = _quantized_params(seed=6)
+    scorer = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64,),
+                    use_fused=True)
+    real = scorer._fused_mod.fused_score
+
+    def flaky(*a, **k):
+        raise RuntimeError("socket closed mid-transfer (simulated)")
+
+    monkeypatch.setattr(scorer._fused_mod, "fused_score", flaky)
+    scorer.warmup()
+    assert not scorer.fused
+    monkeypatch.setattr(scorer._fused_mod, "fused_score", real)
+    qp2, _ = _quantized_params(seed=7)
+    scorer.swap_params(qp2)
+    assert scorer.fused  # transient failure: swap re-enables the kernel
+    ref = Scorer(model_name="mlp_q8", params=qp2, batch_sizes=(64,),
+                 use_fused=False).score(ds.X[:64])
+    np.testing.assert_allclose(scorer.score(ds.X[:64]), ref, atol=1e-5)

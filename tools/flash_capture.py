@@ -81,7 +81,10 @@ class Watchdog:
             if time.monotonic() > self._deadline:
                 try:
                     self._state["wedged_in_section"] = self._section
-                    self._flush()
+                    # best-effort flush, bounded: if the MAIN thread is the
+                    # one wedged inside a flush (holding the lock), waiting
+                    # on it would defeat the hard-exit guarantee
+                    self._flush(lock_timeout_s=10.0)
                 finally:
                     code = 2 if self._state.get("sections") else 3
                     os._exit(code)
@@ -148,9 +151,18 @@ def main() -> int:
                 json.dump(merged, f)
             os.replace(path + ".tmp", path)
 
-    def flush() -> None:
-        with flush_lock:
-            _flush_locked()
+    def flush(lock_timeout_s: float | None = None) -> None:
+        if lock_timeout_s is None:
+            with flush_lock:
+                _flush_locked()
+            return
+        # watchdog path: bounded acquire — a main thread wedged mid-flush
+        # holds the lock forever, and os._exit must still happen
+        if flush_lock.acquire(timeout=lock_timeout_s):
+            try:
+                _flush_locked()
+            finally:
+                flush_lock.release()
 
     dog = Watchdog(flush, state)
     bench = _load_bench()

@@ -130,6 +130,7 @@ def run_flash(timeout_s: float, force_dial: bool = False) -> int:
     argv = [sys.executable, "tools/flash_capture.py"]
     if force_dial:
         argv.append("--force-dial")
+    started = time.time()
     try:
         r = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout_s,
@@ -137,11 +138,15 @@ def run_flash(timeout_s: float, force_dial: bool = False) -> int:
         )
     except subprocess.TimeoutExpired:
         # the flash flushes after every section, so classify an outer
-        # timeout from the artifact instead of writing the window off
+        # timeout from the artifact instead of writing the window off —
+        # but only if THIS run wrote it: a stale file from an earlier
+        # window must not turn a total wedge into a "partial capture"
+        path = os.path.join(REPO, "FLASH_TPU_r04.json")
         try:
-            with open(os.path.join(REPO, "FLASH_TPU_r04.json")) as f:
+            fresh = os.path.getmtime(path) >= started
+            with open(path) as f:
                 snap = json.load(f)
-            if snap.get("platform") == "tpu" and snap.get("result"):
+            if fresh and snap.get("platform") == "tpu" and snap.get("result"):
                 log("flash exceeded outer watchdog with sections banked "
                     f"({sorted(snap.get('sections', {}))}) — partial")
                 return 2
