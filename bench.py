@@ -417,6 +417,11 @@ def _scorer_hop_rate(name, params, x, seconds, use_fused=False):
     while time.perf_counter() - t0 < seconds:
         s.score(x)
         n += x.shape[0]
+    if use_fused and not s.fused:
+        # the scorer degraded mid-loop (runtime fused failure): part of
+        # the window measured the XLA graph — same mislabel risk as the
+        # warmup check above
+        return None
     return round(n / (time.perf_counter() - t0), 1)
 
 
@@ -503,10 +508,11 @@ def _preq_hop_rate(qp, x, seconds):
     from ccfd_tpu.ops import fused_mlp_q8 as fq
 
     try:
-        kp = jax.device_put(fq.fold_for_kernel(qp))
-        sigma = np.asarray(qp["norm"]["sigma"], np.float32)
-        host_norm = {"mu": np.asarray(qp["norm"]["mu"], np.float32),
-                     "inv_sigma": 1.0 / np.where(sigma == 0.0, 1.0, sigma)}
+        folded = fq.fold_for_kernel(qp)
+        kp = jax.device_put(folded)
+        # host copies of the SAME folded normalizer the kernel uses — no
+        # second implementation of the zero-sigma guard to drift
+        host_norm = {k: np.asarray(folded[k]) for k in ("mu", "inv_sigma")}
         x = np.asarray(x, np.float32)
         # adapt the tile to the batch the way Scorer._fused_apply does —
         # an off-tile CCFD_BENCH_BATCH must not read as a kernel failure

@@ -123,10 +123,27 @@ def _kernel(x_ref, mu_ref, inv_ref, w1_ref, s1_ref, b1_ref,
     out_ref[:] = jax.nn.sigmoid(z * sx * s3_ref[:] + b3_ref[:])
 
 
-def _call_kernel(kernel_fn, lead_specs, lead_arrays, kernel_params,
+def _xmap(i):
+    return (i, 0)
+
+
+def _const2(i):
+    return (0, 0)
+
+
+def _const1(i):
+    return (0,)
+
+
+def _call_kernel(kernel_fn, lead_kinds, lead_arrays, kernel_params,
                  tile, interpret):
     """Shared pallas_call scaffolding for both q8 entry points: the lead
-    (batch-tiled) inputs differ, the 10 VMEM-resident weight specs do not."""
+    inputs differ, the 9 VMEM-resident weight specs do not.
+
+    ``lead_kinds``: one entry per lead array — ``("tiled", width)`` for a
+    batch-tiled (tile, width) block, ``("const", length)`` for a
+    grid-constant 1-D vector.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -134,36 +151,30 @@ def _call_kernel(kernel_fn, lead_specs, lead_arrays, kernel_params,
     if batch % tile != 0:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
     hidden = kernel_params["w2q"].shape[0]
-
-    def xmap(i):
-        return (i, 0)
-
-    def const2(i):
-        return (0, 0)
-
-    def const1(i):
-        return (0,)
-
     mem = pltpu.VMEM  # weights resident in VMEM for the whole grid
+    lead_specs = [
+        pl.BlockSpec((tile, dim), _xmap, memory_space=mem)
+        if kind == "tiled"
+        else pl.BlockSpec((dim,), _const1, memory_space=mem)
+        for kind, dim in lead_kinds
+    ]
     weight_specs = [
-        pl.BlockSpec((LANE, hidden), const2, memory_space=mem),
-        pl.BlockSpec((hidden,), const1, memory_space=mem),
-        pl.BlockSpec((hidden,), const1, memory_space=mem),
-        pl.BlockSpec((hidden, hidden), const2, memory_space=mem),
-        pl.BlockSpec((hidden,), const1, memory_space=mem),
-        pl.BlockSpec((hidden,), const1, memory_space=mem),
-        pl.BlockSpec((1, hidden), const2, memory_space=mem),
-        pl.BlockSpec((1,), const1, memory_space=mem),
-        pl.BlockSpec((1,), const1, memory_space=mem),
+        pl.BlockSpec((LANE, hidden), _const2, memory_space=mem),
+        pl.BlockSpec((hidden,), _const1, memory_space=mem),
+        pl.BlockSpec((hidden,), _const1, memory_space=mem),
+        pl.BlockSpec((hidden, hidden), _const2, memory_space=mem),
+        pl.BlockSpec((hidden,), _const1, memory_space=mem),
+        pl.BlockSpec((hidden,), _const1, memory_space=mem),
+        pl.BlockSpec((1, hidden), _const2, memory_space=mem),
+        pl.BlockSpec((1,), _const1, memory_space=mem),
+        pl.BlockSpec((1,), _const1, memory_space=mem),
     ]
     out = pl.pallas_call(
         kernel_fn,
         out_shape=jax.ShapeDtypeStruct((batch, 1), jnp.float32),
         grid=(batch // tile,),
-        in_specs=[
-            spec_fn(tile, xmap, const1, mem) for spec_fn in lead_specs
-        ] + weight_specs,
-        out_specs=pl.BlockSpec((tile, 1), xmap, memory_space=mem),
+        in_specs=lead_specs + weight_specs,
+        out_specs=pl.BlockSpec((tile, 1), _xmap, memory_space=mem),
         interpret=interpret,
     )(
         *lead_arrays,
@@ -190,21 +201,12 @@ def fused_mlp_q8_score(
     """(B, F<=128) rows -> (B,) float32 proba.  B must be a tile multiple.
     f32 rows are the contract (exact parity with the XLA q8 graph); other
     float dtypes are accepted and widened/rounded to f32 first."""
-    from jax.experimental import pallas as pl
-
     if x.dtype != jnp.bfloat16:
         x = x.astype(jnp.float32)
     x = pad_features(x)
-    lead_specs = [
-        lambda tile, xmap, const1, mem: pl.BlockSpec(
-            (tile, LANE), xmap, memory_space=mem),
-        lambda tile, xmap, const1, mem: pl.BlockSpec(
-            (LANE,), const1, memory_space=mem),
-        lambda tile, xmap, const1, mem: pl.BlockSpec(
-            (LANE,), const1, memory_space=mem),
-    ]
     return _call_kernel(
-        _kernel, lead_specs,
+        _kernel,
+        [("tiled", LANE), ("const", LANE), ("const", LANE)],
         (x, kernel_params["mu"], kernel_params["inv_sigma"]),
         kernel_params, tile, interpret,
     )
@@ -274,17 +276,10 @@ def fused_mlp_q8_score_preq(
     """((B, F<=128) int8 rows, (B, 1) f32 scales) -> (B,) float32 proba.
     Rows are padded to the lane width on DEVICE, so the H2D wire carries
     only F int8 bytes per row (34 B/row vs f32's 120 at F=30)."""
-    from jax.experimental import pallas as pl
-
     if q.dtype != jnp.int8:
         raise ValueError("q must be int8 rows (see prequantize_rows_numpy)")
     q = pad_features(q)
-    lead_specs = [
-        lambda tile, xmap, const1, mem: pl.BlockSpec(
-            (tile, LANE), xmap, memory_space=mem),
-        lambda tile, xmap, const1, mem: pl.BlockSpec(
-            (tile, 1), xmap, memory_space=mem),
-    ]
     return _call_kernel(
-        _kernel_preq, lead_specs, (q, s), kernel_params, tile, interpret,
+        _kernel_preq, [("tiled", LANE), ("tiled", 1)], (q, s),
+        kernel_params, tile, interpret,
     )

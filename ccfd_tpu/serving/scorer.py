@@ -354,6 +354,10 @@ class Scorer:
         return any(m in text for m in (
             "Mosaic", "lowering", "Unsupported", "NotImplemented",
             "UNIMPLEMENTED", "INVALID_ARGUMENT",
+            # a kernel that compiles but exceeds VMEM fails permanently
+            # for this (kernel, shape) pair — re-enabling on every swap
+            # would re-pay a failed compile inside the serving path
+            "RESOURCE_EXHAUSTED", "VMEM",
         ))
 
     def _disable_fused(self, e: Exception, where: str) -> None:
