@@ -416,12 +416,13 @@ def _scorer_hop_rate(name, params, x, seconds, use_fused=False):
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < seconds:
         s.score(x)
+        if use_fused and not s.fused:
+            # the scorer degraded mid-loop (runtime fused failure): the
+            # rest of the window would measure the XLA graph under a
+            # fused label — bail NOW and give the heal window's scarce
+            # seconds to the next section
+            return None
         n += x.shape[0]
-    if use_fused and not s.fused:
-        # the scorer degraded mid-loop (runtime fused failure): part of
-        # the window measured the XLA graph — same mislabel risk as the
-        # warmup check above
-        return None
     return round(n / (time.perf_counter() - t0), 1)
 
 
@@ -666,7 +667,13 @@ def main() -> None:
     _arm_watchdog()
     platform_forced = os.environ.get("CCFD_BENCH_PLATFORM", "")
     fellback = False
-    if not platform_forced:
+    if os.environ.get("CCFD_BENCH_SKIP_PROBE") == "1" and not platform_forced:
+        # caller (the watcher, right after a successful flash capture)
+        # already KNOWS the attachment is healthy; the probe subprocess
+        # would spend one of the window's scarce attachments for nothing.
+        # A wedge mid-run is still bounded by the bench watchdog.
+        pass
+    elif not platform_forced:
         ok = _probe_backend(
             float(os.environ.get("CCFD_BENCH_PROBE_S", "90")),
             int(os.environ.get("CCFD_BENCH_PROBE_ATTEMPTS", "5")),

@@ -354,10 +354,11 @@ class Scorer:
         return any(m in text for m in (
             "Mosaic", "lowering", "Unsupported", "NotImplemented",
             "UNIMPLEMENTED", "INVALID_ARGUMENT",
-            # a kernel that compiles but exceeds VMEM fails permanently
-            # for this (kernel, shape) pair — re-enabling on every swap
-            # would re-pay a failed compile inside the serving path
-            "RESOURCE_EXHAUSTED", "VMEM",
+            # exceeding VMEM is permanent for this (kernel, shape) pair —
+            # but generic RESOURCE_EXHAUSTED is NOT matched: that is also
+            # XLA's transient-HBM-pressure status, and latching on it
+            # would turn one recoverable OOM into a permanent downgrade
+            "VMEM",
         ))
 
     def _disable_fused(self, e: Exception, where: str) -> None:

@@ -1,0 +1,80 @@
+"""merge_last_good (tools/flash_capture.py): the flash capture's merge
+into BENCH_TPU_LAST_GOOD.json must refresh measured sections without
+destroying sections an older full capture measured — that file is the
+round's only on-TPU evidence when the tunnel is wedged at bench time."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_flash():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    spec = importlib.util.spec_from_file_location(
+        "flash_capture", os.path.join(REPO, "tools", "flash_capture.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _state(result, sections, ts="2026-07-31T10:00:00Z"):
+    return {"result": result, "sections": sections, "ts_flush": ts,
+            "platform": "tpu"}
+
+
+def test_merge_preserves_unmeasured_sections(tmp_path):
+    flash = _load_flash()
+    path = str(tmp_path / "last_good.json")
+    old = {"captured_at": "2026-07-30T05:00:00Z",
+           "result": {"value": 317674.1, "rest": {"tx_s": 19620.3},
+                      "pipeline": {"tx_s": 56122.7},
+                      "seq": {"histories_s": 293110.7}}}
+    with open(path, "w") as f:
+        json.dump(old, f)
+    flash.merge_last_good(path, _state(
+        {"value": 400000.0, "rest": {"tx_s": 60000.0, "p99_ms": 4.0}},
+        {"attach": 1.0, "scorer": 2.0, "rest_native": 8.0},
+    ))
+    with open(path) as f:
+        merged = json.load(f)
+    # refreshed sections take the new values...
+    assert merged["result"]["value"] == 400000.0
+    assert merged["result"]["rest"]["tx_s"] == 60000.0
+    # ...sections the flash did not reach survive from the old capture
+    assert merged["result"]["pipeline"]["tx_s"] == 56122.7
+    assert merged["result"]["seq"]["histories_s"] == 293110.7
+    assert merged["captured_at"] == "2026-07-31T10:00:00Z"
+    assert set(merged["flash_sections"]) == {"attach", "scorer",
+                                             "rest_native"}
+
+
+def test_merge_from_missing_or_corrupt_file_starts_clean(tmp_path):
+    flash = _load_flash()
+    path = str(tmp_path / "last_good.json")
+    flash.merge_last_good(path, _state({"value": 1.0}, {"scorer": 1.0}))
+    with open(path) as f:
+        assert json.load(f)["result"]["value"] == 1.0
+    with open(path, "w") as f:
+        f.write("{torn json")
+    flash.merge_last_good(path, _state({"value": 2.0}, {"scorer": 1.0}))
+    with open(path) as f:
+        assert json.load(f)["result"]["value"] == 2.0
+
+
+def test_repeated_flashes_accumulate_section_stamps(tmp_path):
+    flash = _load_flash()
+    path = str(tmp_path / "last_good.json")
+    flash.merge_last_good(path, _state(
+        {"zoo": {"gbt": 1}}, {"zoo": 1.0}, ts="2026-07-31T10:00:00Z"))
+    flash.merge_last_good(path, _state(
+        {"quant_int8": {"tx_s": 2}}, {"quant_int8": 2.0},
+        ts="2026-07-31T11:00:00Z"))
+    with open(path) as f:
+        merged = json.load(f)
+    assert merged["result"]["zoo"] == {"gbt": 1}
+    assert merged["result"]["quant_int8"] == {"tx_s": 2}
+    assert merged["flash_sections"]["zoo"] == "2026-07-31T10:00:00Z"
+    assert merged["flash_sections"]["quant_int8"] == "2026-07-31T11:00:00Z"

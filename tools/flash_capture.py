@@ -58,6 +58,29 @@ def _load_bench():
     return mod
 
 
+def merge_last_good(path: str, state: dict) -> None:
+    """Merge this flash's completed sections into the bench's last-good
+    artifact WITHOUT destroying sections an older full capture measured
+    and this flash did not reach (tested: tests/test_flash_merge.py)."""
+    merged: dict = {}
+    try:
+        with open(path) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        pass
+    result = merged.get("result", {})
+    result.update(state["result"])
+    merged["result"] = result
+    merged["captured_at"] = state["ts_flush"]
+    merged["flash_sections"] = {
+        **merged.get("flash_sections", {}),
+        **{k: state["ts_flush"] for k in state["sections"]},
+    }
+    with open(path + ".tmp", "w") as f:
+        json.dump(merged, f)
+    os.replace(path + ".tmp", path)
+
+
 class Watchdog:
     """Deadline the main thread bumps before each section.  On expiry the
     state flushed so far is final: write it and hard-exit — a wedged device
@@ -132,24 +155,9 @@ def main() -> int:
         # merge into the bench's last-good artifact so fallback bench runs
         # (and the round's BENCH_rNN.json) carry the freshest TPU evidence
         if state.get("platform") == "tpu" and state["result"]:
-            path = os.path.join(REPO, "BENCH_TPU_LAST_GOOD.json")
-            merged: dict = {}
-            try:
-                with open(path) as f:
-                    merged = json.load(f)
-            except (OSError, ValueError):
-                pass
-            result = merged.get("result", {})
-            result.update(state["result"])
-            merged["result"] = result
-            merged["captured_at"] = state["ts_flush"]
-            merged["flash_sections"] = {
-                **merged.get("flash_sections", {}),
-                **{k: state["ts_flush"] for k in state["sections"]},
-            }
-            with open(path + ".tmp", "w") as f:
-                json.dump(merged, f)
-            os.replace(path + ".tmp", path)
+            merge_last_good(
+                os.path.join(REPO, "BENCH_TPU_LAST_GOOD.json"), state
+            )
 
     def flush(lock_timeout_s: float | None = None) -> None:
         if lock_timeout_s is None:
