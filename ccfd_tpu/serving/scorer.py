@@ -351,15 +351,17 @@ class Scorer:
         not. Classified by message because jax surfaces both through
         XlaRuntimeError."""
         text = f"{type(e).__name__}: {e}"
-        return any(m in text for m in (
+        if any(m in text for m in (
             "Mosaic", "lowering", "Unsupported", "NotImplemented",
             "UNIMPLEMENTED", "INVALID_ARGUMENT",
-            # exceeding VMEM is permanent for this (kernel, shape) pair —
-            # but generic RESOURCE_EXHAUSTED is NOT matched: that is also
-            # XLA's transient-HBM-pressure status, and latching on it
-            # would turn one recoverable OOM into a permanent downgrade
-            "VMEM",
-        ))
+        )):
+            return True
+        # exceeding VMEM is permanent for this (kernel, shape) pair; the
+        # message spells it "vmem" or "VMEM" depending on the layer. Bare
+        # RESOURCE_EXHAUSTED without a vmem mention is NOT matched: that
+        # is also XLA's transient-HBM-pressure status, and latching on it
+        # would turn one recoverable OOM into a permanent downgrade.
+        return "vmem" in text.lower()
 
     def _disable_fused(self, e: Exception, where: str) -> None:
         """Drop to the XLA graph. A lowering-class failure LATCHES fused

@@ -6,18 +6,20 @@ round's only on-TPU evidence when the tunnel is wedged at bench time."""
 import importlib.util
 import json
 import os
-import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FLASH = None
 
 
 def _load_flash():
-    sys.path.insert(0, os.path.join(REPO, "tools"))
-    spec = importlib.util.spec_from_file_location(
-        "flash_capture", os.path.join(REPO, "tools", "flash_capture.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    global _FLASH
+    if _FLASH is None:
+        # flash_capture.py handles its own sys.path at module top
+        spec = importlib.util.spec_from_file_location(
+            "flash_capture", os.path.join(REPO, "tools", "flash_capture.py"))
+        _FLASH = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_FLASH)
+    return _FLASH
 
 
 def _state(result, sections, ts="2026-07-31T10:00:00Z"):

@@ -101,6 +101,24 @@ def test_scorer_fused_q8_matches_xla_scorer():
     )
 
 
+def test_mesh_sharded_fused_q8_matches_xla():
+    """The q8 kernel composes through the same shard_map data-axis path as
+    the bf16 kernel: row shards per device, replicated int8 weights."""
+    from ccfd_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    qp, ds = _quantized_params(seed=8)
+    mesh = make_mesh()
+    fused = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                   use_fused=True, mesh=mesh)
+    plain = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                   use_fused=False)
+    assert fused.fused
+    x = ds.X[:200]  # padded 256 bucket split over the data axis
+    np.testing.assert_allclose(fused.score(x), plain.score(x), atol=1e-5)
+
+
 def test_warmup_kernel_failure_falls_back_to_xla(monkeypatch):
     """A Mosaic lowering error at first call (only reproducible on real
     TPU) must degrade warmup to the XLA graph, not kill serving."""
