@@ -51,8 +51,9 @@ CCFD_BENCH_PIPELINE (in-flight dispatch depth, default 2),
 CCFD_BENCH_LATENCY_BATCH (default 4096), CCFD_BENCH_PLATFORM=cpu to force
 CPU, CCFD_BENCH_PROBE_S (per-attempt probe timeout, default 90),
 CCFD_BENCH_PROBE_ATTEMPTS (default 5), CCFD_BENCH_PROBE_BACKOFF_S (default
-45), CCFD_BENCH_REST_CLIENTS (default 8), CCFD_BENCH_REST_ROWS (rows per
-request, default 16),
+45), CCFD_BENCH_REST_CLIENTS (default 4), CCFD_BENCH_REST_ROWS (rows per
+request, default 128 - the sweep-measured best configuration,
+REST_SWEEP_r04_cpu.json; the sweep artifact carries the full grid),
 CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq,zoo,quant to skip
 sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
 a tunnel that wedges MID-run would otherwise hang the bench forever;
@@ -767,8 +768,8 @@ def main() -> None:
     if "rest" not in skip:
         rest = _bench_rest(
             params, lat_batch, max(2.0, seconds),
-            int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "8")),
-            int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
+            int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "4")),
+            int(os.environ.get("CCFD_BENCH_REST_ROWS", "128")),
         )
         _PARTIAL["rest"] = rest
         if rest.get("transport") == "NativeFront":
@@ -776,8 +777,8 @@ def main() -> None:
             # the native front's effect is a recorded number
             rest_python = _bench_rest(
                 params, lat_batch, max(2.0, seconds / 2),
-                int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "8")),
-                int(os.environ.get("CCFD_BENCH_REST_ROWS", "16")),
+                int(os.environ.get("CCFD_BENCH_REST_CLIENTS", "4")),
+                int(os.environ.get("CCFD_BENCH_REST_ROWS", "128")),
                 native=False,
             )
             _PARTIAL["rest_python_transport"] = rest_python

@@ -17,8 +17,9 @@ def _load_flash():
         # flash_capture.py handles its own sys.path at module top
         spec = importlib.util.spec_from_file_location(
             "flash_capture", os.path.join(REPO, "tools", "flash_capture.py"))
-        _FLASH = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(_FLASH)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _FLASH = mod  # cache only a fully-initialized module
     return _FLASH
 
 
