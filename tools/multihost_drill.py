@@ -201,9 +201,13 @@ def run_topology(n_processes: int, local_devices: int, model_parallel: int,
         ))
     reports = []
     errors = []
+    deadline = time.monotonic() + timeout_s  # ONE budget for the topology,
+    # not per child: the children run concurrently, and a hung coordinator
+    # hangs all of them — serial full-timeout waits would multiply the stall
     for p in procs:
         try:
-            out, err = p.communicate(timeout=timeout_s)
+            out, err = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
             p.kill()
             errors.append("timeout")
@@ -272,12 +276,29 @@ def main() -> int:
     ap.add_argument("--out", default=os.path.join(REPO, "MULTIHOST_r04.json"))
     args = ap.parse_args()
 
-    runs = []
+    # parse and validate EVERY topology before running any: a malformed
+    # later entry must not discard minutes of completed subprocess work,
+    # and a single-process "topology" would pass every check while
+    # proving nothing crosses a process boundary
+    topologies = []
     for topo in args.topologies.split(","):
-        n_proc, n_dev = (int(v) for v in topo.strip().split("x"))
+        try:
+            n_proc, n_dev = (int(v) for v in topo.strip().split("x"))
+        except ValueError:
+            ap.error(f"malformed topology {topo!r} (want PROCxDEV)")
+        if n_proc < 2:
+            ap.error(f"topology {topo!r}: this drill exists to prove "
+                     "cross-process behavior; need >= 2 processes")
+        if (n_proc * n_dev) % (2 * MODEL_PARALLEL):
+            ap.error(f"topology {topo!r}: global devices must divide the "
+                     f"(data={2}, model={MODEL_PARALLEL}) mesh")
+        topologies.append((n_proc, n_dev))
+
+    runs = []
+    for n_proc, n_dev in topologies:
         runs.append(run_topology(n_proc, n_dev, MODEL_PARALLEL,
                                  args.timeout))
-        print(json.dumps({"topology": topo,
+        print(json.dumps({"topology": f"{n_proc}x{n_dev}",
                           "ok": runs[-1]["ok"],
                           "errors": runs[-1]["errors"]}), flush=True)
     ok = all(r["ok"] for r in runs)
