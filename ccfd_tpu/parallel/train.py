@@ -100,6 +100,10 @@ def make_train_step(
             )
         return compiled["fn"](state, x, y)
 
+    # the inner jit is built on first call (shardings need a concrete
+    # state tree); exposing the cache lets tests lower the REAL compiled
+    # step and pin its HLO (e.g. the gradient all-reduce's presence)
+    wrapped._compiled = compiled  # type: ignore[attr-defined]
     return wrapped
 
 

@@ -100,3 +100,38 @@ def test_pipelined_bulk_scoring_on_mesh(ds, params):
     ref = _single(params).score_pipelined(ds.X[:3000], depth=1)
     assert out.shape == (3000,)
     np.testing.assert_allclose(ref, out, rtol=2e-2, atol=2e-3)
+
+
+def test_sharded_score_hlo_has_no_collectives(params):
+    """The serving contract at the COMPILER level: row-sharded batch in,
+    row-sharded probabilities out, replicated params — XLA must partition
+    the forward with ZERO communication ops. Any collective appearing here
+    means the sharding annotations regressed (e.g. an accidental
+    all-gather of probabilities onto one chip before D2H)."""
+    comm = ("all-reduce", "all-gather", "reduce-scatter",
+            "collective-permute", "all-to-all")
+    mesh = make_mesh()
+    s = _single(params, mesh=mesh, batch_sizes=(256,))
+    xb = s._put_batch(np.zeros((256, 30), np.float32))
+    hlo = s._apply.lower(s._params, xb).compile().as_text()
+    found = {op: hlo.count(op) for op in comm if op in hlo}
+    assert not found, f"serving forward grew collectives: {found}"
+
+
+def test_dp_train_step_hlo_has_gradient_allreduce(params):
+    """The dual contract: the data-parallel train step MUST communicate —
+    the gradient all-reduce is what makes per-process batches train one
+    global model (the drill proves it numerically; this pins it in HLO)."""
+    from ccfd_tpu.parallel.sharding import batch_spec, label_spec
+    from ccfd_tpu.parallel.train import (TrainConfig, init_state,
+                                         make_train_step)
+
+    mesh = make_mesh(model_parallel=1)
+    tc = TrainConfig()
+    state = init_state(params, tc)
+    step = make_train_step(tc, mesh)
+    x = jax.device_put(np.zeros((64, 30), np.float32), batch_spec(mesh))
+    y = jax.device_put(np.zeros((64,), np.float32), label_spec(mesh))
+    state, _ = step(state, x, y)  # builds the inner sharded jit
+    hlo = step._compiled["fn"].lower(state, x, y).compile().as_text()
+    assert "all-reduce" in hlo, "dp train step lost its gradient all-reduce"
