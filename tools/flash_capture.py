@@ -301,6 +301,25 @@ def main() -> int:
                          "p99_ms": round(p99, 3)}
         state["result"]["fused_ab"] = ab
 
+    def do_profile():
+        # a real device trace of the serving hop (TensorBoard-loadable):
+        # evidence of MXU occupancy / wire-vs-compute no throughput number
+        # can carry. Runs LAST of the priority sections — it risks nothing
+        # the earlier flushes haven't banked.
+        from ccfd_tpu.utils.tracing import Tracer
+
+        logdir = os.path.join(REPO, "profile_tpu_r04")
+        scorer = Scorer(model_name="mlp", params=params,
+                        batch_sizes=(batch,), compute_dtype="bfloat16")
+        scorer.warmup()
+        tracer = Tracer()
+        with tracer.profile(logdir):
+            for _ in range(5):
+                scorer.score_pipelined(ds.X[:batch], depth=2)
+        n_files = sum(len(fs) for _, _, fs in os.walk(logdir))
+        state["result"]["profile"] = {"logdir": os.path.basename(logdir),
+                                      "files": n_files}
+
     section("scorer", 300, do_scorer)
     section("zoo", 300, do_zoo)
     section("quant_int8", 240, do_quant)
@@ -310,6 +329,7 @@ def main() -> int:
     section("retrain", 240, do_retrain)
     section("pipeline", 300, do_pipeline)
     section("fused_ab", 240, do_fused_ab)
+    section("profile", 240, do_profile)
 
     errors = [k for k, v in state["sections"].items()
               if isinstance(v, str) and v.startswith("error")]
