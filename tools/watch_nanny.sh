@@ -1,0 +1,16 @@
+#!/bin/sh
+# Keep exactly one tpu_watch alive: the watcher is the round's only path
+# to an on-TPU capture, and an uncaught crash (or OOM kill on the 1-core
+# host) would otherwise silently forfeit every future heal window.
+# Usage: nohup sh tools/watch_nanny.sh > /dev/null 2>&1 &
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO" || exit 1
+while true; do
+    if ! pgrep -f "tpu_watch.py --fast" > /dev/null 2>&1; then
+        echo "[$(date -u +%H:%M:%S)] nanny: watcher dead - restarting" \
+            >> tpu_watch.log
+        nohup python tools/tpu_watch.py --fast-interval 10 --max-hours 11 \
+            > /dev/null 2>&1 &
+    fi
+    sleep 60
+done
