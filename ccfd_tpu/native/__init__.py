@@ -156,6 +156,20 @@ def _load():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
         ]
+        # newer symbol: a shipped pre-q8 .so loaded via the trust path
+        # (sources stripped) must degrade to "q8 pusher unavailable",
+        # not hard-fail every native entry point
+        if hasattr(lib, "ccfd_front_set_host_q8_model"):
+            lib.ccfd_front_set_host_q8_model.restype = None
+            lib.ccfd_front_set_host_q8_model.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ]
         lib.ccfd_front_set_host_trees.restype = None
         lib.ccfd_front_set_host_trees.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,

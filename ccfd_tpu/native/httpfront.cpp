@@ -121,6 +121,17 @@ struct HostModel {
   std::vector<std::vector<float>> w;     // w[l]: (dims[l+1] x dims[l]) row-major
   std::vector<std::vector<float>> b;     // b[l]: dims[l+1]
   std::vector<float> mu, inv_sigma;      // normalizer (identity if empty)
+  // int8-quantized variant (q8 = true): w holds the int8 weight VALUES
+  // widened to float (products and their <=256-term partial sums are
+  // integers below 2^24, exactly representable — the f32 SIMD dot IS the
+  // int32 accumulate, at full vector width), scale[l] the per-output
+  // dequant scales; activations requantize per row before every layer
+  // (same math as ops/quant.py apply_numpy, bit for bit)
+  bool q8 = false;
+  std::vector<std::vector<float>> scale;  // scale[l]: dims[l+1]
+  std::vector<float> sigma;  // q8 normalizes as (x-mu)/sigma — a DIVISION,
+  // because apply_numpy divides and multiply-by-reciprocal differs in the
+  // last ulp, which can flip a quantization step at a rounding boundary
   // ... or a boosted tree ensemble (n_trees > 0): complete binary trees
   // of depth tree_depth in heap layout, the same dense embedding the XLA
   // path uses (models/trees.py)
@@ -285,6 +296,85 @@ void dense_layer_tile(const float* __restrict W, const float* __restrict B,
   }
 }
 
+// Per-row symmetric int8 requantization over a transposed tile: amax
+// across the in_d lanes, s = max(amax/127, eps), q = clip(rint(h/s)).
+// rintf under the default FE_TONEAREST mode rounds half-to-even exactly
+// like np.rint, so the C++ tier reproduces ops/quant.py bit for bit.
+v16 rowquant_tile(v16* __restrict cur, int in_d) {
+  v16 amax = {};
+  for (int j = 0; j < in_d; ++j) {
+    const v16 a = cur[j] < 0.0f ? -cur[j] : cur[j];
+    amax = amax > a ? amax : a;
+  }
+  v16 s = amax / 127.0f;
+  const v16 eps = splat(1e-8f);
+  s = s > eps ? s : eps;
+  for (int j = 0; j < in_d; ++j) {
+    const v16 scaled = cur[j] / s;
+    float* lane = reinterpret_cast<float*>(cur + j);
+    const float* sl = reinterpret_cast<const float*>(&scaled);
+    for (int t = 0; t < kTile; ++t) {
+      float q = rintf(sl[t]);
+      q = q < -127.0f ? -127.0f : (q > 127.0f ? 127.0f : q);
+      lane[t] = q;
+    }
+  }
+  return s;
+}
+
+// One quantized dense layer on a tile: integer-exact f32 dot of the
+// (already row-quantized) activations against the int8-valued weights,
+// accumulated from ZERO, then dequant (acc * s_row) * scale_o + b_o
+// in exactly apply_numpy's multiplication order.
+void q8_dense_layer_tile(const float* __restrict W, const float* __restrict B,
+                         const float* __restrict S, const v16 s_row,
+                         const v16* __restrict in, v16* __restrict out,
+                         int in_d, int out_d, bool relu) {
+  const v16 zero = {};
+  for (int o = 0; o < out_d; ++o) {
+    const float* __restrict wr = W + static_cast<size_t>(o) * in_d;
+    v16 acc = {};
+    for (int j = 0; j < in_d; ++j) acc += wr[j] * in[j];
+    v16 r = (acc * s_row) * S[o] + splat(B[o]);
+    if (relu) r = r > zero ? r : zero;
+    out[o] = r;
+  }
+}
+
+void host_q8_score(const HostModel* m, const float* rows, int n_rows,
+                   int n_features, float* proba_out) {
+  int max_d = 0;
+  for (int d : m->dims) max_d = d > max_d ? d : max_d;
+  std::vector<v16> buf0(max_d), buf1(max_d);
+  for (int start = 0; start < n_rows; start += kTile) {
+    const int tr = n_rows - start < kTile ? n_rows - start : kTile;
+    v16* cur = buf0.data();
+    for (int j = 0; j < m->dims[0]; ++j) {
+      float* lane = reinterpret_cast<float*>(cur + j);
+      const float muj = m->mu.empty() ? 0.0f : m->mu[j];
+      const float sgj = m->sigma.empty() ? 1.0f : m->sigma[j];
+      for (int t = 0; t < tr; ++t)
+        lane[t] =
+            (rows[static_cast<size_t>(start + t) * n_features + j] - muj) /
+            sgj;
+      for (int t = tr; t < kTile; ++t) lane[t] = 0.0f;
+    }
+    v16* nxt = buf1.data();
+    for (int l = 0; l < m->n_layers; ++l) {
+      const v16 s_row = rowquant_tile(cur, m->dims[l]);
+      q8_dense_layer_tile(m->w[l].data(), m->b[l].data(),
+                          m->scale[l].data(), s_row, cur, nxt, m->dims[l],
+                          m->dims[l + 1], l != m->n_layers - 1);
+      v16* tmp = cur;
+      cur = nxt;
+      nxt = tmp;
+    }
+    const float* z = reinterpret_cast<const float*>(cur);
+    for (int t = 0; t < tr; ++t)
+      proba_out[start + t] = stable_sigmoid(z[t]);
+  }
+}
+
 // Boosted-ensemble eval: per row, every tree descends its D levels in a
 // tight scalar loop over tiny resident arrays (a 100-tree depth-4
 // ensemble is ~400 compare+index steps ≈ 1-2us/row — the gathers don't
@@ -315,6 +405,10 @@ void host_model_score(const HostModel* m, const float* rows, int n_rows,
                       int n_features, float* proba_out) {
   if (m->n_trees > 0) {
     host_trees_score(m, rows, n_rows, n_features, proba_out);
+    return;
+  }
+  if (m->q8) {
+    host_q8_score(m, rows, n_rows, n_features, proba_out);
     return;
   }
   int max_d = 0;
@@ -951,6 +1045,50 @@ void ccfd_front_set_host_model(void* h, int n_layers, const int* dims,
   install_host_model(f, m, max_rows, model_name, gauge_cols);
 }
 
+// Install/replace the int8-quantized in-front model (the q8 analog of
+// ccfd_front_set_host_model): weights holds the per-layer int8 weight
+// VALUES widened to float, (dims[l+1] x dims[l]) row-major concatenated;
+// scales the per-output dequant scales concatenated; mean/sigma the RAW
+// normalizer (the q8 path divides by sigma — see HostModel::sigma).
+// Scoring semantics are ops/quant.py apply_numpy, bit for bit.
+void ccfd_front_set_host_q8_model(void* h, int n_layers, const int* dims,
+                                  const float* weights, const float* scales,
+                                  const float* biases, const float* mean,
+                                  const float* sigma, int max_rows,
+                                  const char* model_name,
+                                  const int* gauge_cols) {
+  Front* f = static_cast<Front*>(h);
+  // integer-exactness bound: every partial sum must stay an integer below
+  // 2^24; 127*127*N < 2^24 requires layer widths N <= 1040. A wider model
+  // would silently lose the bit-parity contract — refuse the install
+  // (requests flow to the Python takers, whose int32 math has no bound).
+  bool exact = true;
+  for (int l = 0; l < n_layers; ++l)
+    if (dims[l] > 1040) exact = false;
+  HostModel* m = nullptr;
+  if (n_layers > 0 && max_rows > 0 && exact) {
+    m = new HostModel();
+    m->q8 = true;
+    m->n_layers = n_layers;
+    m->dims.assign(dims, dims + n_layers + 1);
+    size_t w_off = 0;
+    size_t b_off = 0;
+    for (int l = 0; l < n_layers; ++l) {
+      size_t w_n = static_cast<size_t>(m->dims[l]) * m->dims[l + 1];
+      m->w.emplace_back(weights + w_off, weights + w_off + w_n);
+      w_off += w_n;
+      m->b.emplace_back(biases + b_off, biases + b_off + m->dims[l + 1]);
+      m->scale.emplace_back(scales + b_off, scales + b_off + m->dims[l + 1]);
+      b_off += m->dims[l + 1];
+    }
+    if (mean != nullptr && sigma != nullptr) {
+      m->mu.assign(mean, mean + m->dims[0]);
+      m->sigma.assign(sigma, sigma + m->dims[0]);
+    }
+  }
+  install_host_model(f, m, max_rows, model_name, gauge_cols);
+}
+
 // Install/replace an in-front boosted-tree ensemble (the tree analog of
 // ccfd_front_set_host_model): feat/thr are (n_trees x 2^depth-1), leaf is
 // (n_trees x 2^depth), heap layout, identical semantics to the XLA
@@ -1072,6 +1210,10 @@ void ccfd_front_stats(void*, long* out4) {
 void ccfd_front_set_host_model(void*, int, const int*, const float*,
                                const float*, const float*, const float*, int,
                                const char*, const int*) {}
+void ccfd_front_set_host_q8_model(void*, int, const int*, const float*,
+                                  const float*, const float*, const float*,
+                                  const float*, int, const char*,
+                                  const int*) {}
 void ccfd_front_set_host_trees(void*, int, int, const int32_t*, const float*,
                                const float*, float, int, const char*,
                                const int*) {}

@@ -69,6 +69,36 @@ def extract_dense_model(spec_name: str, params) -> tuple | None:
     return None
 
 
+def extract_q8_model(params) -> tuple | None:
+    """Flatten int8-quantized MLP params (ops/quant.py layout) into the
+    C++ front's q8 layout: weights are the int8 VALUES widened to f32
+    (the front's f32 SIMD dot of <=2^24-magnitude integers IS the int32
+    accumulate), transposed (out x in) row-major and concatenated;
+    scales/biases per-output concatenated; mu/sigma RAW (the front
+    divides by sigma for bit parity with apply_numpy)."""
+    try:
+        layers = params["layers"]
+        if "wq" not in layers[0]:
+            return None
+        dims = [int(np.asarray(layers[0]["wq"]).shape[0])] + [
+            int(np.asarray(layer["wq"]).shape[1]) for layer in layers
+        ]
+        weights = np.concatenate(
+            [np.asarray(layer["wq"], np.float32).T.ravel() for layer in layers]
+        )
+        scales = np.concatenate(
+            [np.asarray(layer["scale"], np.float32).ravel() for layer in layers]
+        )
+        biases = np.concatenate(
+            [np.asarray(layer["b"], np.float32).ravel() for layer in layers]
+        )
+        mean = np.asarray(params["norm"]["mu"], np.float32)
+        sigma = np.asarray(params["norm"]["sigma"], np.float32)
+        return dims, weights, scales, biases, mean, sigma
+    except (KeyError, TypeError, IndexError, ValueError):
+        return None
+
+
 def extract_tree_model(params) -> tuple | None:
     """Flatten a tree-ensemble param tree (models/trees.py dense embedding)
     into the C++ front's layout: ``(n_trees, depth, feat, thr, leaf, base)``
@@ -230,6 +260,9 @@ class NativeFront:
         if spec_name == "gbt":
             extracted = extract_tree_model(host_params)
             pusher = self._push_host_trees_locked
+        elif spec_name == "mlp_q8":
+            extracted = extract_q8_model(host_params)
+            pusher = self._push_host_q8_locked
         else:
             extracted = extract_dense_model(spec_name, host_params)
             pusher = self._push_host_model_locked
@@ -285,6 +318,35 @@ class NativeFront:
             b.ctypes.data_as(fp),
             None if m is None else m.ctypes.data_as(fp),
             None if s is None else s.ctypes.data_as(fp),
+            self._inline_rows_cap(),
+            self._server.scorer.spec.name.encode(),
+            gcols,
+        )
+        self.host_model_active = True
+        return True
+
+    def _push_host_q8_locked(self, extracted) -> bool:
+        if not hasattr(self._lib, "ccfd_front_set_host_q8_model"):
+            return False  # pre-q8 shipped .so: requests flow to Python takers
+        dims, weights, scales, biases, mean, sigma = extracted
+        dims_c = (ctypes.c_int * len(dims))(*dims)
+        gcols = self._gauge_cols()
+        # locals keep the arrays alive across the ctypes call
+        w = np.ascontiguousarray(weights, np.float32)
+        sc = np.ascontiguousarray(scales, np.float32)
+        b = np.ascontiguousarray(biases, np.float32)
+        m = np.ascontiguousarray(mean, np.float32)
+        sg = np.ascontiguousarray(sigma, np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        self._lib.ccfd_front_set_host_q8_model(
+            self._handle,
+            len(dims) - 1,
+            dims_c,
+            w.ctypes.data_as(fp),
+            sc.ctypes.data_as(fp),
+            b.ctypes.data_as(fp),
+            m.ctypes.data_as(fp),
+            sg.ctypes.data_as(fp),
             self._inline_rows_cap(),
             self._server.scorer.spec.name.encode(),
             gcols,

@@ -1,0 +1,115 @@
+"""In-front C++ int8 scoring (httpfront.cpp host_q8_score): bit parity
+with ops/quant.py apply_numpy and the end-to-end native-front path for
+``mlp_q8`` — completing "in-IO-thread scoring on every backend" for the
+quantized model family."""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from ccfd_tpu import native
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import synthetic_dataset
+from ccfd_tpu.models import mlp
+from ccfd_tpu.ops import quant
+from ccfd_tpu.serving.native_front import extract_q8_model
+from ccfd_tpu.serving.scorer import Scorer
+from ccfd_tpu.serving.server import PredictionServer
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="needs the native toolchain"
+)
+
+
+def _qparams(seed=0):
+    ds = synthetic_dataset(n=1024, fraud_rate=0.1, seed=seed)
+    p = mlp.init(jax.random.PRNGKey(seed))
+    p = mlp.set_normalizer(p, ds.X.mean(0), ds.X.std(0))
+    return quant.quantize_mlp(p), ds
+
+
+def test_extract_q8_layout():
+    qp, _ = _qparams()
+    host = jax.tree.map(np.asarray, qp)
+    dims, w, sc, b, mu, sg = extract_q8_model(host)
+    assert dims == [30, 256, 256, 1]
+    assert w.shape == (30 * 256 + 256 * 256 + 256,)
+    assert sc.shape == b.shape == (256 + 256 + 1,)
+    # weights are exactly int8 values widened to float
+    assert np.all(w == np.rint(w)) and np.abs(w).max() <= 127
+    # f32 trees without "wq" are not q8-extractable
+    assert extract_q8_model({"layers": [{"w": np.zeros((30, 8))}]}) is None
+
+
+def test_front_q8_scores_small_requests_in_io_thread():
+    """Serve mlp_q8 through the native front: a host-tier-sized request is
+    scored by the C++ q8 path (host-scored counter moves) and matches the
+    quantized numpy forward to float-rounding precision."""
+    qp, ds = _qparams(seed=1)
+    scorer = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                    use_fused=False, host_tier_rows=256)
+    srv = PredictionServer(scorer, Config(dynamic_batching=True,
+                                          native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        front = srv._httpd
+        if type(front).__name__ != "NativeFront":
+            pytest.skip("native front unavailable")
+        assert front.host_model_active, "q8 model did not install in-front"
+        x = ds.X[:32]
+        payload = json.dumps({"data": {"ndarray": x.tolist()}}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v0.1/predictions", payload,
+            {"Content-Type": "application/json"})
+        body = json.load(urllib.request.urlopen(req, timeout=10))
+        proba = np.asarray(body["data"]["ndarray"], np.float64)[:, 1]
+        ref = quant.apply_numpy(jax.tree.map(np.asarray, qp), x)
+        np.testing.assert_allclose(proba, ref, atol=2e-6)
+        # the front, not the Python takers, scored it
+        counts = np.zeros((2, front._n_buckets), np.int64)
+        sums = np.zeros(2, np.float64)
+        gauges = np.zeros(3, np.float32)
+        import ctypes
+
+        n = front._lib.ccfd_front_host_stats(
+            front._handle,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            gauges.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            np.zeros(1, np.float64).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_double)),
+        )
+        assert n >= 1, "request did not score on the in-front q8 path"
+    finally:
+        srv.stop()
+
+
+def test_front_q8_parity_across_row_counts():
+    """Tile boundaries (16-row SIMD tiles): 1, 15, 16, 17, 33 rows all
+    match apply_numpy exactly through the served surface."""
+    qp, ds = _qparams(seed=2)
+    scorer = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64,),
+                    use_fused=False, host_tier_rows=64)
+    srv = PredictionServer(scorer, Config(dynamic_batching=True,
+                                          native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        if type(srv._httpd).__name__ != "NativeFront":
+            pytest.skip("native front unavailable")
+        host = jax.tree.map(np.asarray, qp)
+        for n in (1, 15, 16, 17, 33):
+            x = ds.X[:n]
+            payload = json.dumps({"data": {"ndarray": x.tolist()}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v0.1/predictions", payload,
+                {"Content-Type": "application/json"})
+            body = json.load(urllib.request.urlopen(req, timeout=10))
+            proba = np.asarray(body["data"]["ndarray"], np.float64)[:, 1]
+            np.testing.assert_allclose(
+                proba, quant.apply_numpy(host, x), atol=2e-6,
+                err_msg=f"n={n}")
+    finally:
+        srv.stop()

@@ -5,7 +5,11 @@
 # Usage: nohup sh tools/watch_nanny.sh > /dev/null 2>&1 &
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO" || exit 1
-while true; do
+# the nanny has its own deadline (default 11h, NANNY_HOURS overrides):
+# the watcher's --max-hours exit is intentional, and resurrecting it with
+# a fresh budget forever would run the watch indefinitely past the round
+END=$(( $(date +%s) + ${NANNY_HOURS:-11} * 3600 ))
+while [ "$(date +%s)" -lt "$END" ]; do
     if ! pgrep -f "tpu_watch.py --fast" > /dev/null 2>&1; then
         echo "[$(date -u +%H:%M:%S)] nanny: watcher dead - restarting" \
             >> tpu_watch.log
@@ -14,3 +18,4 @@ while true; do
     fi
     sleep 60
 done
+echo "[$(date -u +%H:%M:%S)] nanny: deadline reached, exiting" >> tpu_watch.log
