@@ -204,6 +204,71 @@ def _embed_tree(
     return f, t, leaves
 
 
+def from_sklearn_hgb(clf, max_embed_depth: int = 10) -> Params:
+    """Convert a fitted sklearn HistGradientBoostingClassifier (binary) —
+    the strongest reference-family model on the canonical table
+    (BASELINE.md AUC 0.9650) — into the dense complete-tree embedding.
+
+    Parity: raw_score(x) = baseline + sum_t tree_t(x); leaf values already
+    carry shrinkage, and "x <= num_threshold goes left" matches the
+    evaluator's ``x > thr`` right branch. The missing-value branch
+    (``missing_go_to_left``) is intentionally not embedded: this pipeline
+    zero-fills bad cells at decode (native/decode.cpp), so NaN never
+    reaches the scorer; categorical splits are rejected.
+
+    HGB grows leaf-count-bounded (default 31 leaves), possibly unbalanced,
+    so the complete-binary embedding is exponential in the DEEPEST path:
+    ``max_embed_depth`` refuses pathological trees (train with
+    ``max_depth<=10`` for servable models) instead of silently allocating
+    2^depth nodes per tree.
+    """
+    if getattr(clf, "n_trees_per_iteration_", 1) != 1:
+        raise ValueError("from_sklearn_hgb supports binary classifiers "
+                         "only (one tree per boosting iteration)")
+    predictors = [p[0] for p in clf._predictors]
+    adapters = []
+    max_depth_seen = 0
+    for pred in predictors:
+        nodes = pred.nodes
+        if np.any(nodes["is_categorical"]):
+            raise ValueError("categorical splits are not embeddable")
+        is_leaf = nodes["is_leaf"].astype(bool)
+        cl = np.where(is_leaf, -1, nodes["left"].astype(np.int64))
+        cr = np.where(is_leaf, -1, nodes["right"].astype(np.int64))
+        feat = nodes["feature_idx"].astype(np.int64)
+        thr = nodes["num_threshold"].astype(np.float64)
+        val = nodes["value"].astype(np.float64)
+
+        def depth_of(node=0, cl=cl, cr=cr):
+            if cl[node] == -1:
+                return 0
+            return 1 + max(depth_of(int(cl[node])), depth_of(int(cr[node])))
+
+        d = depth_of()
+        max_depth_seen = max(max_depth_seen, d)
+        adapters.append((cl, cr, feat, thr, val))
+    if max_depth_seen > max_embed_depth:
+        raise ValueError(
+            f"HGB tree depth {max_depth_seen} > {max_embed_depth}: the "
+            "dense embedding is 2^depth nodes/tree — retrain with "
+            "max_depth bounded (e.g. 6-8) for a servable model"
+        )
+    depth = max(max_depth_seen, 1)
+    fs, ts, ls = [], [], []
+    for cl, cr, feat, thr, val in adapters:
+        f, th, lv = _embed_tree(cl, cr, feat, thr, val, depth, scale=1.0)
+        fs.append(f)
+        ts.append(th)
+        ls.append(lv)
+    base = float(np.asarray(clf._baseline_prediction).reshape(()))
+    return {
+        "feature": jnp.asarray(np.stack(fs)),
+        "threshold": jnp.asarray(np.stack(ts)),
+        "leaf": jnp.asarray(np.stack(ls)),
+        "base": jnp.asarray(base, jnp.float32),
+    }
+
+
 def from_sklearn_gbt(clf) -> Params:
     """Convert a fitted sklearn GradientBoostingClassifier (binary).
 

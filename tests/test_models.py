@@ -176,3 +176,37 @@ def test_gbt_mxu_nonfinite_rows_match_gather_eval():
     za = np.asarray(trees.logits(p, x))
     zb = np.asarray(trees.logits_mxu(p, x))
     np.testing.assert_allclose(zb, za)
+
+
+def test_hgb_sklearn_parity_and_serving(dataset):
+    """HistGradientBoosting — the strongest reference-family model on the
+    canonical table — converts to the dense embedding at float precision
+    and serves through the same gbt Scorer path."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from ccfd_tpu.serving.scorer import Scorer
+
+    clf = HistGradientBoostingClassifier(
+        max_depth=5, max_iter=30, random_state=0
+    ).fit(dataset.X, dataset.y)
+    params = trees.from_sklearn_hgb(clf)
+    ours = np.asarray(trees.apply(params, jnp.asarray(dataset.X)))
+    ref = clf.predict_proba(dataset.X)[:, 1]
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    s = Scorer(model_name="gbt", params=params, batch_sizes=(64, 256),
+               use_fused=False)
+    np.testing.assert_allclose(
+        s.score(dataset.X[:100]), ref[:100], rtol=1e-4, atol=2e-5
+    )
+
+
+def test_hgb_depth_guard_refuses_pathological_trees(dataset):
+    """Unbounded-depth HGB trees would allocate 2^depth nodes per tree in
+    the dense embedding: the converter must refuse, not OOM."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    clf = HistGradientBoostingClassifier(
+        max_depth=4, max_iter=5, random_state=0
+    ).fit(dataset.X, dataset.y)
+    with pytest.raises(ValueError, match="retrain with"):
+        trees.from_sklearn_hgb(clf, max_embed_depth=3)
