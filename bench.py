@@ -458,13 +458,29 @@ def _bench_zoo(seconds, batch=16384):
         ),
         "base": gbt_params["base"],
     }
+    # the servable-HGB shape (HGB_SERVABLE_r04.json best: 44 trees x
+    # depth 8): the quality champion's serving cost, same randomization
+    hgb_like = trees.init_empty(n_trees=44, depth=8)
+    hgb_like = {
+        "feature": jax.numpy.asarray(
+            rng.integers(0, 30, hgb_like["feature"].shape), "int32"
+        ),
+        "threshold": jax.numpy.asarray(
+            rng.normal(size=hgb_like["threshold"].shape), "float32"
+        ),
+        "leaf": jax.numpy.asarray(
+            rng.normal(scale=0.05, size=hgb_like["leaf"].shape), "float32"
+        ),
+        "base": hgb_like["base"],
+    }
     out = {}
-    for name, params in (
-        ("logreg", logreg.fit_numpy(ds.X[:2048], ds.y[:2048])),
-        ("gbt", gbt_params),        # lockstep-descent gathers
-        ("gbt_mxu", gbt_params),    # gather-free one-hot-matmul eval
+    for name, model, params in (
+        ("logreg", "logreg", logreg.fit_numpy(ds.X[:2048], ds.y[:2048])),
+        ("gbt", "gbt", gbt_params),          # lockstep-descent gathers
+        ("gbt_mxu", "gbt_mxu", gbt_params),  # gather-free one-hot matmul
+        ("gbt_hgb_shape", "gbt", hgb_like),  # 44 trees x depth 8
     ):
-        out[name] = {"tx_s": _scorer_hop_rate(name, params, ds.X, seconds),
+        out[name] = {"tx_s": _scorer_hop_rate(model, params, ds.X, seconds),
                      "batch": batch}
     return out
 
