@@ -442,37 +442,30 @@ def _bench_zoo(seconds, batch=16384):
     from ccfd_tpu.models import logreg, trees
 
     ds = synthetic_dataset(n=batch, fraud_rate=0.01, seed=4)
-    gbt_params = trees.init_empty(n_trees=100, depth=4)
-    # randomized splits so gathers hit varied nodes (an all-inf threshold
-    # ensemble would descend one hot path and flatter the number)
     rng = np.random.default_rng(0)
-    gbt_params = {
-        "feature": jax.numpy.asarray(
-            rng.integers(0, 30, gbt_params["feature"].shape), "int32"
-        ),
-        "threshold": jax.numpy.asarray(
-            rng.normal(size=gbt_params["threshold"].shape), "float32"
-        ),
-        "leaf": jax.numpy.asarray(
-            rng.normal(scale=0.05, size=gbt_params["leaf"].shape), "float32"
-        ),
-        "base": gbt_params["base"],
-    }
+
+    def random_tree_params(n_trees, depth):
+        # randomized splits so gathers hit varied nodes (an all-inf
+        # threshold ensemble would descend one hot path and flatter the
+        # number)
+        skel = trees.init_empty(n_trees=n_trees, depth=depth)
+        return {
+            "feature": jax.numpy.asarray(
+                rng.integers(0, 30, skel["feature"].shape), "int32"
+            ),
+            "threshold": jax.numpy.asarray(
+                rng.normal(size=skel["threshold"].shape), "float32"
+            ),
+            "leaf": jax.numpy.asarray(
+                rng.normal(scale=0.05, size=skel["leaf"].shape), "float32"
+            ),
+            "base": skel["base"],
+        }
+
+    gbt_params = random_tree_params(100, 4)
     # the servable-HGB shape (HGB_SERVABLE_r04.json best: 44 trees x
-    # depth 8): the quality champion's serving cost, same randomization
-    hgb_like = trees.init_empty(n_trees=44, depth=8)
-    hgb_like = {
-        "feature": jax.numpy.asarray(
-            rng.integers(0, 30, hgb_like["feature"].shape), "int32"
-        ),
-        "threshold": jax.numpy.asarray(
-            rng.normal(size=hgb_like["threshold"].shape), "float32"
-        ),
-        "leaf": jax.numpy.asarray(
-            rng.normal(scale=0.05, size=hgb_like["leaf"].shape), "float32"
-        ),
-        "base": hgb_like["base"],
-    }
+    # depth 8): the quality champion's serving cost
+    hgb_like = random_tree_params(44, 8)
     out = {}
     for name, model, params in (
         ("logreg", "logreg", logreg.fit_numpy(ds.X[:2048], ds.y[:2048])),

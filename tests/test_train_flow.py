@@ -296,3 +296,42 @@ def test_cmd_tasks_investigator_workflow(capsys):
         assert main(["tasks", "--engine-url", "inproc://engine"]) == 2
     finally:
         srv.stop()
+
+
+def test_hgb_lifecycle(tmp_path, capsys, monkeypatch):
+    """train --family hgb -> npz params -> CCFD_MODEL=gbt restore serves
+    the EXACT converted ensemble (models/trees.py from_sklearn_hgb)."""
+    import os
+    from unittest import mock
+
+    import jax.numpy as jnp
+
+    from ccfd_tpu.cli import _restore_gbt_params, main
+    from ccfd_tpu.data.ccfd import load_dataset
+    from ccfd_tpu.models import trees
+
+    gbt_dir = str(tmp_path / "gbt")
+    monkeypatch.setenv("CCFD_SURROGATE_ROWS", "20000")  # lifecycle, not scale
+    rc = main(["train", "--family", "hgb", "--hgb-depth", "5",
+               "--gbt-dir", gbt_dir])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["family"] == "hgb" and out["max_depth"] == 5
+    assert out["conversion_max_prob_delta"] < 1e-6
+    assert 0.5 < out["auc_hgb_served"] <= 1.0
+
+    params = _restore_gbt_params(gbt_dir)
+    assert params is not None
+    assert np.asarray(params["feature"]).ndim == 3 or \
+        np.asarray(params["feature"]).ndim == 2
+    ds = load_dataset(n_synthetic=256)
+    p = np.asarray(trees.apply(params, jnp.asarray(ds.X)))
+    assert p.shape == (256,) and np.all((p >= 0) & (p <= 1))
+
+    # backfill scoring restores the SAME params through CCFD_MODEL=gbt
+    with mock.patch.dict(os.environ, {"CCFD_MODEL": "gbt"}):
+        rc = main(["score", "--gbt-dir", gbt_dir])
+    assert rc == 0
+
+    # a missing dir serves fresh init (None), never crashes
+    assert _restore_gbt_params(str(tmp_path / "missing")) is None
