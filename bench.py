@@ -524,15 +524,13 @@ def _preq_hop_rate(qp, x, seconds):
     try:
         folded = fq.fold_for_kernel(qp)
         kp = jax.device_put(folded)
-        # host copies of the SAME folded normalizer the kernel uses — no
-        # second implementation of the zero-sigma guard to drift
-        host_norm = {k: np.asarray(folded[k]) for k in ("mu", "inv_sigma")}
+        # host copies of the SAME folded normalizer the kernel uses
+        # (raw sigma; zero-sigma sanitization lives in set_normalizer)
+        host_norm = {k: np.asarray(folded[k]) for k in ("mu", "sigma")}
         x = np.asarray(x, np.float32)
-        # adapt the tile to the batch the way Scorer._fused_apply does —
-        # an off-tile CCFD_BENCH_BATCH must not read as a kernel failure
-        tile = min(x.shape[0], fq.DEFAULT_TILE)
-        while x.shape[0] % tile:
-            tile //= 2
+        # shared tiling policy — an off-tile CCFD_BENCH_BATCH must not
+        # read as a kernel failure
+        tile = fq.fit_tile(x.shape[0])
 
         def hop(xb):
             q, s = fq.prequantize_rows_numpy(host_norm, xb)

@@ -35,6 +35,16 @@ DEFAULT_TILE = 512
 INPUT_DTYPE = "bfloat16"  # wire format for rows: half the H2D bytes
 
 
+def fit_tile(rows: int) -> int:
+    """Largest power-of-two-ish tile <= DEFAULT_TILE dividing ``rows`` —
+    the ONE tiling policy every caller (both kernels' dispatch paths and
+    the bench) shares."""
+    tile = min(rows, DEFAULT_TILE)
+    while rows % tile:
+        tile //= 2
+    return tile
+
+
 def _pad_to(a: np.ndarray, rows: int) -> np.ndarray:
     pad = rows - a.shape[0]
     if pad <= 0:

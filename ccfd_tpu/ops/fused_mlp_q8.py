@@ -50,6 +50,7 @@ from ccfd_tpu.ops.fused_mlp import (  # noqa: E402
     DEFAULT_TILE,
     LANE,
     _pad_to as _pad_rows,
+    fit_tile,
     pad_features,
 )
 
@@ -62,17 +63,20 @@ def fold_for_kernel(params: Mapping[str, Any]) -> dict[str, jax.Array]:
 
     The normalizer CANNOT be folded into int8 weights the way the f32
     kernel folds it (per-input scaling would break the per-output-channel
-    quantization grid), so mu / 1/sigma ride along as f32 vectors and the
-    kernel normalizes explicitly.  Padded feature columns get
-    inv_sigma = 0, so padded features normalize to exactly 0 and the
-    zero-padded rows of w1q contribute exactly 0 to the accumulate.
+    quantization grid), so mu / sigma ride along as f32 vectors and the
+    kernel normalizes explicitly — as a DIVISION by raw sigma, exactly
+    like quant.logits: multiplying by a precomputed reciprocal differs in
+    the last ulp, which can flip a quantization step at a rounding
+    boundary (measured: up to 4e-3 prob delta on large-magnitude
+    normalizers).  Padded feature columns get mu = 0 / sigma = 1, so
+    padded features normalize to exactly 0 and the zero-padded rows of
+    w1q contribute exactly 0 to the accumulate.
     """
     layers = params["layers"]
     if len(layers) != 3 or "wq" not in layers[0]:
         raise KeyError("fused q8 kernel expects a 3-layer quantized MLP")
     mu = np.asarray(params["norm"]["mu"], np.float32)
     sigma = np.asarray(params["norm"]["sigma"], np.float32)
-    inv = 1.0 / np.where(sigma == 0.0, 1.0, sigma)
     n_feat = mu.shape[0]
     if n_feat > LANE:
         raise ValueError(f"{n_feat} features > lane width {LANE}")
@@ -84,7 +88,8 @@ def fold_for_kernel(params: Mapping[str, Any]) -> dict[str, jax.Array]:
     w3f = np.asarray(layers[2]["wq"], np.float32).reshape(1, -1)
     return {
         "mu": jnp.asarray(np.pad(mu, (0, LANE - n_feat))),
-        "inv_sigma": jnp.asarray(np.pad(inv, (0, LANE - n_feat))),
+        "sigma": jnp.asarray(np.pad(sigma, (0, LANE - n_feat),
+                                    constant_values=1.0)),
         "w1q": jnp.asarray(_pad_rows(w1q, LANE)),  # (128, H) int8
         "s1": jnp.asarray(np.asarray(layers[0]["scale"], np.float32)),
         "b1": jnp.asarray(np.asarray(layers[0]["b"], np.float32)),
@@ -105,10 +110,10 @@ def _rowquant(h: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, s
 
 
-def _kernel(x_ref, mu_ref, inv_ref, w1_ref, s1_ref, b1_ref,
+def _kernel(x_ref, mu_ref, sigma_ref, w1_ref, s1_ref, b1_ref,
             w2_ref, s2_ref, b2_ref, w3_ref, s3_ref, b3_ref, out_ref):
     x = x_ref[:].astype(jnp.float32)
-    h = (x - mu_ref[:]) * inv_ref[:]
+    h = (x - mu_ref[:]) / sigma_ref[:]
     # layer 1: int8 MXU matmul, int32 accumulate
     q, sx = _rowquant(h)
     acc = jnp.dot(q, w1_ref[:], preferred_element_type=jnp.int32)
@@ -207,7 +212,7 @@ def fused_mlp_q8_score(
     return _call_kernel(
         _kernel,
         [("tiled", LANE), ("const", LANE), ("const", LANE)],
-        (x, kernel_params["mu"], kernel_params["inv_sigma"]),
+        (x, kernel_params["mu"], kernel_params["sigma"]),
         kernel_params, tile, interpret,
     )
 
@@ -242,10 +247,11 @@ def prequantize_rows_numpy(
     to exactly 0 either way, so the scales are unaffected).
     """
     mu = np.asarray(kernel_params["mu"], np.float32)
-    inv = np.asarray(kernel_params["inv_sigma"], np.float32)
+    sigma = np.asarray(kernel_params["sigma"], np.float32)
     x = np.asarray(x, np.float32)
     n_feat = x.shape[1]
-    h = (x - mu[:n_feat]) * inv[:n_feat]
+    # DIVISION by raw sigma, exactly like quant.logits (see fold_for_kernel)
+    h = (x - mu[:n_feat]) / sigma[:n_feat]
     amax = np.max(np.abs(h), axis=1, keepdims=True)
     s = np.maximum(amax / 127.0, _EPS).astype(np.float32)
     q = np.clip(np.rint(h / s), -127, 127).astype(np.int8)

@@ -30,6 +30,7 @@ follows the latency plan in SURVEY.md §7 "hard parts":
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Sequence
 
@@ -136,6 +137,8 @@ class Scorer:
         # in reduced precision; params are re-folded on every swap so online
         # retrain keeps working. ``use_fused=False`` forces the XLA path.
         self._fused_params = None
+        self._preq_norm = None
+        self._preq_wire = False
         if use_fused is None:
             # auto only on real TPU: the CPU interpreter runs the same kernel
             # body but orders of magnitude slower (tests opt in explicitly).
@@ -243,13 +246,36 @@ class Scorer:
                 if fused_mod.INPUT_DTYPE == "bfloat16" else np.float32
             )
             try:
-                self._fused_params = self._put_fused(
-                    fused_mod.fold_for_kernel(self._params)
-                )
+                folded = fused_mod.fold_for_kernel(self._params)
+                self._fused_params = self._put_fused(folded)
+                self._preq_norm = self._preq_norm_of(folded)
             except (KeyError, TypeError, ValueError):
                 self._fused_params = None  # incompatible layout: XLA path
             self._fused_interpret = jax.default_backend() == "cpu"
             self._fused_sharded_cache: dict[int, Any] = {}
+            # int8 wire (q8 kernel, single device): on by default — the
+            # math is bit-identical and only the H2D bytes change;
+            # CCFD_Q8_WIRE=f32 opts out (e.g. when the serving host's CPU,
+            # not the wire, is the bottleneck). Mesh serving keeps the
+            # f32 wire: the preq arrays would need their own shard_map
+            # composition, unwarranted before an on-TPU number exists.
+            # static capability/env flag only: whether CURRENT params
+            # fold is the dynamic `preq_norm is not None` check at
+            # dispatch, so a later foldable swap re-enables the wire
+            self._preq_wire = (
+                hasattr(fused_mod, "prequantize_rows_numpy")
+                and os.environ.get("CCFD_Q8_WIRE", "int8") != "f32"
+            )
+
+    @staticmethod
+    def _preq_norm_of(folded: Any) -> dict | None:
+        """Host copies of the folded normalizer for the int8 wire's
+        host-side requantization — the SAME arrays the kernel normalizes
+        with, so there is no second zero-sigma guard to drift."""
+        if not isinstance(folded, dict) or "sigma" not in folded:
+            return None
+        return {"mu": np.asarray(folded["mu"]),
+                "sigma": np.asarray(folded["sigma"])}
 
     def _put_fused(self, folded: Any) -> Any:
         """Fused weights live whole in every chip's VMEM: replicate on mesh."""
@@ -267,14 +293,36 @@ class Scorer:
 
     def _fused_apply(self, fused_params: Any, x: jax.Array) -> jax.Array:
         rows = x.shape[0] if self.mesh is None else x.shape[0] // self._data_size
-        tile = min(rows, self._fused_mod.DEFAULT_TILE)
-        while rows % tile:  # largest power-of-two-ish divisor <= 512
-            tile //= 2
+        tile = self._fused_mod.fit_tile(rows)
         if self.mesh is None:
             return self._fused_mod.fused_score(
                 fused_params, x, tile=tile, interpret=self._fused_interpret
             )
         return self._fused_sharded(tile)(fused_params, x)
+
+    def _fused_dispatch(self, fused_params: Any, chunk: np.ndarray,
+                        preq_norm: Any = None) -> Any:
+        """Host chunk -> device probabilities through the active fused
+        path. The int8 WIRE mode (q8 kernel, single device): the host runs
+        the model's OWN first requantization (prequantize_rows_numpy) and
+        ships 34 B/row instead of 120 — bit-identical math, the H2D
+        transfer is what changes. Everything else ships rows in the
+        kernel's wire dtype (bf16 for the bf16 kernel, f32 for q8).
+        ``preq_norm`` must be snapshotted together with ``fused_params``
+        when a concurrent swap is possible."""
+        if preq_norm is None:
+            preq_norm = self._preq_norm
+        if self._preq_wire and preq_norm is not None and self.mesh is None:
+            q, s = self._fused_mod.prequantize_rows_numpy(preq_norm, chunk)
+            tile = self._fused_mod.fit_tile(q.shape[0])
+            return self._fused_mod.fused_mlp_q8_score_preq(
+                fused_params, jnp.asarray(q), jnp.asarray(s), tile=tile,
+                interpret=self._fused_interpret,
+            )
+        return self._fused_apply(
+            fused_params,
+            self._put_batch(chunk.astype(self._fused_in_dtype, copy=False)),
+        )
 
     def _fused_sharded(self, tile: int) -> Any:
         """SPMD composition of the single-chip Pallas kernel: ``shard_map``
@@ -387,13 +435,13 @@ class Scorer:
             try:
                 for b in self.batch_sizes:
                     if self._fused_params is not None:
+                        # through _fused_dispatch so the SERVING wire path
+                        # (incl. the q8 int8 wire) is what compiles here
                         jax.block_until_ready(
-                            self._fused_apply(
+                            self._fused_dispatch(
                                 self._fused_params,
-                                self._put_batch(
-                                    np.zeros((b, self.num_features),
-                                             self._fused_in_dtype)
-                                ),
+                                np.zeros((b, self.num_features),
+                                         np.float32),
                             )
                         )
                     else:
@@ -451,8 +499,8 @@ class Scorer:
             fused = self._fused_params
             host_params = self._host_params
         if fused is not None:
-            xb = np.zeros((b, self.num_features), self._fused_in_dtype)
-            dispatch = lambda: self._fused_apply(fused, self._put_batch(xb))  # noqa: E731
+            xb = np.zeros((b, self.num_features), np.float32)
+            dispatch = lambda: self._fused_dispatch(fused, xb)  # noqa: E731
         else:
             xf = np.zeros((b, self.num_features), np.float32)
             dispatch = lambda: self._apply(params, self._put_batch(xf))  # noqa: E731
@@ -504,10 +552,13 @@ class Scorer:
         if (getattr(self, "_fused_mod", None) is not None
                 and not getattr(self, "_fused_disabled", False)):
             try:
-                staged_fused = self._put_fused(self._fused_mod.fold_for_kernel(staged))
+                folded = self._fused_mod.fold_for_kernel(staged)
+                staged_fused = self._put_fused(folded)
+                staged_preq_norm = self._preq_norm_of(folded)
                 jax.block_until_ready(staged_fused)
             except (KeyError, TypeError, ValueError):
                 staged_fused = None  # incompatible layout: drop to XLA path
+                staged_preq_norm = None
         staged_host = None
         if self._host_params is not None:
             staged_host = jax.tree.map(_host_cast, new_params)
@@ -516,6 +567,10 @@ class Scorer:
             # never keep serving stale fused weights: an unfoldable tree
             # disables the fused path rather than pinning the old params
             self._fused_params = staged_fused
+            if staged_fused is not None:
+                # the int8 wire quantizes against the CURRENT normalizer;
+                # a stale one would ship rows quantized on the old grid
+                self._preq_norm = staged_preq_norm
             if staged_host is not None:
                 self._host_params = staged_host
             listeners = list(self._swap_listeners)
@@ -565,6 +620,9 @@ class Scorer:
         with self._lock:
             params = self._params
             fused_params = self._fused_params
+            preq_norm = self._preq_norm  # same snapshot as the weights: a
+            # concurrent swap must not pair a new quantization grid with
+            # the old kernel weights
         largest = self.batch_sizes[-1]
         pending: list[tuple[jax.Array, int]] = []
         chunks: list[np.ndarray] = []
@@ -578,16 +636,9 @@ class Scorer:
                     [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
                 )
             if fused_params is not None:
-                # wire dtype per kernel: bf16 rows halve the bytes for the
-                # bf16 kernel (it computes bf16 either way); f32 for q8
-                # (copy=False: the f32->f32 case must not copy the batch)
                 try:
-                    out = self._fused_apply(
-                        fused_params,
-                        self._put_batch(
-                            chunk.astype(self._fused_in_dtype, copy=False)
-                        ),
-                    )
+                    out = self._fused_dispatch(fused_params, chunk,
+                                               preq_norm)
                 except Exception as e:  # noqa: BLE001 - first dispatch of a
                     # swap-re-enabled kernel compiles HERE, not at warmup;
                     # a lowering failure must degrade this request to the

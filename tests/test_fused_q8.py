@@ -33,14 +33,37 @@ def test_kernel_matches_xla_q8_graph_exactly():
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+def test_kernel_parity_survives_large_magnitude_normalizers():
+    """Regression: normalizing with a reciprocal MULTIPLY instead of the
+    XLA graph's division differs in the last ulp and flipped quantization
+    steps on large-magnitude normalizers (measured 4e-3 prob delta). The
+    kernel, the preq host path, and the C++ tier all DIVIDE now."""
+    ds = synthetic_dataset(n=512, fraud_rate=0.1, seed=12)
+    p = mlp.init(jax.random.PRNGKey(12))
+    # Time-column-like scale: huge mu, doubled sigma
+    p = mlp.set_normalizer(p, ds.X.mean(0) + 3.0, ds.X.std(0) * 2.0)
+    qp = quant.quantize_mlp(p)
+    kp = fused_mlp_q8.fold_for_kernel(qp)
+    x = ds.X[:256]
+    ref = np.asarray(quant.apply(qp, jnp.asarray(x)))
+    full = np.asarray(fused_mlp_q8.fused_mlp_q8_score(
+        kp, jnp.asarray(x), tile=256, interpret=True))
+    np.testing.assert_allclose(full, ref, atol=1e-5)
+    q, s = fused_mlp_q8.prequantize_rows_numpy(kp, x)
+    preq = np.asarray(fused_mlp_q8.fused_mlp_q8_score_preq(
+        kp, jnp.asarray(q), jnp.asarray(s), tile=256, interpret=True))
+    np.testing.assert_allclose(preq, ref, atol=1e-5)
+
+
 def test_padded_features_contribute_nothing():
     """Zero-padded feature columns (30 -> 128) must not shift any
-    probability: inv_sigma = 0 in padding makes them normalize to 0, and
+    probability: mu=0 / sigma=1 in padding makes them normalize to 0, and
     w1q's padded rows are 0."""
     qp, ds = _quantized_params(seed=1)
     kp = fused_mlp_q8.fold_for_kernel(qp)
     assert int(np.asarray(kp["w1q"])[30:].max()) == 0
-    assert float(np.asarray(kp["inv_sigma"])[30:].max()) == 0.0
+    assert np.all(np.asarray(kp["sigma"])[30:] == 1.0)
+    assert np.all(np.asarray(kp["mu"])[30:] == 0.0)
     x = jnp.asarray(ds.X[:256])
     ref = np.asarray(quant.apply(qp, x))
     out = np.asarray(
@@ -101,6 +124,47 @@ def test_scorer_fused_q8_matches_xla_scorer():
     )
 
 
+def test_preq_wire_is_the_default_serving_path(monkeypatch):
+    """The int8 wire is the q8 fused scorer's default: _fused_dispatch
+    ships int8 rows + per-row scales, and the probabilities stay identical
+    to the XLA graph. CCFD_Q8_WIRE=f32 opts out."""
+    monkeypatch.delenv("CCFD_Q8_WIRE", raising=False)
+    qp, ds = _quantized_params(seed=9)
+    fused = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                   use_fused=True)
+    assert fused._preq_wire
+    plain = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 256),
+                   use_fused=False)
+    x = ds.X[:100]
+    np.testing.assert_allclose(fused.score(x), plain.score(x), atol=1e-5)
+
+    monkeypatch.setenv("CCFD_Q8_WIRE", "f32")
+    f32wire = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64,),
+                     use_fused=True)
+    assert not f32wire._preq_wire
+    np.testing.assert_allclose(f32wire.score(ds.X[:64]),
+                               plain.score(ds.X[:64]), atol=1e-5)
+
+
+def test_preq_wire_swap_refreshes_quantization_grid():
+    """A retrain swap must re-pair the host-side quantization grid with
+    the new kernel weights — quantizing on the OLD normalizer against new
+    weights would corrupt every score."""
+    qp, ds = _quantized_params(seed=10)
+    scorer = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64,),
+                    use_fused=True)
+    assert scorer._preq_wire
+    # new params with a DIFFERENT normalizer (shifted mu, scaled sigma)
+    ds2 = synthetic_dataset(n=1024, fraud_rate=0.1, seed=11)
+    p2 = mlp.init(jax.random.PRNGKey(11))
+    p2 = mlp.set_normalizer(p2, ds2.X.mean(0) + 3.0, ds2.X.std(0) * 2.0)
+    qp2 = quant.quantize_mlp(p2)
+    scorer.swap_params(qp2)
+    ref = Scorer(model_name="mlp_q8", params=qp2, batch_sizes=(64,),
+                 use_fused=False).score(ds.X[:64])
+    np.testing.assert_allclose(scorer.score(ds.X[:64]), ref, atol=1e-5)
+
+
 def test_mesh_sharded_fused_q8_matches_xla():
     """The q8 kernel composes through the same shard_map data-axis path as
     the bf16 kernel: row shards per device, replicated int8 weights."""
@@ -130,7 +194,10 @@ def test_warmup_kernel_failure_falls_back_to_xla(monkeypatch):
     def boom(*a, **k):
         raise RuntimeError("Mosaic lowering failed (simulated)")
 
+    # patch BOTH device entry points: the q8 scorer serves through the
+    # int8-wire path (fused_mlp_q8_score_preq) by default
     monkeypatch.setattr(scorer._fused_mod, "fused_score", boom)
+    monkeypatch.setattr(scorer._fused_mod, "fused_mlp_q8_score_preq", boom)
     scorer.warmup()  # must not raise
     assert not scorer.fused
     ref = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64, 128),
@@ -154,14 +221,18 @@ def test_transient_warmup_failure_does_not_latch(monkeypatch):
     scorer = Scorer(model_name="mlp_q8", params=qp, batch_sizes=(64,),
                     use_fused=True)
     real = scorer._fused_mod.fused_score
+    real_preq = scorer._fused_mod.fused_mlp_q8_score_preq
 
     def flaky(*a, **k):
         raise RuntimeError("socket closed mid-transfer (simulated)")
 
     monkeypatch.setattr(scorer._fused_mod, "fused_score", flaky)
+    monkeypatch.setattr(scorer._fused_mod, "fused_mlp_q8_score_preq", flaky)
     scorer.warmup()
     assert not scorer.fused
     monkeypatch.setattr(scorer._fused_mod, "fused_score", real)
+    monkeypatch.setattr(scorer._fused_mod, "fused_mlp_q8_score_preq",
+                        real_preq)
     qp2, _ = _quantized_params(seed=7)
     scorer.swap_params(qp2)
     assert scorer.fused  # transient failure: swap re-enables the kernel
