@@ -1077,6 +1077,10 @@ def cmd_doctor(args: argparse.Namespace) -> int:
         except Exception:  # noqa: BLE001 - unreadable dir reads as absent
             step = None
         report[label] = {"dir": d, "latest_step": step}
+    report["gbt"] = {
+        "dir": _GBT_DIR,
+        "present": os.path.exists(os.path.join(_GBT_DIR, "params.npz")),
+    }
 
     # --- config in effect -------------------------------------------------
     report["config"] = {
