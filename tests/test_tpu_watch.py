@@ -1,0 +1,112 @@
+"""Watcher rc-handling (tools/tpu_watch.py): the heal-window machinery's
+classification logic — what counts as a capture, what re-fires fast, and
+when a stale artifact must NOT be read as fresh evidence. These paths
+only run for real during a relay heal, which historically lasts ~1
+minute; unit tests are the only way they stay correct between heals."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WATCH = None
+
+
+def _load_watch():
+    global _WATCH
+    if _WATCH is None:
+        spec = importlib.util.spec_from_file_location(
+            "tpu_watch", os.path.join(REPO, "tools", "tpu_watch.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _WATCH = mod
+    return _WATCH
+
+
+class _Result:
+    def __init__(self, rc, stdout="", stderr=""):
+        self.returncode = rc
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def test_run_flash_passes_through_exit_codes(monkeypatch, tmp_path):
+    w = _load_watch()
+    monkeypatch.setattr(w, "LOG", str(tmp_path / "log"))
+    for rc in (0, 2, 3, 4, 5):
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda *a, rc=rc, **k: _Result(rc, stdout='{"x": 1}\n'))
+        assert w.run_flash(10.0) == rc
+
+
+def test_run_flash_timeout_classifies_fresh_partial(monkeypatch, tmp_path):
+    """Outer timeout + a flash artifact written BY THIS RUN => rc 2
+    (sections banked); a stale artifact from an earlier window => rc 3."""
+    w = _load_watch()
+    monkeypatch.setattr(w, "LOG", str(tmp_path / "log"))
+    art = os.path.join(w.REPO, "FLASH_TPU_r04.json")
+    existed = os.path.exists(art)
+    backup = open(art, "rb").read() if existed else None
+
+    def boom_writing(*a, **k):
+        # the real flash flushes the artifact DURING the run — write it
+        # inside the mocked subprocess so its mtime postdates run start
+        with open(art, "w") as f:
+            json.dump({"platform": "tpu", "result": {"value": 1.0},
+                       "sections": {"scorer": 1.0}}, f)
+        raise subprocess.TimeoutExpired(cmd="flash", timeout=1)
+
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="flash", timeout=1)
+
+    try:
+        monkeypatch.setattr(subprocess, "run", boom_writing)
+        assert w.run_flash(10.0) == 2
+        monkeypatch.setattr(subprocess, "run", boom)
+        # stale artifact (mtime before run start): a total wedge must not
+        # read yesterday's sections as today's evidence
+        old = time.time() - 3600
+        os.utime(art, (old, old))
+        assert w.run_flash(10.0) == 3
+        # corrupt artifact: wedge
+        with open(art, "w") as f:
+            f.write("{torn")
+        assert w.run_flash(10.0) == 3
+    finally:
+        if backup is not None:
+            with open(art, "wb") as f:
+                f.write(backup)
+        elif os.path.exists(art):
+            os.remove(art)
+
+
+def test_capture_pipeline_rc_mapping(monkeypatch, tmp_path):
+    """rc 4 (legs closed pre-dial) => None (not an attempt, no hold-off);
+    rc 0 => full bench follow-up only if legs still listen; rc 2/3/5 pass
+    through with no follow-up."""
+    w = _load_watch()
+    monkeypatch.setattr(w, "LOG", str(tmp_path / "log"))
+    fired = []
+    monkeypatch.setattr(w, "run_bench", lambda *a: fired.append("bench"))
+    monkeypatch.setattr(w, "run_tool", lambda *a, **k: fired.append("tool"))
+
+    monkeypatch.setattr(w, "run_flash", lambda *a, **k: 4)
+    assert w.capture_pipeline(10.0) is None
+    assert fired == []
+
+    monkeypatch.setattr(w, "run_flash", lambda *a, **k: 2)
+    assert w.capture_pipeline(10.0) == 2
+    assert fired == []  # partial window: don't spend more attachments
+
+    monkeypatch.setattr(w, "run_flash", lambda *a, **k: 0)
+    monkeypatch.setattr(w, "relay_legs_listening", lambda *a, **k: [8083])
+    assert w.capture_pipeline(10.0) == 0
+    assert fired == ["bench", "tool"]  # window proven: full suite fires
+
+    fired.clear()
+    monkeypatch.setattr(w, "relay_legs_listening", lambda *a, **k: [])
+    assert w.capture_pipeline(10.0) == 0
+    assert fired == []  # window closed right after the flash: stop
