@@ -388,3 +388,38 @@ def test_extract_dense_model_shapes():
     assert b.shape[0] == sum(dims[1:])
     assert mean.shape == (30,) and inv_std.shape == (30,)
     assert extract_dense_model("trees", {"whatever": 1}) is None
+
+
+def test_hgb_depth8_through_native_front():
+    """The servable-HGB shape (unbalanced depth-8 trees, dead internal
+    slots in the dense embedding) through the C++ front's tree kernel ==
+    sklearn's own predict_proba."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from ccfd_tpu.models import trees
+
+    ds = synthetic_dataset(n=1500, fraud_rate=0.15, seed=7)
+    clf = HistGradientBoostingClassifier(
+        max_depth=8, max_iter=25, random_state=0
+    ).fit(ds.X, ds.y)
+    params = trees.from_sklearn_hgb(clf)
+    scorer = Scorer(
+        model_name="gbt", params=params, batch_sizes=(16, 128),
+        host_tier_rows=64,
+    )
+    scorer.warmup()
+    srv = PredictionServer(scorer, Config(native_front=True))
+    port = srv.start(host="127.0.0.1", port=0)
+    try:
+        front = srv._httpd
+        if not isinstance(front, NativeFront):
+            pytest.skip("native front unavailable")
+        assert front.host_model_active
+        status, out = _post_rows(port, ds.X[:48].astype(float).tolist())
+        assert status == 200
+        got = np.asarray(out["data"]["ndarray"], np.float64)[:, 1]
+        np.testing.assert_allclose(
+            got, clf.predict_proba(ds.X[:48])[:, 1], atol=1e-4
+        )
+    finally:
+        srv.stop()
