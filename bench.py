@@ -494,16 +494,25 @@ def _bench_quant(params, x, seconds):
         "dtype": "int8",
     }
     if jax.default_backend() == "tpu":
-        # A/B the fused int8 Pallas kernel (ops/fused_mlp_q8.py) against
-        # the XLA q8 graph above — identical probabilities by contract, so
-        # the delta is pure kernel effect (VMEM-resident weights, no
-        # inter-layer HBM round trips). TPU-only: the CPU interpreter is
-        # orders of magnitude slower and would record noise.
-        fused_rate = _scorer_hop_rate(
-            "mlp_q8", qp, x, seconds, use_fused=True
-        )
-        # None = the kernel failed to lower and warmup fell back — a
-        # recorded fact, distinct from "no effect"
+        # Three-way ablation, each isolating ONE effect:
+        #   tx_s       — XLA q8 graph, f32 wire
+        #   fused_tx_s — Pallas kernel, f32 wire (kernel effect alone;
+        #                CCFD_Q8_WIRE=f32 pins the wire because the int8
+        #                wire is the scorer's default now)
+        #   preq_tx_s  — Pallas kernel + int8 wire (the serving default)
+        # TPU-only: the CPU interpreter would record noise. None/error =
+        # the kernel failed to lower, distinct from "no effect".
+        prev = os.environ.get("CCFD_Q8_WIRE")
+        os.environ["CCFD_Q8_WIRE"] = "f32"
+        try:
+            fused_rate = _scorer_hop_rate(
+                "mlp_q8", qp, x, seconds, use_fused=True
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("CCFD_Q8_WIRE", None)
+            else:
+                os.environ["CCFD_Q8_WIRE"] = prev
         out["fused_tx_s"] = fused_rate
         if fused_rate is not None:
             out["preq_tx_s"] = _preq_hop_rate(qp, x, seconds)
