@@ -55,7 +55,10 @@ class Record(NamedTuple):
 class _Topic:
     def __init__(self, name: str, n_partitions: int):
         self.name = name
-        self.partitions: list[list[Record]] = [[] for _ in range(n_partitions)]
+        # plain 6-tuples in Record field order, NOT Record instances —
+        # exact tuples untrack from gen-2 GC (see Record's GC note);
+        # consumer-facing APIs rebuild Record views at poll time
+        self.partitions: list[list[tuple]] = [[] for _ in range(n_partitions)]
         self._rr = itertools.count()
 
     @property
@@ -186,26 +189,19 @@ class Broker:
                         f"({t.n_partitions} partitions)"
                     )
                 part = partition
-            rec = Record(
-                topic=topic,
-                partition=part,
-                offset=len(t.partitions[part]),
-                key=key,
-                value=value,
-                timestamp=time.time(),
-            )
-            payload = None
+            now = time.time()
+            item = (topic, part, len(t.partitions[part]), key, value, now)
             if self._log is not None:
                 # encode BEFORE the in-memory append: an unencodable record
                 # must fail cleanly, not leave memory and disk diverged
                 from ccfd_tpu.bus.log import encode_entry
 
-                payload = encode_entry(key, rec.timestamp, value)
-            t.partitions[part].append(tuple(rec))  # exact tuple: GC-untrackable
+                payload = encode_entry(key, now, value)
+            t.partitions[part].append(item)  # exact tuple: GC-untrackable
             if self._log is not None:
                 self._log.append_payload(topic, part, payload)
             self._data_ready.notify_all()
-            return rec
+            return Record._make(item)
 
     def produce_batch(
         self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
