@@ -47,7 +47,7 @@ def test_run_flash_timeout_classifies_fresh_partial(monkeypatch, tmp_path):
     (sections banked); a stale artifact from an earlier window => rc 3."""
     w = _load_watch()
     monkeypatch.setattr(w, "LOG", str(tmp_path / "log"))
-    art = os.path.join(w.REPO, "FLASH_TPU_r04.json")
+    art = w.FLASH_OUT  # the shared constant run_flash itself classifies from
     existed = os.path.exists(art)
     backup = open(art, "rb").read() if existed else None
 
@@ -110,3 +110,37 @@ def test_capture_pipeline_rc_mapping(monkeypatch, tmp_path):
     monkeypatch.setattr(w, "relay_legs_listening", lambda *a, **k: [])
     assert w.capture_pipeline(10.0) == 0
     assert fired == []  # window closed right after the flash: stop
+
+
+def test_availability_timeline_counters_and_windows(tmp_path):
+    """VERDICT r4 item 8: the availability artifact must be a poll
+    statistic — events (capture fired/done) append samples but must not
+    skew open_fraction — and open windows get exact open/close stamps."""
+    w = _load_watch()
+    path = str(tmp_path / "avail.json")
+    tl = w.AvailabilityTimeline(path, heartbeat_every=3)
+    tl.record([])            # poll 1: closed (heartbeat sample)
+    tl.record([])            # poll 2
+    tl.record([8083])        # poll 3: OPEN -> transition sample + window
+    tl.note("capture_fired", [8083])   # event: no counter bump
+    tl.note("capture_done rc=0", [])   # event: no counter bump
+    tl.record([])            # poll 4: CLOSED -> window closed
+    doc = json.load(open(path))
+    assert doc["poll_count"] == 4
+    assert doc["open_poll_count"] == 1
+    assert doc["open_fraction"] == 0.25
+    assert len(doc["open_windows"]) == 1
+    win = doc["open_windows"][0]
+    assert win["legs"] == [8083] and "opened" in win and "closed" in win
+    events = [s["event"] for s in doc["samples"] if "event" in s]
+    assert events == ["capture_fired", "capture_done rc=0"]
+
+
+def test_availability_heartbeat_every_one_samples_every_poll(tmp_path):
+    w = _load_watch()
+    path = str(tmp_path / "avail.json")
+    tl = w.AvailabilityTimeline(path, heartbeat_every=1)
+    for _ in range(5):
+        tl.record([])
+    doc = json.load(open(path))
+    assert len(doc["samples"]) == 5

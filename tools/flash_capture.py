@@ -49,6 +49,11 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 from tpu_triage import legs_listening  # noqa: E402
 
+# single source of truth for this round's flash artifact: tpu_watch.py's
+# outer-timeout classifier reads the same file this runner flushes, and a
+# drifted copy there would misreport banked partial captures as wedges
+DEFAULT_OUT = os.path.join(REPO, "FLASH_TPU_r05.json")
+
 
 def _load_bench():
     spec = importlib.util.spec_from_file_location(
@@ -115,7 +120,7 @@ class Watchdog:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=os.path.join(REPO, "FLASH_TPU_r04.json"))
+    ap.add_argument("--out", default=DEFAULT_OUT)
     ap.add_argument("--rest-seconds", type=float, default=6.0)
     ap.add_argument("--seconds", type=float, default=2.0,
                     help="measured window for non-REST sections")
@@ -308,7 +313,7 @@ def main() -> int:
         # the earlier flushes haven't banked.
         from ccfd_tpu.utils.tracing import Tracer
 
-        logdir = os.path.join(REPO, "profile_tpu_r04")
+        logdir = os.path.join(REPO, "profile_tpu_r05")
         scorer = Scorer(model_name="mlp", params=params,
                         batch_sizes=(batch,), compute_dtype="bfloat16")
         scorer.warmup()

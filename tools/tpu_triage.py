@@ -5,7 +5,7 @@ dials a local relay (pool-service legs on 127.0.0.1:{8083,8093,8103,8113},
 discovered by the connect-trace stage below).  When the relay dies,
 ``jax.devices()`` retries those dials forever — the "wedge" every round has
 fought.  This tool answers, stage by stage, *where* the attachment fails
-right now, and writes the evidence to ``TPU_TRIAGE_r04.json``:
+right now, and writes the evidence to ``TPU_TRIAGE_r05.json``:
 
   1. listeners      — every TCP LISTEN socket in this netns (what's alive)
   2. pool_ports     — per-port verdict for the relay's pool-service legs
@@ -324,7 +324,7 @@ def run_triage(probe_s: float = 45.0, trace: bool = True) -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=os.path.join(REPO, "TPU_TRIAGE_r04.json"))
+    ap.add_argument("--out", default=os.path.join(REPO, "TPU_TRIAGE_r05.json"))
     ap.add_argument("--probe-s", type=float, default=45.0)
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the LD_PRELOAD connect audit stage")

@@ -48,6 +48,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 # here would have the watcher and the flash capture disagreeing on what
 # 'window open' means
 from tpu_triage import POOL_PORTS, legs_listening as relay_legs_listening  # noqa: E402
+from flash_capture import DEFAULT_OUT as FLASH_OUT  # noqa: E402
 
 
 def log(msg: str) -> None:
@@ -55,6 +56,82 @@ def log(msg: str) -> None:
     print(line, flush=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
+
+
+class AvailabilityTimeline:
+    """Round-long relay-leg availability record (VERDICT r4 item 8).
+
+    Round 4 ended with one anecdote: the single observed heal coincided
+    with a fresh builder session starting, and the window died ~8 minutes
+    later.  This turns the watcher's existing fast polls into data: every
+    sample updates counters, and transitions (closed<->open) are always
+    persisted along with a heartbeat every ``heartbeat_every`` samples,
+    so the round ends with an artifact that supports or refutes the
+    session-start correlation instead of folklore.  Downsampling keeps
+    the file small (~150 heartbeats over 12 h at the default cadence)
+    while open windows are recorded exactly, with start/end timestamps.
+    """
+
+    def __init__(self, path: str, heartbeat_every: int = 30):
+        self.path = path
+        self.heartbeat_every = max(int(heartbeat_every), 1)
+        self.started = time.time()
+        self.n = 0
+        self.n_open = 0
+        self.last_open: bool | None = None
+        self.samples: list[dict] = []
+        self.windows: list[dict] = []   # one per observed open window
+
+    @staticmethod
+    def _iso(ts: float) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+    def record(self, legs: list[int]) -> None:
+        """One fast-poll sample: updates the availability counters and the
+        open-window ledger.  Only the loop's regular polls come here so
+        open_fraction stays a poll statistic (events don't skew it)."""
+        now = time.time()
+        self.n += 1
+        is_open = bool(legs)
+        if is_open:
+            self.n_open += 1
+        transition = self.last_open is None or self.last_open != is_open
+        if transition and is_open:
+            self.windows.append({"opened": self._iso(now), "legs": legs})
+        if transition and not is_open and self.windows \
+                and "closed" not in self.windows[-1]:
+            self.windows[-1]["closed"] = self._iso(now)
+        self.last_open = is_open
+        if transition or (self.n - 1) % self.heartbeat_every == 0:
+            self.samples.append({"t": self._iso(now), "legs": legs})
+            self.flush()
+
+    def note(self, event: str, legs: list[int]) -> None:
+        """Timestamped event sample (capture fired/done, budget end) —
+        appended without touching the poll counters or window ledger."""
+        self.samples.append({"t": self._iso(time.time()), "legs": legs,
+                             "event": event})
+        self.flush()
+
+    def flush(self) -> None:
+        doc = {
+            "watcher_started": self._iso(self.started),
+            "written": self._iso(time.time()),
+            "poll_count": self.n,
+            "open_poll_count": self.n_open,
+            "open_fraction": round(self.n_open / max(self.n, 1), 5),
+            "open_windows": self.windows,
+            "note": "transitions always recorded; heartbeat every "
+                    f"{self.heartbeat_every} fast polls",
+            "samples": self.samples,
+        }
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self.path)
+        except OSError as e:  # pragma: no cover - disk-full etc.
+            log(f"availability flush failed: {e!r}")
 
 
 def probe(timeout_s: float) -> bool:
@@ -144,7 +221,7 @@ def run_flash(timeout_s: float, force_dial: bool = False) -> int:
         # timeout from the artifact instead of writing the window off —
         # but only if THIS run wrote it: a stale file from an earlier
         # window must not turn a total wedge into a "partial capture"
-        path = os.path.join(REPO, "FLASH_TPU_r04.json")
+        path = FLASH_OUT
         try:
             fresh = os.path.getmtime(path) >= started
             with open(path) as f:
@@ -213,11 +290,13 @@ def main() -> int:
     captured = 0
     last_attempt = 0.0   # any pipeline firing
     wait_min = 0.0       # minutes to hold off since last_attempt
-    log(f"watch v2 started (fast={args.fast_interval}s, "
+    avail = AvailabilityTimeline(os.path.join(REPO, "TPU_AVAILABILITY_r05.json"))
+    log(f"watch v3 started (fast={args.fast_interval}s, "
         f"budget={args.max_hours}h, legs={POOL_PORTS})")
     while time.time() < deadline:
         attempt += 1
         legs = relay_legs_listening()
+        avail.record(legs)
         slow_n = max(int(args.slow_every), 1)
         go_slow = (attempt - 1) % slow_n == 0
         if not legs and not go_slow:
@@ -240,7 +319,9 @@ def main() -> int:
             # nothing) — the flash capture's own attach is the probe.
             log(f"poll #{attempt}: relay legs LISTENING {legs} — "
                 f"firing capture pipeline")
+            avail.note("capture_fired", legs)
             rc = capture_pipeline(args.bench_timeout)
+            avail.note(f"capture_done rc={rc}", relay_legs_listening())
             if rc is not None:  # None: legs closed pre-dial, keep polling
                 last_attempt = time.time()
                 # rc 2 (wedged mid-run, sections banked) takes the SHORT
@@ -253,7 +334,9 @@ def main() -> int:
             # slow path: attachment healthy without any known leg open —
             # the relay's port set changed; capture anyway
             log(f"poll #{attempt}: HEALTHY without legs — firing pipeline")
+            avail.note("probe_healthy_no_legs capture_fired", legs)
             rc = capture_pipeline(args.bench_timeout, force_dial=True)
+            avail.note(f"capture_done rc={rc}", relay_legs_listening())
             if rc is not None:
                 last_attempt = time.time()
                 wait_min = (args.recapture_min if rc == 0
@@ -263,6 +346,7 @@ def main() -> int:
             # reached at most once per slow_n fast polls (~5 min default)
             log(f"poll #{attempt}: wedged (legs refused, slow probe hung)")
         time.sleep(args.fast_interval)
+    avail.note("budget_exhausted", relay_legs_listening())
     log(f"budget exhausted; captures this run: {captured}")
     return 0 if captured else 3
 
