@@ -478,6 +478,178 @@ def _bench_zoo(seconds, batch=16384):
     return out
 
 
+def _median_time(fn, k=5):
+    """Median wall time of k calls — the timing primitive the roofline
+    split and the seq-pipeline split share."""
+    ts = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _bench_roofline(scorer, params, X, lat_batch, headline_tx_s,
+                    rest, quant):
+    """Roofline accounting (VERDICT r4 items 4/5): turn "wire-bound" from
+    an assertion into numbers.  Records the model's FLOP/row, each measured
+    section's achieved FLOP/s and wire bytes/s against the relevant peaks
+    (MXU bf16/int8, HBM, and a *measured* H2D link bandwidth), plus a
+    host-prep / H2D / device-compute time split for one serving batch — the
+    denominators the batch-size and wire-format decisions (f32 vs bf16 vs
+    int8 rows) have been made without.
+
+    The north star (BASELINE.json) names a v5e-1; published peaks for that
+    chip are used when the attached device reports a v5e kind and carried
+    as "assumed" otherwise.  On the CPU fallback the peaks are null and the
+    H2D figure is host memcpy — labeled, still useful as the split's
+    denominator."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    flop_per_row = int(sum(
+        2 * int(np.asarray(l["w"]).shape[0]) * int(np.asarray(l["w"]).shape[1])
+        for l in params["layers"]
+    ) + 2 * int(np.asarray(params["norm"]["mu"]).shape[0]))
+
+    backend = jax.default_backend()
+    kind = getattr(jax.devices()[0], "device_kind", backend)
+    # published per-chip peaks (dense): bf16 GFLOP/s, int8 GOP/s, HBM GB/s
+    peak_table = {
+        "v5e": (197_000.0, 394_000.0, 819.0),
+        "v5 lite": (197_000.0, 394_000.0, 819.0),
+        "v5p": (459_000.0, 918_000.0, 2765.0),
+        "v4": (275_000.0, 275_000.0, 1228.0),
+        "v3": (123_000.0, 123_000.0, 900.0),
+    }
+    peaks = None
+    peaks_assumed = False
+    if backend == "tpu":
+        for tag, (bf16, int8, hbm) in peak_table.items():
+            if tag in str(kind).lower():
+                peaks = {"mxu_bf16_gflop_s": bf16, "mxu_int8_gop_s": int8,
+                         "hbm_gb_s": hbm}
+                break
+        if peaks is None:  # tunnel may report an opaque kind: assume the
+            peaks_assumed = True  # north star's chip rather than nothing
+            bf16, int8, hbm = peak_table["v5e"]
+            peaks = {"mxu_bf16_gflop_s": bf16, "mxu_int8_gop_s": int8,
+                     "hbm_gb_s": hbm}
+
+    # measured H2D link: one bulk transfer for bandwidth, one small for
+    # per-dispatch overhead (through a tunneled attachment the fixed cost
+    # dominates small batches — that IS the host-tier policy's regime)
+    def _h2d_s(nbytes):
+        arr = np.zeros(nbytes // 4, np.float32)
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(arr))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    bulk_bytes = 32 * 1024 * 1024
+    small_bytes = 256 * 1024
+    h2d_bulk_s = _h2d_s(bulk_bytes)
+    h2d = {
+        "mb_s_measured": round(bulk_bytes / h2d_bulk_s / 1e6, 1),
+        "dispatch_ms_small": round(_h2d_s(small_bytes) * 1e3, 3),
+        "bulk_mib": 32,
+    }
+
+    # host-prep / H2D / device-compute split for one latency batch through
+    # the live scorer's own wire dtype and apply fn
+    use_fused = bool(scorer.fused and scorer._fused_params is not None)
+    wire_dtype = np.dtype(scorer._fused_in_dtype) if use_fused \
+        else np.dtype(np.float32)
+    n_feat = int(np.asarray(params["norm"]["mu"]).shape[0])
+    bytes_per_row = n_feat * wire_dtype.itemsize
+    chunk = np.ascontiguousarray(X[:lat_batch], np.float32)
+
+    prep = lambda: chunk.astype(wire_dtype)  # noqa: E731
+    wired = chunk.astype(wire_dtype)
+    put = lambda: jax.block_until_ready(jax.device_put(wired))  # noqa: E731
+    xdev = jax.device_put(wired)
+    jax.block_until_ready(xdev)
+    apply_fn = scorer._fused_apply if use_fused else scorer._apply
+    wparams = scorer._fused_params if use_fused else scorer._params
+    jax.block_until_ready(apply_fn(wparams, xdev))  # compile outside timing
+    compute = lambda: jax.block_until_ready(apply_fn(wparams, xdev))  # noqa: E731
+    split = {
+        "batch": lat_batch,
+        "host_prep_ms": round(_median_time(prep, k=7) * 1e3, 3),
+        "h2d_ms": round(_median_time(put, k=7) * 1e3, 3),
+        "device_compute_ms": round(_median_time(compute, k=7) * 1e3, 3),
+    }
+
+    def section(tx_s, row_bytes, int8_math=False):
+        if tx_s is None:
+            return None
+        out = {
+            "tx_s": round(tx_s, 1),
+            "bytes_per_row": row_bytes,
+            "achieved_gflop_s": round(tx_s * flop_per_row / 1e9, 2),
+            "wire_mb_s": round(tx_s * row_bytes / 1e6, 2),
+        }
+        out["h2d_link_util_pct"] = round(
+            100.0 * out["wire_mb_s"] / max(h2d["mb_s_measured"], 1e-9), 2)
+        if peaks:
+            peak = peaks["mxu_int8_gop_s"] if int8_math \
+                else peaks["mxu_bf16_gflop_s"]
+            out["mfu_pct"] = round(100.0 * out["achieved_gflop_s"] / peak, 4)
+            out["hbm_util_pct"] = round(
+                100.0 * out["wire_mb_s"] / 1e3 / peaks["hbm_gb_s"], 4)
+        return out
+
+    sections = {}
+    if headline_tx_s:
+        sections["scorer_hop"] = section(headline_tx_s, bytes_per_row)
+    if isinstance(rest, dict) and "tx_s" in rest:
+        # REST rows land as JSON text host-side; the H2D wire is still the
+        # scorer's dtype — host decode cost shows in the split, not here
+        sections["rest"] = section(rest["tx_s"], bytes_per_row)
+    if isinstance(quant, dict):
+        q_tx = quant.get("preq_tx_s") or quant.get("tx_s")
+        if q_tx:
+            # int8 wire: n_feat int8 + one f32 scale per row
+            sections["quant_int8_wire"] = section(
+                q_tx, n_feat + 4, int8_math=True)
+
+    head = sections.get("scorer_hop") or next(
+        (s for s in sections.values() if s), None)
+    if head is None:
+        bound = "unmeasured"
+        head = {}
+    else:
+        utils = {"h2d_wire": head["h2d_link_util_pct"]}
+        if peaks:
+            utils["mxu"] = head.get("mfu_pct", 0.0)
+            utils["hbm"] = head.get("hbm_util_pct", 0.0)
+        # the bound is whichever resource the headline hop uses the
+        # largest fraction of; "host" when nothing device-side is >1%
+        # busy — the time goes to host prep/dispatch, which the split
+        # quantifies
+        bound = max(utils, key=lambda k: utils[k])
+        if utils[bound] < 1.0:
+            bound = "host"
+    return {
+        "flop_per_row": flop_per_row,
+        "device_kind": str(kind),
+        "peaks": peaks,
+        "peaks_assumed": peaks_assumed,
+        "h2d": h2d,
+        "split_ms": split,
+        "wire_dtype": wire_dtype.name,
+        "sections": sections,
+        "bound": bound,
+        # headline copies for the compact summary line
+        "wire_mb_s": head.get("wire_mb_s"),
+        "mfu_pct": head.get("mfu_pct"),
+        "h2d_mb_s_measured": h2d["mb_s_measured"],
+    }
+
+
 def _bench_quant(params, x, seconds):
     """Int8 vs the bf16 headline on the SAME Scorer hop: per-channel int8
     weights + per-row dynamic activations ride the MXU at twice the bf16
@@ -622,6 +794,100 @@ def _arm_watchdog() -> None:
     t = threading.Timer(budget, fire)
     t.daemon = True
     t.start()
+
+
+def _bench_seq_pipeline(seconds):
+    """The seq/history PRODUCT path end-to-end (VERDICT r4 item 6):
+    producer -> bus -> router -> HistoryStore assembly -> bucketed seq
+    dispatch — not the raw model rate (that is the ``seq`` section).
+    Repeating customer keys keep histories warm, so the assembly stage
+    does real ring-buffer work. Also reports an assembly-vs-dispatch
+    time split on a representative full bucket, measured through the
+    same store the router just filled — the number that says whether
+    host-side batch assembly (not the attention FLOPs) bounds this path
+    on a given attachment."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.serving.history import SeqScorer
+
+    cfg = Config()
+    broker = Broker()
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, None)
+    L = 32
+    params = seq_mod.init(jax.random.PRNGKey(0))
+    scorer = SeqScorer(params, length=L, batch_sizes=(1024, 4096),
+                       max_customers=8192)
+    scorer.warmup()
+    # the SeqScorer OBJECT is the score_fn: the router detects
+    # score_with_ids and feeds decoded records so histories key by
+    # customer id (serving/history.py router contract)
+    router = Router(cfg, broker, scorer, engine, reg, max_batch=4096)
+
+    ds = synthetic_dataset(n=8192, fraud_rate=0.01, seed=1)
+    recs = [
+        ",".join(f"{v:.6g}" for v in ds.X[i]).encode()
+        for i in range(len(ds.X))
+    ]
+    keys = [i % 2048 for i in range(len(recs))]  # ~2k warm customers
+
+    stop = threading.Event()
+
+    def feed():
+        i = 0
+        while not stop.is_set():
+            backlog = sum(broker.end_offsets(cfg.kafka_topic))
+            if backlog - router._c_in.value() > 50_000:
+                time.sleep(0.002)
+                continue
+            j = i % 4096
+            broker.produce_batch(cfg.kafka_topic, recs[j:j + 2048],
+                                 keys[j:j + 2048])
+            i += 2048
+
+    th_feed = threading.Thread(target=feed, daemon=True)
+    th_feed.start()
+    th = router.start(poll_timeout_s=0.01)
+    budget = max(3.0, seconds)
+    time.sleep(budget)
+    tx = router._c_in.value()
+    stop.set()
+    router.stop()
+    th.join(timeout=30)
+
+    # assembly-vs-dispatch split on one full bucket through the SAME
+    # (now warm) store: prepare() is the host-side history gather, the
+    # jitted apply is the device dispatch
+    bucket = 4096
+    ids = [i % 2048 for i in range(bucket)]
+    x = np.ascontiguousarray(ds.X[:bucket], np.float32)
+
+    assembly_s = _median_time(lambda: scorer.store.prepare(ids, x))
+    hist, _tok = scorer.store.prepare(ids, x)
+    jax.block_until_ready(scorer._apply(scorer.params, hist))  # compiled
+    dispatch_s = _median_time(
+        lambda: jax.block_until_ready(scorer._apply(scorer.params, hist))
+    )
+    total = assembly_s + dispatch_s
+    return {
+        "tx_s": round(tx / budget, 1),
+        "seq_len": L,
+        "bucket": bucket,
+        "customers": len(scorer.store),
+        "assembly_ms": round(assembly_s * 1e3, 3),
+        "dispatch_ms": round(dispatch_s * 1e3, 3),
+        "assembly_fraction": round(assembly_s / total, 3) if total else None,
+    }
 
 
 def _bench_seq(seconds):
@@ -837,6 +1103,9 @@ def main() -> None:
         seq_res = _bench_seq(max(1.0, seconds / 2))
         _PARTIAL["seq"] = seq_res
 
+    if "seq_pipeline" not in skip:
+        _PARTIAL["seq_pipeline"] = _bench_seq_pipeline(max(3.0, seconds))
+
     zoo_res = None
     if "zoo" not in skip:
         zoo_res = _bench_zoo(max(1.0, seconds / 3))
@@ -846,6 +1115,14 @@ def main() -> None:
     if "quant" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_QUANT")):
         quant_res = _bench_quant(params, ds.X[:batch], max(1.0, seconds / 2))
         _PARTIAL["quant_int8"] = quant_res
+
+    if "roofline" not in skip:
+        try:
+            _PARTIAL["roofline"] = _bench_roofline(
+                scorer, params, ds.X, lat_batch, tx_per_s, rest, quant_res,
+            )
+        except Exception as e:  # noqa: BLE001 - accounting must not cost
+            _PARTIAL["roofline"] = {"error": repr(e)[:200]}  # the bench run
 
     # the e2e p99 the north star talks about is the REST predict hop when
     # measured; the raw scorer-hop p99 otherwise (also when the REST
@@ -891,6 +1168,59 @@ def main() -> None:
             pass
 
     print(json.dumps(result))
+    # LAST line: a compact summary that survives the driver's capture
+    # window.  BENCH_r03/r04.json both recorded "parsed": null because the
+    # full record above is one multi-KB line and the driver keeps only the
+    # final ~2000 chars — the tail held a fragment.  This line is the same
+    # headline plus per-section extracts, bounded well under that window,
+    # so the round's official artifact always ends with one complete JSON
+    # object (VERDICT r4 item 3).
+    print(json.dumps(compact_summary(result)), flush=True)
+
+
+def compact_summary(result: dict) -> dict:
+    """Headline + per-section extracts, guaranteed small (≤ ~1.2 KB).
+
+    Keeps the keys the watcher and the driver contract read (metric /
+    value / unit / vs_baseline / platform) and one-level numeric extracts
+    of each measured section; drops free-form sub-trees (latency grids,
+    per-client detail, attached last-good history) whose size is
+    unbounded."""
+    s = {k: result.get(k) for k in (
+        "metric", "value", "unit", "vs_baseline", "p50_ms", "p99_ms",
+        "p99_e2e_ms", "p99_vs_target", "fused_active", "platform",
+    ) if k in result}
+    s["summary"] = True  # full record precedes this line
+
+    def pick(section: str, *keys: str) -> None:
+        sec = result.get(section)
+        if not isinstance(sec, dict):
+            return
+        if "error" in sec:
+            s[section] = {"error": str(sec["error"])[:120]}
+            return
+        s[section] = {k: sec[k] for k in keys if k in sec}
+
+    pick("rest", "tx_s", "requests_s", "p50_ms", "p99_ms", "transport",
+         "rows_per_request", "host_tier_rows", "errors")
+    pick("pipeline", "tx_s", "paced_rate_tx_s", "p50_ms", "p99_ms")
+    pick("mesh", "tx_s", "devices")
+    pick("retrain", "steps_s", "labels_s", "final_loss")
+    pick("seq", "histories_s", "batch", "seq_len")
+    pick("seq_pipeline", "tx_s", "assembly_ms", "dispatch_ms",
+         "assembly_fraction")
+    pick("quant_int8", "tx_s", "fused_tx_s", "preq_tx_s", "batch")
+    pick("roofline", "wire_mb_s", "h2d_mb_s_measured", "mfu_pct", "bound")
+    zoo = result.get("zoo")
+    if isinstance(zoo, dict):
+        s["zoo"] = {
+            name: fam.get("tx_s") for name, fam in zoo.items()
+            if isinstance(fam, dict)
+        }
+    lg = result.get("last_good_tpu")
+    if isinstance(lg, dict):
+        s["last_good_tpu_at"] = lg.get("captured_at")
+    return s
 
 
 if __name__ == "__main__":

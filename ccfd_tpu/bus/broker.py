@@ -52,13 +52,63 @@ class Record(NamedTuple):
     timestamp: float
 
 
+# Group name under which runtime/recovery.py pins its last durable cut:
+# retention treats the pin as any other committed position, so records at
+# or above the last checkpoint cut can never be deleted and a crash
+# restore can always replay from the cut. This is what makes the broker's
+# delete-before-committed-offset retention safe BY CONSTRUCTION alongside
+# the framework's rewind-based recovery (Kafka's pure size/time retention
+# would happily delete a cut's records out from under it).
+RETENTION_PIN_GROUP = "__ccfd_cut_pin__"
+
+
+class _Partition:
+    """One partition's in-memory tail: a record list plus the offset of
+    its first element.
+
+    ``offset == base + index`` (was ``offset == list index`` before round
+    5's retention work): retention trims the front of ``records`` and
+    advances ``base``, so offsets stay permanent — exactly Kafka's
+    log-start-offset — while memory stays capped. Records remain plain
+    6-tuples in Record field order (exact tuples untrack from gen-2 GC,
+    see Record's GC note). A list with batched front-deletes beats a
+    deque here: the fetch path slices hot (O(k) on a list, O(n) on a
+    deque), while trims are amortized over thousands of appends."""
+
+    __slots__ = ("base", "records")
+
+    def __init__(self, base: int = 0):
+        self.base = base
+        self.records: list[tuple] = []
+
+    @property
+    def end(self) -> int:
+        return self.base + len(self.records)
+
+    def slice(self, start: int, max_n: int) -> tuple[int, list[tuple]]:
+        """-> (effective start offset, records). A ``start`` below
+        ``base`` reads from the earliest retained record — Kafka's
+        auto.offset.reset=earliest on an out-of-range fetch."""
+        eff = max(start, self.base)
+        i = eff - self.base
+        return eff, self.records[i:i + max_n]
+
+    def trim_to(self, offset: int) -> int:
+        """Drop records below ``offset``; returns how many were dropped."""
+        n = min(max(offset - self.base, 0), len(self.records))
+        if n:
+            del self.records[:n]
+            self.base += n
+        return n
+
+
 class _Topic:
-    def __init__(self, name: str, n_partitions: int):
+    def __init__(self, name: str, n_partitions: int,
+                 bases: list[int] | None = None):
         self.name = name
-        # plain 6-tuples in Record field order, NOT Record instances —
-        # exact tuples untrack from gen-2 GC (see Record's GC note);
-        # consumer-facing APIs rebuild Record views at poll time
-        self.partitions: list[list[tuple]] = [[] for _ in range(n_partitions)]
+        self.partitions: list[_Partition] = [
+            _Partition(bases[i] if bases else 0) for i in range(n_partitions)
+        ]
         self._rr = itertools.count()
 
     @property
@@ -90,38 +140,126 @@ class Broker:
         default_partitions: int = 3,
         log_dir: str | None = None,
         fsync: bool = False,
+        retention_records: int | None = None,
+        segment_bytes: int | None = None,
     ):
+        """``retention_records``: cap each partition's retained history.
+
+        Kafka-shaped retention with one deliberate strengthening: a
+        record is only eligible for deletion once it is BOTH older than
+        the newest ``retention_records`` AND below every consumer
+        group's committed offset for that partition (Kafka's time/size
+        retention deletes regardless of consumers; this framework's
+        crash recovery replays from committed cuts — runtime/recovery.py
+        pins its last durable cut as a committed position, see
+        ``RETENTION_PIN_GROUP`` — so delete-before-committed-offset is
+        the only retention that cannot break recovery by construction).
+        ``None`` (default) keeps the historical retain-everything
+        behavior. ``segment_bytes`` sizes the on-disk rolling segments
+        (bus/log.py); retention deletes whole rolled segments."""
         self._default_partitions = default_partitions
         self._topics: dict[str, _Topic] = {}
         self._groups: dict[str, dict[tuple[str, int], int]] = {}  # group -> {(t,p): offset}
         self._members: dict[str, list["Consumer"]] = {}
         self._lock = threading.Lock()
         self._data_ready = threading.Condition(self._lock)
+        self.retention_records = retention_records
+        self.records_trimmed = 0   # lifetime count, for soaks/exporters
+        self.oor_resets = 0        # fetches clamped to log-start (Kafka's
+        #                            auto.offset.reset=earliest analog)
+        self._since_retention: dict[str, int] = {}  # topic -> appends
+        self._log_dir = log_dir
+        self._fsync = fsync
+        self._segment_bytes = segment_bytes
+        self.crash_restarts = 0
         self._log = None
         if log_dir is not None:
-            from ccfd_tpu.bus.log import BusLog
+            self._open_and_replay_log()
 
-            self._log = BusLog(log_dir, fsync=fsync)
-            for name, n_parts in self._log.replay_topics().items():
-                t = _Topic(name, n_parts)
-                self._topics[name] = t
-                for p in range(n_parts):
-                    for key, ts, value in self._log.replay_partition(name, p):
-                        t.partitions[p].append(
-                            (name, p, len(t.partitions[p]), key, value, ts)
-                        )
-            # Clamp replayed offsets to the replayed log: a torn-tail
-            # truncation may have dropped records whose consumption was
-            # already committed; an out-of-range offset would silently skip
-            # every record produced at those slots after restart (Kafka
-            # resets out-of-range offsets the same way).
-            for g, tps in self._log.replay_offsets().items():
-                mine = self._groups.setdefault(g, {})
-                for (tname, p), off in tps.items():
-                    t = self._topics.get(tname)
-                    if t is None or p >= t.n_partitions:
-                        continue  # topic/partition lost with the meta log
-                    mine[(tname, p)] = min(off, len(t.partitions[p]))
+    def _open_and_replay_log(self) -> None:
+        """Open the segment log and replay it into (empty) in-memory state.
+        Runs at construction and again inside ``crash_restart``."""
+        from ccfd_tpu.bus.log import BusLog, DEFAULT_SEGMENT_BYTES
+
+        self._log = BusLog(
+            self._log_dir, fsync=self._fsync,
+            segment_bytes=self._segment_bytes or DEFAULT_SEGMENT_BYTES,
+        )
+        for name, n_parts in self._log.replay_topics().items():
+            bases = []
+            replays = []
+            for p in range(n_parts):
+                base, recs = self._log.replay_partition(name, p)
+                bases.append(base)
+                replays.append(recs)
+            t = _Topic(name, n_parts, bases=bases)
+            self._topics[name] = t
+            for p, recs in enumerate(replays):
+                part = t.partitions[p]
+                for key, ts, value in recs:
+                    part.records.append((name, p, part.end, key, value, ts))
+        # Clamp replayed offsets to the replayed log: a torn-tail
+        # truncation may have dropped records whose consumption was
+        # already committed; an out-of-range offset would silently skip
+        # every record produced at those slots after restart (Kafka
+        # resets out-of-range offsets the same way). The low clamp is
+        # the partition's log-start: retention may have deleted the
+        # committed position's records.
+        for g, tps in self._log.replay_offsets().items():
+            mine = self._groups.setdefault(g, {})
+            for (tname, p), off in tps.items():
+                t = self._topics.get(tname)
+                if t is None or p >= t.n_partitions:
+                    continue  # topic/partition lost with the meta log
+                part = t.partitions[p]
+                mine[(tname, p)] = max(part.base, min(off, part.end))
+
+    def crash_restart(self) -> dict:
+        """Crash the durable broker and restart it from its own disk, IN
+        PLACE, with consumers attached mid-stream.
+
+        The analog of a Kafka broker pod dying and its replacement
+        mounting the same PV (reference deploy/frauddetection_cr.yaml:
+        73-77 — Strimzi persistent-claim storage): every byte of
+        in-memory state is dropped exactly as a process death would drop
+        it, then the on-disk segment log replays back into THIS object,
+        so attached components — who hold the broker reference the way
+        Kafka clients hold a bootstrap address — resume against the
+        restarted broker without being rebuilt. Durability analysis of
+        why close-then-replay equals a crash from the disk's standpoint:
+        every append was already an ``os.write`` (page cache) at produce
+        time, and close adds no flush beyond that; the only write that
+        happens on OPEN (offsets.log compaction) is atomic tmp+rename.
+
+        Consumers survive because group offsets are replayed from the
+        durable offsets log — a registered member keeps its assignment
+        (a reconnecting client) and its next poll resumes from the
+        committed position. Raises on a memory-only broker: with no log
+        there is nothing to restart FROM (a real all-RAM bus crash is
+        total data loss, which the chaos soak would report as exactly
+        that)."""
+        with self._lock:
+            if self._log is None:
+                raise RuntimeError("memory-only broker cannot crash_restart")
+            self._log.close()
+            self._topics.clear()
+            self._groups.clear()
+            self._since_retention.clear()
+            self._open_and_replay_log()
+            # surviving members are clients reconnecting to the restarted
+            # broker: re-register their topics and rebalance each group
+            for g, members in self._members.items():
+                for m in members:
+                    for tname in m.topics:
+                        self._topic(tname)
+                self._rebalance(g)
+            self.crash_restarts += 1
+            self._data_ready.notify_all()
+            return {
+                "topics": {n: [p.end for p in t.partitions]
+                           for n, t in self._topics.items()},
+                "groups": {g: dict(tps) for g, tps in self._groups.items()},
+            }
 
     # -- admin ------------------------------------------------------------
     def create_topic(self, name: str, n_partitions: int | None = None) -> None:
@@ -148,7 +286,13 @@ class Broker:
 
     def end_offsets(self, topic: str) -> list[int]:
         with self._lock:
-            return [len(p) for p in self._topic(topic).partitions]
+            return [p.end for p in self._topic(topic).partitions]
+
+    def beginning_offsets(self, topic: str) -> list[int]:
+        """Per-partition log-start offset (Kafka ``beginning_offsets``):
+        0 until retention trims, then the earliest retained offset."""
+        with self._lock:
+            return [p.base for p in self._topic(topic).partitions]
 
     def health_snapshot(self) -> dict:
         """One consistent view for health/lag exporters: per-topic partition
@@ -158,7 +302,7 @@ class Broker:
         lag reads as the full log, the way Kafka reports it."""
         with self._lock:
             topics = {
-                name: [len(p) for p in t.partitions]
+                name: [p.end for p in t.partitions]
                 for name, t in self._topics.items()
             }
             groups: dict[str, dict[tuple[str, int], int]] = {
@@ -190,16 +334,18 @@ class Broker:
                     )
                 part = partition
             now = time.time()
-            item = (topic, part, len(t.partitions[part]), key, value, now)
+            pobj = t.partitions[part]
+            item = (topic, part, pobj.end, key, value, now)
             if self._log is not None:
                 # encode BEFORE the in-memory append: an unencodable record
                 # must fail cleanly, not leave memory and disk diverged
                 from ccfd_tpu.bus.log import encode_entry
 
                 payload = encode_entry(key, now, value)
-            t.partitions[part].append(item)  # exact tuple: GC-untrackable
+            pobj.records.append(item)  # exact tuple: GC-untrackable
             if self._log is not None:
                 self._log.append_payload(topic, part, payload)
+            self._maybe_retention(topic, t, 1)
             self._data_ready.notify_all()
             return Record._make(item)
 
@@ -238,12 +384,12 @@ class Broker:
                     part = t.route(k)
                     if payloads is not None:
                         self._log.append_payload(topic, part, payloads[i])
-                    t.partitions[part].append(
-                        (topic, part, len(t.partitions[part]), k, v, now)
-                    )
+                    pobj = t.partitions[part]
+                    pobj.records.append((topic, part, pobj.end, k, v, now))
                     appended += 1
             finally:
                 if appended:
+                    self._maybe_retention(topic, t, appended)
                     self._data_ready.notify_all()
             return len(values)
 
@@ -318,12 +464,76 @@ class Broker:
                 )
             g = self._groups.setdefault(group_id, {})
             for p, off in enumerate(offsets):
-                off = max(0, min(int(off), len(t.partitions[p])))
+                pobj = t.partitions[p]
+                # clamp low to log-start: retention may have deleted the
+                # requested position (Kafka resets to earliest the same
+                # way). Counted: a rewind that aimed below the retained
+                # log (e.g. a GENESIS restore with retention on — the
+                # coordinator's pin only protects replay from the last
+                # durable cut, not from offset 0) replays less than the
+                # caller asked for, and operators should see that.
+                if int(off) < pobj.base:
+                    self.oor_resets += 1
+                off = max(pobj.base, min(int(off), pobj.end))
                 g[(topic, p)] = off
                 if self._log is not None:
                     self._log.commit_offset(group_id, topic, p, off)
             # rewound consumers may have records to re-read right now
             self._data_ready.notify_all()
+
+    # -- retention --------------------------------------------------------
+    def _maybe_retention(self, topic: str, t: _Topic, appended: int) -> None:
+        """Amortized retention check, called under the lock after appends:
+        runs the real enforcement once per ~1/8th of the retention window
+        of fresh records, so the trim's O(dropped) list-delete spreads over
+        thousands of produce calls."""
+        if self.retention_records is None:
+            return
+        n = self._since_retention.get(topic, 0) + appended
+        if n < max(1024, self.retention_records // 8):
+            self._since_retention[topic] = n
+            return
+        self._since_retention[topic] = 0
+        self._enforce_retention_locked(topic, t)
+
+    def enforce_retention(self, topic: str | None = None) -> int:
+        """Run retention now (tests, shutdown); returns records trimmed."""
+        if self.retention_records is None:
+            return 0
+        with self._lock:
+            before = self.records_trimmed
+            names = [topic] if topic is not None else list(self._topics)
+            for name in names:
+                t = self._topics.get(name)
+                if t is not None:
+                    self._enforce_retention_locked(name, t)
+            return self.records_trimmed - before
+
+    def _enforce_retention_locked(self, tname: str, t: _Topic) -> None:
+        for p, pobj in enumerate(t.partitions):
+            floor = pobj.end - self.retention_records
+            if floor <= pobj.base:
+                continue
+            # delete-before-committed-offset: the trim stops at the
+            # lowest committed position any group holds for this
+            # partition. Members that attached but never committed hold
+            # position 0 implicitly — their whole backlog is protected,
+            # exactly Kafka's lag accounting (health_snapshot seeds the
+            # same way). No group at all -> pure size retention.
+            tp = (tname, p)
+            mins = [tps[tp] for tps in self._groups.values() if tp in tps]
+            for g, members in self._members.items():
+                if tp not in self._groups.get(g, {}) and any(
+                    tp in m._assignment for m in members
+                ):
+                    mins.append(0)
+            committed_min = min(mins) if mins else pobj.end
+            trim_to = min(committed_min, floor)
+            dropped = pobj.trim_to(trim_to)
+            if dropped:
+                self.records_trimmed += dropped
+                if self._log is not None:
+                    self._log.trim_partition(tname, p, pobj.base)
 
     def _committed(self, group_id: str, tp: tuple[str, int]) -> int:
         return self._groups.setdefault(group_id, {}).get(tp, 0)
@@ -339,18 +549,32 @@ class Broker:
         self, consumer: "Consumer", max_records: int
     ) -> list[Record]:
         out: list[Record] = []
-        for tname, p in consumer._assignment:
+        # Rotate the scan start across polls (Kafka clients do the same):
+        # a loaded partition early in a fixed order would otherwise starve
+        # later ones for as long as it keeps filling max_records — found
+        # live in the round-5 soak, where partition 2's backlog (and the
+        # retention pin reflecting it) grew for the whole run while 0/1
+        # stayed current.
+        n = len(consumer._assignment)
+        first = consumer._fetch_start % n if n else 0
+        for k in range(n):
+            tname, p = consumer._assignment[(first + k) % n]
             if len(out) >= max_records:
                 break
             t = self._topic(tname)
             start = self._committed(consumer.group_id, (tname, p))
-            log = t.partitions[p]
-            take = log[start : start + (max_records - len(out))]
+            eff, take = t.partitions[p].slice(start, max_records - len(out))
+            if eff > start:
+                # committed position fell below the log-start (possible
+                # only for positions retention proved consumed or that a
+                # rewind aimed below the retained log): reset-to-earliest
+                self.oor_resets += 1
             if take:
                 # stored as exact tuples (GC untracking, see Record);
                 # consumers get the Record view
                 out.extend(map(Record._make, take))
-                self._commit(consumer.group_id, (tname, p), start + len(take))
+                self._commit(consumer.group_id, (tname, p), eff + len(take))
+        consumer._fetch_start = first + 1
         return out
 
 
@@ -364,6 +588,7 @@ class Consumer:
         self.group_id = group_id
         self.topics = topics
         self._assignment: list[tuple[str, int]] = []
+        self._fetch_start = 0  # rotating fetch fairness cursor (_fetch)
         self._closed = False
 
     def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[Record]:

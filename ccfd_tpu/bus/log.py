@@ -15,13 +15,17 @@ mechanisms"). This module gives the in-process broker the same property:
   once the append tail dominates, so long-running durable buses don't pay
   unbounded reopen time for commit history
 
-Retention limitation (documented, deliberate): record segments are never
-rotated or truncated — every record of every topic is kept and replayed
-into memory on reopen, like a Kafka topic with ``retention.ms=-1``. The
-demo pipeline's topics are bounded (one Kaggle pass); a production
-deployment would cap topics with segment rotation + delete-before-
-committed-offset, which the framing here supports but the broker's
-in-memory partition lists (offset == list index) do not yet.
+Retention (round 5; closes the round-4 "unbounded bus" ceiling): each
+(topic, partition) is a CHAIN of segment files ``t<i>_p<k>.<base>.log``
+where ``<base>`` is the offset of the segment's first record — exactly
+Kafka's on-disk layout (``00000000000000000000.log``). The active segment
+rolls once it passes ``segment_bytes``; ``trim_partition`` deletes whole
+segments strictly below a given offset (the broker calls it with its
+delete-before-committed-offset retention floor, bus/broker.py). A legacy
+un-suffixed ``t<i>_p<k>.log`` replays as the base-0 segment, so pre-
+rotation log dirs keep working. Offsets are permanent: a record's offset
+never changes when older segments are deleted, and replay returns the
+chain's base so the in-memory partition rebases correctly.
 
 Framing is ``[u32 len][u32 crc32][payload]`` with the byte-crunching
 (frame building, replay scan, torn-tail detection) in C++
@@ -124,21 +128,158 @@ class SegmentFile:
             self._fd = None
 
 
+class _SegmentSeries:
+    """The on-disk segment chain for one (topic, partition).
+
+    Kafka's layout: each file is named by the offset of its first record,
+    the last file is the active (append) segment, rolling at
+    ``segment_bytes``, and retention deletes whole files from the front.
+    Offsets are permanent — deleting old segments never renumbers
+    anything; replay hands back the chain's first base so the in-memory
+    partition rebases instead of assuming 0.
+    """
+
+    def __init__(self, directory: str, tid: int, part: int,
+                 fsync: bool, segment_bytes: int):
+        self.dir = directory
+        self.prefix = f"t{tid}_p{part}"
+        self.fsync = fsync
+        self.segment_bytes = segment_bytes
+        self.chain: list[tuple[int, str]] = []  # (base, path), ascending
+        self._active: SegmentFile | None = None
+        self._active_base = 0
+        self._active_count = 0
+        self._active_bytes = 0
+
+    def _path(self, base: int) -> str:
+        # zero-padded to 20 digits like Kafka: lexical order == offset order
+        return os.path.join(self.dir, f"{self.prefix}.{base:020d}.log")
+
+    def _discover(self) -> None:
+        chain: list[tuple[int, str]] = []
+        legacy = os.path.join(self.dir, self.prefix + ".log")
+        if os.path.exists(legacy):  # pre-rotation dirs: the base-0 segment
+            chain.append((0, legacy))
+        pre = self.prefix + "."
+        for name in os.listdir(self.dir):
+            if name.startswith(pre) and name.endswith(".log"):
+                mid = name[len(pre):-4]
+                if mid.isdigit():
+                    chain.append((int(mid), os.path.join(self.dir, name)))
+        chain.sort()
+        self.chain = chain
+
+    def replay(self) -> tuple[int, list[bytes]]:
+        """-> (base offset of the first retained record, payloads).
+
+        Torn tails truncate to the valid prefix (Kafka log recovery). A
+        truncation that is NOT in the last segment leaves every later
+        segment's base pointing past a hole, so the chain keeps its
+        longest offset-consistent prefix and the orphaned files are
+        deleted — at-least-once replay from an earlier cut beats replaying
+        records at silently wrong offsets."""
+        self._discover()
+        if not self.chain:
+            self._active = None
+            self._active_base = self._active_count = self._active_bytes = 0
+            return 0, []
+        base0 = self.chain[0][0]
+        payloads: list[bytes] = []
+        expected = base0
+        kept = 0
+        for i, (base, path) in enumerate(self.chain):
+            if base != expected:
+                for _, orphan in self.chain[i:]:
+                    try:
+                        os.unlink(orphan)
+                    except OSError:
+                        pass
+                break
+            seg = SegmentFile(path, self.fsync)
+            recs = seg.replay()
+            seg.close()
+            payloads.extend(recs)
+            expected = base + len(recs)
+            kept = i + 1
+        self.chain = self.chain[:kept]
+        last_base, last_path = self.chain[-1]
+        self._active = SegmentFile(last_path, self.fsync)
+        self._active_base = last_base
+        self._active_count = expected - last_base
+        try:
+            self._active_bytes = os.path.getsize(last_path)
+        except OSError:
+            self._active_bytes = 0
+        return base0, payloads
+
+    def append(self, *payloads: bytes) -> None:
+        if self._active is None:
+            self._active = SegmentFile(self._path(self._active_base),
+                                       self.fsync)
+            self.chain.append((self._active_base, self._active.path))
+        self._active.append(*payloads)
+        self._active_count += len(payloads)
+        # 8 framing bytes ([u32 len][u32 crc]) per record
+        self._active_bytes += sum(len(p) + 8 for p in payloads)
+        if self._active_bytes >= self.segment_bytes:
+            self._roll()
+
+    def _roll(self) -> None:
+        self._active.close()
+        self._active_base += self._active_count
+        self._active_count = 0
+        self._active_bytes = 0
+        self._active = SegmentFile(self._path(self._active_base), self.fsync)
+        self._active._ensure_open()  # the empty active must exist on disk:
+        self.chain.append((self._active_base, self._active.path))
+        # a crash right after the roll otherwise replays a chain whose
+        # last base has no file, and new appends would recreate it anyway
+
+    def trim_to(self, offset: int) -> int:
+        """Delete whole segments whose every record sits below ``offset``.
+        The active segment is never deleted; returns segments removed."""
+        n = 0
+        while len(self.chain) >= 2 and self.chain[1][0] <= offset:
+            _, path = self.chain.pop(0)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            n += 1
+        return n
+
+    @property
+    def start_offset(self) -> int:
+        return self.chain[0][0] if self.chain else self._active_base
+
+    def close(self) -> None:
+        if self._active is not None:
+            self._active.close()
+            self._active = None
+
+
+# 64 MiB: big enough that rotation costs nothing at demo rates, small
+# enough that retention reclaims space promptly on long soaks
+DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
+
+
 class BusLog:
     """Directory of segment files backing one Broker instance."""
 
     META = "meta.log"
     OFFSETS = "offsets.log"
 
-    def __init__(self, directory: str, fsync: bool = False):
+    def __init__(self, directory: str, fsync: bool = False,
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES):
         self.dir = directory
         self.fsync = fsync
+        self.segment_bytes = segment_bytes
         os.makedirs(directory, exist_ok=True)
         self._meta = SegmentFile(os.path.join(directory, self.META), fsync)
         self._offsets = SegmentFile(os.path.join(directory, self.OFFSETS), fsync)
         self._topic_ids: dict[str, int] = {}
         self._partitions: dict[str, int] = {}
-        self._segments: dict[tuple[str, int], SegmentFile] = {}
+        self._series: dict[tuple[str, int], _SegmentSeries] = {}
 
     # -- replay -------------------------------------------------------------
 
@@ -150,8 +291,12 @@ class BusLog:
             self._partitions[m["topic"]] = int(m["partitions"])
         return dict(self._partitions)
 
-    def replay_partition(self, topic: str, part: int) -> list[tuple[Any, float, Any]]:
-        return [decode_entry(p) for p in self._segment(topic, part).replay()]
+    def replay_partition(
+        self, topic: str, part: int
+    ) -> tuple[int, list[tuple[Any, float, Any]]]:
+        """-> (base offset of the first retained record, decoded records)."""
+        base, payloads = self._segment(topic, part).replay()
+        return base, [decode_entry(p) for p in payloads]
 
     def replay_offsets(self) -> dict[str, dict[tuple[str, int], int]]:
         groups: dict[str, dict[tuple[str, int], int]] = {}
@@ -217,17 +362,25 @@ class BusLog:
             json.dumps({"g": group, "t": topic, "p": part, "o": offset}).encode()
         )
 
-    def _segment(self, topic: str, part: int) -> SegmentFile:
-        seg = self._segments.get((topic, part))
-        if seg is None:
+    def trim_partition(self, topic: str, part: int, offset: int) -> int:
+        """Delete whole on-disk segments strictly below ``offset`` (the
+        broker's retention floor).  Returns segments removed."""
+        return self._segment(topic, part).trim_to(offset)
+
+    def start_offset(self, topic: str, part: int) -> int:
+        return self._segment(topic, part).start_offset
+
+    def _segment(self, topic: str, part: int) -> _SegmentSeries:
+        series = self._series.get((topic, part))
+        if series is None:
             tid = self._topic_ids[topic]
-            path = os.path.join(self.dir, f"t{tid}_p{part}.log")
-            seg = SegmentFile(path, self.fsync)
-            self._segments[(topic, part)] = seg
-        return seg
+            series = _SegmentSeries(self.dir, tid, part, self.fsync,
+                                    self.segment_bytes)
+            self._series[(topic, part)] = series
+        return series
 
     def close(self) -> None:
         self._meta.close()
         self._offsets.close()
-        for seg in self._segments.values():
-            seg.close()
+        for series in self._series.values():
+            series.close()

@@ -51,7 +51,8 @@ def cmd_demo(args: argparse.Namespace) -> int:
         ds.X, ds.y, steps=args.train_steps, tc=TrainConfig(compute_dtype="float32")
     )
 
-    broker = Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync)
+    broker = Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync,
+                    retention_records=cfg.bus_retention_records or None)
     reg_router, reg_kie, reg_notify, reg_retrain = (
         Registry(), Registry(), Registry(), Registry(),
     )
@@ -697,7 +698,8 @@ def _broker_for(cfg, registry=None):
         return remote
     from ccfd_tpu.bus.broker import Broker
 
-    return Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync)
+    return Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync,
+                    retention_records=cfg.bus_retention_records or None)
 
 
 def _install_sigterm_as_interrupt() -> None:
@@ -733,7 +735,8 @@ def cmd_bus(args: argparse.Namespace) -> int:
 
     cfg = Config.from_env()
     log_dir = args.dir or (cfg.bus_log_dir or None)
-    broker = Broker(log_dir=log_dir, fsync=cfg.bus_fsync)
+    broker = Broker(log_dir=log_dir, fsync=cfg.bus_fsync,
+                    retention_records=cfg.bus_retention_records or None)
     srv = BrokerServer(broker)
     port = srv.start(args.host, args.port)
     print(f"[bus] listening on {args.host}:{port}"

@@ -31,6 +31,13 @@ class Config:
     broker_url: str = "inproc://local"
     bus_log_dir: str = ""  # durable segment-log dir (CCFD_BUS_DIR); "" = memory
     bus_fsync: bool = False  # fsync per append (CCFD_BUS_FSYNC=1)
+    # per-partition retained-record cap (CCFD_BUS_RETENTION_RECORDS;
+    # 0 = retain everything, the pre-round-5 behavior). The broker only
+    # deletes records that are BOTH past this cap and below every
+    # consumer group's committed offset — the Kafka retention analog of
+    # frauddetection_cr.yaml's topic config, strengthened so rewind-based
+    # crash recovery can never lose its cut (bus/broker.py).
+    bus_retention_records: int = 0
     kafka_topic: str = "odh-demo"
     customer_notification_topic: str = "ccd-customer-outgoing"
     customer_response_topic: str = "ccd-customer-response"
@@ -123,6 +130,10 @@ class Config:
             broker_url=e.get("BROKER_URL", Config.broker_url),
             bus_log_dir=e.get("CCFD_BUS_DIR", Config.bus_log_dir),
             bus_fsync=e.get("CCFD_BUS_FSYNC", "") in ("1", "true", "yes"),
+            bus_retention_records=int(
+                e.get("CCFD_BUS_RETENTION_RECORDS",
+                      Config.bus_retention_records)
+            ),
             kafka_topic=e.get("KAFKA_TOPIC", Config.kafka_topic),
             customer_notification_topic=e.get(
                 "CUSTOMER_NOTIFICATION_TOPIC", Config.customer_notification_topic

@@ -84,7 +84,18 @@ def fold_for_kernel(params: Mapping[str, Any]) -> dict[str, jax.Array]:
     if w1q.shape[0] != n_feat:
         raise ValueError("normalizer/layer-0 feature-count mismatch")
     # w3 as f32: int8 products and their partial sums stay integer-exact
-    # in f32 (< 2^24), see module docstring
+    # in f32 (< 2^24), see module docstring.  That bound holds only while
+    # hidden <= 2^24 / 127^2 = 1040; the C++ front refuses wider models at
+    # install (httpfront.cpp ccfd_front_set_host_q8_model) and the kernel
+    # must refuse them too — hiddens are multiples of 128, so 1152+ is a
+    # legal config that would silently break the asserted bit-parity with
+    # the XLA int32 accumulate (ADVICE r4).
+    hidden_last = int(np.asarray(layers[2]["wq"]).shape[0])
+    if hidden_last > 1040:
+        raise ValueError(
+            f"fused q8 kernel: last-layer input width {hidden_last} > 1040 "
+            "breaks the integer-exact f32 accumulate (2^24 bound); "
+            "serve this model via the XLA mlp_q8 graph instead")
     w3f = np.asarray(layers[2]["wq"], np.float32).reshape(1, -1)
     return {
         "mu": jnp.asarray(np.pad(mu, (0, LANE - n_feat))),
@@ -205,9 +216,12 @@ def fused_mlp_q8_score(
 ) -> jax.Array:
     """(B, F<=128) rows -> (B,) float32 proba.  B must be a tile multiple.
     f32 rows are the contract (exact parity with the XLA q8 graph); other
-    float dtypes are accepted and widened/rounded to f32 first."""
-    if x.dtype != jnp.bfloat16:
-        x = x.astype(jnp.float32)
+    float dtypes are accepted and widened/rounded to f32 first — including
+    bf16, whose widening is lossless and keeps the kernel on one wire
+    dtype (a bf16 fast path here would silently ship the degraded
+    0.058-max-prob-delta behavior the module docstring warns against,
+    with stricter sublane tiling on small fit_tile values; ADVICE r4)."""
+    x = x.astype(jnp.float32)
     x = pad_features(x)
     return _call_kernel(
         _kernel,

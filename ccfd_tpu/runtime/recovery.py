@@ -172,7 +172,46 @@ class CheckpointCoordinator:
                 with open(tmp, "w") as f:
                     json.dump({"version": 1, **cut}, f)
                 os.replace(tmp, self.path)
+        # Pin retention only AFTER the cut is durable: until the atomic
+        # replace lands, the newest cut a cold start can load is the
+        # PREVIOUS one, and the previous pin is what keeps that cut's
+        # replay records alive. Pinning first would let retention trim
+        # [old cut, new cut) while disk still holds the old cut — a crash
+        # in that window would restore a cut whose records are gone.
+        self._pin_retention(cut["offsets"])
         return cut
+
+    def _pin_retention(self, cut_offsets: dict[str, list[int]]) -> None:
+        """Publish the cut as a committed position under the broker's
+        retention pin group: the broker's delete-before-committed-offset
+        retention (bus/broker.py) then cannot delete any record a restore
+        of THIS cut would replay. Per topic the pin is the element-wise
+        min across the cut's groups — the earliest position any rewind
+        could aim at. An in-process Broker without retention just records
+        a harmless extra group; transports with no offset-reset surface
+        (RemoteBroker, the Kafka adapter) are skipped — they cannot be
+        pinned from here, and they cannot be rewound by restore() either,
+        so the pin's protection is moot on them (crash recovery over
+        those transports is the server's/cluster's job)."""
+        from ccfd_tpu.bus.broker import RETENTION_PIN_GROUP
+
+        if not callable(getattr(self.broker, "reset_offsets", None)):
+            return
+        pin: dict[str, list[int]] = {}
+        for key, offs in cut_offsets.items():
+            _, t = key.split("\x00", 1)
+            cur = pin.get(t)
+            pin[t] = (list(offs) if cur is None
+                      else [min(a, b) for a, b in zip(cur, offs)])
+        for t, offs in pin.items():
+            try:
+                self.broker.reset_offsets(RETENTION_PIN_GROUP, t, offs)
+            except Exception:  # noqa: BLE001 - pinning is protective only;
+                # a transport that rejects it must not fail the checkpoint
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "retention pin failed for %r", t)
 
     def _router_loop_alive(self) -> bool:
         """Best effort: is some thread inside the router's run loop?  The

@@ -300,8 +300,13 @@ class Scorer:
             )
         return self._fused_sharded(tile)(fused_params, x)
 
+    _PREQ_LIVE = object()  # sentinel: "read the live grid", distinct from
+    # an explicit None snapshot (a non-preq model's locked snapshot) — the
+    # live fallback on None would pair a concurrently-swapped preq grid
+    # with the snapshot's old kernel weights
+
     def _fused_dispatch(self, fused_params: Any, chunk: np.ndarray,
-                        preq_norm: Any = None) -> Any:
+                        preq_norm: Any = _PREQ_LIVE) -> Any:
         """Host chunk -> device probabilities through the active fused
         path. The int8 WIRE mode (q8 kernel, single device): the host runs
         the model's OWN first requantization (prequantize_rows_numpy) and
@@ -309,8 +314,9 @@ class Scorer:
         transfer is what changes. Everything else ships rows in the
         kernel's wire dtype (bf16 for the bf16 kernel, f32 for q8).
         ``preq_norm`` must be snapshotted together with ``fused_params``
-        when a concurrent swap is possible."""
-        if preq_norm is None:
+        when a concurrent swap is possible (pass the snapshot even when
+        it is None — only the default reads the live grid)."""
+        if preq_norm is Scorer._PREQ_LIVE:
             preq_norm = self._preq_norm
         if self._preq_wire and preq_norm is not None and self.mesh is None:
             q, s = self._fused_mod.prequantize_rows_numpy(preq_norm, chunk)
@@ -498,9 +504,13 @@ class Scorer:
             params = self._params
             fused = self._fused_params
             host_params = self._host_params
+            # same locked snapshot as the weights: _fused_dispatch's
+            # contract — a concurrent swap_params must not pair the new
+            # quantization grid with the old kernel weights mid-autotune
+            preq = self._preq_norm
         if fused is not None:
             xb = np.zeros((b, self.num_features), np.float32)
-            dispatch = lambda: self._fused_dispatch(fused, xb)  # noqa: E731
+            dispatch = lambda: self._fused_dispatch(fused, xb, preq)  # noqa: E731
         else:
             xf = np.zeros((b, self.num_features), np.float32)
             dispatch = lambda: self._apply(params, self._put_batch(xf))  # noqa: E731

@@ -1,0 +1,401 @@
+"""Bus retention (round 5; VERDICT r4 item 2): bounded memory and disk
+for the broker while offsets stay permanent and rewind-based recovery
+stays safe.
+
+Semantics under test — Kafka's segment-rotation + size-retention analog
+(reference deploy/frauddetection_cr.yaml:73-77 configures the Strimzi
+cluster whose topics this broker stands in for), strengthened with
+delete-before-committed-offset: a record deletes only once it is past
+the retention cap AND below every group's committed position, so the
+checkpoint coordinator's pinned cut (runtime/recovery.py) can always be
+replayed."""
+
+import json
+import os
+
+import pytest
+
+from ccfd_tpu.bus.broker import RETENTION_PIN_GROUP, Broker
+from ccfd_tpu.bus.log import BusLog
+
+
+def _drain(consumer, n, max_records=500):
+    got = []
+    while len(got) < n:
+        recs = consumer.poll(max_records=max_records, timeout_s=1.0)
+        if not recs:
+            break
+        got.extend(recs)
+    return got
+
+
+# -- in-memory semantics ----------------------------------------------------
+
+def test_retention_caps_memory_and_preserves_offsets():
+    b = Broker(default_partitions=1, retention_records=100)
+    c = b.consumer("g", ["t"])
+    for i in range(500):
+        b.produce("t", i, key=b"k")
+    assert len(_drain(c, 500)) == 500
+    trimmed = b.enforce_retention()
+    assert trimmed == 400
+    assert b.beginning_offsets("t") == [400]
+    assert b.end_offsets("t") == [500]
+    # offsets are permanent: the next produce lands at 500, not 100
+    r = b.produce("t", "next", key=b"k")
+    assert r.offset == 500
+    # and the retained tail is the NEWEST records
+    part = b._topics["t"].partitions[0]
+    assert part.records[0][4] == 400  # value == its original index
+
+
+def test_uncommitted_records_are_never_trimmed():
+    b = Broker(default_partitions=1, retention_records=10)
+    c = b.consumer("g", ["t"])
+    for i in range(200):
+        b.produce("t", i, key=b"k")
+    # the group is assigned but has consumed nothing: its implicit
+    # position 0 protects the whole backlog (lag == full log, like Kafka)
+    assert b.enforce_retention() == 0
+    assert b.beginning_offsets("t") == [0]
+    got = _drain(c, 200)
+    assert [r.value for r in got] == list(range(200))
+    # consumed: now the cap applies
+    assert b.enforce_retention() == 190
+    assert b.beginning_offsets("t") == [190]
+
+
+def test_no_groups_means_pure_size_retention_and_earliest_reset():
+    b = Broker(default_partitions=1, retention_records=50)
+    for i in range(300):
+        b.produce("t", i, key=b"k")
+    assert b.enforce_retention() == 250
+    # a late consumer starts at the log-start, not offset 0
+    c = b.consumer("late", ["t"])
+    got = _drain(c, 50)
+    assert [r.value for r in got] == list(range(250, 300))
+    assert b.oor_resets >= 1  # the clamp was counted
+
+
+def test_reset_offsets_clamps_to_log_start():
+    b = Broker(default_partitions=1, retention_records=50)
+    for i in range(300):
+        b.produce("t", i, key=b"k")
+    b.enforce_retention()
+    b.reset_offsets("g", "t", [0])  # aims below the retained log
+    assert b.committed_offsets("g", "t") == [250]
+
+
+def test_retention_pin_group_blocks_trimming_past_the_cut():
+    b = Broker(default_partitions=1, retention_records=10)
+    c = b.consumer("router", ["t"])
+    for i in range(200):
+        b.produce("t", i, key=b"k")
+    _drain(c, 200)
+    # the coordinator pinned a cut at offset 120: records >= 120 must
+    # survive even though the cap alone would keep only the last 10
+    b.reset_offsets(RETENTION_PIN_GROUP, "t", [120])
+    assert b.enforce_retention() == 120
+    assert b.beginning_offsets("t") == [120]
+    # a rewind to the cut replays exactly the records past it
+    b.reset_offsets("router", "t", [120])
+    got = _drain(c, 80)
+    assert [r.value for r in got] == list(range(120, 200))
+
+
+def test_amortized_retention_fires_without_explicit_enforce():
+    b = Broker(default_partitions=1, retention_records=64)
+    c = b.consumer("g", ["t"])
+    produced = 0
+    for _ in range(6):
+        b.produce_batch("t", list(range(produced, produced + 512)),
+                        keys=[b"k"] * 512)
+        produced += 512
+        _drain(c, 512)
+    # the per-~1024-append check ran during produce_batch
+    assert b.records_trimmed > 0
+    assert b.beginning_offsets("t")[0] > 0
+
+
+def test_multi_group_min_guards_the_slowest_consumer():
+    b = Broker(default_partitions=1, retention_records=10)
+    fast = b.consumer("fast", ["t"])
+    slow = b.consumer("slow", ["t"])
+    for i in range(100):
+        b.produce("t", i, key=b"k")
+    _drain(fast, 100)
+    _drain(slow, 40, max_records=40)
+    assert b.enforce_retention() == 40  # slow group's position wins
+    assert b.beginning_offsets("t") == [40]
+    got = _drain(slow, 60)
+    assert [r.value for r in got] == list(range(40, 100))
+
+
+# -- durable rotation -------------------------------------------------------
+
+def test_segments_roll_trim_and_replay_with_base(tmp_path):
+    d = str(tmp_path / "bus")
+    # tiny segments so a few hundred records roll many times
+    b = Broker(default_partitions=1, log_dir=d, retention_records=100,
+               segment_bytes=2048)
+    c = b.consumer("g", ["t"])
+    for i in range(500):
+        b.produce("t", i, key=b"k")
+    _drain(c, 500)
+    b.enforce_retention()
+    segs = sorted(f for f in os.listdir(d) if f.startswith("t0_p0."))
+    assert len(segs) >= 2          # rolled
+    assert b.records_trimmed == 400
+    base_after = b.beginning_offsets("t")[0]
+    assert base_after == 400
+    b.close()
+
+    # crash-reopen: offsets permanent, retained tail >= the in-memory one
+    # (disk trims whole segments only, so the log may start earlier)
+    b2 = Broker(default_partitions=1, log_dir=d, retention_records=100,
+                segment_bytes=2048)
+    disk_base = b2.beginning_offsets("t")[0]
+    assert disk_base <= base_after
+    assert b2.end_offsets("t") == [500]
+    # the group resumes exactly where it committed
+    c2 = b2.consumer("g", ["t"])
+    assert c2.poll(timeout_s=0.1) == []
+    r = b2.produce("t", "after", key=b"k")
+    assert r.offset == 500
+    assert [x.value for x in _drain(c2, 1)] == ["after"]
+    # a fresh group replays from the retained disk log-start
+    c3 = b2.consumer("fresh", ["t"])
+    got = _drain(c3, 501 - disk_base)
+    assert got[0].offset == disk_base
+    assert got[0].value == disk_base
+    assert got[-1].value == "after"
+    b2.close()
+
+
+def test_disk_trim_deletes_old_segment_files(tmp_path):
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=1, log_dir=d, retention_records=50,
+               segment_bytes=1024)
+    c = b.consumer("g", ["t"])
+    for i in range(400):
+        b.produce("t", i, key=b"k")
+    _drain(c, 400)
+    files_before = len([f for f in os.listdir(d) if f.startswith("t0_p0.")])
+    b.enforce_retention()
+    files_after = len([f for f in os.listdir(d) if f.startswith("t0_p0.")])
+    assert files_after < files_before
+    b.close()
+
+
+def test_legacy_unsuffixed_segment_replays_as_base_zero(tmp_path):
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=1, log_dir=d)
+    for i in range(10):
+        b.produce("t", i, key=b"k")
+    b.close()
+    # rewrite the chain as a pre-rotation dir: one un-suffixed file
+    segs = [f for f in os.listdir(d) if f.startswith("t0_p0.")]
+    assert len(segs) == 1
+    os.rename(os.path.join(d, segs[0]), os.path.join(d, "t0_p0.log"))
+    b2 = Broker(default_partitions=1, log_dir=d)
+    assert b2.beginning_offsets("t") == [0]
+    assert b2.end_offsets("t") == [10]
+    c = b2.consumer("g", ["t"])
+    assert [r.value for r in _drain(c, 10)] == list(range(10))
+    b2.close()
+
+
+def test_mid_chain_corruption_drops_orphaned_segments(tmp_path):
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=1, log_dir=d, segment_bytes=1024)
+    for i in range(300):
+        b.produce("t", i, key=b"k")
+    b.close()
+    segs = sorted(f for f in os.listdir(d) if f.startswith("t0_p0."))
+    assert len(segs) >= 3
+    # corrupt the SECOND segment's tail: its truncation makes every later
+    # segment's base inconsistent, so replay must keep only the valid
+    # prefix and delete the orphans (records at wrong offsets are worse
+    # than a shorter log — replay re-drives from the cut anyway)
+    second = os.path.join(d, segs[1])
+    with open(second, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.truncate(f.tell() - 3)
+    b2 = Broker(default_partitions=1, log_dir=d)
+    end = b2.end_offsets("t")[0]
+    assert 0 < end < 300
+    c = b2.consumer("g", ["t"])
+    got = _drain(c, end)
+    assert [r.value for r in got] == list(range(end))
+    for f_ in os.listdir(d):
+        if f_.startswith("t0_p0."):
+            assert int(f_.split(".")[1]) < end
+    b2.close()
+
+
+def test_buslog_series_trim_is_offset_exact(tmp_path):
+    log = BusLog(str(tmp_path), segment_bytes=512)
+    log.add_topic("t", 1)
+    from ccfd_tpu.bus.log import encode_entry
+
+    for i in range(100):
+        log.append_payload("t", 0, encode_entry(b"k", 0.0, i))
+    series = log._segment("t", 0)
+    assert len(series.chain) >= 3
+    second_base = series.chain[1][0]
+    # trimming below the second segment's base deletes nothing
+    assert log.trim_partition("t", 0, second_base - 1) == 0
+    # trimming AT it deletes exactly the first segment
+    assert log.trim_partition("t", 0, second_base) == 1
+    assert log.start_offset("t", 0) == second_base
+    log.close()
+    log2 = BusLog(str(tmp_path), segment_bytes=512)
+    log2.replay_topics()
+    base2, recs2 = log2.replay_partition("t", 0)
+    assert base2 == second_base
+    assert [v for _, _, v in recs2] == list(range(second_base, 100))
+    log2.close()
+
+
+def test_retention_accounting_invariant_under_concurrent_consume():
+    """The soak's invariant in miniature: every produced record is either
+    consumed or still retained (never silently lost), with retention
+    active and a consumer racing the producer."""
+    import threading
+
+    b = Broker(default_partitions=3, retention_records=256)
+    c = b.consumer("g", ["t"])
+    N = 20_000
+    consumed = []
+    stop = threading.Event()
+
+    def consume():
+        while not stop.is_set() or True:
+            recs = c.poll(max_records=1000, timeout_s=0.2)
+            consumed.extend(recs)
+            if stop.is_set() and not recs:
+                return
+
+    th = threading.Thread(target=consume)
+    th.start()
+    for i in range(0, N, 500):
+        b.produce_batch("t", list(range(i, i + 500)),
+                        keys=[str(j).encode() for j in range(i, i + 500)])
+    stop.set()
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert len(consumed) == N
+    assert sorted(r.value for r in consumed) == list(range(N))
+    assert b.records_trimmed > 0  # retention ran live during the race
+    # once everything is consumed, one sweep caps memory exactly
+    b.enforce_retention()
+    for p in b._topics["t"].partitions:
+        assert len(p.records) <= 256
+
+
+# -- live crash_restart (the soak's bus-kill primitive) ---------------------
+
+def test_crash_restart_with_consumers_attached_mid_stream(tmp_path):
+    """The bus dies and restarts from its own disk IN PLACE while a
+    consumer group is attached mid-stream: the member keeps its
+    assignment (a reconnecting client) and resumes from the committed
+    offset the durable log replayed — no loss, no duplicates."""
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=2, log_dir=d)
+    c = b.consumer("g", ["t"])
+    for i in range(100):
+        b.produce("t", i, key=str(i).encode())
+    first = _drain(c, 60, max_records=60)
+    assert len(first) == 60
+    snap = b.crash_restart()
+    assert b.crash_restarts == 1
+    assert sum(snap["topics"]["t"]) == 100
+    # mid-stream resume: exactly the unconsumed records arrive, once
+    rest = _drain(c, 40)
+    assert len(rest) == 40
+    assert sorted(r.value for r in first + rest) == list(range(100))
+    # the restarted broker accepts produce at the right offsets
+    r = b.produce("t", "post", key=b"post")
+    assert r.offset == b.end_offsets("t")[r.partition] - 1
+    assert [x.value for x in _drain(c, 1)] == ["post"]
+    b.close()
+
+
+def test_crash_restart_preserves_retention_state(tmp_path):
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=1, log_dir=d, retention_records=50,
+               segment_bytes=1024)
+    c = b.consumer("g", ["t"])
+    for i in range(300):
+        b.produce("t", i, key=b"k")
+    _drain(c, 300)
+    b.enforce_retention()
+    base = b.beginning_offsets("t")[0]
+    assert base > 0
+    b.crash_restart()
+    # disk trims whole segments, so the replayed start may be earlier
+    # than the in-memory base was — but never later, and never zero again
+    assert 0 < b.beginning_offsets("t")[0] <= base
+    assert b.end_offsets("t") == [300]
+    # retention keeps working after the restart
+    for i in range(300, 600):
+        b.produce("t", i, key=b"k")
+    _drain(c, 300)
+    b.enforce_retention()
+    assert b.beginning_offsets("t")[0] >= 550
+    b.close()
+
+
+def test_crash_restart_memory_only_refuses():
+    b = Broker()
+    with pytest.raises(RuntimeError, match="memory-only"):
+        b.crash_restart()
+
+
+def test_crash_restart_while_poller_parked(tmp_path):
+    """A consumer parked in a long poll across the restart must wake and
+    receive records produced AFTER the restart (the condition variable is
+    notified and the replayed state serves the fetch)."""
+    import threading
+
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=1, log_dir=d)
+    c = b.consumer("g", ["t"])
+    b.create_topic("t")
+    got = []
+
+    def park():
+        got.extend(c.poll(timeout_s=5.0))
+
+    th = threading.Thread(target=park)
+    th.start()
+    import time
+    time.sleep(0.2)
+    b.crash_restart()
+    b.produce("t", "wake", key=b"k")
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert [r.value for r in got] == ["wake"]
+    b.close()
+
+
+def test_fetch_rotates_partitions_no_starvation():
+    """A partition early in the assignment must not starve later ones
+    when it alone can fill max_records (found live in the round-5 soak:
+    partition 2's backlog grew for the whole run). The fetch start
+    rotates per poll, like Kafka clients."""
+    b = Broker(default_partitions=3)
+    c = b.consumer("g", ["t"])
+    # load p0 heavily, p2 lightly, keep producing to p0 between polls
+    for i in range(50):
+        b.produce("t", f"p2-{i}", partition=2)
+    for _ in range(2000):
+        b.produce("t", "p0", partition=0)
+    # poll with a max_records one partition can fill: rotation must still
+    # reach p2 within a few polls
+    seen_p2 = 0
+    for _ in range(6):
+        for r in c.poll(max_records=100, timeout_s=0.2):
+            if r.partition == 2:
+                seen_p2 += 1
+    assert seen_p2 == 50

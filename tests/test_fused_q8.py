@@ -239,3 +239,38 @@ def test_transient_warmup_failure_does_not_latch(monkeypatch):
     ref = Scorer(model_name="mlp_q8", params=qp2, batch_sizes=(64,),
                  use_fused=False).score(ds.X[:64])
     np.testing.assert_allclose(scorer.score(ds.X[:64]), ref, atol=1e-5)
+
+
+def test_fold_rejects_wide_last_layer_beyond_f32_exact_bound():
+    """hidden > 1040 breaks the last layer's integer-exact f32 accumulate
+    (127^2 * 1040 < 2^24 <= 127^2 * 1041); the C++ front refuses such
+    models at install and fold_for_kernel must mirror that guard instead
+    of silently breaking bit-parity with the XLA int32 path (ADVICE r4)."""
+    qp, _ = _quantized_params()
+    wide = 1152  # the smallest legal multiple-of-128 hidden over the bound
+    layers = [dict(l) for l in qp["layers"]]
+    layers[2] = dict(layers[2])
+    layers[2]["wq"] = np.ones((wide, 1), np.int8)
+    bad = {"norm": qp["norm"], "layers": layers}
+    with pytest.raises(ValueError, match="1040"):
+        fused_mlp_q8.fold_for_kernel(bad)
+
+
+def test_bf16_rows_are_widened_to_f32_not_fast_pathed():
+    """bf16 input must hit the same f32 wire as every other dtype: the
+    widening is lossless, and a bf16 fast path would silently ship the
+    degraded-accuracy behavior the module docstring warns against."""
+    qp, ds = _quantized_params()
+    kp = fused_mlp_q8.fold_for_kernel(qp)
+    x = jnp.asarray(ds.X[:256])
+    tile = fused_mlp_q8.fit_tile(256)
+    ref = fused_mlp_q8.fused_mlp_q8_score(kp, x, tile=tile, interpret=True)
+    got = fused_mlp_q8.fused_mlp_q8_score(
+        kp, x.astype(jnp.bfloat16), tile=tile, interpret=True)
+    # parity with the f32 path on the SAME (bf16-rounded) values: widen
+    # bf16->f32 first, then it must equal feeding those f32 values directly
+    same = fused_mlp_q8.fused_mlp_q8_score(
+        kp, x.astype(jnp.bfloat16).astype(jnp.float32), tile=tile,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(same))
+    assert np.max(np.abs(np.asarray(got) - np.asarray(ref))) < 0.06
