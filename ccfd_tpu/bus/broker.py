@@ -142,6 +142,7 @@ class Broker:
         fsync: bool = False,
         retention_records: int | None = None,
         segment_bytes: int | None = None,
+        retention_overrides: dict[str, int | None] | None = None,
     ):
         """``retention_records``: cap each partition's retained history.
 
@@ -156,14 +157,28 @@ class Broker:
         the only retention that cannot break recovery by construction).
         ``None`` (default) keeps the historical retain-everything
         behavior. ``segment_bytes`` sizes the on-disk rolling segments
-        (bus/log.py); retention deletes whole rolled segments."""
+        (bus/log.py); retention deletes whole rolled segments.
+
+        ``retention_overrides`` is the per-topic config analog of Kafka's
+        ``retention.bytes`` topic override: ``{topic: cap}`` with ``None``
+        meaning retain-everything for that topic (an audit ledger and a
+        high-volume data topic rarely want the same window). Also
+        settable live via :meth:`set_topic_retention` (the
+        ``kafka-configs --alter --topic`` analog)."""
         self._default_partitions = default_partitions
         self._topics: dict[str, _Topic] = {}
         self._groups: dict[str, dict[tuple[str, int], int]] = {}  # group -> {(t,p): offset}
         self._members: dict[str, list["Consumer"]] = {}
         self._lock = threading.Lock()
         self._data_ready = threading.Condition(self._lock)
-        self.retention_records = retention_records
+        self.retention_records = retention_records or None
+        # normalize at intake: 0 and None both mean retain-everything
+        # (matching the CCFD_BUS_RETENTION_* env forms), so no caller can
+        # accidentally configure a cap-zero topic that trims to the
+        # committed floor
+        self._retention_overrides = {
+            t: (cap or None) for t, cap in (retention_overrides or {}).items()
+        }
         self.records_trimmed = 0   # lifetime count, for soaks/exporters
         self.oor_resets = 0        # fetches clamped to log-start (Kafka's
         #                            auto.offset.reset=earliest analog)
@@ -305,6 +320,13 @@ class Broker:
                 name: [p.end for p in t.partitions]
                 for name, t in self._topics.items()
             }
+            # same locked view as the ends: a separate beginning_offsets
+            # call could land after a produce+trim and publish a negative
+            # retained-records gauge
+            begins = {
+                name: [p.base for p in t.partitions]
+                for name, t in self._topics.items()
+            }
             groups: dict[str, dict[tuple[str, int], int]] = {
                 g: dict(tps) for g, tps in self._groups.items()
             }
@@ -313,7 +335,7 @@ class Broker:
                 for m in members:
                     for tp in m._assignment:
                         tps.setdefault(tp, 0)
-        return {"topics": topics, "groups": groups}
+        return {"topics": topics, "begins": begins, "groups": groups}
 
     # -- produce ----------------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None,
@@ -482,15 +504,34 @@ class Broker:
             self._data_ready.notify_all()
 
     # -- retention --------------------------------------------------------
+    def _topic_cap(self, topic: str) -> int | None:
+        """Effective retained-record cap for a topic (override > default)."""
+        if topic in self._retention_overrides:
+            return self._retention_overrides[topic]
+        return self.retention_records
+
+    def set_topic_retention(self, topic: str, records: int | None) -> None:
+        """Per-topic retention override, live (``kafka-configs --alter``
+        analog): ``records`` caps the topic's partitions; ``None`` or
+        ``0`` makes the topic retain-everything regardless of the broker
+        default (the same sentinel the env forms use)."""
+        records = records or None
+        with self._lock:
+            self._retention_overrides[topic] = records
+            t = self._topics.get(topic)
+            if t is not None and records is not None:
+                self._enforce_retention_locked(topic, t)
+
     def _maybe_retention(self, topic: str, t: _Topic, appended: int) -> None:
         """Amortized retention check, called under the lock after appends:
         runs the real enforcement once per ~1/8th of the retention window
         of fresh records, so the trim's O(dropped) list-delete spreads over
         thousands of produce calls."""
-        if self.retention_records is None:
+        cap = self._topic_cap(topic)
+        if cap is None:
             return
         n = self._since_retention.get(topic, 0) + appended
-        if n < max(1024, self.retention_records // 8):
+        if n < max(1024, cap // 8):
             self._since_retention[topic] = n
             return
         self._since_retention[topic] = 0
@@ -498,20 +539,21 @@ class Broker:
 
     def enforce_retention(self, topic: str | None = None) -> int:
         """Run retention now (tests, shutdown); returns records trimmed."""
-        if self.retention_records is None:
-            return 0
         with self._lock:
             before = self.records_trimmed
             names = [topic] if topic is not None else list(self._topics)
             for name in names:
                 t = self._topics.get(name)
-                if t is not None:
+                if t is not None and self._topic_cap(name) is not None:
                     self._enforce_retention_locked(name, t)
             return self.records_trimmed - before
 
     def _enforce_retention_locked(self, tname: str, t: _Topic) -> None:
+        cap = self._topic_cap(tname)
+        if cap is None:
+            return
         for p, pobj in enumerate(t.partitions):
-            floor = pobj.end - self.retention_records
+            floor = pobj.end - cap
             if floor <= pobj.base:
                 continue
             # delete-before-committed-offset: the trim stops at the

@@ -104,6 +104,38 @@ class RemoteBroker:
             raise RemoteBusError(f"offsets for {topic!r} failed: {code}")
         return body
 
+    def beginning_offsets(self, topic: str) -> list[int]:
+        """Per-partition log-start (0 until server-side retention trims)."""
+        code, body = self._request("GET", f"/topics/{topic}/offsets/begin")
+        if code != 200:
+            raise RemoteBusError(f"begin offsets for {topic!r} failed: {code}")
+        return body
+
+    # -- offset admin (parity with Broker / KafkaAdapter) ------------------
+    def committed_offsets(self, group_id: str, topic: str) -> list[int]:
+        code, body = self._request(
+            "GET", f"/groups/{group_id}/topics/{topic}/offsets")
+        if code != 200:
+            raise RemoteBusError(
+                f"committed offsets for {group_id!r}/{topic!r} failed: {code}")
+        return body
+
+    def reset_offsets(self, group_id: str, topic: str,
+                      offsets: list[int]) -> None:
+        """Rewind (or advance) a group's committed offsets on the server —
+        the missing piece for checkpoint-rewind crash recovery (and the
+        coordinator's retention pin) over the remote transport. Idempotent:
+        re-sending the same reset converges to the same committed state,
+        so transport retries are safe."""
+        code, body = self._request(
+            "POST", f"/groups/{group_id}/topics/{topic}/offsets",
+            {"offsets": [int(o) for o in offsets]},
+        )
+        if code != 200:
+            raise RemoteBusError(
+                f"reset offsets for {group_id!r}/{topic!r} failed: "
+                f"{code} {body}")
+
     def consumer(self, group_id: str, topics: Iterable[str]) -> "RemoteConsumer":
         code, body = self._request(
             "POST", "/consumers", {"group": group_id, "topics": list(topics)}

@@ -44,6 +44,8 @@ from ccfd_tpu.metrics.prom import Registry
 
 _PRODUCE = re.compile(r"^/topics/([\w.-]+)/produce$")
 _OFFSETS = re.compile(r"^/topics/([\w.-]+)/offsets$")
+_BEGIN = re.compile(r"^/topics/([\w.-]+)/offsets/begin$")
+_GROUP_OFFSETS = re.compile(r"^/groups/([\w.-]+)/topics/([\w.-]+)/offsets$")
 _POLL = re.compile(r"^/consumers/(\d+)/poll$")
 _CLOSE = re.compile(r"^/consumers/(\d+)/close$")
 
@@ -107,6 +109,23 @@ class BrokerServer:
         self._g_backlog = r.gauge(
             "bus_topic_backlog", "unconsumed records by group/topic"
         )
+        # retention surface (reference Kafka board's log-size panels):
+        # log-start offset per partition (rises as retention trims), total
+        # records deleted by retention, and out-of-range resets (a fetch
+        # or rewind that aimed below the retained log)
+        self._g_start_offset = r.gauge(
+            "bus_topic_log_start_offset", "log start offset by topic/partition"
+        )
+        self._g_retained = r.gauge(
+            "bus_topic_retained_records", "retained records by topic/partition"
+        )
+        self._g_trimmed = r.gauge(
+            "bus_records_trimmed_total", "records deleted by retention"
+        )
+        self._g_oor = r.gauge(
+            "bus_offset_out_of_range_resets_total",
+            "fetches/rewinds clamped to the log start",
+        )
 
     def refresh_health_gauges(self) -> None:
         """Publish per-topic end offsets and per-group backlog (lag) the way
@@ -116,9 +135,19 @@ class BrokerServer:
         snap = self.broker.health_snapshot()
         topics = snap["topics"]
         groups = snap["groups"]
+        all_begins = snap.get("begins", {})
         for name, ends in topics.items():
+            begins = all_begins.get(name)
             for p, end in enumerate(ends):
-                self._g_end_offset.set(end, labels={"topic": name, "partition": str(p)})
+                labels = {"topic": name, "partition": str(p)}
+                self._g_end_offset.set(end, labels=labels)
+                if begins is not None:
+                    self._g_start_offset.set(begins[p], labels=labels)
+                    self._g_retained.set(end - begins[p], labels=labels)
+        if hasattr(self.broker, "records_trimmed"):
+            self._g_trimmed.set(self.broker.records_trimmed)
+        if hasattr(self.broker, "oor_resets"):
+            self._g_oor.set(self.broker.oor_resets)
         for g, tps in groups.items():
             lag_by_topic: dict[str, int] = {}
             for (tname, p), committed in tps.items():
@@ -210,9 +239,19 @@ class BrokerServer:
                 if path in ("/health/status", "/health", "/healthz"):
                     self._send_json(200, {"status": "ok"})
                     return
+                m = _BEGIN.match(path)
+                if m:
+                    self._send_json(
+                        200, server.broker.beginning_offsets(m.group(1)))
+                    return
                 m = _OFFSETS.match(path)
                 if m:
                     self._send_json(200, server.broker.end_offsets(m.group(1)))
+                    return
+                m = _GROUP_OFFSETS.match(path)
+                if m:
+                    self._send_json(200, server.broker.committed_offsets(
+                        m.group(1), m.group(2)))
                     return
                 self._send_json(404, {"error": "not found"})
 
@@ -323,6 +362,31 @@ class BrokerServer:
                 if m:
                     ok = server._close_consumer(int(m.group(1)))
                     self._send_json(200 if ok else 404, {})
+                    return
+                m = _GROUP_OFFSETS.match(path)
+                if m:
+                    # offset-admin parity with the in-process broker and
+                    # the Kafka adapter (kafka-consumer-groups
+                    # --reset-offsets): the remote transport's missing
+                    # piece for checkpoint-rewind recovery + the
+                    # coordinator's retention pin
+                    offs = payload.get("offsets")
+                    if (not isinstance(offs, list)
+                            or not all(isinstance(o, int)
+                                       and not isinstance(o, bool)
+                                       for o in offs)):
+                        self._send_json(400, {"error": "need offsets: [int]"})
+                        return
+                    try:
+                        server.broker.reset_offsets(
+                            m.group(1), m.group(2), offs)
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                    self._send_json(200, {
+                        "committed": server.broker.committed_offsets(
+                            m.group(1), m.group(2)),
+                    })
                     return
                 self._send_json(404, {"error": "not found"})
 

@@ -52,7 +52,8 @@ def cmd_demo(args: argparse.Namespace) -> int:
     )
 
     broker = Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync,
-                    retention_records=cfg.bus_retention_records or None)
+                    retention_records=cfg.bus_retention_records or None,
+                    retention_overrides=cfg.parsed_retention_overrides())
     reg_router, reg_kie, reg_notify, reg_retrain = (
         Registry(), Registry(), Registry(), Registry(),
     )
@@ -699,7 +700,8 @@ def _broker_for(cfg, registry=None):
     from ccfd_tpu.bus.broker import Broker
 
     return Broker(log_dir=cfg.bus_log_dir or None, fsync=cfg.bus_fsync,
-                    retention_records=cfg.bus_retention_records or None)
+                    retention_records=cfg.bus_retention_records or None,
+                    retention_overrides=cfg.parsed_retention_overrides())
 
 
 def _install_sigterm_as_interrupt() -> None:
@@ -736,7 +738,8 @@ def cmd_bus(args: argparse.Namespace) -> int:
     cfg = Config.from_env()
     log_dir = args.dir or (cfg.bus_log_dir or None)
     broker = Broker(log_dir=log_dir, fsync=cfg.bus_fsync,
-                    retention_records=cfg.bus_retention_records or None)
+                    retention_records=cfg.bus_retention_records or None,
+                    retention_overrides=cfg.parsed_retention_overrides())
     srv = BrokerServer(broker)
     port = srv.start(args.host, args.port)
     print(f"[bus] listening on {args.host}:{port}"

@@ -38,6 +38,10 @@ class Config:
     # frauddetection_cr.yaml's topic config, strengthened so rewind-based
     # crash recovery can never lose its cut (bus/broker.py).
     bus_retention_records: int = 0
+    # per-topic overrides, "topic:cap,topic2:0" (0 = retain everything for
+    # that topic) — Kafka's per-topic retention config analog
+    # (CCFD_BUS_RETENTION_OVERRIDES)
+    bus_retention_overrides: str = ""
     kafka_topic: str = "odh-demo"
     customer_notification_topic: str = "ccd-customer-outgoing"
     customer_response_topic: str = "ccd-customer-response"
@@ -105,6 +109,25 @@ class Config:
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
 
+    def parsed_retention_overrides(self) -> dict[str, int | None]:
+        """``"topic:cap,topic2:0"`` -> {topic: cap, topic2: None}; the form
+        ``Broker(retention_overrides=)`` takes (0 = retain everything for
+        that topic). Malformed entries raise here, at config time, not in
+        the broker's append path."""
+        out: dict[str, int | None] = {}
+        for item in self.bus_retention_overrides.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            topic, sep, cap = item.partition(":")
+            if not sep or not topic:
+                raise ValueError(
+                    f"CCFD_BUS_RETENTION_OVERRIDES entry {item!r}: "
+                    "expected topic:records")
+            n = int(cap)
+            out[topic] = n if n > 0 else None
+        return out
+
     def scorer_dispatch_deadline_ms(self) -> float | None:
         """The value serving code passes to ``Scorer(dispatch_deadline_ms=)``.
 
@@ -133,6 +156,10 @@ class Config:
             bus_retention_records=int(
                 e.get("CCFD_BUS_RETENTION_RECORDS",
                       Config.bus_retention_records)
+            ),
+            bus_retention_overrides=e.get(
+                "CCFD_BUS_RETENTION_OVERRIDES",
+                Config.bus_retention_overrides,
             ),
             kafka_topic=e.get("KAFKA_TOPIC", Config.kafka_topic),
             customer_notification_topic=e.get(

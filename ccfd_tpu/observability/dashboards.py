@@ -192,6 +192,15 @@ def bus_dashboard() -> dict:
                ["rate(bus_topic_records_in_total[5m])"]),
         _panel(3, "Log end offset by topic/partition", ["bus_topic_end_offset"]),
         _panel(4, "Consumer-group backlog (lag)", ["bus_topic_backlog"]),
+        # retention/log-size panels (reference Kafka.json "Log size" row):
+        # retained window per partition plus the retention trim counter —
+        # flat retained + rising start offset == bounded bus
+        _panel(10, "Retained records by topic/partition",
+               ["bus_topic_retained_records"]),
+        _panel(11, "Log start offset (retention floor)",
+               ["bus_topic_log_start_offset"]),
+        _panel(12, "Records trimmed by retention",
+               ["rate(bus_records_trimmed_total[5m])"]),
         # alert-depth health stats (the operational point of the reference
         # Kafka board): red when no consumer is attached, when backlog
         # grows past a stall-scale threshold, or when the serving side has

@@ -206,21 +206,57 @@ class SeqScorer:
         compute_dtype: str = "bfloat16",
         max_customers: int = 20_000,
         registry: Any = None,
+        mesh: Any = None,
     ):
+        """``mesh``: serve the seq dispatch over a device mesh — history
+        batches split over the ``"data"`` axis, params replicated (the
+        same SPMD layout the row Scorer's data-axis path uses; history
+        ASSEMBLY stays host-side either way, which is exactly what the
+        bench's seq_pipeline assembly-vs-dispatch split measures).
+        Bucket sizes round up to data-axis multiples so every shard gets
+        identical static shapes."""
         import jax
         import jax.numpy as jnp
 
         from ccfd_tpu.models import seq as seq_mod
 
-        self.params = params
         self.store = HistoryStore(length=length, max_customers=max_customers)
-        self.batch_sizes = tuple(sorted(batch_sizes))
         dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+        self.mesh = mesh
+        self._batch_sharding = None
+        if mesh is None:
+            self.params = params
 
-        @jax.jit
-        def _apply(p, xs):
-            return seq_mod.apply(p, xs, dtype)
+            @jax.jit
+            def _apply(p, xs):
+                return seq_mod.apply(p, xs, dtype)
 
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ccfd_tpu.parallel.sharding import replicated
+
+            # split over EVERY axis the mesh actually has: the data axis
+            # alone would idle the model-axis devices on a
+            # replicated-param elementwise path, and naming an axis the
+            # mesh lacks (e.g. a data-only mesh) would raise
+            part_axes = tuple(a for a in ("data", "model")
+                              if mesh.shape.get(a, 1) > 1) \
+                or tuple(mesh.axis_names[:1])
+            dsize = 1
+            for a in part_axes:
+                dsize *= mesh.shape[a]
+            batch_sizes = tuple(
+                max(1, -(-b // dsize)) * dsize for b in batch_sizes
+            )
+            self.params = jax.device_put(params, replicated(mesh))
+            self._batch_sharding = NamedSharding(
+                mesh, PartitionSpec(part_axes, None, None))
+            _apply = jax.jit(
+                lambda p, xs: seq_mod.apply(p, xs, dtype),
+                out_shardings=NamedSharding(mesh, PartitionSpec(part_axes)),
+            )
+        self.batch_sizes = tuple(sorted(set(batch_sizes)))
         self._apply = _apply
         self._jax = jax
         self._params_lock = threading.Lock()
@@ -230,9 +266,19 @@ class SeqScorer:
                 "seq_history_customers", "customers with live history"
             )
 
+    def _put_hist(self, hist: np.ndarray):
+        """H2D with placement: on a mesh each device gets its row shard."""
+        if self._batch_sharding is None:
+            return hist
+        return self._jax.device_put(hist, self._batch_sharding)
+
     def swap_params(self, params: Any) -> None:
         """Hot-swap model weights (the online-retrain surface the row
         scorer exposes; same treedef ⇒ the jit cache is reused)."""
+        if self.mesh is not None:
+            from ccfd_tpu.parallel.sharding import replicated
+
+            params = self._jax.device_put(params, replicated(self.mesh))
         with self._params_lock:
             self.params = params
 
@@ -240,7 +286,8 @@ class SeqScorer:
         for b in self.batch_sizes:
             xs = np.zeros((b, self.store.length, self.store.num_features),
                           np.float32)
-            self._jax.block_until_ready(self._apply(self.params, xs))
+            self._jax.block_until_ready(
+                self._apply(self.params, self._put_hist(xs)))
 
     def _bucket(self, n: int) -> int:
         for b in self.batch_sizes:
@@ -283,7 +330,7 @@ class SeqScorer:
                 )
             with self._params_lock:
                 params = self.params
-            proba = np.asarray(self._apply(params, hist))
+            proba = np.asarray(self._apply(params, self._put_hist(hist)))
             merged.update(staged)
             out[start:stop] = proba[:m]
             start = stop

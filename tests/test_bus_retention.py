@@ -399,3 +399,37 @@ def test_fetch_rotates_partitions_no_starvation():
             if r.partition == 2:
                 seen_p2 += 1
     assert seen_p2 == 50
+
+
+def test_per_topic_retention_overrides():
+    """Kafka's per-topic retention config analog: the audit ledger can
+    retain everything while the data topic stays capped, and a live
+    set_topic_retention applies immediately."""
+    b = Broker(default_partitions=1, retention_records=50,
+               retention_overrides={"ledger": None})
+    c = b.consumer("g", ["data", "ledger"])
+    for i in range(300):
+        b.produce("data", i, key=b"k")
+        b.produce("ledger", i, key=b"k")
+    _drain(c, 600)
+    b.enforce_retention()
+    assert b.beginning_offsets("data") == [250]
+    assert b.beginning_offsets("ledger") == [0]   # override: unbounded
+    # live alter: cap the ledger now, enforcement applies in the call
+    b.set_topic_retention("ledger", 20)
+    assert b.beginning_offsets("ledger") == [280]
+
+
+def test_config_parses_retention_overrides():
+    from ccfd_tpu.config import Config
+
+    cfg = Config.from_env({
+        "CCFD_BUS_RETENTION_RECORDS": "1000",
+        "CCFD_BUS_RETENTION_OVERRIDES": "ccd-audit:0, odh-demo:500",
+    })
+    assert cfg.parsed_retention_overrides() == {
+        "ccd-audit": None, "odh-demo": 500}
+    import pytest
+    bad = Config.from_env({"CCFD_BUS_RETENTION_OVERRIDES": "nocolon"})
+    with pytest.raises(ValueError, match="topic:records"):
+        bad.parsed_retention_overrides()

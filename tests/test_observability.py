@@ -28,6 +28,9 @@ REFERENCE_CONTRACT_METRICS = [
     "bus_topic_records_in_total",
     "bus_topic_end_offset",
     "bus_topic_backlog",
+    "bus_topic_retained_records",
+    "bus_topic_log_start_offset",
+    "bus_records_trimmed_total",
     "bus_consumers",
     "retrain_param_swaps_total",
     "retrain_labels_total",
