@@ -144,6 +144,20 @@ class KafkaAdapter:
         eo = self._meta_consumer.end_offsets(tps)
         return [eo[tp] for tp in tps]
 
+    def beginning_offsets(self, topic: str) -> list[int]:
+        """Per-partition log-start (rises as the cluster's retention
+        deletes segments) — Broker/RemoteBroker surface parity."""
+        if self._meta_consumer is None:
+            self._meta_consumer = self._kafka.KafkaConsumer(
+                bootstrap_servers=self.bootstrap
+            )
+        parts = self._meta_consumer.partitions_for_topic(topic)
+        if not parts:
+            return []
+        tps = [self._kafka.TopicPartition(topic, p) for p in sorted(parts)]
+        bo = self._meta_consumer.beginning_offsets(tps)
+        return [bo[tp] for tp in tps]
+
     # -- offset admin (crash-recovery surface, Broker-parity) -------------
     def _group_admin(self, group_id: str):
         """Cached group-scoped consumer for offset admin: the checkpoint

@@ -157,6 +157,12 @@ class KafkaConsumer:
             out[tp] = self._broker.end_offsets(tp.topic)[tp.partition]
         return out
 
+    def beginning_offsets(self, tps: Iterable[TopicPartition]) -> dict[TopicPartition, int]:
+        out = {}
+        for tp in tps:
+            out[tp] = self._broker.beginning_offsets(tp.topic)[tp.partition]
+        return out
+
     def close(self) -> None:
         if self._inner is not None:
             self._inner.close()

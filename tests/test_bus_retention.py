@@ -433,3 +433,21 @@ def test_config_parses_retention_overrides():
     bad = Config.from_env({"CCFD_BUS_RETENTION_OVERRIDES": "nocolon"})
     with pytest.raises(ValueError, match="topic:records"):
         bad.parsed_retention_overrides()
+
+
+def test_pin_survives_crash_restart(tmp_path):
+    """The coordinator's retention pin is a durable committed position:
+    a bus crash_restart must replay it, so retention stays blocked at
+    the pinned cut in the restarted broker too."""
+    d = str(tmp_path / "bus")
+    b = Broker(default_partitions=1, log_dir=d, retention_records=10)
+    c = b.consumer("router", ["t"])
+    for i in range(100):
+        b.produce("t", i, key=b"k")
+    _drain(c, 100)
+    b.reset_offsets(RETENTION_PIN_GROUP, "t", [60])
+    b.crash_restart()
+    assert b.committed_offsets(RETENTION_PIN_GROUP, "t") == [60]
+    assert b.enforce_retention() == 60   # still stops at the pin
+    assert b.beginning_offsets("t") == [60]
+    b.close()

@@ -166,3 +166,14 @@ def test_reset_offsets_clamps_and_validates():
     assert a.committed_offsets("g", "tx2") == a.end_offsets("tx2")
     with pytest.raises(ValueError):
         a.reset_offsets("g", "tx2", [0])
+
+
+def test_beginning_offsets_parity():
+    """Broker/RemoteBroker/KafkaAdapter all expose beginning_offsets —
+    the cluster-retention-aware log-start (round 5 surface parity)."""
+    a = adapter()
+    for i in range(10):
+        a.produce("t", {"i": i}, key=str(i).encode())
+    ends = a.end_offsets("t")
+    assert a.beginning_offsets("t") == [0] * len(ends)
+    a.close()
