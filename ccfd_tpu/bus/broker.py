@@ -313,8 +313,12 @@ class Broker:
         """One consistent view for health/lag exporters: per-topic partition
         end offsets plus per-group committed offsets, with groups that
         registered but never committed (e.g. a consumer wedged since
-        startup) seeded at offset 0 over their assigned partitions — their
-        lag reads as the full log, the way Kafka reports it."""
+        startup) seeded at the partition LOG-START over their assigned
+        partitions — their lag reads as every deliverable record (the way
+        Kafka reports lag against the log-start), not as a full log whose
+        trimmed head could never be delivered. Retention's own floor keeps
+        the stronger seed (0): an attached-but-never-committed member
+        still protects its whole backlog from deletion."""
         with self._lock:
             topics = {
                 name: [p.end for p in t.partitions]
@@ -334,7 +338,10 @@ class Broker:
                 tps = groups.setdefault(g, {})
                 for m in members:
                     for tp in m._assignment:
-                        tps.setdefault(tp, 0)
+                        tps.setdefault(
+                            tp,
+                            self._topics[tp[0]].partitions[tp[1]].base,
+                        )
         return {"topics": topics, "begins": begins, "groups": groups}
 
     # -- produce ----------------------------------------------------------
@@ -359,14 +366,16 @@ class Broker:
             pobj = t.partitions[part]
             item = (topic, part, pobj.end, key, value, now)
             if self._log is not None:
-                # encode BEFORE the in-memory append: an unencodable record
-                # must fail cleanly, not leave memory and disk diverged
+                # encode BEFORE any mutation: an unencodable record must
+                # fail cleanly, not leave memory and disk diverged — and
+                # the LOG write precedes the in-memory append (same
+                # failure contract as produce_batch): memory must never
+                # hold a record the log would lose across a restart
                 from ccfd_tpu.bus.log import encode_entry
 
                 payload = encode_entry(key, now, value)
-            pobj.records.append(item)  # exact tuple: GC-untrackable
-            if self._log is not None:
                 self._log.append_payload(topic, part, payload)
+            pobj.records.append(item)  # exact tuple: GC-untrackable
             self._maybe_retention(topic, t, 1)
             self._data_ready.notify_all()
             return Record._make(item)
@@ -609,8 +618,14 @@ class Broker:
             if eff > start:
                 # committed position fell below the log-start (possible
                 # only for positions retention proved consumed or that a
-                # rewind aimed below the retained log): reset-to-earliest
+                # rewind aimed below the retained log): reset-to-earliest.
+                # Commit the clamped position even when the take is empty
+                # (idle topic: base == end) — otherwise every subsequent
+                # poll re-detects the same clamp and oor_resets inflates
+                # forever on a topic that had exactly one reset.
                 self.oor_resets += 1
+                if not take:
+                    self._commit(consumer.group_id, (tname, p), eff)
             if take:
                 # stored as exact tuples (GC untracking, see Record);
                 # consumers get the Record view

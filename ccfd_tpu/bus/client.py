@@ -41,11 +41,17 @@ class RemoteBroker:
         pool_size: int = 4,
         timeout_s: float = 40.0,  # > max server-side long-poll (30s)
         retries: int = 2,
+        breaker=None,
+        faults=None,
     ):
+        # breaker/faults ride the shared transport (utils/httpclient.py);
+        # note poll redelivery still holds under injected faults — the seq
+        # only advances on a successful, uncorrupted response
         self._http = PooledHTTPClient(
             base_url, default_port=9092, pool_size=pool_size,
             timeout_s=timeout_s, retries=retries,
             scheme_error="RemoteBroker needs an http:// URL",
+            breaker=breaker, faults=faults,
         )
 
     def _request(

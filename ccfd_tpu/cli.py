@@ -798,10 +798,21 @@ def cmd_router(args: argparse.Namespace) -> int:
     # the adapter's produce/send-error counters land in the router's
     # scraped registry (the KafkaCluster board's adapter panels)
     broker = _broker_for(cfg, registry=router_registry)
+    # standing fault plan from CCFD_FAULTS (runtime/faults.py): degraded
+    # edges are injectable on the standalone role exactly like under the
+    # platform operator
+    fault_plan = None
+    if cfg.faults_spec:
+        from ccfd_tpu.runtime.faults import FaultPlan
+
+        fault_plan = FaultPlan.from_string(cfg.faults_spec)
+    scorer_faults = (fault_plan.injector("scorer", router_registry)
+                     if fault_plan else None)
+    host_score_fn = None
     if cfg.seldon_url.startswith("http"):
         from ccfd_tpu.serving.client import SeldonClient
 
-        score_fn = SeldonClient(cfg).score
+        score_fn = SeldonClient(cfg, faults=scorer_faults).score
     else:
         from ccfd_tpu.serving.scorer import Scorer
 
@@ -810,12 +821,25 @@ def cmd_router(args: argparse.Namespace) -> int:
                         dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms())
         scorer.warmup()
         score_fn = scorer.score
+        if scorer_faults is not None:
+            score_fn = scorer_faults.wrap_fn(score_fn)
+        if scorer.has_host_forward:
+            host_score_fn = scorer.host_score
     from ccfd_tpu.process.client import EngineRestClient
 
     engine = EngineRestClient(cfg.kie_server_url,
                               timeout_s=cfg.seldon_timeout_ms / 1000.0,
                               retries=cfg.client_retries)
-    router = Router(cfg, broker, score_fn, engine, registry=router_registry)
+    if fault_plan is not None:
+        inj = fault_plan.injector("engine", router_registry)
+        if inj is not None:
+            engine = inj.wrap(engine, methods=("start_process",
+                                               "start_process_batch",
+                                               "signal"))
+    # production role: the degradation ladder is on (same default as the
+    # platform operator) — a sick scorer edge degrades, never stalls
+    router = Router(cfg, broker, score_fn, engine, registry=router_registry,
+                    host_score_fn=host_score_fn, degrade=True)
     # the reference scrapes the router on :8091/prometheus
     # (reference README.md:503-507); the standalone role must expose the
     # same surface the generated k8s Service/annotations point at

@@ -66,6 +66,13 @@ class Config:
     # knob is the timeout; retries keep the pipeline up across scorer
     # restarts under the supervisor)
     client_retries: int = 2
+    # standing network fault plan (CCFD_FAULTS,
+    # "edge:latency=50,jitter=20,error=0.1;edge2:blackhole" —
+    # runtime/faults.py): degraded-edge injection on the named client
+    # edges (scorer/engine/bus/store). "" = no faults. The chaos CR
+    # block's `faults` option is the storm-scheduled form of the same
+    # syntax.
+    faults_spec: str = ""
 
     # --- producer (reference ProducerDeployment.yaml:88-97) ---
     producer_topic: str = "odh-demo"
@@ -183,6 +190,7 @@ class Config:
             ),
             seldon_pool_size=int(e.get("SELDON_POOL_SIZE", str(Config.seldon_pool_size))),
             client_retries=int(e.get("CCFD_CLIENT_RETRIES", str(Config.client_retries))),
+            faults_spec=e.get("CCFD_FAULTS", Config.faults_spec),
             producer_topic=e.get("topic", Config.producer_topic),
             s3_endpoint=e.get("s3endpoint", Config.s3_endpoint),
             s3_bucket=e.get("s3bucket", Config.s3_bucket),

@@ -17,6 +17,8 @@ metric names, one builder per board:
 - Analytics   — mesh analytics jobs + drift PSI (the SparkMetrics.json analog:
   Spark executor panels become device-mesh worker/job panels)
 - Retrain     — online-training health (new capability; no reference analog)
+- Resilience  — fault-injection / circuit-breaker / degradation-ladder
+  surface (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -272,6 +274,40 @@ def analytics_dashboard() -> dict:
     return _dashboard("CCFD Analytics", "ccfd-analytics", p)
 
 
+def resilience_dashboard() -> dict:
+    """Degraded-edge health board (round 6; no reference analog).
+
+    Reads the fault-injection / circuit-breaker / degradation-ladder
+    surface: breaker state per edge (``ccfd_breaker_state``: 0 closed,
+    1 half-open, 2 open — runtime/breaker.py), per-tier degraded scoring
+    and load shedding from the router's ladder (router/router.py), and the
+    chaos layer's injected-fault rates (runtime/faults.py), so an operator
+    can see AT A GLANCE which edge is sick, which tier is absorbing it,
+    and whether the storm is injected or real.
+    """
+    p = [
+        _alert_stat(0, "Any circuit open", ["max(ccfd_breaker_state)"],
+                    red_above=2),
+        _panel(1, "Breaker state by edge (0 closed / 1 half-open / 2 open)",
+               ["ccfd_breaker_state"]),
+        _panel(2, "Breaker transitions / s",
+               ["rate(ccfd_breaker_transitions_total[5m])"]),
+        _panel(3, "Degraded scoring by tier / s",
+               ['rate(router_degraded_total{tier="host"}[5m])',
+                'rate(router_degraded_total{tier="rules"}[5m])']),
+        _alert_stat(4, "Load shedding / s", ["rate(router_shed_total[5m])"],
+                    red_above=1),
+        _panel(5, "Injected faults by edge+kind / s",
+               ["rate(faults_injected_total[5m])"]),
+        _panel(6, "Scorer-edge failures / s",
+               ["rate(router_score_errors_total[5m])"]),
+        _panel(7, "Chaos: service kills / fault windows per s",
+               ["rate(chaos_injections_total[5m])",
+                "rate(chaos_fault_windows_total[5m])"]),
+    ]
+    return _dashboard("CCFD Resilience", "ccfd-resilience", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -292,6 +328,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "KafkaCluster": kafka_cluster_dashboard(),
         "Analytics": analytics_dashboard(),
         "Retrain": retrain_dashboard(),
+        "Resilience": resilience_dashboard(),
     }
 
 

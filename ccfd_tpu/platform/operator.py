@@ -116,6 +116,7 @@ class Platform:
         self.exporter = None
         self.health_server = None
         self.chaos = None
+        self.fault_plan = None  # runtime/faults.FaultPlan when configured
         self.router = None
         self.investigator = None
         self.recovery = None  # CheckpointCoordinator when crash_recovery on
@@ -131,6 +132,28 @@ class Platform:
             return self
         spec, cfg = self.spec, self.cfg
         self.supervisor = Supervisor()
+
+        # 0. network fault plan (runtime/faults.py): CR `chaos.faults`
+        # (ONLY when the chaos component is enabled — chaos is always
+        # opt-in, and a disabled block must not leave standing faults
+        # wired into production edges) or the CCFD_FAULTS env (its own
+        # explicit opt-in). A standing (env) plan starts ACTIVE; a
+        # storm-scheduled plan (chaos.fault_interval_s) starts inactive
+        # and the ChaosMonkey drives its duty cycle. Edges wire up as
+        # each component builds below.
+        chaos_spec = spec.component("chaos")
+        fault_text = (chaos_spec.opt("faults", "")
+                      if chaos_spec.enabled else "") or cfg.faults_spec
+        storm_interval = (chaos_spec.opt("fault_interval_s", None)
+                          if chaos_spec.enabled else None)
+        if fault_text:
+            from ccfd_tpu.runtime.faults import FaultPlan
+
+            self.fault_plan = FaultPlan.from_string(
+                fault_text,
+                seed=int(chaos_spec.opt("seed", 0)),
+                active=storm_interval is None,
+            )
 
         # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
         if spec.component("store").enabled:
@@ -255,8 +278,13 @@ class Platform:
                 self.supervisor,
                 interval_s=float(c.opt("interval_s", 30.0)),
                 seed=int(c.opt("seed", 0)),
-                targets=list(targets) if targets else None,
+                # targets: [] is a valid choice — storms only, no kills
+                targets=(list(targets) if targets is not None else None),
                 registry=self._registry("chaos"),
+                fault_plan=self.fault_plan,
+                fault_interval_s=(float(c.opt("fault_interval_s"))
+                                  if c.opt("fault_interval_s") else None),
+                fault_duration_s=float(c.opt("fault_duration_s", 2.0)),
             ).start()
 
         self._up = True
@@ -456,6 +484,9 @@ class Platform:
         from ccfd_tpu.router.router import Router
         from ccfd_tpu.runtime.supervisor import RestartPolicy
 
+        c = self.spec.component("router")
+        reg = self._registry("router")
+        host_score_fn = None
         if self.scorer is not None:
             from ccfd_tpu.serving.history import SeqScorer
 
@@ -463,10 +494,27 @@ class Platform:
             # detects score_with_ids and feeds it the decoded records
             score_fn = (self.scorer if isinstance(self.scorer, SeqScorer)
                         else self.scorer.score)
+            if getattr(self.scorer, "has_host_forward", False):
+                # the ladder's host tier: a numpy forward that never
+                # touches the (possibly partitioned) device edge
+                host_score_fn = self.scorer.host_score
         else:  # remote scorer over the Seldon REST contract
             from ccfd_tpu.serving.client import SeldonClient
 
-            score_fn = SeldonClient(self.cfg).score
+            score_fn = SeldonClient(
+                self.cfg,
+                faults=(self.fault_plan.injector("scorer", reg)
+                        if self.fault_plan else None),
+            ).score
+        if self.fault_plan is not None and self.scorer is not None:
+            # in-process scorer edge: same injection point the REST client
+            # gets, wrapped around the callable
+            inj = self.fault_plan.injector("scorer", reg)
+            if inj is not None:
+                if hasattr(score_fn, "score_with_ids"):
+                    score_fn = inj.wrap(score_fn)  # SeqScorer object
+                else:
+                    score_fn = inj.wrap_fn(score_fn)
         engine = self.engine
         if engine is None and self.cfg.kie_server_url.startswith("http"):
             # remote engine over the KIE-shaped REST contract
@@ -477,8 +525,23 @@ class Platform:
                 timeout_s=self.cfg.seldon_timeout_ms / 1000.0,
                 retries=self.cfg.client_retries,
             )
+        if self.fault_plan is not None and engine is not None:
+            inj = self.fault_plan.injector("engine", reg)
+            if inj is not None:
+                engine = inj.wrap(
+                    engine,
+                    methods=("start_process", "start_process_batch",
+                             "signal"),
+                )
         router = Router(
-            self.cfg, self.broker, score_fn, engine, self._registry("router")
+            self.cfg, self.broker, score_fn, engine, reg,
+            host_score_fn=host_score_fn,
+            # the ladder is the production default: a sick scorer edge
+            # degrades scoring quality instead of dropping batches
+            # (router.degrade: false restores the historical drop path)
+            degrade=bool(c.opt("degrade", True)),
+            max_inflight=(int(c.opt("max_inflight"))
+                          if c.opt("max_inflight") is not None else None),
         )
         self.router = router
         self.supervisor.add_thread_service(
@@ -612,7 +675,10 @@ class Platform:
 
         c = self.spec.component("producer")
         producer = Producer(
-            self.cfg, self.broker, registry=self._registry("producer")
+            self.cfg, self.broker, registry=self._registry("producer"),
+            store_faults=(self.fault_plan.injector(
+                "store", self._registry("producer"))
+                if self.fault_plan else None),
         )
         limit = c.opt("transactions")
         rate = c.opt("rate")

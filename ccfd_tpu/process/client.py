@@ -23,11 +23,18 @@ class EngineRestClient:
         pool_size: int = 4,
         timeout_s: float = 5.0,
         retries: int = 2,
+        breaker=None,
+        faults=None,
     ):
+        # breaker/faults ride the shared transport (utils/httpclient.py):
+        # an open circuit on the engine hop refuses instantly — the router
+        # counts the group as start errors and keeps routing instead of
+        # stalling a full timeout per micro-batch
         self._http = PooledHTTPClient(
             base_url, default_port=8090, pool_size=pool_size,
             timeout_s=timeout_s, retries=retries,
             scheme_error="unsupported scheme in KIE_SERVER_URL",
+            breaker=breaker, faults=faults,
         )
 
     def _request(

@@ -23,16 +23,20 @@ from ccfd_tpu.data.ccfd import (
 from ccfd_tpu.metrics.prom import Registry
 
 
-def dataset_from_store(cfg: Config, limit: int | None = None) -> Dataset:
+def dataset_from_store(cfg: Config, limit: int | None = None,
+                       faults=None, breaker=None) -> Dataset:
     """Fetch ``filename`` from ``s3bucket`` at ``s3endpoint`` — exactly the
     reference producer's data path (ProducerDeployment.yaml:90-95): endpoint +
-    bucket + key env vars, credentials from the ``keysecret`` pair."""
+    bucket + key env vars, credentials from the ``keysecret`` pair.
+    ``faults``/``breaker`` guard the producer↔store edge
+    (runtime/faults.py, runtime/breaker.py)."""
     from ccfd_tpu.store.client import S3Client
     from ccfd_tpu.store.objectstore import Credentials
 
     client = S3Client(
         cfg.s3_endpoint,
         Credentials(cfg.access_key_id, cfg.secret_access_key),
+        faults=faults, breaker=breaker,
     )
     return load_csv_bytes(client.get(cfg.s3_bucket, cfg.filename), limit=limit)
 
@@ -44,13 +48,16 @@ class Producer:
         broker: Broker,
         dataset: Dataset | None = None,
         registry: Registry | None = None,
+        store_faults=None,
+        store_breaker=None,
     ):
         self.cfg = cfg
         self.broker = broker
         if dataset is not None:
             self.dataset = dataset
         elif cfg.s3_endpoint:
-            self.dataset = dataset_from_store(cfg)
+            self.dataset = dataset_from_store(
+                cfg, faults=store_faults, breaker=store_breaker)
         else:
             self.dataset = load_dataset()
         self.registry = registry or Registry()

@@ -18,6 +18,20 @@ Business counters match the reference metric names (README.md:522-530,
 Router.json:88-326): ``transaction_incoming_total``,
 ``transaction_outgoing_total{type}``, ``notifications_outgoing_total``,
 ``notifications_incoming_total{response}``.
+
+**Degradation ladder** (round 6; runtime/breaker.py): with ``degrade`` on
+(implicit when a ``host_score_fn`` or ``breaker`` is supplied), a sick
+scorer edge degrades scoring quality instead of stalling or dropping the
+ingest loop — device scorer → host-tier numpy forward → rules-only
+conservative scoring — with per-tier ``router_degraded_total{tier}``
+counters, a circuit breaker on the scorer edge (an OPEN circuit skips the
+device instantly, so a blackholed endpoint costs one bounded stall per
+breaker window, not one per micro-batch), response validation (a corrupt
+scorer reply — wrong shape, non-finite probabilities — counts as an edge
+failure and falls down the ladder), and bounded in-flight load shedding
+(``max_inflight`` records consumed-but-unrouted; oldest dropped first,
+counted in ``router_shed_total``). Without the ladder the historical
+semantics hold: a scorer failure drops that batch, counted.
 """
 
 from __future__ import annotations
@@ -185,6 +199,10 @@ class Router:
         registry: Registry | None = None,
         max_batch: int = 4096,
         rules: RuleSet | None = None,
+        host_score_fn: Callable[[np.ndarray], np.ndarray] | None = None,
+        breaker: "Any | None" = None,
+        degrade: bool | None = None,
+        max_inflight: int | None = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -273,7 +291,37 @@ class Router:
             "router_signal_errors_total", "failed signal forwards"
         )
         self._c_score_err = r.counter(
-            "router_score_errors_total", "transactions dropped by scorer failures"
+            "router_score_errors_total",
+            "scorer-edge failures: transactions dropped, or absorbed by "
+            "degraded tiers when the ladder is on",
+        )
+        # -- degradation ladder (see module docstring) ---------------------
+        self._host_score = host_score_fn
+        self._degrade = (degrade if degrade is not None
+                         else (host_score_fn is not None
+                               or breaker is not None))
+        self._breaker = breaker
+        if self._degrade and breaker is None:
+            # default scorer-edge breaker: an open circuit is what keeps a
+            # blackholed scorer from stalling every micro-batch
+            from ccfd_tpu.runtime.breaker import CircuitBreaker
+
+            self._breaker = CircuitBreaker(
+                edge="scorer", registry=r, min_calls=3,
+                failure_ratio=0.5, cooldown_s=1.0,
+            )
+        self.max_inflight = (int(max_inflight) if max_inflight is not None
+                             else 2 * max_batch)
+        self._amount_idx = FEATURE_NAMES.index("Amount")
+        self._c_degraded = r.counter(
+            "router_degraded_total",
+            "transactions scored by a degraded tier (host numpy forward "
+            "or rules-only)",
+        )
+        self._c_shed = r.counter(
+            "router_shed_total",
+            "transactions dropped by bounded-in-flight load shedding "
+            "(oldest first)",
         )
         self._stop = threading.Event()
         # checkpoint barrier (runtime/recovery.py): pause() parks the run
@@ -348,6 +396,71 @@ class Router:
         ts = np.fromiter((r.timestamp for r in records), np.float64, n)
         return x, txs, ts
 
+    # -- degradation ladder ------------------------------------------------
+    def _shed_oldest(self, records: list, inflight_rows: int) -> list:
+        """Bounded in-flight: drop the OLDEST consumed records when a poll
+        would push consumed-but-unrouted work past ``max_inflight``. Under
+        total saturation (every tier slow AND the bus backlogged) shedding
+        the stalest work keeps decision latency bounded for what remains —
+        the SRE load-shedding move. Shed records still count as incoming
+        (they were consumed); ``router_shed_total`` records the drops."""
+        allowed = self.max_inflight - inflight_rows
+        if len(records) <= allowed:
+            return records
+        shed = len(records) - max(0, allowed)
+        self._c_in.inc(shed)
+        self._c_shed.inc(shed)
+        return records[shed:]
+
+    def _rules_proba(self, x: np.ndarray) -> np.ndarray:
+        """Rules-only tier: a conservative ``FRAUD_THRESHOLD`` stand-in
+        with no model at all. High-amount transactions (the reference
+        engine's own risk split, CCFD_LOW_AMOUNT) take proba exactly AT
+        the threshold so the salience-ordered fraud rule fires — flagging
+        for investigation is the conservative failure mode for a fraud
+        system — and the rest score 0.0 (standard). Every transaction
+        still gets a decision through the normal rule base."""
+        thr = np.float32(self.cfg.fraud_threshold)
+        risky = x[:, self._amount_idx] >= self.cfg.low_amount_threshold
+        return np.where(risky, thr, np.float32(0.0)).astype(np.float32)
+
+    def _score_tiered(self, x: np.ndarray, txs: list) -> np.ndarray:
+        """device scorer → host numpy forward → rules-only. Never raises:
+        the bottom tier is pure numpy over data already in hand."""
+        br = self._breaker
+        if br is None or br.allow():
+            t0 = time.perf_counter()
+            try:
+                proba = np.asarray(self._score2(x, txs))
+                lat = time.perf_counter() - t0
+                # corrupt-response validation: a fault-injected (or truly
+                # version-skewed) reply with the wrong shape or non-finite
+                # values must degrade, not route garbage decisions
+                if proba.shape != (len(txs),) or not np.isfinite(proba).all():
+                    raise ValueError("invalid scorer response")
+                if br is not None:
+                    br.record_success(lat)
+                return proba
+            except Exception:
+                if br is not None:
+                    br.record_failure(time.perf_counter() - t0)
+                self._c_score_err.inc(len(txs))
+        if self._host_score is not None:
+            try:
+                proba = np.asarray(self._host_score(x), np.float32)
+                if proba.shape == (len(txs),) and np.isfinite(proba).all():
+                    self._c_degraded.inc(len(txs), labels={"tier": "host"})
+                    return proba
+            except Exception:  # noqa: BLE001 - fall to the rules tier
+                pass
+        self._c_degraded.inc(len(txs), labels={"tier": "rules"})
+        return self._rules_proba(x)
+
+    def _score_batch(self, x: np.ndarray, txs: list) -> np.ndarray:
+        if self._degrade:
+            return self._score_tiered(x, txs)
+        return self._score2(x, txs)
+
     # -- one synchronous cycle (used by tests and the run loop) ------------
     def step(self, poll_timeout_s: float = 0.0) -> int:
         """Route one poll's worth of work; returns #transactions scored."""
@@ -355,9 +468,12 @@ class Router:
         records = self._poll_batch(poll_timeout_s)
         if not records:
             return 0
+        records = self._shed_oldest(records, 0)
+        if not records:
+            return 0
         x, txs, ts = self._decode_batch(records)
         t0 = time.perf_counter()
-        proba = self._score2(x, txs)
+        proba = self._score_batch(x, txs)
         self._h_score_s.observe(time.perf_counter() - t0)
         return self._route(x, txs, proba, ts)
 
@@ -510,7 +626,7 @@ class Router:
             # time INSIDE the worker so the histogram records the scorer
             # round trip, not dispatch + however long the loop polled
             t0 = time.perf_counter()
-            proba = self._score2(x, txs)
+            proba = self._score_batch(x, txs)
             self._h_score_s.observe(time.perf_counter() - t0)
             return proba
 
@@ -546,6 +662,12 @@ class Router:
                 records = self._poll_batch(
                     0.0 if pending is not None else poll_timeout_s
                 )
+                if records:
+                    # bounded in-flight: batch k-1's rows are still
+                    # consumed-but-unrouted while k is being submitted
+                    records = self._shed_oldest(
+                        records, len(pending[2]) if pending else 0
+                    )
                 fut = None
                 if records:
                     x, txs, ts = self._decode_batch(records)

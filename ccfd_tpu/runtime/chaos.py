@@ -9,9 +9,17 @@ the supervisor restarts it, consumers resume from committed offsets, the
 pipeline keeps scoring — run in CI (tests/test_chaos.py) instead of being
 discovered in production.
 
+Beyond whole-service kills, the monkey also drives **network fault
+storms** (round 6): handed a ``FaultPlan`` (runtime/faults.py) it toggles
+the plan active for ``fault_duration_s`` every ``fault_interval_s`` — a
+window where every edge the plan names runs degraded (slow, flaky,
+partitioned) — which is what exercises the circuit breakers and the
+router's degradation ladder rather than the crash-restart machinery.
+
 Determinism: victim choice and kill times derive from ``seed``, so a chaos
 run is replayable. Every injection lands in ``history`` and, when a
-registry is given, in ``chaos_injections_total{service=...}``.
+registry is given, in ``chaos_injections_total{service=...}``; fault
+windows land in ``fault_windows`` and ``chaos_fault_windows_total``.
 """
 
 from __future__ import annotations
@@ -32,6 +40,9 @@ class ChaosMonkey:
         seed: int = 0,
         targets: list[str] | None = None,
         registry: Registry | None = None,
+        fault_plan=None,
+        fault_interval_s: float | None = None,
+        fault_duration_s: float = 2.0,
     ):
         self._sup = supervisor
         self.interval_s = interval_s
@@ -40,11 +51,24 @@ class ChaosMonkey:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.history: list[tuple[float, str]] = []  # (monotonic time, service)
+        # network fault storms (runtime/faults.FaultPlan): the plan should
+        # be built active=False; the monkey owns its duty cycle
+        self._fault_plan = fault_plan
+        self.fault_interval_s = fault_interval_s
+        self.fault_duration_s = fault_duration_s
+        self._fault_thread: threading.Thread | None = None
+        self.fault_windows: list[tuple[float, float]] = []  # (start, end)
         self._c_injected = None
+        self._c_fault_windows = None
         if registry is not None:
             self._c_injected = registry.counter(
                 "chaos_injections_total", "injected service failures"
             )
+            if fault_plan is not None:
+                self._c_fault_windows = registry.counter(
+                    "chaos_fault_windows_total",
+                    "network fault-storm windows driven by the monkey",
+                )
 
     def _eligible(self) -> list[str]:
         status = self._sup.status()
@@ -73,11 +97,33 @@ class ChaosMonkey:
             self._c_injected.inc(labels={"service": name})
         return name
 
+    def fault_storm(self, duration_s: float | None = None) -> None:
+        """Run one fault window now: activate the plan, hold it for the
+        duration (interruptible by stop), deactivate."""
+        if self._fault_plan is None:
+            return
+        dur = self.fault_duration_s if duration_s is None else duration_s
+        t0 = time.monotonic()
+        self._fault_plan.activate()
+        if self._c_fault_windows is not None:
+            self._c_fault_windows.inc()
+        try:
+            self._stop.wait(dur)
+        finally:
+            self._fault_plan.deactivate()
+            self.fault_windows.append((t0, time.monotonic()))
+
     def run(self) -> None:
         while not self._stop.is_set():
             if self._stop.wait(self.interval_s):
                 return
             self.kill_one()
+
+    def _run_faults(self) -> None:
+        while not self._stop.is_set():
+            if self._stop.wait(self.fault_interval_s):
+                return
+            self.fault_storm()
 
     def start(self) -> "ChaosMonkey":
         # re-arm BEFORE the thread exists: clearing inside run() would
@@ -88,9 +134,19 @@ class ChaosMonkey:
             target=self.run, daemon=True, name="ccfd-chaos"
         )
         self._thread.start()
+        if self._fault_plan is not None and self.fault_interval_s:
+            self._fault_thread = threading.Thread(
+                target=self._run_faults, daemon=True, name="ccfd-chaos-net"
+            )
+            self._fault_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self._fault_thread is not None:
+            self._fault_thread.join(timeout=5.0)
+            # a storm interrupted mid-window must not leave edges degraded
+            if self._fault_plan is not None:
+                self._fault_plan.deactivate()

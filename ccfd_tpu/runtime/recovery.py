@@ -103,6 +103,36 @@ class CheckpointCoordinator:
                                            Callable[[Any], None]]] = {}
         self._last: dict[str, Any] | None = None  # {"snap","offsets","ts"}
         self._lock = threading.Lock()  # serializes checkpoint vs restore
+        # Seed the retention pin NOW, at the groups' current committed
+        # positions (<= any future cut): the FIRST checkpoint has no prior
+        # pin, so in the window between its barrier release and its own
+        # pin write (snapshot JSON-normalization + disk persistence) the
+        # consuming groups race ahead and retention could trim
+        # [first cut, live position) — exactly the records that cut's
+        # restore would replay (ADVICE r5 medium). On a crash bring-up
+        # the groups' replayed positions sit PAST the persisted cut the
+        # upcoming restore_from_disk() will rewind to, so the seed folds
+        # in the on-disk cut's offsets (element-wise min) — overwriting
+        # the surviving durable pin with crash-time positions would
+        # un-protect exactly the replay window the pin existed to keep.
+        # Best-effort: transports that cannot report offsets at bring-up
+        # just skip the seed.
+        try:
+            seed = {
+                f"{g}\x00{t}": [int(o)
+                                for o in broker.committed_offsets(g, t)]
+                for g, t in self._cut_groups
+            }
+            for key, offs in self._peek_disk_cut_offsets().items():
+                cur = seed.get(key)
+                seed[key] = (list(offs) if cur is None
+                             else [min(a, b) for a, b in zip(cur, offs)])
+            self._pin_retention(seed)
+        except Exception:  # noqa: BLE001 - seeding is protective only
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "retention pin seed at coordinator start failed")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.checkpoints = 0
@@ -181,6 +211,28 @@ class CheckpointCoordinator:
         self._pin_retention(cut["offsets"])
         return cut
 
+    def _peek_disk_cut_offsets(self) -> dict[str, list[int]]:
+        """The persisted cut's offsets map, for the constructor's pin
+        seed — {} when there is no (usable) cut on disk. Deliberately
+        tolerant: a corrupt file reads as no-cut here exactly as it does
+        in restore_from_disk()."""
+        import json
+        import os
+
+        if not self.path or not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path) as f:
+                cut = json.load(f)
+            offsets = cut["offsets"] if cut.get("version") == 1 else {}
+            return {
+                k: [int(o) for o in v]
+                for k, v in offsets.items()
+                if isinstance(v, list)
+            }
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            return {}
+
     def _pin_retention(self, cut_offsets: dict[str, list[int]]) -> None:
         """Publish the cut as a committed position under the broker's
         retention pin group: the broker's delete-before-committed-offset
@@ -188,11 +240,17 @@ class CheckpointCoordinator:
         of THIS cut would replay. Per topic the pin is the element-wise
         min across the cut's groups — the earliest position any rewind
         could aim at. An in-process Broker without retention just records
-        a harmless extra group; transports with no offset-reset surface
-        (RemoteBroker, the Kafka adapter) are skipped — they cannot be
-        pinned from here, and they cannot be rewound by restore() either,
-        so the pin's protection is moot on them (crash recovery over
-        those transports is the server's/cluster's job)."""
+        a harmless extra group. The pin IS sent over every transport with
+        an offset-admin surface — RemoteBroker forwards it to the bus
+        server, whose broker-side retention honors it exactly like the
+        in-process case, and KafkaAdapter commits it as ordinary group
+        offsets — but on REAL Kafka, size/time retention ignores consumer
+        positions entirely, so the pin does NOT block broker-side
+        deletion there: it only documents the cut for operators
+        (``kafka-consumer-groups --describe``), and recovery over a real
+        cluster relies on the cluster's retention window being wider than
+        the checkpoint interval. Only a transport with no
+        ``reset_offsets`` at all is skipped."""
         from ccfd_tpu.bus.broker import RETENTION_PIN_GROUP
 
         if not callable(getattr(self.broker, "reset_offsets", None)):

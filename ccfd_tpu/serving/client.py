@@ -26,7 +26,7 @@ from ccfd_tpu.data.ccfd import FEATURE_NAMES
 
 
 class SeldonClient:
-    def __init__(self, cfg: Config):
+    def __init__(self, cfg: Config, breaker=None, faults=None):
         self.cfg = cfg
         u = urllib.parse.urlparse(cfg.seldon_url)
         if u.scheme not in ("http", ""):
@@ -35,6 +35,15 @@ class SeldonClient:
         self._port = u.port or 80
         self._path = "/" + cfg.seldon_endpoint.lstrip("/")
         self._timeout = cfg.seldon_timeout_ms / 1000.0
+        # per-edge resilience (runtime/breaker.py, runtime/faults.py): an
+        # open breaker refuses instantly instead of eating SELDON_TIMEOUT
+        # per call against a dead scorer — the router's degradation ladder
+        # is what catches the refusal
+        self._breaker = breaker
+        self._faults = faults
+        import random
+
+        self._rng = random.Random(0)  # deterministic backoff jitter
         self._pool: "queue.Queue[http.client.HTTPConnection]" = queue.Queue()
         for _ in range(max(1, cfg.seldon_pool_size)):
             self._pool.put(self._connect())
@@ -49,11 +58,18 @@ class SeldonClient:
     def _request(self, body: dict[str, Any]) -> dict[str, Any]:
         """POST with per-attempt SELDON_TIMEOUT and bounded retries.
 
-        Retries (CCFD_CLIENT_RETRIES, with short linear backoff) cover the
-        window where the supervisor is restarting a crashed scorer — the
-        reference has no app-level retry, only the timeout knob
-        (README.md:386-393), so a scorer restart drops messages there.
+        Retries (CCFD_CLIENT_RETRIES, exponential backoff with jitter —
+        runtime/breaker.backoff_s) cover the window where the supervisor is
+        restarting a crashed scorer — the reference has no app-level retry,
+        only the timeout knob (README.md:386-393), so a scorer restart
+        drops messages there. With a breaker wired, an open circuit refuses
+        BEFORE dialing: a blackholed scorer costs one timeout per window,
+        not one per micro-batch.
         """
+        if self._breaker is not None and not self._breaker.allow():
+            from ccfd_tpu.runtime.breaker import CircuitOpenError
+
+            raise CircuitOpenError("circuit open for the prediction server")
         conn = self._pool.get()
         try:
             payload = json.dumps(body)
@@ -63,21 +79,50 @@ class SeldonClient:
             attempts = max(1, self.cfg.client_retries + 1)
             last_exc: Exception | None = None
             for attempt in range(attempts):
+                t0 = time.monotonic()
                 try:
+                    corrupt = (self._faults.before()
+                               if self._faults is not None else False)
                     conn.request("POST", self._path, payload, headers)
                     resp = conn.getresponse()
                     data = resp.read()
                     if resp.status != 200:
+                        # ANY non-200 records a breaker failure before
+                        # raising: the edge is not serving predictions,
+                        # and an unrecorded outcome would leak the
+                        # admitted HALF_OPEN probe slot and wedge the
+                        # circuit open permanently
+                        if self._breaker is not None:
+                            self._breaker.record_failure(
+                                time.monotonic() - t0)
                         raise RuntimeError(
                             f"prediction server returned {resp.status}: {data[:200]!r}"
                         )
-                    return json.loads(data)
+                    try:
+                        out = json.loads(data)
+                        if self._faults is not None:
+                            out = self._faults.after(out, corrupt)
+                    except ValueError:
+                        # undecodable 200 body: same record-before-raise
+                        # rule (InjectedFault is an OSError and takes the
+                        # transport-failure path below instead)
+                        if self._breaker is not None:
+                            self._breaker.record_failure(
+                                time.monotonic() - t0)
+                        raise
+                    if self._breaker is not None:
+                        self._breaker.record_success(time.monotonic() - t0)
+                    return out
                 except (http.client.HTTPException, OSError) as e:
                     # stale pooled connection or server mid-restart: reconnect
                     last_exc = e
+                    if self._breaker is not None:
+                        self._breaker.record_failure(time.monotonic() - t0)
                     conn.close()
                     if attempt < attempts - 1:
-                        time.sleep(0.05 * (attempt + 1))
+                        from ccfd_tpu.runtime.breaker import backoff_s
+
+                        time.sleep(backoff_s(attempt, rng=self._rng))
                     conn = self._connect()
             raise ConnectionError(
                 f"prediction server unreachable after {attempts} attempts"

@@ -667,6 +667,28 @@ class Scorer:
             chunks.append(np.asarray(done)[:took])
         return np.concatenate(chunks).astype(np.float32)
 
+    @property
+    def has_host_forward(self) -> bool:
+        """True when a numpy host forward (and a host params copy) exists —
+        what the router's degraded host tier needs."""
+        return self._host_params is not None and self.spec.apply_numpy is not None
+
+    def host_score(self, x: np.ndarray) -> np.ndarray:
+        """(n, F) -> (n,) proba_1 on the HOST params copy, no device
+        round trip. This is the router degradation ladder's host tier
+        (router/router.py): unlike ``score`` — whose own host fallback
+        only engages on a wedge — this never touches the device edge, so
+        it stays alive when that edge is partitioned or fault-injected."""
+        with self._lock:
+            host_params = self._host_params
+        if host_params is None or self.spec.apply_numpy is None:
+            raise RuntimeError(
+                f"model {self.spec.name!r} has no host forward")
+        return np.asarray(
+            self.spec.apply_numpy(host_params, np.asarray(x, np.float32)),
+            np.float32,
+        )
+
     def score(self, x: np.ndarray) -> np.ndarray:
         """(n, F) float32 -> (n,) float32 proba_1, padding to a shape bucket.
 

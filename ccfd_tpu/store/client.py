@@ -28,10 +28,17 @@ from ccfd_tpu.store.server import quote_key, sign_v2
 
 
 class S3Client:
-    def __init__(self, endpoint: str, creds: Credentials, timeout_s: float = 10.0):
+    def __init__(self, endpoint: str, creds: Credentials, timeout_s: float = 10.0,
+                 breaker=None, faults=None):
         self.endpoint = endpoint.rstrip("/")
         self.creds = creds
         self.timeout_s = timeout_s
+        # producer↔store resilience edge (runtime/breaker.py,
+        # runtime/faults.py): gates both transports — the producer's retry
+        # loop sees CircuitOpenError/InjectedFault as ordinary
+        # ConnectionErrors
+        self._breaker = breaker
+        self._faults = faults
         self._inproc: ObjectStore | None = None
         if endpoint.startswith("inproc://"):
             self._inproc = resolve_inproc(endpoint)
@@ -41,6 +48,45 @@ class S3Client:
 
     # --- HTTP plumbing ---------------------------------------------------
     def _request(self, method: str, path: str, data: bytes | None = None) -> bytes:
+        return self._call(self._request_raw, method, path, data)
+
+    def _call(self, fn, *args):
+        if self._breaker is not None or self._faults is not None:
+            return self._guarded(fn, *args)
+        return fn(*args)
+
+    def _guarded(self, fn, *args):
+        """Breaker gate + outcome recording + fault perturbation around one
+        store call (shared by the HTTP and inproc transports)."""
+        import time as _time
+
+        if self._breaker is not None and not self._breaker.allow():
+            from ccfd_tpu.runtime.breaker import CircuitOpenError
+
+            raise CircuitOpenError("circuit open for the object store")
+        t0 = _time.monotonic()
+        try:
+            corrupt = (self._faults.before()
+                       if self._faults is not None else False)
+            out = fn(*args)
+            if self._faults is not None:
+                out = self._faults.after(out, corrupt)
+        except (NoSuchKey, AccessDenied):
+            # application-level outcomes over a HEALTHY transport: record
+            # success — a gated call that records nothing would leak its
+            # HALF_OPEN probe slot and wedge the circuit
+            if self._breaker is not None:
+                self._breaker.record_success(_time.monotonic() - t0)
+            raise
+        except Exception:
+            if self._breaker is not None:
+                self._breaker.record_failure(_time.monotonic() - t0)
+            raise
+        if self._breaker is not None:
+            self._breaker.record_success(_time.monotonic() - t0)
+        return out
+
+    def _request_raw(self, method: str, path: str, data: bytes | None = None) -> bytes:
         headers = {"Date": email.utils.formatdate(usegmt=True)}
         if data is not None:
             # set explicitly so the signed Content-Type matches what urllib
@@ -65,31 +111,32 @@ class S3Client:
     # --- API -------------------------------------------------------------
     def create_bucket(self, bucket: str) -> None:
         if self._inproc is not None:
-            self._inproc.create_bucket(bucket)
+            self._call(self._inproc.create_bucket, bucket)
         else:
             self._request("PUT", f"/{bucket}")
 
     def put(self, bucket: str, key: str, data: bytes) -> None:
         if self._inproc is not None:
-            self._inproc.put(bucket, key, data)
+            self._call(self._inproc.put, bucket, key, data)
         else:
             self._request("PUT", f"/{bucket}/{quote_key(key)}", data=data)
 
     def get(self, bucket: str, key: str) -> bytes:
         if self._inproc is not None:
-            return self._inproc.get(bucket, key)
+            return self._call(self._inproc.get, bucket, key)
         return self._request("GET", f"/{bucket}/{quote_key(key)}")
 
     def delete(self, bucket: str, key: str) -> None:
         if self._inproc is not None:
-            self._inproc.delete(bucket, key)
+            self._call(self._inproc.delete, bucket, key)
         else:
             self._request("DELETE", f"/{bucket}/{quote_key(key)}")
 
     def list(self, bucket: str, prefix: str = "") -> list[str]:
         """Object keys, the `aws s3 ls` check (reference README.md:320-343)."""
         if self._inproc is not None:
-            return [o.key for o in self._inproc.list(bucket, prefix=prefix)]
+            return [o.key for o in
+                    self._call(self._inproc.list, bucket, prefix)]
         body = self._request("GET", f"/{bucket}?prefix={quote_key(prefix)}")
         root = ET.fromstring(body)
         return [c.findtext("Key", "") for c in root.iter("Contents")]

@@ -15,6 +15,15 @@ could have seen and it will not dispatch it). A failure while READING the
 response (timeout, reset after the request was fully sent) may mean the
 server processed it, and re-sending would duplicate the side effect — no
 retry there.
+
+Resilience hooks (runtime/breaker.py, runtime/faults.py): an optional
+per-edge ``CircuitBreaker`` gates every request (open circuit ⇒ instant
+:class:`~ccfd_tpu.runtime.breaker.CircuitOpenError`, no connection dialed,
+no timeout eaten) and records transport errors and 5xx responses as
+failures; retries back off exponentially with jitter under an optional
+deadline budget instead of hammering a restarting server back-to-back; an
+optional ``FaultInjector`` perturbs each attempt so chaos drills exercise
+this exact code path.
 """
 
 from __future__ import annotations
@@ -22,7 +31,9 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import random
 import socket
+import time
 import urllib.parse
 from typing import Any
 
@@ -50,6 +61,11 @@ class PooledHTTPClient:
         timeout_s: float = 5.0,
         retries: int = 2,
         scheme_error: str = "unsupported scheme",
+        breaker=None,
+        faults=None,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        retry_budget_s: float | None = None,
     ):
         u = urllib.parse.urlparse(base_url)
         if u.scheme not in ("http", ""):
@@ -58,6 +74,12 @@ class PooledHTTPClient:
         self.port = u.port or default_port
         self._timeout = timeout_s
         self._retries = max(0, retries)
+        self._breaker = breaker           # runtime/breaker.CircuitBreaker
+        self._faults = faults             # runtime/faults.FaultInjector
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._retry_budget_s = retry_budget_s
+        self._rng = random.Random(0)      # deterministic backoff jitter
         self._pool: "queue.Queue[http.client.HTTPConnection]" = queue.Queue()
         for _ in range(max(1, pool_size)):
             self._pool.put(self._connect())
@@ -70,13 +92,28 @@ class PooledHTTPClient:
     ) -> tuple[int, Any]:
         """-> (status, parsed JSON body or None). Raises ConnectionError when
         the server stays unreachable (or a non-idempotent send failed after
-        possibly reaching it)."""
+        possibly reaching it); CircuitOpenError (a ConnectionError) when the
+        edge's breaker refuses without dialing."""
+        # encode BEFORE the breaker gate: an unencodable body raising
+        # after allow() would leak the admitted HALF_OPEN probe slot
+        # (nothing would ever record its outcome) and wedge the circuit
         payload = json.dumps(body).encode() if body is not None else None
+        if self._breaker is not None and not self._breaker.allow():
+            from ccfd_tpu.runtime.breaker import CircuitOpenError
+
+            raise CircuitOpenError(
+                f"circuit open for {self.host}:{self.port}")
         last: Exception | None = None
-        for _ in range(self._retries + 1):
+        deadline = (None if self._retry_budget_s is None
+                    else time.monotonic() + self._retry_budget_s)
+        for attempt in range(self._retries + 1):
             conn = self._pool.get()
             sent = False
+            returned = False
+            t0 = time.monotonic()
             try:
+                corrupt = (self._faults.before()
+                           if self._faults is not None else False)
                 conn.request(
                     method, path, body=payload,
                     headers={"Content-Type": "application/json"},
@@ -85,17 +122,53 @@ class PooledHTTPClient:
                 resp = conn.getresponse()
                 data = resp.read()
                 self._pool.put(conn)
-                return resp.status, (json.loads(data) if data else None)
+                returned = True
+                parsed = json.loads(data) if data else None
+                if self._faults is not None:
+                    # a corrupt response raises InjectedFault (an OSError):
+                    # the retry/breaker path below treats it like a real
+                    # undecodable body
+                    parsed = self._faults.after(parsed, corrupt)
+                if self._breaker is not None:
+                    lat = time.monotonic() - t0
+                    if resp.status >= 500:
+                        # the server answered but is failing: 5xx counts
+                        # toward opening the circuit, the response still
+                        # reaches the caller
+                        self._breaker.record_failure(lat)
+                    else:
+                        self._breaker.record_success(lat)
+                return resp.status, parsed
+            except ValueError as e:
+                # undecodable response body from a live server: propagate
+                # (historical behavior), but the gated call must still
+                # record an outcome — a silent non-record would leak the
+                # HALF_OPEN probe slot and wedge the circuit open forever
+                if self._breaker is not None:
+                    self._breaker.record_failure(time.monotonic() - t0)
+                raise
             except (OSError, http.client.HTTPException) as e:
                 last = e
-                conn.close()
-                self._pool.put(self._connect())
+                if not returned:
+                    conn.close()
+                    self._pool.put(self._connect())
+                if self._breaker is not None:
+                    self._breaker.record_failure(time.monotonic() - t0)
                 # send-phase failures (conn.request raised — including a
                 # refused connect — mean the request was never fully written,
                 # so the server can't have dispatched it) are safe to retry
                 # even for non-idempotent requests
                 if not idempotent and sent:
                     break
+                if attempt < self._retries:
+                    from ccfd_tpu.runtime.breaker import backoff_s
+
+                    pause = backoff_s(attempt, self._backoff_base_s,
+                                      self._backoff_max_s, self._rng)
+                    if (deadline is not None
+                            and time.monotonic() + pause > deadline):
+                        break  # the budget is spent: fail now, not later
+                    time.sleep(pause)
         raise ConnectionError(f"{self.host}:{self.port} unreachable: {last}")
 
     def close(self) -> None:

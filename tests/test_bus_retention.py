@@ -451,3 +451,70 @@ def test_pin_survives_crash_restart(tmp_path):
     assert b.enforce_retention() == 60   # still stops at the pin
     assert b.beginning_offsets("t") == [60]
     b.close()
+
+
+def test_oor_reset_counts_once_on_idle_topic():
+    """A committed position below the log-start is clamped ONCE: the
+    clamped position commits even when the take is empty (fully-consumed
+    or idle topic), so polling an idle topic doesn't inflate oor_resets
+    forever over a single historical reset."""
+    b = Broker(default_partitions=1, retention_records=10)
+    c = b.consumer("g", ["t"])
+    for i in range(100):
+        b.produce("t", i, key=b"k")
+    _drain(c, 100)
+    # rewind below the retained log: the next poll clamps to log-start
+    b.enforce_retention()
+    base = b.beginning_offsets("t")[0]
+    assert base > 0
+    b.reset_offsets("g", "t", [0])        # counted: aimed below log-start
+    n0 = b.oor_resets
+    got = c.poll(500)                      # clamp + redeliver the tail
+    assert got and got[0].offset == base
+    n1 = b.oor_resets
+    assert n1 >= n0
+    # topic now idle and fully consumed: repeated polls must not count
+    for _ in range(5):
+        assert c.poll(500) == []
+    assert b.oor_resets == n1
+    # the empty-take form: a fully-trimmed partition (base == end, the
+    # state a bus crash-replay of a fully-rolled log leaves behind) with
+    # a group below the base. The FIRST poll must commit the clamped
+    # position — before the fix every poll on the idle topic re-counted.
+    with b._lock:
+        b._topics["t"].partitions[0].trim_to(100)  # base == end == 100
+    b.reset_offsets("g", "t", [95])  # recorded as-is: 95 < base is the
+    # crash-replay clamp's job; simulate it landing stale
+    with b._lock:
+        b._groups["g"][("t", 0)] = 95
+    n2 = b.oor_resets
+    assert c.poll(500) == []          # clamps, counts once, COMMITS
+    assert b.oor_resets == n2 + 1
+    assert b.committed_offsets("g", "t") == [100]
+    for _ in range(5):
+        assert c.poll(500) == []      # idle polls stay clean
+    assert b.oor_resets == n2 + 1
+
+
+def test_health_snapshot_seeds_uncommitted_groups_at_log_start():
+    """bus_topic_backlog must be honest on a trimmed topic: a group that
+    attached but never committed reads lag against the log-start (every
+    DELIVERABLE record), not offset 0 (which would count records the
+    trim already made undeliverable)."""
+    b = Broker(default_partitions=1, retention_records=10)
+    writer = b.consumer("writer", ["t"])
+    for i in range(100):
+        b.produce("t", i, key=b"k")
+    _drain(writer, 100)
+    b.enforce_retention()
+    base = b.beginning_offsets("t")[0]
+    assert base > 0
+    b.consumer("lurker", ["t"])  # attached, never polled
+    snap = b.health_snapshot()
+    assert snap["groups"]["lurker"][("t", 0)] == base
+    # retention's floor logic still treats the lurker as holding 0: its
+    # (deliverable) backlog cannot be deleted out from under it
+    for i in range(100, 200):
+        b.produce("t", i, key=b"k")
+    b.enforce_retention()
+    assert b.beginning_offsets("t")[0] == base

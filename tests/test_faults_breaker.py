@@ -1,0 +1,608 @@
+"""Network-edge fault injection, circuit breakers, and the router's
+degradation ladder (runtime/faults.py, runtime/breaker.py, router tiers).
+
+The properties pinned here are what tools/chaos_soak.py --net-faults then
+exercises under load: a degraded edge (slow, flaky, partitioned, corrupt)
+costs scoring QUALITY — host-tier or rules-only decisions — never progress;
+the breaker turns a per-call stall into one bounded stall per window; and
+every transition/degradation is observable on the metrics surface.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.router import Router
+from ccfd_tpu.runtime.breaker import (
+    CircuitBreaker,
+    CircuitOpenError,
+    backoff_s,
+    call_with_retries,
+)
+from ccfd_tpu.runtime.faults import FaultInjector, FaultPlan, InjectedFault
+
+CFG = Config(fraud_threshold=0.5)
+AMOUNT = FEATURE_NAMES.index("Amount")
+
+
+def amount_score(x: np.ndarray) -> np.ndarray:
+    return (x[:, AMOUNT] > 100.0).astype(np.float32)
+
+
+def full_tx(i: int, amount: float) -> dict:
+    t = {name: 0.0 for name in FEATURE_NAMES}
+    t["Amount"] = amount
+    t["id"] = i
+    return t
+
+
+# -- FaultPlan / FaultSpec parsing ------------------------------------------
+
+def test_fault_plan_parses_env_syntax():
+    plan = FaultPlan.from_string(
+        "scorer:latency=50,jitter=20,error=0.1;engine:blackhole,stall=10;"
+        "*:corrupt=0.5,drip=5"
+    )
+    s = plan.spec_for("scorer")
+    assert (s.latency_ms, s.jitter_ms, s.error_rate) == (50.0, 20.0, 0.1)
+    e = plan.spec_for("engine")
+    assert e.blackhole and e.stall_ms == 10.0
+    # wildcard catches edges without their own spec
+    w = plan.spec_for("bus")
+    assert w.corrupt_rate == 0.5 and w.drip_ms == 5.0
+    assert FaultPlan.from_string("").specs == {}
+    assert FaultPlan.from_env({"CCFD_FAULTS": "bus:error=1"}).spec_for(
+        "bus").error_rate == 1.0
+    assert FaultPlan.from_env({}).injector("bus") is None
+
+
+def test_fault_plan_rejects_malformed():
+    with pytest.raises(ValueError, match="edge:spec"):
+        FaultPlan.from_string("justanedge")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.from_string("scorer:explode=1")
+    with pytest.raises(ValueError):
+        FaultPlan.from_string("scorer:error=1.5")
+
+
+def test_injector_is_seeded_and_deterministic():
+    def seq(seed):
+        plan = FaultPlan.from_string("e:error=0.5", seed=seed)
+        inj = plan.injector("e")
+        out = []
+        for _ in range(32):
+            try:
+                inj.run(lambda: "ok")
+                out.append(True)
+            except InjectedFault:
+                out.append(False)
+        return out
+
+    assert seq(7) == seq(7)
+    assert seq(7) != seq(8)  # overwhelmingly likely for 32 draws
+
+
+def test_blackhole_stalls_bounded_then_raises():
+    plan = FaultPlan.from_string("e:blackhole,stall=30")
+    inj = plan.injector("e", Registry())
+    t0 = time.monotonic()
+    with pytest.raises(InjectedFault, match="blackholed"):
+        inj.run(lambda: "never")
+    assert 0.02 <= time.monotonic() - t0 < 1.0  # bounded partition stall
+
+
+def test_corrupt_response_nans_float_arrays_and_raises_otherwise():
+    plan = FaultPlan.from_string("e:corrupt=1")
+    inj = plan.injector("e")
+    out = inj.run(lambda: np.ones(4, np.float32))
+    assert np.isnan(out).all()
+    with pytest.raises(InjectedFault, match="corrupt"):
+        inj.run(lambda: {"not": "an array"})
+
+
+def test_inactive_plan_is_a_no_op_and_drip_resets():
+    plan = FaultPlan.from_string("e:error=1,drip=100", active=False)
+    inj = plan.injector("e")
+    assert inj.run(lambda: 42) == 42  # inactive: passthrough, no error
+    plan.activate()
+    with pytest.raises(InjectedFault):
+        inj.run(lambda: 42)
+    plan.deactivate()
+    assert inj.run(lambda: 42) == 42
+    assert inj._calls_active == 0  # drip ramp reset between storms
+
+
+def test_fault_proxy_wraps_named_methods_only():
+    class Client:
+        def start_process(self, d, v):
+            return 7
+
+        def definitions(self):
+            return ("fraud",)
+
+    plan = FaultPlan.from_string("engine:error=1")
+    proxied = plan.injector("engine").wrap(
+        Client(), methods=("start_process",))
+    assert proxied.definitions() == ("fraud",)  # passthrough
+    with pytest.raises(InjectedFault):
+        proxied.start_process("fraud", {})
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_breaker_full_cycle_closed_open_half_open_closed():
+    clk = FakeClock()
+    reg = Registry()
+    br = CircuitBreaker(edge="scorer", min_calls=3, failure_ratio=0.5,
+                        cooldown_s=2.0, close_after=2, half_open_max=1,
+                        registry=reg, clock=clk)
+    g = reg.gauge("ccfd_breaker_state")
+    assert br.state == "closed" and g.value({"edge": "scorer"}) == 0
+    for _ in range(3):
+        assert br.allow()
+        br.record_failure(0.01)
+    assert br.state == "open" and g.value({"edge": "scorer"}) == 2
+    assert not br.allow()            # refused instantly inside cooldown
+    clk.advance(10.0)                # past cooldown (incl. jitter)
+    assert br.state == "half_open"
+    assert br.allow()                # first probe admitted
+    assert not br.allow()            # ...but only half_open_max at once
+    br.record_success(0.01)
+    assert br.allow()                # second probe
+    br.record_success(0.01)
+    assert br.state == "closed" and g.value({"edge": "scorer"}) == 0
+    tr = reg.counter("ccfd_breaker_transitions_total")
+    assert tr.value({"edge": "scorer", "to": "open"}) == 1
+    assert tr.value({"edge": "scorer", "to": "closed"}) == 1
+
+
+def test_breaker_reopen_backoff_grows_and_resets():
+    clk = FakeClock()
+    br = CircuitBreaker(edge="e", min_calls=2, cooldown_s=1.0,
+                        cooldown_max_s=8.0, close_after=1, seed=3,
+                        clock=clk)
+    def trip():
+        for _ in range(2):
+            br.allow()
+            br.record_failure()
+
+    trip()
+    first = br._open_until - clk.t
+    assert 1.0 <= first <= 1.5       # base cooldown × [1, 1.5) jitter
+    clk.advance(first + 0.01)
+    assert br.allow()                # half-open probe...
+    br.record_failure()              # ...fails: reopen with doubled base
+    second = br._open_until - clk.t
+    assert 2.0 <= second <= 3.0
+    clk.advance(second + 0.01)
+    assert br.allow()
+    br.record_success()              # close_after=1: closed again
+    assert br.state == "closed"
+    trip()                           # consecutive-opens counter reset
+    assert 1.0 <= br._open_until - clk.t <= 1.5
+
+
+def test_breaker_slow_calls_count_as_failures():
+    clk = FakeClock()
+    br = CircuitBreaker(edge="e", min_calls=3, failure_ratio=0.5,
+                        latency_threshold_s=0.1, clock=clk)
+    for _ in range(3):
+        br.record_success(latency_s=5.0)  # answered, but blew the budget
+    assert br.state == "open"
+
+
+def test_breaker_call_gates_and_records():
+    clk = FakeClock()
+    br = CircuitBreaker(edge="e", min_calls=3, clock=clk)
+    assert br.call(lambda: 5) == 5
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            br.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: 5)
+
+
+def test_breaker_window_evicts_old_outcomes():
+    clk = FakeClock()
+    br = CircuitBreaker(edge="e", window_s=10.0, min_calls=3, clock=clk)
+    br.record_failure()
+    br.record_failure()
+    clk.advance(60.0)                 # failures age out of the window
+    br.record_failure()
+    assert br.state == "closed"       # 1 recent failure < min_calls
+
+
+# -- retry backoff ----------------------------------------------------------
+
+def test_backoff_is_exponential_with_bounded_jitter():
+    rng = random.Random(0)
+    for attempt in range(6):
+        full = min(0.05 * 2 ** attempt, 2.0)
+        for _ in range(50):
+            b = backoff_s(attempt, base_s=0.05, cap_s=2.0, rng=rng)
+            assert full * 0.5 <= b <= full, (attempt, b)
+
+
+def test_call_with_retries_respects_deadline_budget():
+    calls = {"n": 0}
+    sleeps: list[float] = []
+    clk = FakeClock()
+
+    def sleep(dt):
+        sleeps.append(dt)
+        clk.advance(dt)
+
+    def fail():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        call_with_retries(fail, retries=50, base_backoff_s=1.0,
+                          max_backoff_s=64.0, deadline_s=10.0,
+                          rng=random.Random(1), sleep=sleep, clock=clk)
+    # the budget, not the retry count, bounded the loop
+    assert calls["n"] < 51
+    assert sum(sleeps) <= 10.0
+
+
+def test_call_with_retries_returns_first_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("not yet")
+        return "ok"
+
+    assert call_with_retries(flaky, retries=5, base_backoff_s=0.001,
+                             rng=random.Random(0)) == "ok"
+    assert calls["n"] == 3
+
+
+# -- HTTP client integration -------------------------------------------------
+
+def test_pooled_client_breaker_fails_fast_when_open():
+    from ccfd_tpu.utils.httpclient import PooledHTTPClient
+
+    br = CircuitBreaker(edge="dead", min_calls=2, cooldown_s=60.0)
+    client = PooledHTTPClient(
+        "http://127.0.0.1:9", default_port=9, pool_size=1, timeout_s=0.2,
+        retries=1, breaker=br, backoff_base_s=0.001,
+    )
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            client.request("GET", "/x")
+    assert br.state == "open"
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        client.request("GET", "/x")
+    assert time.monotonic() - t0 < 0.05  # refused without dialing
+    client.close()
+
+
+def test_seldon_client_breaker_fails_fast_when_open():
+    from ccfd_tpu.serving.client import SeldonClient
+
+    cfg = Config(seldon_url="http://127.0.0.1:9", seldon_timeout_ms=200,
+                 client_retries=0)
+    br = CircuitBreaker(edge="scorer-rest", min_calls=2, cooldown_s=60.0)
+    client = SeldonClient(cfg, breaker=br)
+    x = np.zeros((2, 30), np.float32)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            client.score(x)
+    with pytest.raises(CircuitOpenError):
+        client.score(x)
+    client.close()
+
+
+# -- router degradation ladder ----------------------------------------------
+
+def _pipeline(score_fn, host_score_fn=None, breaker=None, degrade=None,
+              max_inflight=None, max_batch=256):
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    engine = build_engine(CFG, broker, Registry(), None)
+    router = Router(CFG, broker, score_fn, engine, reg,
+                    max_batch=max_batch, host_score_fn=host_score_fn,
+                    breaker=breaker, degrade=degrade,
+                    max_inflight=max_inflight)
+    return broker, router, reg
+
+
+def test_ladder_host_tier_absorbs_blackholed_scorer():
+    plan = FaultPlan.from_string("scorer:blackhole,stall=10")
+    inj = plan.injector("scorer")
+    broker, router, reg = _pipeline(
+        inj.wrap_fn(amount_score), host_score_fn=amount_score)
+    broker.produce_batch(CFG.kafka_topic,
+                         [full_tx(i, 900.0) for i in range(20)])
+    assert router.step() == 20
+    # decisions are VALID (the host tier computed real probabilities):
+    # Amount 900 > 100 -> fraud for every row
+    out = reg.counter("transaction_outgoing_total")
+    assert out.value({"type": "fraud"}) == 20
+    assert reg.counter("router_degraded_total").value({"tier": "host"}) == 20
+    assert reg.counter("router_degraded_total").value({"tier": "rules"}) == 0
+
+
+def test_ladder_rules_tier_when_no_host_forward():
+    plan = FaultPlan.from_string("scorer:blackhole,stall=5")
+    inj = plan.injector("scorer")
+    broker, router, reg = _pipeline(inj.wrap_fn(amount_score), degrade=True)
+    txs = [full_tx(i, 900.0) for i in range(10)]   # >= CCFD_LOW_AMOUNT
+    txs += [full_tx(100 + i, 5.0) for i in range(10)]  # small
+    broker.produce_batch(CFG.kafka_topic, txs)
+    assert router.step() == 20
+    out = reg.counter("transaction_outgoing_total")
+    # conservative stand-in: high-amount rows flag AT the threshold ->
+    # fraud process; small rows -> standard. Every tx got a decision.
+    assert out.value({"type": "fraud"}) == 10
+    assert out.value({"type": "standard"}) == 10
+    assert reg.counter("router_degraded_total").value({"tier": "rules"}) == 20
+
+
+def test_ladder_falls_through_host_tier_failure_to_rules():
+    def bad_host(x):
+        raise RuntimeError("host params corrupted")
+
+    plan = FaultPlan.from_string("scorer:error=1")
+    inj = plan.injector("scorer")
+    broker, router, reg = _pipeline(inj.wrap_fn(amount_score),
+                                    host_score_fn=bad_host)
+    broker.produce_batch(CFG.kafka_topic, [full_tx(i, 5.0) for i in range(8)])
+    assert router.step() == 8
+    assert reg.counter("router_degraded_total").value({"tier": "rules"}) == 8
+    assert reg.counter("transaction_outgoing_total").value(
+        {"type": "standard"}) == 8
+
+
+def test_corrupt_scorer_response_degrades_instead_of_routing_garbage():
+    plan = FaultPlan.from_string("scorer:corrupt=1")
+    inj = plan.injector("scorer")
+    broker, router, reg = _pipeline(
+        inj.wrap_fn(amount_score), host_score_fn=amount_score)
+    broker.produce_batch(CFG.kafka_topic,
+                         [full_tx(i, 900.0) for i in range(8)])
+    assert router.step() == 8
+    # NaN probabilities were caught by validation, host tier decided
+    assert reg.counter("router_degraded_total").value({"tier": "host"}) == 8
+    assert reg.counter("transaction_outgoing_total").value(
+        {"type": "fraud"}) == 8
+
+
+def test_breaker_opens_and_skips_blackholed_device_tier():
+    calls = {"n": 0}
+
+    def blackholed(x):
+        calls["n"] += 1
+        time.sleep(0.01)
+        raise ConnectionError("partitioned")
+
+    reg = Registry()
+    br = CircuitBreaker(edge="scorer", min_calls=2, failure_ratio=0.5,
+                        cooldown_s=60.0, registry=reg)
+    broker = Broker(default_partitions=1)
+    engine = build_engine(CFG, broker, Registry(), None)
+    router = Router(CFG, broker, blackholed, engine, reg, max_batch=256,
+                    host_score_fn=amount_score, breaker=br)
+    for batch in range(4):
+        broker.produce_batch(CFG.kafka_topic,
+                             [full_tx(batch * 10 + i, 5.0) for i in range(5)])
+        assert router.step() == 5
+    # the breaker opened after the 2nd failing batch; batches 3 and 4
+    # never touched the device edge
+    assert br.state == "open"
+    assert calls["n"] == 2
+    assert reg.counter("router_degraded_total").value({"tier": "host"}) == 20
+    # breaker-state gauge reaches the scrape surface
+    assert 'ccfd_breaker_state{edge="scorer"} 2.0' in reg.render()
+
+
+def test_breaker_recloses_after_scorer_heals():
+    clk = FakeClock()
+    healthy = {"on": False}
+
+    def flaky(x):
+        if not healthy["on"]:
+            raise ConnectionError("down")
+        return amount_score(x)
+
+    br = CircuitBreaker(edge="scorer", min_calls=2, cooldown_s=0.5,
+                        close_after=1, clock=clk)
+    broker, router, reg = _pipeline(flaky, host_score_fn=amount_score,
+                                    breaker=br)
+    for batch in range(2):
+        broker.produce_batch(CFG.kafka_topic,
+                             [full_tx(batch * 10 + i, 5.0) for i in range(4)])
+        router.step()
+    assert br.state == "open"
+    healthy["on"] = True
+    clk.advance(10.0)  # past cooldown: next batch is the half-open probe
+    broker.produce_batch(CFG.kafka_topic,
+                         [full_tx(100 + i, 5.0) for i in range(4)])
+    router.step()
+    assert br.state == "closed"
+    host_after_heal = reg.counter("router_degraded_total").value(
+        {"tier": "host"})
+    broker.produce_batch(CFG.kafka_topic,
+                         [full_tx(200 + i, 5.0) for i in range(4)])
+    router.step()
+    # healed: scoring is back on the device tier, no new degradation
+    assert reg.counter("router_degraded_total").value(
+        {"tier": "host"}) == host_after_heal
+
+
+def test_shedding_bounds_inflight_and_drops_oldest():
+    broker, router, reg = _pipeline(amount_score, degrade=True,
+                                    max_inflight=10, max_batch=256)
+    txs = [full_tx(i, 900.0 if i < 6 else 5.0) for i in range(16)]
+    broker.produce_batch(CFG.kafka_topic, txs)
+    assert router.step() == 10  # 16 polled, 6 OLDEST shed
+    assert reg.counter("router_shed_total").value() == 6
+    # incoming counts every consumed record, shed included
+    assert reg.counter("transaction_incoming_total").value() == 16
+    out = reg.counter("transaction_outgoing_total")
+    # the shed records were the oldest (the 6 high-amount head rows)
+    assert out.value({"type": "standard"}) == 10
+    assert out.value({"type": "fraud"}) == 0
+
+
+def test_default_router_keeps_drop_semantics_without_ladder():
+    """No host_score_fn / breaker / degrade flag: a scorer failure still
+    drops the batch (counted) — the historical contract
+    (tests/test_pipeline.py relies on it)."""
+    def dead(x):
+        raise ConnectionError("down")
+
+    broker, router, reg = _pipeline(dead)
+    broker.produce_batch(CFG.kafka_topic, [full_tx(i, 5.0) for i in range(4)])
+    with pytest.raises(ConnectionError):
+        router.step()
+    assert reg.counter("router_degraded_total").value({"tier": "rules"}) == 0
+
+
+def test_pipelined_loop_degrades_through_fault_storm_and_recovers():
+    """End-to-end: a storm-scheduled blackhole on the scorer edge while
+    the pipelined loop runs — every transaction decided, breaker surface
+    exported, and the device tier resumes after the storm."""
+    import threading
+
+    from ccfd_tpu.runtime.chaos import ChaosMonkey
+    from ccfd_tpu.runtime.supervisor import Supervisor
+
+    plan = FaultPlan.from_string("scorer:blackhole,stall=20", active=False)
+    reg = Registry()
+    inj = plan.injector("scorer", reg)
+    broker = Broker(default_partitions=1)
+    engine = build_engine(CFG, broker, Registry(), None)
+    br = CircuitBreaker(edge="scorer", min_calls=2, cooldown_s=0.2,
+                        close_after=1, registry=reg)
+    router = Router(CFG, broker, inj.wrap_fn(amount_score), engine, reg,
+                    max_batch=256, host_score_fn=amount_score, breaker=br)
+    sup = Supervisor(backoff_initial_s=0.01, backoff_cap_s=0.05)
+    monkey = ChaosMonkey(sup, registry=reg, fault_plan=plan,
+                         fault_interval_s=0.2, fault_duration_s=0.3)
+    th = router.start(poll_timeout_s=0.02, pipeline=True)
+    stop_feed = threading.Event()
+    produced = [0]
+
+    def feed():
+        while not stop_feed.is_set():
+            broker.produce_batch(
+                CFG.kafka_topic,
+                [full_tx(produced[0] + i, 5.0) for i in range(50)])
+            produced[0] += 50
+            time.sleep(0.02)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+    monkey.start()
+    try:
+        time.sleep(2.0)
+    finally:
+        monkey.stop()
+        stop_feed.set()
+        feeder.join(timeout=5)
+        deadline = time.time() + 20
+        out = reg.counter("transaction_outgoing_total")
+        while (time.time() < deadline
+               and out.value({"type": "standard"}) < produced[0]):
+            time.sleep(0.05)
+        router.stop()
+        th.join(timeout=10)
+    assert len(monkey.fault_windows) >= 2
+    assert reg.counter("chaos_fault_windows_total").value() >= 2
+    # every produced transaction received a decision — the loop never
+    # stalled through the storms
+    assert out.value({"type": "standard"}) == produced[0]
+    # storms degraded some scoring to the host tier...
+    assert reg.counter("router_degraded_total").value({"tier": "host"}) > 0
+    # ...and the metrics surface carries the whole story
+    rendered = reg.render()
+    assert "ccfd_breaker_state" in rendered
+    assert "faults_injected_total" in rendered
+
+
+# -- observability ----------------------------------------------------------
+
+def test_resilience_dashboard_covers_the_surface():
+    from ccfd_tpu.observability.dashboards import build_all_dashboards
+
+    board = build_all_dashboards()["Resilience"]
+    exprs = [t["expr"] for p in board["panels"] for t in p["targets"]]
+    for metric in ("ccfd_breaker_state", "ccfd_breaker_transitions_total",
+                   "router_degraded_total", "router_shed_total",
+                   "faults_injected_total", "chaos_fault_windows_total"):
+        assert any(metric in e for e in exprs), metric
+
+
+def test_operator_wires_fault_plan_and_ladder_from_cr():
+    """CR chaos.faults + fault storms through the platform: the plan
+    lands on the scorer edge, the router runs the ladder, and traffic
+    drains to completion while storms fire."""
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {
+        "spec": {
+            "store": {"enabled": False},
+            "bus": {"partitions": 1},
+            "scorer": {"enabled": True, "model": "mlp", "rest": False},
+            "engine": {"enabled": True},
+            "notify": {"enabled": True},
+            "router": {"enabled": True},
+            "retrain": {"enabled": False},
+            "analytics": {"enabled": False},
+            "investigator": {"enabled": False},
+            "producer": {"enabled": True, "transactions": 300,
+                         "wire_format": "dict"},
+            "monitoring": {"enabled": False},
+            "health": {"enabled": False},
+            "chaos": {"enabled": True, "interval_s": 999,
+                      "targets": [],  # storms only, no kills
+                      "faults": "scorer:blackhole,stall=20",
+                      "fault_interval_s": 0.2, "fault_duration_s": 0.3},
+        },
+    }
+    platform = Platform(PlatformSpec.from_cr(cr)).up()
+    try:
+        assert platform.fault_plan is not None
+        assert platform.router._degrade
+        assert platform.wait_producer(timeout_s=30)
+        reg = platform.registries["router"]
+        deadline = time.time() + 30
+        out = reg.counter("transaction_outgoing_total")
+        while time.time() < deadline and (
+                out.value({"type": "standard"})
+                + out.value({"type": "fraud"})) < 300:
+            time.sleep(0.05)
+        assert (out.value({"type": "standard"})
+                + out.value({"type": "fraud"})) == 300
+        # the first storm window may still be open when traffic drains:
+        # wait for one full cycle before asserting
+        deadline = time.time() + 10
+        while time.time() < deadline and not platform.chaos.fault_windows:
+            time.sleep(0.05)
+        assert len(platform.chaos.fault_windows) >= 1
+    finally:
+        platform.down()

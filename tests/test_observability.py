@@ -35,6 +35,13 @@ REFERENCE_CONTRACT_METRICS = [
     "retrain_param_swaps_total",
     "retrain_labels_total",
     "analytics_drift_psi",
+    # round 6: fault-injection / breaker / degradation-ladder surface
+    # (runtime/faults.py, runtime/breaker.py, router ladder)
+    "ccfd_breaker_state",
+    "ccfd_breaker_transitions_total",
+    "router_degraded_total",
+    "router_shed_total",
+    "faults_injected_total",
 ]
 
 
@@ -51,7 +58,7 @@ def test_dashboards_cover_contract_metrics():
     boards = build_all_dashboards()
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
-        "KafkaCluster", "Analytics", "Retrain",
+        "KafkaCluster", "Analytics", "Retrain", "Resilience",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -129,7 +136,7 @@ def test_seldon_board_carries_dispatch_health():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 8
+    assert len(paths) == 9
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")

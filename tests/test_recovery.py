@@ -194,13 +194,22 @@ def test_checkpoint_restore_replays_post_cut_records():
         _drain(router, 20)
         cut = coord.checkpoint()
         assert cut is not None and coord.checkpoints == 1
-        # post-cut work: the doomed engine processes 10 more
+        # post-cut work: the doomed engine processes 10 more. Wait on the
+        # engine's STARTED counter, not _c_in: the pipelined loop counts
+        # incoming at decode time, so _c_in can hit 30 with the batch
+        # still in flight — started_before would read short and restore's
+        # barrier-drained batch would inflate the delta (flaky under load)
         broker.produce_batch(CFG.kafka_topic,
                              [tx(i, 10.0) for i in range(20, 30)])
         _drain(router, 30)
-        started_before = router.engine.registry.counter(
-            "process_instances_started_total"
-        ).value(labels={"process": "standard"})
+        started_c = router.engine.registry.counter(
+            "process_instances_started_total")
+        deadline = time.time() + 20.0
+        while (started_c.value(labels={"process": "standard"}) < 30
+               and time.time() < deadline):
+            time.sleep(0.01)
+        started_before = started_c.value(labels={"process": "standard"})
+        assert started_before == 30
         # crash + restore: the 10 post-cut records must re-deliver into the
         # restored engine (at-least-once), through the SAME live router
         new_engine = coord.restore(reason="test")
@@ -420,3 +429,85 @@ def test_restore_from_disk_tolerates_wrong_shapes(tmp_path):
         coord.path = str(f)
         assert coord.restore_from_disk() is None, content
     assert coord.restores == 0
+
+
+def test_retention_pin_seeded_at_coordinator_start():
+    """The FIRST checkpoint has no prior pin: between its barrier release
+    and its own pin write, the consuming groups advance and retention
+    could trim the new cut's replay window (ADVICE r5 medium). The
+    coordinator must therefore seed RETENTION_PIN_GROUP at construction,
+    at the groups' then-current committed positions."""
+    from ccfd_tpu.bus.broker import RETENTION_PIN_GROUP
+
+    broker = Broker(default_partitions=1, retention_records=64)
+    reg_engine = Registry()
+    factory = lambda: build_engine(CFG, broker, reg_engine)  # noqa: E731
+    router = Router(CFG, broker, amount_score, factory(), Registry(),
+                    max_batch=4096)
+    broker.produce_batch(CFG.kafka_topic, [tx(i, 10.0) for i in range(256)])
+    assert router.step() == 256  # commits the router group at 256
+
+    coord = CheckpointCoordinator(router, broker, factory, interval_s=999.0)
+    # the seed pin exists BEFORE any checkpoint ran...
+    assert coord.checkpoints == 0
+    assert broker.committed_offsets(RETENTION_PIN_GROUP,
+                                    CFG.kafka_topic) == [256]
+    # ...and it holds the trim floor through the first-checkpoint window:
+    # the router races ahead of the (still-unwritten) first cut, retention
+    # runs, and the records a restore-from-256 would replay must survive
+    broker.produce_batch(CFG.kafka_topic,
+                         [tx(i, 10.0) for i in range(1024)])
+    while router.step():
+        pass
+    assert broker.committed_offsets("router", CFG.kafka_topic) == [1280]
+    broker.enforce_retention()
+    assert broker.beginning_offsets(CFG.kafka_topic) == [256], (
+        "retention trimmed into the pre-first-checkpoint replay window")
+    # the first real checkpoint then advances the pin to its own cut
+    # (router marked stopped: no loop exists to ack the barrier)
+    router.stop()
+    assert coord.checkpoint() is not None
+    assert broker.committed_offsets(RETENTION_PIN_GROUP,
+                                    CFG.kafka_topic) == [1280]
+    broker.enforce_retention()
+    assert broker.beginning_offsets(CFG.kafka_topic) == [1280 - 64]
+
+
+def test_seed_pin_respects_on_disk_cut_at_crash_bringup(tmp_path):
+    """Crash bring-up (code-review r6): the groups' replayed committed
+    positions sit PAST the persisted cut that restore_from_disk() will
+    rewind to. The constructor's pin seed must fold the disk cut in
+    (element-wise min), not overwrite the surviving pin forward — or
+    retention could trim the very window the restore replays."""
+    from ccfd_tpu.bus.broker import RETENTION_PIN_GROUP
+
+    broker = Broker(default_partitions=1, retention_records=64)
+    reg_engine = Registry()
+    factory = lambda: build_engine(CFG, broker, reg_engine)  # noqa: E731
+    router = Router(CFG, broker, amount_score, factory(), Registry(),
+                    max_batch=4096)
+    path = str(tmp_path / "cut.json")
+    broker.produce_batch(CFG.kafka_topic, [tx(i, 10.0) for i in range(100)])
+    router.step()
+    router.stop()  # parked: checkpoints don't need a live loop to ack
+    coord1 = CheckpointCoordinator(router, broker, factory,
+                                   interval_s=999.0, path=path)
+    assert coord1.checkpoint() is not None  # disk cut at offset 100
+    # post-cut traffic consumed before the "crash": groups now at 400
+    broker.produce_batch(CFG.kafka_topic, [tx(i, 10.0) for i in range(300)])
+    router.reset()
+    while router.step():
+        pass
+    router.stop()
+    assert broker.committed_offsets("router", CFG.kafka_topic) == [400]
+    # process restart: a FRESH coordinator on the same path + broker.
+    # Its seed must keep the pin at the disk cut (100), not jump to 400.
+    coord2 = CheckpointCoordinator(router, broker, factory,
+                                   interval_s=999.0, path=path)
+    assert broker.committed_offsets(RETENTION_PIN_GROUP,
+                                    CFG.kafka_topic) == [100]
+    broker.enforce_retention()
+    assert broker.beginning_offsets(CFG.kafka_topic) == [100], (
+        "retention trimmed the on-disk cut's replay window before "
+        "restore_from_disk could rewind to it")
+    assert coord2.restore_from_disk() is not None  # replay window intact

@@ -26,7 +26,17 @@ rolled back (at-least-once redelivery, like Kafka into a restarted KIE
 pod — reference deploy/ccd-service.yaml); nothing else may be lost or
 double-completed.
 
+Round 6 adds ``--net-faults``: beyond kills, the ChaosMonkey schedules
+NETWORK fault storms — by default a blackholed scorer edge
+(runtime/faults.py) — and the router runs its degradation ladder
+(runtime/breaker.py + router tiers). The exit criteria then also require
+that storms fired, the ladder absorbed them (``router_degraded_total``),
+the breaker-state gauge is exported, and the accounting walk stayed
+violation-free while degraded — a sick edge must cost scoring QUALITY,
+never progress or correctness.
+
     JAX_PLATFORMS=cpu python tools/chaos_soak.py --seconds 240
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --seconds 240 --net-faults
 
 Prints one JSON line; record it in BASELINE.md.  Exit 0 only when the
 pipeline drained, the device path recovered, engine kills happened and
@@ -257,6 +267,18 @@ def main() -> int:
                     "transactions have flowed (early: replaying the log is "
                     "O(records), so the drill must run on a bounded log, "
                     "not the multi-million-record end state)")
+    ap.add_argument("--net-faults", action="store_true",
+                    help="round 6: drill DEGRADED edges, not just kills — "
+                    "the ChaosMonkey schedules fault storms on the scorer "
+                    "edge (runtime/faults.py) and the router must keep "
+                    "deciding every transaction through its degradation "
+                    "ladder (host tier / rules-only) with zero accounting "
+                    "violations")
+    ap.add_argument("--fault-spec", default="scorer:blackhole,stall=300",
+                    help="CCFD_FAULTS-syntax plan the storms activate "
+                    "(default: a blackholed scorer edge)")
+    ap.add_argument("--fault-interval-s", type=float, default=10.0)
+    ap.add_argument("--fault-duration-s", type=float, default=3.0)
     args = ap.parse_args()
 
     bus_dir = args.bus_log or tempfile.mkdtemp(prefix="ccfd_soak_bus_")
@@ -322,7 +344,26 @@ def main() -> int:
     tune_for_service()  # match the gc config services run with
     scorer._wedge._probe_interval_s = 2.0  # tight recovery for the soak
 
-    router = Router(cfg, broker, scorer.score, engine, reg_r, max_batch=4096)
+    # net-fault mode: the scorer edge gets a storm-scheduled fault plan
+    # (blackhole by default) and the router gets the full degradation
+    # ladder — breaker-gated device tier, host numpy tier, rules-only
+    # floor — so a partitioned scorer degrades quality, never progress
+    fault_plan = None
+    score_fn = scorer.score
+    host_fn = None
+    if args.net_faults:
+        from ccfd_tpu.runtime.faults import FaultPlan  # noqa: E402
+
+        fault_plan = FaultPlan.from_string(args.fault_spec, seed=13,
+                                           active=False)
+        net_injector = fault_plan.injector("scorer", reg_r)
+        if net_injector is not None:
+            score_fn = net_injector.wrap_fn(scorer.score)
+        if scorer.has_host_forward:
+            host_fn = scorer.host_score
+    router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
+                    host_score_fn=host_fn,
+                    degrade=True if args.net_faults else None)
     coord = CheckpointCoordinator(router, broker, engine_factory,
                                   interval_s=args.checkpoint_s)
     sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
@@ -461,7 +502,11 @@ def main() -> int:
 
     targets = [t for t in args.targets.split(",") if t]
     monkey = ChaosMonkey(sup, seed=11, targets=targets,
-                         registry=reg_c, interval_s=args.chaos_interval_s)
+                         registry=reg_c, interval_s=args.chaos_interval_s,
+                         fault_plan=fault_plan,
+                         fault_interval_s=(args.fault_interval_s
+                                           if args.net_faults else None),
+                         fault_duration_s=args.fault_duration_s)
     monkey.start()
 
     def rss_mb() -> float:
@@ -610,6 +655,23 @@ def main() -> int:
         "dispatch_timeouts": scorer.dispatch_timeouts,
         "host_fallback_scores": scorer.host_fallback_scores,
         "tasks_completed_by_investigators": investigator.completed,
+        "net_faults": {
+            "enabled": bool(args.net_faults),
+            "spec": args.fault_spec if args.net_faults else "",
+            "windows": len(monkey.fault_windows),
+            "degraded_host": reg_r.counter(
+                "router_degraded_total").value({"tier": "host"}),
+            "degraded_rules": reg_r.counter(
+                "router_degraded_total").value({"tier": "rules"}),
+            "shed": reg_r.counter("router_shed_total").value(),
+            "scorer_edge_failures": reg_r.counter(
+                "router_score_errors_total").value(),
+            "breaker_opens": (router._breaker.opens
+                              if router._breaker is not None else 0),
+            # the acceptance surface: breaker-state gauges reach /metrics
+            # through the same registry the exporter scrapes
+            "breaker_gauge_exported": "ccfd_breaker_state" in reg_r.render(),
+        },
         "accounting": {
             "starts": acct["starts"],
             "completes": acct["completes"],
@@ -639,6 +701,20 @@ def main() -> int:
         and ("bus" not in targets
              or (result["bus_kills"] > 0 and broker.crash_restarts > 0))
         and acct_ok
+        and (
+            not args.net_faults
+            or (
+                # degraded edges drilled AND absorbed: storms fired, the
+                # ladder scored through them (host tier and/or rules
+                # floor), the breaker surface is on /metrics, and — via
+                # acct_ok above — accounting stayed violation-free while
+                # degraded
+                result["net_faults"]["windows"] > 0
+                and (result["net_faults"]["degraded_host"]
+                     + result["net_faults"]["degraded_rules"]) > 0
+                and result["net_faults"]["breaker_gauge_exported"]
+            )
+        )
     )
     return 0 if ok else 3
 
