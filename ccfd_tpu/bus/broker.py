@@ -25,7 +25,7 @@ import binascii
 import itertools
 import threading
 import time
-from typing import Any, Iterable, NamedTuple
+from typing import Any, Iterable, Mapping, NamedTuple
 
 
 class Record(NamedTuple):
@@ -43,13 +43,23 @@ class Record(NamedTuple):
     # poll time (Record._make, ~100 ns on records consumed once).
     # Bytes/str-valued records then leave gen-2 scans entirely;
     # dict-valued ones (audit events) remain tracked — that residual is
-    # the retention limitation's, not the container's.
+    # the retention limitation's, not the container's (a trace-stamped
+    # batch's shared headers dict adds ONE tracked object per batch,
+    # not per record — every record in the batch aliases it).
+    #
+    # ``headers`` carries Kafka-style record headers — today the W3C
+    # ``traceparent`` stamped per produced batch (observability/trace.py)
+    # so consumers resume the producer's trace. In-memory only: the
+    # durable log does not persist headers (a replayed record's trace
+    # ended with the process that emitted it), and None stays the common
+    # case on untraced paths.
     topic: str
     partition: int
     offset: int
     key: Any
     value: Any
     timestamp: float
+    headers: Any = None
 
 
 # Group name under which runtime/recovery.py pins its last durable cut:
@@ -212,7 +222,8 @@ class Broker:
             for p, recs in enumerate(replays):
                 part = t.partitions[p]
                 for key, ts, value in recs:
-                    part.records.append((name, p, part.end, key, value, ts))
+                    part.records.append(
+                        (name, p, part.end, key, value, ts, None))
         # Clamp replayed offsets to the replayed log: a torn-tail
         # truncation may have dropped records whose consumption was
         # already committed; an out-of-range offset would silently skip
@@ -346,11 +357,14 @@ class Broker:
 
     # -- produce ----------------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None,
-                partition: int | None = None) -> Record:
+                partition: int | None = None,
+                headers: Mapping[str, str] | None = None) -> Record:
         """Append one record. ``partition`` overrides key routing (the
         Kafka producer's explicit-partition mode) — control records that
         must reach EVERY partition, like the recovery coordinator's
-        ``engine_restored`` marker, produce once per partition with it."""
+        ``engine_restored`` marker, produce once per partition with it.
+        ``headers`` are Kafka-style record headers (trace context rides
+        here); in-memory only, not persisted to the durable log."""
         with self._lock:
             t = self._topic(topic)
             if partition is None:
@@ -364,7 +378,7 @@ class Broker:
                 part = partition
             now = time.time()
             pobj = t.partitions[part]
-            item = (topic, part, pobj.end, key, value, now)
+            item = (topic, part, pobj.end, key, value, now, headers)
             if self._log is not None:
                 # encode BEFORE any mutation: an unencodable record must
                 # fail cleanly, not leave memory and disk diverged — and
@@ -381,10 +395,15 @@ class Broker:
             return Record._make(item)
 
     def produce_batch(
-        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+        self, topic: str, values: Iterable[Any],
+        keys: Iterable[Any] | None = None,
+        headers: Mapping[str, str] | None = None,
     ) -> int:
         """Append many records under ONE lock acquisition (the producer's
-        hot path; same surface as RemoteBroker.produce_batch).
+        hot path; same surface as RemoteBroker.produce_batch). One
+        ``headers`` mapping stamps the WHOLE batch (the producer's trace
+        context per transaction batch) — every record aliases it, so the
+        cost is one dict per batch, not per record.
 
         Failure contract: encode errors fail the WHOLE batch before any
         state mutates (payloads are built up front). An I/O error from the
@@ -416,7 +435,8 @@ class Broker:
                     if payloads is not None:
                         self._log.append_payload(topic, part, payloads[i])
                     pobj = t.partitions[part]
-                    pobj.records.append((topic, part, pobj.end, k, v, now))
+                    pobj.records.append(
+                        (topic, part, pobj.end, k, v, now, headers))
                     appended += 1
             finally:
                 if appended:

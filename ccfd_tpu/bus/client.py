@@ -43,15 +43,20 @@ class RemoteBroker:
         retries: int = 2,
         breaker=None,
         faults=None,
+        tracer=None,
     ):
         # breaker/faults ride the shared transport (utils/httpclient.py);
         # note poll redelivery still holds under injected faults — the seq
-        # only advances on a successful, uncorrupted response
+        # only advances on a successful, uncorrupted response. The tracer
+        # (observability/trace.py) makes every bus RPC a client span and
+        # injects traceparent, so a produced batch's context reaches the
+        # BrokerServer and rides its records.
         self._http = PooledHTTPClient(
             base_url, default_port=9092, pool_size=pool_size,
             timeout_s=timeout_s, retries=retries,
             scheme_error="RemoteBroker needs an http:// URL",
             breaker=breaker, faults=faults,
+            tracer=tracer, trace_edge="bus",
         )
 
     def _request(
@@ -64,19 +69,24 @@ class RemoteBroker:
 
     # -- Broker surface ----------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None,
-                partition: int | None = None) -> dict[str, Any]:
+                partition: int | None = None,
+                headers: dict | None = None) -> dict[str, Any]:
         """``partition`` overrides key routing — same surface as
         ``Broker.produce`` / ``KafkaAdapter.produce`` (control records
         like the recovery coordinator's per-partition markers need it on
-        every transport)."""
+        every transport). ``headers`` stamps the record server-side
+        (trace context; the HTTP traceparent header also does this
+        implicitly when the transport is traced)."""
         rec: dict[str, Any] = {
             "value": encode_value(value), "key": encode_value(key),
         }
         if partition is not None:
             rec["partition"] = int(partition)
+        body_out: dict[str, Any] = {"records": [rec]}
+        if headers:
+            body_out["headers"] = dict(headers)
         code, body = self._request(
-            "POST", f"/topics/{topic}/produce",
-            {"records": [rec]},
+            "POST", f"/topics/{topic}/produce", body_out,
             idempotent=False,
         )
         if code != 200:
@@ -84,9 +94,12 @@ class RemoteBroker:
         return body["metas"][0]
 
     def produce_batch(
-        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+        self, topic: str, values: Iterable[Any],
+        keys: Iterable[Any] | None = None,
+        headers: dict | None = None,
     ) -> int:
-        """One HTTP round-trip for many records (the producer's hot path)."""
+        """One HTTP round-trip for many records (the producer's hot path);
+        one ``headers`` mapping stamps the whole batch server-side."""
         if keys is None:
             records = [{"value": encode_value(v), "key": None} for v in values]
         else:
@@ -96,8 +109,11 @@ class RemoteBroker:
             ]
         if not records:
             return 0
+        body_out: dict[str, Any] = {"records": records}
+        if headers:
+            body_out["headers"] = dict(headers)
         code, body = self._request(
-            "POST", f"/topics/{topic}/produce", {"records": records},
+            "POST", f"/topics/{topic}/produce", body_out,
             idempotent=False,
         )
         if code != 200:
@@ -157,7 +173,8 @@ class RemoteBroker:
 class _RemoteRecord:
     """Record view over the wire: same attribute surface as bus.broker.Record."""
 
-    __slots__ = ("topic", "partition", "offset", "key", "value", "timestamp")
+    __slots__ = ("topic", "partition", "offset", "key", "value", "timestamp",
+                 "headers")
 
     def __init__(self, d: dict[str, Any]):
         self.topic = d["topic"]
@@ -166,6 +183,7 @@ class _RemoteRecord:
         self.key = decode_value(d["key"])
         self.value = decode_value(d["value"])
         self.timestamp = d["timestamp"]
+        self.headers = d.get("headers")  # absent on the wire when None
 
 
 class RemoteConsumer:

@@ -54,6 +54,21 @@ def _loads(b: bytes | None) -> Any:
     return decode_value(json.loads(b.decode()))
 
 
+def _wire_headers(headers: dict) -> list[tuple[str, bytes]]:
+    """Framework headers dict -> kafka-python record headers."""
+    return [(str(k), str(v).encode()) for k, v in headers.items()]
+
+
+def _unwire_headers(raw) -> dict | None:
+    """kafka-python record headers -> framework dict (None when absent)."""
+    if not raw:
+        return None
+    out = {}
+    for k, v in raw:
+        out[str(k)] = v.decode("utf-8", "replace") if isinstance(v, bytes) else v
+    return out
+
+
 class KafkaAdapter:
     """``bus.broker.Broker`` surface backed by a real Kafka cluster.
 
@@ -228,15 +243,19 @@ class KafkaAdapter:
 
     # -- produce ----------------------------------------------------------
     def produce(self, topic: str, value: Any, key: Any = None,
-                partition: int | None = None) -> dict[str, Any]:
+                partition: int | None = None,
+                headers: dict | None = None) -> dict[str, Any]:
         """``partition`` overrides key routing (Kafka's explicit-partition
         mode) — the recovery coordinator's per-partition ``engine_restored``
-        markers require it, same surface as ``Broker.produce``."""
-        if partition is None:
-            fut = self._producer.send(topic, value=value, key=key)
-        else:
-            fut = self._producer.send(topic, value=value, key=key,
-                                      partition=partition)
+        markers require it, same surface as ``Broker.produce``. ``headers``
+        map to real Kafka record headers (list of (str, bytes)) — trace
+        context survives the real-cluster transport too."""
+        kw: dict[str, Any] = {}
+        if partition is not None:
+            kw["partition"] = partition
+        if headers:
+            kw["headers"] = _wire_headers(headers)
+        fut = self._producer.send(topic, value=value, key=key, **kw)
         try:
             md = fut.get(timeout=self._timeout_s)
         except Exception:
@@ -248,7 +267,9 @@ class KafkaAdapter:
         return {"topic": md.topic, "partition": md.partition, "offset": md.offset}
 
     def produce_batch(
-        self, topic: str, values: Iterable[Any], keys: Iterable[Any] | None = None
+        self, topic: str, values: Iterable[Any],
+        keys: Iterable[Any] | None = None,
+        headers: dict | None = None,
     ) -> int:
         """Pipelined sends + one flush (the producer's hot path). A send
         error fails the call after the flush resolves every in-flight
@@ -260,8 +281,9 @@ class KafkaAdapter:
         key_list = list(keys) if keys is not None else [None] * len(values)
         if len(key_list) != len(values):
             raise ValueError("keys and values must have equal length")
+        kw = {"headers": _wire_headers(headers)} if headers else {}
         futures = [
-            self._producer.send(topic, value=v, key=k)
+            self._producer.send(topic, value=v, key=k, **kw)
             for v, k in zip(values, key_list)
         ]
         self._producer.flush(timeout=self._timeout_s)
@@ -354,6 +376,8 @@ class KafkaConsumerAdapter:
                         timestamp=(r.timestamp / 1000.0
                                    if r.timestamp and r.timestamp > 0
                                    else time.time()),
+                        headers=_unwire_headers(
+                            getattr(r, "headers", None)),
                     )
                 )
         if out:

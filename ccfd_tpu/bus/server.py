@@ -30,6 +30,7 @@ members — Kafka's session-timeout behavior.
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import re
 import threading
@@ -64,7 +65,7 @@ def decode_value(v: Any) -> Any:
 
 
 def record_view(r: Record) -> dict[str, Any]:
-    return {
+    view = {
         "topic": r.topic,
         "partition": r.partition,
         "offset": r.offset,
@@ -72,6 +73,9 @@ def record_view(r: Record) -> dict[str, Any]:
         "value": encode_value(r.value),
         "timestamp": r.timestamp,
     }
+    if r.headers:  # trace context etc.; absent stays off the wire
+        view["headers"] = dict(r.headers)
+    return view
 
 
 class BrokerServer:
@@ -80,10 +84,14 @@ class BrokerServer:
         broker: Broker | None = None,
         registry: Registry | None = None,
         consumer_ttl_s: float = 60.0,
+        tracer=None,
     ):
         self.broker = broker or Broker()
         self.registry = registry or Registry()
         self.consumer_ttl_s = consumer_ttl_s
+        # observability.trace.Tracer: produce requests join the caller's
+        # trace (traceparent header) with a server-side span
+        self.tracer = tracer
         self._consumers: dict[int, Consumer] = {}
         self._last_poll: dict[int, float] = {}
         # last delivered batch per consumer, keyed by the client's poll seq:
@@ -276,6 +284,34 @@ class BrokerServer:
                     if not isinstance(records, list):
                         self._send_json(400, {"error": "need records: [...]"})
                         return
+                    # batch-level trace context: the producing client's
+                    # traceparent (HTTP header) stamps every record of the
+                    # batch, so remote consumers resume the SAME trace the
+                    # in-process transport would carry. An explicit
+                    # "headers" body field wins (a relay forwarding records
+                    # that already carry their own context).
+                    rec_headers = payload.get("headers")
+                    if rec_headers is not None and not isinstance(rec_headers, dict):
+                        rec_headers = None  # malformed: drop, don't 500
+                    span_cm = None
+                    if server.tracer is not None:
+                        from ccfd_tpu.observability import trace as _trace
+
+                        parent = _trace.extract_context(self.headers)
+                        span_cm = server.tracer.span(
+                            "bus.produce", parent=parent,
+                            attrs={"topic": m.group(1),
+                                   "records": len(records)},
+                        )
+                        if rec_headers is None and parent is not None:
+                            rec_headers = {
+                                _trace.TRACEPARENT:
+                                    _trace.format_traceparent(parent),
+                            }
+                    elif rec_headers is None:
+                        tp = self.headers.get("traceparent")
+                        if tp:
+                            rec_headers = {"traceparent": tp}
                     # explicit-partition mode (control records, e.g.
                     # recovery's engine_restored markers). Validate the
                     # WHOLE batch before producing anything: a mid-batch
@@ -295,15 +331,18 @@ class BrokerServer:
                             return
                     metas = []
                     try:
-                        for r in records:
-                            rec = server.broker.produce(
-                                m.group(1),
-                                decode_value(r.get("value")),
-                                key=decode_value(r.get("key")),
-                                partition=r.get("partition"),
-                            )
-                            metas.append({"partition": rec.partition,
-                                          "offset": rec.offset})
+                        with (span_cm if span_cm is not None
+                              else contextlib.nullcontext()):
+                            for r in records:
+                                rec = server.broker.produce(
+                                    m.group(1),
+                                    decode_value(r.get("value")),
+                                    key=decode_value(r.get("key")),
+                                    partition=r.get("partition"),
+                                    headers=rec_headers,
+                                )
+                                metas.append({"partition": rec.partition,
+                                              "offset": rec.offset})
                     except ValueError as e:
                         # out-of-range partition: records 0..k-1 ARE in
                         # the log — count them so metrics agree with

@@ -682,6 +682,21 @@ def cmd_up(args: argparse.Namespace) -> int:
     return 0
 
 
+def _tracing_for(cfg, registry, component):
+    """(tracer, sink) for a standalone service role, or (None, None) when
+    CCFD_TRACE_SAMPLE=0 turns tracing off. The tracer lands spans in the
+    role's SCRAPED registry; the sink's own sampler metrics live in a
+    'tracing' registry the caller may also export."""
+    if cfg.trace_sample <= 0:
+        return None, None
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.observability.trace import SpanSink, Tracer
+
+    sink = SpanSink(sample=cfg.trace_sample,
+                    slow_s=cfg.trace_slow_ms / 1e3, registry=Registry())
+    return Tracer(registry, component=component, sink=sink), sink
+
+
 def _broker_for(cfg, registry=None):
     """BROKER_URL decides the transport: http:// -> RemoteBroker against a
     `bus serve` process; kafka:// -> real-cluster adapter (health counters
@@ -740,7 +755,11 @@ def cmd_bus(args: argparse.Namespace) -> int:
     broker = Broker(log_dir=log_dir, fsync=cfg.bus_fsync,
                     retention_records=cfg.bus_retention_records or None,
                     retention_overrides=cfg.parsed_retention_overrides())
-    srv = BrokerServer(broker)
+    from ccfd_tpu.metrics.prom import Registry
+
+    bus_registry = Registry()
+    tracer, _sink = _tracing_for(cfg, bus_registry, "bus")
+    srv = BrokerServer(broker, registry=bus_registry, tracer=tracer)
     port = srv.start(args.host, args.port)
     print(f"[bus] listening on {args.host}:{port}"
           + (f" (durable: {log_dir})" if log_dir else " (memory)"), file=sys.stderr)
@@ -763,7 +782,8 @@ def cmd_engine(args: argparse.Namespace) -> int:
 
         if _os.path.exists(args.state_file):
             engine.load(args.state_file)
-    srv = EngineServer(engine)
+    tracer, _sink = _tracing_for(cfg, engine.registry, "kie")
+    srv = EngineServer(engine, tracer=tracer)
     port = srv.start(args.host, args.port)
     print(f"[engine] KIE REST on {args.host}:{port} "
           f"definitions={list(engine.definitions())}", file=sys.stderr)
@@ -798,6 +818,7 @@ def cmd_router(args: argparse.Namespace) -> int:
     # the adapter's produce/send-error counters land in the router's
     # scraped registry (the KafkaCluster board's adapter panels)
     broker = _broker_for(cfg, registry=router_registry)
+    tracer, trace_sink = _tracing_for(cfg, router_registry, "router")
     # standing fault plan from CCFD_FAULTS (runtime/faults.py): degraded
     # edges are injectable on the standalone role exactly like under the
     # platform operator
@@ -812,7 +833,8 @@ def cmd_router(args: argparse.Namespace) -> int:
     if cfg.seldon_url.startswith("http"):
         from ccfd_tpu.serving.client import SeldonClient
 
-        score_fn = SeldonClient(cfg, faults=scorer_faults).score
+        score_fn = SeldonClient(cfg, faults=scorer_faults,
+                                tracer=tracer).score
     else:
         from ccfd_tpu.serving.scorer import Scorer
 
@@ -829,7 +851,8 @@ def cmd_router(args: argparse.Namespace) -> int:
 
     engine = EngineRestClient(cfg.kie_server_url,
                               timeout_s=cfg.seldon_timeout_ms / 1000.0,
-                              retries=cfg.client_retries)
+                              retries=cfg.client_retries,
+                              tracer=tracer)
     if fault_plan is not None:
         inj = fault_plan.injector("engine", router_registry)
         if inj is not None:
@@ -839,14 +862,18 @@ def cmd_router(args: argparse.Namespace) -> int:
     # production role: the degradation ladder is on (same default as the
     # platform operator) — a sick scorer edge degrades, never stalls
     router = Router(cfg, broker, score_fn, engine, registry=router_registry,
-                    host_score_fn=host_score_fn, degrade=True)
+                    host_score_fn=host_score_fn, degrade=True,
+                    tracer=tracer)
     # the reference scrapes the router on :8091/prometheus
     # (reference README.md:503-507); the standalone role must expose the
     # same surface the generated k8s Service/annotations point at
     from ccfd_tpu.metrics.exporter import MetricsExporter
 
+    regs = {"router": router.registry}
+    if trace_sink is not None:
+        regs["tracing"] = trace_sink.registry
     exporter = MetricsExporter(
-        {"router": router.registry}, host="0.0.0.0", port=args.metrics_port
+        regs, host="0.0.0.0", port=args.metrics_port, sink=trace_sink,
     ).start()
     print(f"[router] consuming {cfg.kafka_topic!r} from {cfg.broker_url}; "
           f"metrics on :{args.metrics_port}/prometheus", file=sys.stderr)
@@ -865,12 +892,21 @@ def cmd_notify(args: argparse.Namespace) -> int:
 
     cfg = Config.from_env()
     broker = _broker_for(cfg)
-    svc = NotificationService(cfg, broker, reply_prob=args.reply_prob,
-                              approve_prob=args.approve_prob, seed=args.seed)
+    from ccfd_tpu.metrics.prom import Registry
+
+    notify_registry = Registry()
+    tracer, trace_sink = _tracing_for(cfg, notify_registry, "notify")
+    svc = NotificationService(cfg, broker, notify_registry,
+                              reply_prob=args.reply_prob,
+                              approve_prob=args.approve_prob, seed=args.seed,
+                              tracer=tracer)
     from ccfd_tpu.metrics.exporter import MetricsExporter
 
+    regs = {"notify": svc.registry}
+    if trace_sink is not None:
+        regs["tracing"] = trace_sink.registry
     exporter = MetricsExporter(
-        {"notify": svc.registry}, host="0.0.0.0", port=args.metrics_port
+        regs, host="0.0.0.0", port=args.metrics_port, sink=trace_sink,
     ).start()
     print(f"[notify] consuming {cfg.customer_notification_topic!r} from "
           f"{cfg.broker_url}; metrics on :{args.metrics_port}/prometheus",
@@ -926,7 +962,12 @@ def cmd_producer(args: argparse.Namespace) -> int:
 
     cfg = Config.from_env()
     broker = _broker_for(cfg)
-    producer = Producer(cfg, broker)
+    from ccfd_tpu.metrics.prom import Registry
+
+    producer_registry = Registry()
+    tracer, _sink = _tracing_for(cfg, producer_registry, "producer")
+    producer = Producer(cfg, broker, registry=producer_registry,
+                        tracer=tracer)
     n = producer.run(limit=args.limit, rate_per_s=args.rate,
                      wire_format=args.wire_format)
     print(f"[producer] streamed {n} rows to {cfg.producer_topic!r}",

@@ -97,6 +97,14 @@ class Config:
     retrain_batch: int = 1024
     retrain_min_labels: int = 256
 
+    # --- distributed tracing (observability/trace.py) ---
+    # tail sampler: probabilistic keep-rate for BORING traces
+    # (slow/errored/fraud/degraded traces are always kept). 1.0 keeps
+    # everything (tools/trace_report.py), 0.0 keeps only forced traces.
+    trace_sample: float = 0.02  # CCFD_TRACE_SAMPLE
+    # a trace with any span at/above this duration is always kept
+    trace_slow_ms: float = 100.0  # CCFD_TRACE_SLOW_MS
+
     # --- TPU scorer knobs (new) ---
     model_name: str = "mlp"
     graph_cr: str = ""  # SeldonDeployment-shaped CR file -> serving/graph.py
@@ -212,6 +220,12 @@ class Config:
             retrain_batch=int(e.get("CCFD_RETRAIN_BATCH", str(Config.retrain_batch))),
             retrain_min_labels=int(
                 e.get("CCFD_RETRAIN_MIN_LABELS", str(Config.retrain_min_labels))
+            ),
+            trace_sample=float(
+                e.get("CCFD_TRACE_SAMPLE", str(Config.trace_sample))
+            ),
+            trace_slow_ms=float(
+                e.get("CCFD_TRACE_SLOW_MS", str(Config.trace_slow_ms))
             ),
             model_name=e.get("CCFD_MODEL", Config.model_name),
             graph_cr=e.get("CCFD_GRAPH_CR", Config.graph_cr),

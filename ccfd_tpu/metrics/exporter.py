@@ -11,10 +11,19 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
     GET /prometheus            all registries concatenated
     GET /prometheus/<name>     one component (router, kie, notify, ...)
     GET /rest/metrics          alias for the KIE registry (reference path)
+    GET /traces                retained-trace summaries (tail sampler, JSON)
+    GET /traces/<id>           one retained trace's spans (JSON)
+
+Contract details (scrapers depend on them): metric paths answer with
+``Content-Type: text/plain; version=0.0.4`` — or the OpenMetrics format
+(with histogram exemplars carrying trace ids) when the Accept header asks
+for ``application/openmetrics-text``; unknown registry names 404; HEAD
+mirrors GET headers with no body (liveness probes use it).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler
 
@@ -22,11 +31,90 @@ from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
 from ccfd_tpu.metrics.prom import Registry
 
+_TEXT_CTYPE = "text/plain; version=0.0.4"
+_OPENMETRICS_CTYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def _merge_renders(bodies: list[str], openmetrics: bool) -> str:
+    """Concatenate per-registry expositions into ONE valid exposition.
+
+    Naive concatenation breaks both formats once a metric family appears
+    in more than one registry (every component registry now carries
+    ``trace_span_seconds`` and the labelset-guard counter): duplicate
+    HELP/TYPE headers, families reopened later in the stream, and — for
+    OpenMetrics — ``# EOF`` markers mid-body. Merge family-wise instead:
+    each family's metadata is emitted once (first registry's wins), every
+    registry's samples group under it, IDENTICAL series from different
+    registries combine (counter/histogram samples sum — they are counts;
+    gauges last-write-wins; first exemplar kept), and the OM terminator
+    is appended exactly once at the end."""
+    order: list[str] = []
+    meta: dict[str, list[str]] = {}
+    kind_of: dict[str, str] = {}
+    # family -> {series key ("name{labels}"): [value, trailer]} in order
+    series: dict[str, dict[str, list]] = {}
+    seen_meta: set[tuple[str, str]] = set()
+
+    def family_of(name: str) -> dict[str, list]:
+        if name not in meta:
+            meta[name] = []
+            series[name] = {}
+            order.append(name)
+        return series[name]
+
+    for body in bodies:
+        family = ""  # Registry.render always emits TYPE before samples;
+        family_of("")  # "" is a defensive bucket for stray preamble lines
+        for line in body.splitlines():
+            if line == "# EOF" or not line:
+                continue
+            if line.startswith(("# HELP ", "# TYPE ")):
+                kind, name = line.split(" ", 3)[1:3]
+                family_of(name)
+                family = name
+                if line.startswith("# TYPE "):
+                    kind_of.setdefault(name, line.rsplit(" ", 1)[1])
+                if (name, kind) not in seen_meta:  # first registry wins
+                    seen_meta.add((name, kind))
+                    meta[name].append(line)
+            else:
+                fam = family_of(family)
+                head, _, trailer = line.partition(" # ")
+                key, _, val = head.rpartition(" ")
+                prev = fam.get(key)
+                if prev is None:
+                    fam[key] = [val, trailer]
+                else:
+                    # same series from another registry: counters and
+                    # histogram counts are additive; gauges last-wins
+                    try:
+                        if kind_of.get(family) == "gauge":
+                            prev[0] = val
+                        else:
+                            total = float(prev[0]) + float(val)
+                            prev[0] = (str(int(total))
+                                       if prev[0].isdigit() and val.isdigit()
+                                       else repr(total))
+                    except ValueError:
+                        prev[0] = val  # unparseable (+Inf etc.): last wins
+                    if not prev[1]:
+                        prev[1] = trailer
+    out: list[str] = []
+    for name in order:
+        out.extend(meta.get(name, ()))
+        for key, (val, trailer) in series.get(name, {}).items():
+            out.append(f"{key} {val}" + (f" # {trailer}" if trailer else ""))
+    if openmetrics:
+        out.append("# EOF")
+    return "\n".join(out) + "\n"
+
 
 class MetricsExporter:
     def __init__(self, registries: dict[str, Registry],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 sink=None):
         self._registries = dict(registries)
+        self._sink = sink  # observability.trace.SpanSink (or None)
         self._lock = threading.Lock()
         exporter = self
 
@@ -36,9 +124,12 @@ class MetricsExporter:
             def log_message(self, *args) -> None:
                 pass
 
-            def do_GET(self) -> None:
+            def _answer(self, head_only: bool) -> None:
                 path = self.path.split("?")[0].rstrip("/")
-                body = exporter.render_path(path)
+                openmetrics = "application/openmetrics-text" in (
+                    self.headers.get("Accept") or ""
+                )
+                body, ctype = exporter.respond(path, openmetrics)
                 if body is None:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
@@ -46,10 +137,17 @@ class MetricsExporter:
                     return
                 data = body.encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
-                self.wfile.write(data)
+                if not head_only:
+                    self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                self._answer(head_only=False)
+
+            def do_HEAD(self) -> None:
+                self._answer(head_only=True)
 
         self._httpd = FrameworkHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
@@ -58,18 +156,41 @@ class MetricsExporter:
         with self._lock:
             self._registries[name] = registry
 
-    def render_path(self, path: str) -> str | None:
+    # -- routing -----------------------------------------------------------
+    def respond(self, path: str, openmetrics: bool = False
+                ) -> tuple[str | None, str]:
+        """-> (body or None for 404, content type)."""
+        if path == "/traces" or path.startswith("/traces/"):
+            return self._traces(path), "application/json"
+        body = self.render_path(path, openmetrics)
+        return body, (_OPENMETRICS_CTYPE if openmetrics else _TEXT_CTYPE)
+
+    def render_path(self, path: str, openmetrics: bool = False) -> str | None:
         with self._lock:
             regs = dict(self._registries)
         if path in ("", "/prometheus", "/metrics"):
-            return "\n".join(r.render() for r in regs.values())
+            return _merge_renders(
+                [r.render(openmetrics=openmetrics) for r in regs.values()],
+                openmetrics,
+            )
         if path == "/rest/metrics":  # reference KIE scrape path
             kie = regs.get("kie")
-            return kie.render() if kie else None
+            return kie.render(openmetrics=openmetrics) if kie else None
         if path.startswith("/prometheus/"):
             r = regs.get(path[len("/prometheus/"):])
-            return r.render() if r else None
+            return r.render(openmetrics=openmetrics) if r else None
         return None
+
+    def _traces(self, path: str) -> str | None:
+        if self._sink is None:
+            return None
+        if path == "/traces":
+            return json.dumps({"traces": self._sink.traces()})
+        trace_id = path[len("/traces/"):]
+        spans = self._sink.trace(trace_id)
+        if spans is None:
+            return None
+        return json.dumps({"trace_id": trace_id, "spans": spans})
 
     @property
     def endpoint(self) -> str:

@@ -21,9 +21,21 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Iterable, Mapping, Sequence
 
 LabelKey = tuple[tuple[str, str], ...]
+
+# Label-cardinality guard (round 7): the trace layer labels series by span/
+# edge/component, and a bug (or an attacker-controlled label value) must
+# never be able to blow up the scrape surface. Each metric admits at most
+# ``labelset_limit`` distinct label-sets; extra label-sets fold into ONE
+# overflow series so the signal degrades to "something overflowed" instead
+# of an unbounded /metrics body, and the registry counts the folds in
+# ``ccfd_metric_labelsets_dropped_total{metric=...}``.
+DEFAULT_LABELSET_LIMIT = 512
+OVERFLOW_KEY: LabelKey = (("overflow", "true"),)
+LABELSETS_DROPPED = "ccfd_metric_labelsets_dropped_total"
 
 
 def _labelkey(labels: Mapping[str, str] | None) -> LabelKey:
@@ -54,10 +66,28 @@ def _fmt_value(v: float) -> str:
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, help_: str = ""):
+    def __init__(self, name: str, help_: str = "",
+                 labelset_limit: int | None = None):
         self.name = name
         self.help = help_
+        self.labelset_limit = (DEFAULT_LABELSET_LIMIT
+                               if labelset_limit is None
+                               else int(labelset_limit))
         self._lock = threading.Lock()
+        # set by Registry._get_or_make so folds are counted on the same
+        # scrape surface; directly-constructed metrics stay bounded but
+        # uncounted
+        self._on_overflow = None
+
+    def _admit(self, key: LabelKey, known: Mapping[LabelKey, object]) -> LabelKey:
+        """Call under self._lock: the guarded key for a write. Existing
+        series and the unlabeled series always pass; a NEW series past the
+        limit folds into the overflow bucket."""
+        if not key or key in known or len(known) < self.labelset_limit:
+            return key
+        if self._on_overflow is not None:
+            self._on_overflow(self.name)
+        return OVERFLOW_KEY
 
     def render(self) -> Iterable[str]:  # pragma: no cover - interface
         raise NotImplementedError
@@ -66,13 +96,15 @@ class _Metric:
 class _ScalarMetric(_Metric):
     """Shared labeled-scalar storage for Counter and Gauge."""
 
-    def __init__(self, name: str, help_: str = ""):
-        super().__init__(name, help_)
+    def __init__(self, name: str, help_: str = "",
+                 labelset_limit: int | None = None):
+        super().__init__(name, help_, labelset_limit)
         self._values: dict[LabelKey, float] = {}
 
     def inc(self, amount: float = 1.0, labels: Mapping[str, str] | None = None) -> None:
         key = _labelkey(labels)
         with self._lock:
+            key = self._admit(key, self._values)
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, labels: Mapping[str, str] | None = None) -> float:
@@ -99,8 +131,9 @@ class Gauge(_ScalarMetric):
     kind = "gauge"
 
     def set(self, value: float, labels: Mapping[str, str] | None = None) -> None:
+        key = _labelkey(labels)
         with self._lock:
-            self._values[_labelkey(labels)] = float(value)
+            self._values[self._admit(key, self._values)] = float(value)
 
 
 DEFAULT_BUCKETS = (
@@ -124,23 +157,41 @@ class Histogram(_Metric):
         name: str,
         help_: str = "",
         buckets: Sequence[float] = DEFAULT_BUCKETS,
+        labelset_limit: int | None = None,
     ):
-        super().__init__(name, help_)
+        super().__init__(name, help_, labelset_limit)
         b = sorted(set(float(x) for x in buckets))
         if not b or b[-1] != math.inf:
             b.append(math.inf)
         self.buckets = tuple(b)
         self._counts: dict[LabelKey, list[int]] = {}
         self._sums: dict[LabelKey, float] = {}
+        # last exemplar per (labelset, bucket): OpenMetrics exemplars tie a
+        # trace id to the histogram cell the observation landed in, so a
+        # Grafana heat map links to the exact retained trace
+        # (observability/trace.py; exporter /traces/<id>)
+        self._exemplars: dict[LabelKey, dict[int, tuple[dict, float, float]]] = {}
 
-    def observe(self, value: float, labels: Mapping[str, str] | None = None) -> None:
+    def observe(
+        self,
+        value: float,
+        labels: Mapping[str, str] | None = None,
+        exemplar: Mapping[str, str] | None = None,
+    ) -> None:
         key = _labelkey(labels)
         with self._lock:
+            key = self._admit(key, self._counts)
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            bucket_i = len(self.buckets) - 1
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
                     counts[i] += 1
+                    bucket_i = min(bucket_i, i)
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
+            if exemplar:
+                self._exemplars.setdefault(key, {})[bucket_i] = (
+                    dict(exemplar), float(value), time.time()
+                )
 
     def observe_many(
         self, values, labels: Mapping[str, str] | None = None
@@ -182,6 +233,7 @@ class Histogram(_Metric):
             )
         key = _labelkey(labels)
         with self._lock:
+            key = self._admit(key, self._counts)
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, c in enumerate(bucket_counts):
                 counts[i] += int(c)
@@ -215,14 +267,23 @@ class Histogram(_Metric):
             prev_ub, prev_c = ub, c
         return prev_ub
 
-    def render(self) -> Iterable[str]:
+    def render(self, exemplars: bool = False) -> Iterable[str]:
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
+            exs = ({k: dict(v) for k, v in self._exemplars.items()}
+                   if exemplars else {})
         for key, counts in items:
-            for ub, c in zip(self.buckets, counts):
+            key_exs = exs.get(key, {})
+            for i, (ub, c) in enumerate(zip(self.buckets, counts)):
                 lk = key + (("le", _fmt_value(ub)),)
-                yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {c}"
+                line = f"{self.name}_bucket{_fmt_labels(tuple(sorted(lk)))} {c}"
+                ex = key_exs.get(i)
+                if ex is not None:
+                    ex_labels, ex_value, ex_ts = ex
+                    line += (f" # {_fmt_labels(_labelkey(ex_labels))} "
+                             f"{_fmt_value(ex_value)} {ex_ts:.3f}")
+                yield line
             yield f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(sums.get(key, 0.0))}"
             yield f"{self.name}_count{_fmt_labels(key)} {counts[-1]}"
 
@@ -233,35 +294,70 @@ class Registry:
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        # the cardinality guard's fold counter is a metric like any other
+        # (rendered on the same scrape), created eagerly so alert rules can
+        # reference it before the first overflow ever happens
+        self._labelsets_dropped = Counter(
+            LABELSETS_DROPPED,
+            "new label-sets folded into the overflow bucket, by metric",
+        )
+        self._metrics[LABELSETS_DROPPED] = self._labelsets_dropped
 
-    def counter(self, name: str, help_: str = "") -> Counter:
-        return self._get_or_make(name, lambda: Counter(name, help_), Counter)
+    def _note_overflow(self, metric_name: str) -> None:
+        self._labelsets_dropped.inc(labels={"metric": metric_name})
 
-    def gauge(self, name: str, help_: str = "") -> Gauge:
-        return self._get_or_make(name, lambda: Gauge(name, help_), Gauge)
+    def counter(self, name: str, help_: str = "",
+                labelset_limit: int | None = None) -> Counter:
+        return self._get_or_make(
+            name, lambda: Counter(name, help_, labelset_limit), Counter)
+
+    def gauge(self, name: str, help_: str = "",
+              labelset_limit: int | None = None) -> Gauge:
+        return self._get_or_make(
+            name, lambda: Gauge(name, help_, labelset_limit), Gauge)
 
     def histogram(
-        self, name: str, help_: str = "", buckets: Sequence[float] = DEFAULT_BUCKETS
+        self, name: str, help_: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        labelset_limit: int | None = None,
     ) -> Histogram:
-        return self._get_or_make(name, lambda: Histogram(name, help_, buckets), Histogram)
+        return self._get_or_make(
+            name, lambda: Histogram(name, help_, buckets, labelset_limit),
+            Histogram)
 
     def _get_or_make(self, name, factory, cls):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = factory()
+                m._on_overflow = self._note_overflow
                 self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise TypeError(f"metric {name!r} already registered as {m.kind}")
             return m
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition; ``openmetrics=True`` additionally
+        renders histogram exemplars (``# {trace_id="..."} v ts``) — the
+        only exposition format Prometheus ingests exemplars from. The
+        exporter negotiates it via the Accept header."""
         lines: list[str] = []
         with self._lock:
             metrics = sorted(self._metrics.items())
         for name, m in metrics:
+            family = name
+            if openmetrics and m.kind == "counter" and name.endswith("_total"):
+                # OpenMetrics names the counter FAMILY without the _total
+                # suffix (samples keep it); a family named *_total is a
+                # "clashing name" parse error that loses the whole scrape
+                family = name[: -len("_total")]
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
-            lines.extend(m.render())
+                lines.append(f"# HELP {family} {m.help}")
+            lines.append(f"# TYPE {family} {m.kind}")
+            if openmetrics and isinstance(m, Histogram):
+                lines.extend(m.render(exemplars=True))
+            else:
+                lines.extend(m.render())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"

@@ -12,6 +12,7 @@ Deterministic under a seed so integration tests can script exact outcomes.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any
 
@@ -31,9 +32,14 @@ class NotificationService:
         reply_prob: float = 0.8,
         approve_prob: float = 0.7,
         seed: int = 0,
+        tracer=None,
     ):
         self.cfg = cfg
         self.broker = broker
+        # observability/trace.py: each handled notification resumes the
+        # trace context carried on the record and stamps the customer
+        # response it produces, so the reply leg stays on the same trace
+        self.tracer = tracer
         self.registry = registry or Registry()
         self.reply_prob = reply_prob
         self.approve_prob = approve_prob
@@ -59,15 +65,30 @@ class NotificationService:
             self._c_replied.inc(
                 labels={"response": "approved" if approved else "non_approved"}
             )
-            self.broker.produce(
-                self.cfg.customer_response_topic,
-                {
-                    "process_id": msg.get("process_id"),
-                    "customer_id": msg.get("customer_id"),
-                    "approved": approved,
-                },
-                key=msg.get("process_id"),
-            )
+            span_cm = contextlib.nullcontext()
+            if self.tracer is not None:
+                from ccfd_tpu.observability import trace as _trace
+
+                span_cm = self.tracer.span(
+                    "notify.handle",
+                    parent=_trace.extract_context(
+                        getattr(rec, "headers", None)))
+            with span_cm:
+                resp_headers = (_trace.inject_headers()
+                                if self.tracer is not None else None)
+                # headers kwarg only when stamping: broker test doubles
+                # that predate record headers keep working untraced
+                kw = {"headers": resp_headers} if resp_headers else {}
+                self.broker.produce(
+                    self.cfg.customer_response_topic,
+                    {
+                        "process_id": msg.get("process_id"),
+                        "customer_id": msg.get("customer_id"),
+                        "approved": approved,
+                    },
+                    key=msg.get("process_id"),
+                    **kw,
+                )
         return len(records)
 
     def reset(self) -> None:

@@ -308,6 +308,44 @@ def resilience_dashboard() -> dict:
     return _dashboard("CCFD Resilience", "ccfd-resilience", p)
 
 
+def tracing_dashboard() -> dict:
+    """Distributed-tracing board (round 7; observability/trace.py).
+
+    Per-stage latency decomposition from the span histograms every
+    component tracer exports (``trace_span_seconds{span=...}`` on the
+    component's own scraped registry), the critical-path share each stage
+    contributes (sum-of-durations normalized — the "where did this
+    transaction's 40 ms go" view), and the tail sampler's keep/drop
+    economics so an operator can see both what tracing shows and what it
+    costs. The labelset-guard panel watches the cardinality protection
+    that keeps span/edge labels from blowing up the scrape surface
+    (metrics/prom.py)."""
+    h = "trace_span_seconds"
+    p = [
+        _panel(0, "Per-stage latency p50 (by span)",
+               [f"histogram_quantile(0.5, sum by (span, le) (rate({h}_bucket[5m])))"]),
+        _panel(1, "Per-stage latency p99 (by span)",
+               [f"histogram_quantile(0.99, sum by (span, le) (rate({h}_bucket[5m])))"]),
+        _panel(2, "Critical-path share by stage",
+               [f"sum by (span) (rate({h}_sum[5m])) "
+                f"/ ignoring(span) group_left sum(rate({h}_sum[5m]))"]),
+        _panel(3, "Spans recorded / s (by component)",
+               ["rate(ccfd_trace_spans_total[5m])"]),
+        _panel(4, "Sampler keep vs drop / s",
+               ["rate(ccfd_traces_kept_total[5m])",
+                "rate(ccfd_traces_dropped_total[5m])"]),
+        _panel(5, "Forced keeps by reason / s",
+               ['rate(ccfd_traces_kept_total{reason!="sampled"}[5m])']),
+        _alert_stat(6, "Retained traces", ["ccfd_traces_retained"],
+                    red_below=1),
+        _panel(7, "Traces pending decision", ["ccfd_traces_pending"]),
+        _alert_stat(8, "Label-sets folded to overflow / s",
+                    ["rate(ccfd_metric_labelsets_dropped_total[5m])"],
+                    red_above=1),
+    ]
+    return _dashboard("CCFD Tracing", "ccfd-tracing", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -329,6 +367,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Analytics": analytics_dashboard(),
         "Retrain": retrain_dashboard(),
         "Resilience": resilience_dashboard(),
+        "Tracing": tracing_dashboard(),
     }
 
 

@@ -49,6 +49,7 @@ _COMPONENTS = (
     "monitoring", # Prometheus exporter (L7)
     "health",     # runtime probes (platform)
     "chaos",      # seeded fault injection (new; no reference analog)
+    "tracing",    # distributed tracing + tail sampler (new; round 7)
 )
 
 
@@ -117,6 +118,7 @@ class Platform:
         self.health_server = None
         self.chaos = None
         self.fault_plan = None  # runtime/faults.FaultPlan when configured
+        self.trace_sink = None  # observability/trace.SpanSink when enabled
         self.router = None
         self.investigator = None
         self.recovery = None  # CheckpointCoordinator when crash_recovery on
@@ -154,6 +156,31 @@ class Platform:
                 seed=int(chaos_spec.opt("seed", 0)),
                 active=storm_interval is None,
             )
+
+        # 0b. distributed tracing (observability/trace.py): ONE tail-
+        # sampling span sink shared by every component tracer; the tracers
+        # themselves are built per component below, registry-injected so
+        # span latency lands on the SAME scraped registries the exporter
+        # serves (the old utils/tracing global wrote to a private registry
+        # nothing scraped). Sampler knobs: CR `tracing.sample`/`slow_ms`
+        # over the CCFD_TRACE_SAMPLE / CCFD_TRACE_SLOW_MS env defaults.
+        tr_spec = spec.component("tracing")
+        if tr_spec.enabled:
+            from ccfd_tpu.observability.trace import SpanSink
+
+            self.trace_sink = SpanSink(
+                sample=float(tr_spec.opt("sample", cfg.trace_sample)),
+                slow_s=float(tr_spec.opt("slow_ms", cfg.trace_slow_ms)) / 1e3,
+                max_retained=int(tr_spec.opt("max_retained", 256)),
+                registry=self._registry("tracing"),
+            )
+            if tr_spec.opt("json_logs", True):
+                # trace-correlated structured logs for the framework's own
+                # logger namespace (observability/slog.py); the embedding
+                # application's root logger is left alone
+                from ccfd_tpu.observability import slog
+
+                slog.configure("platform")
 
         # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
         if spec.component("store").enabled:
@@ -243,6 +270,7 @@ class Platform:
                 self.registries,
                 host=mon.opt("host", "127.0.0.1"),
                 port=int(mon.opt("port", 0)),
+                sink=self.trace_sink,  # /traces + /traces/<id> endpoints
             ).start()
 
         if spec.component("health").enabled:
@@ -299,6 +327,17 @@ class Platform:
             if self.exporter is not None:  # registries created post-start
                 self.exporter.add(name, self.registries[name])
         return self.registries[name]
+
+    def _tracer(self, component: str):
+        """Component tracer wired to the component's SCRAPED registry and
+        the shared tail-sampling sink; None with tracing disabled (every
+        consumer treats a None tracer as 'tracing off')."""
+        if self.trace_sink is None:
+            return None
+        from ccfd_tpu.observability.trace import Tracer
+
+        return Tracer(self._registry(component), component=component,
+                      sink=self.trace_sink)
 
     def _up_store(self) -> None:
         from ccfd_tpu.data.ccfd import load_dataset, to_csv_bytes
@@ -384,7 +423,8 @@ class Platform:
             from ccfd_tpu.serving.server import PredictionServer
 
             self.prediction_server = PredictionServer(
-                self.scorer, self.cfg, self._registry("seldon")
+                self.scorer, self.cfg, self._registry("seldon"),
+                tracer=self._tracer("seldon"),
             )
             self.prediction_host = c.opt("host", "127.0.0.1")
             self.prediction_port = self.prediction_server.start(
@@ -458,7 +498,8 @@ class Platform:
             # refuse ("requires an empty engine").
             from ccfd_tpu.process.server import EngineServer
 
-            self.engine_server = EngineServer(self.engine)
+            self.engine_server = EngineServer(
+                self.engine, tracer=self._tracer("kie"))
             self.engine_port = self.engine_server.start(
                 c.opt("rest_host", "127.0.0.1"), int(c.opt("rest_port", 0))
             )
@@ -471,6 +512,7 @@ class Platform:
         notify = NotificationService(
             self.cfg, self.broker, self._registry("notify"),
             seed=int(c.opt("seed", 0)),
+            tracer=self._tracer("notify"),
         )
         self.supervisor.add_thread_service(
             "notify",
@@ -486,6 +528,7 @@ class Platform:
 
         c = self.spec.component("router")
         reg = self._registry("router")
+        router_tracer = self._tracer("router")
         host_score_fn = None
         if self.scorer is not None:
             from ccfd_tpu.serving.history import SeqScorer
@@ -505,6 +548,7 @@ class Platform:
                 self.cfg,
                 faults=(self.fault_plan.injector("scorer", reg)
                         if self.fault_plan else None),
+                tracer=router_tracer,
             ).score
         if self.fault_plan is not None and self.scorer is not None:
             # in-process scorer edge: same injection point the REST client
@@ -524,6 +568,7 @@ class Platform:
                 self.cfg.kie_server_url,
                 timeout_s=self.cfg.seldon_timeout_ms / 1000.0,
                 retries=self.cfg.client_retries,
+                tracer=router_tracer,
             )
         if self.fault_plan is not None and engine is not None:
             inj = self.fault_plan.injector("engine", reg)
@@ -542,6 +587,7 @@ class Platform:
             degrade=bool(c.opt("degrade", True)),
             max_inflight=(int(c.opt("max_inflight"))
                           if c.opt("max_inflight") is not None else None),
+            tracer=router_tracer,
         )
         self.router = router
         self.supervisor.add_thread_service(
@@ -679,6 +725,7 @@ class Platform:
             store_faults=(self.fault_plan.injector(
                 "store", self._registry("producer"))
                 if self.fault_plan else None),
+            tracer=self._tracer("producer"),
         )
         limit = c.opt("transactions")
         rate = c.opt("rate")

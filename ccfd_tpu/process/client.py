@@ -25,16 +25,20 @@ class EngineRestClient:
         retries: int = 2,
         breaker=None,
         faults=None,
+        tracer=None,
     ):
         # breaker/faults ride the shared transport (utils/httpclient.py):
         # an open circuit on the engine hop refuses instantly — the router
         # counts the group as start errors and keeps routing instead of
-        # stalling a full timeout per micro-batch
+        # stalling a full timeout per micro-batch. tracer: every engine
+        # RPC becomes a client span with traceparent injection, so the
+        # EngineServer side joins the router's trace.
         self._http = PooledHTTPClient(
             base_url, default_port=8090, pool_size=pool_size,
             timeout_s=timeout_s, retries=retries,
             scheme_error="unsupported scheme in KIE_SERVER_URL",
             breaker=breaker, faults=faults,
+            tracer=tracer, trace_edge="engine",
         )
 
     def _request(

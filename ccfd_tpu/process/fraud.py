@@ -113,6 +113,15 @@ def build_engine(
         return float(inst.vars.get("transaction", {}).get("Amount", 0.0))
 
     def notify(engine_: Engine, inst: Instance) -> None:
+        # trace carriage (observability/trace.py): process starts run on
+        # the router's thread inside its route span, so the notification
+        # record inherits the batch's trace context and the notify
+        # service's reply leg stays on the SAME end-to-end trace. Timer-
+        # driven notifications (engine clock thread) have no active span
+        # and ride unstamped.
+        from ccfd_tpu.observability.trace import inject_headers
+
+        headers = inject_headers()
         broker.produce(
             cfg.customer_notification_topic,
             {
@@ -121,6 +130,7 @@ def build_engine(
                 "transaction": inst.vars.get("transaction", {}),
             },
             key=inst.pid,
+            **({"headers": headers} if headers else {}),
         )
 
     def on_reply(engine_: Engine, inst: Instance) -> str:

@@ -67,8 +67,12 @@ def task_view(t: Task) -> dict[str, Any]:
 
 
 class EngineServer:
-    def __init__(self, engine: Engine):
+    def __init__(self, engine: Engine, tracer=None):
         self.engine = engine
+        # observability/trace.py: mutating requests (process starts,
+        # signals, task completions) join the caller's trace via the
+        # traceparent header -> "engine.rest" server span
+        self.tracer = tracer
         self._httpd: FrameworkHTTPServer | None = None
 
     def _handler_class(self):
@@ -137,6 +141,19 @@ class EngineServer:
                 self._send_json(404, {"error": "not found"})
 
             def do_POST(self):
+                if server.tracer is None:
+                    self._handle_post()
+                    return
+                from ccfd_tpu.observability.trace import extract_context
+
+                with server.tracer.span(
+                    "engine.rest",
+                    parent=extract_context(self.headers),
+                    attrs={"path": self.path.split("?")[0]},
+                ):
+                    self._handle_post()
+
+            def _handle_post(self):
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                 except ValueError:

@@ -24,7 +24,7 @@ from ccfd_tpu.metrics.prom import Registry
 
 
 def dataset_from_store(cfg: Config, limit: int | None = None,
-                       faults=None, breaker=None) -> Dataset:
+                       faults=None, breaker=None, tracer=None) -> Dataset:
     """Fetch ``filename`` from ``s3bucket`` at ``s3endpoint`` — exactly the
     reference producer's data path (ProducerDeployment.yaml:90-95): endpoint +
     bucket + key env vars, credentials from the ``keysecret`` pair.
@@ -36,7 +36,7 @@ def dataset_from_store(cfg: Config, limit: int | None = None,
     client = S3Client(
         cfg.s3_endpoint,
         Credentials(cfg.access_key_id, cfg.secret_access_key),
-        faults=faults, breaker=breaker,
+        faults=faults, breaker=breaker, tracer=tracer,
     )
     return load_csv_bytes(client.get(cfg.s3_bucket, cfg.filename), limit=limit)
 
@@ -50,14 +50,21 @@ class Producer:
         registry: Registry | None = None,
         store_faults=None,
         store_breaker=None,
+        tracer=None,
     ):
         self.cfg = cfg
         self.broker = broker
+        # observability/trace.py: each produced batch opens a ROOT span
+        # ("producer.batch") whose context is stamped onto the records as
+        # a traceparent header — the head of the end-to-end pipeline trace
+        # the router/engine/notify resume downstream
+        self.tracer = tracer
         if dataset is not None:
             self.dataset = dataset
         elif cfg.s3_endpoint:
             self.dataset = dataset_from_store(
-                cfg, faults=store_faults, breaker=store_breaker)
+                cfg, faults=store_faults, breaker=store_breaker,
+                tracer=tracer)
         else:
             self.dataset = load_dataset()
         self.registry = registry or Registry()
@@ -100,12 +107,10 @@ class Producer:
                 chunk_v.append(value)
                 chunk_k.append(key)
                 if len(chunk_v) >= 1000:
-                    produced += batcher(self.cfg.producer_topic, chunk_v, chunk_k)
-                    self._c_rows.inc(len(chunk_v))
+                    produced += self._produce_chunk(batcher, chunk_v, chunk_k)
                     chunk_v, chunk_k = [], []
             if chunk_v:
-                produced += batcher(self.cfg.producer_topic, chunk_v, chunk_k)
-                self._c_rows.inc(len(chunk_v))
+                produced += self._produce_chunk(batcher, chunk_v, chunk_k)
             return produced
         next_emit = time.perf_counter()
         for value, key in payloads:
@@ -118,7 +123,33 @@ class Producer:
                 next_emit += interval
             # the reference's producer-side `topic` env var (ProducerDeployment
             # contract) decides the sink topic, not the router's KAFKA_TOPIC
-            self.broker.produce(self.cfg.producer_topic, value, key=key)
+            if self.tracer is not None:
+                # paced emission is the latency experiment: a root span per
+                # record keeps one-transaction traces attributable
+                from ccfd_tpu.observability.trace import inject_headers
+
+                with self.tracer.span("producer.produce"):
+                    self.broker.produce(
+                        self.cfg.producer_topic, value, key=key,
+                        headers=inject_headers())
+            else:
+                self.broker.produce(self.cfg.producer_topic, value, key=key)
             self._c_rows.inc()
             produced += 1
         return produced
+
+    def _produce_chunk(self, batcher, values: list, keys: list) -> int:
+        """One batched produce, traced as one root span: the span context
+        stamps every record of the batch (one shared headers dict)."""
+        if self.tracer is None:
+            n = batcher(self.cfg.producer_topic, values, keys)
+            self._c_rows.inc(len(values))
+            return n
+        from ccfd_tpu.observability.trace import inject_headers
+
+        with self.tracer.span("producer.batch",
+                              attrs={"rows": len(values)}):
+            n = batcher(self.cfg.producer_topic, values, keys,
+                        headers=inject_headers())
+        self._c_rows.inc(len(values))
+        return n

@@ -36,6 +36,7 @@ semantics hold: a scorer failure drops that batch, counted.
 
 from __future__ import annotations
 
+import contextlib
 import operator
 import threading
 import time
@@ -62,6 +63,7 @@ class EngineClient(Protocol):
 
 _SCHEMA_GETTER = operator.itemgetter(*FEATURE_NAMES)
 _ZERO_ROW = (0.0,) * len(FEATURE_NAMES)
+_NULL_CM = contextlib.nullcontext()  # reusable: enter/exit hold no state
 
 
 def _decode_row_lenient(tx: Any, out_row: np.ndarray) -> int:
@@ -203,10 +205,19 @@ class Router:
         breaker: "Any | None" = None,
         degrade: bool | None = None,
         max_inflight: int | None = None,
+        tracer: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
         self.score = score_fn
+        # observability/trace.py: per micro-batch, the router RESUMES the
+        # trace context the producer stamped on the records ("router.batch"
+        # span parented on the producer's span) and opens child spans for
+        # decode/score/route — the per-stage latency attribution the
+        # Tracing board and tools/trace_report.py decompose. Fraud-routed
+        # and degraded-tier batches flag their spans, which the tail
+        # sampler always keeps.
+        self.tracer = tracer
         # history-aware scorers (serving/history.py SeqScorer) score each
         # transaction against the customer's history: they expose
         # score_with_ids(txs, x) and the router feeds them the decoded
@@ -382,13 +393,38 @@ class Router:
                 records.extend(more)
         return records
 
+    # -- tracing helpers ---------------------------------------------------
+    def _begin_batch_span(self, records: list):
+        """Open the micro-batch span, parented on the trace context the
+        producer stamped onto the records (first stamped record wins — a
+        batch mixes producer batches; per-stage attribution needs ONE
+        parent and the stages are batch-granular anyway). Returns None
+        when tracing is off."""
+        if self.tracer is None:
+            return None
+        from ccfd_tpu.observability.trace import extract_context
+
+        parent = None
+        for rec in records[:16]:  # stamped records carry it up front
+            h = getattr(rec, "headers", None)
+            if h:
+                parent = extract_context(h)
+                if parent is not None:
+                    break
+        return self.tracer.start("router.batch", parent=parent,
+                                 attrs={"records": len(records)})
+
     def _decode_batch(
-        self, records: list
+        self, records: list, batch_span=None
     ) -> tuple[np.ndarray, list, np.ndarray]:
         n = len(records)
         self._c_in.inc(n)
         self._h_batch.observe(n)
-        x, txs, bad = decode_records(records)
+        span_cm = (self.tracer.span("router.decode",
+                                    parent=batch_span.context)
+                   if batch_span is not None else None)
+        with (span_cm if span_cm is not None else _NULL_CM):
+            x, txs, bad = decode_records(records)
         if bad:
             self._c_decode_err.inc(bad)
         # produce timestamps ride along so _route can observe the
@@ -424,9 +460,12 @@ class Router:
         risky = x[:, self._amount_idx] >= self.cfg.low_amount_threshold
         return np.where(risky, thr, np.float32(0.0)).astype(np.float32)
 
-    def _score_tiered(self, x: np.ndarray, txs: list) -> np.ndarray:
+    def _score_tiered(self, x: np.ndarray, txs: list,
+                      span=None) -> np.ndarray:
         """device scorer → host numpy forward → rules-only. Never raises:
-        the bottom tier is pure numpy over data already in hand."""
+        the bottom tier is pure numpy over data already in hand. ``span``
+        (when tracing) gets the degraded-tier flag — a trace scored by a
+        fallback tier is always tail-sampled KEEP."""
         br = self._breaker
         if br is None or br.allow():
             t0 = time.perf_counter()
@@ -445,18 +484,31 @@ class Router:
                 if br is not None:
                     br.record_failure(time.perf_counter() - t0)
                 self._c_score_err.inc(len(txs))
+        elif span is not None:
+            span.attrs["breaker_open"] = True
         if self._host_score is not None:
             try:
                 proba = np.asarray(self._host_score(x), np.float32)
                 if proba.shape == (len(txs),) and np.isfinite(proba).all():
                     self._c_degraded.inc(len(txs), labels={"tier": "host"})
+                    if span is not None:
+                        span.attrs["degraded"] = "host"
                     return proba
             except Exception:  # noqa: BLE001 - fall to the rules tier
                 pass
         self._c_degraded.inc(len(txs), labels={"tier": "rules"})
+        if span is not None:
+            span.attrs["degraded"] = "rules"
         return self._rules_proba(x)
 
-    def _score_batch(self, x: np.ndarray, txs: list) -> np.ndarray:
+    def _score_batch(self, x: np.ndarray, txs: list,
+                     batch_span=None) -> np.ndarray:
+        if self.tracer is not None and batch_span is not None:
+            with self.tracer.span("router.score",
+                                  parent=batch_span.context) as sp:
+                if self._degrade:
+                    return self._score_tiered(x, txs, span=sp)
+                return self._score2(x, txs)
         if self._degrade:
             return self._score_tiered(x, txs)
         return self._score2(x, txs)
@@ -471,14 +523,49 @@ class Router:
         records = self._shed_oldest(records, 0)
         if not records:
             return 0
-        x, txs, ts = self._decode_batch(records)
-        t0 = time.perf_counter()
-        proba = self._score_batch(x, txs)
-        self._h_score_s.observe(time.perf_counter() - t0)
-        return self._route(x, txs, proba, ts)
+        batch_sp = self._begin_batch_span(records)
+        try:
+            x, txs, ts = self._decode_batch(records, batch_sp)
+            t0 = time.perf_counter()
+            proba = self._score_batch(x, txs, batch_sp)
+            self._h_score_s.observe(
+                time.perf_counter() - t0,
+                exemplar=({"trace_id": batch_sp.trace_id}
+                          if batch_sp is not None else None))
+            return self._route(x, txs, proba, ts, batch_span=batch_sp)
+        except BaseException:
+            # a crashed batch is exactly the trace an operator needs:
+            # error status forces the tail sampler's keep
+            if batch_sp is not None:
+                batch_sp.status = "error"
+            raise
+        finally:
+            if batch_sp is not None:
+                self.tracer.finish(batch_sp)
 
     def _route(self, x: np.ndarray, txs: list, proba: np.ndarray,
-               ts: np.ndarray | None = None) -> int:
+               ts: np.ndarray | None = None, batch_span=None) -> int:
+        route_sp = None
+        if self.tracer is not None and batch_span is not None:
+            route_sp = self.tracer.start("router.route",
+                                         parent=batch_span.context)
+        try:
+            if route_sp is None:
+                return self._route_inner(x, txs, proba, ts, batch_span,
+                                         route_sp)
+            # activate on THIS thread: the engine calls below (and the
+            # notification records the engine produces inside them,
+            # process/fraud.py notify) read current_context() to join the
+            # trace — an unactivated span would orphan the engine/notify leg
+            with self.tracer.activate(route_sp.context):
+                return self._route_inner(x, txs, proba, ts, batch_span,
+                                         route_sp)
+        finally:
+            if route_sp is not None:
+                self.tracer.finish(route_sp)
+
+    def _route_inner(self, x: np.ndarray, txs: list, proba: np.ndarray,
+                     ts: np.ndarray | None, batch_span, route_sp) -> int:
         fired = self.rules.evaluate(x, proba)
         # group the micro-batch by fired rule: one batched process-start per
         # (rule, process) instead of one engine round-trip per transaction —
@@ -522,6 +609,9 @@ class Router:
             if n_ok:
                 self._c_out.inc(n_ok, labels={"type": rule.process})
                 self._c_rule.inc(n_ok, labels={"rule": rule.name})
+                if route_sp is not None and "fraud" in rule.process:
+                    # fraud-routed batches are always tail-sampled KEEP
+                    route_sp.attrs["fraud"] = True
         if ts is not None and len(ts):
             self._h_decision_s.observe_many(time.time() - ts)
         return len(txs)
@@ -622,27 +712,42 @@ class Router:
         """
         from concurrent.futures import ThreadPoolExecutor
 
-        def timed_score(x: np.ndarray, txs: list) -> np.ndarray:
+        def timed_score(x: np.ndarray, txs: list, batch_sp) -> np.ndarray:
             # time INSIDE the worker so the histogram records the scorer
-            # round trip, not dispatch + however long the loop polled
+            # round trip, not dispatch + however long the loop polled.
+            # batch_sp rides along explicitly — the worker thread has no
+            # ambient trace context (contextvars are per-thread)
             t0 = time.perf_counter()
-            proba = self._score_batch(x, txs)
-            self._h_score_s.observe(time.perf_counter() - t0)
+            proba = self._score_batch(x, txs, batch_sp)
+            self._h_score_s.observe(
+                time.perf_counter() - t0,
+                exemplar=({"trace_id": batch_sp.trace_id}
+                          if batch_sp is not None else None))
             return proba
 
         def finish(pending: tuple) -> None:
-            pfut, px, ptxs, pts = pending
+            pfut, px, ptxs, pts, psp = pending
             try:
-                proba = pfut.result()
-            except Exception:
-                # a transient scorer failure (e.g. remote model timeout)
-                # drops this batch, not the routing loop
-                self._c_score_err.inc(len(ptxs))
-                return
-            self._route(px, ptxs, proba, pts)
+                try:
+                    proba = pfut.result()
+                except Exception:
+                    # a transient scorer failure (e.g. remote model timeout)
+                    # drops this batch, not the routing loop
+                    self._c_score_err.inc(len(ptxs))
+                    if psp is not None:
+                        psp.status = "error"
+                    return
+                self._route(px, ptxs, proba, pts, batch_span=psp)
+            except BaseException:
+                if psp is not None:  # _route crashed: force-keep the trace
+                    psp.status = "error"
+                raise
+            finally:
+                if psp is not None:
+                    self.tracer.finish(psp)
 
         ex = ThreadPoolExecutor(1, thread_name_prefix="ccfd-router-score")
-        pending: tuple | None = None  # (future, x, txs, ts)
+        pending: tuple | None = None  # (future, x, txs, ts, batch_span)
         try:
             while not self._stop.is_set():
                 if self._pause_req.is_set():
@@ -670,11 +775,13 @@ class Router:
                     )
                 fut = None
                 if records:
-                    x, txs, ts = self._decode_batch(records)
-                    fut = ex.submit(timed_score, x, txs)
+                    batch_sp = self._begin_batch_span(records)
+                    x, txs, ts = self._decode_batch(records, batch_sp)
+                    fut = ex.submit(timed_score, x, txs, batch_sp)
                 if pending is not None:
                     finish(pending)
-                pending = (fut, x, txs, ts) if fut is not None else None
+                pending = ((fut, x, txs, ts, batch_sp)
+                           if fut is not None else None)
         finally:
             try:
                 if pending is not None:

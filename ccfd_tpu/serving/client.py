@@ -15,6 +15,7 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import sys
 import time
 import urllib.parse
 from typing import Any
@@ -26,8 +27,12 @@ from ccfd_tpu.data.ccfd import FEATURE_NAMES
 
 
 class SeldonClient:
-    def __init__(self, cfg: Config, breaker=None, faults=None):
+    def __init__(self, cfg: Config, breaker=None, faults=None, tracer=None):
         self.cfg = cfg
+        # observability/trace.py: each predict POST becomes an rpc.scorer
+        # client span and carries traceparent, so the remote
+        # PredictionServer's serving.predict span joins the router's trace
+        self._tracer = tracer
         u = urllib.parse.urlparse(cfg.seldon_url)
         if u.scheme not in ("http", ""):
             raise ValueError(f"unsupported scheme in SELDON_URL: {cfg.seldon_url!r}")
@@ -69,13 +74,38 @@ class SeldonClient:
         if self._breaker is not None and not self._breaker.allow():
             from ccfd_tpu.runtime.breaker import CircuitOpenError
 
+            if self._tracer is not None:
+                from ccfd_tpu.observability.trace import current_context
+
+                # flag the CALLER's trace (breaker refusals are always
+                # tail-sampled KEEP) — but only when a trace is active:
+                # rooting a fresh trace per refusal would cycle the
+                # retained ring with zero-length refusal traces during
+                # exactly the incident window
+                if current_context() is not None:
+                    with self._tracer.span("rpc.scorer",
+                                           attrs={"breaker_open": True}):
+                        pass
             raise CircuitOpenError("circuit open for the prediction server")
+        span_cm = (self._tracer.span("rpc.scorer",
+                                     attrs={"path": self._path})
+                   if self._tracer is not None else None)
+        span_entered = False
         conn = self._pool.get()
         try:
             payload = json.dumps(body)
             headers = {"Content-Type": "application/json"}
             if self.cfg.seldon_token:
                 headers["Authorization"] = f"Bearer {self.cfg.seldon_token}"
+            if span_cm is not None:
+                from ccfd_tpu.observability.trace import (
+                    current_context,
+                    format_traceparent,
+                )
+
+                span_cm.__enter__()
+                span_entered = True
+                headers["traceparent"] = format_traceparent(current_context())
             attempts = max(1, self.cfg.client_retries + 1)
             last_exc: Exception | None = None
             for attempt in range(attempts):
@@ -129,6 +159,10 @@ class SeldonClient:
             ) from last_exc
         finally:
             self._pool.put(conn)
+            if span_entered:
+                # closes the span with error status when an exception is
+                # in flight (sys.exc_info() is live inside finally)
+                span_cm.__exit__(*sys.exc_info())
 
     def score(self, x: np.ndarray) -> np.ndarray:
         """(B, 30) -> (B,) proba_1 via POST <SELDON_URL>/<SELDON_ENDPOINT>."""

@@ -33,6 +33,7 @@ threads overlap host work.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 from typing import Any
@@ -57,10 +58,16 @@ class PredictionServer:
         scorer: Scorer,
         cfg: Config | None = None,
         registry: Registry | None = None,
+        tracer=None,
     ):
         self.scorer = scorer
         self.cfg = cfg or Config()
         self.registry = registry or Registry()
+        # observability/trace.py: predict requests join the caller's trace
+        # (extracted traceparent -> "serving.predict" server span) and the
+        # latency histogram carries the trace id as an exemplar. Python
+        # transport only — the C++ native front never enters this handler.
+        self.tracer = tracer
         r = self.registry
         # SeldonCore dashboard series (request rate / success / 4xx / 5xx and
         # latency quantiles come from this histogram + status-coded counter).
@@ -225,41 +232,57 @@ class PredictionServer:
         if not (path.endswith("/predictions") or path == "/predict"):
             return self._json(404, {"error": "not found"})
 
-        # hot path: the canonical payload's matrix parses natively
-        # (C++ strtof straight into float32, no json.loads); anything
-        # unusual — a names header, ragged rows, no toolchain — falls
-        # back to the Python JSON route below
-        from ccfd_tpu.serving.dispatch import ScorerTimeout
+        span_cm = contextlib.nullcontext()
+        if self.tracer is not None:
+            from ccfd_tpu.observability import trace as _trace
 
-        x = native_decode_ndarray(body, self.scorer.num_features)
-        if x is not None:
-            try:
-                proba = self._score_matrix(x)
-            except ScorerTimeout as e:
-                # wedged attachment, no host fallback for this model:
-                # bounded failure (503) instead of a hung connection — the
-                # server-side twin of the reference's SELDON_TIMEOUT
-                return self._json(503, {"error": f"scoring unavailable: {e}"})
-            out = self._response_dict(proba, self.scorer.spec.name)
-        else:
-            try:
-                payload = json.loads(body or b"{}")
-            except (ValueError, json.JSONDecodeError):
-                return self._json(400, {"error": "malformed JSON body"})
-            data = payload.get("data", {})
-            rows = data.get("ndarray")
-            if rows is None or not isinstance(rows, list):
-                return self._json(400, {"error": "missing data.ndarray in request"})
-            try:
-                out = self.predict_ndarray(data.get("names") or [], rows)
-            except (TypeError, ValueError) as e:
-                return self._json(400, {"error": f"bad ndarray: {e}"})
-            except ScorerTimeout as e:
-                return self._json(503, {"error": f"scoring unavailable: {e}"})
-        self._h_latency.observe(
-            time.perf_counter() - t0, labels={"endpoint": path}
-        )
-        return self._json(200, out)
+            span_cm = self.tracer.span(
+                "serving.predict", parent=_trace.extract_context(headers),
+                attrs={"endpoint": path})
+        with span_cm as sp:
+            trace_id = sp.trace_id if sp is not None else None
+            # hot path: the canonical payload's matrix parses natively
+            # (C++ strtof straight into float32, no json.loads); anything
+            # unusual — a names header, ragged rows, no toolchain — falls
+            # back to the Python JSON route below
+            from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+            x = native_decode_ndarray(body, self.scorer.num_features)
+            if x is not None:
+                try:
+                    proba = self._score_matrix(x)
+                except ScorerTimeout as e:
+                    # wedged attachment, no host fallback for this model:
+                    # bounded failure (503) instead of a hung connection — the
+                    # server-side twin of the reference's SELDON_TIMEOUT.
+                    # Returned, not raised, so the span must be marked here
+                    # for the sampler's always-keep-errored rule.
+                    if sp is not None:
+                        sp.status = "error"
+                    return self._json(503, {"error": f"scoring unavailable: {e}"})
+                out = self._response_dict(proba, self.scorer.spec.name)
+            else:
+                try:
+                    payload = json.loads(body or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._json(400, {"error": "malformed JSON body"})
+                data = payload.get("data", {})
+                rows = data.get("ndarray")
+                if rows is None or not isinstance(rows, list):
+                    return self._json(400, {"error": "missing data.ndarray in request"})
+                try:
+                    out = self.predict_ndarray(data.get("names") or [], rows)
+                except (TypeError, ValueError) as e:
+                    return self._json(400, {"error": f"bad ndarray: {e}"})
+                except ScorerTimeout as e:
+                    if sp is not None:
+                        sp.status = "error"
+                    return self._json(503, {"error": f"scoring unavailable: {e}"})
+            self._h_latency.observe(
+                time.perf_counter() - t0, labels={"endpoint": path},
+                exemplar=({"trace_id": trace_id} if trace_id else None),
+            )
+            return self._json(200, out)
 
     def start(self, host: str | None = None, port: int | None = None) -> int:
         """Start serving on a background thread; returns the bound port.

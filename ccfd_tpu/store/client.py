@@ -29,16 +29,18 @@ from ccfd_tpu.store.server import quote_key, sign_v2
 
 class S3Client:
     def __init__(self, endpoint: str, creds: Credentials, timeout_s: float = 10.0,
-                 breaker=None, faults=None):
+                 breaker=None, faults=None, tracer=None):
         self.endpoint = endpoint.rstrip("/")
         self.creds = creds
         self.timeout_s = timeout_s
         # producer↔store resilience edge (runtime/breaker.py,
         # runtime/faults.py): gates both transports — the producer's retry
         # loop sees CircuitOpenError/InjectedFault as ordinary
-        # ConnectionErrors
+        # ConnectionErrors. tracer: each store op is an rpc.store client
+        # span; the HTTP transport carries traceparent.
         self._breaker = breaker
         self._faults = faults
+        self._tracer = tracer
         self._inproc: ObjectStore | None = None
         if endpoint.startswith("inproc://"):
             self._inproc = resolve_inproc(endpoint)
@@ -51,6 +53,12 @@ class S3Client:
         return self._call(self._request_raw, method, path, data)
 
     def _call(self, fn, *args):
+        if self._tracer is not None:
+            with self._tracer.span("rpc.store"):
+                return self._call_untraced(fn, *args)
+        return self._call_untraced(fn, *args)
+
+    def _call_untraced(self, fn, *args):
         if self._breaker is not None or self._faults is not None:
             return self._guarded(fn, *args)
         return fn(*args)
@@ -88,6 +96,11 @@ class S3Client:
 
     def _request_raw(self, method: str, path: str, data: bytes | None = None) -> bytes:
         headers = {"Date": email.utils.formatdate(usegmt=True)}
+        if self._tracer is not None:
+            from ccfd_tpu.observability.trace import inject_headers
+
+            inject_headers(headers)  # traceparent is not part of the
+            # v2 StringToSign set, so signing stays valid
         if data is not None:
             # set explicitly so the signed Content-Type matches what urllib
             # sends (it would otherwise inject x-www-form-urlencoded unsigned)

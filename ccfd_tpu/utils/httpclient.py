@@ -66,6 +66,8 @@ class PooledHTTPClient:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         retry_budget_s: float | None = None,
+        tracer=None,
+        trace_edge: str = "http",
     ):
         u = urllib.parse.urlparse(base_url)
         if u.scheme not in ("http", ""):
@@ -76,6 +78,8 @@ class PooledHTTPClient:
         self._retries = max(0, retries)
         self._breaker = breaker           # runtime/breaker.CircuitBreaker
         self._faults = faults             # runtime/faults.FaultInjector
+        self._tracer = tracer             # observability/trace.Tracer
+        self._trace_edge = trace_edge     # span name suffix: rpc.<edge>
         self._backoff_base_s = backoff_base_s
         self._backoff_max_s = backoff_max_s
         self._retry_budget_s = retry_budget_s
@@ -93,11 +97,50 @@ class PooledHTTPClient:
         """-> (status, parsed JSON body or None). Raises ConnectionError when
         the server stays unreachable (or a non-idempotent send failed after
         possibly reaching it); CircuitOpenError (a ConnectionError) when the
-        edge's breaker refuses without dialing."""
+        edge's breaker refuses without dialing.
+
+        With a tracer wired, the whole call (retries included) is one
+        client span ``rpc.<edge>`` and the span's W3C ``traceparent`` rides
+        the request headers, so the server side resumes the same trace. A
+        breaker refusal flags the span (``breaker_open``) — the tail
+        sampler always keeps those traces."""
+        if self._tracer is None:
+            return self._do_request(method, path, body, idempotent, None)
+        with self._tracer.span(
+            f"rpc.{self._trace_edge}",
+            attrs={"method": method, "path": path,
+                   "peer": f"{self.host}:{self.port}"},
+        ) as sp:
+            from ccfd_tpu.observability.trace import format_traceparent
+
+            try:
+                status, parsed = self._do_request(
+                    method, path, body, idempotent,
+                    format_traceparent(sp.context))
+            except ConnectionError as e:
+                from ccfd_tpu.runtime.breaker import CircuitOpenError
+
+                if isinstance(e, CircuitOpenError):
+                    sp.attrs["breaker_open"] = True
+                raise
+            sp.attrs["status"] = status
+            if status >= 500:
+                # a 5xx is a failed call even though it returns normally:
+                # the tail sampler's always-keep-errored rule must see it
+                sp.status = "error"
+            return status, parsed
+
+    def _do_request(
+        self, method: str, path: str, body: Any, idempotent: bool,
+        traceparent: str | None,
+    ) -> tuple[int, Any]:
         # encode BEFORE the breaker gate: an unencodable body raising
         # after allow() would leak the admitted HALF_OPEN probe slot
         # (nothing would ever record its outcome) and wedge the circuit
         payload = json.dumps(body).encode() if body is not None else None
+        req_headers = {"Content-Type": "application/json"}
+        if traceparent is not None:
+            req_headers["traceparent"] = traceparent
         if self._breaker is not None and not self._breaker.allow():
             from ccfd_tpu.runtime.breaker import CircuitOpenError
 
@@ -114,10 +157,7 @@ class PooledHTTPClient:
             try:
                 corrupt = (self._faults.before()
                            if self._faults is not None else False)
-                conn.request(
-                    method, path, body=payload,
-                    headers={"Content-Type": "application/json"},
-                )
+                conn.request(method, path, body=payload, headers=req_headers)
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()

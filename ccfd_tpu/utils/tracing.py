@@ -1,67 +1,27 @@
-"""Tracing/profiling: span timing + jax.profiler integration.
+"""DEPRECATED: moved to :mod:`ccfd_tpu.observability.trace`.
 
-The reference exposes only JVM introspection ports (jolokia/jmx,
-reference deploy/router.yaml:50-53, ccd-service.yaml:50-53) and no
-application-level tracing (SURVEY.md §5). The TPU build upgrades this to:
-
-- ``Tracer``: lightweight named spans with monotonic timing, aggregated
-  into Prometheus histograms (so span latencies land on the same scrape
-  surface as everything else) plus an in-memory ring of recent spans for
-  debugging;
-- ``jax.profiler`` device traces: ``Tracer.profile(path)`` wraps a block in
-  ``jax.profiler.trace`` producing TensorBoard-loadable traces of the XLA
-  executables — the TPU-native equivalent of the JVM's flight recorder.
+The old module-global ``Tracer`` wrote spans into a private registry the
+metrics exporter never served — fixed by the observability subsystem,
+where component tracers are registry-injected by the platform operator
+and finished spans feed the tail-sampling :class:`SpanSink`. This shim
+keeps the historical import path (``Tracer``, ``trace_span``) working;
+new code should import from ``ccfd_tpu.observability.trace``.
 """
 
 from __future__ import annotations
 
-import collections
-import contextlib
-import threading
-import time
-from typing import Iterator
+import warnings
 
-from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.trace import (  # noqa: F401 - re-exports
+    SpanContext,
+    SpanSink,
+    Tracer,
+    trace_span,
+)
 
-
-class Tracer:
-    def __init__(self, registry: Registry | None = None, ring_size: int = 1024):
-        self.registry = registry or Registry()
-        self._hist = self.registry.histogram(
-            "trace_span_seconds", "span durations by name"
-        )
-        self._ring: collections.deque = collections.deque(maxlen=ring_size)
-        self._lock = threading.Lock()
-
-    @contextlib.contextmanager
-    def span(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self._hist.observe(dt, labels={"span": name})
-            with self._lock:
-                self._ring.append((time.time(), name, dt))
-
-    def recent(self, n: int = 50) -> list[tuple[float, str, float]]:
-        with self._lock:
-            return list(self._ring)[-n:]
-
-    @contextlib.contextmanager
-    def profile(self, logdir: str) -> Iterator[None]:
-        """Device-level XLA trace (TensorBoard format) around a block."""
-        import jax
-
-        with jax.profiler.trace(logdir):
-            yield
-
-
-_GLOBAL = Tracer()
-
-
-@contextlib.contextmanager
-def trace_span(name: str) -> Iterator[None]:
-    """Module-level convenience span on the default tracer."""
-    with _GLOBAL.span(name):
-        yield
+warnings.warn(
+    "ccfd_tpu.utils.tracing is deprecated; import from "
+    "ccfd_tpu.observability.trace",
+    DeprecationWarning,
+    stacklevel=2,
+)

@@ -49,7 +49,9 @@ TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
 OffsetAndMetadata = namedtuple("OffsetAndMetadata", ["offset", "metadata"])
 RecordMetadata = namedtuple("RecordMetadata", ["topic", "partition", "offset"])
 ConsumerRecord = namedtuple(
-    "ConsumerRecord", ["topic", "partition", "offset", "key", "value", "timestamp"]
+    "ConsumerRecord",
+    ["topic", "partition", "offset", "key", "value", "timestamp", "headers"],
+    defaults=(None,),
 )
 
 
@@ -73,9 +75,12 @@ class KafkaProducer:
         self.flush_calls = 0
 
     def send(self, topic: str, value: Any = None, key: Any = None,
-             partition: int | None = None) -> _Future:
+             partition: int | None = None, headers=None) -> _Future:
+        # headers: kafka-python's list[(str, bytes)]; carried through the
+        # backing broker verbatim so the consumer side re-surfaces them
         rec = self._broker.produce(topic, self._vs(value), key=self._ks(key),
-                                   partition=partition)
+                                   partition=partition,
+                                   headers=headers or None)
         return _Future(RecordMetadata(rec.topic, rec.partition, rec.offset))
 
     def flush(self, timeout: float | None = None) -> None:
@@ -119,6 +124,7 @@ class KafkaConsumer:
                     key=self._kd(r.key),
                     value=self._vd(r.value),
                     timestamp=int(r.timestamp * 1000),
+                    headers=r.headers,
                 )
             )
         return out

@@ -1,10 +1,11 @@
-"""Dashboards generator, tracer spans, CLI demo smoke."""
+"""Dashboards generator, tracer spans, dashboard-metric contract, CLI demo."""
 
 import json
+import re
 
 from ccfd_tpu.metrics.prom import Registry
 from ccfd_tpu.observability.dashboards import build_all_dashboards, write_dashboards
-from ccfd_tpu.utils.tracing import Tracer
+from ccfd_tpu.observability.trace import Tracer
 
 
 # The reference's full metrics contract (SURVEY.md §5): router business
@@ -42,6 +43,14 @@ REFERENCE_CONTRACT_METRICS = [
     "router_degraded_total",
     "router_shed_total",
     "faults_injected_total",
+    # round 7: distributed tracing + tail sampler + cardinality guard
+    # (observability/trace.py, metrics/prom.py)
+    "trace_span_seconds",
+    "ccfd_trace_spans_total",
+    "ccfd_traces_kept_total",
+    "ccfd_traces_dropped_total",
+    "ccfd_traces_retained",
+    "ccfd_metric_labelsets_dropped_total",
 ]
 
 
@@ -58,7 +67,7 @@ def test_dashboards_cover_contract_metrics():
     boards = build_all_dashboards()
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
-        "KafkaCluster", "Analytics", "Retrain", "Resilience",
+        "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -136,7 +145,7 @@ def test_seldon_board_carries_dispatch_health():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 9
+    assert len(paths) == 10
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")
@@ -151,6 +160,77 @@ def test_tracer_spans_land_in_histogram():
         pass
     assert reg.histogram("trace_span_seconds").count({"span": "score"}) == 2
     assert len(tr.recent()) == 2
+
+
+# -- dashboard ↔ exported-metric contract (round 7 CI guard) -----------------
+# PromQL pieces that are NOT metric names: functions, keywords, label names
+# and label values that the bare-identifier scan below would otherwise pick
+# up once the {label="value"} matchers are stripped.
+_PROMQL_NOISE = {
+    "rate", "irate", "sum", "max", "min", "avg", "count",
+    "histogram_quantile", "by", "on", "ignoring", "group_left",
+    "group_right", "le", "m", "s",
+}
+# Metrics a dashboard may reference that this codebase does NOT export:
+# the KafkaCluster board reads the Kafka JMX exporter of a REAL Strimzi
+# cluster (deploy mode where the in-proc bus is swapped out entirely).
+_EXTERNAL_METRICS = re.compile(
+    r"^(kafka_server_|kafka_controller_|kafka_consumergroup_)"
+)
+
+
+def _registered_metric_names() -> set[str]:
+    """Every metric name the codebase registers, by static scan: the
+    registry factory calls plus direct metric constructions."""
+    import os
+
+    pkg = os.path.join(os.path.dirname(__file__), "..", "ccfd_tpu")
+    pat = re.compile(
+        r"(?:\.(?:counter|gauge|histogram)|\b(?:Counter|Gauge|Histogram))\(\s*"
+        r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]"
+    )
+    names: set[str] = set()
+    for root, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(root, fn)) as f:
+                    names.update(pat.findall(f.read()))
+    # registered through a named constant, not a literal, so the literal
+    # scan can't see it — import the authoritative name instead
+    from ccfd_tpu.metrics.prom import LABELSETS_DROPPED
+
+    names.add(LABELSETS_DROPPED)
+    # native-code observers fold into histograms registered in Python, so
+    # the scan above is the full set
+    return names
+
+
+def test_every_dashboard_expr_metric_is_exported():
+    """The CI guard the unscraped-tracer bug motivated: every metric name
+    a generated board queries must be one some component actually
+    registers (or a documented external exporter's). Catches silent
+    metric-name drift between dashboards and code."""
+    registered = _registered_metric_names()
+    assert "transaction_incoming_total" in registered  # scan sanity
+    ident = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+    unknown = []
+    for name, board in build_all_dashboards().items():
+        for expr in _all_exprs({name: board}):
+            bare = re.sub(r"\{[^}]*\}", "", expr)  # drop label matchers
+            # drop grouping clauses: their identifiers are LABEL names
+            bare = re.sub(
+                r"\b(?:by|on|without|ignoring|group_left|group_right)\s*"
+                r"\([^)]*\)", " ", bare)
+            for tok in ident.findall(bare):
+                if tok in _PROMQL_NOISE or _EXTERNAL_METRICS.match(tok):
+                    continue
+                base = re.sub(r"_(bucket|sum|count)$", "", tok)
+                if tok not in registered and base not in registered:
+                    unknown.append((name, tok, expr))
+    assert not unknown, (
+        "dashboard exprs reference metrics nothing exports: "
+        f"{unknown[:10]}"
+    )
 
 
 def test_cli_demo_smoke(capsys):

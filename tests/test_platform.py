@@ -127,6 +127,48 @@ class TestBringUp:
         finally:
             platform.down()
 
+    def test_operator_wired_tracing_reaches_scrape_and_traces_endpoint(self):
+        """Satellite regression for the unscraped-tracer bug: the operator
+        wires component tracers into the SCRAPED registries, so span
+        histograms appear on /prometheus, the tail sampler's metrics live
+        in the scraped 'tracing' registry, and a retained end-to-end trace
+        resolves via the exporter's /traces/<id>."""
+        cfg = Config(customer_reply_timeout_s=0.2)
+        cr = minimal_cr(
+            producer={"enabled": True, "transactions": 200},
+            tracing={"enabled": True, "sample": 1.0},
+        )
+        platform = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(
+            wait_ready_s=20.0)
+        try:
+            assert platform.trace_sink is not None
+            assert platform.wait_producer(timeout_s=20.0)
+            reg = platform.registries["router"]
+            deadline = time.monotonic() + 30.0
+            while (time.monotonic() < deadline and
+                   reg.counter("transaction_incoming_total").value() < 200):
+                time.sleep(0.05)
+            platform.trace_sink.flush(0.0)
+            metrics = platform.status()["endpoints"]["metrics"]
+            with urllib.request.urlopen(metrics + "/prometheus/router") as r:
+                body = r.read().decode()
+            assert "trace_span_seconds" in body  # scraped, not private
+            with urllib.request.urlopen(metrics + "/prometheus/tracing") as r:
+                assert "ccfd_traces_kept_total" in r.read().decode()
+            with urllib.request.urlopen(metrics + "/traces") as r:
+                traces = json.loads(r.read())["traces"]
+            e2e = [t for t in traces
+                   if {"producer", "router"} <= set(t["components"])]
+            assert e2e, traces[:3]
+            with urllib.request.urlopen(
+                metrics + f"/traces/{e2e[0]['trace_id']}"
+            ) as r:
+                spans = json.loads(r.read())["spans"]
+            assert {"producer.batch", "router.batch"} <= {
+                s["name"] for s in spans}
+        finally:
+            platform.down()
+
     def test_producer_registry_reaches_exporter_and_readyz_stays_up(self):
         """Registries created after exporter start must still be scraped, and
         a finished one-shot producer must not degrade readiness."""
