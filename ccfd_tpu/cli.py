@@ -860,10 +860,23 @@ def cmd_router(args: argparse.Namespace) -> int:
                                                "start_process_batch",
                                                "signal"))
     # production role: the degradation ladder is on (same default as the
-    # platform operator) — a sick scorer edge degrades, never stalls
-    router = Router(cfg, broker, score_fn, engine, registry=router_registry,
-                    host_score_fn=host_score_fn, degrade=True,
-                    tracer=tracer)
+    # platform operator) — a sick scorer edge degrades, never stalls.
+    # --workers (or CCFD_ROUTER_WORKERS) fans the loop out partition-
+    # parallel with shared coalesced dispatch (router/parallel.py).
+    workers = (args.workers if args.workers is not None
+               else cfg.router_workers)
+    if workers == 1:
+        router = Router(cfg, broker, score_fn, engine,
+                        registry=router_registry,
+                        host_score_fn=host_score_fn, degrade=True,
+                        tracer=tracer)
+    else:
+        from ccfd_tpu.router.parallel import ParallelRouter
+
+        router = ParallelRouter(cfg, broker, score_fn, engine,
+                                registry=router_registry, workers=workers,
+                                host_score_fn=host_score_fn, degrade=True,
+                                tracer=tracer, coalesce=cfg.router_coalesce)
     # the reference scrapes the router on :8091/prometheus
     # (reference README.md:503-507); the standalone role must expose the
     # same surface the generated k8s Service/annotations point at
@@ -1416,6 +1429,11 @@ def main(argv: list[str] | None = None) -> int:
 
     ro = sub.add_parser("router", help="standalone decision router")
     ro.add_argument("--metrics-port", type=int, default=8091)  # README.md:503-507
+    ro.add_argument("--workers", type=int, default=None,
+                    help="partition-parallel worker loops sharing one "
+                    "coalesced scorer dispatch (default: "
+                    "CCFD_ROUTER_WORKERS; 1 = single router, 0 = one "
+                    "worker per bus partition)")
     ro.set_defaults(fn=cmd_router)
 
     no = sub.add_parser("notify", help="standalone notification service")

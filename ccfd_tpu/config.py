@@ -105,6 +105,17 @@ class Config:
     # a trace with any span at/above this duration is always kept
     trace_slow_ms: float = 100.0  # CCFD_TRACE_SLOW_MS
 
+    # --- router fan-out (router/parallel.py) ---
+    # worker loops consuming the transaction topic: 1 = the historical
+    # single Router; 0 = auto (one worker per bus partition); >1 explicit.
+    # Workers split partitions via consumer-group assignment and share one
+    # device scorer through a coalescing batcher (CCFD_ROUTER_WORKERS).
+    router_workers: int = 1
+    # coalesce concurrent workers' sub-batches into one device dispatch
+    # (CCFD_ROUTER_COALESCE; on by default — off means each worker
+    # dispatches its own batches, which only makes sense for measuring)
+    router_coalesce: bool = True
+
     # --- TPU scorer knobs (new) ---
     model_name: str = "mlp"
     graph_cr: str = ""  # SeldonDeployment-shaped CR file -> serving/graph.py
@@ -227,6 +238,11 @@ class Config:
             trace_slow_ms=float(
                 e.get("CCFD_TRACE_SLOW_MS", str(Config.trace_slow_ms))
             ),
+            router_workers=int(
+                e.get("CCFD_ROUTER_WORKERS", str(Config.router_workers))
+            ),
+            router_coalesce=e.get("CCFD_ROUTER_COALESCE", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
             model_name=e.get("CCFD_MODEL", Config.model_name),
             graph_cr=e.get("CCFD_GRAPH_CR", Config.graph_cr),
             compute_dtype=e.get("CCFD_DTYPE", Config.compute_dtype),

@@ -13,6 +13,10 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
     GET /rest/metrics          alias for the KIE registry (reference path)
     GET /traces                retained-trace summaries (tail sampler, JSON)
     GET /traces/<id>           one retained trace's spans (JSON)
+    GET /memory                memory-drift evidence (JSON): RSS, GC stats,
+                               per-component object counts, tracemalloc top
+                               allocators; ?trace=1 arms tracemalloc
+                               (observability/memory.py)
 
 Contract details (scrapers depend on them): metric paths answer with
 ``Content-Type: text/plain; version=0.0.4`` — or the OpenMetrics format
@@ -112,10 +116,24 @@ def _merge_renders(bodies: list[str], openmetrics: bool) -> str:
 class MetricsExporter:
     def __init__(self, registries: dict[str, Registry],
                  host: str = "127.0.0.1", port: int = 0,
-                 sink=None):
+                 sink=None,
+                 memory_probes: dict[str, "object"] | None = None):
         self._registries = dict(registries)
         self._sink = sink  # observability.trace.SpanSink (or None)
         self._lock = threading.Lock()
+        # memory-drift surface (observability/memory.py): a "process"
+        # registry every scrape refreshes with the RSS gauge and one
+        # object-count gauge series per registered probe — the flat-memory
+        # evidence the endurance soaks assert over, on the same scrape
+        # Prometheus already collects
+        self._memory_probes: dict[str, object] = dict(memory_probes or {})
+        self._process_registry = Registry()
+        self._g_rss = self._process_registry.gauge(
+            "ccfd_process_rss_bytes", "process resident set size")
+        self._g_objects = self._process_registry.gauge(
+            "ccfd_component_objects",
+            "live objects held per component container (memory probes)")
+        self._registries.setdefault("process", self._process_registry)
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -126,10 +144,11 @@ class MetricsExporter:
 
             def _answer(self, head_only: bool) -> None:
                 path = self.path.split("?")[0].rstrip("/")
+                query = self.path.partition("?")[2]
                 openmetrics = "application/openmetrics-text" in (
                     self.headers.get("Accept") or ""
                 )
-                body, ctype = exporter.respond(path, openmetrics)
+                body, ctype = exporter.respond(path, openmetrics, query)
                 if body is None:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
@@ -156,16 +175,55 @@ class MetricsExporter:
         with self._lock:
             self._registries[name] = registry
 
+    def add_probe(self, component: str, count_fn) -> None:
+        """Register a live-object-count callable for the memory surface
+        (``ccfd_component_objects{component=...}`` + /memory)."""
+        with self._lock:
+            self._memory_probes[component] = count_fn
+
+    def _refresh_memory_gauges(self) -> None:
+        from ccfd_tpu.observability.memory import rss_bytes
+
+        self._g_rss.set(rss_bytes())
+        with self._lock:
+            probes = dict(self._memory_probes)
+        for name, fn in probes.items():
+            try:
+                self._g_objects.set(float(fn()), labels={"component": name})
+            except Exception:  # noqa: BLE001 - a dead probe must not 500
+                self._g_objects.set(-1.0, labels={"component": name})
+
     # -- routing -----------------------------------------------------------
-    def respond(self, path: str, openmetrics: bool = False
-                ) -> tuple[str | None, str]:
+    def respond(self, path: str, openmetrics: bool = False,
+                query: str = "") -> tuple[str | None, str]:
         """-> (body or None for 404, content type)."""
         if path == "/traces" or path.startswith("/traces/"):
             return self._traces(path), "application/json"
+        if path == "/memory":
+            return self._memory(query), "application/json"
         body = self.render_path(path, openmetrics)
         return body, (_OPENMETRICS_CTYPE if openmetrics else _TEXT_CTYPE)
 
+    def _memory(self, query: str) -> str:
+        from urllib.parse import parse_qs
+
+        from ccfd_tpu.observability.memory import (
+            ensure_tracemalloc,
+            memory_report,
+        )
+
+        if parse_qs(query or "").get("trace") == ["1"]:
+            # arming is explicit — tracemalloc costs ~2x allocation
+            # overhead while on, which an always-on scrape must not pay
+            ensure_tracemalloc()
+        with self._lock:
+            probes = dict(self._memory_probes)
+        return json.dumps(memory_report(probes))
+
     def render_path(self, path: str, openmetrics: bool = False) -> str | None:
+        # the scrape is the sampling clock for the memory gauges: every
+        # metric render refreshes RSS + component object counts first
+        self._refresh_memory_gauges()
         with self._lock:
             regs = dict(self._registries)
         if path in ("", "/prometheus", "/metrics"):

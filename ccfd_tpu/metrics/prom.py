@@ -111,6 +111,12 @@ class _ScalarMetric(_Metric):
         with self._lock:
             return self._values.get(_labelkey(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set — ``sum(metric)`` in PromQL terms
+        (e.g. worker-labelled batch counters pooled for a scaling ratio)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def render(self) -> Iterable[str]:
         with self._lock:
             items = sorted(self._values.items())

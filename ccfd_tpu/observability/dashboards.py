@@ -124,6 +124,20 @@ def router_dashboard() -> dict:
         _panel(7, "Decision latency p50/p99 (produce → process start)",
                ["histogram_quantile(0.5, rate(router_decision_seconds_bucket[5m]))",
                 "histogram_quantile(0.99, rate(router_decision_seconds_bucket[5m]))"]),
+        # partition-parallel fan-out (router/parallel.py): batches per
+        # worker loop show the partition split is actually balanced, and
+        # the coalesced-dispatch rate against the pooled worker-batch rate
+        # shows the fan-in onto one device (fewer dispatches than batches
+        # == concurrent workers' sub-batches merged)
+        _panel(8, "Batches per router worker / s",
+               ["rate(router_worker_batches_total[5m])"]),
+        _panel(9, "Coalesced device dispatches vs worker batches / s",
+               ["rate(router_coalesced_dispatches_total[5m])",
+                "sum(rate(router_worker_batches_total[5m]))"]),
+        _panel(10, "Coalesced rows / s",
+               ["rate(router_coalesced_rows_total[5m])"]),
+        _alert_stat(11, "Load shed / s", ["rate(router_shed_total[5m])"],
+                    red_above=1),
     ]
     return _dashboard("CCFD Router", "ccfd-router", p)
 
@@ -304,6 +318,10 @@ def resilience_dashboard() -> dict:
         _panel(7, "Chaos: service kills / fault windows per s",
                ["rate(chaos_injections_total[5m])",
                 "rate(chaos_fault_windows_total[5m])"]),
+        # memory-drift surface (observability/memory.py): RSS slope is the
+        # endurance signal, per-component object counts name the suspect
+        _panel(8, "Process RSS (bytes)", ["ccfd_process_rss_bytes"]),
+        _panel(9, "Component object counts", ["ccfd_component_objects"]),
     ]
     return _dashboard("CCFD Resilience", "ccfd-resilience", p)
 

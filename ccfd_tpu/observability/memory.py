@@ -1,0 +1,111 @@
+"""Memory-drift evidence: RSS, GC pressure, per-component object counts,
+and tracemalloc top allocators.
+
+The round-5 endurance soak measured a ~23 MB/min RSS drift nobody has
+located. This module is the instrumentation that names the component next
+time: a process RSS reading every exporter scrape can gauge
+(``ccfd_process_rss_bytes``), per-component live-object counts
+(``ccfd_component_objects{component=...}`` — registered as probes by
+whoever owns the container), and an on-demand ``/memory`` JSON endpoint
+(metrics/exporter.py) that adds a tracemalloc top-allocators table when
+allocation tracing is on.
+
+tracemalloc costs ~2x allocation overhead while tracing, so it is OFF by
+default and armed explicitly: ``GET /memory?trace=1`` (or
+``ensure_tracemalloc()``) starts it; subsequent ``/memory`` reads include
+the top allocation sites since then. That makes the drift workflow:
+notice the slope (soak artifact / RSS gauge), arm tracing, wait, read
+``/memory``, read the component name off the top of the table.
+"""
+
+from __future__ import annotations
+
+import gc
+from typing import Any, Callable, Mapping
+
+
+def rss_bytes() -> int:
+    """Resident set size from /proc (Linux); 0 where unavailable."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
+def ensure_tracemalloc(nframes: int = 5) -> bool:
+    """Arm allocation tracing (idempotent); returns whether it is on."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(nframes)
+    return tracemalloc.is_tracing()
+
+
+def tracemalloc_top(limit: int = 15) -> list[dict[str, Any]]:
+    """Top allocation sites by retained bytes; [] when tracing is off."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return []
+    snap = tracemalloc.take_snapshot()
+    # the profiler's own frames would otherwise dominate the table
+    snap = snap.filter_traces((
+        tracemalloc.Filter(False, "<frozen importlib._bootstrap>"),
+        tracemalloc.Filter(False, tracemalloc.__file__),
+    ))
+    out = []
+    for stat in snap.statistics("lineno")[:limit]:
+        frame = stat.traceback[0]
+        out.append({
+            "file": frame.filename,
+            "line": frame.lineno,
+            "size_bytes": stat.size,
+            "count": stat.count,
+        })
+    return out
+
+
+def memory_report(
+    probes: Mapping[str, Callable[[], float]] | None = None,
+    top: int = 15,
+) -> dict[str, Any]:
+    """One self-contained memory evidence blob (the /memory body).
+
+    ``probes`` maps component name -> live-object-count callable; a probe
+    that raises reads as -1 (a dead component is itself evidence)."""
+    import tracemalloc
+
+    components: dict[str, float] = {}
+    for name, fn in (probes or {}).items():
+        try:
+            components[name] = float(fn())
+        except Exception:  # noqa: BLE001 - a broken probe must not 500
+            components[name] = -1.0
+    report: dict[str, Any] = {
+        "rss_bytes": rss_bytes(),
+        "gc": {
+            "counts": gc.get_count(),
+            "garbage": len(gc.garbage),
+        },
+        "components": components,
+        "tracemalloc": {
+            "tracing": tracemalloc.is_tracing(),
+            "top": tracemalloc_top(top),
+        },
+    }
+    if tracemalloc.is_tracing():
+        # gc.get_objects() materializes a list referencing EVERY tracked
+        # object — at drift-incident scale that is a multi-hundred-MB
+        # transient spike of exactly the signal this endpoint measures,
+        # so the full object walk rides the same explicit opt-in as the
+        # allocator table (?trace=1)
+        report["gc"]["tracked_objects"] = len(gc.get_objects())
+    if tracemalloc.is_tracing():
+        cur, peak = tracemalloc.get_traced_memory()
+        report["tracemalloc"]["traced_bytes"] = cur
+        report["tracemalloc"]["peak_bytes"] = peak
+    return report

@@ -272,6 +272,7 @@ class Platform:
                 port=int(mon.opt("port", 0)),
                 sink=self.trace_sink,  # /traces + /traces/<id> endpoints
             ).start()
+            self._wire_memory_probes()
 
         if spec.component("health").enabled:
             from ccfd_tpu.runtime.health import HealthServer
@@ -578,8 +579,7 @@ class Platform:
                     methods=("start_process", "start_process_batch",
                              "signal"),
                 )
-        router = Router(
-            self.cfg, self.broker, score_fn, engine, reg,
+        common = dict(
             host_score_fn=host_score_fn,
             # the ladder is the production default: a sick scorer edge
             # degrades scoring quality instead of dropping batches
@@ -589,6 +589,26 @@ class Platform:
                           if c.opt("max_inflight") is not None else None),
             tracer=router_tracer,
         )
+        # partition-parallel fan-out (router/parallel.py): CR
+        # `router.workers` over CCFD_ROUTER_WORKERS; 1 = the historical
+        # single Router, 0 = one worker per bus partition. Workers split
+        # partitions via the consumer group and share one scorer through
+        # a coalescing batcher, one in-flight budget, one breaker and a
+        # group-wide pause barrier — the checkpoint/recovery machinery
+        # below drives either shape through the same surface.
+        workers = int(c.opt("workers", self.cfg.router_workers))
+        if workers == 1:
+            router = Router(self.cfg, self.broker, score_fn, engine, reg,
+                            **common)
+        else:
+            from ccfd_tpu.router.parallel import ParallelRouter
+
+            router = ParallelRouter(
+                self.cfg, self.broker, score_fn, engine, reg,
+                workers=workers,
+                coalesce=bool(c.opt("coalesce", self.cfg.router_coalesce)),
+                **common,
+            )
         self.router = router
         self.supervisor.add_thread_service(
             "router",
@@ -748,6 +768,34 @@ class Platform:
         )
         self.supervisor.start_service("producer")
 
+    def _wire_memory_probes(self) -> None:
+        """Per-component live-object counts for the memory-drift surface
+        (``ccfd_component_objects`` gauges + the /memory endpoint,
+        observability/memory.py). Probes resolve through ``self`` so
+        crash-recovery swaps are followed automatically."""
+        ex = self.exporter
+        if self.engine is not None and hasattr(self.engine, "object_counts"):
+            # sum over object_counts: instances + tasks + rings
+            ex.add_probe("engine", lambda: sum(
+                (self.engine.object_counts() or {}).values()))
+        if self.broker is not None and hasattr(self.broker,
+                                               "health_snapshot"):
+            def bus_retained() -> int:
+                snap = self.broker.health_snapshot()
+                return sum(
+                    e - b
+                    for t in snap["topics"]
+                    for e, b in zip(snap["topics"][t], snap["begins"][t])
+                )
+
+            ex.add_probe("bus_retained_records", bus_retained)
+        if self.trace_sink is not None:
+            ex.add_probe("trace_sink",
+                         lambda: len(self.trace_sink.traces()))
+        if getattr(self.router, "batcher", None) is not None:
+            ex.add_probe("router_batcher_queue",
+                         lambda: self.router.batcher.qsize())
+
     # -- status / teardown -------------------------------------------------
     def wait_producer(self, timeout_s: float = 60.0) -> bool:
         return self._producer_done.wait(timeout=timeout_s)
@@ -795,6 +843,13 @@ class Platform:
             self.recovery.stop()
         if self.supervisor:
             self.supervisor.stop()
+        # a ParallelRouter owns coalescing-batcher threads the supervisor
+        # doesn't know about; release any callers still parked on futures
+        if getattr(self.router, "batcher", None) is not None:
+            try:
+                self.router.batcher.stop()
+            except Exception:  # noqa: BLE001
+                pass
         if self.engine is not None and (
             getattr(self, "_engine_state_file", None)
             or getattr(self, "_usertask_state_file", None)

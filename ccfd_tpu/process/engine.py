@@ -177,6 +177,8 @@ class Engine:
         task_listener: Callable[[Task], None] | None = None,
         completed_retention: int = 10_000,
         audit_sink: Callable[[dict[str, Any]], None] | None = None,
+        audit_evict: bool = True,
+        postmortem_retention: int = 2048,
     ):
         self.clock: Clock = clock or RealClock()
         self.registry = registry or Registry()
@@ -214,6 +216,23 @@ class Engine:
         # bound at tens of thousands of entries per second.
         self._completed_retention = completed_retention
         self._completed_order: deque[int] = deque()
+        # Audit-coupled eviction (the round-8 RSS-drift fix): with an audit
+        # sink wired, a completed instance's full state leaves the runtime
+        # store as soon as its ``process_completed`` event has actually
+        # been DELIVERED to the sink (for the bus sink that means the
+        # durable log already holds it — bus/broker.py writes the log
+        # before the in-memory append). The 10k ``completed_retention``
+        # FIFO then only backstops sink failures. Without a sink the
+        # historical cap is the only eviction, as before.
+        self._audit_evict = bool(audit_evict)
+        # bounded post-mortem ring: evicted instances stay queryable as
+        # lightweight summaries (pid/definition/status/ts) — what the soak's
+        # tail-completion reconciliation and operators' "what happened to
+        # pid X" need, at ~100 B instead of a full Instance + tasks
+        self._postmortem_retention = int(postmortem_retention)
+        # pid -> (definition_id, status, ts) — tuples, not dicts (hot
+        # path); completed_info/recent_completions rebuild dicts on query
+        self._postmortem: dict[int, tuple[str, str, float]] = {}
         self._tasks_by_pid: dict[int, list[int]] = {}
         # def_id -> (service_nodes, end_node, history) for straight-through
         # definitions (ServiceNode chain into an EndNode, no waits/gateways/
@@ -275,14 +294,40 @@ class Engine:
                     import logging
 
                     logging.getLogger(__name__).exception("audit sink failed")
+                    return  # undelivered: retention cap remains the evictor
+                self._evict_flushed(events)
                 return
+            delivered: list[dict[str, Any]] = []
             for ev in events:
                 try:
                     self._audit(ev)
+                    delivered.append(ev)
                 except Exception:  # noqa: BLE001 - drop THIS event only
                     import logging
 
                     logging.getLogger(__name__).exception("audit sink failed")
+            self._evict_flushed(delivered)
+
+    def _evict_flushed(self, events: list[dict[str, Any]]) -> None:
+        """Evict instances whose terminal audit event just reached the sink
+        (audit-coupled eviction — see __init__). Caller holds the flush
+        lock, NOT the state lock; lock order matches shutdown()."""
+        if not self._audit_evict:
+            return
+        pids = [ev["pid"] for ev in events
+                if ev.get("event") == "process_completed"]
+        if not pids:
+            return
+        with self._lock:
+            for pid in pids:
+                inst = self._instances.get(pid)
+                if inst is None or inst.status == "active":
+                    continue  # re-driven/rolled-back pid live again: keep
+                self._instances.pop(pid, None)
+                for tid in self._tasks_by_pid.pop(pid, ()):
+                    self._tasks.pop(tid, None)
+                # the pid stays in _completed_order; the FIFO backstop's
+                # pop(None) tolerates already-evicted entries
 
     @property
     def state_lock(self) -> threading.RLock:
@@ -358,8 +403,14 @@ class Engine:
             # to propagate must still get its buffered events delivered
             self._flush_audit()
 
+    # capability flag the router reads through any method proxy (fault
+    # injector / breaker guard): this engine's start_process_batch accepts
+    # ``copy_vars=False``. Remote clients (EngineRestClient) lack it.
+    start_batch_nocopy = True
+
     def start_process_batch(
-        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]],
+        copy_vars: bool = True,
     ) -> list[int | None]:
         """Start many instances of one definition under a single lock
         acquisition — the router's hot path (one start per scored
@@ -371,6 +422,14 @@ class Engine:
         node walk is precomputed at ``register`` time and the metrics
         counters advance once per batch instead of once per instance.
 
+        ``copy_vars=False`` adopts each (plain-dict) variables mapping as
+        the instance's vars WITHOUT the defensive copy — for callers that
+        hand over freshly built, never-reused dicts (the router's route
+        stage builds one per transaction and drops it). The copy was one
+        of the larger constants in the GIL-bound hand-off, which bounds
+        the parallel router fan-out's scaling. Non-dict mappings are
+        still copied (and non-mappings still poison only their slot).
+
         Error semantics (unlike single ``start_process``, which propagates):
         an exception from a service/gateway aborts THAT instance only — its
         slot in the returned list is ``None``, the instance is left
@@ -378,12 +437,14 @@ class Engine:
         transaction must not drop a whole micro-batch of process starts.
         """
         try:
-            return self._start_process_batch_locked(def_id, variables_list)
+            return self._start_process_batch_locked(
+                def_id, variables_list, copy_vars)
         finally:
             self._flush_audit()
 
     def _start_process_batch_locked(
-        self, def_id: str, variables_list: Sequence[Mapping[str, Any]]
+        self, def_id: str, variables_list: Sequence[Mapping[str, Any]],
+        copy_vars: bool = True,
     ) -> list[int | None]:
         with self._lock:
             self._check_alive()
@@ -397,7 +458,10 @@ class Engine:
                         # a non-mapping element must poison only its slot:
                         # dict() belongs inside the isolation boundary too
                         inst = Instance(
-                            pid=next(self._pid), definition=d, vars=dict(variables)
+                            pid=next(self._pid), definition=d,
+                            vars=(variables
+                                  if not copy_vars and type(variables) is dict
+                                  else dict(variables)),
                         )
                     except (TypeError, ValueError):
                         pids.append(None)
@@ -418,18 +482,32 @@ class Engine:
                         continue
                     pids.append(inst.pid)
             else:
+                # straight-through fast lane. This loop is the engine's
+                # per-transaction floor under the parallel router fan-out
+                # (GIL-bound, one iteration per scored transaction at wire
+                # rate): locals are hoisted, the clock is read once per
+                # batch, and per-instance counter bumps are batched below.
                 services, end, history = chain
                 n_ok = 0
                 n_started = 0
+                now = self.clock.now()
+                instances = self._instances
+                next_pid = self._pid.__next__
+                end_name = end.name
+                end_status = end.status
+                append_pid = pids.append
                 for variables in variables_list:
                     try:
                         inst = Instance(
-                            pid=next(self._pid), definition=d, vars=dict(variables)
+                            pid=next_pid(), definition=d,
+                            vars=(variables
+                                  if not copy_vars and type(variables) is dict
+                                  else dict(variables)),
                         )
                     except (TypeError, ValueError):
-                        pids.append(None)
+                        append_pid(None)
                         continue
-                    self._instances[inst.pid] = inst
+                    instances[inst.pid] = inst
                     n_started += 1
                     if audit_on:
                         self._emit("process_started", inst.pid, def_id)
@@ -443,17 +521,17 @@ class Engine:
                         if audit_on:
                             self._emit("process_completed", inst.pid, def_id,
                                        status="aborted")
-                        self._note_completed(inst.pid)
-                        pids.append(None)
+                        self._note_completed(inst.pid, now)
+                        append_pid(None)
                         continue
-                    inst.node = end.name
+                    inst.node = end_name
                     inst.history = list(history)
-                    inst.status = end.status
+                    inst.status = end_status
                     if audit_on:
                         self._emit("process_completed", inst.pid, def_id,
-                                   status=end.status)
-                    pids.append(inst.pid)
-                    self._note_completed(inst.pid)
+                                   status=end_status)
+                    append_pid(inst.pid)
+                    self._note_completed(inst.pid, now)
                     n_ok += 1
                 if n_started:
                     self._started.inc(n_started, labels={"process": def_id})
@@ -489,6 +567,34 @@ class Engine:
     def instance(self, pid: int) -> Instance:
         with self._lock:
             return self._instances[pid]
+
+    def completed_info(self, pid: int) -> dict[str, Any] | None:
+        """Post-mortem summary for an evicted (or still-resident) completed
+        instance, from the bounded ring; None if it aged out."""
+        with self._lock:
+            row = self._postmortem.get(pid)
+        if row is None:
+            return None
+        return {"pid": pid, "process": row[0], "status": row[1],
+                "ts": row[2]}
+
+    def recent_completions(self, n: int = 100) -> list[dict[str, Any]]:
+        with self._lock:
+            tail = list(self._postmortem.items())[-n:]
+        return [{"pid": pid, "process": row[0], "status": row[1],
+                 "ts": row[2]} for pid, row in tail]
+
+    def object_counts(self) -> dict[str, int]:
+        """Live container sizes — the per-component object gauges the
+        memory-drift hunt reads (metrics/exporter.py /memory)."""
+        with self._lock:
+            return {
+                "instances": len(self._instances),
+                "tasks": len(self._tasks),
+                "completed_order": len(self._completed_order),
+                "postmortem": len(self._postmortem),
+                "audit_buffer": len(self._audit_buffer),
+            }
 
     def instances(self, status: str | None = None) -> list[Instance]:
         with self._lock:
@@ -739,11 +845,29 @@ class Engine:
             self.restore(json.load(f))
 
     # -- internals --------------------------------------------------------
-    def _note_completed(self, pid: int) -> None:
+    def _note_completed(self, pid: int, now: float | None = None) -> None:
         """Record a terminal instance and evict past the retention cap.
-        Caller holds the lock. Evicted instances (and their tasks) leave the
-        runtime store; history lives on in the metrics, like jBPM's audit
-        log vs runtime separation."""
+        Caller holds the lock (``now`` lets batch callers amortize the
+        clock read). Evicted instances (and their tasks) leave the
+        runtime store; history lives on in the audit stream and metrics,
+        like jBPM's audit log vs runtime separation. With an audit sink the
+        real eviction happens in ``_evict_flushed`` (as soon as the
+        terminal event is delivered); the FIFO here is the no-sink path
+        and the backstop for sink failures."""
+        inst = self._instances.get(pid)
+        if inst is not None and self._audit is not None:
+            # bounded post-mortem ring: a tuple summary outlives the
+            # audit-coupled eviction (tuples, not dicts: this runs once
+            # per completed transaction at wire rate; completed_info
+            # rebuilds the dict on query). Without an audit sink there is
+            # no prompt eviction — the completed-retention FIFO keeps the
+            # full instance queryable — so the ring would be pure hot-path
+            # overhead and is skipped.
+            pm = self._postmortem
+            pm[pid] = (inst.definition.id, inst.status,
+                       self.clock.now() if now is None else now)
+            if len(pm) > self._postmortem_retention:
+                del pm[next(iter(pm))]
         self._completed_order.append(pid)
         while len(self._completed_order) > self._completed_retention:
             old = self._completed_order.popleft()

@@ -82,6 +82,12 @@ class DynamicBatcher:
         """Synchronous convenience: submit + wait."""
         return self.submit(x).result()
 
+    def qsize(self) -> int:
+        """Requests currently queued (not yet taken by a worker) — the
+        public depth surface monitoring probes read."""
+        with self._cv:
+            return len(self._queue)
+
     # -- worker ------------------------------------------------------------
     def _take_first(self) -> list[tuple[np.ndarray, Future]]:
         with self._cv:

@@ -51,6 +51,14 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_traces_dropped_total",
     "ccfd_traces_retained",
     "ccfd_metric_labelsets_dropped_total",
+    # round 8: partition-parallel router fan-out + coalesced dispatch
+    # (router/parallel.py) and the memory-drift surface
+    # (observability/memory.py, metrics/exporter.py)
+    "router_worker_batches_total",
+    "router_coalesced_dispatches_total",
+    "router_coalesced_rows_total",
+    "ccfd_process_rss_bytes",
+    "ccfd_component_objects",
 ]
 
 

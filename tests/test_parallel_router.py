@@ -1,0 +1,567 @@
+"""Partition-parallel router invariants (router/parallel.py).
+
+What the fan-out must NOT change: per-partition arrival order into the
+engine, exactly-once hand-off accounting (no double-route, no drop) under
+concurrent workers, the checkpoint coordinator's aligned-cut guarantee
+(group-wide pause barrier), and the bounded-in-flight budget — which must
+hold GLOBALLY across workers, not per loop. Plus the shared coalesced
+dispatch: concurrent workers' sub-batches merge into fewer device
+dispatches, and the memory-drift surface the exporter grew alongside.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.parallel import ParallelRouter
+from ccfd_tpu.router.router import InflightBudget, Router
+from ccfd_tpu.serving.batcher import DynamicBatcher
+
+CFG = Config(customer_reply_timeout_s=30.0, fraud_threshold=0.5)
+AMOUNT = FEATURE_NAMES.index("Amount")
+
+
+def amount_score(x: np.ndarray) -> np.ndarray:
+    return (x[:, AMOUNT] > 100.0).astype(np.float32)
+
+
+class RecordingEngine:
+    """Thread-safe engine stub that records every start's variables in
+    call order (the arrival-order and accounting ground truth)."""
+
+    start_batch_nocopy = True
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.started: list[dict] = []
+        self._pid = 0
+
+    def definitions(self):
+        return ("standard", "fraud")
+
+    def start_process_batch(self, def_id, vars_list, copy_vars=True):
+        with self.lock:
+            pids = []
+            for v in vars_list:
+                self._pid += 1
+                self.started.append(v)
+                pids.append(self._pid)
+            return pids
+
+    def start_process(self, def_id, variables):
+        return self.start_process_batch(def_id, [variables])[0]
+
+    def signal(self, pid, name, payload=None):
+        return True
+
+
+def _mk(workers=4, partitions=4, engine=None, score=amount_score, **kw):
+    broker = Broker(default_partitions=partitions)
+    reg = Registry()
+    engine = engine if engine is not None else RecordingEngine()
+    pr = ParallelRouter(CFG, broker, score, engine, reg,
+                        workers=workers, max_batch=256, **kw)
+    return broker, reg, engine, pr
+
+
+def _drive(pr, broker, n, timeout_s=20.0):
+    th = pr.start(poll_timeout_s=0.01)
+    deadline = time.time() + timeout_s
+    while pr._c_in.value() < n and time.time() < deadline:
+        time.sleep(0.01)
+    # group-wide barrier: on True every consumed record is fully routed
+    assert pr.pause(10.0)
+    return th
+
+
+def test_disjoint_partition_ownership():
+    broker, reg, engine, pr = _mk(workers=4, partitions=4)
+    owned = [tp for w in pr.workers for tp in w._tx_consumer._assignment]
+    assert len(owned) == len(set(owned)) == 4  # every partition, once
+    pr.close()
+
+
+def test_no_double_route_no_drop_under_concurrent_workers():
+    broker, reg, engine, pr = _mk(workers=4, partitions=4)
+    n = 4000
+    txs = [{"id": i, "Amount": float(i % 300)} for i in range(n)]
+    broker.produce_batch(CFG.kafka_topic, txs, keys=list(range(n)))
+    th = _drive(pr, broker, n)
+    ids = [v["transaction"]["id"] for v in engine.started]
+    assert len(ids) == n                      # no drop
+    assert len(set(ids)) == n                 # no double-route
+    assert reg.counter("router_shed_total").value() == 0
+    pr.resume()
+    pr.stop()
+    th.join(timeout=10)
+    pr.close()
+
+
+def test_per_partition_arrival_order_preserved_end_to_end():
+    broker, reg, engine, pr = _mk(workers=4, partitions=4)
+    n_per = 600
+    # explicit-partition produce with a per-partition sequence number:
+    # the strongest ordering Kafka promises is per partition, and a
+    # partition has exactly one consuming worker
+    for seq in range(n_per):
+        for part in range(4):
+            broker.produce(CFG.kafka_topic,
+                           {"id": part, "Amount": 1.0, "V1": float(seq)},
+                           partition=part)
+    th = _drive(pr, broker, 4 * n_per)
+    seen: dict[int, list[float]] = {p: [] for p in range(4)}
+    for v in engine.started:
+        tx = v["transaction"]
+        seen[tx["id"]].append(tx["V1"])
+    for part, seqs in seen.items():
+        assert seqs == sorted(seqs), f"partition {part} reordered"
+        assert len(seqs) == n_per
+    pr.resume()
+    pr.stop()
+    th.join(timeout=10)
+    pr.close()
+
+
+def test_group_pause_is_a_consistent_cut_and_nests():
+    broker, reg, engine, pr = _mk(workers=3, partitions=3)
+    n = 1500
+    txs = [{"id": i, "Amount": 5.0} for i in range(n)]
+    broker.produce_batch(CFG.kafka_topic, txs, keys=list(range(n)))
+    th = pr.start(poll_timeout_s=0.01)
+    assert pr.pause(10.0)
+    # parked: consumed == routed (nothing consumed-but-unrouted anywhere)
+    assert pr._c_in.value() == len(engine.started)
+    assert pr._budget.inflight == 0
+    routed_at_pause = len(engine.started)
+    # records produced while parked must NOT move until resume
+    broker.produce_batch(CFG.kafka_topic,
+                         [{"id": 1, "Amount": 2.0}] * 300,
+                         keys=list(range(300)))
+    time.sleep(0.3)
+    assert len(engine.started) == routed_at_pause
+    # nesting: a second holder keeps the pool parked after one resume
+    assert pr.pause(10.0)
+    pr.resume()
+    time.sleep(0.2)
+    assert len(engine.started) == routed_at_pause
+    pr.resume()  # last holder releases
+    deadline = time.time() + 10
+    while len(engine.started) < n + 300 and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(engine.started) == n + 300
+    pr.stop()
+    th.join(timeout=10)
+    pr.close()
+
+
+def test_checkpoint_coordinator_drives_parallel_router():
+    """The coordinator's surface (pause/swap/recycle/rewind) must work
+    group-wide: checkpoint under load, then restore, with 0 lost and 0
+    double-routed records — the chaos-soak invariant in miniature."""
+    from ccfd_tpu.runtime.recovery import CheckpointCoordinator
+
+    broker = Broker(default_partitions=3)
+    reg = Registry()
+    kreg = Registry()
+
+    def engine_factory():
+        return build_engine(CFG, broker, kreg, None)
+
+    pr = ParallelRouter(CFG, broker, amount_score, engine_factory(), reg,
+                        workers=3, max_batch=256)
+    coord = CheckpointCoordinator(pr, broker, engine_factory,
+                                  interval_s=999.0)
+    n = 1200
+    txs = [{"id": i, "Amount": 5.0} for i in range(n)]
+    broker.produce_batch(CFG.kafka_topic, txs, keys=list(range(n)))
+    th = pr.start(poll_timeout_s=0.01)
+    deadline = time.time() + 15
+    while pr._c_in.value() < n and time.time() < deadline:
+        time.sleep(0.01)
+    cut = coord.checkpoint()
+    assert cut is not None
+    started_at_cut = kreg.counter(
+        "process_instances_started_total").value({"process": "standard"})
+    assert started_at_cut == n
+    # crash the engine: restore must swap a fresh engine into EVERY worker
+    # and rewind the group to the cut — nothing re-delivers (cut was clean)
+    old_engine = pr.engine
+    restored = coord.restore(reason="test")
+    assert restored is not old_engine
+    assert all(w.engine is restored for w in pr.workers)
+    time.sleep(0.5)
+    assert kreg.counter("process_instances_started_total").value(
+        {"process": "standard"}) == n  # no replay past the cut, no loss
+    pr.stop()
+    th.join(timeout=10)
+    coord.stop()
+    pr.close()
+
+
+def test_inflight_budget_is_global_not_per_worker():
+    # direct budget semantics
+    b = InflightBudget(100)
+    assert b.reserve(60) == 60
+    assert b.reserve(60) == 40     # only the remainder is granted
+    assert b.reserve(10) == 0
+    b.release(50)
+    assert b.reserve(60) == 50
+    b.release(1000)
+    assert b.inflight == 0
+
+    # two routers sharing one budget: with a scorer that parks the first
+    # batch, the SECOND router's poll must shed against the SHARED bound,
+    # not a private one
+    broker = Broker(default_partitions=2)
+    reg = Registry()
+    engine = RecordingEngine()
+    budget = InflightBudget(300)
+    gate = threading.Event()
+
+    def slow_score(x):
+        gate.wait(timeout=10.0)
+        return amount_score(x)
+
+    workers = [
+        Router(CFG, broker, slow_score, engine, reg, max_batch=256,
+               inflight_budget=budget, worker_id=i)
+        for i in range(2)
+    ]
+    for part in range(2):
+        for i in range(256):
+            broker.produce(CFG.kafka_topic, {"id": i, "Amount": 1.0},
+                           partition=part)
+    results = []
+
+    def step(w):
+        results.append(w.step(0.2))
+
+    threads = [threading.Thread(target=step, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    gate.set()
+    for t in threads:
+        t.join(timeout=15)
+    # both polled 256; the shared budget admitted exactly 300 rows total
+    assert sum(results) == 300
+    assert reg.counter("router_shed_total").value() == 212
+    assert reg.counter("transaction_incoming_total").value() == 512
+    assert budget.inflight == 0
+    assert len(engine.started) == 300
+    for w in workers:
+        w.close()
+
+
+def test_route_crash_does_not_double_finish_or_leak_budget():
+    """A _route crash in the pipelined loop must not re-finish the batch
+    (the outer finally used to re-run it: duplicate engine starts AND a
+    double budget release that lets a shared pool exceed max_inflight).
+    The loop dies, but every record routed exactly once and the budget
+    drained clean for the supervisor's respawn."""
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    engine = RecordingEngine()
+    router = Router(CFG, broker, amount_score, engine, reg, max_batch=256)
+
+    def boom(*a, **k):
+        raise RuntimeError("post-start crash")
+
+    # crash AFTER the engine starts landed (the worst case for double-route)
+    router._h_decision_s.observe_many = boom
+    broker.produce_batch(CFG.kafka_topic,
+                         [{"id": i, "Amount": 1.0} for i in range(100)],
+                         keys=list(range(100)))
+    t = threading.Thread(target=router.run, args=(0.01,), daemon=True)
+    t.start()
+    t.join(timeout=15)
+    assert not t.is_alive()                      # the crash killed the loop
+    ids = [v["transaction"]["id"] for v in engine.started]
+    assert sorted(ids) == list(range(100))        # exactly once, no dupes
+    assert router._budget.inflight == 0           # no leak, no double-release
+    router.close()
+
+
+def test_worker_crash_stops_pool_and_surfaces_to_supervisor():
+    """A crashed worker must not be a silent partial outage: the first
+    crash stops the WHOLE pool and re-raises out of run(), so the
+    supervisor restarts the service exactly as for a crashed single
+    Router — and no record double-routes, no budget rows leak."""
+    broker, reg, engine, pr = _mk(workers=2, partitions=2)
+
+    def boom(*a, **k):
+        raise RuntimeError("post-start crash")
+
+    # the decision histogram is shared registry state: one patch crashes
+    # whichever worker routes first, after its engine starts landed
+    pr.workers[0]._h_decision_s.observe_many = boom
+    errs: list[BaseException] = []
+
+    def body():
+        try:
+            pr.run(0.01)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    broker.produce_batch(CFG.kafka_topic,
+                         [{"id": i, "Amount": 1.0} for i in range(200)],
+                         keys=list(range(200)))
+    t.join(timeout=15)
+    assert not t.is_alive()            # the pool came down with the crash
+    assert errs and isinstance(errs[0], RuntimeError)
+    assert pr._stop.is_set()
+    ids = [v["transaction"]["id"] for v in engine.started]
+    assert len(ids) == len(set(ids))   # no double-route through the crash
+    assert pr._budget.inflight == 0    # no shared-budget leak
+    pr.close()
+
+
+def test_concurrent_submitters_coalesce_into_one_dispatch():
+    """DynamicBatcher regression (the shared-dispatch contract the
+    parallel router leans on): submissions queued while a dispatch is on
+    the device merge into ONE following dispatch."""
+    dispatched: list[int] = []
+    release = threading.Event()
+    first_in = threading.Event()
+
+    def score(x):
+        if not dispatched:
+            first_in.set()
+            release.wait(timeout=10.0)
+        dispatched.append(x.shape[0])
+        return np.zeros(x.shape[0], np.float32)
+
+    b = DynamicBatcher(score, max_batch=1024, deadline_ms=50.0, workers=1)
+    f0 = b.submit(np.zeros((4, 30), np.float32))
+    assert first_in.wait(timeout=5.0)
+    # two concurrent submitters while the worker is on the "device"
+    f1 = b.submit(np.zeros((8, 30), np.float32))
+    f2 = b.submit(np.zeros((16, 30), np.float32))
+    release.set()
+    assert f1.result(timeout=10.0).shape == (8,)
+    assert f2.result(timeout=10.0).shape == (16,)
+    f0.result(timeout=10.0)
+    assert dispatched == [4, 24]     # f1+f2 coalesced into one dispatch
+    assert b.dispatches == 2 and b.rows == 28
+    b.stop()
+
+
+def test_parallel_router_coalesces_worker_batches():
+    """End-to-end: with workers>1 sharing the batcher, device dispatches
+    land at or below the worker-batch count, and every row still routes."""
+    broker, reg, engine, pr = _mk(workers=4, partitions=4, coalesce=True)
+    assert pr.batcher is not None
+    n = 3000
+    txs = [{"id": i, "Amount": 5.0} for i in range(n)]
+    broker.produce_batch(CFG.kafka_topic, txs, keys=list(range(n)))
+    th = _drive(pr, broker, n)
+    batches = reg.counter("router_worker_batches_total").total()
+    dispatches = reg.counter("router_coalesced_dispatches_total").value()
+    rows = reg.counter("router_coalesced_rows_total").value()
+    assert len(engine.started) == n
+    assert rows == n
+    assert 0 < dispatches <= batches
+    pr.resume()
+    pr.stop()
+    th.join(timeout=10)
+    pr.close()
+
+
+def test_seq_scorer_shape_bypasses_coalescing():
+    """History-aware scorers (score_with_ids) key on decoded records — a
+    row-concatenating batcher can't carry that, so they go direct."""
+
+    class SeqLike:
+        def __call__(self, x):
+            return np.zeros(len(x), np.float32)
+
+        def score_with_ids(self, txs, x):
+            return np.zeros(len(x), np.float32)
+
+    broker, reg, engine, pr = _mk(workers=2, partitions=2, score=SeqLike())
+    assert pr.batcher is None
+    pr.close()
+
+
+def test_supervisor_restart_cycle():
+    """stop() unblocks run(); reset() re-arms the whole pool for the
+    supervisor's respawn — the ChaosMonkey kill path."""
+    broker, reg, engine, pr = _mk(workers=2, partitions=2)
+    for cycle in range(2):
+        pr.reset()
+        t = threading.Thread(target=pr.run, args=(0.01,), daemon=True)
+        t.start()
+        broker.produce_batch(CFG.kafka_topic,
+                             [{"id": i, "Amount": 1.0} for i in range(100)],
+                             keys=list(range(100)))
+        deadline = time.time() + 10
+        want = 100 * (cycle + 1)
+        while len(engine.started) < want and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(engine.started) == want
+        pr.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    pr.close()
+
+
+def test_worker_labels_on_metrics():
+    broker, reg, engine, pr = _mk(workers=2, partitions=2)
+    n = 400
+    broker.produce_batch(CFG.kafka_topic,
+                         [{"id": i, "Amount": 1.0} for i in range(n)],
+                         keys=list(range(n)))
+    th = _drive(pr, broker, n)
+    c = reg.counter("router_worker_batches_total")
+    per_worker = [c.value({"worker": str(w)}) for w in range(2)]
+    assert all(v > 0 for v in per_worker)   # both workers actually worked
+    assert c.total() == sum(per_worker)
+    pr.resume()
+    pr.stop()
+    th.join(timeout=10)
+    pr.close()
+
+
+def test_engine_runtime_store_stays_flat_with_audit_eviction():
+    """Endurance-style satellite: with the audit stream on, completed
+    instances leave the runtime store as soon as their terminal event is
+    durably produced — the map must stay FLAT across sustained load (the
+    round-5 RSS-drift suspect), with the bounded post-mortem ring as the
+    queryable remainder."""
+    cfg = Config(audit_topic="ccd-audit")
+    broker = Broker()
+    engine = build_engine(cfg, broker, Registry(), None)
+    sizes = []
+    last_pids = None
+    for _ in range(40):
+        pids = engine.start_process_batch(
+            "standard",
+            [{"transaction": {"id": i, "Amount": 1.0}} for i in range(500)],
+        )
+        assert all(p is not None for p in pids)
+        sizes.append(len(engine._instances))
+        last_pids = pids
+    # flat: the store never accumulates completed instances across 20k
+    # starts (a strict bound, not a trend assertion)
+    assert max(sizes) <= 500
+    assert len(engine._instances) == 0
+    # post-mortem ring is bounded and still answers for recent pids
+    counts = engine.object_counts()
+    assert counts["postmortem"] <= 2048
+    info = engine.completed_info(last_pids[-1])
+    assert info is not None and info["status"] == "completed"
+    # the audit ledger durably holds the full history
+    assert sum(broker.end_offsets(cfg.audit_topic)) == 2 * 20_000
+
+
+def test_exporter_memory_surface():
+    """/memory endpoint + rss/object-count gauges (memory-drift
+    satellite): the scrape carries ccfd_process_rss_bytes and one
+    ccfd_component_objects series per probe; /memory returns the JSON
+    evidence blob."""
+    import json
+    import urllib.request
+
+    from ccfd_tpu.metrics.exporter import MetricsExporter
+
+    reg = Registry()
+    ex = MetricsExporter({"router": reg},
+                         memory_probes={"thing": lambda: 42}).start()
+    try:
+        ex.add_probe("broken", lambda: 1 / 0)
+        with urllib.request.urlopen(ex.endpoint + "/prometheus",
+                                    timeout=10) as resp:
+            scrape = resp.read().decode()
+        assert "ccfd_process_rss_bytes" in scrape
+        assert 'ccfd_component_objects{component="thing"} 42' in scrape
+        assert 'ccfd_component_objects{component="broken"} -1' in scrape
+        with urllib.request.urlopen(ex.endpoint + "/memory",
+                                    timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["rss_bytes"] > 0
+        assert body["components"]["thing"] == 42.0
+        assert body["components"]["broken"] == -1.0
+        assert body["tracemalloc"]["tracing"] in (False, True)
+        # arming tracemalloc over the endpoint adds the allocator table
+        with urllib.request.urlopen(ex.endpoint + "/memory?trace=1",
+                                    timeout=10) as resp:
+            json.loads(resp.read().decode())
+        with urllib.request.urlopen(ex.endpoint + "/memory",
+                                    timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["tracemalloc"]["tracing"] is True
+        assert isinstance(body["tracemalloc"]["top"], list)
+    finally:
+        ex.stop()
+
+
+def test_operator_wires_parallel_router(tmp_path):
+    """CR `router.workers` (or CCFD_ROUTER_WORKERS) brings the platform up
+    with the fan-out; the checkpoint machinery drives it unchanged."""
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "scorer": {"enabled": True, "model": "logreg"},
+        "router": {"enabled": True, "workers": 2},
+        "bus": {"enabled": True, "partitions": 2},
+        "engine": {"enabled": True},
+        "notify": {"enabled": True},
+        "monitoring": {"enabled": True},
+        "tracing": {"enabled": False},
+        "producer": {"enabled": True, "transactions": 300},
+    }}
+    plat = Platform(PlatformSpec.from_cr(cr, cfg=CFG)).up(wait_ready_s=60)
+    try:
+        assert isinstance(plat.router, ParallelRouter)
+        assert len(plat.router.workers) == 2
+        assert plat.wait_producer(60.0)
+        reg = plat.registries["router"]
+        deadline = time.time() + 30
+        while (reg.counter("transaction_incoming_total").value() < 300
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert reg.counter("transaction_incoming_total").value() == 300
+        # per-worker attribution survived the operator wiring
+        assert reg.counter("router_worker_batches_total").total() > 0
+    finally:
+        plat.down()
+
+
+def test_parse_only_tier1_gate(tmp_path):
+    """tools/verify_tier1.sh --parse-only: green log -> 0, red log -> 1,
+    missing/clobbered summary -> 2 (the fail-loudly contract, VERDICT r5
+    weak #1)."""
+    import subprocess
+
+    script = __file__.replace("tests/test_parallel_router.py",
+                              "tools/verify_tier1.sh")
+
+    def run(text):
+        p = tmp_path / "t1.log"
+        p.write_text(text)
+        proc = subprocess.run(["bash", script, "--parse-only", str(p)],
+                              capture_output=True, text=True, timeout=60)
+        return proc.returncode, proc.stdout.strip()
+
+    rc, out = run("." * 10 + "\n= 10 passed, 2 skipped in 300.00s =\n")
+    assert rc == 0 and "passed=10" in out and "verdict=PASS" in out
+    rc, out = run("..F\n== 5 failed, 600 passed, 2 errors in 290.1s ==\n")
+    assert rc == 1
+    assert "failed=5" in out and "errors=2" in out and "verdict=FAIL" in out
+    rc, out = run("the run died before pytest printed anything\n")
+    assert rc == 2 and "UNPARSEABLE" in out
+    rc, out = run("")
+    assert rc == 2
+    # a green summary the progress stream doesn't support (clobbered /
+    # spliced log) must refuse to PASS
+    rc, out = run("...\n= 623 passed in 3.00s =\n")
+    assert rc == 2 and "summary-dots-mismatch" in out

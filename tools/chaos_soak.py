@@ -279,6 +279,12 @@ def main() -> int:
                     "(default: a blackholed scorer edge)")
     ap.add_argument("--fault-interval-s", type=float, default=10.0)
     ap.add_argument("--fault-duration-s", type=float, default=3.0)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="router worker loops (router/parallel.py): >1 "
+                    "drills the partition-parallel fan-out — group-wide "
+                    "pause barrier, shared in-flight budget, coalesced "
+                    "dispatch — under the same kills; 1 = the historical "
+                    "single router")
     args = ap.parse_args()
 
     bus_dir = args.bus_log or tempfile.mkdtemp(prefix="ccfd_soak_bus_")
@@ -361,9 +367,22 @@ def main() -> int:
             score_fn = net_injector.wrap_fn(scorer.score)
         if scorer.has_host_forward:
             host_fn = scorer.host_score
-    router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
-                    host_score_fn=host_fn,
-                    degrade=True if args.net_faults else None)
+    if args.workers > 1:
+        # partition-parallel fan-out: the workers split the topic's
+        # partitions, share ONE in-flight budget + breaker + coalescing
+        # batcher, and the pause barrier the checkpoint coordinator takes
+        # below is group-wide — the soak asserts the same 0-violation
+        # accounting through kills with the whole pool in play
+        from ccfd_tpu.router.parallel import ParallelRouter
+
+        router = ParallelRouter(
+            cfg, broker, score_fn, engine, reg_r, workers=args.workers,
+            max_batch=4096, host_score_fn=host_fn,
+            degrade=True if args.net_faults else None)
+    else:
+        router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
+                        host_score_fn=host_fn,
+                        degrade=True if args.net_faults else None)
     coord = CheckpointCoordinator(router, broker, engine_factory,
                                   interval_s=args.checkpoint_s)
     sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
@@ -590,7 +609,13 @@ def main() -> int:
             if final_engine.instance(pid).status != "active":
                 tail_completed.add(pid)
         except KeyError:
-            pass  # evicted == long-terminal: still a real ghost
+            # audit-coupled eviction (round 8): a tail-completed instance
+            # leaves the runtime store as soon as its terminal event is
+            # durably produced — the bounded post-mortem ring is the
+            # queryable record. A pid in NEITHER store is a real ghost.
+            info = final_engine.completed_info(pid)
+            if info is not None and info["status"] != "active":
+                tail_completed.add(pid)
     ghost -= tail_completed
     unaudited = active_now - acct["open_at_end"]
     acct_ok = not acct["violation_count"] and not ghost and not unaudited
@@ -613,10 +638,38 @@ def main() -> int:
                 sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / var * 60,
                 3,
             )
+    # memory-drift evidence (observability/memory.py): per-component
+    # object counts alongside the RSS slope, so a drifting soak NAMES the
+    # growing container instead of just measuring the growth
+    from ccfd_tpu.observability.memory import memory_report
+
+    mem = memory_report({
+        "engine": lambda: sum(final_engine.object_counts().values()),
+        "bus_retained_records": lambda: sum(
+            e - b
+            for t in (cfg.kafka_topic, cfg.audit_topic)
+            for e, b in zip(broker.end_offsets(t),
+                            broker.beginning_offsets(t))
+        ),
+        "walker_ledger_bytes": lambda: sum(
+            len(st["done"].bits) + len(st["seen"].bits)
+            for st in walker._parts.values()
+        ),
+    })
+
     result = {
         "seconds": round(elapsed, 1),
         "tx_total": int(total),
         "tx_s": round(total / elapsed, 1),
+        "router_workers": args.workers,
+        "coalesced": {
+            "worker_batches": int(reg_r.counter(
+                "router_worker_batches_total").total()),
+            "dispatches": int(reg_r.counter(
+                "router_coalesced_dispatches_total").value()),
+        },
+        # the RSS slope, top-level: THE memory-drift acceptance number
+        "rss_slope_mb_per_min": drift_mb_per_min,
         "targets": targets,
         "kills": kills,
         "engine_kills": kills.get("engine", 0),
@@ -645,6 +698,7 @@ def main() -> int:
             "drift_mb_per_min": drift_mb_per_min,
             "samples": rss_samples,
         },
+        "memory": mem,
         "supervisor_restarts": {n: s["restarts"] for n, s in status.items()},
         "checkpoints": coord.checkpoints,
         "checkpoint_skips": coord.skipped,

@@ -118,6 +118,11 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=256,
                     help="producer batch size == trace granularity")
     ap.add_argument("--fraud-rate", type=float, default=0.02)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="router workers (router/parallel.py): >1 verifies "
+                    "the per-stage trace decomposition survives the "
+                    "partition-parallel fan-out (worker-labelled "
+                    "router.batch spans); 1 = single router")
     args = ap.parse_args()
 
     cfg = Config()
@@ -140,8 +145,16 @@ def main() -> int:
     scorer = Scorer(model_name="mlp", params=params,
                     batch_sizes=(128, 1024, 4096))
     scorer.warmup()
-    router = Router(cfg, broker, scorer.score, engine, regs["router"],
-                    max_batch=args.batch, tracer=tracer("router"))
+    if args.workers > 1:
+        from ccfd_tpu.router.parallel import ParallelRouter
+
+        router = ParallelRouter(cfg, broker, scorer.score, engine,
+                                regs["router"], workers=args.workers,
+                                max_batch=args.batch,
+                                tracer=tracer("router"))
+    else:
+        router = Router(cfg, broker, scorer.score, engine, regs["router"],
+                        max_batch=args.batch, tracer=tracer("router"))
     notify = NotificationService(cfg, broker, regs["notify"],
                                  tracer=tracer("notify"))
     producer_tracer = tracer("producer")
@@ -169,6 +182,16 @@ def main() -> int:
                 "router.route"} <= {s["name"] for s in spans}]
     breakdown = stage_breakdown(e2e)
     mono = all(monotone_ok(spans) for spans in e2e) and bool(e2e)
+    # parallel-router attribution: every router.batch span carries its
+    # worker id, and with workers>1 more than one worker must actually
+    # have contributed spans (the fan-out genuinely split the stream)
+    worker_ids = sorted({
+        s["attrs"].get("worker")
+        for spans in full if spans is not None
+        for s in spans
+        if s["name"] == "router.batch" and "worker" in s.get("attrs", {})
+    })
+    workers_ok = (args.workers <= 1) or len(worker_ids) > 1
 
     # -- exemplar loop: scrape OpenMetrics, resolve the trace over HTTP ----
     req = urllib.request.Request(
@@ -198,6 +221,9 @@ def main() -> int:
         "traces_retained": len(summaries),
         "end_to_end_traces": len(e2e),
         "monotone_ok": mono,
+        "router_workers": args.workers,
+        "worker_span_labels": worker_ids,
+        "worker_labels_ok": workers_ok,
         "stages": breakdown,
         "exemplars_in_scrape": len(exemplar_ids),
         "exemplar_trace_resolved": resolved,
@@ -217,7 +243,7 @@ def main() -> int:
               f"   share={st['critical_path_share']:.1%}  (n={st['n']})",
               file=sys.stderr)
     print(json.dumps(report))
-    ok = bool(e2e) and mono and resolved is not None
+    ok = bool(e2e) and mono and resolved is not None and workers_ok
     return 0 if ok else 3
 
 
