@@ -286,6 +286,43 @@ def _bench_pipeline(scorer_params, seconds):
         "fraud_starts": out.value(labels={"type": "fraud"}),
     }
 
+    # Phase 1a — shadow-scoring overhead (lifecycle/shadow.py): the SAME
+    # saturated harness with a challenger armed in the scorer's slot and
+    # the router's score lane tap-wrapped. The lifecycle's hot-path
+    # contract is that shadow evaluation rides a bounded queue serviced
+    # off-thread (host numpy forward), so tx_s must sit within noise of
+    # the baseline — overhead_pct is the acceptance number, with the
+    # dropped-batch count showing where backpressure went instead.
+    from ccfd_tpu.lifecycle.shadow import ShadowTap
+
+    broker_s = Broker()
+    reg_s = Registry()
+    engine_s = build_engine(cfg, broker_s, reg_s, None)
+    tap = ShadowTap(scorer, broker_s, cfg.shadow_topic, reg_s)
+    scorer.install_challenger(1, scorer_params)
+    tap.arm(1)
+    router_s = Router(cfg, broker_s, tap.wrap(scorer.score), engine_s,
+                      reg_s, max_batch=4096)
+    shadow_thread = threading.Thread(
+        target=lambda: tap.run(interval_s=0.01), daemon=True)
+    shadow_thread.start()
+    c_in_s = reg_s.counter("transaction_incoming_total")
+    elapsed_s = saturated_run(broker_s, c_in_s, router_s)
+    tap.stop()
+    shadow_thread.join(timeout=5)
+    tap.disarm()
+    scorer.clear_challenger()
+    tx_s_shadow = c_in_s.value() / elapsed_s
+    result["shadow"] = {
+        "tx_s": round(tx_s_shadow, 1),
+        "overhead_pct": round(
+            100.0 * (1.0 - tx_s_shadow / max(result["tx_s"], 1e-9)), 1),
+        "rows_shadow_scored": int(reg_s.counter(
+            "ccfd_lifecycle_shadow_rows_total").value()),
+        "rows_dropped": int(reg_s.counter(
+            "ccfd_lifecycle_shadow_dropped_total").value()),
+    }
+
     # Phase 1b — worker-count axis (router/parallel.py ParallelRouter):
     # the SAME max_batch budget, N partition-parallel worker loops
     # sharing one coalescing batcher. Reports scaling efficiency against
@@ -1264,7 +1301,7 @@ def compact_summary(result: dict) -> dict:
     pick("rest", "tx_s", "requests_s", "p50_ms", "p99_ms", "transport",
          "rows_per_request", "host_tier_rows", "errors")
     pick("pipeline", "tx_s", "paced_rate_tx_s", "p50_ms", "p99_ms",
-         "workers", "workers_cpus")
+         "workers", "workers_cpus", "shadow")
     pick("mesh", "tx_s", "devices")
     pick("retrain", "steps_s", "labels_s", "final_loss")
     pick("seq", "histories_s", "batch", "seq_len")

@@ -60,6 +60,40 @@ class Report(NamedTuple):
     class_counts: np.ndarray  # (2,) rows per Class label
     amount_sum_by_class: np.ndarray  # (2,)
 
+    def save(self, path: str) -> str:
+        """Persist the report (one .npz, tmp+rename crash-safe) so a PSI
+        baseline survives restarts — the DriftMonitor otherwise loses its
+        reference distribution on every bring-up and must re-summarize the
+        training set before the first drift score."""
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                n=np.int64(self.n),
+                **{k: np.asarray(getattr(self, k))
+                   for k in ("mean", "std", "min", "max", "hist", "edges",
+                             "corr", "class_counts", "amount_sum_by_class")},
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "Report":
+        data = np.load(path)
+        return Report(
+            n=int(data["n"]),
+            mean=data["mean"], std=data["std"],
+            min=data["min"], max=data["max"],
+            hist=data["hist"], edges=data["edges"], corr=data["corr"],
+            class_counts=data["class_counts"],
+            amount_sum_by_class=data["amount_sum_by_class"],
+        )
+
     def to_dict(self) -> dict[str, Any]:
         n1 = float(max(self.class_counts[1], 0.0))
         return {
@@ -271,12 +305,39 @@ class DriftMonitor:
         registry=None,
         window: int = 4096,
         reference_builder: Callable[[], Report] | None = None,
+        reference_path: str | None = None,
     ):
-        if reference is None and reference_builder is None:
-            raise ValueError("need a reference Report or a reference_builder")
         self.cfg = cfg
         self.engine = engine if engine is not None else AnalyticsEngine(registry=registry)
         self.reference = reference
+        # persisted baseline: a restart reloads the reference histogram
+        # instead of rebuilding it from scratch (and a freshly built one
+        # is saved back). A stale file with a different binning is
+        # ignored — the builder recreates and overwrites it.
+        self.reference_path = reference_path
+        if reference is None and reference_path:
+            import os
+
+            if os.path.exists(reference_path):
+                import zipfile
+
+                try:
+                    loaded = Report.load(reference_path)
+                    if loaded.hist.shape[1] == self.engine.nbins:
+                        self.reference = loaded
+                # np.load surfaces corruption as BadZipFile (truncated
+                # archive) or EOFError (empty file), neither an OSError —
+                # all of them mean "rebuild", never "refuse to start"
+                except (OSError, KeyError, ValueError, EOFError,
+                        zipfile.BadZipFile) as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "drift reference %s unreadable (%r); rebuilding",
+                        reference_path, e)
+        if self.reference is None and reference_builder is None:
+            raise ValueError("need a reference Report, a readable "
+                             "reference_path, or a reference_builder")
         # deferred: dataset load + summarize compile can take tens of
         # seconds; built on the supervised thread, not platform bring-up
         self._reference_builder = reference_builder
@@ -303,6 +364,15 @@ class DriftMonitor:
         """Consume one poll; score a window when one fills. Returns rows seen."""
         if self.reference is None:
             self.reference = self._reference_builder()
+            if self.reference_path:
+                try:
+                    self.reference.save(self.reference_path)
+                except OSError:
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "drift reference save to %s failed; the baseline "
+                        "will NOT survive a restart", self.reference_path)
         records = self._consumer.poll(self.window, poll_timeout_s)
         if not records:
             return 0

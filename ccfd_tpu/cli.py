@@ -483,6 +483,57 @@ def cmd_audit(args: argparse.Namespace) -> int:
         consumer.close()
 
 
+def cmd_lifecycle(args: argparse.Namespace) -> int:
+    """Model-lifecycle console: the versioned lineage + transition audit
+    trail the controller persists (lifecycle/versions.py). Reads the
+    store the platform's ``lifecycle.state_dir`` (or CCFD_LIFECYCLE_DIR)
+    points at — the compliance question "which model served when, trained
+    on which labels, and why was it promoted/rolled back" answered from
+    one JSON file, no running platform needed."""
+    from ccfd_tpu.lifecycle.versions import VersionStore
+
+    cfg = Config.from_env()
+    state_dir = args.dir or cfg.lifecycle_dir
+    if not state_dir:
+        print("[lifecycle] no state dir: pass --dir or set "
+              "CCFD_LIFECYCLE_DIR (the CR's lifecycle.state_dir)",
+              file=sys.stderr)
+        return 2
+    path = os.path.join(state_dir, "versions.json")
+    if not os.path.exists(path):
+        print(f"[lifecycle] no lineage at {path}", file=sys.stderr)
+        return 2
+    try:
+        # recover=False: an INSPECTION command must never quarantine the
+        # live lineage file out from under a running platform — report
+        # the corruption and let the controller's own recovery handle it
+        store = VersionStore(path, recover=False)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"[lifecycle] lineage at {path} is unreadable ({e!r}); the "
+              "controller quarantines and re-bootstraps it at next "
+              "bring-up", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({
+            "versions": [v.to_dict() for v in store.versions()],
+            "audit": store.audit_trail(args.version or None),
+        }, indent=1))
+        return 0
+    champ = store.champion()
+    print(f"champion: v{champ.version}" if champ else "champion: none")
+    for v in store.versions():
+        mark = "*" if champ and v.version == champ.version else " "
+        print(f"{mark} v{v.version:<4} stage={v.stage:<12} "
+              f"parent={v.parent if v.parent is not None else '-':<4} "
+              f"labels@{v.label_watermark:<8} "
+              f"ckpt={v.checkpoint_step if v.checkpoint_step is not None else '-'}")
+    if args.audit:
+        for e in store.audit_trail(args.version or None):
+            detail = json.dumps(e["detail"], sort_keys=True)
+            print(f"  {e['ts']:.3f} v{e['version']} {e['event']}: {detail}")
+    return 0
+
+
 def cmd_score(args: argparse.Namespace) -> int:
     """Offline bulk scoring: CSV in -> probabilities out, through the same
     pipelined bucketed dispatch the serving path uses. The batch analog of
@@ -1382,6 +1433,20 @@ def main(argv: list[str] | None = None) -> int:
     au.add_argument("--follow", action="store_true", help="keep consuming")
     au.add_argument("--limit", type=int, default=0, help="stop after N events")
     au.set_defaults(fn=cmd_audit)
+
+    lc = sub.add_parser(
+        "lifecycle",
+        help="model-lifecycle lineage + audit trail (versions console)",
+    )
+    lc.add_argument("--dir", default="",
+                    help="lifecycle state dir (default: CCFD_LIFECYCLE_DIR)")
+    lc.add_argument("--audit", action="store_true",
+                    help="print the transition audit trail too")
+    lc.add_argument("--version", type=int, default=0,
+                    help="restrict the audit trail to one version id")
+    lc.add_argument("--json", action="store_true",
+                    help="emit the full lineage+audit as JSON")
+    lc.set_defaults(fn=cmd_lifecycle)
 
     sc = sub.add_parser("score", help="offline bulk scoring: CSV -> probabilities")
     sc.add_argument("--input", default="", help="creditcard.csv path (default: CCFD_CSV/synthetic)")

@@ -97,6 +97,26 @@ class Config:
     retrain_batch: int = 1024
     retrain_min_labels: int = 256
 
+    # --- model lifecycle (lifecycle/; governed rollout of retrained
+    # models: shadow -> canary -> gated promotion with auto-rollback) ---
+    # paired champion/challenger shadow scores ride this topic
+    shadow_topic: str = "ccd-shadow-scores"  # CCFD_LIFECYCLE_SHADOW_TOPIC
+    # lineage/audit + candidate checkpoints persistence root; "" keeps the
+    # version store in memory (lineage does NOT survive restarts then)
+    lifecycle_dir: str = ""  # CCFD_LIFECYCLE_DIR
+    # guardrails (lifecycle/controller.py Guardrails; see ARCHITECTURE.md)
+    lifecycle_min_labels: int = 128          # CCFD_LIFECYCLE_MIN_LABELS
+    lifecycle_min_shadow_rows: int = 1024    # CCFD_LIFECYCLE_MIN_SHADOW_ROWS
+    lifecycle_auc_margin: float = 0.01       # CCFD_LIFECYCLE_AUC_MARGIN
+    lifecycle_max_alert_delta: float = 0.10  # CCFD_LIFECYCLE_MAX_ALERT_DELTA
+    lifecycle_max_psi: float = 0.25          # CCFD_LIFECYCLE_MAX_PSI
+    lifecycle_canary_weight: float = 0.10    # CCFD_LIFECYCLE_CANARY_WEIGHT
+    lifecycle_canary_min_labels: int = 64    # CCFD_LIFECYCLE_CANARY_MIN_LABELS
+    # submissions inside this interval of the last accepted candidate
+    # coalesce into it instead of superseding it (anti-livelock pacing
+    # for fast retrain loops); 0 accepts every submission
+    lifecycle_min_submit_interval_s: float = 30.0  # CCFD_LIFECYCLE_MIN_SUBMIT_INTERVAL_S
+
     # --- distributed tracing (observability/trace.py) ---
     # tail sampler: probabilistic keep-rate for BORING traces
     # (slow/errored/fraud/degraded traces are always kept). 1.0 keeps
@@ -231,6 +251,41 @@ class Config:
             retrain_batch=int(e.get("CCFD_RETRAIN_BATCH", str(Config.retrain_batch))),
             retrain_min_labels=int(
                 e.get("CCFD_RETRAIN_MIN_LABELS", str(Config.retrain_min_labels))
+            ),
+            shadow_topic=e.get(
+                "CCFD_LIFECYCLE_SHADOW_TOPIC", Config.shadow_topic
+            ),
+            lifecycle_dir=e.get("CCFD_LIFECYCLE_DIR", Config.lifecycle_dir),
+            lifecycle_min_labels=int(
+                e.get("CCFD_LIFECYCLE_MIN_LABELS",
+                      str(Config.lifecycle_min_labels))
+            ),
+            lifecycle_min_shadow_rows=int(
+                e.get("CCFD_LIFECYCLE_MIN_SHADOW_ROWS",
+                      str(Config.lifecycle_min_shadow_rows))
+            ),
+            lifecycle_auc_margin=float(
+                e.get("CCFD_LIFECYCLE_AUC_MARGIN",
+                      str(Config.lifecycle_auc_margin))
+            ),
+            lifecycle_max_alert_delta=float(
+                e.get("CCFD_LIFECYCLE_MAX_ALERT_DELTA",
+                      str(Config.lifecycle_max_alert_delta))
+            ),
+            lifecycle_max_psi=float(
+                e.get("CCFD_LIFECYCLE_MAX_PSI", str(Config.lifecycle_max_psi))
+            ),
+            lifecycle_canary_weight=float(
+                e.get("CCFD_LIFECYCLE_CANARY_WEIGHT",
+                      str(Config.lifecycle_canary_weight))
+            ),
+            lifecycle_canary_min_labels=int(
+                e.get("CCFD_LIFECYCLE_CANARY_MIN_LABELS",
+                      str(Config.lifecycle_canary_min_labels))
+            ),
+            lifecycle_min_submit_interval_s=float(
+                e.get("CCFD_LIFECYCLE_MIN_SUBMIT_INTERVAL_S",
+                      str(Config.lifecycle_min_submit_interval_s))
             ),
             trace_sample=float(
                 e.get("CCFD_TRACE_SAMPLE", str(Config.trace_sample))

@@ -1,0 +1,36 @@
+"""Model lifecycle: versioned shadow -> canary -> gated promotion -> rollback.
+
+The reference's defining loop is feedback-driven retraining — investigator
+decisions become labels that retrain the served model (reference
+README.md:571-581) — and ``parallel/online.py`` reproduces the retrain but
+then hot-swaps every candidate straight into production unvalidated. This
+package turns that blind swap into a governed state machine:
+
+    TRAIN -> SHADOW -> CANARY -> PROMOTE
+                 \\        \\-> ROLLBACK (guardrail breach / breaker open)
+                  \\-> REJECT
+
+- :mod:`~ccfd_tpu.lifecycle.versions` — ModelVersion lineage (monotone id,
+  parent, label watermark, checkpoint ref, recorded eval metrics) persisted
+  so restarts resume lineage, plus the transition audit trail.
+- :mod:`~ccfd_tpu.lifecycle.shadow` — the challenger scores the SAME live
+  batches off the critical path; paired champion/challenger scores land on
+  a bus topic.
+- :mod:`~ccfd_tpu.lifecycle.evaluator` — joins shadow scores with the
+  delayed human labels from the fraud process (AUC / precision@k /
+  alert-rate delta) and tracks champion-vs-challenger score-distribution
+  PSI (reusing :func:`ccfd_tpu.analytics.engine.psi`).
+- :mod:`~ccfd_tpu.lifecycle.controller` — guardrailed transitions; the
+  canary phase drives the :mod:`ccfd_tpu.serving.graph` ``hash_split``
+  ROUTER weights, and any guardrail breach (or a scorer-edge breaker open)
+  during canary auto-rolls back to the champion checkpoint.
+"""
+
+from ccfd_tpu.lifecycle.controller import (  # noqa: F401
+    CanaryGate,
+    Guardrails,
+    LifecycleController,
+)
+from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator  # noqa: F401
+from ccfd_tpu.lifecycle.shadow import ShadowTap  # noqa: F401
+from ccfd_tpu.lifecycle.versions import ModelVersion, VersionStore  # noqa: F401

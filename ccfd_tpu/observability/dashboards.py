@@ -19,6 +19,8 @@ metric names, one builder per board:
 - Retrain     — online-training health (new capability; no reference analog)
 - Resilience  — fault-injection / circuit-breaker / degradation-ladder
   surface (new capability; no reference analog)
+- ModelLifecycle — shadow/canary/promotion/rollback surface of the model
+  lifecycle controller (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -364,6 +366,56 @@ def tracing_dashboard() -> dict:
     return _dashboard("CCFD Tracing", "ccfd-tracing", p)
 
 
+def lifecycle_dashboard() -> dict:
+    """Model-lifecycle board (round 9; lifecycle/).
+
+    The governed-rollout surface: which stage the candidate is in
+    (``ccfd_lifecycle_stage``: 0 idle / 1 shadow / 2 canary), the
+    promotion/rejection/rollback economics, shadow-scoring throughput and
+    drops (the off-hot-path contract: drops, not latency), the evaluator's
+    champion-vs-challenger evidence (label AUC, alert-rate delta,
+    score-distribution PSI against its 0.25 action threshold), and the
+    canary traffic split by arm. An operator reads it as: what is in
+    flight, how close is the verdict, and did anything roll back."""
+    p = [
+        _alert_stat(0, "Candidate stage (0 idle / 1 shadow / 2 canary)",
+                    ["ccfd_lifecycle_stage"], red_above=2),
+        _panel(1, "Champion / candidate version",
+               ["ccfd_lifecycle_champion_version",
+                "ccfd_lifecycle_candidate_version"], "stat"),
+        _panel(2, "Promotions / rollbacks / rejections",
+               ["ccfd_lifecycle_promotions_total",
+                "ccfd_lifecycle_rollbacks_total",
+                "ccfd_lifecycle_rejections_total"], "stat"),
+        _alert_stat(3, "Rollbacks / s",
+                    ["rate(ccfd_lifecycle_rollbacks_total[5m])"],
+                    red_above=0.01),
+        _panel(4, "Candidates accepted vs coalesced / s",
+               ["rate(ccfd_lifecycle_candidates_total[5m])",
+                "rate(ccfd_lifecycle_submissions_coalesced_total[5m])"]),
+        _panel(5, "Shadow rows scored / dropped per s",
+               ["rate(ccfd_lifecycle_shadow_rows_total[5m])",
+                "rate(ccfd_lifecycle_shadow_dropped_total[5m])"]),
+        _panel(6, "Label AUC by model",
+               ["ccfd_lifecycle_auc"]),
+        _panel(7, "Labels / shadow rows joined for the candidate",
+               ["ccfd_lifecycle_eval_labels",
+                "ccfd_lifecycle_eval_shadow_rows"]),
+        _alert_stat(8, "Champion vs challenger score PSI",
+                    ["ccfd_lifecycle_score_psi"], red_above=0.25),
+        _panel(9, "Alert-rate delta (challenger - champion)",
+               ["ccfd_lifecycle_alert_rate_delta"]),
+        _panel(10, "Canary rows by arm / s",
+               ['rate(ccfd_lifecycle_canary_rows_total{arm="champion"}[5m])',
+                'rate(ccfd_lifecycle_canary_rows_total{arm="challenger"}[5m])']),
+        _alert_stat(11, "Shadow scoring errors / s",
+                    ["rate(ccfd_lifecycle_shadow_errors_total[5m])",
+                     "rate(ccfd_lifecycle_canary_errors_total[5m])"],
+                    red_above=0.1),
+    ]
+    return _dashboard("CCFD Model Lifecycle", "ccfd-lifecycle", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -386,6 +438,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Retrain": retrain_dashboard(),
         "Resilience": resilience_dashboard(),
         "Tracing": tracing_dashboard(),
+        "ModelLifecycle": lifecycle_dashboard(),
     }
 
 

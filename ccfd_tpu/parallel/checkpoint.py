@@ -37,6 +37,11 @@ class CheckpointManager:
     def __init__(self, root: str, keep: int = 3, use_orbax: bool | None = None):
         self.root = root
         self.keep = keep
+        # steps retention must never delete (beyond the newest-``keep``
+        # window): the model lifecycle pins its CHAMPION's checkpoint here
+        # so a stream of rejected candidates can't GC the one checkpoint
+        # rollback/restart restore from
+        self.pinned: set[int] = set()
         os.makedirs(root, exist_ok=True)
         if use_orbax is None:
             try:
@@ -101,7 +106,9 @@ class CheckpointManager:
 
     def _gc(self) -> None:
         dirs = _step_dirs(self.root)
-        for _, path in dirs[: -self.keep] if self.keep else []:
+        for step, path in dirs[: -self.keep] if self.keep else []:
+            if step in self.pinned:
+                continue
             import shutil
 
             shutil.rmtree(path, ignore_errors=True)

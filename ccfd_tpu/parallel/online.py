@@ -8,12 +8,18 @@ on TPU, pmap over v5e-4)". The loop:
 2. accumulate a replay buffer; once ``retrain_min_labels`` are available,
    run train steps on ``retrain_batch``-row batches through the
    mesh-sharded train step (ccfd_tpu/parallel/train.make_train_step),
-3. checkpoint and publish the new params into the serving scorer with
+3. hand the candidate to the model-lifecycle controller
+   (ccfd_tpu/lifecycle/controller.py) for shadow -> canary -> gated
+   promotion — or, in the legacy opt-in direct-swap mode (``lifecycle``
+   unset), publish it straight into the serving scorer with
    ``Scorer.swap_params`` — double-buffered, serving never pauses.
 
 Labels are rare relative to traffic (only resolved fraud processes emit
 them), so the buffer is a reservoir over the last ``buffer_size`` labels
-and every retrain epoch resamples from it.
+and every retrain epoch resamples from it. Sampling uses a seeded,
+injectable RNG that ``reset()`` re-seeds, so a supervisor respawn (or a
+re-run with the same label stream) reproduces the same candidates —
+the determinism the lifecycle's audit trail and tests depend on.
 """
 
 from __future__ import annotations
@@ -48,6 +54,8 @@ class OnlineTrainer:
         buffer_size: int = 65536,
         steps_per_round: int = 8,
         seed: int = 0,
+        rng: np.random.Generator | None = None,
+        lifecycle: Any = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -58,7 +66,18 @@ class OnlineTrainer:
         self.checkpoints = checkpoints
         self.buffer_size = buffer_size
         self.steps_per_round = steps_per_round
-        self._rng = np.random.default_rng(seed)
+        # governed rollout (lifecycle/controller.py): when set, candidates
+        # go through shadow -> canary -> gated promotion instead of the
+        # legacy direct swap (kept for lifecycle=None callers)
+        self.lifecycle = lifecycle
+        self.seed = seed
+        # batch sampling must be reproducible across runs: an injected rng
+        # is the caller's contract; the default is seeded here AND
+        # re-seeded by reset() so a supervisor respawn replays the same
+        # sampling stream instead of continuing from opaque state
+        self._rng_injected = rng is not None
+        self._rng = rng if rng is not None else np.random.default_rng(seed)
+        self.labels_seen = 0  # lifetime label count: the lineage watermark
 
         self._consumer = broker.consumer("online-trainer", (cfg.labels_topic,))
         self._X = np.zeros((0, len(FEATURE_NAMES)), np.float32)
@@ -67,6 +86,9 @@ class OnlineTrainer:
         # alias the pytree the serving scorer holds
         self._state = init_state(jax.tree.map(lambda a: jnp.array(a, copy=True), params), self.tc)
         self._new_labels = 0
+        # lifecycle rebase request (controller thread -> trainer thread):
+        # applied at the top of the next step(), never mid-train
+        self._rebase_params: Any = None
         self._step_fn = make_train_step(self.tc, mesh=mesh)
         self._stop = threading.Event()
 
@@ -104,13 +126,32 @@ class OnlineTrainer:
         self._y = np.concatenate([self._y, np.asarray(labels, np.float32)])[
             -self.buffer_size :
         ]
+        self.labels_seen += len(rows)
         return len(rows)
+
+    # -- lifecycle rebase --------------------------------------------------
+    def rebase(self, params: Any) -> None:
+        """Re-base the training state onto ``params`` (the champion).
+
+        Wired by the operator as the lifecycle controller's rebase hook:
+        after a candidate is REJECTED or ROLLED BACK, continuing to train
+        from its weights would make every later candidate descend from
+        the discarded model while the lineage records parent=champion.
+        Thread-safe hand-off: the request is staged here (any thread) and
+        applied at the next step() boundary on the trainer thread — never
+        mid-train-step, whose donated buffers must not race a swap."""
+        self._rebase_params = jax.tree.map(
+            lambda a: jnp.array(np.asarray(a)), params)
 
     # -- one retrain round -------------------------------------------------
     def step(self) -> bool:
         """Ingest labels; train + swap only when NEW labels arrived and the
         buffer is warm. Returns whether a swap happened (so the run loop
         sleeps instead of re-training a stale buffer in a tight loop)."""
+        pending = self._rebase_params
+        if pending is not None:
+            self._rebase_params = None
+            self._state = init_state(pending, self.tc)
         self._new_labels += self._ingest()
         if len(self._y) < self.cfg.retrain_min_labels or self._new_labels == 0:
             return False
@@ -126,8 +167,15 @@ class OnlineTrainer:
         if loss is not None:
             self._g_loss.set(float(loss))
         new_params = self._state["params"]
-        self.scorer.swap_params(new_params)
-        self._c_swaps.inc()
+        if self.lifecycle is not None:
+            # governed rollout: the controller checkpoints/versions the
+            # candidate and walks it through shadow/canary before any
+            # params reach serving (lifecycle/controller.py)
+            self.lifecycle.submit_candidate(
+                new_params, label_watermark=self.labels_seen)
+        else:
+            self.scorer.swap_params(new_params)
+            self._c_swaps.inc()
         if self.checkpoints is not None:
             self.checkpoints.save(int(self._state["step"]), new_params)
         return True
@@ -135,8 +183,12 @@ class OnlineTrainer:
     # -- daemon ------------------------------------------------------------
     def reset(self) -> None:
         """Re-arm after stop(); called by the supervisor before respawn
-        (clearing inside run() would race a concurrent stop())."""
+        (clearing inside run() would race a concurrent stop()). Re-seeds
+        the default RNG so the respawned loop's batch sampling replays the
+        same stream (an injected rng is the caller's to manage)."""
         self._stop.clear()
+        if not self._rng_injected:
+            self._rng = np.random.default_rng(self.seed)
 
     def run(self, interval_s: float = 1.0) -> None:
         while not self._stop.is_set():

@@ -50,6 +50,8 @@ _COMPONENTS = (
     "health",     # runtime probes (platform)
     "chaos",      # seeded fault injection (new; no reference analog)
     "tracing",    # distributed tracing + tail sampler (new; round 7)
+    "lifecycle",  # model lifecycle: shadow -> canary -> gated promotion
+                  # with auto-rollback (new; round 9, lifecycle/)
 )
 
 
@@ -119,6 +121,7 @@ class Platform:
         self.chaos = None
         self.fault_plan = None  # runtime/faults.FaultPlan when configured
         self.trace_sink = None  # observability/trace.SpanSink when enabled
+        self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
         self.investigator = None
         self.recovery = None  # CheckpointCoordinator when crash_recovery on
@@ -211,6 +214,18 @@ class Platform:
         # 3. model serving (Seldon, README.md:271-301)
         if spec.component("scorer").enabled:
             self._up_scorer()
+
+        # 3b. model lifecycle (lifecycle/): governs how retrain candidates
+        #     reach the scorer — shadow -> canary -> gated promotion with
+        #     auto-rollback. Built BEFORE the router so the router's score
+        #     lane can be wrapped with the shadow tap + canary gate, and
+        #     before retrain so the trainer hands candidates to it. Needs
+        #     a local scorer with a host forward (the challenger slot
+        #     scores off-device by design) and the bus (shadow pairs +
+        #     label joins ride topics).
+        if (spec.component("lifecycle").enabled
+                and self.scorer is not None and self.broker is not None):
+            self._up_lifecycle()
 
         # 4. process engine (KIE, README.md:345-408)
         if spec.component("engine").enabled:
@@ -432,6 +447,92 @@ class Platform:
                 self.prediction_host, int(c.opt("port", 0))
             )
 
+    def _up_lifecycle(self) -> None:
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+        from ccfd_tpu.serving.history import SeqScorer
+
+        if (isinstance(self.scorer, SeqScorer)
+                or not getattr(self.scorer, "has_host_forward", False)):
+            logging.getLogger(__name__).warning(
+                "lifecycle enabled but the scorer has no host forward "
+                "(model=%s): the challenger slot scores off-device by "
+                "design; skipping lifecycle",
+                getattr(getattr(self.scorer, "spec", None), "name", "?"),
+            )
+            return
+        from ccfd_tpu.lifecycle.controller import (
+            Guardrails,
+            LifecycleController,
+        )
+        from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+        from ccfd_tpu.lifecycle.shadow import ShadowTap
+        from ccfd_tpu.lifecycle.versions import VersionStore
+        from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+        c = self.spec.component("lifecycle")
+        cfg = self.cfg
+        registry = self._registry("lifecycle")
+        state_dir = c.opt("state_dir", cfg.lifecycle_dir) or ""
+        store = VersionStore(
+            os.path.join(state_dir, "versions.json") if state_dir else None
+        )
+        if state_dir:
+            ckpt_dir = os.path.join(state_dir, "checkpoints")
+        else:
+            # in-memory lineage still needs somewhere for rollback
+            # checkpoints to live for the process lifetime
+            import tempfile
+
+            ckpt_dir = tempfile.mkdtemp(prefix="ccfd_lifecycle_")
+        checkpoints = CheckpointManager(
+            ckpt_dir, keep=int(c.opt("keep_checkpoints", 8))
+        )
+        shadow = ShadowTap(
+            self.scorer, self.broker, cfg.shadow_topic, registry,
+            max_queued_batches=int(c.opt("shadow_queue_batches", 64)),
+        )
+        evaluator = ShadowEvaluator(
+            cfg, self.broker, self.scorer, registry,
+            k_frac=float(c.opt("precision_k_frac", 0.05)),
+        )
+        guardrails = Guardrails(
+            min_labels=int(c.opt("min_labels", cfg.lifecycle_min_labels)),
+            min_shadow_rows=int(
+                c.opt("min_shadow_rows", cfg.lifecycle_min_shadow_rows)),
+            auc_margin=float(c.opt("auc_margin", cfg.lifecycle_auc_margin)),
+            max_alert_rate_delta=float(
+                c.opt("max_alert_rate_delta", cfg.lifecycle_max_alert_delta)),
+            max_score_psi=float(
+                c.opt("max_score_psi", cfg.lifecycle_max_psi)),
+            canary_weight=float(
+                c.opt("canary_weight", cfg.lifecycle_canary_weight)),
+            canary_min_labels=int(
+                c.opt("canary_min_labels", cfg.lifecycle_canary_min_labels)),
+            min_submit_interval_s=float(
+                c.opt("min_submit_interval_s",
+                      cfg.lifecycle_min_submit_interval_s)),
+        )
+        self.lifecycle = LifecycleController(
+            cfg, self.scorer, store=store, checkpoints=checkpoints,
+            shadow=shadow, evaluator=evaluator, guardrails=guardrails,
+            registry=registry,
+        )
+        interval = float(c.opt("interval_s", 0.25))
+        self.supervisor.add_thread_service(
+            "lifecycle",
+            lambda: self.lifecycle.run(interval_s=interval),
+            self.lifecycle.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=self.lifecycle.reset,
+        )
+        self.supervisor.add_thread_service(
+            "lifecycle-shadow",
+            lambda: shadow.run(interval_s=0.05),
+            shadow.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=shadow.reset,
+        )
+
     def _up_engine(self) -> None:
         from ccfd_tpu.process.fraud import build_engine
         from ccfd_tpu.process.prediction import ScorerPredictionService
@@ -560,6 +661,24 @@ class Platform:
                     score_fn = inj.wrap(score_fn)  # SeqScorer object
                 else:
                     score_fn = inj.wrap_fn(score_fn)
+        breaker = None
+        if self.lifecycle is not None and not hasattr(
+                score_fn, "score_with_ids"):
+            # lifecycle serving lane: shadow tap inside (pure champion
+            # pairs), canary gate outside (challenger-arm override). Sits
+            # UNDER the ParallelRouter's coalescing batcher, so the tap
+            # observes the same coalesced batches the device scores.
+            # Faults injected above stay inside the wrap: a fault-storm
+            # failure degrades the ladder, not the lifecycle accounting.
+            score_fn = self.lifecycle.wrap_score(score_fn)
+            # one scorer-edge breaker, shared between the router's
+            # degradation ladder and the controller's canary guardrail
+            # (a breaker leaving CLOSED mid-canary is a rollback trigger)
+            if bool(c.opt("degrade", True)):
+                from ccfd_tpu.router.router import default_scorer_breaker
+
+                breaker = default_scorer_breaker(reg)
+                self.lifecycle.breaker = breaker
         engine = self.engine
         if engine is None and self.cfg.kie_server_url.startswith("http"):
             # remote engine over the KIE-shaped REST contract
@@ -581,6 +700,7 @@ class Platform:
                 )
         common = dict(
             host_score_fn=host_score_fn,
+            breaker=breaker,
             # the ladder is the production default: a sick scorer edge
             # degrades scoring quality instead of dropping batches
             # (router.degrade: false restores the historical drop path)
@@ -686,10 +806,20 @@ class Platform:
         from ccfd_tpu.runtime.supervisor import RestartPolicy
 
         c = self.spec.component("retrain")
+        # governed rollout by default when the lifecycle component is up;
+        # retrain.direct_swap: true keeps the legacy unvalidated hot swap
+        lifecycle = (None if bool(c.opt("direct_swap", False))
+                     else self.lifecycle)
         trainer = OnlineTrainer(
             self.cfg, self.broker, self.scorer, self.scorer.params,
             registry=self._registry("retrain"),
+            seed=int(c.opt("seed", 0)),
+            lifecycle=lifecycle,
         )
+        if lifecycle is not None:
+            # REJECT/ROLLBACK re-bases the trainer onto the champion so
+            # the next candidate descends from its recorded parent
+            lifecycle.trainer_rebase = trainer.rebase
         interval = float(c.opt("interval_s", 0.5))
         self.supervisor.add_thread_service(
             "retrain",
@@ -725,6 +855,10 @@ class Platform:
             registry=registry,
             window=int(c.opt("window", 4096)),
             reference_builder=build_reference,
+            # persisted PSI baseline (CR analytics.reference_file): a
+            # restart reloads the training-distribution histogram instead
+            # of rebuilding it from an empty window
+            reference_path=c.opt("reference_file", "") or None,
         )
         interval = float(c.opt("interval_s", 0.25))
         self.supervisor.add_thread_service(
@@ -843,6 +977,11 @@ class Platform:
             self.recovery.stop()
         if self.supervisor:
             self.supervisor.stop()
+        if self.lifecycle is not None:
+            try:
+                self.lifecycle.close()  # releases the evaluator consumers
+            except Exception:  # noqa: BLE001
+                pass
         # a ParallelRouter owns coalescing-batcher threads the supervisor
         # doesn't know about; release any callers still parked on futures
         if getattr(self.router, "batcher", None) is not None:

@@ -183,14 +183,44 @@ def _hash_split_init(key, config):
 def _hash_split_weights(p, x, cfg):
     """Deterministic traffic split (A/B, canary): a cheap per-row hash of the
     features lands each request in a weight bucket, so the same transaction
-    always routes to the same arm — no host RNG, no state, jit-stable."""
-    h = jnp.dot(x, jnp.arange(1.0, x.shape[1] + 1.0, dtype=x.dtype) * 0.61803398875)
+    always routes to the same arm — no host RNG, no state, jit-stable.
+    HIGHEST precision pins the dot to f32 accumulation on TPU too (default
+    matmul precision there is bf16), keeping the compiled split
+    bit-compatible with the ``hash_split_arms_numpy`` host mirror the
+    lifecycle canary gate and offline audits recompute arms with."""
+    h = jnp.dot(x, jnp.arange(1.0, x.shape[1] + 1.0, dtype=x.dtype) * 0.61803398875,
+                precision=jax.lax.Precision.HIGHEST)
     u = jnp.mod(jnp.abs(h), 1.0)
     arm = jnp.sum(u[:, None] >= p["cum"][None, :-1], axis=1)
     return jax.nn.one_hot(arm, p["cum"].shape[0], dtype=jnp.float32)
 
 
 register_component("ROUTER", "hash_split", _hash_split_init, _hash_split_weights)
+
+
+def hash_split_arms_numpy(x, weights):
+    """Host mirror of the ``hash_split`` ROUTER's per-row arm assignment.
+
+    The model-lifecycle canary gate (lifecycle/controller.py) splits live
+    traffic with the SAME hash the compiled router component uses, so a
+    transaction lands on the same arm whether the split runs in this
+    process, another process, or inside a jitted graph — the determinism
+    the canary accounting depends on (test-asserted against
+    ``_hash_split_weights`` under jit and across processes). Computed in
+    float32 end-to-end to match the compiled component's dtype.
+
+    ``x``: (B, F) features; ``weights``: per-arm traffic fractions.
+    Returns (B,) int arm indices.
+    """
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    w = np.asarray([float(v) for v in weights], np.float32)
+    cum = np.cumsum(w / np.sum(w))
+    vec = (np.arange(1.0, x.shape[1] + 1.0, dtype=np.float32)
+           * np.float32(0.61803398875))
+    u = np.mod(np.abs(x @ vec), 1.0)
+    return np.sum(u[:, None] >= cum[None, :-1], axis=1).astype(np.int32)
 
 
 # --------------------------------------------------------------------------

@@ -185,6 +185,12 @@ class Scorer:
         self._notify_lock = threading.Lock()
         self._swap_gen = 0
         self._swap_delivered_gen = 0
+        # challenger slot (lifecycle/shadow.py): a second, double-buffered
+        # (version, host_params) pair living NEXT TO the champion — shadow
+        # and canary scoring read it via the host numpy forward, so the
+        # challenger never contends for the device. Installed/cleared by
+        # the lifecycle controller; swap_params does not touch it.
+        self._challenger: tuple[int, Any] | None = None
         # Dispatch deadline (server-side SELDON_TIMEOUT analog,
         # /root/reference/README.md:386-393): the serving ``score`` path
         # bounds its device round trip; a wedged attachment (tunnel hang
@@ -614,6 +620,49 @@ class Scorer:
         with self._lock:
             if fn in self._swap_listeners:
                 self._swap_listeners.remove(fn)
+
+    # -- challenger slot (model lifecycle: shadow/canary scoring) ----------
+    def install_challenger(self, version: int, params: Any) -> None:
+        """Stage a challenger's host-params copy beside the champion.
+
+        Double-buffered like ``swap_params``: the host cast happens into
+        fresh buffers before the reference swaps under the lock, so an
+        in-flight ``challenger_score`` keeps the old tree alive and the
+        next call sees the new one. Requires a numpy host forward — the
+        whole point of the slot is scoring off the device's critical path.
+        """
+        if self.spec.apply_numpy is None:
+            raise RuntimeError(
+                f"model {self.spec.name!r} has no host forward; the "
+                f"challenger slot scores on the host by design")
+        staged = jax.tree.map(_host_cast, params)
+        with self._lock:
+            self._challenger = (int(version), staged)
+
+    def clear_challenger(self, version: int | None = None) -> None:
+        """Remove the challenger; with ``version`` given, only that one
+        (a stale clear must not evict a newer candidate)."""
+        with self._lock:
+            if (self._challenger is not None
+                    and (version is None
+                         or self._challenger[0] == int(version))):
+                self._challenger = None
+
+    @property
+    def challenger_version(self) -> int | None:
+        ch = self._challenger
+        return ch[0] if ch is not None else None
+
+    def challenger_score(self, x: np.ndarray) -> np.ndarray:
+        """(n, F) -> (n,) proba_1 on the challenger slot's host params —
+        no device round trip, never touches the champion path."""
+        ch = self._challenger
+        if ch is None:
+            raise RuntimeError("no challenger installed")
+        return np.asarray(
+            self.spec.apply_numpy(ch[1], np.asarray(x, np.float32)),
+            np.float32,
+        )
 
     def score_pipelined(self, x: np.ndarray, depth: int = 2) -> np.ndarray:
         """Bulk scoring with ``depth`` dispatches in flight.

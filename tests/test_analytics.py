@@ -127,6 +127,79 @@ def test_drift_monitor_builds_reference_lazily(dataset):
         mon.stop()
 
 
+def test_drift_reference_persists_across_restart(dataset, tmp_path):
+    """The PSI baseline must survive a bring-up: the first monitor builds
+    and saves it; a restarted monitor loads it WITHOUT invoking the
+    builder (previously every restart rebuilt from an empty window)."""
+    cfg = Config.from_env({})
+    broker = Broker()
+    eng = AnalyticsEngine()
+    ref_path = str(tmp_path / "drift_reference.npz")
+
+    mon = DriftMonitor(
+        cfg, broker, None, engine=eng, window=128,
+        reference_builder=lambda: eng.summarize(dataset.X, dataset.y),
+        reference_path=ref_path,
+    )
+    try:
+        for row in dataset.X[:256]:
+            broker.produce(cfg.kafka_topic, _tx(row))
+        for _ in range(5):
+            mon.step()
+            if mon.windows_scored:
+                break
+        assert mon.windows_scored >= 1
+        assert mon.reference is not None
+    finally:
+        mon.stop()
+    import os
+
+    assert os.path.exists(ref_path)
+
+    def must_not_build():
+        raise AssertionError("restart rebuilt the reference despite the "
+                             "persisted baseline")
+
+    mon2 = DriftMonitor(
+        Config.from_env({}), Broker(), None, engine=eng, window=128,
+        reference_builder=must_not_build, reference_path=ref_path,
+    )
+    try:
+        # loaded eagerly at construction, bitwise-equal to the saved one
+        assert mon2.reference is not None
+        np.testing.assert_array_equal(mon2.reference.hist,
+                                      mon.reference.hist)
+        np.testing.assert_array_equal(mon2.reference.min,
+                                      mon.reference.min)
+        assert mon2.reference.n == mon.reference.n
+        # and it scores windows immediately, builder untouched
+        broker2 = mon2._broker
+        for row in dataset.X[:256]:
+            broker2.produce(cfg.kafka_topic, _tx(row))
+        for _ in range(5):
+            mon2.step()
+            if mon2.windows_scored:
+                break
+        assert mon2.windows_scored >= 1
+    finally:
+        mon2.stop()
+
+
+def test_drift_reference_path_alone_is_sufficient(dataset, tmp_path):
+    """A readable reference_path satisfies the constructor without a
+    builder; an unreadable one still demands a fallback."""
+    ref_path = str(tmp_path / "ref.npz")
+    eng = AnalyticsEngine()
+    eng.summarize(dataset.X[:512], dataset.y[:512]).save(ref_path)
+    mon = DriftMonitor(Config.from_env({}), Broker(), None, engine=eng,
+                       reference_path=ref_path)
+    assert mon.reference is not None
+    mon.stop()
+    with pytest.raises(ValueError):
+        DriftMonitor(Config.from_env({}), Broker(), None, engine=eng,
+                     reference_path=str(tmp_path / "missing.npz"))
+
+
 def test_drift_monitor_scores_windows(dataset):
     cfg = Config.from_env({})
     broker = Broker()

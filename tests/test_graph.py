@@ -6,6 +6,7 @@ re-designed in ccfd_tpu/serving/graph.py.
 """
 
 import json
+import os
 import pathlib
 
 import jax
@@ -142,6 +143,75 @@ def test_router_hash_split_is_deterministic_and_splits(rng):
     )
     share = w[:, 0].mean()
     assert 0.6 < share < 0.95
+
+
+def test_hash_split_numpy_mirror_matches_compiled_router(rng):
+    """The canary gate's host arm assignment (hash_split_arms_numpy) must
+    agree row-for-row with the compiled ROUTER component — the lifecycle
+    controller splits live traffic with one and tests/graphs with the
+    other (lifecycle/controller.py CanaryGate)."""
+    from ccfd_tpu.serving.graph import (
+        _hash_split_init,
+        _hash_split_weights,
+        hash_split_arms_numpy,
+    )
+
+    for weights in ([0.9, 0.1], [0.5, 0.5], [0.6, 0.3, 0.1]):
+        x = _x(rng, n=4096)
+        p = _hash_split_init(None, {"weights": weights})
+        onehot = np.asarray(_hash_split_weights(p, jnp.asarray(x), {}))
+        jax_arms = onehot.argmax(axis=1)
+        np.testing.assert_array_equal(
+            hash_split_arms_numpy(x, weights), jax_arms)
+
+
+def test_hash_split_stable_under_jit_retrace(rng):
+    """Canary weights depend on the per-row hash split staying identical
+    across jit re-traces: a fresh jit of the same component (new trace,
+    new executable) must assign every row the same arm."""
+    from ccfd_tpu.serving.graph import _hash_split_init, _hash_split_weights
+
+    x = jnp.asarray(_x(rng, n=2048))
+    p = _hash_split_init(None, {"weights": [0.8, 0.2]})
+    first = np.asarray(jax.jit(_hash_split_weights, static_argnums=2)(
+        p, x, ()))
+    # independent trace: a new jit wrapper compiles from scratch
+    again = np.asarray(jax.jit(
+        lambda pp, xx: _hash_split_weights(pp, xx, {}))(p, x))
+    np.testing.assert_array_equal(first, again)
+    # and a different batch shape re-traces without perturbing shared rows
+    sliced = np.asarray(jax.jit(
+        lambda pp, xx: _hash_split_weights(pp, xx, {}))(p, x[:777]))
+    np.testing.assert_array_equal(first[:777], sliced)
+
+
+def test_hash_split_stable_across_processes(rng, tmp_path):
+    """Same rows, another interpreter: the split must not depend on
+    process state (hash seeds, import order) — a canary arm decided in a
+    router worker must match one recomputed by an offline audit."""
+    import json
+    import subprocess
+    import sys
+
+    x = _x(rng, n=256)
+    xf = tmp_path / "x.npy"
+    np.save(xf, x)
+    code = (
+        "import numpy as np, json, sys\n"
+        "from ccfd_tpu.serving.graph import hash_split_arms_numpy\n"
+        f"x = np.load({str(xf)!r})\n"
+        "print(json.dumps(hash_split_arms_numpy(x, [0.8, 0.2]).tolist()))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        check=True, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+    )
+    from ccfd_tpu.serving.graph import hash_split_arms_numpy
+
+    theirs = np.asarray(json.loads(out.stdout.strip().splitlines()[-1]))
+    np.testing.assert_array_equal(hash_split_arms_numpy(x, [0.8, 0.2]),
+                                  theirs)
 
 
 def test_graph_validation_errors():

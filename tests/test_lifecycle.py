@@ -1,0 +1,881 @@
+"""Model lifecycle: versions/audit, shadow tap, evaluator, controller
+state machine (reject / promote / rollback), trainer handoff, operator
+wiring, and the seeded-RNG retrain determinism satellite."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.lifecycle.controller import (
+    STAGE_CANARY,
+    STAGE_IDLE,
+    STAGE_SHADOW,
+    CanaryGate,
+    Guardrails,
+    LifecycleController,
+)
+from ccfd_tpu.lifecycle.evaluator import (
+    ShadowEvaluator,
+    auc_score,
+    precision_at_k,
+)
+from ccfd_tpu.lifecycle.shadow import ShadowTap
+from ccfd_tpu.lifecycle.versions import ModelVersion, VersionStore
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.parallel.checkpoint import CheckpointManager
+from ccfd_tpu.serving.scorer import Scorer
+
+
+@pytest.fixture(scope="module")
+def champion_params(dataset):
+    from ccfd_tpu.parallel.train import TrainConfig, fit_mlp
+
+    return fit_mlp(dataset.X, dataset.y, steps=100, seed=0,
+                   tc=TrainConfig(compute_dtype="float32"))
+
+
+def _degraded(params):
+    """Challenger whose ranking is exactly inverted: negate the output
+    layer, so proba' = 1 - proba and the AUC flips — the label-flip
+    injection's effect without a second training run."""
+    p = jax.tree.map(np.asarray, params)
+    p = {"norm": p["norm"], "layers": [dict(l) for l in p["layers"]]}
+    p["layers"][-1] = {
+        "w": -p["layers"][-1]["w"], "b": -p["layers"][-1]["b"]}
+    return p
+
+
+def _improved(params, bias=0.01):
+    """Challenger with identical ranking (monotone logit shift): passes
+    every gate while still producing measurably different scores."""
+    p = jax.tree.map(np.asarray, params)
+    p = {"norm": p["norm"], "layers": [dict(l) for l in p["layers"]]}
+    p["layers"][-1] = {
+        "w": p["layers"][-1]["w"],
+        "b": p["layers"][-1]["b"] + np.float32(bias),
+    }
+    return p
+
+
+def _make_scorer(params):
+    return Scorer(model_name="mlp", params=params,
+                  batch_sizes=(16, 128, 1024, 4096),
+                  compute_dtype="float32")
+
+
+def _mk_stack(tmp_path, scorer, guardrails=None, breaker=None,
+              persist=True):
+    cfg = Config()
+    broker = Broker()
+    reg = Registry()
+    store = VersionStore(
+        str(tmp_path / "versions.json") if persist else None)
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), keep=8)
+    shadow = ShadowTap(scorer, broker, cfg.shadow_topic, reg)
+    ev = ShadowEvaluator(cfg, broker, scorer, reg)
+    g = guardrails or Guardrails(
+        min_labels=32, min_shadow_rows=256, canary_min_labels=16,
+        max_score_psi=5.0, min_submit_interval_s=0.0)
+    ctl = LifecycleController(
+        cfg, scorer, store=store, checkpoints=ckpt, shadow=shadow,
+        evaluator=ev, guardrails=g, registry=reg, breaker=breaker)
+    return cfg, broker, reg, store, shadow, ev, ctl
+
+
+def _pump(cfg, broker, shadow, ctl, served, X, y, batches=6,
+          labels_per_batch=16, seed=0, with_labels=True):
+    rng = np.random.default_rng(seed)
+    for _ in range(batches):
+        idx = rng.integers(0, len(X), size=256)
+        served(X[idx])
+        shadow.step()
+        if with_labels:
+            lidx = rng.integers(0, len(X), size=labels_per_batch)
+            for j in lidx:
+                broker.produce(cfg.labels_topic, {
+                    "transaction": dict(
+                        zip(FEATURE_NAMES, map(float, X[j]))),
+                    "label": int(y[j]),
+                })
+        ctl.step()
+
+
+# -- versions.py -------------------------------------------------------------
+
+def test_version_store_persists_lineage_and_audit(tmp_path):
+    path = str(tmp_path / "versions.json")
+    store = VersionStore(path)
+    v1 = store.create(parent=None, label_watermark=10, checkpoint_step=1)
+    store.set_stage(v1.version, "CHAMPION", reason="bootstrap")
+    v2 = store.create(parent=v1.version, label_watermark=25)
+    store.set_stage(v2.version, "SHADOW")
+    store.set_stage(v2.version, "REJECTED", reason="auc",
+                    metrics={"auc_challenger": 0.4})
+
+    reopened = VersionStore(path)
+    assert [v.version for v in reopened.versions()] == [1, 2]
+    assert reopened.champion().version == 1
+    assert reopened.get(2).stage == "REJECTED"
+    assert reopened.get(2).metrics["auc_challenger"] == 0.4
+    assert reopened.get(2).parent == 1
+    # monotone counter survives restart
+    v3 = reopened.create(parent=1)
+    assert v3.version == 3
+    events = [e["event"] for e in reopened.audit_trail(2)]
+    assert events == ["created", "stage", "stage"]
+    transitions = [e["detail"].get("to") for e in reopened.audit_trail(2)
+                   if e["event"] == "stage"]
+    assert transitions == ["SHADOW", "REJECTED"]
+    # lineage walks parents newest-first
+    assert [v.version for v in reopened.lineage(3)] == [3, 1]
+
+
+def test_version_store_rejects_unknown_stage(tmp_path):
+    store = VersionStore(None)
+    v = store.create(parent=None)
+    with pytest.raises(ValueError):
+        store.set_stage(v.version, "LIMBO")
+
+
+def test_model_version_roundtrip():
+    v = ModelVersion(version=4, parent=2, stage="CANARY",
+                     label_watermark=99, checkpoint_step=4,
+                     created_at=1.5, metrics={"auc_challenger": 0.9})
+    assert ModelVersion.from_dict(v.to_dict()) == v
+
+
+# -- scorer challenger slot --------------------------------------------------
+
+def test_scorer_challenger_slot(champion_params, dataset):
+    scorer = _make_scorer(champion_params)
+    x = dataset.X[:64]
+    with pytest.raises(RuntimeError):
+        scorer.challenger_score(x)
+    assert scorer.challenger_version is None
+    scorer.install_challenger(7, _degraded(champion_params))
+    assert scorer.challenger_version == 7
+    champ = scorer.host_score(x)
+    chall = scorer.challenger_score(x)
+    np.testing.assert_allclose(chall, 1.0 - champ, atol=1e-5)
+    # champion serving path is untouched by the slot
+    np.testing.assert_allclose(scorer.score(x), champ, atol=1e-4)
+    # versioned clear: a stale clear must not evict a newer candidate
+    scorer.clear_challenger(version=3)
+    assert scorer.challenger_version == 7
+    scorer.clear_challenger(version=7)
+    assert scorer.challenger_version is None
+
+
+# -- shadow tap --------------------------------------------------------------
+
+def test_shadow_tap_produces_pairs_only_when_armed(champion_params, dataset):
+    cfg = Config()
+    broker = Broker()
+    reg = Registry()
+    scorer = _make_scorer(champion_params)
+    tap = ShadowTap(scorer, broker, cfg.shadow_topic, reg)
+    served = tap.wrap(scorer.host_score)
+    consumer = broker.consumer("t", (cfg.shadow_topic,))
+
+    x = dataset.X[:128]
+    served(x)          # not armed: nothing queued
+    assert tap.qsize() == 0 and tap.step() == 0
+
+    scorer.install_challenger(2, _degraded(champion_params))
+    tap.arm(2)
+    proba = served(x)  # hot-path result is the champion's, tap or not
+    np.testing.assert_allclose(proba, scorer.host_score(x), atol=1e-6)
+    assert tap.step() == 128
+    recs = consumer.poll(10, 0.0)
+    assert len(recs) == 1
+    msg = recs[0].value
+    assert msg["version"] == 2
+    np.testing.assert_allclose(
+        np.asarray(msg["challenger"]),
+        1.0 - np.asarray(msg["champion"]), atol=1e-5)
+    assert reg.counter("ccfd_lifecycle_shadow_rows_total").value() == 128
+
+    tap.disarm()
+    served(x)
+    assert tap.qsize() == 0
+
+
+def test_shadow_tap_bounded_queue_drops_oldest(champion_params, dataset):
+    cfg = Config()
+    reg = Registry()
+    scorer = _make_scorer(champion_params)
+    scorer.install_challenger(1, _degraded(champion_params))
+    tap = ShadowTap(scorer, Broker(), cfg.shadow_topic, reg,
+                    max_queued_batches=4)
+    served = tap.wrap(scorer.host_score)
+    tap.arm(1)
+    for _ in range(10):
+        served(dataset.X[:8])
+    assert tap.qsize() == 4
+    # dropped counts ROWS (same unit as shadow_rows_total): 6 batches x 8
+    assert reg.counter("ccfd_lifecycle_shadow_dropped_total").value() == 48
+
+
+# -- evaluator ---------------------------------------------------------------
+
+def test_auc_and_precision_primitives():
+    y = np.array([0, 0, 1, 1], np.float64)
+    p_perfect = np.array([0.1, 0.2, 0.8, 0.9])
+    p_inverted = 1.0 - p_perfect
+    assert auc_score(y, p_perfect) == 1.0
+    assert auc_score(y, p_inverted) == 0.0
+    assert auc_score(y, np.full(4, 0.5)) == 0.5  # ties average to chance
+    assert np.isnan(auc_score(np.zeros(4), p_perfect))  # one class only
+    assert precision_at_k(y, p_perfect, 2) == 1.0
+    assert precision_at_k(y, p_inverted, 2) == 0.0
+
+
+def test_evaluator_joins_labels_and_shadow(champion_params, dataset):
+    cfg = Config()
+    broker = Broker()
+    scorer = _make_scorer(champion_params)
+    scorer.install_challenger(3, _degraded(champion_params))
+    ev = ShadowEvaluator(cfg, broker, scorer, Registry())
+    ev.begin(3)
+    champ = scorer.host_score(dataset.X[:512])
+    broker.produce(cfg.shadow_topic, {
+        "version": 3, "champion": champ.tolist(),
+        "challenger": (1.0 - champ).tolist()})
+    broker.produce(cfg.shadow_topic, {  # stale version: must be ignored
+        "version": 99, "champion": [0.9] * 8, "challenger": [0.9] * 8})
+    for i in range(64):
+        broker.produce(cfg.labels_topic, {
+            "transaction": dict(
+                zip(FEATURE_NAMES, map(float, dataset.X[i]))),
+            "label": int(dataset.y[i])})
+    ev.poll()
+    snap = ev.snapshot()
+    assert snap.version == 3
+    assert snap.n_labels == 64
+    assert snap.n_shadow_rows == 512
+    # trained champion ranks well; the inverted challenger is its mirror
+    assert snap.auc_champion > 0.9
+    assert abs(snap.auc_challenger - (1.0 - snap.auc_champion)) < 1e-9
+    assert np.isfinite(snap.score_psi) and snap.score_psi > 0.0
+    assert snap.alert_rate_delta == pytest.approx(
+        snap.alert_rate_challenger - snap.alert_rate_champion)
+    ev.close()
+
+
+# -- canary gate -------------------------------------------------------------
+
+def test_canary_gate_blends_deterministic_split(champion_params, dataset):
+    from ccfd_tpu.serving.graph import hash_split_arms_numpy
+
+    scorer = _make_scorer(champion_params)
+    scorer.install_challenger(5, _improved(champion_params, bias=2.0))
+    reg = Registry()
+    gate = CanaryGate(scorer, reg)
+    served = gate.wrap(scorer.host_score)
+    x = dataset.X[:512]
+    champ = scorer.host_score(x)
+
+    np.testing.assert_allclose(served(x), champ, atol=1e-6)  # inactive
+
+    gate.activate(0.25)
+    out = served(x)
+    arms = hash_split_arms_numpy(x, gate.weights)
+    assert 0 < arms.sum() < len(x)  # both arms in play
+    np.testing.assert_allclose(out[arms == 0], champ[arms == 0], atol=1e-6)
+    np.testing.assert_allclose(
+        out[arms == 1], scorer.challenger_score(x[arms == 1]), atol=1e-6)
+    c = reg.counter("ccfd_lifecycle_canary_rows_total")
+    assert c.value(labels={"arm": "champion"}) == (arms == 0).sum()
+    assert c.value(labels={"arm": "challenger"}) == (arms == 1).sum()
+
+    gate.deactivate()
+    np.testing.assert_allclose(served(x), champ, atol=1e-6)
+
+
+# -- controller state machine ------------------------------------------------
+
+def test_controller_rejects_degraded_challenger_in_shadow(
+        tmp_path, champion_params, dataset):
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    served = ctl.wrap_score(scorer.host_score)
+    before = scorer.score(dataset.X[:64]).copy()
+
+    v = ctl.submit_candidate(_degraded(champion_params), label_watermark=40)
+    assert ctl.stage == STAGE_SHADOW
+    assert scorer.challenger_version == v
+    assert store.get(v).label_watermark == 40
+    _pump(cfg, broker, shadow, ctl, served, dataset.X, dataset.y, batches=8)
+
+    assert store.get(v).stage == "REJECTED"
+    assert ctl.stage == STAGE_IDLE
+    assert scorer.challenger_version is None
+    assert not ctl.gate.active
+    assert reg.counter("ccfd_lifecycle_rejections_total").value() == 1
+    assert reg.counter("ccfd_lifecycle_promotions_total").value() == 0
+    # champion serving never touched
+    np.testing.assert_allclose(scorer.score(dataset.X[:64]), before,
+                               atol=1e-5)
+    rec = store.get(v)
+    assert "auc" in " ".join(
+        e["detail"].get("reason", "") for e in store.audit_trail(v))
+    assert rec.metrics["n_labels"] >= 32
+    ctl.close()
+
+
+def test_controller_promotes_through_canary(tmp_path, champion_params,
+                                            dataset):
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    served = ctl.wrap_score(scorer.host_score)
+    genesis = ctl.champion
+    improved = _improved(champion_params)
+
+    v = ctl.submit_candidate(improved, label_watermark=80)
+    saw_canary = False
+    rng = np.random.default_rng(1)
+    for _ in range(24):
+        idx = rng.integers(0, len(dataset.X), size=256)
+        served(dataset.X[idx])
+        shadow.step()
+        for j in rng.integers(0, len(dataset.X), size=16):
+            broker.produce(cfg.labels_topic, {
+                "transaction": dict(
+                    zip(FEATURE_NAMES, map(float, dataset.X[j]))),
+                "label": int(dataset.y[j])})
+        ctl.step()
+        if ctl.stage == STAGE_CANARY:
+            saw_canary = True
+            assert ctl.gate.active
+            assert store.get(v).stage == "CANARY"
+        if ctl.stage == STAGE_IDLE and store.get(v).stage == "CHAMPION":
+            break
+    assert saw_canary, "candidate must pass through CANARY before promote"
+    assert store.get(v).stage == "CHAMPION"
+    assert store.get(genesis).stage == "RETIRED"
+    assert ctl.champion == v
+    assert store.champion().version == v
+    assert reg.counter("ccfd_lifecycle_promotions_total").value() == 1
+    assert reg.gauge("ccfd_lifecycle_champion_version").value() == v
+    # serving now runs the challenger's params
+    expected = Scorer(model_name="mlp", params=improved,
+                      batch_sizes=(16, 128, 1024, 4096),
+                      compute_dtype="float32").score(dataset.X[:64])
+    np.testing.assert_allclose(scorer.score(dataset.X[:64]), expected,
+                               atol=1e-4)
+    assert ctl.serving_consistent()
+    # canary rows flowed through both arms while the gate was up
+    c = reg.counter("ccfd_lifecycle_canary_rows_total")
+    assert c.value(labels={"arm": "challenger"}) > 0
+    ctl.close()
+
+
+def _drive_to_canary(cfg, broker, shadow, ctl, served, X, y, seed=2):
+    rng = np.random.default_rng(seed)
+    for _ in range(24):
+        idx = rng.integers(0, len(X), size=256)
+        served(X[idx])
+        shadow.step()
+        if ctl.stage == STAGE_SHADOW:
+            for j in rng.integers(0, len(X), size=16):
+                broker.produce(cfg.labels_topic, {
+                    "transaction": dict(zip(FEATURE_NAMES, map(float, X[j]))),
+                    "label": int(y[j])})
+        ctl.step()
+        if ctl.stage == STAGE_CANARY:
+            return
+    raise AssertionError("candidate never reached CANARY")
+
+
+def test_controller_rolls_back_on_canary_guardrail_breach(
+        tmp_path, champion_params, dataset):
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    served = ctl.wrap_score(scorer.host_score)
+    before = scorer.score(dataset.X[:64]).copy()
+
+    v = ctl.submit_candidate(_improved(champion_params), label_watermark=10)
+    _drive_to_canary(cfg, broker, shadow, ctl, served, dataset.X, dataset.y)
+
+    # mid-canary regression: the challenger starts alerting on everything
+    # (injected as shadow evidence, the stream the guardrails watch)
+    for _ in range(12):
+        broker.produce(cfg.shadow_topic, {
+            "version": v,
+            "champion": [0.05] * 256,
+            "challenger": [0.99] * 256,
+        })
+    ctl.step()
+
+    assert store.get(v).stage == "ROLLED_BACK"
+    assert ctl.stage == STAGE_IDLE
+    assert not ctl.gate.active
+    assert scorer.challenger_version is None
+    assert reg.counter("ccfd_lifecycle_rollbacks_total").value() == 1
+    # serving restored to the champion checkpoint
+    np.testing.assert_allclose(scorer.score(dataset.X[:64]), before,
+                               atol=1e-4)
+    events = store.audit_trail()
+    assert any(e["event"] == "rollback_restore" for e in events)
+    assert ctl.serving_consistent()
+    ctl.close()
+
+
+def test_controller_rolls_back_on_breaker_open(tmp_path, champion_params,
+                                               dataset):
+    class StubBreaker:
+        state = "closed"
+
+    breaker = StubBreaker()
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(
+        tmp_path, scorer, breaker=breaker)
+    served = ctl.wrap_score(scorer.host_score)
+
+    v = ctl.submit_candidate(_improved(champion_params))
+    _drive_to_canary(cfg, broker, shadow, ctl, served, dataset.X, dataset.y)
+    breaker.state = "open"
+    ctl.step()
+    assert store.get(v).stage == "ROLLED_BACK"
+    assert "breaker" in " ".join(
+        e["detail"].get("reason", "") for e in store.audit_trail(v))
+    assert reg.counter("ccfd_lifecycle_rollbacks_total").value() == 1
+    ctl.close()
+
+
+def test_new_candidate_supersedes_inflight_one(tmp_path, champion_params,
+                                               dataset):
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    v1 = ctl.submit_candidate(_improved(champion_params, bias=0.01))
+    v2 = ctl.submit_candidate(_improved(champion_params, bias=0.02))
+    assert store.get(v1).stage == "SUPERSEDED"
+    assert store.get(v2).stage == "SHADOW"
+    assert scorer.challenger_version == v2
+    assert shadow.armed_version == v2
+    ctl.close()
+
+
+def test_submit_pacing_coalesces_fast_retrains(tmp_path, champion_params,
+                                               dataset):
+    """A trainer retraining faster than the verdict window must not
+    supersede every candidate before judgment (governed-rollout livelock):
+    submissions inside min_submit_interval_s coalesce into the in-flight
+    one."""
+    scorer = _make_scorer(champion_params)
+    g = Guardrails(min_labels=32, min_shadow_rows=256, canary_min_labels=16,
+                   max_score_psi=5.0, min_submit_interval_s=60.0)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(
+        tmp_path, scorer, guardrails=g)
+    v1 = ctl.submit_candidate(_improved(champion_params, bias=0.01))
+    v_again = ctl.submit_candidate(_improved(champion_params, bias=0.02))
+    assert v_again == v1  # coalesced, not superseded
+    assert store.get(v1).stage == "SHADOW"
+    assert len(store.versions()) == 2  # genesis + the one candidate
+    assert reg.counter(
+        "ccfd_lifecycle_submissions_coalesced_total").value() == 1
+    ctl.close()
+
+
+def test_controller_restart_resumes_lineage(tmp_path, champion_params,
+                                            dataset):
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    genesis = ctl.champion
+    ctl.submit_candidate(_improved(champion_params))
+    ctl.close()
+
+    # a fresh controller on the same store: same champion, the interrupted
+    # SHADOW candidate stamped rolled back, and new ids stay monotone
+    scorer2 = _make_scorer(champion_params)
+    store2 = VersionStore(str(tmp_path / "versions.json"))
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), keep=8)
+    shadow2 = ShadowTap(scorer2, broker, cfg.shadow_topic, Registry())
+    ev2 = ShadowEvaluator(cfg, broker, scorer2, Registry())
+    ctl2 = LifecycleController(cfg, scorer2, store=store2, checkpoints=ckpt,
+                               shadow=shadow2, evaluator=ev2,
+                               registry=Registry())
+    assert ctl2.champion == genesis
+    assert store2.in_stage("SHADOW") == []
+    v3 = ctl2.submit_candidate(_improved(champion_params))
+    assert v3 == 3  # genesis=1, interrupted=2
+    ctl2.close()
+
+
+def test_restart_reasserts_promoted_champion_into_serving(
+        tmp_path, champion_params, dataset):
+    """A restarted controller must swap the persisted champion's params
+    into the freshly-built scorer — otherwise the audit trail says vN
+    serves while the boot params actually score."""
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    served = ctl.wrap_score(scorer.host_score)
+    improved = _improved(champion_params, bias=0.5)
+    v = ctl.submit_candidate(improved)
+    rng = np.random.default_rng(3)
+    for _ in range(24):
+        served(dataset.X[rng.integers(0, len(dataset.X), size=256)])
+        shadow.step()
+        for j in rng.integers(0, len(dataset.X), size=16):
+            broker.produce(cfg.labels_topic, {
+                "transaction": dict(
+                    zip(FEATURE_NAMES, map(float, dataset.X[j]))),
+                "label": int(dataset.y[j])})
+        ctl.step()
+        if store.get(v).stage == "CHAMPION":
+            break
+    assert store.get(v).stage == "CHAMPION"
+    promoted_scores = scorer.score(dataset.X[:64]).copy()
+    ctl.close()
+
+    # "restart": a new scorer from the ORIGINAL boot params + a new
+    # controller on the persisted lineage
+    scorer2 = _make_scorer(champion_params)
+    boot_scores = scorer2.score(dataset.X[:64]).copy()
+    assert not np.allclose(boot_scores, promoted_scores, atol=1e-5)
+    ctl2 = LifecycleController(
+        cfg, scorer2,
+        store=VersionStore(str(tmp_path / "versions.json")),
+        checkpoints=CheckpointManager(str(tmp_path / "ckpt"), keep=8),
+        shadow=ShadowTap(scorer2, broker, cfg.shadow_topic, Registry()),
+        evaluator=ShadowEvaluator(cfg, broker, scorer2, Registry()),
+        registry=Registry())
+    assert ctl2.champion == v
+    np.testing.assert_allclose(scorer2.score(dataset.X[:64]),
+                               promoted_scores, atol=1e-4)
+    ctl2.close()
+
+
+def test_evaluator_window_isolates_canary_evidence(champion_params, dataset):
+    """snapshot_window() judges only post-mark evidence: a regression
+    injected after mark() must not be diluted by the history before it."""
+    cfg = Config()
+    broker = Broker()
+    scorer = _make_scorer(champion_params)
+    scorer.install_challenger(4, _improved(champion_params))
+    ev = ShadowEvaluator(cfg, broker, scorer, Registry())
+    ev.begin(4)
+    # long green history: identical champion/challenger scores
+    for _ in range(20):
+        broker.produce(cfg.shadow_topic, {
+            "version": 4, "champion": [0.1] * 256,
+            "challenger": [0.1] * 256})
+    ev.poll()
+    ev.mark()
+    # post-mark regression: challenger alerts on everything
+    for _ in range(2):
+        broker.produce(cfg.shadow_topic, {
+            "version": 4, "champion": [0.1] * 256,
+            "challenger": [0.9] * 256})
+    ev.poll()
+    full = ev.snapshot()
+    window = ev.snapshot_window()
+    assert window.n_shadow_rows == 512
+    assert window.alert_rate_delta == pytest.approx(1.0)
+    # the cumulative view dilutes the same regression below 0.1
+    assert full.alert_rate_delta < 0.1 < window.alert_rate_delta
+    ev.close()
+
+
+def test_version_store_quarantines_corrupt_file(tmp_path):
+    """A truncated/corrupt lineage file must not brick bring-up: it is
+    quarantined and a fresh lineage starts."""
+    path = str(tmp_path / "versions.json")
+    store = VersionStore(path)
+    store.create(parent=None)
+    with open(path, "w") as f:
+        f.write('{"versions": [')  # torn write
+    fresh = VersionStore(path)
+    assert fresh.versions() == []
+    v = fresh.create(parent=None)
+    assert v.version == 1
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_evaluator_bounds_label_accumulators(champion_params, dataset):
+    cfg = Config()
+    broker = Broker()
+    scorer = _make_scorer(champion_params)
+    scorer.install_challenger(1, _improved(champion_params))
+    ev = ShadowEvaluator(cfg, broker, scorer, Registry(), max_labels=50)
+    ev.begin(1)
+    for _ in range(4):
+        for i in range(20):
+            broker.produce(cfg.labels_topic, {
+                "transaction": dict(
+                    zip(FEATURE_NAMES, map(float, dataset.X[i]))),
+                "label": int(dataset.y[i])})
+        ev.poll()
+    assert ev.n_labels == 50  # oldest aged out
+    assert len(ev._p_champ) == len(ev._p_chall) == 50  # pairing intact
+    ev.close()
+
+
+def test_version_store_readonly_open_reports_without_quarantine(tmp_path):
+    path = str(tmp_path / "versions.json")
+    with open(path, "w") as f:
+        f.write('{"versions": [')
+    with pytest.raises(ValueError):
+        VersionStore(path, recover=False)
+    # the inspection path must not move the live file
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".corrupt")
+
+
+def test_version_store_bounds_terminal_versions(tmp_path):
+    store = VersionStore(str(tmp_path / "v.json"), max_versions=5)
+    keep = store.create(parent=None)
+    store.set_stage(keep.version, "CHAMPION")
+    for _ in range(10):
+        v = store.create(parent=keep.version)
+        store.set_stage(v.version, "REJECTED")
+    assert len(store.versions()) <= 6  # cap + the never-evicted champion
+    assert store.champion().version == keep.version  # champion survives
+    assert any(e["event"] == "versions_trimmed"
+               for e in store.audit_trail())
+
+
+def test_checkpoint_pin_survives_gc(tmp_path):
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    mgr.pinned = {1}
+    for step in range(1, 6):
+        mgr.save(step, {"w": np.ones(3) * step})
+    # newest 2 kept by the window, step 1 kept by the pin
+    restored = mgr.restore({"w": np.zeros(3)}, step=1)
+    assert restored is not None
+    np.testing.assert_array_equal(restored[0]["w"], np.ones(3))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore({"w": np.zeros(3)}, step=2)
+
+
+def test_champion_checkpoint_pinned_through_candidate_churn(
+        tmp_path, champion_params, dataset):
+    """A stream of rejected/superseded candidates must not GC the
+    champion's checkpoint — it is the rollback/restart anchor."""
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    ckpt = ctl.checkpoints
+    ckpt.keep = 2  # tight window: churn would evict an unpinned champion
+    genesis = ctl.champion
+    for i in range(5):
+        ctl.submit_candidate(_improved(champion_params, bias=0.01 * (i + 1)))
+    assert ckpt.pinned == {genesis}
+    like = jax.tree.map(np.asarray, champion_params)
+    assert ctl.checkpoints.restore(like, step=genesis) is not None
+    ctl.close()
+
+
+def test_version_store_bounds_audit_trail(tmp_path):
+    store = VersionStore(str(tmp_path / "v.json"), max_audit_events=10)
+    v = store.create(parent=None)
+    for i in range(30):
+        store.record_event(v.version, "tick", {"i": i})
+    trail = store.audit_trail()
+    assert len(trail) <= 11  # bound + the one-time truncation marker
+    assert trail[0]["event"] == "audit_trimmed"
+    assert trail[-1]["detail"]["i"] == 29  # newest survive
+
+
+def test_resolve_for_shutdown_withdraws_inflight(tmp_path, champion_params,
+                                                 dataset):
+    """Quiesce vocabulary: a shadow-only candidate is SUPERSEDED (it never
+    changed serving — no rollback counter, no champion swap); only a
+    mid-canary candidate takes the full ROLLED_BACK path."""
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    v = ctl.submit_candidate(_improved(champion_params))
+    ctl.resolve_for_shutdown()
+    assert store.get(v).stage == "SUPERSEDED"
+    assert reg.counter("ccfd_lifecycle_rollbacks_total").value() == 0
+    assert ctl.serving_consistent()
+    ctl.resolve_for_shutdown()  # idempotent with nothing in flight
+
+    v2 = ctl.submit_candidate(_improved(champion_params, bias=0.02))
+    served = ctl.wrap_score(scorer.host_score)
+    _drive_to_canary(cfg, broker, shadow, ctl, served, dataset.X, dataset.y)
+    ctl.resolve_for_shutdown()
+    assert store.get(v2).stage == "ROLLED_BACK"
+    assert reg.counter("ccfd_lifecycle_rollbacks_total").value() == 1
+    assert ctl.serving_consistent()
+    ctl.close()
+
+
+def test_reject_rebases_trainer_on_champion(tmp_path, champion_params,
+                                            dataset):
+    """After a REJECT the trainer's state re-bases onto the champion, so
+    the next candidate descends from its recorded parent instead of the
+    discarded weights."""
+    from ccfd_tpu.parallel.online import OnlineTrainer
+    from ccfd_tpu.parallel.train import TrainConfig
+
+    scorer = _make_scorer(champion_params)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_stack(tmp_path, scorer)
+    trainer = OnlineTrainer(cfg, broker, scorer, champion_params,
+                            tc=TrainConfig(compute_dtype="float32"),
+                            steps_per_round=1, seed=0, lifecycle=ctl)
+    ctl.trainer_rebase = trainer.rebase
+    served = ctl.wrap_score(scorer.host_score)
+    # poison the trainer's state away from the champion, then reject
+    trainer.rebase(_degraded(champion_params))
+    assert trainer.step() is False  # applies the staged rebase, no labels
+    ctl.submit_candidate(_degraded(champion_params))
+    _pump(cfg, broker, shadow, ctl, served, dataset.X, dataset.y, batches=8)
+    assert store.in_stage("REJECTED")
+    # the controller's hook staged a champion rebase; the next trainer
+    # step applies it before training
+    assert trainer._rebase_params is not None
+    assert trainer.step() is False
+    got = jax.tree.leaves(jax.tree.map(np.asarray,
+                                       trainer._state["params"]))
+    want = jax.tree.leaves(jax.tree.map(np.asarray, champion_params))
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    trainer.close()
+    ctl.close()
+
+
+# -- trainer handoff + seeded RNG satellite ----------------------------------
+
+def _feed_labels(cfg, broker, X, y, n):
+    for i in range(n):
+        broker.produce(cfg.labels_topic, {
+            "transaction": dict(zip(FEATURE_NAMES, map(float, X[i]))),
+            "label": int(y[i])})
+
+
+def test_trainer_hands_candidates_to_lifecycle(champion_params, dataset):
+    from ccfd_tpu.parallel.online import OnlineTrainer
+    from ccfd_tpu.parallel.train import TrainConfig
+
+    class StubLifecycle:
+        def __init__(self):
+            self.submissions = []
+
+        def submit_candidate(self, params, label_watermark=0):
+            self.submissions.append(
+                (jax.tree.map(np.asarray, params), label_watermark))
+            return len(self.submissions)
+
+    cfg = Config(retrain_min_labels=8, retrain_batch=32)
+    broker = Broker()
+    scorer = _make_scorer(champion_params)
+    before = scorer.score(dataset.X[:32]).copy()
+    lc = StubLifecycle()
+    trainer = OnlineTrainer(cfg, broker, scorer, scorer.params,
+                            tc=TrainConfig(compute_dtype="float32"),
+                            steps_per_round=2, seed=0, lifecycle=lc)
+    _feed_labels(cfg, broker, dataset.X, dataset.y, 16)
+    assert trainer.step() is True
+    assert len(lc.submissions) == 1
+    assert lc.submissions[0][1] == 16  # label watermark rides along
+    # governed mode: NO direct swap — serving untouched until promotion
+    np.testing.assert_allclose(scorer.score(dataset.X[:32]), before,
+                               atol=1e-5)
+    assert trainer.registry.counter(
+        "retrain_param_swaps_total").value() == 0
+    trainer.close()
+
+
+def test_trainer_rng_seeded_reproducible_and_injectable(dataset):
+    from ccfd_tpu.parallel.online import OnlineTrainer
+    from ccfd_tpu.parallel.train import TrainConfig
+
+    cfg = Config(retrain_min_labels=8, retrain_batch=32)
+
+    def run_once(rng=None):
+        broker = Broker()
+        scorer = _make_scorer(None)
+        trainer = OnlineTrainer(cfg, broker, scorer, scorer.params,
+                                tc=TrainConfig(compute_dtype="float32"),
+                                steps_per_round=2, seed=7, rng=rng)
+        _feed_labels(cfg, broker, dataset.X, dataset.y, 16)
+        assert trainer.step() is True
+        leaves = jax.tree.leaves(
+            jax.tree.map(np.asarray, trainer._state["params"]))
+        trainer.close()
+        return leaves
+
+    a, b = run_once(), run_once()
+    for la, lb in zip(a, b):
+        np.testing.assert_array_equal(la, lb)
+    # an injected generator is honored (different stream -> different params)
+    c = run_once(rng=np.random.default_rng(123456))
+    assert any(not np.array_equal(la, lc) for la, lc in zip(a, c))
+
+
+def test_trainer_reset_reseeds_sampling_stream(dataset):
+    from ccfd_tpu.parallel.online import OnlineTrainer
+    from ccfd_tpu.parallel.train import TrainConfig
+
+    cfg = Config(retrain_min_labels=8, retrain_batch=32)
+    broker = Broker()
+    scorer = _make_scorer(None)
+    trainer = OnlineTrainer(cfg, broker, scorer, scorer.params,
+                            tc=TrainConfig(compute_dtype="float32"),
+                            steps_per_round=1, seed=9)
+    first = trainer._rng.integers(0, 1 << 30, size=8)
+    trainer.stop()
+    trainer.reset()  # the supervisor's respawn hook
+    replay = trainer._rng.integers(0, 1 << 30, size=8)
+    np.testing.assert_array_equal(first, replay)
+    trainer.close()
+
+
+# -- operator wiring ---------------------------------------------------------
+
+def test_operator_wires_lifecycle_component(tmp_path, dataset):
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "store": {"enabled": False},
+        "bus": {"partitions": 2},
+        "scorer": {"enabled": True, "model": "mlp", "dtype": "float32"},
+        "engine": {"enabled": True},
+        "notify": {"enabled": False},
+        "router": {"enabled": True},
+        "retrain": {"enabled": True},
+        "analytics": {"enabled": False},
+        "monitoring": {"enabled": True, "port": 0},
+        "health": {"enabled": False},
+        "lifecycle": {
+            "state_dir": str(tmp_path / "lifecycle"),
+            "min_labels": 8, "min_shadow_rows": 64,
+        },
+    }}
+    spec = PlatformSpec.from_cr(cr, cfg=Config())
+    platform = Platform(spec).up(wait_ready_s=30.0)
+    try:
+        assert platform.lifecycle is not None
+        status = platform.supervisor.status()
+        assert "lifecycle" in status and "lifecycle-shadow" in status
+        # the router's score lane is the lifecycle-wrapped one
+        assert hasattr(platform.router.score, "__wrapped__")
+        # breaker shared between the router ladder and the controller
+        assert platform.lifecycle.breaker is platform.router._breaker
+        # lineage bootstrap persisted a genesis champion
+        assert platform.lifecycle.store.champion() is not None
+        assert os.path.exists(str(tmp_path / "lifecycle" / "versions.json"))
+        # the lifecycle registry rides the scraped exporter
+        body = platform.exporter.render_path("/metrics")
+        assert "ccfd_lifecycle_stage" in body
+        assert "ccfd_lifecycle_promotions_total" in body
+        assert "ccfd_lifecycle_rollbacks_total" in body
+    finally:
+        platform.down()
+
+
+def test_operator_retrain_direct_swap_opts_out(tmp_path):
+    """retrain.direct_swap keeps the legacy unvalidated hot swap."""
+    from ccfd_tpu.platform.operator import PlatformSpec
+
+    cr = {"spec": {"retrain": {"direct_swap": True}}}
+    spec = PlatformSpec.from_cr(cr, cfg=Config())
+    assert spec.component("retrain").opt("direct_swap") is True
+    assert spec.component("lifecycle").enabled  # default-on component

@@ -59,6 +59,19 @@ REFERENCE_CONTRACT_METRICS = [
     "router_coalesced_rows_total",
     "ccfd_process_rss_bytes",
     "ccfd_component_objects",
+    # round 9: model lifecycle — shadow/canary/promotion surface
+    # (lifecycle/controller.py, lifecycle/shadow.py, lifecycle/evaluator.py)
+    "ccfd_lifecycle_stage",
+    "ccfd_lifecycle_promotions_total",
+    "ccfd_lifecycle_rollbacks_total",
+    "ccfd_lifecycle_rejections_total",
+    "ccfd_lifecycle_candidates_total",
+    "ccfd_lifecycle_shadow_rows_total",
+    "ccfd_lifecycle_shadow_dropped_total",
+    "ccfd_lifecycle_auc",
+    "ccfd_lifecycle_score_psi",
+    "ccfd_lifecycle_alert_rate_delta",
+    "ccfd_lifecycle_canary_rows_total",
 ]
 
 
@@ -76,6 +89,7 @@ def test_dashboards_cover_contract_metrics():
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
+        "ModelLifecycle",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -153,7 +167,7 @@ def test_seldon_board_carries_dispatch_health():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 10
+    assert len(paths) == 11
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")

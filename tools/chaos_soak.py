@@ -285,6 +285,13 @@ def main() -> int:
                     "pause barrier, shared in-flight budget, coalesced "
                     "dispatch — under the same kills; 1 = the historical "
                     "single router")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="run the model-lifecycle controller (lifecycle/) "
+                    "under the storm: candidates cycle through shadow/"
+                    "canary/promotion while services are killed; asserts "
+                    "the pool ends on a single consistent model version")
+    ap.add_argument("--lifecycle-submit-s", type=float, default=15.0,
+                    help="seconds between candidate submissions")
     args = ap.parse_args()
 
     bus_dir = args.bus_log or tempfile.mkdtemp(prefix="ccfd_soak_bus_")
@@ -367,6 +374,55 @@ def main() -> int:
             score_fn = net_injector.wrap_fn(scorer.score)
         if scorer.has_host_forward:
             host_fn = scorer.host_score
+    # -- model lifecycle under the storm (--lifecycle) ---------------------
+    # The governed-rollout machinery (lifecycle/) runs THROUGH the kills:
+    # a submitter cycles perturbed candidates through shadow -> canary ->
+    # promotion while the router/engine/bus die and recover around it. The
+    # end-of-run assertion is the one that matters operationally: after
+    # recovery the pool serves a SINGLE consistent version (serving params
+    # == the champion's checkpoint; no challenger slot or canary gate left
+    # dangling by a mid-canary kill).
+    lifecycle = None
+    lifecycle_breaker = None
+    lifecycle_tap = None
+    lifecycle_stats = {"canary_seen": 0}
+    if args.lifecycle:
+        from ccfd_tpu.lifecycle.controller import (  # noqa: E402
+            Guardrails,
+            LifecycleController,
+        )
+        from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator  # noqa: E402
+        from ccfd_tpu.lifecycle.shadow import ShadowTap  # noqa: E402
+        from ccfd_tpu.lifecycle.versions import VersionStore  # noqa: E402
+        from ccfd_tpu.parallel.checkpoint import CheckpointManager  # noqa: E402
+        from ccfd_tpu.router.router import default_scorer_breaker  # noqa: E402
+
+        lc_dir = tempfile.mkdtemp(prefix="ccfd_soak_lifecycle_")
+        lifecycle_tap = ShadowTap(scorer, broker, cfg.shadow_topic, reg_r)
+        lifecycle_breaker = default_scorer_breaker(reg_r)
+        lifecycle = LifecycleController(
+            cfg, scorer,
+            store=VersionStore(os.path.join(lc_dir, "versions.json")),
+            # keep enough steps that the champion's checkpoint survives a
+            # storm's worth of rejected/superseded candidates saved after
+            # it (the end-of-run consistency check restores it)
+            checkpoints=CheckpointManager(
+                os.path.join(lc_dir, "checkpoints"), keep=64),
+            shadow=lifecycle_tap,
+            evaluator=ShadowEvaluator(cfg, broker, scorer, reg_r),
+            # labels come from the engine's investigation resolutions, a
+            # trickle relative to traffic: small gates so cycles complete
+            # within storm windows. Perturbed candidates rank identically,
+            # so the quality gates pass and the drill exercises the
+            # TRANSITIONS under kills, not the verdicts.
+            # min_submit_interval_s=0: the soak WANTS supersession in the
+            # mix (a mid-flight candidate replaced during a storm is one
+            # of the transitions under drill)
+            guardrails=Guardrails(
+                min_labels=16, min_shadow_rows=256, canary_min_labels=8,
+                max_score_psi=10.0, min_submit_interval_s=0.0),
+            registry=reg_r, breaker=lifecycle_breaker)
+        score_fn = lifecycle.wrap_score(score_fn)
     if args.workers > 1:
         # partition-parallel fan-out: the workers split the topic's
         # partitions, share ONE in-flight budget + breaker + coalescing
@@ -378,10 +434,12 @@ def main() -> int:
         router = ParallelRouter(
             cfg, broker, score_fn, engine, reg_r, workers=args.workers,
             max_batch=4096, host_score_fn=host_fn,
+            breaker=lifecycle_breaker,
             degrade=True if args.net_faults else None)
     else:
         router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
                         host_score_fn=host_fn,
+                        breaker=lifecycle_breaker,
                         degrade=True if args.net_faults else None)
     coord = CheckpointCoordinator(router, broker, engine_factory,
                                   interval_s=args.checkpoint_s)
@@ -409,9 +467,64 @@ def main() -> int:
         bus_booted[0] = True
 
     sup.add_thread_service("bus", bus_run, bus_stop.set, reset=bus_reset)
+    if lifecycle is not None:
+        sup.add_thread_service(
+            "lifecycle", lambda: lifecycle.run(interval_s=0.25),
+            lifecycle.stop, reset=lifecycle.reset)
+        sup.add_thread_service(
+            "lifecycle-shadow", lambda: lifecycle_tap.run(interval_s=0.05),
+            lifecycle_tap.stop, reset=lifecycle_tap.reset)
     attach_engine_service(sup, coord)
     sup.start()
     coord.start()
+
+    # candidate submitter: perturbed copies of the live champion cycle
+    # through the lifecycle while the storm rages
+    submit_stop = threading.Event()
+
+    def submit_loop() -> None:
+        rng_lc = np.random.default_rng(23)
+        fraud_rows = np.flatnonzero(ds.y == 1)
+        legit_rows = np.flatnonzero(ds.y == 0)
+        tick = max(0.5, args.lifecycle_submit_s / 8.0)
+        next_submit = time.time()
+        while not submit_stop.wait(tick):
+            try:
+                # label trickle: the evaluator's evidence stream. In the
+                # platform the fraud process emits these on resolution; the
+                # soak (whose engine bias routes almost nothing to fraud in
+                # short runs) feeds ground truth directly, both classes
+                # represented so the AUC gate gets a verdict
+                picks = np.concatenate([
+                    rng_lc.choice(legit_rows, size=6),
+                    rng_lc.choice(fraud_rows, size=2),
+                ])
+                for j in picks:
+                    broker.produce(cfg.labels_topic, {
+                        "transaction": dict(
+                            zip(FEATURE_NAMES, map(float, ds.X[j]))),
+                        "label": int(ds.y[j]),
+                    })
+                if time.time() < next_submit:
+                    continue
+                next_submit = time.time() + args.lifecycle_submit_s
+                base = jax.tree.map(np.asarray,
+                                    lifecycle._champion_params)
+                cand = {"norm": base["norm"],
+                        "layers": [dict(l) for l in base["layers"]]}
+                last = dict(cand["layers"][-1])
+                last["b"] = last["b"] + np.float32(
+                    rng_lc.normal(0.0, 0.01))
+                cand["layers"][-1] = last
+                lifecycle.submit_candidate(cand, label_watermark=0)
+            except Exception:  # noqa: BLE001 - submit races teardown
+                pass
+
+    submitter = None
+    if lifecycle is not None:
+        submitter = threading.Thread(target=submit_loop, daemon=True,
+                                     name="soak-lifecycle-submit")
+        submitter.start()
 
     # feeder: keep the topic loaded without unbounded backlog; the gate
     # lets the bus drill quiesce production without killing the thread.
@@ -555,6 +668,8 @@ def main() -> int:
         if cur > last_in:
             last_in, last_progress = cur, time.time()
         max_stall_s = max(max_stall_s, time.time() - last_progress)
+        if lifecycle is not None and lifecycle.stage == 2:
+            lifecycle_stats["canary_seen"] += 1
         if not wedge_done and time.time() >= t_wedge:
             wedge_info["wedged_at_tx"] = cur
             wedged.set()
@@ -587,6 +702,49 @@ def main() -> int:
         prev = cur
         time.sleep(1.0)
     router.pause(10.0)
+
+    # -- lifecycle consistency after recovery ------------------------------
+    lifecycle_res: dict = {}
+    if lifecycle is not None:
+        submit_stop.set()
+        if submitter is not None:
+            submitter.join(timeout=5)
+        # deterministic quiesce: a candidate still mid-flight (e.g. the
+        # last kill landed mid-canary) rolls back, then serving must equal
+        # the champion's checkpoint — ONE consistent version in the pool
+        lifecycle.resolve_for_shutdown()
+        champ = lifecycle.store.champion()
+        served = jax.tree.map(np.asarray, scorer.params)
+        try:
+            restored = lifecycle.checkpoints.restore(
+                served, step=champ.checkpoint_step)
+        except FileNotFoundError:
+            restored = None  # champion ckpt GC'd (very long soak): fail
+        params_match = restored is not None and all(
+            np.allclose(a, b, atol=1e-6)
+            for a, b in zip(jax.tree.leaves(served),
+                            jax.tree.leaves(restored[0]))
+        )
+        stages = [v.stage for v in lifecycle.store.versions()]
+        lifecycle_res = {
+            "enabled": True,
+            "champion_version": champ.version,
+            "versions": len(stages),
+            "promotions": int(reg_r.counter(
+                "ccfd_lifecycle_promotions_total").value()),
+            "rollbacks": int(reg_r.counter(
+                "ccfd_lifecycle_rollbacks_total").value()),
+            "rejections": int(reg_r.counter(
+                "ccfd_lifecycle_rejections_total").value()),
+            "canary_ticks_observed": lifecycle_stats["canary_seen"],
+            "serving_matches_champion_checkpoint": bool(params_match),
+            "serving_consistent": lifecycle.serving_consistent(),
+            # a dangling challenger slot or canary gate after quiesce
+            # would be the mid-canary-kill inconsistency this drill exists
+            # to rule out
+            "challenger_cleared": scorer.challenger_version is None,
+            "gate_inactive": not lifecycle.gate.active,
+        }
 
     total = router._c_in.value()
     final_engine = router.engine
@@ -708,6 +866,7 @@ def main() -> int:
         "bus_reopen_check": bus_check,
         "dispatch_timeouts": scorer.dispatch_timeouts,
         "host_fallback_scores": scorer.host_fallback_scores,
+        "lifecycle": lifecycle_res,
         "tasks_completed_by_investigators": investigator.completed,
         "net_faults": {
             "enabled": bool(args.net_faults),
@@ -755,6 +914,20 @@ def main() -> int:
         and ("bus" not in targets
              or (result["bus_kills"] > 0 and broker.crash_restarts > 0))
         and acct_ok
+        and (
+            not args.lifecycle
+            or (
+                # the pool ends on ONE consistent model version: serving
+                # params equal the champion checkpoint, no challenger slot
+                # or canary gate dangling, and transitions actually cycled
+                # under the storm
+                lifecycle_res.get("serving_matches_champion_checkpoint")
+                and lifecycle_res.get("serving_consistent")
+                and lifecycle_res.get("challenger_cleared")
+                and lifecycle_res.get("gate_inactive")
+                and lifecycle_res.get("versions", 0) > 1
+            )
+        )
         and (
             not args.net_faults
             or (
