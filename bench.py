@@ -138,6 +138,33 @@ def _loadgen_client() -> str:
     return _CLIENT
 
 
+def _hop_buckets(top: int) -> tuple[int, ...]:
+    """ONE bucket ladder for every per-model bench section. The sections
+    had drifted apart — rest compiled (16, 128, 1024, top), zoo/quant a
+    single (top,) bucket — so the 'same' hop ran different executable
+    sets and padding regimes on CPU vs TPU and the captures were not
+    comparable (ROADMAP item 1 / SNIPPETS PR-5 header). Every section now
+    compiles the canonical serving ladder clipped at its top size."""
+    return tuple(b for b in (16, 128, 1024, 4096) if b < top) + (int(top),)
+
+
+def _section_scorer(model, params, top, use_fused=None, host_tier_rows=0):
+    """The shared Scorer construction for the rest/zoo/quant sections:
+    same bucket ladder (:func:`_hop_buckets`), same bfloat16 compute
+    dtype, differing ONLY in what the section is isolating (fused path
+    on/off; host tier 0 for raw device-hop rates, None = auto for the
+    REST section, whose serving policy includes the host tier)."""
+    from ccfd_tpu.serving.scorer import Scorer
+
+    kw = {} if use_fused is None else {"use_fused": use_fused}
+    s = Scorer(
+        model_name=model, params=params, batch_sizes=_hop_buckets(top),
+        compute_dtype="bfloat16", host_tier_rows=host_tier_rows, **kw,
+    )
+    s.warmup()
+    return s
+
+
 def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
                 native=True):
     """HTTP clients -> PredictionServer -> DynamicBatcher -> scorer: the full
@@ -149,14 +176,10 @@ def _bench_rest(scorer_params, lat_batch, seconds, n_clients, rows_per_req,
     import numpy as np
 
     from ccfd_tpu.config import Config
-    from ccfd_tpu.serving.scorer import Scorer
     from ccfd_tpu.serving.server import PredictionServer
 
-    scorer = Scorer(
-        model_name="mlp", params=scorer_params,
-        batch_sizes=(16, 128, 1024, lat_batch), compute_dtype="bfloat16",
-    )
-    scorer.warmup()
+    scorer = _section_scorer("mlp", scorer_params, lat_batch,
+                             host_tier_rows=None)
     srv = PredictionServer(scorer, Config(dynamic_batching=True,
                                           native_front=native))
     port = srv.start(host="127.0.0.1", port=0)
@@ -503,12 +526,10 @@ def _scorer_hop_rate(name, params, x, seconds, use_fused=False):
     """Time the REAL scorer hop for one model: numpy in, probabilities on
     host out, full H2D + dispatch + D2H per call through the Scorer (host
     tier forced off so the number is the device path) — the same surface
-    the headline MLP metric measures, so the zoo ranks comparably."""
-    from ccfd_tpu.serving.scorer import Scorer
-
-    s = Scorer(model_name=name, params=params, batch_sizes=(x.shape[0],),
-               host_tier_rows=0, use_fused=use_fused)
-    s.warmup()
+    the headline MLP metric measures, so the zoo ranks comparably.
+    Built through :func:`_section_scorer`, so zoo/quant compile the SAME
+    bucket ladder the rest section serves."""
+    s = _section_scorer(name, params, x.shape[0], use_fused=use_fused)
     if use_fused and not s.fused:
         # warmup fell back (lowering failure): recording the XLA rate
         # under a fused label would corrupt the A/B this exists to settle

@@ -916,18 +916,30 @@ def cmd_router(args: argparse.Namespace) -> int:
     # parallel with shared coalesced dispatch (router/parallel.py).
     workers = (args.workers if args.workers is not None
                else cfg.router_workers)
+    # overload control (runtime/overload.py): same default-on wiring as
+    # the platform operator — adaptive AIMD in-flight budget, priority-
+    # aware shedding, dispatch watchdog (CCFD_OVERLOAD_* env knobs)
+    overload = None
+    if cfg.overload_enabled:
+        from ccfd_tpu.runtime.overload import OverloadControl
+
+        n_eff = workers if workers > 0 else max(
+            1, len(broker.end_offsets(cfg.kafka_topic)))
+        overload = OverloadControl.from_config(
+            cfg, router_registry, max_batch=4096, workers=n_eff)
     if workers == 1:
         router = Router(cfg, broker, score_fn, engine,
                         registry=router_registry,
                         host_score_fn=host_score_fn, degrade=True,
-                        tracer=tracer)
+                        tracer=tracer, overload=overload)
     else:
         from ccfd_tpu.router.parallel import ParallelRouter
 
         router = ParallelRouter(cfg, broker, score_fn, engine,
                                 registry=router_registry, workers=workers,
                                 host_score_fn=host_score_fn, degrade=True,
-                                tracer=tracer, coalesce=cfg.router_coalesce)
+                                tracer=tracer, coalesce=cfg.router_coalesce,
+                                overload=overload)
     # the reference scrapes the router on :8091/prometheus
     # (reference README.md:503-507); the standalone role must expose the
     # same surface the generated k8s Service/annotations point at

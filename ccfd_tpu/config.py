@@ -136,6 +136,40 @@ class Config:
     # dispatches its own batches, which only makes sense for measuring)
     router_coalesce: bool = True
 
+    # --- overload control (runtime/overload.py) ---
+    # master switch for the adaptive-admission plane: AIMD in-flight
+    # budget + priority-aware shedding on the router, priority-tiered
+    # 429 admission on the REST fronts (CCFD_OVERLOAD; 0 disables and
+    # restores the static-budget / unbounded-queue semantics everywhere)
+    overload_enabled: bool = True
+    # scorer-stage latency budget the router's AIMD limit is derived
+    # from: observed dispatch latency above it shrinks the in-flight
+    # limit multiplicatively, a window below it grows it additively
+    overload_target_ms: float = 50.0       # CCFD_OVERLOAD_TARGET_MS
+    # serving-stage (REST) latency budget for the admission gate's AIMD
+    overload_serve_target_ms: float = 25.0  # CCFD_OVERLOAD_SERVE_TARGET_MS
+    # adaptive limit bounds in rows; 0 = auto (min: one router max_batch,
+    # max: 4x the initial limit)
+    overload_min_inflight: int = 0         # CCFD_OVERLOAD_MIN_INFLIGHT
+    overload_max_inflight: int = 0         # CCFD_OVERLOAD_MAX_INFLIGHT
+    # CoDel-style bus sojourn target: records older than this (scaled 1x/
+    # 2x/4x for bulk/normal/critical priority) drop from the front at
+    # poll time. DEFAULT OFF (0): crash recovery legitimately re-drives
+    # minutes-old records, and a standing deadline would shed the replay —
+    # arm it explicitly for live traffic (CCFD_OVERLOAD_CODEL_TARGET_MS)
+    overload_codel_target_ms: float = 0.0
+    # serving DynamicBatcher queue sojourn target (same CoDel policy,
+    # perf_counter-based so replay-safe); 0 = off
+    overload_serve_codel_target_ms: float = 0.0  # CCFD_OVERLOAD_SERVE_CODEL_TARGET_MS
+    # serving DynamicBatcher queue bound in rows with priority-aware
+    # eviction (arrivals past it 429); 0 = unbounded (historical)
+    overload_rest_queue_rows: int = 0      # CCFD_OVERLOAD_REST_QUEUE_ROWS
+    # router dispatch watchdog: a scorer dispatch past this deadline is
+    # killed and trips the scorer-edge breaker instead of stalling the
+    # worker. -1 = auto (SELDON_TIMEOUT on accelerator backends, off on
+    # cpu — same resolution as the server-side dispatch deadline); 0 = off
+    overload_dispatch_deadline_ms: float = -1.0  # CCFD_OVERLOAD_DISPATCH_DEADLINE_MS
+
     # --- TPU scorer knobs (new) ---
     model_name: str = "mlp"
     graph_cr: str = ""  # SeldonDeployment-shaped CR file -> serving/graph.py
@@ -298,6 +332,40 @@ class Config:
             ),
             router_coalesce=e.get("CCFD_ROUTER_COALESCE", "1").strip().lower()
             not in ("0", "false", "no", "off"),
+            overload_enabled=e.get("CCFD_OVERLOAD", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            overload_target_ms=float(
+                e.get("CCFD_OVERLOAD_TARGET_MS",
+                      str(Config.overload_target_ms))
+            ),
+            overload_serve_target_ms=float(
+                e.get("CCFD_OVERLOAD_SERVE_TARGET_MS",
+                      str(Config.overload_serve_target_ms))
+            ),
+            overload_min_inflight=int(
+                e.get("CCFD_OVERLOAD_MIN_INFLIGHT",
+                      str(Config.overload_min_inflight))
+            ),
+            overload_max_inflight=int(
+                e.get("CCFD_OVERLOAD_MAX_INFLIGHT",
+                      str(Config.overload_max_inflight))
+            ),
+            overload_codel_target_ms=float(
+                e.get("CCFD_OVERLOAD_CODEL_TARGET_MS",
+                      str(Config.overload_codel_target_ms))
+            ),
+            overload_serve_codel_target_ms=float(
+                e.get("CCFD_OVERLOAD_SERVE_CODEL_TARGET_MS",
+                      str(Config.overload_serve_codel_target_ms))
+            ),
+            overload_rest_queue_rows=int(
+                e.get("CCFD_OVERLOAD_REST_QUEUE_ROWS",
+                      str(Config.overload_rest_queue_rows))
+            ),
+            overload_dispatch_deadline_ms=float(
+                e.get("CCFD_OVERLOAD_DISPATCH_DEADLINE_MS",
+                      str(Config.overload_dispatch_deadline_ms))
+            ),
             model_name=e.get("CCFD_MODEL", Config.model_name),
             graph_cr=e.get("CCFD_GRAPH_CR", Config.graph_cr),
             compute_dtype=e.get("CCFD_DTYPE", Config.compute_dtype),

@@ -21,6 +21,9 @@ metric names, one builder per board:
   surface (new capability; no reference analog)
 - ModelLifecycle — shadow/canary/promotion/rollback surface of the model
   lifecycle controller (new capability; no reference analog)
+- Overload     — adaptive admission / priority shedding / backpressure
+  surface of the overload-control plane (new capability; no reference
+  analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -324,8 +327,53 @@ def resilience_dashboard() -> dict:
         # endurance signal, per-component object counts name the suspect
         _panel(8, "Process RSS (bytes)", ["ccfd_process_rss_bytes"]),
         _panel(9, "Component object counts", ["ccfd_component_objects"]),
+        # overload plane (runtime/overload.py): the adaptive in-flight
+        # limit MOVING against its utilization is the live evidence the
+        # AIMD loop is in control (the full surface is the Overload board)
+        _panel(10, "Adaptive in-flight limit vs used (by stage)",
+               ["ccfd_inflight_limit", "ccfd_inflight_used"]),
     ]
     return _dashboard("CCFD Resilience", "ccfd-resilience", p)
+
+
+def overload_dashboard() -> dict:
+    """Overload-control board (round 10; runtime/overload.py).
+
+    The adaptive-admission surface: the AIMD in-flight limit against its
+    utilization per stage (the limit visibly dropping under a latency
+    step and recovering after IS the control loop working), admission
+    decisions and sheds broken out by priority class and stage (bulk must
+    shed first, critical last — the priority-inversion tripwire alerts if
+    that ordering ever breaks), the dispatch-watchdog kill rate, REST
+    429s, and the bus backlog the backpressure path parks load in instead
+    of consuming it into an unbounded shed."""
+    p = [
+        _panel(0, "Adaptive in-flight limit vs used (by stage)",
+               ["ccfd_inflight_limit", "ccfd_inflight_used"]),
+        _panel(1, "Admission decisions (rows/s) by stage+priority",
+               ['rate(ccfd_admission_total{decision="admit"}[5m])',
+                'rate(ccfd_admission_total{decision!="admit"}[5m])']),
+        _panel(2, "Shed rows / s by priority and stage",
+               ["rate(ccfd_shed_total[5m])"]),
+        _alert_stat(3, "Priority inversions (must be 0)",
+                    ["ccfd_priority_inversions_total"], red_above=1),
+        _alert_stat(4, "Dispatch watchdog kills / s",
+                    ["rate(ccfd_dispatch_timeout_total[5m])"],
+                    red_above=0.1),
+        _panel(5, "REST admission: 429 responses / s",
+               ['rate(seldon_api_executor_server_requests_total{code="429"}[5m])']),
+        _panel(6, "Bus backlog under backpressure (consumer lag)",
+               ["bus_topic_backlog"]),
+        _panel(7, "Admitted-traffic decision latency p50/p99",
+               ["histogram_quantile(0.5, rate(router_decision_seconds_bucket[5m]))",
+                "histogram_quantile(0.99, rate(router_decision_seconds_bucket[5m]))"]),
+        _alert_stat(8, "Router shed rate (rows/s)",
+                    ["rate(router_shed_total[5m])"], red_above=1),
+        _panel(9, "Batcher queue depth (serving REST / router coalescing)",
+               ['ccfd_component_objects{component="serving_batcher_queue"}',
+                'ccfd_component_objects{component="router_batcher_queue"}']),
+    ]
+    return _dashboard("CCFD Overload", "ccfd-overload", p)
 
 
 def tracing_dashboard() -> dict:
@@ -439,6 +487,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Resilience": resilience_dashboard(),
         "Tracing": tracing_dashboard(),
         "ModelLifecycle": lifecycle_dashboard(),
+        "Overload": overload_dashboard(),
     }
 
 

@@ -52,6 +52,8 @@ _COMPONENTS = (
     "tracing",    # distributed tracing + tail sampler (new; round 7)
     "lifecycle",  # model lifecycle: shadow -> canary -> gated promotion
                   # with auto-rollback (new; round 9, lifecycle/)
+    "overload",   # overload control: adaptive AIMD admission, priority-
+                  # aware shedding, REST 429s (new; runtime/overload.py)
 )
 
 
@@ -159,6 +161,36 @@ class Platform:
                 seed=int(chaos_spec.opt("seed", 0)),
                 active=storm_interval is None,
             )
+
+        # 0a. overload control (runtime/overload.py): the CR `overload:`
+        # block overlays the CCFD_OVERLOAD_* env KNOBS once, here, so the
+        # scorer's REST admission gate (built in step 3) and the router's
+        # adaptive budget (step 6) read the same resolved values.
+        # Precedence for the on/off switch: either side can DISABLE the
+        # plane (CR `enabled: false` OR env CCFD_OVERLOAD=0) — the env
+        # form is the emergency kill switch and a CR cannot override it
+        # (an absent CR block is indistinguishable from a default-enabled
+        # one, so "CR re-enables over env" is not expressible anyway).
+        ov_spec = spec.component("overload")
+        ov_overrides: dict[str, Any] = {}
+        if not ov_spec.enabled:
+            ov_overrides["overload_enabled"] = False
+        else:
+            for opt, field in (
+                ("target_ms", "overload_target_ms"),
+                ("serve_target_ms", "overload_serve_target_ms"),
+                ("min_inflight", "overload_min_inflight"),
+                ("max_inflight", "overload_max_inflight"),
+                ("codel_target_ms", "overload_codel_target_ms"),
+                ("serve_codel_target_ms", "overload_serve_codel_target_ms"),
+                ("rest_queue_rows", "overload_rest_queue_rows"),
+                ("dispatch_deadline_ms", "overload_dispatch_deadline_ms"),
+            ):
+                if ov_spec.opt(opt) is not None:
+                    ov_overrides[field] = type(getattr(cfg, field))(
+                        ov_spec.opt(opt))
+        if ov_overrides:
+            self.cfg = cfg = dataclasses.replace(cfg, **ov_overrides)
 
         # 0b. distributed tracing (observability/trace.py): ONE tail-
         # sampling span sink shared by every component tracer; the tracers
@@ -698,6 +730,32 @@ class Platform:
                     methods=("start_process", "start_process_batch",
                              "signal"),
                 )
+        # overload-control plane (runtime/overload.py): default on — the
+        # static in-flight cap becomes an adaptive AIMD limit derived
+        # from the scorer stage's observed latency, sheds become
+        # priority-aware, and a hung dispatch is watchdog-killed into the
+        # breaker. One OverloadControl per router pool: with workers > 1
+        # every worker shares it, so the adaptive bound is global.
+        workers = int(c.opt("workers", self.cfg.router_workers))
+        overload = None
+        if self.cfg.overload_enabled:
+            from ccfd_tpu.runtime.overload import OverloadControl
+
+            n_eff = workers if workers > 0 else max(
+                1, len(self.broker.end_offsets(self.cfg.kafka_topic)))
+            overload = OverloadControl.from_config(
+                self.cfg, reg, max_batch=4096, workers=n_eff)
+            mi = c.opt("max_inflight")
+            if overload is not None and mi is not None:
+                # an explicit CR cap stays a hard ceiling on the
+                # adaptive limit — AIMD moves below it, never above.
+                # min_limit clamps too: a floor above the cap would let
+                # the first AIMD decrease snap the limit back OVER the
+                # operator's bound (max(min_limit, limit*beta))
+                b = overload.budget
+                b.max_limit = min(b.max_limit, int(mi))
+                b.min_limit = min(b.min_limit, int(mi))
+                b.limit = min(b.limit, int(mi))
         common = dict(
             host_score_fn=host_score_fn,
             breaker=breaker,
@@ -708,6 +766,7 @@ class Platform:
             max_inflight=(int(c.opt("max_inflight"))
                           if c.opt("max_inflight") is not None else None),
             tracer=router_tracer,
+            overload=overload,
         )
         # partition-parallel fan-out (router/parallel.py): CR
         # `router.workers` over CCFD_ROUTER_WORKERS; 1 = the historical
@@ -716,7 +775,6 @@ class Platform:
         # a coalescing batcher, one in-flight budget, one breaker and a
         # group-wide pause barrier — the checkpoint/recovery machinery
         # below drives either shape through the same surface.
-        workers = int(c.opt("workers", self.cfg.router_workers))
         if workers == 1:
             router = Router(self.cfg, self.broker, score_fn, engine, reg,
                             **common)
@@ -929,6 +987,11 @@ class Platform:
         if getattr(self.router, "batcher", None) is not None:
             ex.add_probe("router_batcher_queue",
                          lambda: self.router.batcher.qsize())
+        if getattr(self.prediction_server, "batcher", None) is not None:
+            # the REST-side DynamicBatcher (the queue the overload
+            # codel/bound knobs police) — the Overload board charts it
+            ex.add_probe("serving_batcher_queue",
+                         lambda: self.prediction_server.batcher.qsize())
 
     # -- status / teardown -------------------------------------------------
     def wait_producer(self, timeout_s: float = 60.0) -> bool:

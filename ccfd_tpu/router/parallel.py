@@ -90,6 +90,7 @@ class ParallelRouter:
         coalesce_max_batch: int | None = None,
         coalesce_deadline_ms: float | None = None,
         coalesce_workers: int = 2,
+        overload: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -106,9 +107,21 @@ class ParallelRouter:
         # 2×max_batch (one batch in flight + one fresh poll), so the
         # pool-wide default is 2×max_batch×workers: healthy operation
         # never sheds, exactly like the single-router default.
-        self.max_inflight = (int(max_inflight) if max_inflight is not None
-                             else 2 * max_batch * workers)
-        self._budget = InflightBudget(self.max_inflight)
+        #
+        # With an OverloadControl (runtime/overload.py) the pool shares
+        # ITS adaptive AIMD budget instead: one limit, moved by every
+        # worker's scorer-latency observations, bounding the whole pool —
+        # the same global-across-workers semantics, made dynamic.
+        self._overload = overload
+        if overload is not None:
+            self._budget = overload.budget
+            self.max_inflight = self._budget.limit
+        else:
+            self.max_inflight = (int(max_inflight)
+                                 if max_inflight is not None
+                                 else 2 * max_batch * workers)
+            self._budget = InflightBudget(self.max_inflight,
+                                          registry=self.registry)
 
         # -- shared scorer edge: one breaker, one coalescing batcher -------
         self._degrade = (degrade if degrade is not None
@@ -162,6 +175,7 @@ class ParallelRouter:
                 host_score_fn=host_score_fn, breaker=self._breaker,
                 degrade=degrade, max_inflight=self.max_inflight,
                 tracer=tracer, inflight_budget=self._budget, worker_id=i,
+                overload=overload,
             )
             for i in range(workers)
         ]

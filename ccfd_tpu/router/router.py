@@ -90,25 +90,68 @@ class InflightBudget:
 
     ``reserve`` grants up to ``n`` rows and the caller sheds the rest;
     ``release`` returns rows once they are fully routed (or dropped).
+
+    With a ``registry``, the current limit and utilization export as
+    ``ccfd_inflight_limit`` / ``ccfd_inflight_used`` gauges labeled by
+    ``stage`` — a fixed cap used to be invisible (you saw the sheds, not
+    the bound), and the adaptive subclass
+    (:class:`~ccfd_tpu.runtime.overload.AdaptiveInflightBudget`) MOVES the
+    limit, which the Resilience/Overload boards chart.
     """
 
-    __slots__ = ("limit", "_n", "_mu")
+    __slots__ = ("limit", "_n", "_mu", "_g_limit", "_g_used", "_stage")
 
-    def __init__(self, limit: int):
+    def __init__(self, limit: int, registry=None, stage: str = "router"):
         self.limit = int(limit)
         self._n = 0
         self._mu = threading.Lock()
+        self._stage = {"stage": stage}
+        self._g_limit = self._g_used = None
+        if registry is not None:
+            self._g_limit = registry.gauge(
+                "ccfd_inflight_limit",
+                "in-flight row budget per stage (adaptive when the "
+                "overload plane is armed)",
+            )
+            self._g_used = registry.gauge(
+                "ccfd_inflight_used", "in-flight rows reserved per stage"
+            )
+            self._set_gauges_locked()
+
+    def _set_gauges_locked(self) -> None:
+        if self._g_limit is not None:
+            self._g_limit.set(self.limit, labels=self._stage)
+            self._g_used.set(self._n, labels=self._stage)
 
     def reserve(self, n: int) -> int:
         """Take up to ``n`` rows from the budget; returns rows granted."""
         with self._mu:
             take = min(n, max(0, self.limit - self._n))
             self._n += take
+            self._set_gauges_locked()
             return take
+
+    def try_reserve(self, n: int, ceiling: float = 1.0) -> bool:
+        """All-or-nothing reserve (request-atomic admission): grant only
+        when the post-grant utilization stays at or under ``ceiling``.
+        An idle stage always grants — a lone request bigger than the
+        (possibly adapted-down) limit must run alone, not starve."""
+        with self._mu:
+            if self._n == 0 or self._n + n <= int(self.limit * ceiling):
+                self._n += n
+                self._set_gauges_locked()
+                return True
+            return False
 
     def release(self, n: int) -> None:
         with self._mu:
             self._n = max(0, self._n - n)
+            self._set_gauges_locked()
+
+    def room(self) -> int:
+        """Rows the budget could grant right now (backpressure probe)."""
+        with self._mu:
+            return max(0, self.limit - self._n)
 
     @property
     def inflight(self) -> int:
@@ -272,6 +315,7 @@ class Router:
         tracer: "Any | None" = None,
         inflight_budget: InflightBudget | None = None,
         worker_id: int | None = None,
+        overload: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -389,11 +433,22 @@ class Router:
             self._breaker = default_scorer_breaker(r)
         self.max_inflight = (int(max_inflight) if max_inflight is not None
                              else 2 * max_batch)
+        # overload-control plane (runtime/overload.py): adaptive AIMD
+        # in-flight budget, deadline (CoDel) + priority-aware shedding,
+        # and the dispatch watchdog. None keeps the historical static-
+        # budget / oldest-first semantics. A ParallelRouter hands every
+        # worker the SAME OverloadControl, so the adaptive bound — like
+        # the static one — holds globally across the pool.
+        self._overload = overload
         # the bounded-in-flight budget: private by default; a
         # ParallelRouter hands every worker the SAME budget so the bound
         # holds globally (satellite of the partition-parallel fan-out)
-        self._budget = (inflight_budget if inflight_budget is not None
-                        else InflightBudget(self.max_inflight))
+        if inflight_budget is not None:
+            self._budget = inflight_budget
+        elif overload is not None:
+            self._budget = overload.budget
+        else:
+            self._budget = InflightBudget(self.max_inflight, registry=r)
         # worker identity (ParallelRouter): labels this loop's batches and
         # trace spans so per-stage attribution survives the fan-out
         self.worker_id = worker_id
@@ -455,23 +510,44 @@ class Router:
         first records arrive, keep accumulating until the batch bucket
         fills or batch_deadline_ms elapses — under sustained load the TPU
         dispatch amortizes over a full bucket, while the deadline bounds
-        the latency a lone transaction can be held for."""
-        records = self._tx_consumer.poll(self.max_batch, poll_timeout_s)
-        if not records:
-            return records
-        deadline_s = self.cfg.batch_deadline_ms / 1e3
-        if deadline_s > 0 and len(records) < self.max_batch:
-            deadline = time.perf_counter() + deadline_s
-            while len(records) < self.max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                more = self._tx_consumer.poll(
-                    self.max_batch - len(records), remaining
-                )
-                if not more:
-                    break  # poll slept out the remaining deadline
-                records.extend(more)
+        the latency a lone transaction can be held for.
+
+        With the overload plane armed the poll is budget-PREPAID: the
+        loop reserves in-flight room BEFORE consuming and polls at most
+        the grant, so a record is never consumed that cannot be admitted
+        (consuming past capacity would force shedding records of EVERY
+        priority — the inversion the plane exists to prevent). With no
+        room the loop does not consume at all: backpressure propagates —
+        the backlog stays in the bus, where the producer (and the Bus
+        board) observe it as lag (``bus_topic_backlog``) instead of an
+        unbounded consumed-then-shed churn. Polling resumes as routed
+        batches release rows."""
+        cap = self.max_batch
+        granted = -1
+        if self._overload is not None:
+            granted = self._budget.reserve(self.max_batch)
+            if granted <= 0:
+                if poll_timeout_s > 0:
+                    time.sleep(min(poll_timeout_s, 0.02))
+                return []
+            cap = granted
+        records = self._tx_consumer.poll(cap, poll_timeout_s)
+        if records:
+            deadline_s = self.cfg.batch_deadline_ms / 1e3
+            if deadline_s > 0 and len(records) < cap:
+                deadline = time.perf_counter() + deadline_s
+                while len(records) < cap:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    more = self._tx_consumer.poll(
+                        cap - len(records), remaining
+                    )
+                    if not more:
+                        break  # poll slept out the remaining deadline
+                    records.extend(more)
+        if granted >= 0 and granted > len(records):
+            self._budget.release(granted - len(records))
         return records
 
     # -- tracing helpers ---------------------------------------------------
@@ -536,6 +612,21 @@ class Router:
         self._c_shed.inc(shed)
         return records[shed:] if granted else []
 
+    def _admit(self, records: list) -> list:
+        """Admission for one poll's records. With the overload plane armed
+        the decision is deadline- and priority-aware (stale rows drop from
+        the front, budget victims are picked bulk-first/critical-last,
+        runtime/overload.py); without it, the historical oldest-first
+        bounded-in-flight shed. Either way the budget is reserved for
+        exactly the survivors and ``router_shed_total`` counts the drops."""
+        if self._overload is None:
+            return self._shed_oldest(records)
+        keep, shed = self._overload.admit(records, prepaid=True)
+        if shed:
+            self._c_in.inc(shed)  # shed records were still consumed
+            self._c_shed.inc(shed)
+        return keep
+
     def _rules_proba(self, x: np.ndarray) -> np.ndarray:
         """Rules-only tier: a conservative ``FRAUD_THRESHOLD`` stand-in
         with no model at all. High-amount transactions (the reference
@@ -558,7 +649,16 @@ class Router:
         if br is None or br.allow():
             t0 = time.perf_counter()
             try:
-                proba = np.asarray(self._score2(x, txs))
+                ov = self._overload
+                if ov is not None and ov.dispatch_deadline_s > 0:
+                    # dispatch watchdog: a hung/slow device dispatch (the
+                    # seq path measured 1412 ms, BENCH_r05) is killed at
+                    # the deadline and lands in this except — one breaker
+                    # failure and a ladder fall, not a stalled worker
+                    proba = np.asarray(
+                        ov.bounded_dispatch(lambda: self._score2(x, txs)))
+                else:
+                    proba = np.asarray(self._score2(x, txs))
                 lat = time.perf_counter() - t0
                 # corrupt-response validation: a fault-injected (or truly
                 # version-skewed) reply with the wrong shape or non-finite
@@ -608,7 +708,7 @@ class Router:
         records = self._poll_batch(poll_timeout_s)
         if not records:
             return 0
-        records = self._shed_oldest(records)
+        records = self._admit(records)
         if not records:
             return 0
         batch_sp = None
@@ -617,10 +717,15 @@ class Router:
             x, txs, ts = self._decode_batch(records, batch_sp)
             t0 = time.perf_counter()
             proba = self._score_batch(x, txs, batch_sp)
+            score_s = time.perf_counter() - t0
             self._h_score_s.observe(
-                time.perf_counter() - t0,
+                score_s,
                 exemplar=({"trace_id": batch_sp.trace_id}
                           if batch_sp is not None else None))
+            if self._overload is not None:
+                # AIMD feedback: the scorer stage's measured latency vs its
+                # budget is what moves the adaptive in-flight limit
+                self._overload.observe_stage(score_s)
             return self._route(x, txs, proba, ts, batch_span=batch_sp)
         except BaseException:
             # a crashed batch is exactly the trace an operator needs:
@@ -838,10 +943,13 @@ class Router:
             # ambient trace context (contextvars are per-thread)
             t0 = time.perf_counter()
             proba = self._score_batch(x, txs, batch_sp)
+            score_s = time.perf_counter() - t0
             self._h_score_s.observe(
-                time.perf_counter() - t0,
+                score_s,
                 exemplar=({"trace_id": batch_sp.trace_id}
                           if batch_sp is not None else None))
+            if self._overload is not None:
+                self._overload.observe_stage(score_s)
             return proba
 
         def finish(pending: tuple) -> None:
@@ -895,10 +1003,10 @@ class Router:
                 if records:
                     # bounded in-flight: batch k-1's rows are still
                     # reserved (consumed-but-unrouted) while k is being
-                    # submitted — the budget reserve inside _shed_oldest
+                    # submitted — the budget reserve inside _admit
                     # accounts for them (and, under ParallelRouter, for
                     # every other worker's in-flight rows too)
-                    records = self._shed_oldest(records)
+                    records = self._admit(records)
                 fut = None
                 if records:
                     batch_sp = None

@@ -1,0 +1,509 @@
+"""End-to-end overload control: adaptive admission + priority-aware shedding.
+
+PR 1's breakers and degradation ladder defend every RPC edge against
+*faults*; this module defends the pipeline against *overload* — the flash
+crowd at the REST front, the partition-skewed hot key, the scorer whose
+latency quietly doubled. The design follows the serving-robustness
+literature the ROADMAP names: per-stage admission control with an
+SLO-derived concurrency limit (InferLine, arXiv:1812.01776), overload
+isolation as the defining serving problem at millions-of-users scale
+("Scaling TensorFlow to 300M predictions/sec", arXiv:2109.09541), and the
+SRE load-shedding canon (shed by value, never by arrival order alone).
+
+Four pieces, composed by the router, the serving fronts and the operator:
+
+- :class:`AdaptiveInflightBudget` — an AIMD concurrency limiter with the
+  :class:`~ccfd_tpu.router.router.InflightBudget` surface, so it drops in
+  wherever the static budget lived (one instance shared across every
+  ParallelRouter worker keeps the PR-3 global-bound semantics). Each
+  ``observe(latency)`` compares a stage's measured latency against its
+  budget: over budget → multiplicative decrease (cooldown-limited so one
+  burst can't collapse the limit), a window of in-budget observations →
+  additive increase. The limit and its utilization export as
+  ``ccfd_inflight_limit`` / ``ccfd_inflight_used`` gauges (labeled by
+  stage) so the Resilience and Overload boards show the limit moving.
+- :class:`DeadlinePolicy` — a CoDel-style deadline-aware queue policy:
+  work is dropped FROM THE FRONT when its queue sojourn exceeds a target,
+  so stale work never reaches the device (serving it would blow the SLO
+  for everything behind it, the bufferbloat failure CoDel exists to kill).
+  Targets scale per priority class — bulk work goes stale at 1× the
+  target, normal at 2×, critical at 4× — which is what makes deadline
+  shedding priority-ordered under a growing backlog.
+- :class:`OverloadControl` — the router/bus-side admission plane (one per
+  router pool; workers share it): deadline shedding + budget-bounded
+  admission with priority-aware victim selection (bulk shed first,
+  critical last, oldest-first within a class), a self-checking
+  ``ccfd_priority_inversions_total`` tripwire, and the dispatch watchdog —
+  a bounded device-dispatch call whose expiry trips the scorer-edge
+  breaker instead of stalling a worker forever
+  (``ccfd_dispatch_timeout_total``).
+- :class:`AdmissionGate` — the serving-side (REST) admission plane:
+  request-atomic reserve against an adaptive serving budget with
+  priority-tiered utilization ceilings (bulk refused at 50% utilization,
+  normal at 90%, critical at 100%), mapped by the fronts to an explicit
+  429 + retry-after.
+
+Priority classes ride as data: bus records carry a ``priority`` header
+(``bulk`` / ``normal`` / ``critical``; the producer stamps per-chunk),
+REST requests an ``x-ccfd-priority`` header. Fraud-suspect re-scores and
+canary/shadow-evaluation traffic are stamped ``critical`` (shed LAST);
+bulk re-score jobs ``bulk`` (shed FIRST); everything else defaults
+``normal``.
+
+Replay safety: deadline (CoDel) shedding on the bus judges records by
+their PRODUCE timestamp, and crash recovery legitimately re-drives
+minutes-old records — the bus deadline therefore defaults OFF
+(``CCFD_OVERLOAD_CODEL_TARGET_MS=0``) and is armed explicitly for live
+traffic; the adaptive budget and priority shedding are always safe and
+default on under the operator.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from ccfd_tpu.router.router import InflightBudget
+
+# priority classes: "bigger is more precious" — shed ascending
+PRIORITY_BULK, PRIORITY_NORMAL, PRIORITY_CRITICAL = 0, 1, 2
+PRIORITY_NAMES = {PRIORITY_BULK: "bulk", PRIORITY_NORMAL: "normal",
+                  PRIORITY_CRITICAL: "critical"}
+_PRIORITY_BY_NAME = {
+    "bulk": PRIORITY_BULK, "low": PRIORITY_BULK,
+    "normal": PRIORITY_NORMAL, "default": PRIORITY_NORMAL,
+    "critical": PRIORITY_CRITICAL, "high": PRIORITY_CRITICAL,
+    # semantic aliases for the traffic the ISSUE pins to each end:
+    "fraud": PRIORITY_CRITICAL, "canary": PRIORITY_CRITICAL,
+    "shadow": PRIORITY_CRITICAL, "rescore": PRIORITY_BULK,
+}
+
+
+def parse_priority(value: Any, default: int = PRIORITY_NORMAL) -> int:
+    """Header/payload value -> priority class. Accepts the class names
+    (and their aliases), bytes, and bare ints; anything unparseable is
+    NORMAL — a malformed header must not be a shed-first footgun."""
+    if value is None:
+        return default
+    if isinstance(value, bytes):
+        value = value.decode("latin-1", "replace")
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v in _PRIORITY_BY_NAME:
+            return _PRIORITY_BY_NAME[v]
+        try:
+            value = int(v)
+        except ValueError:
+            return default
+    if isinstance(value, (int, float)):
+        return min(PRIORITY_CRITICAL, max(PRIORITY_BULK, int(value)))
+    return default
+
+
+def headers_priority(headers: Any, default: int = PRIORITY_NORMAL) -> int:
+    """Priority from a record/request header carrier: a mapping or a
+    Kafka-style ``[(key, value), ...]`` list. Missing/None -> default."""
+    if not headers:
+        return default
+    if isinstance(headers, Mapping):
+        return parse_priority(headers.get("priority"), default)
+    try:  # list of (key, value) pairs (bus/kafka_adapter header mapping)
+        for k, v in headers:
+            kk = k.decode("latin-1") if isinstance(k, bytes) else k
+            if kk == "priority":
+                return parse_priority(v, default)
+    except (TypeError, ValueError):
+        return default
+    return default
+
+
+def record_priority(rec: Any, default: int = PRIORITY_NORMAL) -> int:
+    return headers_priority(getattr(rec, "headers", None), default)
+
+
+class OverloadShed(RuntimeError):
+    """Work refused or dropped by the overload plane. Carries the
+    retry-after hint the REST fronts surface on a 429."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.1):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class AdaptiveInflightBudget(InflightBudget):
+    """AIMD concurrency limiter with the InflightBudget surface.
+
+    The static cap asked the operator to guess a constant that is really a
+    function of the stage's current latency; this derives it: the limit
+    additively grows while observed latency sits inside the stage budget
+    (``target_s``) and multiplicatively collapses when it doesn't — the
+    TCP-congestion shape, which converges to the largest concurrency the
+    stage sustains AT its latency budget and backs off within one window
+    when the stage slows (InferLine's SLO-driven admission substrate).
+
+    Sharing semantics are inherited: hand ONE instance to every
+    ParallelRouter worker and the adaptive bound stays global across the
+    pool, exactly like the static budget it replaces.
+    """
+
+    __slots__ = ("min_limit", "max_limit", "target_s", "beta", "step",
+                 "good_window", "_good", "_cooldown_until", "_inc_next",
+                 "increase_interval_s", "decrease_cooldown_s", "_clock")
+
+    def __init__(
+        self,
+        limit: int,
+        min_limit: int | None = None,
+        max_limit: int | None = None,
+        target_s: float = 0.05,
+        beta: float = 0.7,
+        step: int | None = None,
+        good_window: int = 8,
+        decrease_cooldown_s: float | None = None,
+        increase_interval_s: float = 0.0,
+        registry=None,
+        stage: str = "router",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        super().__init__(limit, registry=registry, stage=stage)
+        self.min_limit = int(min_limit if min_limit is not None
+                             else max(1, limit // 8))
+        self.max_limit = int(max_limit if max_limit is not None
+                             else 4 * limit)
+        self.target_s = float(target_s)
+        self.beta = float(beta)
+        self.step = int(step if step is not None else max(1, limit // 16))
+        self.good_window = int(good_window)
+        self.increase_interval_s = float(increase_interval_s)
+        # one decrease per ~stage round trip: a single slow burst's many
+        # observations must cost ONE multiplicative cut, not limit→min
+        self.decrease_cooldown_s = float(
+            decrease_cooldown_s if decrease_cooldown_s is not None
+            else max(2.0 * self.target_s, 0.1)
+        )
+        self._clock = clock
+        self._good = 0
+        self._cooldown_until = 0.0
+        self._inc_next = 0.0
+
+    def observe(self, latency_s: float) -> None:
+        """Feed one stage-latency sample; adjusts the limit AIMD-style."""
+        now = self._clock()
+        with self._mu:
+            if latency_s > self.target_s:
+                self._good = 0
+                if now >= self._cooldown_until:
+                    self.limit = max(self.min_limit,
+                                     int(self.limit * self.beta))
+                    self._cooldown_until = now + self.decrease_cooldown_s
+                    self._set_gauges_locked()
+                return
+            self._good += 1
+            if self._good >= self.good_window and now >= self._inc_next:
+                self._good = 0
+                self._inc_next = now + self.increase_interval_s
+                if self.limit < self.max_limit:
+                    self.limit = min(self.max_limit, self.limit + self.step)
+                    self._set_gauges_locked()
+
+
+class DeadlinePolicy:
+    """CoDel-style deadline-aware queue policy: drop-from-front when
+    sojourn time exceeds the target, scaled per priority class.
+
+    The classic failure this kills: a standing queue forms, every entry
+    waits out the full backlog, and the pipeline serves exclusively stale
+    work at 100% utilization (bufferbloat). Dropping the FRONT — the
+    oldest, already-blown entries — keeps the work that can still meet
+    its deadline flowing. Per-class target multipliers (bulk 1×, normal
+    2×, critical 4×) make a growing backlog shed bulk first and critical
+    last without a separate priority queue.
+    """
+
+    __slots__ = ("target_s", "scale")
+
+    def __init__(self, target_s: float,
+                 scale: tuple[float, float, float] = (1.0, 2.0, 4.0)):
+        self.target_s = float(target_s)
+        self.scale = scale
+
+    def cutoff_s(self, priority: int) -> float:
+        return self.target_s * self.scale[
+            min(len(self.scale) - 1, max(0, priority))]
+
+    def should_drop(self, sojourn_s: float, priority: int) -> bool:
+        return sojourn_s > self.cutoff_s(priority)
+
+
+def _shed_counter(registry):
+    return registry.counter(
+        "ccfd_shed_total",
+        "rows shed by the overload plane, by priority class and stage "
+        "(deadline = CoDel sojourn expiry — the row went stale waiting, "
+        "a fate not an admission choice; budget = in-flight bound "
+        "victim selection; batcher = serving queue policy; rest = REST "
+        "admission 429s)",
+    )
+
+
+def _admission_counter(registry):
+    return registry.counter(
+        "ccfd_admission_total",
+        "admission decisions in rows by stage, priority and decision",
+    )
+
+
+class OverloadControl:
+    """Router/bus-side overload plane; ONE instance per router pool.
+
+    Owns the shared adaptive budget, the bus deadline policy, the
+    priority-shedding victim selection and the dispatch watchdog, plus
+    the ``ccfd_*`` overload metrics. ParallelRouter hands the same
+    instance to every worker, so — like PR 3's budget/breaker — the
+    admission bound and the AIMD evidence stay global.
+    """
+
+    def __init__(
+        self,
+        registry,
+        budget: AdaptiveInflightBudget,
+        codel: DeadlinePolicy | None = None,
+        dispatch_deadline_ms: float = 0.0,
+        dispatch_threads: int = 4,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.registry = registry
+        self.budget = budget
+        self.codel = codel
+        self.dispatch_deadline_s = max(0.0, float(dispatch_deadline_ms)) / 1e3
+        # sacrificial-thread pool for the watchdog: sized to the worker
+        # count (from_config) — the dispatcher's deadline covers queue
+        # wait, so a pool smaller than the concurrently-dispatching
+        # workers would turn healthy busy-queueing into spurious
+        # ScorerTimeout kills that trip the breaker
+        self.dispatch_threads = max(1, int(dispatch_threads))
+        self._clock = clock  # wall clock: record timestamps are time.time()
+        self._c_shed = _shed_counter(registry)
+        self._c_admit = _admission_counter(registry)
+        self._c_inversions = registry.counter(
+            "ccfd_priority_inversions_total",
+            "batches where a higher-priority row was shed while a "
+            "lower-priority one was admitted — must stay 0; a nonzero "
+            "value means the victim selection is broken",
+        )
+        self._c_dispatch_timeout = registry.counter(
+            "ccfd_dispatch_timeout_total",
+            "router scorer dispatches killed by the watchdog deadline "
+            "(each trips the scorer-edge breaker instead of stalling a "
+            "worker)",
+        )
+        self._dispatcher = None
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def from_config(cfg, registry, max_batch: int = 4096,
+                    workers: int = 1) -> "OverloadControl | None":
+        """The operator/CLI construction path. None when overload control
+        is disabled (CCFD_OVERLOAD=0 / CR ``overload.enabled: false``) —
+        callers then keep the static-budget semantics."""
+        if not getattr(cfg, "overload_enabled", True):
+            return None
+        workers = max(1, int(workers))
+        # initial limit == the static default the adaptive budget replaces
+        # (2×max_batch per worker: one batch in flight + one fresh poll)
+        initial = 2 * max_batch * workers
+        min_l = cfg.overload_min_inflight or max_batch
+        max_l = cfg.overload_max_inflight or 4 * initial
+        budget = AdaptiveInflightBudget(
+            initial, min_limit=min_l, max_limit=max_l,
+            target_s=cfg.overload_target_ms / 1e3,
+            registry=registry, stage="router",
+        )
+        codel = (DeadlinePolicy(cfg.overload_codel_target_ms / 1e3)
+                 if cfg.overload_codel_target_ms > 0 else None)
+        dd = cfg.overload_dispatch_deadline_ms
+        if dd < 0:  # auto: track the server-side SELDON_TIMEOUT resolution
+            dd = cfg.scorer_dispatch_deadline_ms() or 0.0
+        return OverloadControl(registry, budget, codel=codel,
+                               dispatch_deadline_ms=dd,
+                               dispatch_threads=max(4, workers))
+
+    # -- bus-record admission ---------------------------------------------
+    def admit(self, records: list,
+              prepaid: bool = False) -> tuple[list, int]:
+        """One poll's records -> (admitted survivors in arrival order,
+        rows shed). On return the shared budget holds a reservation for
+        exactly the survivors; the caller releases len(survivors) once
+        they are fully routed.
+
+        ``prepaid=True`` is the router's poll path: the loop reserved the
+        budget BEFORE consuming (so overload never forces shedding rows
+        of every priority at once), and this call releases the shed
+        rows' share. ``prepaid=False`` reserves here, and when the limit
+        can't cover the batch picks victims lowest-priority-first,
+        oldest-first within a class (the PR-1 stalest-first rule, applied
+        class by class).
+
+        Shedding order: (1) deadline/CoDel — records whose bus sojourn
+        exceeds their class cutoff (bulk 1x, normal 2x, critical 4x the
+        target) drop from the front; (2) budget. By construction no
+        admitted row has lower priority than any budget-shed row in the
+        same batch; the inversion counter is the tripwire proving it
+        stayed that way.
+        """
+        n = len(records)
+        if n == 0:
+            return records, 0
+        pris = [record_priority(r) for r in records]
+        shed_by: dict[tuple[int, str], int] = {}
+        keep_idx = range(n)
+        shed_rows = 0
+
+        codel = self.codel
+        if codel is not None:
+            now = self._clock()
+            # cheap pre-check on the OLDEST record: a multi-partition poll
+            # concatenates partitions in partition order, not timestamp
+            # order, so the batch head can be fresh while a lagging hot
+            # partition's stale records hide behind it — min() over the
+            # timestamps is what proves the batch fresh, not records[0]
+            if now - min(r.timestamp for r in records) > codel.target_s:
+                kept: list[int] = []
+                for i in keep_idx:
+                    if codel.should_drop(now - records[i].timestamp,
+                                         pris[i]):
+                        key = (pris[i], "deadline")
+                        shed_by[key] = shed_by.get(key, 0) + 1
+                        shed_rows += 1
+                    else:
+                        kept.append(i)
+                keep_idx = kept
+
+        keep_idx = list(keep_idx)
+        if prepaid:
+            # every consumed row was reserved at poll time; hand the shed
+            # rows' reservation back
+            if shed_rows:
+                self.budget.release(shed_rows)
+        else:
+            granted = self.budget.reserve(len(keep_idx))
+            if granted < len(keep_idx):
+                excess = len(keep_idx) - granted
+                # victims: lowest class first; within a class the OLDEST
+                # first (stable index order == arrival order)
+                order = sorted(keep_idx, key=lambda i: (pris[i], i))
+                victims = set(order[:excess])
+                max_shed_p = max(pris[i] for i in victims)
+                survivors = [i for i in keep_idx if i not in victims]
+                if survivors and min(
+                        pris[i] for i in survivors) < max_shed_p:
+                    self._c_inversions.inc()
+                for i in victims:
+                    key = (pris[i], "budget")
+                    shed_by[key] = shed_by.get(key, 0) + 1
+                shed_rows += excess
+                keep_idx = survivors
+
+        for (p, stage), count in shed_by.items():
+            self._c_shed.inc(count, labels={
+                "priority": PRIORITY_NAMES[p], "stage": stage})
+            self._c_admit.inc(count, labels={
+                "stage": "bus", "priority": PRIORITY_NAMES[p],
+                "decision": "shed"})
+        if keep_idx:
+            admit_by: dict[int, int] = {}
+            for i in keep_idx:
+                admit_by[pris[i]] = admit_by.get(pris[i], 0) + 1
+            for p, count in admit_by.items():
+                self._c_admit.inc(count, labels={
+                    "stage": "bus", "priority": PRIORITY_NAMES[p],
+                    "decision": "admit"})
+        if len(keep_idx) == n:
+            return records, 0
+        return [records[i] for i in keep_idx], shed_rows
+
+    # -- stage feedback ----------------------------------------------------
+    def observe_stage(self, latency_s: float) -> None:
+        """Feed a scorer-stage latency sample into the AIMD budget."""
+        self.budget.observe(latency_s)
+
+    # -- dispatch watchdog -------------------------------------------------
+    def bounded_dispatch(self, fn: Callable[[], Any]) -> Any:
+        """Run a device dispatch under the watchdog deadline. On expiry the
+        call raises (the router's ladder records a scorer-edge failure, so
+        a hung dispatch trips the existing breaker instead of stalling the
+        worker forever), the timeout is counted, and the deadline itself is
+        fed to AIMD as the worst-possible latency sample."""
+        if self.dispatch_deadline_s <= 0:
+            return fn()
+        from ccfd_tpu.serving.dispatch import DeviceDispatcher, ScorerTimeout
+
+        if self._dispatcher is None:
+            with self._mu:
+                if self._dispatcher is None:
+                    self._dispatcher = DeviceDispatcher(
+                        max_threads=self.dispatch_threads,
+                        name="ccfd-router-dispatch")
+        try:
+            return self._dispatcher.call(fn, self.dispatch_deadline_s)
+        except ScorerTimeout:
+            self._c_dispatch_timeout.inc()
+            self.budget.observe(self.dispatch_deadline_s + self.budget.target_s)
+            raise
+
+
+class AdmissionGate:
+    """Serving-side (REST) admission: request-atomic reserve against an
+    adaptive serving budget with priority-tiered utilization ceilings.
+
+    Bulk requests are refused once the stage is half full, normal at 90%,
+    critical only at the full limit — under load the 429s land on the
+    traffic that can retry cheapest. A lone oversize request always
+    admits (``try_reserve``'s empty-pass rule), so the gate can never
+    starve a request bigger than the adapted limit.
+    """
+
+    UTIL_CEILING = {PRIORITY_BULK: 0.5, PRIORITY_NORMAL: 0.9,
+                    PRIORITY_CRITICAL: 1.0}
+
+    def __init__(self, budget: AdaptiveInflightBudget, registry,
+                 stage: str = "rest", retry_after_s: float = 0.25):
+        self.budget = budget
+        self.stage = stage
+        self.retry_after_s = float(retry_after_s)
+        self._c_admit = _admission_counter(registry)
+        self._c_shed = _shed_counter(registry)
+
+    @staticmethod
+    def from_config(cfg, registry, max_rows: int) -> "AdmissionGate | None":
+        if not getattr(cfg, "overload_enabled", True):
+            return None
+        budget = AdaptiveInflightBudget(
+            4 * max_rows, min_limit=max_rows, max_limit=16 * max_rows,
+            target_s=cfg.overload_serve_target_ms / 1e3,
+            registry=registry, stage="serving",
+        )
+        return AdmissionGate(budget, registry)
+
+    def try_admit(self, rows: int, priority: int = PRIORITY_NORMAL) -> bool:
+        ceiling = self.UTIL_CEILING.get(priority, 0.9)
+        ok = self.budget.try_reserve(rows, ceiling=ceiling)
+        name = PRIORITY_NAMES.get(priority, "normal")
+        self._c_admit.inc(rows, labels={
+            "stage": self.stage, "priority": name,
+            "decision": "admit" if ok else "reject"})
+        if not ok:
+            self._c_shed.inc(rows, labels={
+                "priority": name, "stage": self.stage})
+        return ok
+
+    def release(self, rows: int) -> None:
+        self.budget.release(rows)
+
+    def observe(self, latency_s: float) -> None:
+        self.budget.observe(latency_s)
+
+    def refusal(self) -> OverloadShed:
+        return OverloadShed("serving stage overloaded",
+                            retry_after_s=self.retry_after_s)

@@ -410,7 +410,38 @@ class NativeFront:
                 counts[i] = meta[3 * i + 1]
                 tags[i] = meta[3 * i + 2]
                 total += meta[3 * i + 1]
+            # overload admission (runtime/overload.py): the C++ queue does
+            # not forward headers, so native-path requests admit at NORMAL
+            # priority, request-atomically from the front of the block;
+            # the refused tail gets an explicit 429 + retry-after hint in
+            # the body. The reserve is released after the respond below.
+            gate = getattr(srv, "admission", None)
+            admitted_rows = total
+            if gate is not None:
+                n_admit = 0
+                admitted_rows = 0
+                for i in range(n_reqs):
+                    if not gate.try_admit(counts[i]):
+                        break
+                    admitted_rows += counts[i]
+                    n_admit += 1
+                if n_admit < n_reqs:
+                    rej = json.dumps({
+                        "error": "overloaded",
+                        "retry_after_s": round(gate.retry_after_s, 3),
+                    }).encode()
+                    for i in range(n_admit, n_reqs):
+                        self._lib.ccfd_front_respond_misc(
+                            handle, ids[i], 429, b"application/json",
+                            rej, len(rej),
+                        )
+                        srv._c_requests.inc(labels={"code": "429"})
+                    n_reqs = n_admit
+                    total = admitted_rows
+                    if n_reqs == 0:
+                        continue
             x = rows_buf[:total]
+            t_sc = time.monotonic()
             try:
                 proba = np.ascontiguousarray(
                     np.asarray(srv.scorer.score(x)), np.float32
@@ -424,6 +455,8 @@ class NativeFront:
                         handle, ids[i], 503, b"application/json", err, len(err)
                     )
                     srv._c_requests.inc(labels={"code": "503"})
+                if gate is not None:
+                    gate.release(admitted_rows)
                 continue
             except Exception:  # noqa: BLE001 - fail the requests, not the loop
                 err = b'{"error": "scoring failed"}'
@@ -432,7 +465,12 @@ class NativeFront:
                         handle, ids[i], 500, b"application/json", err, len(err)
                     )
                     srv._c_requests.inc(labels={"code": "500"})
+                if gate is not None:
+                    gate.release(admitted_rows)
                 continue
+            if gate is not None:
+                gate.release(admitted_rows)
+                gate.observe(time.monotonic() - t_sc)
             self._lib.ccfd_front_respond(
                 handle, ids, counts, n_reqs,
                 proba.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), model,
@@ -488,9 +526,11 @@ class NativeFront:
             if path in ("/prometheus", "/metrics"):
                 self._sync_native_counters(handle)
             try:
-                status, ctype, resp = srv._http_handler(
-                    method, path, auth_hdr, body
-                )
+                res = srv._http_handler(method, path, auth_hdr, body)
+                # 3-tuple, or 4-tuple with extra response headers (429
+                # Retry-After); the C++ responder has no header channel,
+                # so the extra headers ride only in the JSON body here
+                status, ctype, resp = res[0], res[1], res[2]
             except Exception:  # noqa: BLE001
                 status, ctype, resp = 500, "text/plain", b"internal error"
             self._lib.ccfd_front_respond_misc(

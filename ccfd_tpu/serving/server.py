@@ -100,6 +100,19 @@ class PredictionServer:
         self._g_v10 = r.gauge("V10", "last scored V10")
         self._httpd: FastHTTPServer | None = None
         self._gauges_set_ms = 0.0  # last Python-path gauge write (monotonic ms)
+        # overload admission (runtime/overload.py): priority-tiered,
+        # request-atomic reserve against an adaptive serving budget —
+        # refused requests get an explicit 429 + retry-after instead of
+        # queueing into a latency collapse. Requests carry their class in
+        # an ``x-ccfd-priority`` header (bulk / normal / critical);
+        # CCFD_OVERLOAD=0 removes the gate entirely.
+        self.admission = None
+        if self.cfg.overload_enabled:
+            from ccfd_tpu.runtime.overload import AdmissionGate
+
+            self.admission = AdmissionGate.from_config(
+                self.cfg, r, max_rows=max(self.scorer.batch_sizes)
+            )
         # dynamic batching (SURVEY.md §7 stage 2: request -> micro-batch
         # queue -> TPU): concurrent requests coalesce into one dispatch;
         # the adaptive policy adds no latency for a lone sequential client
@@ -120,12 +133,41 @@ class PredictionServer:
             self._c_dispatches.inc()
             self._c_batched_rows.inc(n_rows)
 
+        codel = None
+        max_queue_rows = 0
+        on_shed = None
+        if self.cfg.overload_enabled:
+            # CoDel-style queue policy + priority-aware bounded queue
+            # (runtime/overload.py); both default off via their Config
+            # knobs, so plain deployments keep the historical semantics
+            if self.cfg.overload_serve_codel_target_ms > 0:
+                from ccfd_tpu.runtime.overload import DeadlinePolicy
+
+                codel = DeadlinePolicy(
+                    self.cfg.overload_serve_codel_target_ms / 1e3)
+            max_queue_rows = self.cfg.overload_rest_queue_rows
+            if codel is not None or max_queue_rows:
+                from ccfd_tpu.runtime.overload import (
+                    PRIORITY_NAMES,
+                    _shed_counter,
+                )
+
+                c_shed = _shed_counter(self.registry)
+
+                def on_shed(rows: int, priority: int) -> None:
+                    c_shed.inc(rows, labels={
+                        "priority": PRIORITY_NAMES.get(priority, "normal"),
+                        "stage": "batcher"})
+
         return DynamicBatcher(
             self.scorer.score,
             max_batch=max(self.scorer.batch_sizes),
             deadline_ms=self.cfg.batch_deadline_ms,
             on_dispatch=on_dispatch,
             workers=self.cfg.batch_workers,
+            codel=codel,
+            max_queue_rows=max_queue_rows,
+            on_shed=on_shed,
         )
 
     def _sync_dispatch_health(self) -> None:
@@ -144,9 +186,9 @@ class PredictionServer:
             self._host_fallbacks_synced += d
 
     # -- scoring ----------------------------------------------------------
-    def _score_matrix(self, x: np.ndarray) -> np.ndarray:
+    def _score_matrix(self, x: np.ndarray, priority: int = 1) -> np.ndarray:
         if self.batcher is not None:
-            proba = self.batcher.score(x)
+            proba = self.batcher.score(x, priority=priority)
         else:
             proba = self.scorer.score(x)
         if x.shape[0]:
@@ -171,7 +213,8 @@ class PredictionServer:
             "meta": {"model": model},
         }
 
-    def predict_ndarray(self, names: list[str], rows: list[list[float]]) -> dict:
+    def predict_ndarray(self, names: list[str], rows: list[list[float]],
+                        priority: int = 1) -> dict:
         nf = self.scorer.num_features
         if names and names != list(FEATURE_NAMES):
             idx = {n: j for j, n in enumerate(FEATURE_NAMES)}
@@ -194,13 +237,26 @@ class PredictionServer:
                 x = np.zeros((len(rows), nf), np.float32)
                 for i, row in enumerate(rows):
                     x[i, : len(row)] = np.asarray(row, np.float32)[:nf]
-        proba = self._score_matrix(x)
+        proba = self._score_matrix(x, priority=priority)
         return self._response_dict(proba, self.scorer.spec.name)
 
     # -- HTTP plumbing (FastHTTPServer handler contract) -------------------
     def _json(self, code: int, obj: Any) -> tuple[int, str, bytes]:
         self._c_requests.inc(labels={"code": str(code)})
         return code, "application/json", json.dumps(obj).encode()
+
+    def _reject_overload(self, retry_after_s: float):
+        """Explicit admission refusal: 429 with the retry-after hint both
+        as an HTTP header (4-tuple; FastHTTPServer and the native front's
+        misc path send it) and in the JSON body for clients that only
+        read bodies."""
+        self._c_requests.inc(labels={"code": "429"})
+        body = json.dumps({
+            "error": "overloaded",
+            "retry_after_s": round(float(retry_after_s), 3),
+        }).encode()
+        retry = str(max(1, int(-(-retry_after_s // 1))))  # ceil, >= 1s
+        return 429, "application/json", body, {"Retry-After": retry}
 
     def _authorized(self, headers: dict) -> bool:
         token = self.cfg.seldon_token
@@ -247,10 +303,21 @@ class PredictionServer:
             # back to the Python JSON route below
             from ccfd_tpu.serving.dispatch import ScorerTimeout
 
+            gate = self.admission
+            pri = 1
+            if gate is not None:
+                from ccfd_tpu.runtime.overload import parse_priority
+
+                pri = parse_priority(headers.get(b"x-ccfd-priority"))
+
             x = native_decode_ndarray(body, self.scorer.num_features)
             if x is not None:
+                n_rows = x.shape[0]
+                if gate is not None and not gate.try_admit(n_rows, pri):
+                    return self._reject_overload(gate.retry_after_s)
+                t_sc = time.perf_counter()
                 try:
-                    proba = self._score_matrix(x)
+                    proba = self._score_matrix(x, priority=pri)
                 except ScorerTimeout as e:
                     # wedged attachment, no host fallback for this model:
                     # bounded failure (503) instead of a hung connection — the
@@ -260,6 +327,17 @@ class PredictionServer:
                     if sp is not None:
                         sp.status = "error"
                     return self._json(503, {"error": f"scoring unavailable: {e}"})
+                except Exception as e:
+                    from ccfd_tpu.runtime.overload import OverloadShed
+
+                    if isinstance(e, OverloadShed):  # queue policy shed
+                        return self._reject_overload(e.retry_after_s)
+                    raise
+                finally:
+                    if gate is not None:
+                        gate.release(n_rows)
+                if gate is not None:
+                    gate.observe(time.perf_counter() - t_sc)
                 out = self._response_dict(proba, self.scorer.spec.name)
             else:
                 try:
@@ -270,14 +348,29 @@ class PredictionServer:
                 rows = data.get("ndarray")
                 if rows is None or not isinstance(rows, list):
                     return self._json(400, {"error": "missing data.ndarray in request"})
+                if gate is not None and not gate.try_admit(len(rows), pri):
+                    return self._reject_overload(gate.retry_after_s)
+                t_sc = time.perf_counter()
                 try:
-                    out = self.predict_ndarray(data.get("names") or [], rows)
+                    out = self.predict_ndarray(data.get("names") or [], rows,
+                                               priority=pri)
                 except (TypeError, ValueError) as e:
                     return self._json(400, {"error": f"bad ndarray: {e}"})
                 except ScorerTimeout as e:
                     if sp is not None:
                         sp.status = "error"
                     return self._json(503, {"error": f"scoring unavailable: {e}"})
+                except Exception as e:
+                    from ccfd_tpu.runtime.overload import OverloadShed
+
+                    if isinstance(e, OverloadShed):
+                        return self._reject_overload(e.retry_after_s)
+                    raise
+                finally:
+                    if gate is not None:
+                        gate.release(len(rows))
+                if gate is not None:
+                    gate.observe(time.perf_counter() - t_sc)
             self._h_latency.observe(
                 time.perf_counter() - t0, labels={"endpoint": path},
                 exemplar=({"trace_id": trace_id} if trace_id else None),

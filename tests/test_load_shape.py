@@ -1,0 +1,37 @@
+"""CI smoke for the traffic-shape SLO harness (tools/load_shape.py).
+
+The acceptance drill, exit-code gated: a short 5x flash crowd against the
+live in-process pipeline must keep admitted-traffic p99 inside the SLO,
+produce zero accounting violations and zero priority inversions, shed
+bulk traffic hardest and critical least, and move the AIMD limit down
+under the injected latency step and back up after. The same regime runs
+from the shell as ``tools/verify_tier1.sh --overload-smoke``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import load_shape  # noqa: E402
+
+
+def test_flash_crowd_short_regime_holds_every_invariant():
+    res = load_shape.run_flash(seconds=6.0, slo_ms=1200.0, base_rate=4000.0)
+    assert res["violations"] == [], res
+    # the individual invariants, spelled out so a regression names itself
+    assert res["drained"]
+    assert res["counts"]["inversions"] == 0
+    assert res["window_inversions"] == 0
+    assert res["counts"]["shed"] > 0  # the crowd genuinely saturated
+    assert res["counts"]["shed_by_priority_stage"]["critical:budget"] == 0
+    f = res["shed_fraction_by_priority"]
+    assert f["bulk"] >= f["normal"] >= f["critical"]
+    # AIMD moved: collapsed under the latency step, recovered after
+    assert res["limit_min"] < 8192
+    assert res["limit_end"] > res["limit_min"]
+    assert res["p99_ms"] is not None and res["p99_ms"] <= 1200.0
+    # accounting conservation held exactly (also covered by violations)
+    c = res["counts"]
+    assert c["incoming"] == (c["outgoing"] + c["shed"]
+                             + c["start_errors"] + c["score_err"])

@@ -72,6 +72,14 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_lifecycle_score_psi",
     "ccfd_lifecycle_alert_rate_delta",
     "ccfd_lifecycle_canary_rows_total",
+    # round 10: overload control — adaptive admission, priority shedding,
+    # dispatch watchdog (runtime/overload.py)
+    "ccfd_inflight_limit",
+    "ccfd_inflight_used",
+    "ccfd_admission_total",
+    "ccfd_shed_total",
+    "ccfd_priority_inversions_total",
+    "ccfd_dispatch_timeout_total",
 ]
 
 
@@ -89,7 +97,7 @@ def test_dashboards_cover_contract_metrics():
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
-        "ModelLifecycle",
+        "ModelLifecycle", "Overload",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -167,7 +175,7 @@ def test_seldon_board_carries_dispatch_health():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 11
+    assert len(paths) == 12
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")

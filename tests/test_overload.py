@@ -1,0 +1,625 @@
+"""Overload-control invariants (runtime/overload.py + the wiring).
+
+The contract under test, per the overload plane's design:
+
+- AIMD: the adaptive in-flight limit decreases multiplicatively under a
+  latency step (injected via runtime/faults.py, the acceptance path),
+  recovers additively after, and the movement is visible as the
+  ``ccfd_inflight_limit`` gauge.
+- CoDel/deadline queue policy: stale work drops FROM THE FRONT (never
+  the fresh tail), with per-priority cutoffs (bulk first).
+- Flash-crowd shedding: victims are picked lowest-priority-first,
+  oldest-first within a class; the priority-inversion tripwire stays 0.
+- The adaptive limit is ONE object shared by every parallel-router
+  worker (the PR-3 global-bound semantics, made dynamic).
+- REST admission: refusals are explicit 429s with a retry-after hint;
+  priority tiers make bulk refuse first.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.router import Router
+from ccfd_tpu.runtime.faults import FaultPlan, FaultSpec
+from ccfd_tpu.runtime.overload import (
+    PRIORITY_BULK,
+    PRIORITY_CRITICAL,
+    PRIORITY_NORMAL,
+    AdaptiveInflightBudget,
+    AdmissionGate,
+    DeadlinePolicy,
+    OverloadControl,
+    OverloadShed,
+    headers_priority,
+    parse_priority,
+)
+
+
+# -- priority parsing --------------------------------------------------------
+def test_parse_priority_names_aliases_ints_and_garbage():
+    assert parse_priority("bulk") == PRIORITY_BULK
+    assert parse_priority(b"critical") == PRIORITY_CRITICAL
+    assert parse_priority("fraud") == PRIORITY_CRITICAL
+    assert parse_priority("canary") == PRIORITY_CRITICAL
+    assert parse_priority("rescore") == PRIORITY_BULK
+    assert parse_priority("2") == PRIORITY_CRITICAL
+    assert parse_priority(7) == PRIORITY_CRITICAL  # clamped
+    assert parse_priority(None) == PRIORITY_NORMAL
+    assert parse_priority("nonsense") == PRIORITY_NORMAL
+    assert headers_priority({"priority": "bulk"}) == PRIORITY_BULK
+    assert headers_priority([(b"priority", b"critical")]) == PRIORITY_CRITICAL
+    assert headers_priority(None) == PRIORITY_NORMAL
+
+
+# -- AIMD limiter ------------------------------------------------------------
+def test_aimd_decrease_is_multiplicative_and_cooldown_limited():
+    clock = [0.0]
+    b = AdaptiveInflightBudget(
+        1024, min_limit=64, max_limit=4096, target_s=0.05,
+        beta=0.5, decrease_cooldown_s=1.0, clock=lambda: clock[0],
+    )
+    b.observe(0.2)  # over budget: one multiplicative cut
+    assert b.limit == 512
+    b.observe(0.2)  # inside the cooldown: NO second cut
+    assert b.limit == 512
+    clock[0] = 1.5
+    b.observe(0.2)
+    assert b.limit == 256
+    for _ in range(50):  # floors at min_limit
+        clock[0] += 2.0
+        b.observe(0.2)
+    assert b.limit == 64
+
+
+def test_aimd_increase_is_additive_after_good_window():
+    clock = [0.0]
+    b = AdaptiveInflightBudget(
+        1024, min_limit=64, max_limit=2048, target_s=0.05,
+        step=100, good_window=4, increase_interval_s=0.0,
+        clock=lambda: clock[0],
+    )
+    for _ in range(3):
+        b.observe(0.01)
+    assert b.limit == 1024  # window not yet full
+    b.observe(0.01)
+    assert b.limit == 1124  # +step after good_window samples
+    for _ in range(100):
+        b.observe(0.01)
+    assert b.limit == 2048  # capped at max_limit
+    # one bad sample resets the good window
+    b.observe(0.2)
+    assert b.limit == 1433  # int(2048 * 0.7)
+
+
+def test_aimd_limit_and_utilization_exported_as_gauges():
+    reg = Registry()
+    b = AdaptiveInflightBudget(100, min_limit=10, max_limit=200,
+                               target_s=0.05, registry=reg, stage="router")
+    g_lim = reg.gauge("ccfd_inflight_limit")
+    g_used = reg.gauge("ccfd_inflight_used")
+    assert g_lim.value(labels={"stage": "router"}) == 100
+    assert b.reserve(30) == 30
+    assert g_used.value(labels={"stage": "router"}) == 30
+    b.observe(1.0)  # decrease must show on the gauge
+    assert g_lim.value(labels={"stage": "router"}) == 70
+    b.release(30)
+    assert g_used.value(labels={"stage": "router"}) == 0
+
+
+# -- deadline (CoDel) policy -------------------------------------------------
+def test_deadline_policy_priority_scaled_cutoffs():
+    p = DeadlinePolicy(0.1)
+    assert p.should_drop(0.15, PRIORITY_BULK)
+    assert not p.should_drop(0.15, PRIORITY_NORMAL)
+    assert p.should_drop(0.25, PRIORITY_NORMAL)
+    assert not p.should_drop(0.35, PRIORITY_CRITICAL)
+    assert p.should_drop(0.45, PRIORITY_CRITICAL)
+
+
+class _Rec:
+    __slots__ = ("timestamp", "headers", "value", "key")
+
+    def __init__(self, ts, priority=None):
+        self.timestamp = ts
+        self.headers = {"priority": priority} if priority else None
+        self.value = b""
+        self.key = 0
+
+
+def _control(registry=None, limit=1000, codel_target=None, **kw):
+    reg = registry or Registry()
+    budget = AdaptiveInflightBudget(
+        limit, min_limit=limit, max_limit=limit, target_s=0.05,
+        registry=reg, stage="router")
+    codel = DeadlinePolicy(codel_target) if codel_target else None
+    return OverloadControl(reg, budget, codel=codel, **kw), reg
+
+
+def test_codel_drops_stale_front_not_fresh_tail():
+    now = 1000.0
+    ov, reg = _control(codel_target=0.1, clock=lambda: now)
+    recs = [_Rec(now - 0.5), _Rec(now - 0.3), _Rec(now - 0.01)]
+    keep, shed = ov.admit(recs)
+    assert shed == 2
+    assert keep == [recs[2]]  # the fresh TAIL survives; stale head drops
+    assert reg.counter("ccfd_shed_total").value(
+        labels={"priority": "normal", "stage": "deadline"}) == 2
+    ov.budget.release(len(keep))
+
+
+def test_codel_catches_stale_records_behind_a_fresh_head():
+    """Multi-partition polls concatenate partitions in partition order:
+    a fresh head must not hide a lagging partition's stale tail from the
+    deadline scan (the hot-key skew case)."""
+    now = 1000.0
+    ov, reg = _control(codel_target=0.1, clock=lambda: now)
+    recs = [_Rec(now - 0.01), _Rec(now - 5.0)]  # fresh head, stale tail
+    keep, shed = ov.admit(recs)
+    assert shed == 1
+    assert keep == [recs[0]]
+    ov.budget.release(len(keep))
+
+
+def test_codel_priority_scaled_grace_sheds_bulk_before_critical():
+    now = 1000.0
+    ov, reg = _control(codel_target=0.1, clock=lambda: now)
+    age = now - 0.25  # past bulk (0.1) and normal (0.2), not critical (0.4)
+    recs = [_Rec(age, "bulk"), _Rec(age, "normal"), _Rec(age, "critical")]
+    keep, shed = ov.admit(recs)
+    assert shed == 2
+    assert [r.headers["priority"] for r in keep] == ["critical"]
+    ov.budget.release(len(keep))
+
+
+# -- flash-crowd budget shedding --------------------------------------------
+def test_budget_shed_takes_lowest_priority_first_oldest_within_class():
+    now = 1000.0
+    ov, reg = _control(limit=4, clock=lambda: now)
+    recs = [
+        _Rec(now - 0.9, "normal"),    # oldest normal
+        _Rec(now - 0.8, "bulk"),      # oldest bulk  -> shed 1st
+        _Rec(now - 0.7, "critical"),
+        _Rec(now - 0.6, "bulk"),      # younger bulk -> shed 2nd
+        _Rec(now - 0.5, "normal"),
+        _Rec(now - 0.4, "critical"),
+    ]
+    keep, shed = ov.admit(recs)
+    assert shed == 2
+    kept_p = [r.headers["priority"] for r in keep]
+    assert kept_p == ["normal", "critical", "normal", "critical"]
+    c = reg.counter("ccfd_shed_total")
+    assert c.value(labels={"priority": "bulk", "stage": "budget"}) == 2
+    assert c.value(labels={"priority": "critical", "stage": "budget"}) == 0
+    assert reg.counter("ccfd_priority_inversions_total").value() == 0
+    # arrival order preserved among survivors
+    assert [r.timestamp for r in keep] == sorted(
+        r.timestamp for r in keep)
+    ov.budget.release(len(keep))
+
+
+def test_budget_shed_eats_into_normal_only_after_bulk_is_gone():
+    now = 1000.0
+    ov, _ = _control(limit=2, clock=lambda: now)
+    recs = [_Rec(now - 0.5, "normal"), _Rec(now - 0.4, "bulk"),
+            _Rec(now - 0.3, "normal"), _Rec(now - 0.2, "critical")]
+    keep, shed = ov.admit(recs)
+    assert shed == 2  # the one bulk + the OLDEST normal
+    assert [r.headers["priority"] for r in keep] == ["normal", "critical"]
+    assert keep[0].timestamp == now - 0.3
+    ov.budget.release(len(keep))
+
+
+def test_prepaid_admit_releases_shed_rows_and_reserves_survivors():
+    now = 1000.0
+    ov, _ = _control(limit=100, codel_target=0.1, clock=lambda: now)
+    recs = [_Rec(now - 0.5), _Rec(now - 0.01)]
+    granted = ov.budget.reserve(len(recs))  # the router's poll prepay
+    assert granted == 2
+    keep, shed = ov.admit(recs, prepaid=True)
+    assert shed == 1 and len(keep) == 1
+    assert ov.budget.inflight == 1  # shed row's reservation handed back
+    ov.budget.release(len(keep))
+    assert ov.budget.inflight == 0
+
+
+# -- router integration: AIMD moves under an injected latency step -----------
+def _make_router(reg, broker, overload, **kw):
+    cfg = Config()
+    engine = build_engine(cfg, broker, reg, None)
+    return cfg, Router(
+        cfg, broker, kw.pop("score_fn"), engine, reg,
+        max_batch=256, overload=overload, **kw,
+    )
+
+
+def test_aimd_limit_decreases_under_injected_latency_step_and_recovers():
+    """The acceptance drill: a latency fault (runtime/faults.py) on the
+    scorer edge collapses the adaptive limit; deactivating the plan lets
+    it climb back. Asserted on the limiter AND its exported gauge."""
+    reg = Registry()
+    broker = Broker(default_partitions=1)
+    budget = AdaptiveInflightBudget(
+        1024, min_limit=128, max_limit=2048, target_s=0.02,
+        step=128, good_window=2, decrease_cooldown_s=0.0, registry=reg)
+    ov = OverloadControl(reg, budget)
+    plan = FaultPlan({"scorer": FaultSpec(latency_ms=50.0)}, active=False)
+    inj = plan.injector("scorer", reg)
+    score_fn = inj.wrap_fn(lambda x: np.zeros(x.shape[0], np.float32))
+    cfg, router = _make_router(reg, broker, ov, score_fn=score_fn)
+    rows = [b"0.0" + b",0.0" * 29] * 64
+    g_lim = reg.gauge("ccfd_inflight_limit")
+
+    def drive(n_batches):
+        for _ in range(n_batches):
+            broker.produce_batch(cfg.kafka_topic, rows, list(range(64)))
+            router.step()
+
+    drive(4)
+    baseline = budget.limit
+    assert baseline >= 1024  # fast scoring grew (or held) the limit
+
+    plan.activate()  # the latency step
+    drive(6)
+    stepped = budget.limit
+    assert stepped < baseline
+    assert g_lim.value(labels={"stage": "router"}) == stepped
+
+    plan.deactivate()  # recovery
+    drive(8)
+    assert budget.limit > stepped
+    assert g_lim.value(labels={"stage": "router"}) == budget.limit
+    router.close()
+
+
+def test_flash_crowd_shed_ordering_through_router_poll_path():
+    """End-to-end over the bus: stale mixed-priority backlog at poll time
+    sheds bulk first (its deadline grace is 1x vs critical's 4x), the
+    tripwire stays 0, and shed records still count as incoming."""
+    reg = Registry()
+    broker = Broker(default_partitions=1)
+    budget = AdaptiveInflightBudget(
+        4096, min_limit=4096, max_limit=4096, target_s=10.0, registry=reg)
+    t = [0.0]
+    ov = OverloadControl(reg, budget, codel=DeadlinePolicy(0.1),
+                         clock=lambda: t[0])
+    score_fn = lambda x: np.zeros(x.shape[0], np.float32)  # noqa: E731
+    cfg, router = _make_router(reg, broker, ov, score_fn=score_fn)
+    rows = [b"0.0" + b",0.0" * 29] * 32
+    for pri in ("bulk", "normal", "critical"):
+        broker.produce_batch(cfg.kafka_topic, rows, list(range(32)),
+                             headers={"priority": pri})
+    # age the backlog past bulk (0.1s) and normal (0.2s) cutoffs but not
+    # critical (0.4s) — injectable clock, no sleeps
+    t[0] = time.time() + 0.3
+    routed = router.step()
+    assert routed == 32  # critical only
+    c = reg.counter("ccfd_shed_total")
+    assert c.value(labels={"priority": "bulk", "stage": "deadline"}) == 32
+    assert c.value(labels={"priority": "normal", "stage": "deadline"}) == 32
+    assert c.value(
+        labels={"priority": "critical", "stage": "deadline"}) == 0
+    assert reg.counter("router_shed_total").value() == 64
+    assert reg.counter("transaction_incoming_total").value() == 96
+    assert reg.counter("ccfd_priority_inversions_total").value() == 0
+    assert budget.inflight == 0
+    router.close()
+
+
+def test_backpressure_poll_is_budget_prepaid():
+    """With the budget exhausted the router must NOT consume — the
+    backlog stays in the bus as observable lag instead of being consumed
+    into a shed."""
+    reg = Registry()
+    broker = Broker(default_partitions=1)
+    budget = AdaptiveInflightBudget(
+        64, min_limit=64, max_limit=64, target_s=10.0, registry=reg)
+    ov = OverloadControl(reg, budget)
+    score_fn = lambda x: np.zeros(x.shape[0], np.float32)  # noqa: E731
+    cfg, router = _make_router(reg, broker, ov, score_fn=score_fn)
+    rows = [b"0.0" + b",0.0" * 29] * 128
+    broker.produce_batch(cfg.kafka_topic, rows, list(range(128)))
+    taken = budget.reserve(64)  # someone else holds the whole budget
+    assert taken == 64
+    assert router.step() == 0
+    assert reg.counter("transaction_incoming_total").value() == 0
+    assert reg.counter("router_shed_total").value() == 0
+    budget.release(64)
+    # room back: the poll consumes at most the grant per cycle
+    assert router.step() == 64
+    assert router.step() == 64
+    assert budget.inflight == 0
+    router.close()
+
+
+def test_parallel_router_workers_share_one_adaptive_budget():
+    from ccfd_tpu.router.parallel import ParallelRouter
+
+    reg = Registry()
+    broker = Broker(default_partitions=4)
+    budget = AdaptiveInflightBudget(
+        512, min_limit=128, max_limit=1024, target_s=0.05, registry=reg)
+    ov = OverloadControl(reg, budget)
+    cfg = Config()
+    engine = build_engine(cfg, broker, reg, None)
+    pr = ParallelRouter(
+        cfg, broker, lambda x: np.zeros(x.shape[0], np.float32), engine,
+        reg, workers=3, overload=ov,
+    )
+    assert pr._budget is budget
+    for w in pr.workers:
+        assert w._budget is budget
+        assert w._overload is ov
+    rows = [b"0.0" + b",0.0" * 29] * 16
+    broker.produce_batch(cfg.kafka_topic, rows, list(range(16)))
+    assert pr.step() == 16
+    assert budget.inflight == 0  # every worker released into the one pool
+    pr.close()
+
+
+def test_operator_wires_overload_by_default_and_cr_can_disable():
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "store": False, "producer": False, "investigator": False,
+        "retrain": False, "analytics": False, "monitoring": False,
+        "health": False, "notify": False, "lifecycle": False,
+        "tracing": False,
+        "scorer": {"enabled": True, "model": "logreg"},
+    }}
+    p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up(wait_ready_s=30)
+    try:
+        assert p.router._overload is not None
+        assert p.router._budget is p.router._overload.budget
+        # the gauges land on the router's scraped registry
+        assert p.registries["router"].gauge("ccfd_inflight_limit").value(
+            labels={"stage": "router"}) > 0
+        # REST admission gate built on the serving side
+        assert p.prediction_server is None  # rest not enabled here
+    finally:
+        p.down()
+
+    cr["spec"]["overload"] = {"enabled": False}
+    p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up(wait_ready_s=30)
+    try:
+        assert p.router._overload is None
+        assert type(p.router._budget).__name__ == "InflightBudget"
+    finally:
+        p.down()
+
+
+def test_operator_cr_max_inflight_is_a_hard_ceiling_on_aimd():
+    """A CR max_inflight below the adaptive floor must clamp min_limit
+    too — otherwise the first AIMD decrease (max(min_limit, limit*beta))
+    snaps the limit back ABOVE the operator's bound."""
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "store": False, "producer": False, "investigator": False,
+        "retrain": False, "analytics": False, "monitoring": False,
+        "health": False, "notify": False, "lifecycle": False,
+        "tracing": False,
+        "scorer": {"enabled": True, "model": "logreg"},
+        "router": {"max_inflight": 1024},  # below the 4096 default floor
+    }}
+    p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up(wait_ready_s=30)
+    try:
+        b = p.router._overload.budget
+        assert b.limit <= 1024 and b.max_limit <= 1024
+        b.observe(10.0)  # a decrease must stay under the cap
+        assert b.limit <= 1024
+    finally:
+        p.down()
+
+
+# -- dispatch watchdog -------------------------------------------------------
+def test_dispatch_watchdog_times_out_and_trips_the_breaker():
+    from ccfd_tpu.runtime.breaker import CircuitBreaker
+
+    reg = Registry()
+    broker = Broker(default_partitions=1)
+    budget = AdaptiveInflightBudget(
+        1024, min_limit=64, max_limit=1024, target_s=0.05, registry=reg)
+    ov = OverloadControl(reg, budget, dispatch_deadline_ms=50.0)
+    calls = {"n": 0}
+
+    def hung_score(x):
+        calls["n"] += 1
+        time.sleep(0.6)  # wedged dispatch: far past the 50 ms deadline
+        return np.zeros(x.shape[0], np.float32)
+
+    breaker = CircuitBreaker(edge="scorer", registry=reg, min_calls=2,
+                             failure_ratio=0.5, cooldown_s=30.0)
+    cfg = Config()
+    engine = build_engine(cfg, broker, reg, None)
+    router = Router(cfg, broker, hung_score, engine, reg, max_batch=64,
+                    overload=ov, breaker=breaker, degrade=True)
+    rows = [b"0.0" + b",0.0" * 29] * 8
+    for _ in range(3):
+        broker.produce_batch(cfg.kafka_topic, rows, list(range(8)))
+        assert router.step() == 8  # rules tier still decides every row
+    # watchdog fired (and counted); the breaker OPENED so later batches
+    # skip the wedged edge entirely (calls stop growing)
+    assert reg.counter("ccfd_dispatch_timeout_total").value() >= 2
+    assert breaker.state == "open"
+    calls_at_open = calls["n"]
+    broker.produce_batch(cfg.kafka_topic, rows, list(range(8)))
+    assert router.step() == 8
+    assert calls["n"] == calls_at_open
+    assert reg.counter("router_degraded_total").value(
+        labels={"tier": "rules"}) >= 8
+    router.close()
+
+
+# -- serving-side admission (REST 429 path) ----------------------------------
+def _serving_server(**cfg_kw):
+    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.serving.server import PredictionServer
+
+    cfg = Config(dynamic_batching=False, native_front=False, **cfg_kw)
+    scorer = Scorer(model_name="logreg", batch_sizes=(16, 128),
+                    host_tier_rows=0)
+    return PredictionServer(scorer, cfg, Registry())
+
+
+def _predict(srv, rows=1, headers=None):
+    import json
+
+    body = json.dumps(
+        {"data": {"ndarray": [[0.0] * 30] * rows}}).encode()
+    res = srv._http_handler("POST", "/api/v0.1/predictions",
+                            headers or {}, body)
+    return res
+
+
+def test_rest_admission_429_with_retry_after():
+    import json
+
+    srv = _serving_server()
+    assert srv.admission is not None
+    ok = _predict(srv, rows=2)
+    assert ok[0] == 200
+    # saturate the serving budget so the next request is refused
+    srv.admission.budget.reserve(srv.admission.budget.limit)
+    res = _predict(srv, rows=2)
+    assert res[0] == 429
+    body = json.loads(res[2])
+    assert body["error"] == "overloaded"
+    assert body["retry_after_s"] > 0
+    assert len(res) == 4 and "Retry-After" in res[3]
+    assert srv.registry.counter(
+        "seldon_api_executor_server_requests_total").value(
+        labels={"code": "429"}) == 1
+    # refusal released nothing: draining the budget un-sticks admission
+    srv.admission.budget.release(srv.admission.budget.limit)
+    assert _predict(srv, rows=2)[0] == 200
+    srv.stop()
+
+
+def test_rest_priority_tiers_bulk_refused_before_critical():
+    srv = _serving_server()
+    b = srv.admission.budget
+    # fill to just above the bulk ceiling (50%) but under critical (100%)
+    b.reserve(int(b.limit * 0.6))
+    assert _predict(srv, rows=1,
+                    headers={b"x-ccfd-priority": b"bulk"})[0] == 429
+    assert _predict(srv, rows=1,
+                    headers={b"x-ccfd-priority": b"critical"})[0] == 200
+    srv.stop()
+
+
+def test_rest_oversize_request_admits_when_idle():
+    srv = _serving_server()
+    # bigger than the whole serving limit, but the stage is idle: the
+    # empty-pass rule must admit it rather than starve it forever
+    assert _predict(srv, rows=srv.admission.budget.limit + 7)[0] == 200
+    assert srv.admission.budget.inflight == 0
+    srv.stop()
+
+
+def test_overload_disabled_removes_gate_and_batcher_policy():
+    srv = _serving_server(overload_enabled=False)
+    assert srv.admission is None
+    assert _predict(srv, rows=4)[0] == 200
+    srv.stop()
+
+
+# -- serving batcher queue policy --------------------------------------------
+def test_batcher_codel_sheds_stale_head_serves_fresh_tail():
+    import threading
+
+    from ccfd_tpu.serving.batcher import DynamicBatcher
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_score(x):
+        started.set()
+        release.wait(timeout=5.0)
+        return np.zeros(x.shape[0], np.float32)
+
+    shed = []
+    b = DynamicBatcher(
+        slow_score, max_batch=64, deadline_ms=0.0,
+        codel=DeadlinePolicy(0.05),
+        on_shed=lambda rows, pri: shed.append((rows, pri)),
+    )
+    f0 = b.submit(np.zeros((1, 30), np.float32))  # occupies the worker
+    assert started.wait(timeout=5.0)
+    f_stale = b.submit(np.zeros((2, 30), np.float32))  # queues, goes stale
+    time.sleep(0.15)  # stale: sojourn > 2x the 50 ms normal cutoff
+    f_fresh = b.submit(np.zeros((3, 30), np.float32))
+    release.set()
+    assert f0.result(timeout=5.0).shape == (1,)
+    with pytest.raises(OverloadShed):
+        f_stale.result(timeout=5.0)
+    assert f_fresh.result(timeout=5.0).shape == (3,)
+    assert shed == [(2, 1)]
+    assert b.shed_rows == 2
+    b.stop()
+
+
+def test_batcher_bounded_queue_evicts_lower_priority_for_higher():
+    import threading
+
+    from ccfd_tpu.serving.batcher import DynamicBatcher
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_score(x):
+        started.set()
+        release.wait(timeout=5.0)
+        return np.zeros(x.shape[0], np.float32)
+
+    b = DynamicBatcher(slow_score, max_batch=64, deadline_ms=0.0,
+                       max_queue_rows=4)
+    b.submit(np.zeros((1, 30), np.float32))  # taken by the worker
+    assert started.wait(timeout=5.0)
+    f_bulk = b.submit(np.zeros((4, 30), np.float32), priority=0)
+    # a critical arrival evicts the queued bulk work to make room
+    f_crit = b.submit(np.zeros((4, 30), np.float32), priority=2)
+    with pytest.raises(OverloadShed):
+        f_bulk.result(timeout=5.0)
+    # and a bulk arrival against a full same-or-higher queue is refused
+    # synchronously
+    with pytest.raises(OverloadShed):
+        b.submit(np.zeros((4, 30), np.float32), priority=0)
+    release.set()
+    assert f_crit.result(timeout=5.0).shape == (4,)
+    b.stop()
+
+
+def test_batcher_oversize_arrival_never_evicts_and_idle_passes():
+    import threading
+
+    from ccfd_tpu.serving.batcher import DynamicBatcher
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_score(x):
+        started.set()
+        release.wait(timeout=5.0)
+        return np.zeros(x.shape[0], np.float32)
+
+    b = DynamicBatcher(slow_score, max_batch=64, deadline_ms=0.0,
+                       max_queue_rows=4)
+    # idle-pass: an oversize request against an empty queue runs alone
+    f_big = b.submit(np.zeros((10, 30), np.float32))
+    assert started.wait(timeout=5.0)
+    f_bulk = b.submit(np.zeros((2, 30), np.float32), priority=0)
+    # an oversize arrival that can NEVER fit must be refused without
+    # destroying the queued (serviceable) bulk work
+    with pytest.raises(OverloadShed):
+        b.submit(np.zeros((10, 30), np.float32), priority=2)
+    assert not f_bulk.done()  # the queued work survived
+    release.set()
+    assert f_big.result(timeout=5.0).shape == (10,)
+    assert f_bulk.result(timeout=5.0).shape == (2,)
+    b.stop()

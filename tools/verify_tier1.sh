@@ -19,10 +19,28 @@
 #   tools/verify_tier1.sh                  run the suite, then tally
 #   tools/verify_tier1.sh --parse-only F   tally an existing log file F
 #                                          (used by tests/test_verify_tier1.py)
+#   tools/verify_tier1.sh --overload-smoke run the traffic-shape SLO
+#                                          harness's short flash-crowd
+#                                          regime (tools/load_shape.py)
+#                                          and gate on its exit code:
+#                                          OVERLOAD verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
+
+if [ "${1:-}" = "--overload-smoke" ]; then
+    # exit-code-gated smoke of the overload plane: a 5x flash crowd must
+    # keep admitted p99 inside the SLO with zero accounting violations
+    # and zero priority inversions (see tools/load_shape.py)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/load_shape.py --regime flash --short; then
+        echo "OVERLOAD verdict=PASS"
+        exit 0
+    fi
+    echo "OVERLOAD verdict=FAIL"
+    exit 1
+fi
 
 if [ "${1:-}" = "--parse-only" ]; then
     LOG="${2:?--parse-only needs a log file}"
