@@ -170,6 +170,22 @@ class Config:
     # cpu — same resolution as the server-side dispatch deadline); 0 = off
     overload_dispatch_deadline_ms: float = -1.0  # CCFD_OVERLOAD_DISPATCH_DEADLINE_MS
 
+    # --- sequence serving (serving/history.py; CR block `scorer.seq_*`) ---
+    # HistoryStore stripe count: per-stripe locks keep ParallelRouter
+    # workers from convoying on one global lock (CCFD_SEQ_STRIPES)
+    seq_stripes: int = 8
+    # async dispatches in flight before the scoring loop blocks on the
+    # oldest; 0 restores the synchronous chunk loop (CCFD_SEQ_INFLIGHT)
+    seq_inflight: int = 2
+    # short-sequence ladder: a row whose post-append history depth fits a
+    # bucket dispatches through that (bucket, F) executable instead of
+    # padding to full L. OFF by default (empty): short windows attend
+    # fewer zero-pad tokens than the full-L graph (no padding mask in
+    # the attention), so cold-row scores differ between rungs — arm it
+    # explicitly for dispatch-bound deployments where that tradeoff is
+    # acceptable (CCFD_SEQ_LEN_BUCKETS, comma-separated, e.g. "1,8")
+    seq_len_buckets: Sequence[int] = ()
+
     # --- TPU scorer knobs (new) ---
     model_name: str = "mlp"
     graph_cr: str = ""  # SeldonDeployment-shaped CR file -> serving/graph.py
@@ -229,7 +245,16 @@ class Config:
     def from_env(env: Mapping[str, str] | None = None) -> "Config":
         e = dict(os.environ if env is None else env)
         sizes = e.get("CCFD_BATCH_SIZES", "")
+        seq_lb = e.get("CCFD_SEQ_LEN_BUCKETS", "")
         return Config(
+            seq_stripes=int(e.get("CCFD_SEQ_STRIPES", str(Config.seq_stripes))),
+            seq_inflight=int(
+                e.get("CCFD_SEQ_INFLIGHT", str(Config.seq_inflight))
+            ),
+            seq_len_buckets=(
+                tuple(int(s) for s in seq_lb.split(",") if s.strip())
+                if seq_lb else Config.seq_len_buckets
+            ),
             broker_url=e.get("BROKER_URL", Config.broker_url),
             bus_log_dir=e.get("CCFD_BUS_DIR", Config.bus_log_dir),
             bus_fsync=e.get("CCFD_BUS_FSYNC", "") in ("1", "true", "yes"),

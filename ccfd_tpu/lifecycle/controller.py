@@ -120,35 +120,50 @@ class CanaryGate:
     def weights(self) -> tuple[float, float]:
         return self._weights
 
-    def wrap(self, score_fn: Callable[[np.ndarray], np.ndarray]) -> Callable:
+    def apply(self, x: np.ndarray, proba: np.ndarray,
+              rescore: Callable[[np.ndarray], np.ndarray] | None = None,
+              ) -> np.ndarray:
+        """Override one batch's challenger arm. ``x`` (B, F) drives the
+        deterministic hash split; ``rescore(mask) -> (n_chall,) scores``
+        lets context-aware scorers (the SeqScorer's history-conditioned
+        lane) re-score the challenger arm against the SAME assembled
+        contexts — default is the challenger slot's cold forward on the
+        masked feature rows (the row lane)."""
+        if not self._active:
+            return proba
         from ccfd_tpu.serving.graph import hash_split_arms_numpy
 
+        arms = hash_split_arms_numpy(x, self._weights)
+        mask = arms == 1
+        n_chall = int(mask.sum())
+        if n_chall:
+            try:
+                if rescore is not None:
+                    chall = rescore(mask)
+                else:
+                    chall = self.scorer.challenger_score(
+                        np.asarray(x, np.float32)[mask])
+            except Exception:  # noqa: BLE001 - challenger gone mid-swap:
+                # champion scores stand; the controller sees the error
+                # counter and the breaker sees nothing (host-side only)
+                if self._c_errors is not None:
+                    self._c_errors.inc(n_chall)
+                return proba
+            proba = np.array(proba, np.float32, copy=True)
+            proba[mask] = chall
+        if self._c_rows is not None:
+            self._c_rows.inc(len(x) - n_chall,
+                             labels={"arm": "champion"})
+            if n_chall:
+                self._c_rows.inc(n_chall, labels={"arm": "challenger"})
+        return proba
+
+    def wrap(self, score_fn: Callable[[np.ndarray], np.ndarray]) -> Callable:
         def gated(x: np.ndarray) -> np.ndarray:
             proba = score_fn(x)
             if not self._active:
                 return proba
-            weights = self._weights
-            arms = hash_split_arms_numpy(x, weights)
-            mask = arms == 1
-            n_chall = int(mask.sum())
-            if n_chall:
-                try:
-                    chall = self.scorer.challenger_score(
-                        np.asarray(x, np.float32)[mask])
-                except Exception:  # noqa: BLE001 - challenger gone mid-swap:
-                    # champion scores stand; the controller sees the error
-                    # counter and the breaker sees nothing (host-side only)
-                    if self._c_errors is not None:
-                        self._c_errors.inc(n_chall)
-                    return proba
-                proba = np.array(proba, np.float32, copy=True)
-                proba[mask] = chall
-            if self._c_rows is not None:
-                self._c_rows.inc(len(x) - n_chall,
-                                 labels={"arm": "champion"})
-                if n_chall:
-                    self._c_rows.inc(n_chall, labels={"arm": "challenger"})
-            return proba
+            return self.apply(x, proba)
 
         gated.__wrapped__ = score_fn
         return gated

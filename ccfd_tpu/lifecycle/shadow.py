@@ -44,11 +44,19 @@ class ShadowTap:
         registry: Any = None,
         max_queued_batches: int = 64,
         max_rows_per_s: float = 2048.0,
+        max_queued_rows: int = 8192,
     ):
         self.scorer = scorer
         self.broker = broker
         self.topic = topic
         self.max_queued_batches = int(max_queued_batches)
+        # row-denominated queue bound: the seq lane offers full (B, L, F)
+        # history batches (~L x the row lane's bytes per row-batch), so a
+        # batch-count bound alone would admit gigabytes of resident
+        # tapped state behind a slow challenger; oldest batches drop
+        # first past either bound
+        self.max_queued_rows = int(max_queued_rows)
+        self._queued_rows = 0
         # sampling budget: rows/s admitted into the shadow queue. Deficit
         # token bucket — a batch is admitted whenever the balance is
         # positive and then charged in full, so batches BIGGER than one
@@ -106,6 +114,16 @@ class ShadowTap:
         tapped.__wrapped__ = score_fn  # introspection/debugging
         return tapped
 
+    def offer(self, x: np.ndarray, proba: Any) -> None:
+        """Direct tap entry for scorers the router calls as an OBJECT
+        (``score_with_ids`` — serving/history.py SeqScorer): there is no
+        score_fn to :meth:`wrap`, so the scorer offers each resolved
+        batch itself. Same budget/queue bounds, same no-challenger cost
+        (one attribute read)."""
+        version = self._armed_version
+        if version is not None:
+            self._offer(version, x, proba)
+
     def _offer(self, version: int, x: np.ndarray, proba: Any) -> None:
         with self._mu:
             if self.max_rows_per_s > 0:
@@ -124,11 +142,25 @@ class ShadowTap:
                         self._c_dropped.inc(len(x))
                     return
                 self._tokens -= len(x)  # may go negative: deficit charge
-            if len(self._queue) >= self.max_queued_batches:
+            if self.max_queued_rows > 0 and len(x) > self.max_queued_rows:
+                # an offer that can NEVER fit drops itself — evicting the
+                # whole queue of serviceable pairs for it would be the
+                # oversize-arrival defect the PR 6 batcher hardening
+                # fixed (the verdict window just grows)
+                if self._c_dropped is not None:
+                    self._c_dropped.inc(len(x))
+                return
+            while self._queue and (
+                    len(self._queue) >= self.max_queued_batches
+                    or (self.max_queued_rows > 0
+                        and self._queued_rows + len(x)
+                        > self.max_queued_rows)):
                 _, x_old, _ = self._queue.popleft()
+                self._queued_rows -= len(x_old)
                 if self._c_dropped is not None:
                     self._c_dropped.inc(len(x_old))
             self._queue.append((version, x, np.asarray(proba)))
+            self._queued_rows += len(x)
         if self._c_batches is not None:
             self._c_batches.inc()
 
@@ -136,12 +168,14 @@ class ShadowTap:
     def arm(self, version: int) -> None:
         with self._mu:
             self._queue.clear()  # pairs from an older candidate are noise
+            self._queued_rows = 0
             self._armed_version = int(version)
 
     def disarm(self) -> None:
         with self._mu:
             self._armed_version = None
             self._queue.clear()
+            self._queued_rows = 0
 
     @property
     def armed_version(self) -> int | None:
@@ -161,6 +195,7 @@ class ShadowTap:
                 if not self._queue:
                     return rows
                 version, x, champ = self._queue.popleft()
+                self._queued_rows -= len(x)
             if version != self._armed_version:
                 continue  # stale pair from a superseded candidate
             try:

@@ -78,3 +78,10 @@ register_model(
 from ccfd_tpu.ops import quant as _quant  # noqa: E402
 
 _quant.register()
+
+# sequence family: seq (bf16 champion) + seq_q8 (int8 lifecycle-gated
+# variant); served through SeqScorer, not the row Scorer — see
+# ops/seq_quant.register for the contract
+from ccfd_tpu.ops import seq_quant as _seq_quant  # noqa: E402
+
+_seq_quant.register()

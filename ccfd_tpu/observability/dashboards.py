@@ -24,6 +24,9 @@ metric names, one builder per board:
 - Overload     — adaptive admission / priority shedding / backpressure
   surface of the overload-control plane (new capability; no reference
   analog)
+- SeqServing   — overlapped sequence-serving dataflow: assembly/dispatch
+  split, (L, B)-bucket executable mix, async in-flight depth, stale-commit
+  crash-replay tripwire (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -464,6 +467,41 @@ def lifecycle_dashboard() -> dict:
     return _dashboard("CCFD Model Lifecycle", "ccfd-lifecycle", p)
 
 
+def seq_serving_dashboard() -> dict:
+    """Sequence Serving board (round 11; serving/history.py).
+
+    The overlapped seq dataflow's surface: host assembly vs device
+    dispatch per router batch (the BENCH_r05 1412-vs-13 ms split, now
+    live numbers — dispatch here counts only the blocking waits the
+    overlap failed to hide), the (L, B)-bucket executable mix (short L
+    buckets firing = the cold-row fast lane actually serving), async
+    in-flight depth, the anonymous lock-free fast path, live-history
+    customers against the LRU cap, and the stale-generation commit
+    counter — nonzero only when a dispatch was in flight across a crash
+    restore, where the no-op commit is exactly what keeps replay from
+    double-appending."""
+    p = [
+        _panel(0, "Assembly vs dispatch p50 (s / batch)",
+               ["histogram_quantile(0.5, rate(seq_assembly_seconds_bucket[5m]))",
+                "histogram_quantile(0.5, rate(seq_dispatch_seconds_bucket[5m]))"]),
+        _panel(1, "Assembly vs dispatch p99 (s / batch)",
+               ["histogram_quantile(0.99, rate(seq_assembly_seconds_bucket[5m]))",
+                "histogram_quantile(0.99, rate(seq_dispatch_seconds_bucket[5m]))"]),
+        _panel(2, "Dispatches by (L, B) bucket / s",
+               ["rate(seq_bucket_dispatch_total[5m])"]),
+        _panel(3, "Rows by L bucket / s",
+               ["rate(seq_bucket_rows_total[5m])"]),
+        _panel(4, "Async dispatches in flight", ["seq_inflight_dispatches"]),
+        _panel(5, "Anonymous fast-path rows / s",
+               ["rate(seq_anonymous_rows_total[5m])"]),
+        _panel(6, "Customers with live history", ["seq_history_customers"],
+               "stat"),
+        _alert_stat(7, "Stale-generation commits (crash-replay no-ops)",
+                    ["rate(seq_stale_commits_total[5m])"], red_above=1),
+    ]
+    return _dashboard("CCFD Sequence Serving", "ccfd-seq", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -488,6 +526,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Tracing": tracing_dashboard(),
         "ModelLifecycle": lifecycle_dashboard(),
         "Overload": overload_dashboard(),
+        "SeqServing": seq_serving_dashboard(),
     }
 
 

@@ -423,7 +423,7 @@ class Platform:
 
         c = self.spec.component("scorer")
         cfg = self.cfg
-        if c.opt("model", cfg.model_name) == "seq":
+        if c.opt("model", cfg.model_name) in ("seq", "seq_q8"):
             # history-aware long-context family (serving/history.py):
             # streamed through the router (history lives where the stream
             # is); the stateless REST front stays row-based by design
@@ -438,6 +438,13 @@ class Platform:
             sparams = seq_mod.set_normalizer(
                 sparams, ds.X.mean(0), ds.X.std(0)
             )
+            if c.opt("model", cfg.model_name) == "seq_q8":
+                # int8 serving variant (ops/seq_quant.py) straight from
+                # the CR — the governed route is still the lifecycle
+                # shadow lane; this is the explicit operator choice
+                from ccfd_tpu.ops.seq_quant import quantize_seq
+
+                sparams = quantize_seq(sparams)
             self.scorer = SeqScorer(
                 sparams,
                 length=int(c.opt("history_length", 64)),
@@ -445,6 +452,10 @@ class Platform:
                 compute_dtype=c.opt("dtype", cfg.compute_dtype),
                 max_customers=int(c.opt("max_customers", 20_000)),
                 registry=self._registry("seldon"),
+                stripes=int(c.opt("seq_stripes", cfg.seq_stripes)),
+                inflight=int(c.opt("seq_inflight", cfg.seq_inflight)),
+                len_buckets=tuple(
+                    c.opt("seq_len_buckets", cfg.seq_len_buckets)),
             )
             self.scorer.warmup()
             return
@@ -483,8 +494,8 @@ class Platform:
         from ccfd_tpu.runtime.supervisor import RestartPolicy
         from ccfd_tpu.serving.history import SeqScorer
 
-        if (isinstance(self.scorer, SeqScorer)
-                or not getattr(self.scorer, "has_host_forward", False)):
+        is_seq = isinstance(self.scorer, SeqScorer)
+        if not is_seq and not getattr(self.scorer, "has_host_forward", False):
             logging.getLogger(__name__).warning(
                 "lifecycle enabled but the scorer has no host forward "
                 "(model=%s): the challenger slot scores off-device by "
@@ -549,6 +560,30 @@ class Platform:
             shadow=shadow, evaluator=evaluator, guardrails=guardrails,
             registry=registry,
         )
+        if is_seq:
+            # the router calls a SeqScorer as an OBJECT (score_with_ids),
+            # so there is no score_fn lane to wrap — the scorer offers
+            # each resolved batch to the tap itself (challenger slot —
+            # typically the int8 seq_q8 variant — scores tapped histories
+            # on the tap's worker thread, sample-bounded) and serves the
+            # canary gate's deterministic challenger slice against the
+            # same assembled contexts
+            self.scorer.shadow_tap = shadow
+            self.scorer.canary_gate = self.lifecycle.gate
+            if len(self.scorer.len_buckets) > 1:
+                # ladder + lifecycle: tapped champion scores come from
+                # short-rung executables while the challenger re-scores
+                # the full-L contexts, so the PSI/alert evidence absorbs
+                # rung noise on cold rows (conservative bias — breaches
+                # read larger, never smaller). Judge candidates with the
+                # ladder off for a clean variant-only verdict.
+                logging.getLogger(__name__).warning(
+                    "lifecycle shadow evaluation with seq len_buckets=%s "
+                    "armed: champion scores ride short-L rungs while the "
+                    "challenger scores full-L contexts — distribution "
+                    "gates will include ladder-rung noise (conservative)",
+                    self.scorer.len_buckets,
+                )
         interval = float(c.opt("interval_s", 0.25))
         self.supervisor.add_thread_service(
             "lifecycle",

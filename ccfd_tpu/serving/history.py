@@ -4,41 +4,88 @@ The seq model (models/seq.py) scores the NEWEST transaction given the
 customer's recent history (B, L, F). Single-row REST scoring is stateless
 by design (the Seldon contract); history lives where the stream lives —
 in the routing tier, which already sees every transaction in arrival
-order. This module is that state:
+order. This module is that state, reworked (round 11) from a synchronous
+chunk loop into an overlapped serving dataflow — BENCH_r05 measured the
+old path at 1412 ms device dispatch vs 13 ms assembly per bucket
+(assembly_fraction 0.009): entirely dispatch-bound, serialized anyway.
 
 - ``HistoryStore`` — fixed-depth ring buffer per customer, bounded total
-  customers (LRU eviction at the cap), thread-safe. Mutation is
-  two-phase: ``prepare()`` stages copies, ``commit()`` publishes them —
-  a failed scorer dispatch must not leave transactions in history that
-  were never routed. The store is CHECKPOINTABLE (snapshot/restore), and
-  the recovery coordinator treats it as pipeline state: after a crash
-  rewind, replayed records re-build exactly the histories the cut had —
-  without this, at-least-once redelivery would append every replayed
-  transaction a second time and silently corrupt every active
+  customers (LRU eviction at the cap), INTERNALLY STRIPED by key hash:
+  N stripes with per-stripe locks so ParallelRouter workers stop
+  convoying on one global lock, a global monotonic touch-stamp keeping
+  LRU eviction exact across stripes, an all-anonymous fast path that
+  takes no lock at all (cold REST scoring), and a vectorized ``prepare``
+  for the common no-duplicate-key chunk. Mutation is two-phase:
+  ``prepare()`` stages copies, ``commit()`` publishes them — a failed
+  scorer dispatch must not leave transactions in history that were never
+  routed. The store is CHECKPOINTABLE (snapshot/restore); ``snapshot``
+  is stripe-incremental (clean stripes reuse the previous snapshot's
+  entry list — no 150 MB memcpy under the checkpoint barrier; buffers
+  are immutable-by-convention, so entries are shared, never copied), and
+  the recovery coordinator treats the store as pipeline state: after a
+  crash rewind, replayed records re-build exactly the histories the cut
+  had — without this, at-least-once redelivery would append every
+  replayed transaction a second time and silently corrupt every active
   customer's context.
-- ``SeqScorer`` — the router-facing scorer: takes this poll's rows + ids,
-  assembles the (bucket, L, F) batch (cold customers zero-pad on the
-  LEFT so the newest transaction is always the last token — the readout
-  position), and runs one jitted dispatch per micro-batch over bucketed
-  batch sizes, the same static-shape discipline as the row scorer
-  (serving/scorer.py; the bucketing is intentionally the same shape —
-  single-device serving here, so the row scorer's data-parallel bucket
-  rounding does not apply).
+- ``SeqScorer`` — the router-facing scorer, now an overlapped dataflow:
+  each (L-bucket, B-bucket) group's device call is ENQUEUED (JAX async
+  dispatch) and the next group assembles while it runs; results resolve
+  (``np.asarray``) only when the bounded in-flight window (``inflight``)
+  fills or the batch ends, and the store commits once, after every
+  dispatch resolved — a crash restore racing an in-flight dispatch
+  drops the whole batch's commit (stale generation, counted in
+  ``seq_stale_commits_total``), and when the PR 6 dispatch watchdog
+  abandons a hung batch whose commit later lands CONCURRENTLY with the
+  worker's next batch, the store's per-key optimistic check skips the
+  contended keys instead of clobbering newer state
+  (``HistoryStore.contended_skips``; the skipped appends are in the
+  routed stream, so the next crash-restore replay recovers them). Rows bucket by
+  HISTORY LENGTH as well as batch size: a mostly-cold row (filled << L)
+  dispatches through a short-sequence executable (the ``len_buckets``
+  ladder) instead of padding to full L, with per-(L, B)-bucket hit
+  counters; shapes stay static per (L, B) pair so XLA never re-traces.
+  The device graph is ``seq.apply_serving`` (exact last-block readout
+  optimization) or ``ops/seq_quant.apply`` when the installed params are
+  the int8 variant — ``swap_params`` re-binds the jit by sniffing the
+  param tree, which is how a lifecycle-promoted ``seq_q8`` candidate
+  takes over serving.
 
 TPU-first notes: histories assemble host-side into one contiguous array
 per micro-batch (one transfer, one dispatch — never per-customer gathers
-on device); L is static so XLA sees a fixed (bucket, L, F) shape; the
-model runs bf16 with f32 accumulation.
+on device); every L bucket is static so XLA sees fixed (bucket, L, F)
+shapes; the model runs bf16 with f32 accumulation.
 """
 from __future__ import annotations
 
+import itertools
 import threading
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 from typing import Any
 
 import numpy as np
 
 from ccfd_tpu.data.ccfd import NUM_FEATURES
+
+DEFAULT_STRIPES = 8
+# short-sequence ladder OFF by default: bucketed windows attend fewer
+# zero-pad tokens than the full-L graph (reference_attention has no
+# padding mask), so scores for cold rows differ between rungs — arming
+# the ladder is an explicit serving choice (seq.len_buckets /
+# CCFD_SEQ_LEN_BUCKETS), the same opt-in posture as the CoDel deadline
+DEFAULT_LEN_BUCKETS: tuple = ()
+DEFAULT_INFLIGHT = 2
+
+
+class _Stripe:
+    __slots__ = ("lock", "h", "dirty", "cache")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        # key -> (buffer (L, F) f32, filled count, touch stamp)
+        self.h: OrderedDict[Any, tuple[np.ndarray, int, int]] = OrderedDict()
+        self.dirty = True
+        self.cache: list[tuple[int, Any, np.ndarray, int]] = []
 
 
 class HistoryStore:
@@ -47,19 +94,37 @@ class HistoryStore:
     Memory bound: ``max_customers * length * num_features * 4`` bytes —
     the default (20k x 64 x 30 x f32) admits ~150 MB resident on the
     serving host; size the cap to the deployment's live-customer working
-    set, not its total cardinality (LRU keeps the hot set)."""
+    set, not its total cardinality (LRU keeps the hot set).
+
+    Concurrency: reads/stages take only the key's stripe lock (and the
+    all-anonymous path none); ``commit``/``restore``/``snapshot``
+    serialize on one commit lock (commits are per router batch — rare
+    next to prepares — and a restore interleaving a half-published
+    commit would corrupt the cut). Stored buffers are IMMUTABLE by
+    convention: prepare copies before mutating and commit replaces
+    entries, which is what lets lookups hand out references under the
+    stripe lock and snapshots share entries across generations."""
 
     def __init__(self, length: int = 64, num_features: int = NUM_FEATURES,
-                 max_customers: int = 20_000):
+                 max_customers: int = 20_000, stripes: int = DEFAULT_STRIPES):
         if length < 1:
             raise ValueError("history length must be >= 1")
         self.length = int(length)
         self.num_features = int(num_features)
         self.max_customers = int(max_customers)
-        self._lock = threading.Lock()
-        # id -> (buffer (L, F) f32, filled count); OrderedDict as LRU:
-        # move_to_end on touch, evict the coldest when over cap
-        self._h: OrderedDict[Any, tuple[np.ndarray, int]] = OrderedDict()
+        self.stripes = max(1, int(stripes))
+        self._stripes = [_Stripe() for _ in range(self.stripes)]
+        self._commit_lock = threading.Lock()
+        self._count_lock = threading.Lock()
+        self._total = 0
+        # global touch stamp: commit order defines recency ACROSS stripes,
+        # so LRU eviction at the cap stays exact despite per-stripe LRU
+        # order (itertools.count().__next__ is GIL-atomic)
+        self._stamp = itertools.count().__next__
+        # commits skipped by the per-key optimistic check (see commit());
+        # nonzero means concurrent same-key batches raced — e.g. a
+        # watchdog-abandoned dispatch's late commit
+        self._contended = 0
         # epoch generation: restore() bumps it and commit() drops staged
         # chunks from an older generation — a scorer dispatch that was in
         # flight across a crash restore (the unacked-barrier path) must
@@ -67,18 +132,24 @@ class HistoryStore:
         # engine's equivalent guard is Engine._check_alive)
         self._gen = 0
 
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._h)
+    def _stripe_of(self, key: Any) -> _Stripe:
+        return self._stripes[hash(key) % self.stripes]
 
+    def __len__(self) -> int:
+        with self._count_lock:
+            return self._total
+
+    # -- staging ------------------------------------------------------------
     def prepare(
         self, ids: list, rows: np.ndarray, overlay: dict | None = None
-    ) -> tuple[np.ndarray, tuple[int, dict]]:
+    ) -> tuple[np.ndarray, tuple[int, dict, np.ndarray]]:
         """Stage this chunk: return the (B, L, F) batch of post-append
-        histories (newest last) plus a staged token, WITHOUT mutating the
-        store. ``commit()`` publishes staged state only after the scorer
-        dispatch succeeded — a dropped batch (transient scorer failure)
-        must leave histories exactly matching the routed stream.
+        histories (newest last) plus a token ``(gen, staged, filled)``,
+        WITHOUT mutating the store. ``commit()`` publishes staged state
+        only after the scorer dispatch succeeded — a dropped batch
+        (transient scorer failure) must leave histories exactly matching
+        the routed stream. ``filled`` is the per-row post-append history
+        depth — what the scorer's L-bucket ladder partitions on.
 
         A customer appearing twice in one chunk sees its earlier
         same-chunk rows in the later assembly; ``overlay`` extends that
@@ -86,117 +157,288 @@ class HistoryStore:
         accumulates staged dicts and commits once). ``None`` ids are
         anonymous: scored against an empty history and NEVER stored — a
         bounded store must not spend its cap (and evict real customers)
-        on keys no future record can match."""
+        on keys no future record can match. An ALL-anonymous chunk takes
+        no lock and stages nothing (the cold-REST fast path)."""
         rows = np.ascontiguousarray(rows, np.float32)
         n = len(rows)
-        out = np.zeros((n, self.length, self.num_features), np.float32)
-        staged: dict[Any, tuple[np.ndarray, int]] = {}
-        with self._lock:
-            gen = self._gen
-            for i in range(n):
-                key = ids[i]
-                if key is None:
-                    # anonymous: cold context + this row as the readout
-                    out[i, -1] = rows[i]
-                    continue
-                ent = staged.get(key)
-                if ent is None and overlay is not None:
-                    ent = overlay.get(key)
-                    if ent is not None:  # earlier chunk's staged copy
-                        ent = (ent[0].copy(), ent[1])
-                if ent is None:
-                    ent = self._h.get(key)
-                    if ent is None:
-                        buf = np.zeros((self.length, self.num_features),
-                                       np.float32)
-                        filled = 0
-                    else:  # copy-on-write: the live buffer stays untouched
-                        buf, filled = ent
-                        buf = buf.copy()
-                else:
-                    buf, filled = ent
-                # shift-left ring: newest transaction is always row L-1
-                # (the seq model's readout token); cold-start zeros stay
-                # on the left until the buffer fills
-                buf[:-1] = buf[1:]
-                buf[-1] = rows[i]
-                filled = min(filled + 1, self.length)
-                staged[key] = (buf, filled)
-                out[i] = buf
-        return out, (gen, staged)
+        L = self.length
+        out = np.zeros((n, L, self.num_features), np.float32)
+        filled_out = np.ones((n,), np.int32)
+        gen = self._gen
+        if n:
+            out[:, -1] = rows
+        keyed = [(i, ids[i]) for i in range(n) if ids[i] is not None]
+        if not keyed:
+            return out, (gen, {}, filled_out)
+        keys = [k for _, k in keyed]
+        if len(set(keys)) == len(keys):
+            staged = self._prepare_unique(keyed, rows, out, filled_out,
+                                          overlay)
+        else:
+            staged = self._prepare_general(ids, rows, out, filled_out,
+                                           overlay)
+        return out, (gen, staged, filled_out)
 
-    def commit(self, token: tuple[int, dict]) -> bool:
-        """Publish a prepared chunk (call only after a successful
-        dispatch). Evicts the coldest keys past the cap. Returns False —
-        and changes nothing — when the store was restored since the
-        prepare (stale generation: the rewound bus will re-drive those
-        records onto the restored state)."""
-        gen, staged = token
+    def _lookup_refs(self, pairs: list[tuple[int, Any]]) -> dict:
+        """(row, key) pairs -> {row: (buf_ref, filled)} for keys live in
+        the store; one pass per touched stripe, references only under the
+        lock (buffers are immutable, see class docstring)."""
+        by_stripe: dict[int, list[tuple[int, Any]]] = {}
+        for i, key in pairs:
+            by_stripe.setdefault(hash(key) % self.stripes, []).append((i, key))
+        hits: dict[int, tuple[np.ndarray, int, int]] = {}
+        for si, group in by_stripe.items():
+            st = self._stripes[si]
+            with st.lock:
+                h = st.h
+                for i, key in group:
+                    ent = h.get(key)
+                    if ent is not None:
+                        hits[i] = ent  # (buf, filled, stamp) — immutable
+        return hits
+
+    def _prepare_unique(self, keyed, rows, out, filled_out, overlay) -> dict:
+        """No key repeats in the chunk: assembly vectorizes — one stripe
+        pass collects buffer references, one batched shifted-gather fills
+        ``out``, one contiguous copy per row stages."""
+        L = self.length
+        hits: dict[int, tuple[np.ndarray, int]] = {}
+        if overlay:
+            missing = []
+            for i, key in keyed:
+                ent = overlay.get(key)
+                if ent is not None:
+                    hits[i] = ent
+                else:
+                    missing.append((i, key))
+        else:
+            missing = keyed
+        if missing:
+            hits.update(self._lookup_refs(missing))
+        if hits:
+            # shift-left ring, batched: rows 1..L-1 of each prior buffer
+            # land at 0..L-2; the newest transaction is already at L-1
+            hi = np.fromiter(hits.keys(), np.intp, len(hits))
+            out[hi, : L - 1] = np.stack([hits[i][0] for i in hi])[:, 1:]
+        staged: dict[Any, tuple[np.ndarray, int, int | None]] = {}
+        for i, key in keyed:
+            ent = hits.get(i)
+            filled = min((ent[1] if ent is not None else 0) + 1, L)
+            # base = the stamp of the store entry this staging derives
+            # from (None for a fresh key): commit's optimistic check
+            staged[key] = (out[i].copy(), filled,
+                           ent[2] if ent is not None else None)
+            filled_out[i] = filled
+        return staged
+
+    def _prepare_general(self, ids, rows, out, filled_out, overlay) -> dict:
+        """Duplicate keys in the chunk: the per-row loop (earlier
+        same-chunk rows must be visible to later assemblies), with store
+        lookups still batched per stripe up front."""
+        L = self.length
+        seen: dict[Any, int] = {}
+        firsts = []
+        for i, key in enumerate(ids):
+            if key is not None and key not in seen:
+                seen[key] = i
+                firsts.append((i, key))
+        refs_by_row = self._lookup_refs(firsts)
+        refs = {ids[i]: ent for i, ent in refs_by_row.items()}
+        staged: dict[Any, tuple[np.ndarray, int, int | None]] = {}
+        for i, key in enumerate(ids):
+            if key is None:
+                continue  # cold context + this row, already assembled
+            ent = staged.get(key)
+            if ent is None and overlay is not None:
+                o = overlay.get(key)
+                if o is not None:  # earlier chunk's staged copy keeps its
+                    ent = (o[0].copy(), o[1], o[2])  # original base stamp
+            if ent is None:
+                r = refs.get(key)
+                if r is None:
+                    buf = np.zeros((L, self.num_features), np.float32)
+                    filled, base = 0, None
+                else:  # copy-on-write: the live buffer stays untouched
+                    buf, filled, base = r[0].copy(), r[1], r[2]
+            else:
+                buf, filled, base = ent
+            buf[:-1] = buf[1:]
+            buf[-1] = rows[i]
+            filled = min(filled + 1, L)
+            if key in staged:  # recency = LAST occurrence (see score())
+                del staged[key]
+            staged[key] = (buf, filled, base)
+            out[i] = buf
+            filled_out[i] = filled
+        return staged
+
+    # -- publication --------------------------------------------------------
+    def commit(self, token: tuple) -> bool:
+        """Publish a prepared chunk (call only after every dispatch of the
+        batch resolved). Evicts the globally-coldest keys past the cap.
+        Returns False — and changes nothing — when the store was restored
+        since the prepare (stale generation: the rewound bus will
+        re-drive those records onto the restored state).
+
+        Per-key optimistic check: each staged entry carries the stamp of
+        the store entry it derives from; a key whose live entry moved
+        since the prepare (a CONCURRENT batch committed it — e.g. a
+        watchdog-abandoned dispatch's late commit racing the worker's
+        next batch on the same partition keys) is SKIPPED rather than
+        clobbering the newer state, counted in ``contended_skips``. The
+        skipped batch's appends are recovered by the next crash-restore
+        replay (the records are in the routed stream)."""
+        gen, staged = token[0], token[1]
         if not staged:
             return True
-        with self._lock:
+        with self._commit_lock:
             if gen != self._gen:
                 return False
+            # stamps follow the batch's ARRIVAL order (staged dicts
+            # preserve first-occurrence order), assigned BEFORE the
+            # per-stripe insertion pass: stamping inside that pass would
+            # make whole stripe-groups "newest" within a batch, and under
+            # a binding cap eviction would systematically keep one hash
+            # class of each batch (found by the replay drill: disjoint
+            # survivor sets before/after a rewind)
+            by_stripe: dict[int, list] = {}
             for key, ent in staged.items():
-                if key in self._h:
-                    self._h.move_to_end(key)
-                self._h[key] = ent
-            while len(self._h) > self.max_customers:
-                self._h.popitem(last=False)
+                by_stripe.setdefault(hash(key) % self.stripes, []).append(
+                    (key, ent, self._stamp()))
+            added = 0
+            for si, items in by_stripe.items():
+                st = self._stripes[si]
+                with st.lock:
+                    h = st.h
+                    for key, (buf, filled, base), stamp in items:
+                        cur = h.get(key)
+                        if cur is not None and (base is None
+                                                or cur[2] != base):
+                            # live entry moved since this prepare: a
+                            # concurrent batch owns the newer state
+                            self._contended += 1
+                            continue
+                        if cur is not None:
+                            h.move_to_end(key)
+                        else:
+                            added += 1
+                        h[key] = (buf, filled, stamp)
+                    st.dirty = True
+            if added:
+                with self._count_lock:
+                    self._total += added
+            self._evict_over_cap()
         return True
 
-    # -- checkpoint surface (pipeline state, like the engine) --------------
+    def _evict_over_cap(self) -> None:
+        """Pop the globally-oldest entry until under the cap. Runs under
+        the commit lock (single evictor); takes one stripe lock at a time
+        — the scan reads each stripe's LRU head stamp, the pop re-checks
+        under the chosen stripe's lock."""
+        while True:
+            with self._count_lock:
+                if self._total <= self.max_customers:
+                    return
+            best_i, best_stamp = -1, None
+            for i, st in enumerate(self._stripes):
+                with st.lock:
+                    if st.h:
+                        stamp = next(iter(st.h.values()))[2]
+                        if best_stamp is None or stamp < best_stamp:
+                            best_i, best_stamp = i, stamp
+            if best_i < 0:
+                return
+            st = self._stripes[best_i]
+            with st.lock:
+                if st.h:
+                    st.h.popitem(last=False)
+                    st.dirty = True
+                    with self._count_lock:
+                        self._total -= 1
+
+    # -- checkpoint surface (pipeline state, like the engine) ---------------
     def snapshot(self) -> dict:
-        """Copy-only state for the recovery coordinator's cut: runs under
-        the checkpoint barrier, so buffers are returned as numpy COPIES
-        (fast memcpy) — the coordinator JSON-normalizes outside the
-        barrier (recovery.py _np_jsonable); ``restore`` accepts either
-        form."""
-        with self._lock:
+        """State for the recovery coordinator's cut: runs under the
+        checkpoint barrier. Stripe-incremental and ZERO-copy: a stripe
+        untouched since the last snapshot reuses its cached entry list,
+        and entries share the live buffers (immutable by convention — the
+        store replaces, never mutates them), so the barrier cost is
+        proportional to churn, not store size. The coordinator
+        JSON-normalizes outside the barrier (recovery.py _np_jsonable);
+        ``restore`` accepts either form. Entries are ordered coldest
+        first (global touch stamps), so a restore rebuilds the same
+        eviction order."""
+        with self._commit_lock:
+            entries: list[tuple[int, Any, np.ndarray, int]] = []
+            for st in self._stripes:
+                with st.lock:
+                    if st.dirty:
+                        st.cache = [
+                            (stamp, key, buf, filled)
+                            for key, (buf, filled, stamp) in st.h.items()
+                        ]
+                        st.dirty = False
+                    entries.extend(st.cache)
+            entries.sort(key=lambda e: e[0])
             return {
                 "version": 1,
                 "length": self.length,
                 "num_features": self.num_features,
-                "customers": [
-                    [key, buf.copy(), filled]
-                    for key, (buf, filled) in self._h.items()
-                ],
+                "customers": [[key, buf, filled]
+                              for _, key, buf, filled in entries],
             }
 
     def restore(self, snap: dict | None) -> None:
         """Replace the store's content with a snapshot's (crash recovery:
         the rewound bus re-drives post-cut records, re-building exactly
         the histories the cut had). ``None`` resets to empty (genesis
-        restore — replay from offset 0 rebuilds everything)."""
-        if snap is None:
-            with self._lock:
-                self._h.clear()
-                self._gen += 1
-            return
-        if snap.get("version") != 1:
-            raise ValueError(f"unknown history snapshot {snap.get('version')!r}")
-        if (int(snap["length"]) != self.length
-                or int(snap["num_features"]) != self.num_features):
-            raise ValueError("history snapshot shape mismatch")
-        with self._lock:
-            self._h.clear()
+        restore — replay from offset 0 rebuilds everything). The
+        generation bumps LAST, so a prepare racing this call either sees
+        the old generation (its commit is dropped) or the fully-restored
+        state."""
+        with self._commit_lock:
+            for st in self._stripes:
+                with st.lock:
+                    st.h.clear()
+                    st.dirty = True
+                    st.cache = []
+            total = 0
+            if snap is not None:
+                if snap.get("version") != 1:
+                    raise ValueError(
+                        f"unknown history snapshot {snap.get('version')!r}")
+                if (int(snap["length"]) != self.length
+                        or int(snap["num_features"]) != self.num_features):
+                    raise ValueError("history snapshot shape mismatch")
+                for key, buf, filled in snap["customers"]:
+                    st = self._stripe_of(key)
+                    with st.lock:
+                        st.h[key] = (
+                            np.asarray(buf, np.float32).reshape(
+                                self.length, self.num_features),
+                            int(filled),
+                            self._stamp(),
+                        )
+                    total += 1
+            with self._count_lock:
+                self._total = total
             self._gen += 1  # in-flight prepares become stale commits
-            for key, buf, filled in snap["customers"]:
-                self._h[key] = (
-                    np.asarray(buf, np.float32).reshape(
-                        self.length, self.num_features
-                    ),
-                    int(filled),
-                )
+
+    @property
+    def contended_skips(self) -> int:
+        return self._contended
 
     def snapshot_counts(self) -> dict:
-        with self._lock:
-            return {"customers": len(self._h), "length": self.length}
+        return {"customers": len(self), "length": self.length,
+                "stripes": self.stripes}
 
 
 class SeqScorer:
-    """History-aware scorer with the row scorer's serving discipline:
-    bucketed static shapes, one jit dispatch per micro-batch."""
+    """History-aware scorer with the row scorer's serving discipline —
+    bucketed static shapes — run as an overlapped dataflow: per-(L, B)
+    bucket dispatches enqueue asynchronously while the next group
+    assembles, bounded by ``inflight``; ONE commit per router batch after
+    every dispatch resolved (see module docstring)."""
 
     def __init__(
         self,
@@ -207,31 +449,38 @@ class SeqScorer:
         max_customers: int = 20_000,
         registry: Any = None,
         mesh: Any = None,
+        stripes: int = DEFAULT_STRIPES,
+        inflight: int = DEFAULT_INFLIGHT,
+        len_buckets: tuple | None = None,
     ):
         """``mesh``: serve the seq dispatch over a device mesh — history
-        batches split over the ``"data"`` axis, params replicated (the
+        batches split over the partitioned axes, params replicated (the
         same SPMD layout the row Scorer's data-axis path uses; history
-        ASSEMBLY stays host-side either way, which is exactly what the
-        bench's seq_pipeline assembly-vs-dispatch split measures).
-        Bucket sizes round up to data-axis multiples so every shard gets
-        identical static shapes."""
+        ASSEMBLY stays host-side either way). Bucket sizes round up to
+        axis-size multiples so every shard gets identical static shapes.
+
+        ``inflight``: async dispatches in flight before the loop blocks
+        on the oldest (0 = resolve immediately, the synchronous path).
+        ``len_buckets``: the short-sequence ladder; the full ``length``
+        is always appended. A row dispatches at the smallest bucket
+        covering its post-append history depth."""
         import jax
         import jax.numpy as jnp
 
-        from ccfd_tpu.models import seq as seq_mod
-
-        self.store = HistoryStore(length=length, max_customers=max_customers)
-        dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+        self.store = HistoryStore(length=length, max_customers=max_customers,
+                                  stripes=stripes)
+        self._dtype = (jnp.bfloat16 if compute_dtype == "bfloat16"
+                       else jnp.float32)
+        self.inflight = max(0, int(inflight))
+        if len_buckets is None:
+            len_buckets = DEFAULT_LEN_BUCKETS
+        self.len_buckets = tuple(sorted(
+            {int(b) for b in len_buckets if 0 < int(b) < length}
+            | {int(length)}))
         self.mesh = mesh
         self._batch_sharding = None
-        if mesh is None:
-            self.params = params
-
-            @jax.jit
-            def _apply(p, xs):
-                return seq_mod.apply(p, xs, dtype)
-
-        else:
+        self._part_axes = None
+        if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             from ccfd_tpu.parallel.sharding import replicated
@@ -249,22 +498,104 @@ class SeqScorer:
             batch_sizes = tuple(
                 max(1, -(-b // dsize)) * dsize for b in batch_sizes
             )
-            self.params = jax.device_put(params, replicated(mesh))
+            self._part_axes = part_axes
+            params = jax.device_put(params, replicated(mesh))
             self._batch_sharding = NamedSharding(
                 mesh, PartitionSpec(part_axes, None, None))
-            _apply = jax.jit(
-                lambda p, xs: seq_mod.apply(p, xs, dtype),
-                out_shardings=NamedSharding(mesh, PartitionSpec(part_axes)),
-            )
+        self.params = params
         self.batch_sizes = tuple(sorted(set(batch_sizes)))
-        self._apply = _apply
         self._jax = jax
+        self._quantized = self._is_quantized(params)
+        self._apply = self._make_apply(self._quantized)
         self._params_lock = threading.Lock()
+        # challenger slot (lifecycle/): a second params tree + jit scored
+        # off the hot path by the shadow tap's worker — how the seq_q8
+        # variant earns its AUC/PSI verdict before it may serve
+        self._challenger: tuple[int, Any, Any] | None = None
+        # shadow tap + canary gate (lifecycle/): the router calls
+        # score_with_ids on this OBJECT, so there is no score_fn lane to
+        # wrap — when armed, each resolved chunk offers its (hist, proba)
+        # pair to the tap, and an active canary gate re-scores its
+        # deterministic challenger slice against the same assembled
+        # contexts (the seq analog of tap-inside/gate-outside)
+        self.shadow_tap: Any = None
+        self.canary_gate: Any = None
         self._g_customers = None
+        self._h_assembly = self._h_dispatch = None
+        self._c_bucket = self._c_bucket_rows = None
+        self._g_inflight = self._c_anon = self._c_stale = None
         if registry is not None:
             self._g_customers = registry.gauge(
                 "seq_history_customers", "customers with live history"
             )
+            self._h_assembly = registry.histogram(
+                "seq_assembly_seconds",
+                "host-side history assembly time per router batch "
+                "(prepare + L/B bucketing + padding)",
+            )
+            self._h_dispatch = registry.histogram(
+                "seq_dispatch_seconds",
+                "device dispatch time per router batch: enqueue plus the "
+                "blocking waits the overlap could not hide",
+            )
+            self._c_bucket = registry.counter(
+                "seq_bucket_dispatch_total",
+                "seq dispatches by (L bucket, B bucket) executable",
+            )
+            self._c_bucket_rows = registry.counter(
+                "seq_bucket_rows_total",
+                "rows scored per L bucket (short buckets = the cold-row "
+                "fast lane actually firing)",
+            )
+            self._g_inflight = registry.gauge(
+                "seq_inflight_dispatches",
+                "async seq dispatches currently in flight",
+            )
+            self._c_anon = registry.counter(
+                "seq_anonymous_rows_total",
+                "anonymous rows scored cold (lock-free prepare fast path; "
+                "never stored)",
+            )
+            self._c_stale = registry.counter(
+                "seq_stale_commits_total",
+                "commits dropped for stale generation (dispatch in flight "
+                "across a crash restore — the no-op that keeps replay "
+                "from double-appending)",
+            )
+
+    # -- variant dispatch ---------------------------------------------------
+    @staticmethod
+    def _is_quantized(params: Any) -> bool:
+        from ccfd_tpu.ops import seq_quant
+
+        return seq_quant.is_quantized(params)
+
+    def _make_apply(self, quantized: bool):
+        import jax
+
+        from ccfd_tpu.models import seq as seq_mod
+        from ccfd_tpu.ops import seq_quant
+
+        dtype = self._dtype
+        # positional encodings anchor at the store's FULL length: a short
+        # L-bucket window's tokens keep the positions the full-L path
+        # gives them, so a customer's score doesn't jump at ladder
+        # crossovers (models/seq.py logits_readout pos_length)
+        plen = self.store.length
+        if self.mesh is None:
+            if quantized:
+                return lambda p, xs: seq_quant.apply_serving(
+                    p, xs, dtype, pos_length=plen)
+            return lambda p, xs: seq_mod.apply_serving(
+                p, xs, dtype, pos_length=plen)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        fn = seq_quant.logits if quantized else seq_mod.logits_readout
+        return jax.jit(
+            lambda p, xs: jax.nn.sigmoid(fn(p, xs, dtype, pos_length=plen)),
+            out_shardings=NamedSharding(self.mesh,
+                                        PartitionSpec(self._part_axes)),
+        )
 
     def _put_hist(self, hist: np.ndarray):
         """H2D with placement: on a mesh each device gets its row shard."""
@@ -273,21 +604,44 @@ class SeqScorer:
         return self._jax.device_put(hist, self._batch_sharding)
 
     def swap_params(self, params: Any) -> None:
-        """Hot-swap model weights (the online-retrain surface the row
-        scorer exposes; same treedef ⇒ the jit cache is reused)."""
+        """Hot-swap model weights (the lifecycle promotion surface; the
+        row scorer exposes the same). A variant change — bf16 champion
+        replaced by a promoted int8 ``seq_q8`` tree, or back — re-binds
+        the jitted apply; same-variant swaps reuse the jit cache (same
+        treedef, same executable)."""
         if self.mesh is not None:
             from ccfd_tpu.parallel.sharding import replicated
 
             params = self._jax.device_put(params, replicated(self.mesh))
+        quantized = self._is_quantized(params)
+        new_apply = None
+        if quantized != self._quantized:
+            # variant change (e.g. a promoted seq_q8): compile the whole
+            # (B, L) executable grid BEFORE publishing — scoring keeps the
+            # old graph meanwhile, so the hot path never pays an XLA
+            # compile (which could outlive the dispatch watchdog deadline
+            # and roll back the candidate that was just promoted)
+            new_apply = self._make_apply(quantized)
+            for b in self.batch_sizes:
+                for lb in self.len_buckets:
+                    xs = np.zeros((b, lb, self.store.num_features),
+                                  np.float32)
+                    self._jax.block_until_ready(
+                        new_apply(params, self._put_hist(xs)))
         with self._params_lock:
             self.params = params
+            if new_apply is not None:
+                self._quantized = quantized
+                self._apply = new_apply
 
     def warmup(self) -> None:
+        """Compile every (B bucket, L bucket) executable the ladder can
+        dispatch — the re-trace-stable static shape set."""
         for b in self.batch_sizes:
-            xs = np.zeros((b, self.store.length, self.store.num_features),
-                          np.float32)
-            self._jax.block_until_ready(
-                self._apply(self.params, self._put_hist(xs)))
+            for lb in self.len_buckets:
+                xs = np.zeros((b, lb, self.store.num_features), np.float32)
+                self._jax.block_until_ready(
+                    self._apply(self.params, self._put_hist(xs)))
 
     def _bucket(self, n: int) -> int:
         for b in self.batch_sizes:
@@ -295,50 +649,178 @@ class SeqScorer:
                 return b
         return self.batch_sizes[-1]
 
+    def _len_bucket_index(self, filled: np.ndarray) -> np.ndarray:
+        """Per-row ladder index: smallest L bucket covering the row's
+        post-append history depth."""
+        return np.searchsorted(np.asarray(self.len_buckets), filled,
+                               side="left")
+
+    # -- the overlapped scoring loop ---------------------------------------
     def score(self, x: np.ndarray, ids: list | None = None) -> np.ndarray:
         """Router-compatible scorer: (B, F) rows -> (B,) probabilities,
         each conditioned on that customer's history. Rows with no id
         (``ids`` absent or None entries) score against an empty history
-        and are not tracked."""
+        and are not tracked.
+
+        ONE commit for the whole router batch, after EVERY dispatch
+        resolved: a mid-batch failure drops the batch at the router (or
+        the PR 6 dispatch watchdog kills it), and a half-committed
+        history would diverge from the routed stream. The overlay keeps
+        same-customer visibility across chunks; the generation token
+        makes a commit that raced a crash restore a no-op (the rewind
+        re-drives those records)."""
         n = len(x)
         if n == 0:
             return np.zeros((0,), np.float32)
         if ids is None:
             ids = [None] * n
         out = np.empty((n,), np.float32)
-        start = 0
         largest = self.batch_sizes[-1]
-        # ONE commit for the whole router batch, after EVERY chunk's
-        # dispatch succeeded: a mid-batch failure drops the batch at the
-        # router, and a half-committed history would diverge from the
-        # routed stream. The overlay keeps same-customer visibility
-        # across chunks; the generation token makes a commit that raced
-        # a crash restore a no-op (the rewind re-drives those records).
+        L = self.store.length
+        ladder = self.len_buckets
         merged: dict = {}
         gen = None
+        pending: deque = deque()  # (device array, global row idx, m)
+        # shadow/canary lane: when a challenger is armed (tap) or a
+        # canary slice is live (gate), keep each chunk's assembled
+        # (full-L) history batch so the challenger scores the SAME
+        # contexts the champion just did (one flag read when idle)
+        tap = self.shadow_tap
+        if tap is not None and tap.armed_version is None:
+            tap = None
+        gate = self.canary_gate
+        if gate is not None and not gate.active:
+            gate = None
+        tap_chunks: list[tuple[np.ndarray, int, int]] = []
+        keep_hist = tap is not None or gate is not None
+        t_asm = 0.0
+        t_disp = 0.0
+        n_anon = 0
+        start = 0
         while start < n:
             stop = min(start + largest, n)
-            hist, (gen, staged) = self.store.prepare(
-                ids[start:stop], x[start:stop], overlay=merged
+            t0 = time.perf_counter()
+            chunk_ids = ids[start:stop]
+            hist, (chunk_gen, staged, filled) = self.store.prepare(
+                chunk_ids, x[start:stop], overlay=merged
             )
-            m = stop - start
-            bucket = self._bucket(m)
-            if m < bucket:
-                hist = np.concatenate(
-                    [hist, np.zeros((bucket - m, *hist.shape[1:]),
-                                    np.float32)]
-                )
-            with self._params_lock:
-                params = self.params
-            proba = np.asarray(self._apply(params, self._put_hist(hist)))
+            # the FIRST chunk's generation stamps the whole batch: a
+            # restore landing between chunk prepares bumps the store's
+            # generation, and committing with a later chunk's (fresh) gen
+            # would publish the earlier chunks' pre-restore staging onto
+            # the restored state — the first gen is stale then, so the
+            # commit is the no-op replay correctness requires
+            if gen is None:
+                gen = chunk_gen
+            # recency = LAST occurrence: a key re-staged by a later chunk
+            # moves to the end of merged, so commit stamps (and therefore
+            # LRU eviction under a binding cap) follow stream order, not
+            # first-touch order — replay with different batch boundaries
+            # must rebuild the same survivor set
+            for k in staged:
+                if k in merged:
+                    del merged[k]
             merged.update(staged)
-            out[start:stop] = proba[:m]
+            n_anon += chunk_ids.count(None)
+            li = self._len_bucket_index(filled)
+            if keep_hist:
+                tap_chunks.append((hist, start, stop))
+            t_asm += time.perf_counter() - t0
+            for bi in np.unique(li):
+                lb = ladder[bi]
+                idx = np.nonzero(li == bi)[0]
+                # greedy B decomposition: a group between bucket sizes
+                # dispatches as exact-fit sub-batches (1229 -> 1024 + 128
+                # + 128-padded-77) instead of one bucket padded to 3x the
+                # rows — padding is wasted device compute, and with async
+                # dispatch the extra launches pipeline instead of queuing
+                pos = 0
+                m_total = len(idx)
+                while pos < m_total:
+                    t0 = time.perf_counter()
+                    rem = m_total - pos
+                    bucket = None
+                    for b in reversed(self.batch_sizes):
+                        if b <= rem:
+                            bucket = b
+                            break
+                    if bucket is None:
+                        bucket = self.batch_sizes[0]
+                    m = min(rem, bucket)
+                    sub_idx = idx[pos:pos + m]
+                    pos += m
+                    if lb == L and m == len(hist):
+                        sub = hist
+                    else:  # right-aligned window
+                        sub = hist[sub_idx, L - lb:, :]
+                    if m < bucket:
+                        sub = np.concatenate(
+                            [sub, np.zeros((bucket - m, *sub.shape[1:]),
+                                           np.float32)]
+                        )
+                    with self._params_lock:
+                        params, apply_fn = self.params, self._apply
+                    t_asm += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    # JAX async dispatch: the call ENQUEUES the executable
+                    # and returns; the next group assembles while it runs.
+                    dev = apply_fn(params, self._put_hist(sub))
+                    t_disp += time.perf_counter() - t0
+                    pending.append((dev, sub_idx + start, m))
+                    if self._c_bucket is not None:
+                        self._c_bucket.inc(labels={
+                            "l_bucket": str(lb), "b_bucket": str(bucket)})
+                        self._c_bucket_rows.inc(
+                            m, labels={"l_bucket": str(lb)})
+                    if self._g_inflight is not None:
+                        self._g_inflight.set(float(len(pending)))
+                    while len(pending) > self.inflight:
+                        t_disp += self._resolve(pending, out)
             start = stop
+        while pending:
+            t_disp += self._resolve(pending, out)
         if gen is not None:
-            self.store.commit((gen, merged))
+            if not self.store.commit((gen, merged)):
+                if self._c_stale is not None:
+                    self._c_stale.inc()
+        if tap is not None:
+            # the tap pairs PURE champion scores (offered before any
+            # canary override, like the row lane's tap-inside/gate-outside
+            # composition)
+            for hist, s0, s1 in tap_chunks:
+                tap.offer(hist, out[s0:s1])
+        if gate is not None and tap_chunks:
+            # canary slice: the challenger arm re-scores against the SAME
+            # assembled contexts (bounded by the gate's weight; a
+            # challenger failure keeps champion scores and counts)
+            def rescore(mask: np.ndarray) -> np.ndarray:
+                parts = [h[mask[s0:s1]] for h, s0, s1 in tap_chunks]
+                sel = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                return self.challenger_score(sel)
+
+            out = gate.apply(np.ascontiguousarray(x, np.float32), out,
+                             rescore=rescore)
         if self._g_customers is not None:
             self._g_customers.set(float(len(self.store)))
+        if self._h_assembly is not None:
+            self._h_assembly.observe(t_asm)
+            self._h_dispatch.observe(t_disp)
+        if n_anon and self._c_anon is not None:
+            self._c_anon.inc(n_anon)
         return out
+
+    def _resolve(self, pending: deque, out: np.ndarray) -> float:
+        """Block on the oldest in-flight dispatch and scatter its rows;
+        returns the blocking wait (the dispatch time overlap failed to
+        hide)."""
+        dev, idx, m = pending.popleft()
+        t0 = time.perf_counter()
+        proba = np.asarray(dev)
+        dt = time.perf_counter() - t0
+        out[idx] = proba[:m]
+        if self._g_inflight is not None:
+            self._g_inflight.set(float(len(pending)))
+        return dt
 
     # Router contract: passing the SeqScorer OBJECT as the router's
     # score_fn makes it callable for the plain (x,) path, and the router
@@ -349,7 +831,10 @@ class SeqScorer:
     def score_with_ids(self, txs: list, x: np.ndarray) -> np.ndarray:
         """Batch entry for the router: ids come from each record's
         ``customer_id``/``id`` field; records with neither are anonymous
-        (scored cold, not tracked)."""
+        (scored cold, not tracked). When the shadow tap is armed,
+        ``score`` offers each chunk's assembled history batch alongside
+        the champion's probabilities — the challenger shadow-scores the
+        SAME contexts."""
         ids: list = []
         for t in txs:
             key = None
@@ -359,3 +844,81 @@ class SeqScorer:
                     key = t.get("id")
             ids.append(key)
         return self.score(x, ids)
+
+    # -- challenger slot (model lifecycle: shadow scoring of seq_q8) --------
+    def install_challenger(self, version: int, params: Any) -> None:
+        """Stage a challenger (typically the int8 ``seq_q8`` tree) beside
+        the champion. Challenger forwards run on the shadow tap's worker
+        thread against cold contexts or tapped batches — sample-bounded
+        by the tap's token bucket, so the hot path never waits on it."""
+        fn = self._make_challenger_apply(params)
+        with self._params_lock:
+            self._challenger = (int(version), params, fn)
+
+    def _make_challenger_apply(self, params: Any):
+        from ccfd_tpu.models import seq as seq_mod
+        from ccfd_tpu.ops import seq_quant
+
+        dtype = self._dtype
+        plen = self.store.length
+        if self._is_quantized(params):
+            return lambda p, xs: seq_quant.apply_serving(
+                p, xs, dtype, pos_length=plen)
+        return lambda p, xs: seq_mod.apply_serving(
+            p, xs, dtype, pos_length=plen)
+
+    def clear_challenger(self, version: int | None = None) -> None:
+        with self._params_lock:
+            if (self._challenger is not None
+                    and (version is None
+                         or self._challenger[0] == int(version))):
+                self._challenger = None
+
+    @property
+    def challenger_version(self) -> int | None:
+        ch = self._challenger
+        return None if ch is None else ch[0]
+
+    def challenger_score(self, x: np.ndarray) -> np.ndarray:
+        """(n, F) rows (scored against a COLD context — the evaluator's
+        label joins carry no history) or (n, L', F) histories (tapped
+        batches) -> (n,) proba on the challenger params."""
+        ch = self._challenger
+        if ch is None:
+            raise RuntimeError("no challenger installed")
+        _, params, fn = ch
+        return self._score_direct(np.asarray(x, np.float32), params, fn,
+                                  put=lambda h: h)
+
+    def host_score(self, x: np.ndarray) -> np.ndarray:
+        """Champion cold-context scoring for (n, F) rows — the paired
+        half of the evaluator's label join (same rows, same cold
+        context, champion vs challenger)."""
+        with self._params_lock:
+            params, fn = self.params, self._apply
+        return self._score_direct(np.asarray(x, np.float32), params, fn,
+                                  put=self._put_hist)
+
+    def _score_direct(self, x: np.ndarray, params: Any, fn, put) -> np.ndarray:
+        if x.ndim == 2:
+            lb = self.len_buckets[0]
+            h = np.zeros((len(x), lb, self.store.num_features), np.float32)
+            h[:, -1] = x
+            x = h
+        n = len(x)
+        out = np.empty((n,), np.float32)
+        largest = self.batch_sizes[-1]
+        start = 0
+        while start < n:
+            stop = min(start + largest, n)
+            m = stop - start
+            sub = x[start:stop]
+            bucket = self._bucket(m)
+            if m < bucket:
+                sub = np.concatenate(
+                    [sub, np.zeros((bucket - m, *sub.shape[1:]), np.float32)]
+                )
+            proba = np.asarray(fn(params, put(np.ascontiguousarray(sub))))
+            out[start:stop] = proba[:m]
+            start = stop
+        return out

@@ -261,3 +261,367 @@ def test_seq_scorer_mesh_dispatch_matches_single_device():
     meshed.swap_params(params)
     np.testing.assert_allclose(meshed.score(rows, ids),
                                single.score(rows, ids), atol=5e-3)
+
+
+# -- round 11: striped store, fast paths, L buckets, overlapped dispatch ----
+
+
+def test_anonymous_only_prepare_stages_nothing_and_skips_the_store():
+    """Cold REST scoring (every id None) must not touch stripe locks or
+    the cap: empty staged dict, store untouched, commit a no-op."""
+    st = HistoryStore(length=3, num_features=2, max_customers=2)
+    out, token = st.prepare([None, None, None], np.ones((3, 2), np.float32))
+    gen, staged = token[0], token[1]
+    assert staged == {}
+    assert np.all(out[:, :2] == 0.0) and np.all(out[:, 2] == 1.0)
+    assert st.commit(token) is True
+    assert len(st) == 0
+
+
+def test_seq_scorer_counts_anonymous_fast_path_rows():
+    from ccfd_tpu.metrics.prom import Registry
+
+    reg = Registry()
+    params = seq_mod.init(jax.random.PRNGKey(0))
+    s = SeqScorer(params, length=4, batch_sizes=(8,),
+                  compute_dtype="float32", registry=reg)
+    s.score(np.zeros((5, 30), np.float32))  # no ids at all
+    assert reg.counter("seq_anonymous_rows_total", "").value() == 5.0
+    assert len(s.store) == 0
+
+
+def test_striped_store_keeps_global_lru_exact():
+    """Eviction order is GLOBAL commit recency, not per-stripe: with many
+    stripes and a tiny cap, the coldest keys fall regardless of which
+    stripe they hash to."""
+    st = HistoryStore(length=2, num_features=1, max_customers=3, stripes=7)
+    for key in "abcde":
+        st.commit(st.prepare([key], np.ones((1, 1), np.float32))[1])
+    assert len(st) == 3
+    snap_keys = [c[0] for c in st.snapshot()["customers"]]
+    assert sorted(snap_keys) == ["c", "d", "e"]
+    # snapshot order is coldest-first (stamp order) for faithful restore
+    assert snap_keys == ["c", "d", "e"]
+    # touching "c" (re-commit) makes "d" the next victim
+    st.commit(st.prepare(["c"], np.ones((1, 1), np.float32))[1])
+    st.commit(st.prepare(["f"], np.ones((1, 1), np.float32))[1])
+    assert sorted(c[0] for c in st.snapshot()["customers"]) == ["c", "e", "f"]
+
+
+def test_lru_cap_holds_under_interleaved_workers():
+    """Satellite: concurrent prepare/commit across threads (the
+    ParallelRouter shape) never overshoots the cap and keeps per-key
+    histories intact."""
+    import threading
+
+    st = HistoryStore(length=4, num_features=2, max_customers=64, stripes=8)
+    errors: list = []
+
+    def worker(wid: int) -> None:
+        try:
+            rng = np.random.default_rng(wid)
+            for it in range(30):
+                keys = [f"w{wid}-k{int(k)}" for k in
+                        rng.integers(0, 40, size=16)]
+                out, token = st.prepare(keys, rng.normal(
+                    size=(16, 2)).astype(np.float32))
+                assert out.shape == (16, 4, 2)
+                assert st.commit(token) is True
+                assert len(st) <= 64
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert 0 < len(st) <= 64
+    # survivors carry well-formed ring buffers
+    for key, buf, filled in st.snapshot()["customers"]:
+        assert np.asarray(buf).shape == (4, 2)
+        assert 1 <= filled <= 4
+
+
+def test_duplicate_keys_across_chunks_see_overlay_and_same_chunk_rows():
+    """Satellite: overlay visibility with duplicate keys BOTH within a
+    chunk and across chunks of one router batch (batch_sizes=(2,) forces
+    3 chunks over 6 rows of two interleaved customers)."""
+    params = seq_mod.init(jax.random.PRNGKey(5))
+    s = SeqScorer(params, length=8, batch_sizes=(2,), compute_dtype="float32")
+    x = np.arange(6 * 30, dtype=np.float32).reshape(6, 30)
+    ids = ["a", "b", "a", "b", "a", "a"]
+    s.score(x, ids=ids)
+    snap = {c[0]: (np.asarray(c[1]), c[2]) for c in
+            s.store.snapshot()["customers"]}
+    buf_a, filled_a = snap["a"]
+    buf_b, filled_b = snap["b"]
+    assert filled_a == 4 and filled_b == 2
+    # a's ring holds rows 0, 2, 4, 5 newest-last
+    assert np.allclose(buf_a[-1], x[5]) and np.allclose(buf_a[-2], x[4])
+    assert np.allclose(buf_a[-3], x[2]) and np.allclose(buf_a[-4], x[0])
+    assert np.allclose(buf_b[-1], x[3]) and np.allclose(buf_b[-2], x[1])
+
+
+def test_stale_generation_commit_after_restore_races_async_dispatch():
+    """Satellite: a crash restore landing while an ASYNC dispatch is in
+    flight must not let that batch's commit land on the restored state —
+    the rewound bus re-drives those records. The dispatch is held open on
+    an event; restore() fires mid-flight; the resolved batch still
+    returns scores but its commit is a counted no-op."""
+    import threading
+
+    from ccfd_tpu.metrics.prom import Registry
+
+    reg = Registry()
+    params = seq_mod.init(jax.random.PRNGKey(6))
+    s = SeqScorer(params, length=4, batch_sizes=(4,),
+                  compute_dtype="float32", inflight=2, registry=reg)
+    s.score(np.ones((1, 30), np.float32), ids=["k"])
+    snap = s.store.snapshot()
+
+    real_apply = s._apply
+    entered = threading.Event()
+    release = threading.Event()
+
+    def blocking_apply(p, xs):
+        entered.set()
+        assert release.wait(timeout=10)
+        return real_apply(p, xs)
+
+    s._apply = blocking_apply
+    result: dict = {}
+
+    def run():
+        result["proba"] = s.score(
+            np.full((2, 30), 9.0, np.float32), ids=["k", "k2"])
+
+    t = threading.Thread(target=run)
+    t.start()
+    assert entered.wait(timeout=10)
+    s.store.restore(snap)  # crash restore while the dispatch is in flight
+    release.set()
+    t.join(timeout=30)
+    assert result["proba"].shape == (2,)
+    # the doomed-epoch commit was dropped: store is exactly the cut
+    final = s.store.snapshot()
+    assert [c[0] for c in final["customers"]] == ["k"]
+    assert final["customers"][0][2] == 1
+    assert reg.counter("seq_stale_commits_total", "").value() == 1.0
+
+
+def test_len_bucket_ladder_routes_cold_rows_to_short_executables():
+    """Cold rows (filled << L) dispatch through the short-L executable;
+    a customer whose history outgrows the bucket moves up the ladder.
+    Hit counters record the (L, B) mix."""
+    from ccfd_tpu.metrics.prom import Registry
+
+    reg = Registry()
+    params = seq_mod.init(jax.random.PRNGKey(7))
+    s = SeqScorer(params, length=16, batch_sizes=(4,),
+                  compute_dtype="float32", len_buckets=(4,), registry=reg)
+    assert s.len_buckets == (4, 16)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 30)).astype(np.float32)
+    p1 = s.score(x, ids=["c", "c", "c"])  # filled <= 3: short bucket
+    c = reg.counter("seq_bucket_rows_total", "")
+    assert c.value(labels={"l_bucket": "4"}) == 3.0
+    assert c.value(labels={"l_bucket": "16"}) == 0.0
+    # two more appends: the 4th row still fits the short bucket, the 5th
+    # (filled=5 > 4) moves up to the full-L executable
+    p2 = s.score(x[:2], ids=["c", "c"])
+    assert c.value(labels={"l_bucket": "4"}) == 4.0
+    assert c.value(labels={"l_bucket": "16"}) == 1.0
+    assert np.all((p1 >= 0) & (p1 <= 1)) and np.all((p2 >= 0) & (p2 <= 1))
+
+
+def test_len_bucket_short_dispatch_keeps_full_l_token_positions():
+    """The short-bucket executable scores the right-aligned window with
+    positional encodings anchored at the FULL length (pos_length=L): a
+    cold row's tokens keep the positions the full-L path gives them, so
+    scores don't jump at ladder crossovers. Pinned by direct equality
+    with the documented serving function."""
+    import jax.numpy as jnp
+
+    params = seq_mod.init(jax.random.PRNGKey(8))
+    L = 16
+    bucketed = SeqScorer(params, length=L, batch_sizes=(4,),
+                         compute_dtype="float32", len_buckets=(4,))
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 30)).astype(np.float32)
+    got = bucketed.score(x, ids=["p", "q"])  # filled=1 -> lb=4 window
+    w = np.zeros((2, 4, 30), np.float32)
+    w[:, -1] = x
+    w = np.concatenate([w, np.zeros((2, 4, 30), np.float32)])  # B bucket 4
+    want = np.asarray(seq_mod.apply_serving(
+        params, w[:4], jnp.float32, pos_length=L))[:2]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # anchoring is a real offset: the un-anchored forward differs
+    unanchored = np.asarray(seq_mod.apply_serving(
+        params, w[:4], jnp.float32))[:2]
+    assert not np.allclose(got, unanchored, atol=1e-6)
+
+
+def test_async_overlapped_scores_match_synchronous():
+    """inflight > 0 (overlapped) and inflight=0 (synchronous) run the
+    same executables over the same assemblies — identical probabilities,
+    identical store contents."""
+    params = seq_mod.init(jax.random.PRNGKey(9))
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(40, 30)).astype(np.float32)
+    ids = [i % 7 for i in range(40)]
+    sync = SeqScorer(params, length=8, batch_sizes=(16,),
+                     compute_dtype="float32", inflight=0)
+    over = SeqScorer(params, length=8, batch_sizes=(16,),
+                     compute_dtype="float32", inflight=3)
+    p_sync = sync.score(x, ids)
+    p_over = over.score(x, ids)
+    np.testing.assert_allclose(p_over, p_sync, atol=1e-6)
+    a = {c[0]: np.asarray(c[1]) for c in sync.store.snapshot()["customers"]}
+    b = {c[0]: np.asarray(c[1]) for c in over.store.snapshot()["customers"]}
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_snapshot_is_stripe_incremental_and_zero_copy():
+    """Clean stripes reuse the cached entry list and entries share the
+    live buffers (immutable by convention): back-to-back snapshots hand
+    out the SAME arrays, and a commit touching one key only refreshes
+    that stripe's entries."""
+    st = HistoryStore(length=2, num_features=2, max_customers=8, stripes=4)
+    st.commit(st.prepare(["a", "b"], np.ones((2, 2), np.float32))[1])
+    s1 = st.snapshot()
+    s2 = st.snapshot()
+    bufs1 = {c[0]: c[1] for c in s1["customers"]}
+    bufs2 = {c[0]: c[1] for c in s2["customers"]}
+    assert all(bufs1[k] is bufs2[k] for k in bufs1)  # no re-copy
+    st.commit(st.prepare(["a"], np.full((1, 2), 2.0, np.float32))[1])
+    s3 = st.snapshot()
+    bufs3 = {c[0]: c[1] for c in s3["customers"]}
+    assert bufs3["a"] is not bufs1["a"]  # touched: fresh entry
+    assert bufs3["b"] is bufs1["b"]      # untouched stripe: shared
+    # and the older snapshots were not corrupted by the later commit
+    assert np.all(np.asarray(bufs1["a"])[-1] == 1.0)
+
+
+def test_quantized_swap_rebinds_the_serving_graph():
+    """swap_params with an int8 seq_q8 tree (the lifecycle promotion
+    path) re-binds the jitted apply by sniffing the params — scores keep
+    flowing, close to the f32 champion's."""
+    from ccfd_tpu.ops.seq_quant import is_quantized, quantize_seq
+
+    params = seq_mod.init(jax.random.PRNGKey(10))
+    s = SeqScorer(params, length=8, batch_sizes=(8,),
+                  compute_dtype="float32")
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(6, 30)).astype(np.float32)
+    before = s.score(x, ids=list(range(6)))
+    s.swap_params(quantize_seq(params))
+    assert is_quantized(s.params)
+    after = s.score(x, ids=list(range(6)))
+    assert after.shape == (6,)
+    np.testing.assert_allclose(after, before, atol=0.06)
+
+
+def test_batch_commit_evicts_by_arrival_order_not_stripe_group():
+    """Regression (found by the live replay drill): stamps must follow
+    the batch's ARRIVAL order. Assigning them during the per-stripe
+    insertion pass made whole stripe-groups 'newest' within a batch, so
+    eviction at the cap systematically kept one hash class per batch —
+    and a crash-replay with different batch boundaries rebuilt a
+    DISJOINT survivor set."""
+    st = HistoryStore(length=2, num_features=1, max_customers=4, stripes=4)
+    keys = list(range(12))  # unique customers, one batch, cap binds hard
+    st.commit(st.prepare(keys, np.ones((12, 1), np.float32))[1])
+    survivors = sorted(c[0] for c in st.snapshot()["customers"])
+    assert survivors == [8, 9, 10, 11], survivors  # the arrival tail
+
+
+def test_restore_between_chunk_prepares_dooms_the_whole_batch():
+    """Regression: the batch commits with the FIRST chunk's generation.
+    A restore landing BETWEEN chunk prepares must drop the whole batch —
+    committing with a later chunk's fresh generation would publish the
+    earlier chunks' pre-restore staging onto the restored state, and the
+    rewound bus would then double-append those records."""
+    import threading
+
+    params = seq_mod.init(jax.random.PRNGKey(12))
+    s = SeqScorer(params, length=4, batch_sizes=(2,),
+                  compute_dtype="float32", inflight=0)
+    s.score(np.ones((1, 30), np.float32), ids=["k"])
+    snap = s.store.snapshot()
+
+    real_apply = s._apply
+    calls = {"n": 0}
+    first_done = threading.Event()
+    resume = threading.Event()
+
+    def chunked_apply(p, xs):
+        calls["n"] += 1
+        if calls["n"] == 1:  # park AFTER chunk 1's prepare+dispatch
+            first_done.set()
+            assert resume.wait(timeout=10)
+        return real_apply(p, xs)
+
+    s._apply = chunked_apply
+    result = {}
+
+    def run():
+        # 4 rows, batch_sizes=(2,): two chunks, two prepares
+        result["p"] = s.score(np.full((4, 30), 2.0, np.float32),
+                              ids=["k", "k2", "k3", "k4"])
+
+    t = threading.Thread(target=run)
+    t.start()
+    assert first_done.wait(timeout=10)
+    s.store.restore(snap)  # lands between chunk 1 and chunk 2 prepares
+    resume.set()
+    t.join(timeout=30)
+    assert result["p"].shape == (4,)
+    # the whole batch's commit was a no-op: exactly the cut's state
+    final = s.store.snapshot()
+    assert [c[0] for c in final["customers"]] == ["k"]
+    assert final["customers"][0][2] == 1
+
+
+def test_duplicate_key_recency_is_batch_boundary_invariant():
+    """Regression: a key appearing twice in one batch must take its LAST
+    occurrence's recency — dict insertion order would keep the FIRST, so
+    the same record stream replayed with different batch boundaries
+    would evict a different survivor set under a binding cap."""
+    def survivors(batches):
+        st = HistoryStore(length=2, num_features=1, max_customers=2,
+                          stripes=4)
+        for keys in batches:
+            st.commit(st.prepare(
+                keys, np.ones((len(keys), 1), np.float32))[1])
+        return sorted(str(c[0]) for c in st.snapshot()["customers"])
+
+    # same stream A,B,A,C under three different batchings
+    one = survivors([["A", "B", "A", "C"]])
+    two = survivors([["A", "B", "A"], ["C"]])
+    three = survivors([["A", "B"], ["A"], ["C"]])
+    assert one == two == three == ["A", "C"]  # B is the LRU victim
+
+
+def test_late_commit_from_abandoned_batch_cannot_clobber_newer_state():
+    """Regression: a watchdog-abandoned dispatch's commit can land AFTER
+    the worker's next batch (same partition keys) prepared and committed.
+    The per-key optimistic check must skip the contended key — the newer
+    state survives, the skip is counted, and the routed stream (which
+    contains both batches' records) rebuilds the full history at the
+    next crash-restore replay."""
+    st = HistoryStore(length=4, num_features=1, stripes=2)
+    st.commit(st.prepare(["c"], np.ones((1, 1), np.float32))[1])
+    # both batches prepare from the same base state (B1's dispatch hung;
+    # the router abandoned it and moved on to B2)
+    _, t1 = st.prepare(["c"], np.full((1, 1), 2.0, np.float32))
+    _, t2 = st.prepare(["c"], np.full((1, 1), 3.0, np.float32))
+    assert st.commit(t2) is True          # the live batch publishes
+    assert st.commit(t1) is True          # the late commit is per-key
+    assert st.contended_skips == 1        # ... skipped, not clobbering
+    (key, buf, filled), = st.snapshot()["customers"]
+    assert key == "c" and filled == 2
+    assert np.asarray(buf)[-1, 0] == 3.0  # B2's append survived

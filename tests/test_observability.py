@@ -97,7 +97,7 @@ def test_dashboards_cover_contract_metrics():
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
-        "ModelLifecycle", "Overload",
+        "ModelLifecycle", "Overload", "SeqServing",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -166,6 +166,29 @@ def test_bus_board_has_alert_threshold_stats():
         assert "thresholds" in stats[title]["fieldConfig"]["defaults"], title
 
 
+def test_seq_serving_board_covers_the_dataflow_metrics():
+    """The Sequence Serving panel group (round 11): every metric the
+    overlapped seq dataflow exports must be charted — the split that
+    motivated the rework (assembly vs dispatch), the L/B bucket mix, the
+    async depth, the anonymous fast path and the crash-replay stale-commit
+    tripwire (which must be an alert-colored stat, like the other
+    must-stay-zero signals)."""
+    board = build_all_dashboards()["SeqServing"]
+    exprs = _all_exprs({"s": board})
+    for metric in (
+        "seq_assembly_seconds", "seq_dispatch_seconds",
+        "seq_bucket_dispatch_total", "seq_bucket_rows_total",
+        "seq_inflight_dispatches", "seq_anonymous_rows_total",
+        "seq_history_customers", "seq_stale_commits_total",
+    ):
+        assert any(metric in e for e in exprs), metric
+    stale = [p for p in board["panels"]
+             if any("seq_stale_commits_total" in t["expr"]
+                    for t in p["targets"])]
+    assert stale and stale[0]["type"] == "stat"
+    assert "thresholds" in stale[0]["fieldConfig"]["defaults"]
+
+
 def test_seldon_board_carries_dispatch_health():
     exprs = _all_exprs({"s": build_all_dashboards()["SeldonCore"]})
     for metric in ("ccfd_device_wedged", "ccfd_dispatch_timeouts_total",
@@ -175,7 +198,7 @@ def test_seldon_board_carries_dispatch_health():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 12
+    assert len(paths) == 13
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")

@@ -113,3 +113,20 @@ def test_ring_attention_is_differentiable():
     g_ref = jax.grad(loss_ref)(params)
     for a, b in zip(jax.tree.leaves(g_ring), jax.tree.leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4)
+
+
+def test_readout_logits_match_full_logits():
+    """The serving-path last-block readout optimization (round 11) is
+    EXACT: same params, same numbers as the full forward modulo float
+    reassociation — SeqScorer dispatches apply_serving, so any drift here
+    would silently change production scores."""
+    params = seq.init(jax.random.PRNGKey(11))
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(32, 24, 30)),
+                    jnp.float32)
+    full = np.asarray(seq.logits(params, x, jnp.float32))
+    fast = np.asarray(seq.logits_readout(params, x, jnp.float32))
+    np.testing.assert_allclose(fast, full, rtol=1e-5, atol=1e-5)
+    # and through the jitted serving entry, in bf16 too
+    a = np.asarray(seq.apply(params, x))
+    b = np.asarray(seq.apply_serving(params, x))
+    np.testing.assert_allclose(a, b, atol=5e-3)

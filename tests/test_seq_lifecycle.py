@@ -1,0 +1,178 @@
+"""Quantized seq variant through the lifecycle shadow lane (round 11).
+
+The int8 ``seq_q8`` scorer (ops/seq_quant.py) may only reach serving
+through the PR 4 lifecycle gates: shadow-scored against the bf16/f32
+champion over live traffic (AUC on joined labels, score-distribution PSI,
+alert-rate delta), then canary, then a promotion that re-binds the
+SeqScorer's serving graph. Both verdicts are exercised: a faithful
+quantization passes and PROMOTES; a broken one (collapsed scales — the
+quantization-bug shape) breaches the distribution gates and is REJECTED
+with the champion untouched."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES, synthetic_dataset
+from ccfd_tpu.lifecycle.controller import (
+    STAGE_CANARY,
+    STAGE_IDLE,
+    Guardrails,
+    LifecycleController,
+)
+from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+from ccfd_tpu.lifecycle.shadow import ShadowTap
+from ccfd_tpu.lifecycle.versions import VersionStore
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.models import seq as seq_mod
+from ccfd_tpu.ops.seq_quant import is_quantized, quantize_seq
+from ccfd_tpu.parallel.checkpoint import CheckpointManager
+from ccfd_tpu.serving.history import SeqScorer
+
+
+def test_seq_q8_probabilities_track_the_float_graph():
+    """Accuracy contract, like mlp_q8's: the int8 graph's probabilities
+    stay within int8-noise of the f32 forward — far inside the
+    FRAUD_THRESHOLD routing granularity."""
+    params = seq_mod.init(jax.random.PRNGKey(0))
+    qp = quantize_seq(params)
+    assert is_quantized(qp) and not is_quantized(params)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 16, 30)).astype(np.float32)
+    from ccfd_tpu.ops import seq_quant
+
+    a = np.asarray(seq_mod.apply_serving(params, x, jax.numpy.float32))
+    b = np.asarray(seq_quant.apply(qp, x, jax.numpy.float32))
+    assert float(np.abs(a - b).max()) < 0.05
+
+
+def test_seq_q8_registered_in_the_zoo():
+    from ccfd_tpu.models.registry import get_model
+
+    spec = get_model("seq_q8")
+    assert spec.trainable is False
+    qp = spec.init(jax.random.PRNGKey(1))
+    assert is_quantized(qp)
+    x = np.zeros((4, 8, 30), np.float32)
+    assert np.asarray(spec.apply(qp, x)).shape == (4,)
+    assert get_model("seq").name == "seq"
+
+
+def _mk_seq_stack(tmp_path, scorer, guardrails):
+    cfg = Config()
+    broker = Broker()
+    reg = Registry()
+    store = VersionStore(None)
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), keep=8)
+    # unlimited sampling budget: the test drives batches faster than wall
+    # time refills a token bucket
+    shadow = ShadowTap(scorer, broker, cfg.shadow_topic, reg,
+                       max_rows_per_s=0)
+    ev = ShadowEvaluator(cfg, broker, scorer, reg)
+    ctl = LifecycleController(
+        cfg, scorer, store=store, checkpoints=ckpt, shadow=shadow,
+        evaluator=ev, guardrails=guardrails, registry=reg)
+    scorer.shadow_tap = shadow  # the seq lane's tap wiring (operator.py)
+    return cfg, broker, reg, store, shadow, ev, ctl
+
+
+def _pump_seq(cfg, broker, scorer, shadow, ctl, X, y, batches=4,
+              labels_per_batch=24, seed=0):
+    """Live traffic + labels: warm repeating customers through the real
+    score_with_ids lane (so the tap sees assembled histories), labels
+    onto the labels topic for the evaluator's paired cold re-score."""
+    rng = np.random.default_rng(seed)
+    for _ in range(batches):
+        idx = rng.integers(0, len(X), size=256)
+        txs = [{"customer_id": int(i % 64)} for i in idx]
+        scorer.score_with_ids(txs, X[idx])
+        shadow.step()
+        lidx = rng.integers(0, len(X), size=labels_per_batch)
+        for j in lidx:
+            broker.produce(cfg.labels_topic, {
+                "transaction": dict(
+                    zip(FEATURE_NAMES, map(float, X[j]))),
+                "label": int(y[j]),
+            })
+        ctl.step()
+
+
+def test_quantized_seq_passes_shadow_gate_and_promotes(tmp_path):
+    ds = synthetic_dataset(n=2048, fraud_rate=0.05, seed=0)
+    params = seq_mod.set_normalizer(
+        seq_mod.init(jax.random.PRNGKey(2)), ds.X.mean(0), ds.X.std(0))
+    scorer = SeqScorer(params, length=8, batch_sizes=(256,),
+                       compute_dtype="float32", max_customers=256)
+    # distribution gates at realistic ceilings; the AUC margin is wide
+    # because the untrained champion's label AUC is itself noisy — the
+    # contract under test is the GATE PATH, the reject test pins a breach
+    g = Guardrails(min_labels=24, min_shadow_rows=512,
+                   auc_margin=0.2, max_alert_rate_delta=0.5,
+                   max_score_psi=0.5, canary_min_labels=8,
+                   min_submit_interval_s=0.0)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_seq_stack(
+        tmp_path, scorer, g)
+    scorer.canary_gate = ctl.gate  # the seq canary wiring (operator.py)
+
+    v = ctl.submit_candidate(quantize_seq(params), label_watermark=1)
+    assert scorer.challenger_version == v
+    _pump_seq(cfg, broker, scorer, shadow, ctl, ds.X, ds.y, batches=3)
+    # shadow gates judged: a faithful quantization enters canary
+    assert ctl.stage in (STAGE_CANARY, STAGE_IDLE)
+    # more live traffic + labels: the canary slice must actually SERVE —
+    # challenger-arm rows re-scored against the same assembled contexts
+    _pump_seq(cfg, broker, scorer, shadow, ctl, ds.X, ds.y, batches=2,
+              seed=7)
+    canary_rows = reg.counter("ccfd_lifecycle_canary_rows_total", "")
+    assert canary_rows.value(labels={"arm": "challenger"}) > 0
+    assert canary_rows.value(labels={"arm": "champion"}) > 0
+    for _ in range(4):
+        ctl.step()
+    # ...and promotes: the serving graph is now the int8 variant
+    assert ctl.stage == STAGE_IDLE
+    assert ctl.champion == v
+    assert store.get(v).stage == "CHAMPION"
+    assert is_quantized(scorer.params)
+    assert scorer.challenger_version is None
+    # the promoted graph still serves history-conditioned scores
+    p = scorer.score(ds.X[:16], ids=[int(i % 4) for i in range(16)])
+    assert p.shape == (16,) and np.isfinite(p).all()
+
+
+def test_broken_quantization_is_rejected_and_champion_untouched(tmp_path):
+    ds = synthetic_dataset(n=2048, fraud_rate=0.05, seed=1)
+    params = seq_mod.set_normalizer(
+        seq_mod.init(jax.random.PRNGKey(3)), ds.X.mean(0), ds.X.std(0))
+    scorer = SeqScorer(params, length=8, batch_sizes=(256,),
+                       compute_dtype="float32", max_customers=256)
+    g = Guardrails(min_labels=24, min_shadow_rows=512,
+                   auc_margin=0.2, max_alert_rate_delta=0.5,
+                   max_score_psi=0.5, canary_min_labels=0,
+                   min_submit_interval_s=0.0)
+    cfg, broker, reg, store, shadow, ev, ctl = _mk_seq_stack(
+        tmp_path, scorer, g)
+
+    # the quantization-bug shape: collapsed scales flatten every logit to
+    # its bias — the score distribution degenerates and PSI blows through
+    # the ceiling (plus an alert-rate collapse, breach either way)
+    broken = jax.tree.map(np.asarray, quantize_seq(params))
+    broken["head"] = dict(broken["head"])
+    broken["head"]["scale"] = np.zeros_like(
+        np.asarray(broken["head"]["scale"]))
+    broken["head"]["b"] = np.asarray([4.0], np.float32)  # constant alert
+
+    v = ctl.submit_candidate(broken, label_watermark=2)
+    _pump_seq(cfg, broker, scorer, shadow, ctl, ds.X, ds.y, batches=3,
+              seed=1)
+    assert ctl.stage == STAGE_IDLE
+    assert store.get(v).stage == "REJECTED"
+    assert ctl.champion != v
+    # champion untouched: still the float graph, challenger withdrawn
+    assert not is_quantized(scorer.params)
+    assert scorer.challenger_version is None
+    # the audit trail records the breach reasons
+    audit = store.audit_trail(v)
+    assert any("REJECTED" in str(e) for e in audit)

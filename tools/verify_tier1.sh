@@ -24,6 +24,13 @@
 #                                          regime (tools/load_shape.py)
 #                                          and gate on its exit code:
 #                                          OVERLOAD verdict=PASS|FAIL
+#   tools/verify_tier1.sh --seq-smoke      exit-code-gated smoke of the
+#                                          overlapped seq dataflow
+#                                          (tools/seq_smoke.py): overlap
+#                                          active + accounting conserves +
+#                                          restore-replay rebuilds
+#                                          byte-identical histories:
+#                                          SEQSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -39,6 +46,18 @@ if [ "${1:-}" = "--overload-smoke" ]; then
         exit 0
     fi
     echo "OVERLOAD verdict=FAIL"
+    exit 1
+fi
+
+if [ "${1:-}" = "--seq-smoke" ]; then
+    # exit-code-gated smoke of the round-11 seq dataflow: async overlap
+    # must not change scores or lose rows, and crash restore-replay must
+    # rebuild byte-identical histories (see tools/seq_smoke.py)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/seq_smoke.py; then
+        # the script already printed SEQSMOKE verdict=PASS
+        exit 0
+    fi
     exit 1
 fi
 
