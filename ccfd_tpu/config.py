@@ -170,6 +170,30 @@ class Config:
     # cpu — same resolution as the server-side dispatch deadline); 0 = off
     overload_dispatch_deadline_ms: float = -1.0  # CCFD_OVERLOAD_DISPATCH_DEADLINE_MS
 
+    # --- SLO monitoring (observability/slo.py; CR block `slo:`) ---
+    # master switch for the stage profiler + SLO engine (CCFD_SLO; 0
+    # disables the profile/burn-rate plane entirely — like CCFD_OVERLOAD
+    # it is the emergency kill switch a CR cannot override)
+    slo_enabled: bool = True
+    # evaluation tick for the supervised SLO service
+    slo_interval_s: float = 5.0            # CCFD_SLO_INTERVAL_S
+    # latency objectives: "objective fraction of events at/under target"
+    slo_e2e_target_ms: float = 50.0        # CCFD_SLO_E2E_TARGET_MS
+    slo_rest_target_ms: float = 25.0       # CCFD_SLO_REST_TARGET_MS
+    slo_objective: float = 0.99            # CCFD_SLO_OBJECTIVE
+    # error-rate objective: counted process-start failures over incoming
+    slo_max_error_rate: float = 0.01       # CCFD_SLO_MAX_ERROR_RATE
+    # burn-rate windows in seconds: every entry but the last is a FAST
+    # window alerting at slo_fast_burn (short confirms long); the last is
+    # the slow budget window at burn 1.0 (CCFD_SLO_WINDOWS)
+    slo_windows: str = "300,3600,21600"
+    slo_fast_burn: float = 14.4            # CCFD_SLO_FAST_BURN
+    # REST transport floor for the budget ledger: the r04
+    # rest_latency_floor measurement (NativeFront 1x1-row RTT p99,
+    # REST_SWEEP/BENCH_r04) — re-measure with tools/rest_sweep.py when
+    # the front or host changes (CCFD_SLO_TRANSPORT_FLOOR_MS)
+    slo_transport_floor_ms: float = 0.072
+
     # --- sequence serving (serving/history.py; CR block `scorer.seq_*`) ---
     # HistoryStore stripe count: per-stripe locks keep ParallelRouter
     # workers from convoying on one global lock (CCFD_SEQ_STRIPES)
@@ -345,6 +369,34 @@ class Config:
             lifecycle_min_submit_interval_s=float(
                 e.get("CCFD_LIFECYCLE_MIN_SUBMIT_INTERVAL_S",
                       str(Config.lifecycle_min_submit_interval_s))
+            ),
+            slo_enabled=e.get("CCFD_SLO", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            slo_interval_s=float(
+                e.get("CCFD_SLO_INTERVAL_S", str(Config.slo_interval_s))
+            ),
+            slo_e2e_target_ms=float(
+                e.get("CCFD_SLO_E2E_TARGET_MS",
+                      str(Config.slo_e2e_target_ms))
+            ),
+            slo_rest_target_ms=float(
+                e.get("CCFD_SLO_REST_TARGET_MS",
+                      str(Config.slo_rest_target_ms))
+            ),
+            slo_objective=float(
+                e.get("CCFD_SLO_OBJECTIVE", str(Config.slo_objective))
+            ),
+            slo_max_error_rate=float(
+                e.get("CCFD_SLO_MAX_ERROR_RATE",
+                      str(Config.slo_max_error_rate))
+            ),
+            slo_windows=e.get("CCFD_SLO_WINDOWS", Config.slo_windows),
+            slo_fast_burn=float(
+                e.get("CCFD_SLO_FAST_BURN", str(Config.slo_fast_burn))
+            ),
+            slo_transport_floor_ms=float(
+                e.get("CCFD_SLO_TRANSPORT_FLOOR_MS",
+                      str(Config.slo_transport_floor_ms))
             ),
             trace_sample=float(
                 e.get("CCFD_TRACE_SAMPLE", str(Config.trace_sample))

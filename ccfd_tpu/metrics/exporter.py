@@ -13,6 +13,11 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
     GET /rest/metrics          alias for the KIE registry (reference path)
     GET /traces                retained-trace summaries (tail sampler, JSON)
     GET /traces/<id>           one retained trace's spans (JSON)
+    GET /profile               live StageProfile document (JSON): per-stage
+                               queueing/service/dispatch decomposition +
+                               batch-conditioned service curves + compile
+                               attribution — the provisioning-planner input
+                               contract (observability/profile.py)
     GET /memory                memory-drift evidence (JSON): RSS, GC stats,
                                per-component object counts, tracemalloc top
                                allocators; ?trace=1 arms tracemalloc
@@ -117,9 +122,11 @@ class MetricsExporter:
     def __init__(self, registries: dict[str, Registry],
                  host: str = "127.0.0.1", port: int = 0,
                  sink=None,
-                 memory_probes: dict[str, "object"] | None = None):
+                 memory_probes: dict[str, "object"] | None = None,
+                 profiler=None):
         self._registries = dict(registries)
         self._sink = sink  # observability.trace.SpanSink (or None)
+        self._profiler = profiler  # observability.profile.StageProfiler
         self._lock = threading.Lock()
         # memory-drift surface (observability/memory.py): a "process"
         # registry every scrape refreshes with the RSS gauge and one
@@ -199,6 +206,11 @@ class MetricsExporter:
         """-> (body or None for 404, content type)."""
         if path == "/traces" or path.startswith("/traces/"):
             return self._traces(path), "application/json"
+        if path == "/profile":
+            if self._profiler is None:
+                return None, "application/json"
+            return (json.dumps(self._profiler.snapshot()),
+                    "application/json")
         if path == "/memory":
             return self._memory(query), "application/json"
         body = self.render_path(path, openmetrics)
@@ -222,8 +234,15 @@ class MetricsExporter:
 
     def render_path(self, path: str, openmetrics: bool = False) -> str | None:
         # the scrape is the sampling clock for the memory gauges: every
-        # metric render refreshes RSS + component object counts first
+        # metric render refreshes RSS + component object counts first —
+        # and for the stage-latency gauges (the SLO board's decomposition
+        # panels must read fresh quantiles, not the last /profile read's)
         self._refresh_memory_gauges()
+        if self._profiler is not None:
+            try:
+                self._profiler.refresh_gauges()
+            except Exception:  # noqa: BLE001 - a profiler bug must not 500
+                pass
         with self._lock:
             regs = dict(self._registries)
         if path in ("", "/prometheus", "/metrics"):

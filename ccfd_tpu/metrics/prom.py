@@ -117,6 +117,13 @@ class _ScalarMetric(_Metric):
         with self._lock:
             return sum(self._values.values())
 
+    def items(self) -> list[tuple[LabelKey, float]]:
+        """Every (labelkey, value) pair — read-side enumeration for
+        consumers that aggregate across label sets (the stage profiler's
+        overload section, the SLO engine's error-class sums)."""
+        with self._lock:
+            return list(self._values.items())
+
     def render(self) -> Iterable[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -254,6 +261,45 @@ class Histogram(_Metric):
         with self._lock:
             return self._sums.get(_labelkey(labels), 0.0)
 
+    def count_le(self, value: float,
+                 labels: Mapping[str, str] | None = None) -> float:
+        """Interpolated cumulative count of observations <= ``value`` —
+        the inverse of :meth:`quantile`. The SLO engine derives good/bad
+        event counts from latency histograms with it: good = count_le(
+        target), bad = count - good. ``value`` rarely sits on a bucket
+        boundary, so the within-bucket share interpolates linearly (same
+        assumption histogram_quantile() makes)."""
+        with self._lock:
+            counts = list(self._counts.get(_labelkey(labels), []))
+        return self._count_le_of(counts, value)
+
+    def _count_le_of(self, counts: list, value: float) -> float:
+        if not counts:
+            return 0.0
+        prev_ub, prev_c = 0.0, 0
+        for ub, c in zip(self.buckets, counts):
+            if value <= ub:
+                if ub == math.inf:
+                    return float(prev_c)
+                span = ub - prev_ub
+                frac = (value - prev_ub) / span if span > 0 else 1.0
+                return prev_c + (c - prev_c) * frac
+            prev_ub, prev_c = ub, c
+        return float(counts[-1])
+
+    def total_count(self) -> int:
+        """Observation count summed across every label set (the serving
+        latency series is labeled by endpoint; an SLO over "all requests"
+        must see all of them)."""
+        with self._lock:
+            return sum(c[-1] for c in self._counts.values())
+
+    def total_count_le(self, value: float) -> float:
+        """:meth:`count_le` summed across every label set."""
+        with self._lock:
+            all_counts = [list(c) for c in self._counts.values()]
+        return sum(self._count_le_of(c, value) for c in all_counts)
+
     def quantile(self, q: float, labels: Mapping[str, str] | None = None) -> float:
         """Bucket-interpolated quantile (what histogram_quantile() computes)."""
         with self._lock:
@@ -330,6 +376,14 @@ class Registry:
         return self._get_or_make(
             name, lambda: Histogram(name, help_, buckets, labelset_limit),
             Histogram)
+
+    def get(self, name: str) -> "_Metric | None":
+        """A registered metric by name, or None — the read-side lookup the
+        SLO engine and stage profiler resolve metric sources with (they
+        consume other components' registries without knowing types up
+        front)."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def _get_or_make(self, name, factory, cls):
         with self._lock:

@@ -27,6 +27,11 @@ metric names, one builder per board:
 - SeqServing   — overlapped sequence-serving dataflow: assembly/dispatch
   split, (L, B)-bucket executable mix, async in-flight depth, stale-commit
   crash-replay tripwire (new capability; no reference analog)
+- SLO          — burn-rate SLO monitoring + stage-profile surface:
+  multi-window error-budget burn per SLO, budget remaining, breach
+  alerts, the REST per-layer latency-budget ledger, and the live
+  queueing/service/dispatch stage decomposition with XLA compile
+  attribution (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -502,6 +507,50 @@ def seq_serving_dashboard() -> dict:
     return _dashboard("CCFD Sequence Serving", "ccfd-seq", p)
 
 
+def slo_dashboard() -> dict:
+    """SLO board (round 12; observability/slo.py + profile.py).
+
+    The objective-side view the Overload board's mechanisms defend: per
+    SLO, the multi-window error-budget burn rate (the fast 5m/1h pair is
+    the page condition; the slow 6h window is the budget-consumption
+    trend), error budget remaining, and the edge-triggered breach
+    counter. Below it, the stage-profile surface: the per-layer REST
+    latency-budget ledger (which layer is eating the budget — transport
+    floor, batcher wait, device dispatch, H2D), the live queueing vs
+    service vs dispatch decomposition per pipeline stage, and XLA
+    compile-event attribution (a mid-traffic compile explains a p99
+    spike no traffic change does)."""
+    p = [
+        _panel(0, "Error-budget burn rate by SLO and window",
+               ["ccfd_slo_burn_rate"]),
+        _alert_stat(1, "Fast-window burn (page at threshold)",
+                    ['max(ccfd_slo_burn_rate{window="5m"})',
+                     'max(ccfd_slo_burn_rate{window="1h"})'],
+                    red_above=14.4),
+        _alert_stat(2, "Error budget remaining by SLO",
+                    ["ccfd_slo_error_budget_remaining"], red_below=0.1),
+        _alert_stat(3, "SLO breaches (edge-triggered)",
+                    ["ccfd_slo_breach_total"], red_above=1),
+        _panel(4, "SLO breaching now (0/1)", ["ccfd_slo_breaching"]),
+        _panel(5, "REST budget spent ratio by layer "
+                  "(>1 = layer blows its slice)",
+               ["ccfd_slo_budget_spent_ratio"]),
+        _panel(6, "Stage latency p99 by component (ms)",
+               ['ccfd_stage_latency_ms{quantile="p99"}']),
+        _panel(7, "Stage latency p50 by component (ms)",
+               ['ccfd_stage_latency_ms{quantile="p50"}']),
+        _panel(8, "Queueing share: bus wait vs scorer dispatch p99 (ms)",
+               ['ccfd_stage_latency_ms{stage="bus",component="queue",quantile="p99"}',
+                'ccfd_stage_latency_ms{stage="router.score",component="dispatch",quantile="p99"}']),
+        _alert_stat(9, "XLA compiles under traffic / s",
+                    ["rate(ccfd_xla_compile_events_total[5m])"],
+                    red_above=0.1),
+        _panel(10, "Cumulative XLA compile seconds",
+               ["ccfd_xla_compile_seconds_total"]),
+    ]
+    return _dashboard("CCFD SLO", "ccfd-slo", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -527,6 +576,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "ModelLifecycle": lifecycle_dashboard(),
         "Overload": overload_dashboard(),
         "SeqServing": seq_serving_dashboard(),
+        "SLO": slo_dashboard(),
     }
 
 

@@ -1,0 +1,489 @@
+"""Stage profiles: a machine-readable queueing/service/dispatch decomposition.
+
+PR 2's spans, PR 6's overload gauges and the bench rows are human-readable
+evidence; ROADMAP item 3's InferLine-style provisioning planner
+(arXiv:1812.01776) needs a machine-readable PROFILE of each pipeline stage
+— per stage, how much of a transaction's latency was queueing (waiting for
+the stage), service (host work in the stage) and device dispatch (the XLA
+round trip), plus how service time scales with batch size (the curve the
+planner trades against batching deadlines). This module maintains exactly
+that, live:
+
+- :class:`LatencyDigest` — a fixed-geometric-bucket quantile sketch
+  (t-digest-shaped accuracy at a fraction of the code): bounded memory,
+  mergeable counts, interpolated quantiles. Every component below records
+  into digests, never raw samples.
+- :class:`StageProfiler` — per-stage accumulators with three components
+  (``queue`` / ``service`` / ``dispatch``) and a batch-size-conditioned
+  service curve. Fed two ways, both wired by the operator:
+
+  1. **direct observes** on the hot paths that know their own split — the
+     router feeds bus queueing delay, decode/route service and the scorer
+     dispatch per micro-batch; the serving ``DynamicBatcher`` feeds REST
+     batcher wait and dispatch time per coalesced launch;
+  2. **span ingestion** — a listener on the PR 2 :class:`SpanSink` maps
+     finished spans (every span, not just tail-sampled keeps) onto stages
+     by name, so stages with no direct feed (producer, engine REST,
+     notify, serving) profile for free wherever tracing is on.
+
+  XLA compile events attribute through a ``jax.monitoring`` duration
+  listener (``backend_compile``): a stage whose p99 spikes because a new
+  executable compiled mid-traffic shows the compile in the same profile
+  (`compile` section + ``ccfd_xla_compile_events_total``), and
+  :meth:`StageProfiler.profile_device` wraps ``jax.profiler.trace`` for
+  the deep device-level view.
+
+- **StageProfile artifact** — :meth:`StageProfiler.snapshot` renders the
+  whole profile as one JSON document (schema :data:`PROFILE_SCHEMA`,
+  validated by :func:`validate_profile`), served live at the exporter's
+  ``/profile`` endpoint and written crash-safely (tmp+rename) by
+  :meth:`StageProfiler.write` / ``tools/slo_report.py``. This document is
+  the input contract the future planner consumes.
+
+The profiler is wall-clock-free on the hot path (two ``perf_counter``
+reads per batch where it is fed directly) and entirely lock-striped per
+stage; a disabled profiler costs one ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+import weakref
+from typing import Any, Iterator, Mapping
+
+PROFILE_SCHEMA = "ccfd.stage_profile.v1"
+
+# the three latency components every stage decomposes into
+COMPONENTS = ("queue", "service", "dispatch")
+
+# canonical pipeline stages (ISSUE 9: produce -> bus -> router decode/
+# score/route -> engine -> notify, plus the REST serving path). Stages not
+# in this tuple are still accepted — the planner contract only promises
+# these names when the corresponding path carried traffic.
+STAGES = (
+    "produce",        # producer batch emit (service)
+    "bus",            # topic wait: produce timestamp -> router poll (queue)
+    "router.decode",  # record decode into the (B, 30) matrix (service)
+    "router.score",   # scorer device round trip (dispatch)
+    "router.route",   # rule eval + engine process starts (service)
+    "engine",         # KIE REST surface (service)
+    "notify",         # notification handling (service)
+    "rest",           # serving predict request end to end (service)
+    "rest.batcher",   # DynamicBatcher queue sojourn (queue)
+    "rest.dispatch",  # serving-side coalesced device dispatch (dispatch)
+)
+
+# span name -> (stage, component): the SpanSink ingestion map. The router
+# span family (router.batch/decode/score/route) is deliberately ABSENT:
+# the router feeds its stages directly (richer — batch sizes, the
+# queue/service split — and present even with tracing off), and ingesting
+# its spans too would double-count every batch. Stages with no hot-path
+# feed profile through their spans.
+SPAN_STAGES: Mapping[str, tuple[str, str]] = {
+    "producer.batch": ("produce", "service"),
+    "producer.produce": ("produce", "service"),
+    "engine.rest": ("engine", "service"),
+    "notify.handle": ("notify", "service"),
+    "serving.predict": ("rest", "service"),
+}
+
+# batch-size buckets conditioning the service curve (the scorer's own
+# bucket ladder shape)
+BATCH_BUCKETS = (1, 8, 64, 256, 1024, 4096, 16384)
+
+
+class LatencyDigest:
+    """Fixed-geometric-bucket latency sketch: 1 µs .. ~137 s at 2^(1/4)
+    spacing (~9% worst-case relative quantile error after interpolation),
+    bounded memory, cheap adds. NOT thread-safe — callers lock."""
+
+    # 4 buckets per octave over 27 octaves: 1e-6 * 2**(k/4)
+    _BASE = 1e-6
+    _PER_OCTAVE = 4
+    _N = 27 * _PER_OCTAVE + 1
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * self._N
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def _index(self, value: float) -> int:
+        if value <= self._BASE:
+            return 0
+        i = int(math.log2(value / self._BASE) * self._PER_OCTAVE) + 1
+        return min(self._N - 1, i)
+
+    @classmethod
+    def _upper(cls, i: int) -> float:
+        if i <= 0:
+            return cls._BASE
+        return cls._BASE * 2.0 ** (i / cls._PER_OCTAVE)
+
+    def add(self, value: float, n: int = 1) -> None:
+        value = max(0.0, float(value))
+        self.counts[self._index(value)] += n
+        self.count += n
+        self.sum += value * n
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile in SECONDS; NaN with no samples."""
+        if self.count == 0:
+            return float("nan")
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            prev_cum = cum
+            cum += c
+            if cum >= rank:
+                lo = self._upper(i - 1) if i > 0 else 0.0
+                hi = self._upper(i)
+                frac = (rank - prev_cum) / c if c else 1.0
+                v = lo + (hi - lo) * frac
+                # never report outside the observed envelope (the last
+                # bucket's upper bound can exceed the true max wildly)
+                return min(max(v, self.min), self.max)
+        return self.max
+
+    def to_dict(self) -> dict[str, Any]:
+        if self.count == 0:
+            return {"count": 0, "sum_s": 0.0}
+        return {
+            "count": self.count,
+            "sum_s": round(self.sum, 6),
+            "mean_ms": round(1e3 * self.sum / self.count, 4),
+            "p50_ms": round(1e3 * self.quantile(0.5), 4),
+            "p90_ms": round(1e3 * self.quantile(0.9), 4),
+            "p99_ms": round(1e3 * self.quantile(0.99), 4),
+            "min_ms": round(1e3 * self.min, 4),
+            "max_ms": round(1e3 * self.max, 4),
+        }
+
+
+class _StageAcc:
+    __slots__ = ("lock", "digests", "by_batch", "rows")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.digests = {c: LatencyDigest() for c in COMPONENTS}
+        # batch-bucket -> service-or-dispatch digest (the service curve)
+        self.by_batch: dict[int, LatencyDigest] = {}
+        self.rows = 0
+
+
+def _batch_bucket(n: int) -> int:
+    for b in BATCH_BUCKETS:
+        if n <= b:
+            return b
+    return BATCH_BUCKETS[-1]
+
+
+# jax.monitoring listeners are process-global with no unregister: one hook,
+# registered once, forwarding to the CURRENT profiler via weakref (see
+# StageProfiler.arm_compile_listener)
+_COMPILE_HOOK_REGISTERED = False
+_COMPILE_TARGET: "weakref.ref[StageProfiler] | None" = None
+
+
+def _on_compile_event(event: str, secs: float, **_kw) -> None:
+    if not event.endswith("backend_compile_duration"):
+        return
+    target = _COMPILE_TARGET() if _COMPILE_TARGET is not None else None
+    if target is not None:
+        target._record_compile(secs)
+
+
+class StageProfiler:
+    """Live per-stage latency decomposition; see the module docstring.
+
+    With a ``registry``, :meth:`refresh_gauges` (called on every
+    :meth:`snapshot`, i.e. on every ``/profile`` read and SLO tick)
+    exports ``ccfd_stage_latency_ms{stage,component,quantile}`` so the
+    SLO Grafana board charts the decomposition without parsing the JSON
+    artifact, plus the compile-event counter/clock.
+    """
+
+    def __init__(self, registry=None,
+                 overload_registry=None) -> None:
+        self._stages: dict[str, _StageAcc] = {}
+        self._stages_mu = threading.Lock()
+        self._overload_registry = overload_registry
+        self._compile_mu = threading.Lock()
+        self._compile = LatencyDigest()
+        self._compile_armed = False
+        self.registry = registry
+        self._g_stage = self._c_compile = self._g_compile_s = None
+        if registry is not None:
+            self._g_stage = registry.gauge(
+                "ccfd_stage_latency_ms",
+                "stage-profile latency decomposition by stage, component "
+                "(queue/service/dispatch) and quantile",
+            )
+            self._c_compile = registry.counter(
+                "ccfd_xla_compile_events_total",
+                "XLA backend_compile events attributed to this process "
+                "(jax.monitoring hook; a mid-traffic compile explains a "
+                "stage p99 spike)",
+            )
+            self._g_compile_s = registry.gauge(
+                "ccfd_xla_compile_seconds_total",
+                "cumulative wall seconds spent in XLA backend compiles",
+            )
+
+    # -- ingestion ---------------------------------------------------------
+    def _acc(self, stage: str) -> _StageAcc:
+        acc = self._stages.get(stage)
+        if acc is None:
+            with self._stages_mu:
+                acc = self._stages.setdefault(stage, _StageAcc())
+        return acc
+
+    def observe(self, stage: str, queue_s: float | None = None,
+                service_s: float | None = None,
+                dispatch_s: float | None = None,
+                batch: int | None = None, rows: int = 1) -> None:
+        """Record one sample for ``stage``. Any subset of the three
+        components may be present; ``batch`` additionally conditions the
+        service/dispatch sample on the batch-size bucket (the service
+        curve a provisioning planner fits)."""
+        acc = self._acc(stage)
+        with acc.lock:
+            acc.rows += rows
+            if queue_s is not None:
+                acc.digests["queue"].add(queue_s)
+            if service_s is not None:
+                acc.digests["service"].add(service_s)
+            if dispatch_s is not None:
+                acc.digests["dispatch"].add(dispatch_s)
+            if batch is not None and (service_s is not None
+                                      or dispatch_s is not None):
+                b = _batch_bucket(int(batch))
+                d = acc.by_batch.get(b)
+                if d is None:
+                    d = acc.by_batch[b] = LatencyDigest()
+                d.add(dispatch_s if dispatch_s is not None else service_s)
+
+    def on_span(self, span) -> None:
+        """SpanSink listener: fold a finished span into its stage (see
+        :data:`SPAN_STAGES` for why the router family is excluded)."""
+        mapped = SPAN_STAGES.get(span.name)
+        if mapped is None:
+            return
+        stage, component = mapped
+        self.observe(stage, **{f"{component}_s": span.duration_s})
+
+    def digest(self, stage: str, component: str) -> LatencyDigest | None:
+        """A consistent COPY of the stage/component digest (or None).
+        Digests are not thread-safe and hot-path writers hold the stage
+        lock — readers (budget ledger, load_shape shares) get a snapshot
+        taken under it, never the live object."""
+        acc = self._stages.get(stage)
+        if acc is None:
+            return None
+        with acc.lock:
+            d = acc.digests.get(component)
+            if d is None:
+                return None
+            out = LatencyDigest()
+            out.counts = list(d.counts)
+            out.count = d.count
+            out.sum = d.sum
+            out.min = d.min
+            out.max = d.max
+            return out
+
+    # -- XLA compile attribution ------------------------------------------
+    def arm_compile_listener(self) -> bool:
+        """Attribute XLA backend compiles via ``jax.monitoring``. The jax
+        registration is process-global with no unregister, so exactly ONE
+        module-level hook ever registers; it forwards to the most recently
+        armed profiler through a weakref (a torn-down platform's profiler
+        is collectable and stops receiving events — newest wins, exactly
+        like supervisor respawns elsewhere)."""
+        global _COMPILE_TARGET
+        if not self._compile_armed:
+            try:
+                import jax.monitoring as monitoring
+            except Exception:  # noqa: BLE001 - profile without jax works
+                return False
+            global _COMPILE_HOOK_REGISTERED
+            if not _COMPILE_HOOK_REGISTERED:
+                try:
+                    monitoring.register_event_duration_secs_listener(
+                        _on_compile_event)
+                except Exception:  # noqa: BLE001 - older jax, no hook
+                    return False
+                _COMPILE_HOOK_REGISTERED = True
+            self._compile_armed = True
+        _COMPILE_TARGET = weakref.ref(self)
+        return True
+
+    def _record_compile(self, secs: float) -> None:
+        with self._compile_mu:
+            self._compile.add(float(secs))
+        if self._c_compile is not None:
+            self._c_compile.inc()
+            self._g_compile_s.set(self._compile.sum)
+
+    @contextlib.contextmanager
+    def profile_device(self, logdir: str) -> Iterator[None]:
+        """Device-level XLA trace (TensorBoard format) around a block —
+        the deep-dive companion to the always-on stage profile."""
+        import jax
+
+        with jax.profiler.trace(logdir):
+            yield
+
+    # -- export ------------------------------------------------------------
+    def _overload_section(self) -> dict[str, Any]:
+        reg = self._overload_registry
+        if reg is None:
+            return {}
+        out: dict[str, Any] = {}
+        try:
+            lim = reg.get("ccfd_inflight_limit")
+            used = reg.get("ccfd_inflight_used")
+            if lim is not None:
+                out["inflight"] = {
+                    "limit": {("|".join(f"{k}={v}" for k, v in key) or "all"):
+                              val for key, val in lim.items()},
+                    "used": ({("|".join(f"{k}={v}" for k, v in key) or "all"):
+                              val for key, val in used.items()}
+                             if used is not None else {}),
+                }
+            for name in ("ccfd_shed_total", "ccfd_admission_total",
+                         "ccfd_dispatch_timeout_total",
+                         "ccfd_priority_inversions_total"):
+                m = reg.get(name)
+                if m is not None and hasattr(m, "total"):
+                    out[name] = m.total()
+        except Exception:  # noqa: BLE001 - profile export must never 500
+            pass
+        return out
+
+    def refresh_gauges(self) -> None:
+        if self._g_stage is None:
+            return
+        with self._stages_mu:
+            stages = dict(self._stages)
+        for stage, acc in stages.items():
+            with acc.lock:
+                for comp, d in acc.digests.items():
+                    if d.count == 0:
+                        continue
+                    for q, qname in ((0.5, "p50"), (0.99, "p99")):
+                        self._g_stage.set(
+                            1e3 * d.quantile(q),
+                            labels={"stage": stage, "component": comp,
+                                    "quantile": qname})
+
+    def snapshot(self) -> dict[str, Any]:
+        """The StageProfile document (:data:`PROFILE_SCHEMA`) — the
+        planner input contract; also refreshes the stage gauges."""
+        self.refresh_gauges()
+        with self._stages_mu:
+            stages = dict(self._stages)
+        doc_stages: dict[str, Any] = {}
+        for stage, acc in stages.items():
+            with acc.lock:
+                entry: dict[str, Any] = {"rows": acc.rows}
+                for comp, d in acc.digests.items():
+                    entry[comp] = d.to_dict()
+                if acc.by_batch:
+                    entry["service_by_batch"] = {
+                        str(b): d.to_dict()
+                        for b, d in sorted(acc.by_batch.items())
+                    }
+            doc_stages[stage] = entry
+        with self._compile_mu:
+            compile_section = self._compile.to_dict()
+        return {
+            "schema": PROFILE_SCHEMA,
+            "generated_unix": time.time(),
+            "stages": doc_stages,
+            "compile": compile_section,
+            "overload": self._overload_section(),
+        }
+
+    def write(self, path: str) -> dict[str, Any]:
+        """Crash-safe artifact write (tmp+rename); returns the document."""
+        doc = self.snapshot()
+        write_json_crash_safe(path, doc)
+        return doc
+
+
+def write_json_crash_safe(path: str, doc: Mapping[str, Any]) -> None:
+    """tmp+rename JSON write: a crash mid-write leaves the previous
+    artifact intact, never a torn file. The one writer every profile-
+    family artifact shares (StageProfiler.write, tools/slo_report.py,
+    tools/trace_report.py --json)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _digest_errors(where: str, d: Any) -> list[str]:
+    errs: list[str] = []
+    if not isinstance(d, Mapping):
+        return [f"{where}: not a mapping"]
+    if not isinstance(d.get("count"), int) or d["count"] < 0:
+        errs.append(f"{where}: missing/invalid count")
+        return errs
+    if d["count"] > 0:
+        for k in ("sum_s", "mean_ms", "p50_ms", "p99_ms"):
+            v = d.get(k)
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                errs.append(f"{where}: missing/non-finite {k}")
+    return errs
+
+
+def validate_profile(doc: Any) -> list[str]:
+    """Schema check for a StageProfile document -> list of problems
+    ([] = valid). Hand-rolled (no jsonschema dependency): the planner and
+    the CI smoke both gate on it, so failures must NAME the path."""
+    errs: list[str] = []
+    if not isinstance(doc, Mapping):
+        return ["document: not a mapping"]
+    if doc.get("schema") != PROFILE_SCHEMA:
+        errs.append(f"schema: expected {PROFILE_SCHEMA!r}, "
+                    f"got {doc.get('schema')!r}")
+    if not isinstance(doc.get("generated_unix"), (int, float)):
+        errs.append("generated_unix: missing")
+    stages = doc.get("stages")
+    if not isinstance(stages, Mapping):
+        return errs + ["stages: missing"]
+    for name, entry in stages.items():
+        if not isinstance(entry, Mapping):
+            errs.append(f"stages.{name}: not a mapping")
+            continue
+        if not isinstance(entry.get("rows"), int):
+            errs.append(f"stages.{name}.rows: missing")
+        for comp in COMPONENTS:
+            if comp in entry:
+                errs.extend(_digest_errors(f"stages.{name}.{comp}",
+                                           entry[comp]))
+        for b, d in (entry.get("service_by_batch") or {}).items():
+            if not str(b).isdigit():
+                errs.append(f"stages.{name}.service_by_batch: "
+                            f"non-integer bucket {b!r}")
+            errs.extend(_digest_errors(
+                f"stages.{name}.service_by_batch.{b}", d))
+    if "compile" in doc:
+        errs.extend(_digest_errors("compile", doc["compile"]))
+    return errs

@@ -219,6 +219,10 @@ class SpanSink:
         self.max_retained = int(max_retained)
         self.decision_window_s = float(decision_window_s)
         self._lock = threading.Lock()
+        # span listeners (observability/profile.py StageProfiler): called
+        # for EVERY finished span, before sampling — the stage profile
+        # must see the full population, not the tail-sampled keeps
+        self._listeners: list = []
         self._pending: "collections.OrderedDict[str, _TraceBuf]" = (
             collections.OrderedDict()
         )
@@ -239,7 +243,18 @@ class SpanSink:
                                   "traces awaiting a sampling decision")
 
     # -- ingestion ---------------------------------------------------------
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(span)`` to every finished span (unsampled). A
+        raising listener is the listener's bug, not a span-loss event —
+        exceptions are swallowed in :meth:`add`."""
+        self._listeners.append(fn)
+
     def add(self, span: Span) -> None:
+        for fn in self._listeners:
+            try:
+                fn(span)
+            except Exception:  # noqa: BLE001 - listener bug must not drop spans
+                pass
         self._c_spans.inc(labels={"component": span.component})
         with self._lock:
             retained = self._retained.get(span.trace_id)

@@ -54,6 +54,9 @@ _COMPONENTS = (
                   # with auto-rollback (new; round 9, lifecycle/)
     "overload",   # overload control: adaptive AIMD admission, priority-
                   # aware shedding, REST 429s (new; runtime/overload.py)
+    "slo",        # stage profiler + SLO engine: queueing/service/dispatch
+                  # decomposition, burn-rate monitoring, budget ledger
+                  # (new; observability/profile.py, observability/slo.py)
 )
 
 
@@ -123,6 +126,8 @@ class Platform:
         self.chaos = None
         self.fault_plan = None  # runtime/faults.FaultPlan when configured
         self.trace_sink = None  # observability/trace.SpanSink when enabled
+        self.profiler = None    # observability/profile.StageProfiler
+        self.slo = None         # observability/slo.SLOEngine when enabled
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
         self.investigator = None
@@ -217,6 +222,29 @@ class Platform:
 
                 slog.configure("platform")
 
+        # 0c. stage profiler (observability/profile.py): ONE profiler for
+        # the whole platform, fed directly by the router (bus queue,
+        # decode/route service, scorer dispatch) and the serving batcher
+        # (REST wait/dispatch), plus span ingestion off the tail sampler
+        # for the stages with no hot-path feed (producer, engine REST,
+        # notify, serving). Exported live at the exporter's /profile —
+        # the machine-readable planner input (ROADMAP item 3). The SLO
+        # engine over it is built in step 7c, once the components whose
+        # histograms it reads exist. CCFD_SLO=0 (or CR slo.enabled:
+        # false) disables the whole plane.
+        slo_spec = spec.component("slo")
+        if slo_spec.enabled and cfg.slo_enabled:
+            from ccfd_tpu.observability.profile import StageProfiler
+
+            self.profiler = StageProfiler(
+                registry=self._registry("slo"),
+                overload_registry=self._registry("router"),
+            )
+            if self.trace_sink is not None:
+                self.trace_sink.add_listener(self.profiler.on_span)
+            if bool(slo_spec.opt("compile_events", True)):
+                self.profiler.arm_compile_listener()
+
         # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
         if spec.component("store").enabled:
             self._up_store()
@@ -308,6 +336,29 @@ class Platform:
         if spec.component("analytics").enabled:
             self._up_analytics()
 
+        # 7c. SLO engine (observability/slo.py): built once the components
+        #     whose histograms/counters it reads exist. Declarative specs
+        #     from the CR `slo:` block (or the CCFD_SLO_* defaults:
+        #     e2e-p99 / rest-p99 / error-rate), multi-window burn-rate
+        #     gauges + breach alerts, and the REST-path budget ledger over
+        #     the stage profiler. Runs as a supervised service.
+        if self.profiler is not None:
+            from ccfd_tpu.observability.slo import SLOEngine
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            self.slo = SLOEngine.from_config(
+                cfg, self.registries, self._registry("slo"),
+                profiler=self.profiler, options=slo_spec.options,
+            )
+            interval = float(slo_spec.opt("interval_s", cfg.slo_interval_s))
+            self.supervisor.add_thread_service(
+                "slo",
+                lambda: self.slo.run(interval_s=interval),
+                self.slo.stop,
+                policy=RestartPolicy.ALWAYS,
+                reset=self.slo.reset,
+            )
+
         # 8. monitoring (README.md:487-537)
         if spec.component("monitoring").enabled:
             from ccfd_tpu.metrics.exporter import MetricsExporter
@@ -318,6 +369,7 @@ class Platform:
                 host=mon.opt("host", "127.0.0.1"),
                 port=int(mon.opt("port", 0)),
                 sink=self.trace_sink,  # /traces + /traces/<id> endpoints
+                profiler=self.profiler,  # /profile StageProfile endpoint
             ).start()
             self._wire_memory_probes()
 
@@ -484,6 +536,7 @@ class Platform:
             self.prediction_server = PredictionServer(
                 self.scorer, self.cfg, self._registry("seldon"),
                 tracer=self._tracer("seldon"),
+                profiler=self.profiler,
             )
             self.prediction_host = c.opt("host", "127.0.0.1")
             self.prediction_port = self.prediction_server.start(
@@ -802,6 +855,7 @@ class Platform:
                           if c.opt("max_inflight") is not None else None),
             tracer=router_tracer,
             overload=overload,
+            profiler=self.profiler,
         )
         # partition-parallel fan-out (router/parallel.py): CR
         # `router.workers` over CCFD_ROUTER_WORKERS; 1 = the historical

@@ -91,6 +91,7 @@ class ParallelRouter:
         coalesce_deadline_ms: float | None = None,
         coalesce_workers: int = 2,
         overload: "Any | None" = None,
+        profiler: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -175,7 +176,7 @@ class ParallelRouter:
                 host_score_fn=host_score_fn, breaker=self._breaker,
                 degrade=degrade, max_inflight=self.max_inflight,
                 tracer=tracer, inflight_budget=self._budget, worker_id=i,
-                overload=overload,
+                overload=overload, profiler=profiler,
             )
             for i in range(workers)
         ]

@@ -316,6 +316,7 @@ class Router:
         inflight_budget: InflightBudget | None = None,
         worker_id: int | None = None,
         overload: "Any | None" = None,
+        profiler: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -449,6 +450,12 @@ class Router:
             self._budget = overload.budget
         else:
             self._budget = InflightBudget(self.max_inflight, registry=r)
+        # stage profiler (observability/profile.py): per micro-batch the
+        # router feeds the decomposition no histogram carries — bus
+        # queueing delay (poll time minus produce timestamps), decode and
+        # route service time, and the scorer dispatch round trip, batch-
+        # size-conditioned. None costs one attribute read per batch.
+        self._profiler = profiler
         # worker identity (ParallelRouter): labels this loop's batches and
         # trace spans so per-stage attribution survives the fan-out
         self.worker_id = worker_id
@@ -583,6 +590,7 @@ class Router:
         span_cm = (self.tracer.span("router.decode",
                                     parent=batch_span.context)
                    if batch_span is not None else None)
+        t0 = time.perf_counter()
         with (span_cm if span_cm is not None else _NULL_CM):
             x, txs, bad = decode_records(records)
         if bad:
@@ -590,6 +598,20 @@ class Router:
         # produce timestamps ride along so _route can observe the
         # end-to-end decision latency (producer -> process start)
         ts = np.fromiter((r.timestamp for r in records), np.float64, n)
+        if self._profiler is not None or batch_span is not None:
+            # bus queueing delay: how long this batch's rows waited on the
+            # topic before the poll (mean across the batch — the component
+            # that sums with service/dispatch to the decision latency)
+            queue_s = max(0.0, time.time() - float(ts.mean()))
+            if batch_span is not None:
+                # ride the span too: the profiler's span-ingestion path
+                # (and offline trace analysis) reads it from the attrs
+                batch_span.attrs["queue_s"] = queue_s
+            if self._profiler is not None:
+                self._profiler.observe("bus", queue_s=queue_s, rows=n)
+                self._profiler.observe(
+                    "router.decode",
+                    service_s=time.perf_counter() - t0, batch=n, rows=n)
         return x, txs, ts
 
     # -- degradation ladder ------------------------------------------------
@@ -726,6 +748,9 @@ class Router:
                 # AIMD feedback: the scorer stage's measured latency vs its
                 # budget is what moves the adaptive in-flight limit
                 self._overload.observe_stage(score_s)
+            if self._profiler is not None:
+                self._profiler.observe("router.score", dispatch_s=score_s,
+                                       batch=len(txs), rows=len(txs))
             return self._route(x, txs, proba, ts, batch_span=batch_sp)
         except BaseException:
             # a crashed batch is exactly the trace an operator needs:
@@ -744,6 +769,7 @@ class Router:
         if self.tracer is not None and batch_span is not None:
             route_sp = self.tracer.start("router.route",
                                          parent=batch_span.context)
+        t0 = time.perf_counter() if self._profiler is not None else 0.0
         try:
             if route_sp is None:
                 return self._route_inner(x, txs, proba, ts, batch_span,
@@ -756,6 +782,10 @@ class Router:
                 return self._route_inner(x, txs, proba, ts, batch_span,
                                          route_sp)
         finally:
+            if self._profiler is not None:
+                self._profiler.observe(
+                    "router.route", service_s=time.perf_counter() - t0,
+                    batch=len(txs), rows=len(txs))
             if route_sp is not None:
                 self.tracer.finish(route_sp)
 
@@ -950,6 +980,9 @@ class Router:
                           if batch_sp is not None else None))
             if self._overload is not None:
                 self._overload.observe_stage(score_s)
+            if self._profiler is not None:
+                self._profiler.observe("router.score", dispatch_s=score_s,
+                                       batch=len(txs), rows=len(txs))
             return proba
 
         def finish(pending: tuple) -> None:

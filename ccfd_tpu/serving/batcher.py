@@ -67,8 +67,17 @@ class DynamicBatcher:
         codel: "object | None" = None,
         max_queue_rows: int = 0,
         on_shed: Callable[[int, int], None] | None = None,
+        profiler: "object | None" = None,
+        profile_stage: str = "rest",
     ):
         self._score = score_fn
+        # stage profiler (observability/profile.py): per coalesced
+        # dispatch, feed the queue-sojourn / device-dispatch split under
+        # "<profile_stage>.batcher" / "<profile_stage>.dispatch" — the
+        # measured layers of the REST latency-budget ledger
+        self._profiler = profiler
+        self._stage_queue = f"{profile_stage}.batcher"
+        self._stage_dispatch = f"{profile_stage}.dispatch"
         self.max_batch = max_batch
         self.deadline_s = max(0.0, deadline_ms) / 1e3
         self._on_dispatch = on_dispatch
@@ -265,6 +274,15 @@ class DynamicBatcher:
 
     def _dispatch(self, batch: list) -> None:
         xs = [x for x, _f, _e, _p in batch]
+        n_rows = int(sum(x.shape[0] for x in xs))
+        t0 = time.perf_counter()
+        if self._profiler is not None:
+            # queue sojourn up to dispatch assembly, row-weighted mean —
+            # the "batcher_wait" layer of the REST budget ledger
+            wait = sum((t0 - e) * x.shape[0]
+                       for x, _f, e, _p in batch) / max(1, n_rows)
+            self._profiler.observe(self._stage_queue, queue_s=wait,
+                                   rows=n_rows)
         try:
             proba = self._score(np.concatenate(xs) if len(xs) > 1 else xs[0])
         except Exception as e:  # noqa: BLE001 - fail the batch, not the worker
@@ -272,7 +290,11 @@ class DynamicBatcher:
                 if not f.cancelled():
                     f.set_exception(e)
             return
-        n_rows = int(sum(x.shape[0] for x in xs))
+        if self._profiler is not None:
+            self._profiler.observe(
+                self._stage_dispatch,
+                dispatch_s=time.perf_counter() - t0,
+                batch=n_rows, rows=n_rows)
         with self._cv:  # workers share the stats; += alone would race
             self.dispatches += 1
             self.rows += n_rows

@@ -59,10 +59,15 @@ class PredictionServer:
         cfg: Config | None = None,
         registry: Registry | None = None,
         tracer=None,
+        profiler=None,
     ):
         self.scorer = scorer
         self.cfg = cfg or Config()
         self.registry = registry or Registry()
+        # stage profiler (observability/profile.py): handed to the
+        # DynamicBatcher so the REST path's batcher-wait / device-dispatch
+        # layers feed the SLO budget ledger
+        self.profiler = profiler
         # observability/trace.py: predict requests join the caller's trace
         # (extracted traceparent -> "serving.predict" server span) and the
         # latency histogram carries the trace id as an exemplar. Python
@@ -168,6 +173,7 @@ class PredictionServer:
             codel=codel,
             max_queue_rows=max_queue_rows,
             on_shed=on_shed,
+            profiler=self.profiler,
         )
 
     def _sync_dispatch_health(self) -> None:

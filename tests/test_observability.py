@@ -80,6 +80,16 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_shed_total",
     "ccfd_priority_inversions_total",
     "ccfd_dispatch_timeout_total",
+    # round 12: SLO burn-rate monitoring + stage profiles
+    # (observability/slo.py, observability/profile.py)
+    "ccfd_slo_burn_rate",
+    "ccfd_slo_error_budget_remaining",
+    "ccfd_slo_breach_total",
+    "ccfd_slo_breaching",
+    "ccfd_slo_budget_spent_ratio",
+    "ccfd_stage_latency_ms",
+    "ccfd_xla_compile_events_total",
+    "ccfd_xla_compile_seconds_total",
 ]
 
 
@@ -97,7 +107,7 @@ def test_dashboards_cover_contract_metrics():
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
-        "ModelLifecycle", "Overload", "SeqServing",
+        "ModelLifecycle", "Overload", "SeqServing", "SLO",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:
@@ -198,10 +208,35 @@ def test_seldon_board_carries_dispatch_health():
 
 def test_write_dashboards_roundtrip(tmp_path):
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 13
+    assert len(paths) == len(build_all_dashboards())
     for p in paths:
         board = json.load(open(p))
         assert board["panels"] and board["uid"].startswith("ccfd-")
+
+
+def test_docs_state_generated_board_count_once():
+    """README's layer map drifted to "6 Grafana boards" while the
+    generator emitted 13 (ISSUE 9 satellite). The count now lives in ONE
+    doc sentence ("N generated Grafana boards", README layer map) and
+    this test pins it to both the generator and the checked-in file set,
+    so it can't drift again."""
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    pattern = re.compile(r"(\d+) generated Grafana boards")
+    counts: list[tuple[str, int]] = []
+    for doc in ("README.md", "ARCHITECTURE.md"):
+        with open(os.path.join(root, doc)) as f:
+            counts.extend((doc, int(m)) for m in pattern.findall(f.read()))
+    assert len(counts) == 1, (
+        f"the generated-board count must be stated exactly once across "
+        f"README/ARCHITECTURE, found {counts}"
+    )
+    documented = counts[0][1]
+    assert documented == len(build_all_dashboards())
+    checked_in = [f for f in os.listdir(os.path.join(root, "deploy", "grafana"))
+                  if f.endswith(".json")]
+    assert documented == len(checked_in)
 
 
 def test_tracer_spans_land_in_histogram():

@@ -1,0 +1,594 @@
+"""Stage profiles + SLO engine (observability/profile.py, observability/slo.py):
+digest math, span/direct ingestion, schema + /profile endpoint, burn-rate
+windows, breach isolation, budget ledger, CR spec parsing, operator wiring."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.exporter import MetricsExporter
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.profile import (
+    PROFILE_SCHEMA,
+    LatencyDigest,
+    StageProfiler,
+    validate_profile,
+)
+from ccfd_tpu.observability.slo import (
+    BudgetLedger,
+    SLOEngine,
+    SLOSpec,
+    window_name,
+)
+from ccfd_tpu.observability.trace import SpanSink, Tracer
+
+
+# -- LatencyDigest -----------------------------------------------------------
+class TestLatencyDigest:
+    def test_quantiles_track_uniform_distribution(self):
+        rng = np.random.default_rng(0)
+        d = LatencyDigest()
+        vals = rng.uniform(0.001, 0.101, size=20000)
+        for v in vals:
+            d.add(float(v))
+        assert d.count == 20000
+        # geometric buckets at 2^(1/4): interpolated quantiles within ~10%
+        assert d.quantile(0.5) == pytest.approx(0.051, rel=0.12)
+        assert d.quantile(0.99) == pytest.approx(0.100, rel=0.12)
+        assert d.min <= d.quantile(0.01) <= d.quantile(0.99) <= d.max
+
+    def test_quantile_clamped_to_observed_envelope(self):
+        d = LatencyDigest()
+        d.add(0.010)
+        # a single sample: every quantile IS that sample, not the bucket's
+        # upper bound
+        assert d.quantile(0.99) == pytest.approx(0.010)
+        assert d.quantile(0.01) == pytest.approx(0.010)
+
+    def test_empty_and_dict_shape(self):
+        d = LatencyDigest()
+        assert np.isnan(d.quantile(0.5))
+        assert d.to_dict() == {"count": 0, "sum_s": 0.0}
+        d.add(0.002, n=3)
+        out = d.to_dict()
+        assert out["count"] == 3
+        assert out["sum_s"] == pytest.approx(0.006)
+        assert out["p99_ms"] == pytest.approx(2.0, rel=0.2)
+
+
+# -- StageProfiler -----------------------------------------------------------
+class TestStageProfiler:
+    def test_observe_and_snapshot_validate(self):
+        p = StageProfiler(registry=Registry())
+        for _ in range(50):
+            p.observe("router.score", dispatch_s=0.01, batch=700, rows=700)
+            p.observe("bus", queue_s=0.004, rows=700)
+            p.observe("router.decode", service_s=0.001, batch=700, rows=700)
+        doc = p.snapshot()
+        assert validate_profile(doc) == []
+        assert doc["schema"] == PROFILE_SCHEMA
+        score = doc["stages"]["router.score"]
+        assert score["dispatch"]["count"] == 50
+        assert score["dispatch"]["p99_ms"] == pytest.approx(10.0, rel=0.15)
+        # batch 700 conditions into the 1024 bucket
+        assert set(score["service_by_batch"]) == {"1024"}
+        assert doc["stages"]["bus"]["queue"]["count"] == 50
+
+    def test_span_ingestion_via_sink_listener(self):
+        sink = SpanSink(sample=0.0, registry=Registry())
+        p = StageProfiler()
+        sink.add_listener(p.on_span)
+        tr = Tracer(Registry(), component="producer", sink=sink)
+        with tr.span("producer.batch"):
+            pass
+        with tr.span("serving.predict"):
+            pass
+        with tr.span("router.batch"):  # router family: direct-feed only
+            pass
+        doc = p.snapshot()
+        assert doc["stages"]["produce"]["service"]["count"] == 1
+        assert doc["stages"]["rest"]["service"]["count"] == 1
+        # router spans must NOT double-count against the direct feed
+        assert "router.score" not in doc["stages"]
+        assert "bus" not in doc["stages"]
+
+    def test_stage_gauges_exported(self):
+        reg = Registry()
+        p = StageProfiler(registry=reg)
+        p.observe("router.score", dispatch_s=0.02, batch=128, rows=128)
+        p.snapshot()  # refreshes gauges
+        g = reg.get("ccfd_stage_latency_ms")
+        assert g.value({"stage": "router.score", "component": "dispatch",
+                        "quantile": "p99"}) == pytest.approx(20.0, rel=0.15)
+
+    def test_compile_listener_single_hook_targets_latest_profiler(self):
+        # jax.monitoring has no unregister: ONE module-level hook forwards
+        # to the latest armed profiler via weakref — re-arming (operator
+        # up→down→up) must not fan events into stale profilers
+        import jax
+        import jax.numpy as jnp
+
+        p1 = StageProfiler()
+        p2 = StageProfiler()
+        assert p1.arm_compile_listener()
+        assert p2.arm_compile_listener()
+        # a fresh lambda identity forces a real backend compile
+        jax.jit(lambda x: x * 3.14159 + 2.71828)(
+            jnp.ones(7)).block_until_ready()
+        assert p2.snapshot()["compile"]["count"] >= 1
+        assert p1.snapshot()["compile"]["count"] == 0
+
+    def test_write_is_crash_safe_and_valid(self, tmp_path):
+        p = StageProfiler()
+        p.observe("bus", queue_s=0.001)
+        out = tmp_path / "profile.json"
+        doc = p.write(str(out))
+        assert not (tmp_path / "profile.json.tmp").exists()
+        on_disk = json.loads(out.read_text())
+        assert validate_profile(on_disk) == []
+        assert on_disk["stages"] == json.loads(json.dumps(doc["stages"]))
+
+    def test_validate_names_problems(self):
+        assert validate_profile([]) == ["document: not a mapping"]
+        errs = validate_profile({"schema": "nope", "stages": {}})
+        assert any("schema" in e for e in errs)
+        errs = validate_profile({
+            "schema": PROFILE_SCHEMA, "generated_unix": 1.0,
+            "stages": {"bus": {"rows": 1,
+                               "queue": {"count": 2}}},  # count>0, no sum
+        })
+        assert any("stages.bus.queue" in e for e in errs)
+
+
+# -- /profile endpoint -------------------------------------------------------
+class TestProfileEndpoint:
+    def test_profile_served_and_404_without_profiler(self):
+        p = StageProfiler()
+        p.observe("bus", queue_s=0.003, rows=10)
+        exp = MetricsExporter({"slo": Registry()}, profiler=p).start()
+        try:
+            with urllib.request.urlopen(
+                    exp.endpoint + "/profile", timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == "application/json"
+                doc = json.loads(resp.read().decode())
+            assert validate_profile(doc) == []
+            assert doc["stages"]["bus"]["rows"] == 10
+        finally:
+            exp.stop()
+        exp2 = MetricsExporter({"slo": Registry()}).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(exp2.endpoint + "/profile", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            exp2.stop()
+
+
+# -- histogram count_le (the SLO good/bad derivation) ------------------------
+def test_histogram_count_le_interpolates():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for _ in range(10):
+        h.observe(0.005)   # <= 0.01
+    for _ in range(10):
+        h.observe(0.05)    # (0.01, 0.1]
+    assert h.count_le(0.01) == pytest.approx(10.0)
+    assert h.count_le(1.0) == pytest.approx(20.0)
+    # halfway through the (0.01, 0.1] bucket: linear share of its 10 obs
+    assert h.count_le(0.055) == pytest.approx(15.0)
+    assert h.count_le(2.0) == 20.0
+    assert reg.histogram("empty").count_le(0.5) == 0.0
+
+
+def test_histogram_totals_aggregate_label_sets():
+    # the serving latency series is labeled by endpoint: an SLO over "all
+    # requests" must aggregate, not read the (empty) unlabeled series
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005, labels={"endpoint": "/a"})
+    h.observe(0.5, labels={"endpoint": "/b"})
+    h.observe(0.005)
+    assert h.count() == 1  # unlabeled series alone
+    assert h.total_count() == 3
+    assert h.total_count_le(0.01) == pytest.approx(2.0)
+
+
+def test_latency_slo_sees_endpoint_labeled_series():
+    reg = Registry()
+    h = reg.histogram("seldon_api_executor_client_requests_seconds")
+    eng, clock = _engine(specs=[SLOSpec(
+        "rest-p99", metric="seldon_api_executor_client_requests_seconds",
+        target_ms=25.0, objective=0.99)], registries={"seldon": reg})
+    for _ in range(50):
+        h.observe(0.5, labels={"endpoint": "/api/v0.1/predictions"})
+    clock["t"] += 1
+    st = eng.tick()
+    assert st["slos"]["rest-p99"]["burn_rate"]["5s"] == pytest.approx(100.0)
+
+
+# -- SLOEngine ---------------------------------------------------------------
+def _engine(windows=((5, 14.4), (10, 14.4), (30, 1.0)), specs=None,
+            registries=None):
+    registries = registries if registries is not None else {}
+    clock = {"t": 1000.0}
+    eng = SLOEngine(
+        specs or [SLOSpec("e2e-p99", metric="router_decision_seconds",
+                          target_ms=50.0, objective=0.99)],
+        registries, registry=Registry(), windows=windows,
+        clock=lambda: clock["t"],
+    )
+    return eng, clock
+
+
+class TestSLOEngine:
+    def test_window_names(self):
+        assert window_name(300) == "5m"
+        assert window_name(3600) == "1h"
+        assert window_name(21600) == "6h"
+        assert window_name(5) == "5s"
+
+    def test_green_traffic_no_burn(self):
+        reg = Registry()
+        h = reg.histogram("router_decision_seconds")
+        eng, clock = _engine(registries={"router": reg})
+        for _ in range(100):
+            h.observe(0.001)
+        clock["t"] += 1
+        st = eng.tick()
+        slo = st["slos"]["e2e-p99"]
+        assert slo["burn_rate"]["5s"] == 0.0
+        assert not slo["breaching"] and slo["breaches"] == 0
+        assert slo["error_budget_remaining"] == 1.0
+
+    def test_breach_requires_both_fast_windows_and_edge_triggers(self):
+        reg = Registry()
+        h = reg.histogram("router_decision_seconds")
+        eng, clock = _engine(registries={"router": reg})
+        g = eng.registry.get("ccfd_slo_burn_rate")
+        for _ in range(50):
+            h.observe(0.5)  # every event blows the 50 ms target
+        clock["t"] += 1
+        st = eng.tick()
+        slo = st["slos"]["e2e-p99"]
+        assert slo["burn_rate"]["5s"] == pytest.approx(100.0)
+        assert slo["breaching"] and slo["breaches"] == 1
+        assert g.value({"slo": "e2e-p99", "window": "5s"}) == pytest.approx(
+            100.0)
+        # still breaching on the next tick: the counter must NOT re-fire
+        for _ in range(50):
+            h.observe(0.5)
+        clock["t"] += 1
+        assert eng.tick()["slos"]["e2e-p99"]["breaches"] == 1
+        # recovery, then a NEW breach counts again
+        for _ in range(5000):
+            h.observe(0.001)
+        clock["t"] += 12  # past both fast windows
+        assert not eng.tick()["slos"]["e2e-p99"]["breaching"]
+        for _ in range(5000):
+            h.observe(0.5)
+        clock["t"] += 1
+        assert eng.tick()["slos"]["e2e-p99"]["breaches"] == 2
+
+    def test_breach_requires_every_fast_window_not_just_the_first_pair(self):
+        # 4-window ladder: THREE fast windows must all confirm (the
+        # contract "every entry but the last is fast"); a burst that only
+        # lights the two shortest must not page
+        reg = Registry()
+        h = reg.histogram("router_decision_seconds")
+        eng, clock = _engine(
+            windows=((2, 14.4), (4, 14.4), (8, 14.4), (30, 1.0)),
+            registries={"router": reg})
+        for _ in range(5000):  # old good history: lands in the 8s window
+            h.observe(0.001)
+        clock["t"] += 1
+        eng.tick()
+        clock["t"] += 5  # good burst now 6s old: outside 2s/4s, inside 8s
+        for _ in range(50):
+            h.observe(0.5)
+        clock["t"] += 0.5
+        st = eng.tick()["slos"]["e2e-p99"]
+        assert st["burn_rate"]["2s"] >= 14.4
+        assert st["burn_rate"]["4s"] >= 14.4
+        assert st["burn_rate"]["8s"] < 14.4  # diluted by the good history
+        assert not st["breaching"] and st["breaches"] == 0
+
+    def test_fast_ticks_bucket_into_bounded_ring(self):
+        # sub-bucket ticks merge: a short interval_s against a long slow
+        # window must not age burned budget out of the ring early
+        reg = Registry()
+        h = reg.histogram("router_decision_seconds")
+        eng, clock = _engine(windows=((2, 14.4), (4, 14.4), (4096, 1.0)),
+                             registries={"router": reg})
+        for _ in range(50):  # bucket_s = 4096/4096 = 1.0 s; ticks 0.1 s
+            h.observe(0.001)
+            clock["t"] += 0.1
+            eng.tick()
+        ring = eng._trackers["e2e-p99"].ring
+        assert len(ring) <= 7  # ~5 s of ticks -> ~5 one-second buckets
+        assert sum(g for _t, g, _b in ring) == 50  # nothing lost
+
+    def test_bad_fraction_outside_window_ages_out(self):
+        reg = Registry()
+        h = reg.histogram("router_decision_seconds")
+        eng, clock = _engine(registries={"router": reg})
+        for _ in range(50):
+            h.observe(0.5)
+        clock["t"] += 1
+        eng.tick()
+        clock["t"] += 60  # beyond every window
+        st = eng.tick()
+        assert st["slos"]["e2e-p99"]["burn_rate"]["30s"] == 0.0
+        assert st["slos"]["e2e-p99"]["error_budget_remaining"] == 1.0
+
+    def test_error_rate_spec_from_counters(self):
+        reg = Registry()
+        total = reg.counter("transaction_incoming_total")
+        errs = reg.counter("router_process_start_errors_total")
+        spec = SLOSpec("error-rate", kind="error_rate",
+                       metric="transaction_incoming_total",
+                       error_metric="router_process_start_errors_total",
+                       objective=0.99)
+        eng, clock = _engine(specs=[spec], registries={"router": reg})
+        total.inc(1000)
+        errs.inc(500, labels={"type": "fraud"})  # labels sum via total()
+        clock["t"] += 1
+        st = eng.tick()
+        assert st["slos"]["error-rate"]["burn_rate"]["5s"] == pytest.approx(
+            50.0)
+        assert st["slos"]["error-rate"]["breaching"]
+
+    def test_source_resolves_lazily_after_engine_build(self):
+        registries = {}
+        eng, clock = _engine(registries=registries)
+        clock["t"] += 1
+        eng.tick()  # metric doesn't exist yet: no events, no crash
+        reg = Registry()
+        registries["router"] = reg
+        reg.histogram("router_decision_seconds").observe(0.5)
+        clock["t"] += 1
+        assert eng.tick()["slos"]["e2e-p99"]["burn_rate"]["5s"] > 0
+
+    def test_tick_refreshes_stage_gauges(self):
+        # the supervised tick (and the exporter scrape) are the sampling
+        # clocks for ccfd_stage_latency_ms — the SLO board must not
+        # depend on someone polling /profile
+        reg = Registry()
+        p = StageProfiler(registry=reg)
+        eng = SLOEngine(
+            [SLOSpec("e2e-p99", metric="router_decision_seconds")],
+            {}, registry=Registry(), windows=((5, 14.4), (30, 1.0)),
+            profiler=p)
+        p.observe("bus", queue_s=0.005, rows=1)
+        eng.tick()
+        g = reg.get("ccfd_stage_latency_ms")
+        assert g.value({"stage": "bus", "component": "queue",
+                        "quantile": "p99"}) == pytest.approx(5.0, rel=0.15)
+
+    def test_spec_parsing_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown keys"):
+            SLOSpec.from_mapping({"name": "x", "tarlet_ms": 5})
+        with pytest.raises(ValueError, match="needs a name"):
+            SLOSpec.from_mapping({"kind": "latency"})
+        s = SLOSpec.from_mapping({"name": "er", "metric": "a",
+                                  "error_metric": "b",
+                                  "max_error_rate": 0.05})
+        assert s.kind == "error_rate"
+        assert s.objective == pytest.approx(0.95)
+
+    def test_windows_from_config(self):
+        cfg = Config()
+        ws = SLOEngine.windows_from_config(cfg)
+        assert ws == [(300.0, 14.4), (3600.0, 14.4), (21600.0, 1.0)]
+        ws = SLOEngine.windows_from_config(cfg, "3,6,20")
+        assert ws == [(3.0, 14.4), (6.0, 14.4), (20.0, 1.0)]
+        with pytest.raises(ValueError):
+            SLOEngine.windows_from_config(cfg, "300")
+
+    def test_from_config_cr_specs_and_ledger(self):
+        cfg = Config()
+        profiler = StageProfiler()
+        options = {
+            "windows": "4,8,16",
+            "specs": [
+                {"name": "rest-p99", "kind": "latency",
+                 "metric": "seldon_api_executor_client_requests_seconds",
+                 "target_ms": 30.0, "objective": 0.999},
+            ],
+        }
+        eng = SLOEngine.from_config(cfg, {}, Registry(), profiler=profiler,
+                                    options=options)
+        assert [s.name for s in eng.specs] == ["rest-p99"]
+        assert eng.specs[0].target_ms == 30.0
+        assert eng.windows[0] == (4.0, 14.4)
+        assert eng.ledger is not None and eng.ledger.slo == "rest-p99"
+        assert eng.ledger.target_ms == 30.0
+        # no rest SLO declared -> no ledger
+        eng2 = SLOEngine.from_config(
+            cfg, {}, Registry(), profiler=profiler,
+            options={"specs": [{"name": "only-e2e", "metric": "m"}]})
+        assert eng2.ledger is None
+
+
+# -- BudgetLedger ------------------------------------------------------------
+class TestBudgetLedger:
+    def test_rest_ledger_layers_and_ratio_gauges(self):
+        cfg = Config()
+        reg = Registry()
+        profiler = StageProfiler()
+        for _ in range(20):
+            profiler.observe("rest.batcher", queue_s=0.002, rows=16)
+            profiler.observe("rest.dispatch", dispatch_s=0.010, batch=16,
+                             rows=16)
+        ledger = BudgetLedger.for_rest_path(cfg, profiler, reg)
+        snap = ledger.evaluate()
+        layers = snap["layers"]
+        assert set(layers) == {"transport", "batcher_wait", "dispatch",
+                               "h2d"}
+        # static transport floor = the r04 rest_latency_floor number
+        assert layers["transport"]["spent_p99_ms"] == pytest.approx(
+            cfg.slo_transport_floor_ms)
+        assert layers["h2d"]["spent_p99_ms"] == 0.0  # placeholder layer
+        assert layers["dispatch"]["spent_p99_ms"] == pytest.approx(
+            10.0, rel=0.15)
+        assert layers["dispatch"]["count"] == 20
+        g = reg.get("ccfd_slo_budget_spent_ratio")
+        ratio = g.value({"slo": "rest-p99", "layer": "dispatch"})
+        assert ratio == pytest.approx(
+            layers["dispatch"]["spent_p99_ms"]
+            / layers["dispatch"]["budget_ms"], rel=1e-3)
+        # budget slices cover the target
+        total_budget = sum(e["budget_ms"] for e in layers.values())
+        assert total_budget == pytest.approx(cfg.slo_rest_target_ms,
+                                             rel=0.01)
+
+    def test_budget_overrides(self):
+        cfg = Config()
+        ledger = BudgetLedger.for_rest_path(
+            cfg, StageProfiler(), Registry(),
+            budgets={"dispatch": 5.0, "transport": 1.0})
+        layers = ledger.evaluate()["layers"]
+        assert layers["dispatch"]["budget_ms"] == 5.0
+        assert layers["transport"]["budget_ms"] == 1.0
+
+
+# -- hot-path feeds ----------------------------------------------------------
+class TestFeeds:
+    def test_dynamic_batcher_feeds_wait_and_dispatch(self):
+        from ccfd_tpu.serving.batcher import DynamicBatcher
+
+        profiler = StageProfiler()
+        b = DynamicBatcher(lambda x: np.zeros(x.shape[0], np.float32),
+                           deadline_ms=0.0, profiler=profiler)
+        try:
+            b.score(np.zeros((8, 30), np.float32))
+            b.score(np.zeros((4, 30), np.float32))
+        finally:
+            b.stop()
+        doc = profiler.snapshot()
+        assert doc["stages"]["rest.batcher"]["queue"]["count"] == 2
+        assert doc["stages"]["rest.dispatch"]["dispatch"]["count"] == 2
+        assert doc["stages"]["rest.dispatch"]["rows"] == 12
+
+    def test_router_feeds_queue_decode_score_route(self):
+        from ccfd_tpu.bus.broker import Broker
+        from ccfd_tpu.process.fraud import build_engine
+        from ccfd_tpu.router.router import Router
+
+        cfg = Config()
+        broker = Broker(default_partitions=1)
+        reg = Registry()
+        engine = build_engine(cfg, broker, reg, None)
+        profiler = StageProfiler()
+        router = Router(cfg, broker,
+                        lambda x: np.zeros(x.shape[0], np.float32),
+                        engine, reg, max_batch=64, profiler=profiler)
+        try:
+            broker.produce_batch(
+                cfg.kafka_topic,
+                [b"0.1," * 29 + b"5.0" for _ in range(32)],
+                list(range(32)))
+            while router.step() > 0:
+                pass
+        finally:
+            router.close()
+            broker.close()
+        doc = profiler.snapshot()
+        for stage, comp in (("bus", "queue"), ("router.decode", "service"),
+                            ("router.score", "dispatch"),
+                            ("router.route", "service")):
+            assert doc["stages"][stage][comp]["count"] >= 1, stage
+        assert doc["stages"]["router.score"]["rows"] == 32
+        assert "64" in doc["stages"]["router.score"]["service_by_batch"]
+
+
+# -- operator wiring ---------------------------------------------------------
+class TestOperatorWiring:
+    def _cr(self, **slo_block):
+        return {"spec": {
+            "store": {"enabled": False},
+            "bus": {"partitions": 2},
+            "scorer": {"enabled": True, "model": "logreg",
+                       "train_steps": 0},
+            "engine": {"enabled": True},
+            "notify": {"enabled": False},
+            "router": {"enabled": True},
+            "retrain": {"enabled": False},
+            "producer": {"enabled": False},
+            "analytics": {"enabled": False},
+            "investigator": {"enabled": False},
+            "lifecycle": {"enabled": False},
+            "tracing": {"enabled": False},
+            "monitoring": {"enabled": True},
+            "health": {"enabled": False},
+            **({"slo": slo_block} if slo_block else {}),
+        }}
+
+    def test_default_on_profiler_engine_service_and_endpoint(self):
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        platform = Platform(PlatformSpec.from_cr(
+            self._cr(), cfg=Config(slo_windows="3,6,20"))).up(
+                wait_ready_s=20.0)
+        try:
+            assert platform.profiler is not None
+            assert platform.slo is not None
+            assert platform.status()["services"]["slo"]["state"] == "Running"
+            # specs default to the CCFD_SLO_* stock objectives
+            assert [s.name for s in platform.slo.specs] == [
+                "e2e-p99", "rest-p99", "error-rate"]
+            assert platform.slo.ledger is not None
+            # the profile endpoint serves over the platform exporter
+            metrics = platform.status()["endpoints"]["metrics"]
+            with urllib.request.urlopen(metrics + "/profile",
+                                        timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+            assert validate_profile(doc) == []
+            # burn gauges land on the aggregated scrape
+            with urllib.request.urlopen(metrics + "/prometheus",
+                                        timeout=10) as resp:
+                body = resp.read().decode()
+            platform.slo.tick()
+            with urllib.request.urlopen(metrics + "/prometheus",
+                                        timeout=10) as resp:
+                body = resp.read().decode()
+            assert "ccfd_slo_burn_rate" in body
+            assert "ccfd_slo_error_budget_remaining" in body
+        finally:
+            platform.down()
+
+    def test_cr_disable_and_env_kill_switch(self):
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        platform = Platform(PlatformSpec.from_cr(
+            self._cr(enabled=False), cfg=Config())).up(wait_ready_s=20.0)
+        try:
+            assert platform.profiler is None and platform.slo is None
+            assert "slo" not in platform.status()["services"]
+        finally:
+            platform.down()
+        platform = Platform(PlatformSpec.from_cr(
+            self._cr(), cfg=Config(slo_enabled=False))).up(wait_ready_s=20.0)
+        try:
+            assert platform.profiler is None and platform.slo is None
+        finally:
+            platform.down()
+
+    def test_router_and_rest_batcher_share_the_platform_profiler(self):
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        cr = self._cr()
+        cr["spec"]["scorer"]["rest"] = True
+        platform = Platform(PlatformSpec.from_cr(
+            cr, cfg=Config())).up(wait_ready_s=20.0)
+        try:
+            assert platform.router._profiler is platform.profiler
+            assert (platform.prediction_server.batcher._profiler
+                    is platform.profiler)
+        finally:
+            platform.down()

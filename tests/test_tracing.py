@@ -424,6 +424,42 @@ def test_exemplar_rendering_openmetrics_only():
     assert om.rstrip().endswith("# EOF")
 
 
+def test_exemplar_on_overflowed_labelset_is_spec_valid_openmetrics():
+    """Exemplars attached to series that FOLD into the cardinality
+    guard's overflow labelset (metrics/prom.py OVERFLOW_KEY) must render
+    spec-valid OpenMetrics — the fold rewrites the series labels after
+    the exemplar was recorded, which was untested (ISSUE 9 satellite).
+    The reference OM parser is the judge, as in the exporter tests."""
+    prom_parser = pytest.importorskip("prometheus_client.openmetrics.parser")
+    reg = Registry()
+    h = reg.histogram("lat_seconds", labelset_limit=2)
+    for i in range(6):
+        h.observe(0.004, labels={"endpoint": f"/e{i}"},
+                  exemplar={"trace_id": f"{i:032x}"})
+    om = reg.render(openmetrics=True)
+    families = {f.name: f for f in
+                prom_parser.text_string_to_metric_families(om)}
+    assert "lat_seconds" in families  # parsed end-to-end without raising
+    overflow_buckets = [
+        s for s in families["lat_seconds"].samples
+        if s.name == "lat_seconds_bucket"
+        and s.labels.get("overflow") == "true"
+    ]
+    # the 4 folded observations landed on ONE overflow series...
+    assert overflow_buckets
+    assert any(s.value == 4 for s in overflow_buckets)
+    # ...carrying a well-formed exemplar (one of the folded trace ids)
+    folded_ids = {f"{i:032x}" for i in range(2, 6)}
+    ex = [s.exemplar for s in overflow_buckets if s.exemplar is not None]
+    assert ex and ex[0].labels["trace_id"] in folded_ids
+    assert ex[0].value == pytest.approx(0.004)
+    # admitted series keep their own exemplars untouched by the fold
+    kept = [s.exemplar for s in families["lat_seconds"].samples
+            if s.exemplar is not None
+            and s.labels.get("endpoint") == "/e0"]
+    assert kept and kept[0].labels["trace_id"] == f"{0:032x}"
+
+
 def test_label_cardinality_guard_folds_and_counts():
     reg = Registry()
     c = reg.counter("edges", labelset_limit=3)

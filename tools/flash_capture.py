@@ -136,6 +136,13 @@ def main() -> int:
                     help="skip the relay-leg pre-filter and dial anyway "
                     "(for a probe-confirmed attachment whose port set "
                     "moved away from the known legs)")
+    ap.add_argument("--profile-dir", default="",
+                    help="device-trace output dir for the profile section "
+                    "(TensorBoard format). Default: profile_<platform>_"
+                    "<YYYYMMDD> under the repo — stamped per capture, not "
+                    "pinned to a round name, so the next on-TPU heal "
+                    "captures cleanly instead of clobbering (or cohabiting) "
+                    "an old round's trace")
     args = ap.parse_args()
 
     if (not args.force_dial and not legs_listening()
@@ -333,7 +340,15 @@ def main() -> int:
         # the earlier flushes haven't banked.
         from ccfd_tpu.utils.tracing import Tracer
 
-        logdir = os.path.join(REPO, "profile_tpu_r05")
+        # output dir stamped by platform + capture date (no hardcoded
+        # round name): each heal's trace lands in its own dir, and the
+        # artifact records the resolved platform so a fallback capture is
+        # never mistaken for device evidence
+        logdir = args.profile_dir or os.path.join(
+            REPO,
+            f"profile_{state['platform']}_"
+            f"{time.strftime('%Y%m%d', time.gmtime())}",
+        )
         scorer = Scorer(model_name="mlp", params=params,
                         batch_sizes=(batch,), compute_dtype="bfloat16")
         scorer.warmup()
@@ -343,7 +358,8 @@ def main() -> int:
                 scorer.score_pipelined(ds.X[:batch], depth=2)
         n_files = sum(len(fs) for _, _, fs in os.walk(logdir))
         state["result"]["profile"] = {"logdir": os.path.basename(logdir),
-                                      "files": n_files}
+                                      "files": n_files,
+                                      "platform": state["platform"]}
 
     section("scorer", 300, do_scorer)
     section("zoo", 300, do_zoo)

@@ -17,6 +17,13 @@ armed -> engine), with traffic stamped across the three priority classes
   so one worker's partitions carry the load) proving the GLOBAL budget
   keeps a skewed worker from blowing the p99 for everyone.
 
+Round 12 adds the OBJECTIVE side (observability/profile.py + slo.py): the
+pipeline runs with the stage profiler and a burn-rate SLO engine armed —
+the flash crowd must burn the e2e SLO's fast windows with the stage
+profile showing the damage concentrated in the QUEUEING layer
+(backpressure parks the crowd in the bus; ``slo.stage_shares`` in the
+artifact), while the diurnal ramp must stay green (0 breaches).
+
 Exit 0 only when EVERY regime holds its invariants:
 
 1. admitted-traffic decision p99 (produce -> process start,
@@ -58,6 +65,8 @@ from ccfd_tpu.bus.broker import Broker  # noqa: E402
 from ccfd_tpu.config import Config  # noqa: E402
 from ccfd_tpu.data.ccfd import synthetic_dataset  # noqa: E402
 from ccfd_tpu.metrics.prom import Registry  # noqa: E402
+from ccfd_tpu.observability.profile import StageProfiler  # noqa: E402
+from ccfd_tpu.observability.slo import SLOEngine, SLOSpec  # noqa: E402
 from ccfd_tpu.process.fraud import build_engine  # noqa: E402
 from ccfd_tpu.router.parallel import ParallelRouter  # noqa: E402
 from ccfd_tpu.runtime.faults import FaultPlan, FaultSpec  # noqa: E402
@@ -79,10 +88,26 @@ class Pipeline:
     regimes drive (fault plan on the scorer edge, priority-aware feeder)."""
 
     def __init__(self, workers: int = 2, partitions: int = 4,
-                 limit_floor: int = 2048, codel_target_ms: float = 100.0):
+                 limit_floor: int = 2048, codel_target_ms: float = 100.0,
+                 burn_target_ms: float = 150.0):
         self.cfg = Config()
         self.broker = Broker(default_partitions=partitions)
         self.reg = Registry()
+        # stage profiler + burn-rate SLO over the same live run
+        # (observability/profile.py + slo.py): the regimes assert the
+        # OBJECTIVE side of what the overload mechanisms defend — the
+        # flash crowd must burn the e2e budget with the damage
+        # concentrated in the QUEUEING layer (backpressure parks the
+        # crowd in the bus), diurnal must stay green. Fast windows are
+        # CI-scale (2 s confirms 4 s); the burn target is a production-
+        # shaped decision bound, not the regime's hard --slo-ms ceiling.
+        self.profiler = StageProfiler()
+        self.slo = SLOEngine(
+            [SLOSpec("e2e-p99", metric="router_decision_seconds",
+                     target_ms=burn_target_ms, objective=0.99)],
+            {"router": self.reg}, registry=self.reg,
+            windows=((2.0, 14.4), (4.0, 14.4), (12.0, 1.0)),
+        )
         self.engine = build_engine(self.cfg, self.broker, self.reg, None)
         scorer = Scorer(model_name="mlp", batch_sizes=(128, 1024, 4096, 8192))
         scorer.warmup()
@@ -105,7 +130,7 @@ class Pipeline:
         self.router = ParallelRouter(
             self.cfg, self.broker, score_fn, self.engine, self.reg,
             workers=workers, max_batch=4096, coalesce_max_batch=8192,
-            overload=self.overload,
+            overload=self.overload, profiler=self.profiler,
         )
         ds = synthetic_dataset(n=8192, fraud_rate=0.01, seed=7)
         self._rows = [
@@ -186,8 +211,30 @@ class Pipeline:
         self.router.close()
         return drained
 
+    def stage_shares(self) -> dict[str, float]:
+        """Where the run's decision latency went, from the stage profiler:
+        each component's share of the summed wall time across queueing
+        (bus wait), decode, device dispatch and route/engine. The flash
+        regime's claim — backpressure parks the crowd in the BUS — reads
+        directly off the queue share."""
+        comps = {
+            "queue": ("bus", "queue"),
+            "decode": ("router.decode", "service"),
+            "dispatch": ("router.score", "dispatch"),
+            "route": ("router.route", "service"),
+        }
+        sums: dict[str, float] = {}
+        for name, (stage, comp) in comps.items():
+            d = self.profiler.digest(stage, comp)
+            sums[name] = d.sum if d is not None else 0.0
+        total = sum(sums.values())
+        if total <= 0:
+            return {k: 0.0 for k in sums}
+        return {k: round(v / total, 4) for k, v in sums.items()}
+
     def verdict(self, slo_ms: float) -> dict:
         """Shared invariant checks every regime asserts after its drain."""
+        self.slo.tick()
         cts = self.counts()
         dec = self.reg.histogram("router_decision_seconds")
         p50 = dec.quantile(0.5) * 1e3
@@ -221,6 +268,11 @@ class Pipeline:
             "limit_min": self._limit_min,
             "limit_max": self._limit_max,
             "limit_end": self.budget.limit,
+            "slo": {
+                "breaches": self.slo.breaches("e2e-p99"),
+                "target_ms": self.slo.specs[0].target_ms,
+                "stage_shares": self.stage_shares(),
+            },
             "violations": violations,
         }
 
@@ -249,6 +301,7 @@ def _run_windows(pipe: Pipeline, seconds: float, rate_fn,
             on_window(t)
         now = time.monotonic()
         if now >= next_window:
+            pipe.slo.tick()  # burn-rate evaluation rides the window clock
             cur = pipe.counts()
             win = {
                 "t_s": round(t, 1),
@@ -364,6 +417,25 @@ def run_flash(seconds: float, slo_ms: float, base_rate: float) -> dict:
     if out["limit_end"] <= out["limit_min"]:
         out["violations"].append(
             "AIMD limit did not recover after the crowd")
+    # the SLO layer's flash claims (ISSUE 9): the crowd must burn the e2e
+    # fast windows, and the stage profile must show the damage living in
+    # the QUEUEING layer — backpressure parked the crowd in the bus, it
+    # didn't inflate service time
+    if out["slo"]["breaches"] == 0:
+        out["violations"].append(
+            "flash crowd never burned the e2e SLO's fast windows — the "
+            "burn-rate layer saw no saturation")
+    shares = out["slo"]["stage_shares"]
+    if sum(shares.values()) <= 0:
+        # an all-zero share map means the profiler never sampled — the
+        # claim below would pass vacuously on a broken feed
+        out["violations"].append(
+            "stage profiler recorded no samples — the queueing-layer "
+            "claim has no evidence")
+    elif max(shares, key=shares.get) != "queue":
+        out["violations"].append(
+            f"flash budget burn not concentrated in the queueing layer: "
+            f"{shares}")
     return out
 
 
@@ -389,6 +461,10 @@ def run_diurnal(seconds: float, slo_ms: float, base_rate: float) -> dict:
         out["violations"].append(
             f"diurnal ramp shed {out['counts']['shed']} rows — the plane "
             "interfered with a load it should absorb")
+    if out["slo"]["breaches"] > 0:
+        out["violations"].append(
+            f"diurnal ramp burned the e2e SLO ({out['slo']['breaches']} "
+            "breaches) — the daily shape must stay green")
     return out
 
 

@@ -1,0 +1,101 @@
+"""StageProfile writer: produce the planner-input artifact, validated.
+
+The machine-readable counterpart of ``tools/trace_report.py``'s human
+table (ISSUE 9): emits the **StageProfile JSON artifact** — the per-stage
+queueing/service/dispatch decomposition with batch-conditioned service
+curves and XLA compile attribution (``observability/profile.py``,
+schema ``ccfd.stage_profile.v1``) — the input contract ROADMAP item 3's
+provisioning planner consumes, plus the SLO engine's burn-rate/budget
+status alongside on stdout.
+
+Two modes:
+
+- **live** (``--url http://host:9100``): fetch ``/profile`` from a running
+  platform's metrics exporter, validate it against the schema, write it
+  crash-safely (tmp+rename).
+- **drive** (default): bring up the in-process pipeline + REST lane with
+  the profiler and SLO engine armed (the slo_smoke harness, no faults),
+  run traffic for ``--seconds``, verify the document round-trips through
+  the live exporter's ``/profile`` over real HTTP, and write it.
+
+    JAX_PLATFORMS=cpu python tools/slo_report.py --out STAGE_PROFILE.json
+    python tools/slo_report.py --url http://127.0.0.1:9100
+
+Exit 0 only when the artifact validates and carries at least one stage
+with samples; one JSON status line on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(REPO, "STAGE_PROFILE.json"))
+    ap.add_argument("--url", default="",
+                    help="fetch /profile from a live exporter instead of "
+                    "driving an in-process pipeline")
+    ap.add_argument("--seconds", type=float, default=6.0)
+    ap.add_argument("--cr", default=os.path.join(
+        REPO, "deploy", "platform_cr.yaml"))
+    args = ap.parse_args()
+
+    from ccfd_tpu.observability.profile import (
+        validate_profile,
+        write_json_crash_safe,
+    )
+
+    slo_status = None
+    if args.url:
+        with urllib.request.urlopen(
+                args.url.rstrip("/") + "/profile", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from slo_smoke import Harness
+
+        h = Harness(args.cr, windows="5,10,30", fault_ms=0.0)
+        try:
+            h.drive(args.seconds)
+            slo_status = h.engine.tick()
+            # the artifact is read over the SAME surface the planner will
+            # use: the live exporter's /profile, not a private snapshot
+            with urllib.request.urlopen(
+                    h.exporter.endpoint + "/profile", timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+        finally:
+            h.close()
+
+    errs = validate_profile(doc)
+    sampled = [s for s, e in doc.get("stages", {}).items()
+               if any(isinstance(e.get(c), dict) and e[c].get("count", 0)
+                      for c in ("queue", "service", "dispatch"))]
+    ok = not errs and bool(sampled)
+    if ok:
+        write_json_crash_safe(args.out, doc)
+    print(json.dumps({
+        "harness": "slo_report",
+        "ok": ok,
+        "out": args.out if ok else None,
+        "schema": doc.get("schema"),
+        "stages_with_samples": sorted(sampled),
+        "validation_errors": errs[:5],
+        "slo": (slo_status or {}).get("slos"),
+        "budget_ledger": (slo_status or {}).get("budget_ledger"),
+    }))
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

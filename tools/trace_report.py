@@ -29,6 +29,7 @@ import json
 import os
 import re
 import sys
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -123,6 +124,13 @@ def main() -> int:
                     "the per-stage trace decomposition survives the "
                     "partition-parallel fan-out (worker-labelled "
                     "router.batch spans); 1 = single router")
+    ap.add_argument("--json", dest="json_out", default="",
+                    help="also write the report as a machine-readable "
+                    "artifact (crash-safe tmp+rename) — the trace-derived "
+                    "sibling of the StageProfile schema family "
+                    "(observability/profile.py), for CI and the "
+                    "provisioning planner; exit stays nonzero when no "
+                    "end-to-end trace was retained")
     args = ap.parse_args()
 
     cfg = Config()
@@ -244,6 +252,30 @@ def main() -> int:
               file=sys.stderr)
     print(json.dumps(report))
     ok = bool(e2e) and mono and resolved is not None and workers_ok
+    if args.json_out:
+        # StageProfile-family artifact: trace-derived decomposition under
+        # its own schema id, stages shaped like the profile's digests so a
+        # planner can consume either. Written even on failure (the "ok"
+        # flag and exit code carry the verdict; CI wants the evidence).
+        artifact = {
+            "schema": "ccfd.stage_profile.trace.v1",
+            "generated_unix": time.time(),
+            "ok": ok,
+            "source": "trace_report",
+            "stages": {
+                name: {
+                    "count": st["n"],
+                    "p50_ms": st["p50_ms"],
+                    "p99_ms": st["p99_ms"],
+                    "critical_path_share": st["critical_path_share"],
+                }
+                for name, st in breakdown.items()
+            },
+            "report": report,
+        }
+        from ccfd_tpu.observability.profile import write_json_crash_safe
+
+        write_json_crash_safe(args.json_out, artifact)
     return 0 if ok else 3
 
 

@@ -31,6 +31,16 @@
 #                                          restore-replay rebuilds
 #                                          byte-identical histories:
 #                                          SEQSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --slo-smoke      exit-code-gated smoke of the
+#                                          SLO plane (tools/slo_smoke.py):
+#                                          CR-loaded specs, a fault-
+#                                          injected latency step breaches
+#                                          ONLY the REST SLO, the budget
+#                                          ledger attributes the added
+#                                          latency to the dispatch layer,
+#                                          and the StageProfile artifact
+#                                          round-trips through /profile:
+#                                          SLOSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -56,6 +66,17 @@ if [ "${1:-}" = "--seq-smoke" ]; then
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/seq_smoke.py; then
         # the script already printed SEQSMOKE verdict=PASS
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--slo-smoke" ]; then
+    # exit-code-gated smoke of the SLO/stage-profile plane: burn-rate
+    # breach isolation + budget-ledger attribution + /profile round-trip
+    # (see tools/slo_smoke.py; the script prints SLOSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/slo_smoke.py; then
         exit 0
     fi
     exit 1
