@@ -80,6 +80,87 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(__file__), "BENCH_TPU_LAST_GOOD.js
 _PARTIAL: dict = {}
 
 
+def _triage_verdict(root: str | None = None,
+                    max_age_h: float | None = None) -> str | None:
+    """The newest FRESH tools/tpu_triage.py artifact's verdict (ISSUE 10
+    satellite): on accelerator-probe fallback the platform string names
+    WHERE the attachment is wedged (``wedged_relay_dead`` vs
+    ``wedged_backend``) instead of the generic probe-failed label.
+
+    Freshness gates on the artifact's own ``ts`` stamp
+    (CCFD_BENCH_TRIAGE_MAX_AGE_H, default 24): a weeks-old checked-in
+    triage must not be asserted as the root cause of TODAY's probe
+    failure — stale or absent artifacts fall back to the generic label
+    (None)."""
+    import glob
+
+    if max_age_h is None:
+        max_age_h = float(os.environ.get("CCFD_BENCH_TRIAGE_MAX_AGE_H",
+                                         "24"))
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(root, "TPU_TRIAGE_*.json"))
+    best: tuple[float, str, str] | None = None  # (age_ok sort key…)
+    for path in paths:
+        try:
+            with open(path) as f:
+                report = json.load(f)
+        except (OSError, ValueError):
+            continue
+        verdict = report.get("verdict")
+        ts = report.get("ts", "")
+        if not isinstance(verdict, str) or not verdict:
+            continue
+        try:
+            import calendar
+
+            # timegm, not mktime: the ts is UTC, and mktime's local-time
+            # (DST-dependent) interpretation would skew the gate an hour
+            stamped = calendar.timegm(time.strptime(ts,
+                                                    "%Y-%m-%dT%H:%M:%SZ"))
+        except (TypeError, ValueError, OverflowError):
+            continue  # unparseable stamp: cannot prove freshness
+        if time.time() - stamped > max_age_h * 3600.0:
+            continue
+        if best is None or stamped > best[0]:
+            best = (stamped, verdict, ts)
+    if best is None:
+        return None
+    return f"triage: {best[1]} @ {best[2]}"
+
+
+class _DeviceMeter:
+    """Per-section device telemetry for bench rows (ISSUE 10 satellite):
+    installs a DeviceTelemetry plane as the process default — every
+    scorer the sections build stages through it — and hands out per-
+    section H2D byte deltas + the running peak device memory."""
+
+    def __init__(self, attach_rows: bool):
+        from ccfd_tpu.observability.device import (
+            DeviceTelemetry,
+            set_default,
+        )
+
+        self.attach_rows = attach_rows
+        self.tele = DeviceTelemetry()
+        set_default(self.tele)
+        self._last_bytes = 0
+
+    def section(self, row) -> None:
+        """Attach {h2d_bytes, peak_device_memory_bytes} to a completed
+        section row (on-device runs; the CPU fallback exercises the same
+        counters but its rows stay unchanged)."""
+        if self.tele is None:
+            return
+        total = self.tele.h2d_bytes()
+        delta, self._last_bytes = total - self._last_bytes, total
+        if not (self.attach_rows and isinstance(row, dict)):
+            return
+        row["device"] = {
+            "h2d_bytes": int(delta),
+            "peak_device_memory_bytes": self.tele.peak_memory_bytes(),
+        }
+
+
 def _probe_backend(timeout_s: float, attempts: int, backoff_s: float) -> bool:
     """Can this environment initialize its default jax backend? Run the
     check in a child so a wedged TPU tunnel can't hang the bench itself,
@@ -1204,6 +1285,11 @@ def main() -> None:
     lat_batch = int(os.environ.get("CCFD_BENCH_LATENCY_BATCH", "4096"))
     skip = set(os.environ.get("CCFD_BENCH_SKIP", "").split(","))
     on_tpu = jax.default_backend() == "tpu"
+    # device telemetry (observability/device.py): every scorer below
+    # stages through the process-default plane; sections get h2d/peak-
+    # memory rows on device (CCFD_BENCH_DEVICE=1 forces rows on cpu)
+    meter = _DeviceMeter(
+        attach_rows=on_tpu or os.environ.get("CCFD_BENCH_DEVICE") == "1")
 
     ds = synthetic_dataset(n=max(batch, lat_batch, 4096), fraud_rate=0.01, seed=0)
     params = mlp.init(jax.random.PRNGKey(0))
@@ -1235,6 +1321,7 @@ def main() -> None:
         "p99_ms": round(p99, 3), "fused_active": scorer.fused,
         "platform_measured": jax.default_backend(),
     })
+    meter.section(None)  # reset the per-section H2D baseline past warmup
 
     fused_ab = None
     if "ab" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_AB")):
@@ -1261,6 +1348,7 @@ def main() -> None:
                          "p99_ms": round(r_p99, 3)}
         fused_ab = ab
         _PARTIAL["fused_ab"] = fused_ab
+        meter.section(None)
 
     rest = None
     rest_python = None
@@ -1273,6 +1361,7 @@ def main() -> None:
             params, lat_batch, max(2.0, seconds), rest_clients, rest_rows,
         )
         _PARTIAL["rest"] = rest
+        meter.section(rest)
         if rest.get("transport") == "NativeFront":
             # transport A/B: the same load through the Python server, so
             # the native front's effect is a recorded number
@@ -1296,8 +1385,12 @@ def main() -> None:
 
     pipeline = None
     if "pipeline" not in skip:
+        # fresh H2D baseline: the transport-A/B and latency-floor REST
+        # benches above are unmetered and must not bill this section
+        meter.section(None)
         pipeline = _bench_pipeline(pipe_params, max(2.0, seconds))
         _PARTIAL["pipeline"] = pipeline
+        meter.section(pipeline)
 
     mesh_res = None
     if "mesh" not in skip:
@@ -1306,19 +1399,23 @@ def main() -> None:
         )
         if mesh_res is not None:
             _PARTIAL["mesh"] = mesh_res
+            meter.section(mesh_res)
 
     retrain_res = None
     if "retrain" not in skip:
         retrain_res = _bench_retrain(max(1.0, seconds / 2))
         _PARTIAL["retrain"] = retrain_res
+        meter.section(retrain_res)
 
     seq_res = None
     if "seq" not in skip:
         seq_res = _bench_seq(max(1.0, seconds / 2))
         _PARTIAL["seq"] = seq_res
+        meter.section(seq_res)
 
     if "seq_pipeline" not in skip:
         _PARTIAL["seq_pipeline"] = _bench_seq_pipeline(max(3.0, seconds))
+        meter.section(_PARTIAL["seq_pipeline"])
 
     zoo_res = None
     if "zoo" not in skip:
@@ -1327,8 +1424,10 @@ def main() -> None:
 
     quant_res = None
     if "quant" not in skip and (on_tpu or os.environ.get("CCFD_BENCH_QUANT")):
+        meter.section(None)  # zoo traffic is unmetered: reset the baseline
         quant_res = _bench_quant(params, ds.X[:batch], max(1.0, seconds / 2))
         _PARTIAL["quant_int8"] = quant_res
+        meter.section(quant_res)
 
     if "roofline" not in skip:
         try:
@@ -1354,7 +1453,9 @@ def main() -> None:
         "latency_batch": lat_batch,
         "fused_active": scorer.fused,
         "platform": jax.default_backend()
-        + (" (fallback: accelerator probe failed)" if fellback else ""),
+        + ((" (fallback: " + (_triage_verdict()
+                              or "accelerator probe failed") + ")")
+           if fellback else ""),
     }
     # section results flow through _PARTIAL (written as each completes for
     # the watchdog); the final result picks them up from ONE place instead

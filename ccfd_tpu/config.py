@@ -194,6 +194,29 @@ class Config:
     # the front or host changes (CCFD_SLO_TRANSPORT_FLOOR_MS)
     slo_transport_floor_ms: float = 0.072
 
+    # --- device telemetry (observability/device.py; CR block `device:`) ---
+    # master switch for the device & transfer telemetry plane: per-device
+    # memory gauges, measured H2D accounting on the scorer staging path,
+    # the executable inventory and the /debug/profile capture endpoint
+    # (CCFD_DEVICE; 0 is the emergency kill switch — the BudgetLedger's
+    # h2d layer then falls back to the fixed reservation)
+    device_enabled: bool = True
+
+    # --- incident flight recorder (observability/incident.py; CR block
+    # `incident:`) ---
+    # master switch for the FlightRecorder + SLO-breach incident bundles
+    # (CCFD_INCIDENT; 0 kills the plane — breaches still page, they just
+    # stop dumping post-mortem bundles)
+    incident_enabled: bool = True
+    # periodic ring-snapshot cadence for the supervised recorder service
+    incident_interval_s: float = 5.0       # CCFD_INCIDENT_INTERVAL_S
+    # bounded snapshot ring depth
+    incident_ring: int = 64                # CCFD_INCIDENT_RING
+    # bundle persistence dir ("" = bundles held in memory only — still
+    # served at /incidents, lost on restart); writes are crash-safe
+    # (tmp+rename)
+    incident_dir: str = ""                 # CCFD_INCIDENT_DIR
+
     # --- sequence serving (serving/history.py; CR block `scorer.seq_*`) ---
     # HistoryStore stripe count: per-stripe locks keep ParallelRouter
     # workers from convoying on one global lock (CCFD_SEQ_STRIPES)
@@ -372,6 +395,18 @@ class Config:
             ),
             slo_enabled=e.get("CCFD_SLO", "1").strip().lower()
             not in ("0", "false", "no", "off"),
+            device_enabled=e.get("CCFD_DEVICE", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            incident_enabled=e.get("CCFD_INCIDENT", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            incident_interval_s=float(
+                e.get("CCFD_INCIDENT_INTERVAL_S",
+                      str(Config.incident_interval_s))
+            ),
+            incident_ring=int(
+                e.get("CCFD_INCIDENT_RING", str(Config.incident_ring))
+            ),
+            incident_dir=e.get("CCFD_INCIDENT_DIR", Config.incident_dir),
             slo_interval_s=float(
                 e.get("CCFD_SLO_INTERVAL_S", str(Config.slo_interval_s))
             ),

@@ -22,6 +22,18 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
                                per-component object counts, tracemalloc top
                                allocators; ?trace=1 arms tracemalloc
                                (observability/memory.py)
+    GET /incidents             incident-bundle summaries (JSON), newest
+                               first (observability/incident.py)
+    GET /incidents/<id>        one full schema-validated incident bundle
+                               (JSON); unknown ids 404
+    GET /debug/device          live device-telemetry snapshot (JSON):
+                               per-device memory, measured H2D accounting,
+                               executable inventory (observability/device.py)
+    GET /debug/profile?seconds=N   on-demand jax.profiler device capture:
+                               blocks ~N seconds (clamped to 60), returns
+                               {"trace_dir": ...} with the TensorBoard
+                               trace; one capture at a time (409-style
+                               {"error": ...} body while busy)
 
 Contract details (scrapers depend on them): metric paths answer with
 ``Content-Type: text/plain; version=0.0.4`` — or the OpenMetrics format
@@ -123,10 +135,15 @@ class MetricsExporter:
                  host: str = "127.0.0.1", port: int = 0,
                  sink=None,
                  memory_probes: dict[str, "object"] | None = None,
-                 profiler=None):
+                 profiler=None,
+                 telemetry=None,
+                 recorder=None):
         self._registries = dict(registries)
         self._sink = sink  # observability.trace.SpanSink (or None)
         self._profiler = profiler  # observability.profile.StageProfiler
+        self._telemetry = telemetry  # observability.device.DeviceTelemetry
+        self._recorder = recorder  # observability.incident.FlightRecorder
+        self._capture_lock = threading.Lock()  # one device capture at a time
         self._lock = threading.Lock()
         # memory-drift surface (observability/memory.py): a "process"
         # registry every scrape refreshes with the RSS gauge and one
@@ -211,6 +228,15 @@ class MetricsExporter:
                 return None, "application/json"
             return (json.dumps(self._profiler.snapshot()),
                     "application/json")
+        if path == "/incidents" or path.startswith("/incidents/"):
+            return self._incidents(path), "application/json"
+        if path == "/debug/device":
+            if self._telemetry is None:
+                return None, "application/json"
+            return (json.dumps(self._telemetry.snapshot()),
+                    "application/json")
+        if path == "/debug/profile":
+            return self._device_capture(query), "application/json"
         if path == "/memory":
             return self._memory(query), "application/json"
         body = self.render_path(path, openmetrics)
@@ -232,6 +258,47 @@ class MetricsExporter:
             probes = dict(self._memory_probes)
         return json.dumps(memory_report(probes))
 
+    def _incidents(self, path: str) -> str | None:
+        if self._recorder is None:
+            return None
+        if path.rstrip("/") == "/incidents":
+            return json.dumps({"incidents": self._recorder.incidents()})
+        doc = self._recorder.incident_doc(path[len("/incidents/"):])
+        if doc is None:
+            return None
+        return json.dumps(doc)
+
+    def _device_capture(self, query: str) -> str | None:
+        """On-demand jax.profiler trace (/debug/profile?seconds=N): the
+        deep device-level view behind the always-on stage profile. Blocks
+        the (threaded) handler for ~N seconds; captures are serialized —
+        jax.profiler.trace is not reentrant. Part of the DEVICE plane's
+        contract: CCFD_DEVICE=0 (telemetry absent) 404s it like
+        /debug/device, even when the slo profiler is still armed."""
+        if self._profiler is None or self._telemetry is None:
+            return None
+        import tempfile
+        import time as _time
+        from urllib.parse import parse_qs
+
+        q = parse_qs(query or "")
+        try:
+            seconds = float((q.get("seconds") or ["3"])[0])
+        except ValueError:
+            seconds = 3.0
+        seconds = min(max(seconds, 0.05), 60.0)
+        if not self._capture_lock.acquire(blocking=False):
+            return json.dumps({"error": "device capture already in progress"})
+        try:
+            logdir = tempfile.mkdtemp(prefix="ccfd_device_trace_")
+            with self._profiler.profile_device(logdir):
+                _time.sleep(seconds)
+            return json.dumps({"trace_dir": logdir, "seconds": seconds})
+        except Exception as e:  # noqa: BLE001 - a debug endpoint must not 500
+            return json.dumps({"error": repr(e)[:200]})
+        finally:
+            self._capture_lock.release()
+
     def render_path(self, path: str, openmetrics: bool = False) -> str | None:
         # the scrape is the sampling clock for the memory gauges: every
         # metric render refreshes RSS + component object counts first —
@@ -242,6 +309,13 @@ class MetricsExporter:
             try:
                 self._profiler.refresh_gauges()
             except Exception:  # noqa: BLE001 - a profiler bug must not 500
+                pass
+        if self._telemetry is not None:
+            # same contract as RSS: the scrape is the sampling clock for
+            # the per-device memory gauges
+            try:
+                self._telemetry.refresh()
+            except Exception:  # noqa: BLE001 - telemetry must not 500
                 pass
         with self._lock:
             regs = dict(self._registries)

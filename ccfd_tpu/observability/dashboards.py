@@ -32,6 +32,10 @@ metric names, one builder per board:
   alerts, the REST per-layer latency-budget ledger, and the live
   queueing/service/dispatch stage decomposition with XLA compile
   attribution (new capability; no reference analog)
+- Device       — device & transfer telemetry + incident flight recorder:
+  per-device memory by kind, measured H2D bytes/latency on the scorer
+  staging path, per-stage compile attribution, and the incident plane's
+  snapshot/bundle economics (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -551,6 +555,48 @@ def slo_dashboard() -> dict:
     return _dashboard("CCFD SLO", "ccfd-slo", p)
 
 
+def device_dashboard() -> dict:
+    """Device telemetry + incident board (round 13; observability/device.py
+    + observability/incident.py).
+
+    The measured side of the H2D/HBM story: per-device memory by kind
+    (allocator in-use/peak/limit where the backend reports them, live
+    buffer bytes everywhere), H2D staging throughput and per-put latency
+    from the scorer's instrumented dispatch path (the numbers the
+    BudgetLedger's h2d layer now reads instead of a reservation),
+    per-stage XLA compile attribution, and the incident flight recorder's
+    economics — ring depth, snapshot reasons, and the bundle counter an
+    operator checks after a page to find the post-mortem at
+    ``/incidents``."""
+    p = [
+        _panel(0, "Device memory by kind (bytes)",
+               ["ccfd_device_memory_bytes"]),
+        _panel(1, "H2D staged bytes / s",
+               ["rate(ccfd_h2d_bytes_total[5m])"]),
+        _panel(2, "H2D put latency p50/p99",
+               ["histogram_quantile(0.5, rate(ccfd_h2d_seconds_bucket[5m]))",
+                "histogram_quantile(0.99, rate(ccfd_h2d_seconds_bucket[5m]))"]),
+        _panel(3, "H2D puts / s",
+               ["rate(ccfd_h2d_seconds_count[5m])"]),
+        _panel(4, "Compile seconds by stage",
+               ["ccfd_compile_stage_seconds_total"]),
+        _alert_stat(5, "XLA compiles under traffic / s",
+                    ["rate(ccfd_xla_compile_events_total[5m])"],
+                    red_above=0.1),
+        _panel(6, "Flight-recorder snapshots / s (by reason)",
+               ["rate(ccfd_incident_snapshots_total[5m])"]),
+        _alert_stat(7, "Incident bundles dumped",
+                    ["ccfd_incidents_total"], red_above=1),
+        _panel(8, "Snapshot ring depth", ["ccfd_incident_ring_size"],
+               "stat"),
+        _alert_stat(9, "Dispatch watchdog kills / s "
+                       "(each snapshots the ring)",
+                    ["rate(ccfd_dispatch_timeout_total[5m])"],
+                    red_above=0.1),
+    ]
+    return _dashboard("CCFD Device", "ccfd-device", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -577,6 +623,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Overload": overload_dashboard(),
         "SeqServing": seq_serving_dashboard(),
         "SLO": slo_dashboard(),
+        "Device": device_dashboard(),
     }
 
 

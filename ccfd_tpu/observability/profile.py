@@ -48,6 +48,7 @@ stage; a disabled profiler costs one ``is None`` check.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
 import math
 import os
@@ -159,6 +160,17 @@ class LatencyDigest:
                 return min(max(v, self.min), self.max)
         return self.max
 
+    def copy(self) -> "LatencyDigest":
+        """Field-complete clone (readers snapshot under the writer's lock;
+        the layout knowledge stays HERE, not at every call site)."""
+        out = LatencyDigest()
+        out.counts = list(self.counts)
+        out.count = self.count
+        out.sum = self.sum
+        out.min = self.min
+        out.max = self.max
+        return out
+
     def to_dict(self) -> dict[str, Any]:
         if self.count == 0:
             return {"count": 0, "sum_s": 0.0}
@@ -198,6 +210,25 @@ def _batch_bucket(n: int) -> int:
 _COMPILE_HOOK_REGISTERED = False
 _COMPILE_TARGET: "weakref.ref[StageProfiler] | None" = None
 
+# per-stage compile attribution: backend_compile events fire synchronously
+# on the compiling thread, so a contextvar label set by the component that
+# triggered the compile (scorer warmup, a seq variant swap, a live
+# re-trace) names the stage the compile bills to
+_COMPILE_STAGE: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ccfd_compile_stage", default="untagged")
+
+
+@contextlib.contextmanager
+def compile_stage(label: str) -> Iterator[None]:
+    """Attribute XLA compiles inside the block to ``label`` (the device
+    telemetry plane's executable-inventory companion: WHICH stage paid
+    the compile, not just that one happened)."""
+    token = _COMPILE_STAGE.set(str(label))
+    try:
+        yield
+    finally:
+        _COMPILE_STAGE.reset(token)
+
 
 def _on_compile_event(event: str, secs: float, **_kw) -> None:
     if not event.endswith("backend_compile_duration"):
@@ -224,9 +255,13 @@ class StageProfiler:
         self._overload_registry = overload_registry
         self._compile_mu = threading.Lock()
         self._compile = LatencyDigest()
+        # stage label -> digest (see compile_stage): the per-stage compile
+        # attribution the Device board and incident bundles read
+        self._compile_stages: dict[str, LatencyDigest] = {}
         self._compile_armed = False
         self.registry = registry
         self._g_stage = self._c_compile = self._g_compile_s = None
+        self._g_compile_stage_s = None
         if registry is not None:
             self._g_stage = registry.gauge(
                 "ccfd_stage_latency_ms",
@@ -242,6 +277,12 @@ class StageProfiler:
             self._g_compile_s = registry.gauge(
                 "ccfd_xla_compile_seconds_total",
                 "cumulative wall seconds spent in XLA backend compiles",
+            )
+            self._g_compile_stage_s = registry.gauge(
+                "ccfd_compile_stage_seconds_total",
+                "cumulative XLA backend-compile seconds attributed to the "
+                "stage that triggered them (compile_stage labels; "
+                "'untagged' = compiles outside any labeled block)",
             )
 
     # -- ingestion ---------------------------------------------------------
@@ -296,15 +337,7 @@ class StageProfiler:
             return None
         with acc.lock:
             d = acc.digests.get(component)
-            if d is None:
-                return None
-            out = LatencyDigest()
-            out.counts = list(d.counts)
-            out.count = d.count
-            out.sum = d.sum
-            out.min = d.min
-            out.max = d.max
-            return out
+            return d.copy() if d is not None else None
 
     # -- XLA compile attribution ------------------------------------------
     def arm_compile_listener(self) -> bool:
@@ -333,11 +366,22 @@ class StageProfiler:
         return True
 
     def _record_compile(self, secs: float) -> None:
+        stage = _COMPILE_STAGE.get()
         with self._compile_mu:
             self._compile.add(float(secs))
-        if self._c_compile is not None:
-            self._c_compile.inc()
-            self._g_compile_s.set(self._compile.sum)
+            d = self._compile_stages.get(stage)
+            if d is None:
+                d = self._compile_stages[stage] = LatencyDigest()
+            d.add(float(secs))
+            # the *_total gauges publish under the same lock that computed
+            # them: two concurrent compiles setting out of order would
+            # move a cumulative series BACKWARDS, which rate()/increase()
+            # reads as a counter reset
+            if self._c_compile is not None:
+                self._c_compile.inc()
+                self._g_compile_s.set(self._compile.sum)
+                self._g_compile_stage_s.set(d.sum,
+                                            labels={"stage": stage})
 
     @contextlib.contextmanager
     def profile_device(self, logdir: str) -> Iterator[None]:
@@ -411,11 +455,14 @@ class StageProfiler:
             doc_stages[stage] = entry
         with self._compile_mu:
             compile_section = self._compile.to_dict()
+            compile_by_stage = {s: d.to_dict()
+                                for s, d in self._compile_stages.items()}
         return {
             "schema": PROFILE_SCHEMA,
             "generated_unix": time.time(),
             "stages": doc_stages,
             "compile": compile_section,
+            "compile_by_stage": compile_by_stage,
             "overload": self._overload_section(),
         }
 
@@ -486,4 +533,11 @@ def validate_profile(doc: Any) -> list[str]:
                 f"stages.{name}.service_by_batch.{b}", d))
     if "compile" in doc:
         errs.extend(_digest_errors("compile", doc["compile"]))
+    cbs = doc.get("compile_by_stage")
+    if cbs is not None:
+        if not isinstance(cbs, Mapping):
+            errs.append("compile_by_stage: not a mapping")
+        else:
+            for stage, d in cbs.items():
+                errs.extend(_digest_errors(f"compile_by_stage.{stage}", d))
     return errs

@@ -30,10 +30,12 @@ machinery, sized for this pipeline:
   (0.072 ms p99, REST_SWEEP; ``CCFD_SLO_TRANSPORT_FLOOR_MS``) as a static
   layer, measured batcher wait and device dispatch from the
   :class:`~ccfd_tpu.observability.profile.StageProfiler`, and an H2D
-  placeholder layer (0 until ROADMAP item 1's pinned-host staging lands —
-  the slot exists so the ledger's shape is stable). Each layer gets a
-  slice of the SLO target; ``ccfd_slo_budget_spent_ratio{slo,layer}``
-  says which layer is eating the budget.
+  layer that reads the MEASURED transfer digest from the device
+  telemetry plane (observability/device.py) when it is armed — the
+  pre-telemetry explicit-zero reservation remains the fallback so the
+  ledger's shape is stable either way. Each layer gets a slice of the
+  SLO target; ``ccfd_slo_budget_spent_ratio{slo,layer}`` says which
+  layer is eating the budget.
 
 The engine runs as a default-on supervised service under the operator
 (CR ``slo:`` block, ``CCFD_SLO=0`` kill switch) and is driven inline by
@@ -219,6 +221,10 @@ class SLOEngine:
         self.specs = list(specs)
         self.windows = [(float(s), float(th)) for s, th in windows]
         self.ledger = ledger
+        # breach-edge listeners (observability/incident.py FlightRecorder):
+        # fn(slo_name, status_doc) fires once per ENTRY into the breaching
+        # state, same edge semantics as ccfd_slo_breach_total
+        self._breach_listeners: list[Callable[[str, dict], Any]] = []
         # the stage profiler whose ccfd_stage_latency_ms gauges this
         # engine's tick refreshes (the supervised tick is the sampling
         # clock for the SLO board's decomposition panels; /profile reads
@@ -294,10 +300,13 @@ class SLOEngine:
     @staticmethod
     def from_config(cfg, registries: Mapping[str, Registry],
                     registry: Registry, profiler=None,
-                    options: Mapping[str, Any] | None = None) -> "SLOEngine":
+                    options: Mapping[str, Any] | None = None,
+                    telemetry=None) -> "SLOEngine":
         """The operator/CLI construction path: CR ``slo:`` options overlay
         the ``CCFD_SLO_*`` env defaults; ``specs:`` replaces the stock
-        objectives wholesale when declared."""
+        objectives wholesale when declared. ``telemetry`` (the
+        DeviceTelemetry plane) upgrades the ledger's ``h2d`` layer from
+        the fixed reservation to the measured transfer digest."""
         opts = dict(options or {})
         raw_specs = opts.get("specs")
         specs = ([SLOSpec.from_mapping(s) for s in raw_specs]
@@ -309,10 +318,16 @@ class SLOEngine:
                           if s.name == "rest-p99")
             ledger = BudgetLedger.for_rest_path(
                 cfg, profiler, registry, target_ms=target,
-                budgets=opts.get("budget"))
+                budgets=opts.get("budget"), telemetry=telemetry)
         return SLOEngine(specs, registries, registry=registry,
                          windows=windows, ledger=ledger,
                          profiler=profiler)
+
+    def add_breach_listener(self, fn: Callable[[str, dict], Any]) -> None:
+        """``fn(slo_name, status_doc)`` fires on every breach EDGE (once
+        per entry into breaching, again only after recovery + re-breach) —
+        the incident flight recorder's trigger."""
+        self._breach_listeners.append(fn)
 
     # -- evaluation --------------------------------------------------------
     def tick(self, now: float | None = None) -> dict[str, Any]:
@@ -325,6 +340,7 @@ class SLOEngine:
             {"window": window_name(s), "seconds": s, "threshold": th}
             for s, th in self.windows
         ]}
+        fired: list[str] = []
         with self._mu:
             # every window but the last is a FAST alerting window (the
             # short ones confirm the long ones); the last is the slow
@@ -352,6 +368,7 @@ class SLOEngine:
                 breaching = fast_over == n_fast
                 if breaching and not tr.breaching:
                     self._c_breach.inc(labels={"slo": spec.name})
+                    fired.append(spec.name)
                 tr.breaching = breaching
                 self._g_breaching.set(
                     1.0 if breaching else 0.0, labels={"slo": spec.name})
@@ -368,6 +385,14 @@ class SLOEngine:
                 }
             if self.ledger is not None:
                 out["budget_ledger"] = self.ledger.evaluate()
+        # listeners run OUTSIDE the engine lock: the flight recorder reads
+        # registries/profiler and must never deadlock a concurrent tick
+        for name in fired:
+            for fn in self._breach_listeners:
+                try:
+                    fn(name, out)
+                except Exception:  # noqa: BLE001 - evidence capture must
+                    pass           # never fail the evaluation loop
         return out
 
     def breaches(self, slo: str) -> int:
@@ -413,15 +438,21 @@ class BudgetLedger:
     def for_rest_path(cfg, profiler, registry: Registry,
                       target_ms: float | None = None,
                       budgets: Mapping[str, float] | None = None,
-                      ) -> "BudgetLedger":
+                      telemetry=None) -> "BudgetLedger":
         """The REST-path ledger ROADMAP item 1 decomposes against:
         transport floor (static, the r04 ``rest_latency_floor`` number),
         batcher wait + device dispatch (measured via the profiler), and
-        the H2D staging placeholder. Default budget slices: transport
-        gets 2x its floor (min-clamped to 0.2 ms — the clamp binds at
-        the shipped 0.072 ms floor), H2D a fixed 0.5 ms reservation, and
-        the remainder splits 60/40 dispatch/batcher-wait; a CR
-        ``budget:`` mapping overrides any slice."""
+        the H2D staging layer. Default budget slices: transport gets 2x
+        its floor (min-clamped to 0.2 ms — the clamp binds at the shipped
+        0.072 ms floor), H2D a fixed 0.5 ms slice, and the remainder
+        splits 60/40 dispatch/batcher-wait; a CR ``budget:`` mapping
+        overrides any slice.
+
+        ``telemetry`` (observability/device.py DeviceTelemetry): when the
+        device plane is armed, the ``h2d`` layer reads the MEASURED
+        per-transfer digest from the scorer's instrumented staging path;
+        without it the layer keeps the explicit-zero reservation so the
+        ledger schema (and the planner's view) is stable either way."""
         target = float(target_ms if target_ms is not None
                        else cfg.slo_rest_target_ms)
         floor_ms = float(cfg.slo_transport_floor_ms)
@@ -431,6 +462,22 @@ class BudgetLedger:
         remainder = max(target - transport_b - h2d_b, 1.0)
         dispatch_b = float(b.get("dispatch", 0.6 * remainder))
         wait_b = float(b.get("batcher_wait", 0.4 * remainder))
+
+        def h2d_fetch():
+            if telemetry is not None:
+                # measured: each sample is one staging put on the scorer
+                # dispatch path (ccfd_h2d_seconds' digest twin). NOTE:
+                # this digest is PROCESS-WIDE — the operator arms one
+                # telemetry plane and one scorer serves both the router
+                # and REST lanes, so unlike the lane-scoped rest.batcher/
+                # rest.dispatch digests it folds bus-lane puts in too.
+                # Read it as an upper bound on the REST lane's per-put
+                # staging cost until puts carry lane context.
+                return telemetry.h2d_digest()
+            # telemetry disarmed: the pre-telemetry reservation, an
+            # explicit zero rather than an absence (regression-tested)
+            return 0.0
+
         return BudgetLedger(
             "rest-p99", target, registry,
             layers=[
@@ -439,11 +486,7 @@ class BudgetLedger:
                  lambda: profiler.digest("rest.batcher", "queue")),
                 ("dispatch", dispatch_b,
                  lambda: profiler.digest("rest.dispatch", "dispatch")),
-                # H2D staging is not separately measurable until the
-                # pinned-host staging buffers land (ROADMAP item 1); the
-                # layer exists NOW so the ledger schema is stable and the
-                # planner sees an explicit zero, not an absence
-                ("h2d", h2d_b, lambda: 0.0),
+                ("h2d", h2d_b, h2d_fetch),
             ])
 
     def evaluate(self) -> dict[str, Any]:

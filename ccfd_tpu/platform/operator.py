@@ -57,6 +57,12 @@ _COMPONENTS = (
     "slo",        # stage profiler + SLO engine: queueing/service/dispatch
                   # decomposition, burn-rate monitoring, budget ledger
                   # (new; observability/profile.py, observability/slo.py)
+    "device",     # device & transfer telemetry: per-device memory gauges,
+                  # measured H2D accounting, executable inventory,
+                  # /debug/profile capture (new; observability/device.py)
+    "incident",   # SLO-breach incident flight recorder: snapshot ring +
+                  # schema-validated post-mortem bundles served at
+                  # /incidents (new; observability/incident.py)
 )
 
 
@@ -128,6 +134,9 @@ class Platform:
         self.trace_sink = None  # observability/trace.SpanSink when enabled
         self.profiler = None    # observability/profile.StageProfiler
         self.slo = None         # observability/slo.SLOEngine when enabled
+        self.device = None      # observability/device.DeviceTelemetry
+        self.recorder = None    # observability/incident.FlightRecorder
+        self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
         self.investigator = None
@@ -245,6 +254,20 @@ class Platform:
             if bool(slo_spec.opt("compile_events", True)):
                 self.profiler.arm_compile_listener()
 
+        # 0d. device & transfer telemetry (observability/device.py): ONE
+        # plane for the whole platform — the scorer built below stages
+        # through it (measured H2D), the exporter refreshes its per-device
+        # memory gauges on every scrape, and the SLO engine's budget
+        # ledger (7c) reads its transfer digest in place of the h2d
+        # reservation. CCFD_DEVICE=0 (or CR device.enabled: false) kills
+        # the plane; everything downstream then keeps the pre-telemetry
+        # fallbacks.
+        dev_spec = spec.component("device")
+        if dev_spec.enabled and cfg.device_enabled:
+            from ccfd_tpu.observability.device import DeviceTelemetry
+
+            self.device = DeviceTelemetry(registry=self._registry("device"))
+
         # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
         if spec.component("store").enabled:
             self._up_store()
@@ -349,6 +372,7 @@ class Platform:
             self.slo = SLOEngine.from_config(
                 cfg, self.registries, self._registry("slo"),
                 profiler=self.profiler, options=slo_spec.options,
+                telemetry=self.device,
             )
             interval = float(slo_spec.opt("interval_s", cfg.slo_interval_s))
             self.supervisor.add_thread_service(
@@ -357,6 +381,43 @@ class Platform:
                 self.slo.stop,
                 policy=RestartPolicy.ALWAYS,
                 reset=self.slo.reset,
+            )
+
+        # 7d. incident flight recorder (observability/incident.py): the
+        #     bounded snapshot ring runs as a supervised service; the SLO
+        #     engine's breach edge dumps a schema-validated bundle, and a
+        #     dispatch-watchdog kill snapshots into the ring. Served at
+        #     the exporter's /incidents endpoints below. CCFD_INCIDENT=0
+        #     (or CR incident.enabled: false) kills the plane.
+        inc_spec = spec.component("incident")
+        if inc_spec.enabled and cfg.incident_enabled:
+            from ccfd_tpu.observability.incident import FlightRecorder
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            self.recorder = FlightRecorder(
+                self.registries,
+                registry=self._registry("incident"),
+                profiler=self.profiler,
+                telemetry=self.device,
+                sink=self.trace_sink,
+                ring=int(inc_spec.opt("ring", cfg.incident_ring)),
+                out_dir=(inc_spec.opt("dir", cfg.incident_dir) or None),
+                max_bundles=int(inc_spec.opt("max_bundles", 16)),
+                timeout_debounce_s=float(
+                    inc_spec.opt("timeout_debounce_s", 2.0)),
+            )
+            if self.slo is not None:
+                self.slo.add_breach_listener(self.recorder.on_breach)
+            if self._overload is not None:
+                self._overload.recorder = self.recorder
+            inc_interval = float(
+                inc_spec.opt("interval_s", cfg.incident_interval_s))
+            self.supervisor.add_thread_service(
+                "incident",
+                lambda: self.recorder.run(interval_s=inc_interval),
+                self.recorder.stop,
+                policy=RestartPolicy.ALWAYS,
+                reset=self.recorder.reset,
             )
 
         # 8. monitoring (README.md:487-537)
@@ -370,6 +431,8 @@ class Platform:
                 port=int(mon.opt("port", 0)),
                 sink=self.trace_sink,  # /traces + /traces/<id> endpoints
                 profiler=self.profiler,  # /profile StageProfile endpoint
+                telemetry=self.device,  # device gauges + /debug endpoints
+                recorder=self.recorder,  # /incidents + /incidents/<id>
             ).start()
             self._wire_memory_probes()
 
@@ -508,8 +571,12 @@ class Platform:
                 inflight=int(c.opt("seq_inflight", cfg.seq_inflight)),
                 len_buckets=tuple(
                     c.opt("seq_len_buckets", cfg.seq_len_buckets)),
+                telemetry=self.device,
             )
             self.scorer.warmup()
+            if self.device is not None:
+                self.device.register_executable_source(
+                    "seq", self.scorer.executable_grid)
             return
         params = None
         if c.opt("train_steps", 0):
@@ -528,8 +595,12 @@ class Platform:
             batch_sizes=cfg.batch_sizes,
             host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
             dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms(),
+            telemetry=self.device,
         )
         self.scorer.warmup()
+        if self.device is not None:
+            self.device.register_executable_source(
+                "scorer", self.scorer.executable_grid)
         if c.opt("rest", False):
             from ccfd_tpu.serving.server import PredictionServer
 
@@ -844,6 +915,9 @@ class Platform:
                 b.max_limit = min(b.max_limit, int(mi))
                 b.min_limit = min(b.min_limit, int(mi))
                 b.limit = min(b.limit, int(mi))
+        # kept for the incident recorder (7d): a dispatch-watchdog kill
+        # snapshots into the flight recorder's ring
+        self._overload = overload
         common = dict(
             host_score_fn=host_score_fn,
             breaker=breaker,

@@ -300,6 +300,11 @@ class OverloadControl:
         )
         self._dispatcher = None
         self._mu = threading.Lock()
+        # incident flight recorder (observability/incident.py): when wired
+        # by the operator, every watchdog kill snapshots the system state
+        # into the recorder's ring — post-mortem evidence for hung-
+        # dispatch kills, not only SLO breaches
+        self.recorder = None
 
     @staticmethod
     def from_config(cfg, registry, max_batch: int = 4096,
@@ -450,6 +455,11 @@ class OverloadControl:
         except ScorerTimeout:
             self._c_dispatch_timeout.inc()
             self.budget.observe(self.dispatch_deadline_s + self.budget.target_s)
+            if self.recorder is not None:
+                try:
+                    self.recorder.note_dispatch_timeout()
+                except Exception:  # noqa: BLE001 - evidence capture must
+                    pass           # never mask the timeout signal
             raise
 
 

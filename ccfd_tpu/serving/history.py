@@ -452,6 +452,7 @@ class SeqScorer:
         stripes: int = DEFAULT_STRIPES,
         inflight: int = DEFAULT_INFLIGHT,
         len_buckets: tuple | None = None,
+        telemetry: Any = None,
     ):
         """``mesh``: serve the seq dispatch over a device mesh — history
         batches split over the partitioned axes, params replicated (the
@@ -469,6 +470,16 @@ class SeqScorer:
 
         self.store = HistoryStore(length=length, max_customers=max_customers,
                                   stripes=stripes)
+        # device telemetry plane (observability/device.py): the seq
+        # dispatch ships (B, L, F) history batches whose transfer happens
+        # INSIDE the jitted call, so only the bytes are separately
+        # countable here (ccfd_h2d_bytes_total); the row scorer's explicit
+        # staging carries the timed samples
+        if telemetry is None:
+            from ccfd_tpu.observability import device as _device
+
+            telemetry = _device.get_default()
+        self.telemetry = telemetry
         self._dtype = (jnp.bfloat16 if compute_dtype == "bfloat16"
                        else jnp.float32)
         self.inflight = max(0, int(inflight))
@@ -621,13 +632,16 @@ class SeqScorer:
             # old graph meanwhile, so the hot path never pays an XLA
             # compile (which could outlive the dispatch watchdog deadline
             # and roll back the candidate that was just promoted)
+            from ccfd_tpu.observability.profile import compile_stage
+
             new_apply = self._make_apply(quantized)
-            for b in self.batch_sizes:
-                for lb in self.len_buckets:
-                    xs = np.zeros((b, lb, self.store.num_features),
-                                  np.float32)
-                    self._jax.block_until_ready(
-                        new_apply(params, self._put_hist(xs)))
+            with compile_stage("seq.swap"):
+                for b in self.batch_sizes:
+                    for lb in self.len_buckets:
+                        xs = np.zeros((b, lb, self.store.num_features),
+                                      np.float32)
+                        self._jax.block_until_ready(
+                            new_apply(params, self._put_hist(xs)))
         with self._params_lock:
             self.params = params
             if new_apply is not None:
@@ -637,11 +651,32 @@ class SeqScorer:
     def warmup(self) -> None:
         """Compile every (B bucket, L bucket) executable the ladder can
         dispatch — the re-trace-stable static shape set."""
-        for b in self.batch_sizes:
-            for lb in self.len_buckets:
-                xs = np.zeros((b, lb, self.store.num_features), np.float32)
-                self._jax.block_until_ready(
-                    self._apply(self.params, self._put_hist(xs)))
+        from ccfd_tpu.observability.profile import compile_stage
+
+        with compile_stage("seq.warmup"):
+            for b in self.batch_sizes:
+                for lb in self.len_buckets:
+                    xs = np.zeros((b, lb, self.store.num_features),
+                                  np.float32)
+                    self._jax.block_until_ready(
+                        self._apply(self.params, self._put_hist(xs)))
+
+    def executable_grid(self) -> dict:
+        """The (L, B) executable grid with per-executable dispatch counts
+        — the seq family's entry in the device telemetry inventory."""
+        grid = []
+        for lb in self.len_buckets:
+            for b in self.batch_sizes:
+                entry: dict = {"l_bucket": int(lb), "b_bucket": int(b)}
+                if self._c_bucket is not None:
+                    entry["dispatches"] = int(self._c_bucket.value(
+                        {"l_bucket": str(lb), "b_bucket": str(b)}))
+                grid.append(entry)
+        return {
+            "model": "seq_q8" if self._quantized else "seq",
+            "length": int(self.store.length),
+            "grid": grid,
+        }
 
     def _bucket(self, n: int) -> int:
         for b in self.batch_sizes:
@@ -766,6 +801,8 @@ class SeqScorer:
                     # and returns; the next group assembles while it runs.
                     dev = apply_fn(params, self._put_hist(sub))
                     t_disp += time.perf_counter() - t0
+                    if self.telemetry is not None:
+                        self.telemetry.record_h2d(sub.nbytes)
                     pending.append((dev, sub_idx + start, m))
                     if self._c_bucket is not None:
                         self._c_bucket.inc(labels={

@@ -74,10 +74,22 @@ class Scorer:
         param_partition: str = "replicated",
         host_tier_rows: int | None = None,
         dispatch_deadline_ms: float | None = None,
+        telemetry: Any = None,
     ):
         self.spec: ModelSpec = get_model(model_name)
         self.num_features = num_features
         self.mesh = mesh
+        # device telemetry plane (observability/device.py): when armed,
+        # every staging put on the dispatch path is timed + byte-counted
+        # (ccfd_h2d_bytes_total / ccfd_h2d_seconds — the measured numbers
+        # the BudgetLedger's h2d layer reads). None resolves through the
+        # module default so harnesses (bench) arm scorers built deep
+        # inside helpers; the operator passes its instance explicitly.
+        if telemetry is None:
+            from ccfd_tpu.observability import device as _device
+
+            telemetry = _device.get_default()
+        self.telemetry = telemetry
         if param_partition not in ("replicated", "model"):
             raise ValueError(f"unknown param_partition {param_partition!r}")
         if param_partition == "model" and model_name != "mlp":
@@ -292,10 +304,18 @@ class Scorer:
         return jax.device_put(folded, replicated(self.mesh))
 
     def _put_batch(self, chunk: np.ndarray) -> jax.Array:
-        """H2D with placement: on a mesh each chip gets only its row shard."""
+        """H2D with placement: on a mesh each chip gets only its row shard.
+        With the device telemetry plane armed the put is timed and byte-
+        counted (the measured H2D accounting; two perf_counter reads)."""
         if self._batch_sharding is None:
-            return jnp.asarray(chunk)
-        return jax.device_put(chunk, self._batch_sharding)
+            put = lambda: jnp.asarray(chunk)  # noqa: E731
+        else:
+            put = lambda: jax.device_put(chunk, self._batch_sharding)  # noqa: E731
+        if self.telemetry is None:
+            return put()
+        from ccfd_tpu.observability.device import timed_put
+
+        return timed_put(self.telemetry, chunk.nbytes, put)
 
     def _fused_apply(self, fused_params: Any, x: jax.Array) -> jax.Array:
         rows = x.shape[0] if self.mesh is None else x.shape[0] // self._data_size
@@ -327,8 +347,19 @@ class Scorer:
         if self._preq_wire and preq_norm is not None and self.mesh is None:
             q, s = self._fused_mod.prequantize_rows_numpy(preq_norm, chunk)
             tile = self._fused_mod.fit_tile(q.shape[0])
+            if self.telemetry is None:
+                qd, sd = jnp.asarray(q), jnp.asarray(s)
+            else:
+                from ccfd_tpu.observability.device import timed_put
+
+                # the int8 wire's whole point is fewer H2D bytes — count
+                # the bytes actually shipped, not the f32 equivalent
+                qd = timed_put(self.telemetry, q.nbytes,
+                               lambda: jnp.asarray(q))
+                sd = timed_put(self.telemetry, s.nbytes,
+                               lambda: jnp.asarray(s))
             return self._fused_mod.fused_mlp_q8_score_preq(
-                fused_params, jnp.asarray(q), jnp.asarray(s), tile=tile,
+                fused_params, qd, sd, tile=tile,
                 interpret=self._fused_interpret,
             )
         return self._fused_apply(
@@ -382,6 +413,19 @@ class Scorer:
     def fused(self) -> bool:
         return self._fused_params is not None
 
+    def executable_grid(self) -> dict:
+        """The compiled-executable set this scorer serves from — the row
+        family's entry in the device telemetry plane's inventory (the seq
+        family reports its (L, B) grid the same way)."""
+        return {
+            "model": self.spec.name,
+            "batch_sizes": list(self.batch_sizes),
+            "fused": self.fused,
+            "int8_wire": bool(self._preq_wire
+                              and self._preq_norm is not None),
+            "host_tier_rows": self.host_tier_rows,
+        }
+
     def warmup(self) -> None:
         """Compile every bucket (and measure the host-tier crossover).
 
@@ -390,8 +434,16 @@ class Scorer:
         marks the device wedged after ``CCFD_WARMUP_DEADLINE_S`` (default
         180 s — first XLA compile through a tunnel runs tens of seconds) and
         serving starts in host-fallback mode instead of hanging."""
+        from ccfd_tpu.observability.profile import compile_stage
+
+        def body() -> None:
+            # compile attribution: warmup compiles the whole bucket grid;
+            # the label rides the contextvar on whichever thread runs it
+            with compile_stage("scorer.warmup"):
+                self._warmup_body()
+
         if self._dispatcher is None:
-            self._warmup_body()
+            body()
             return
         import os as _os
 
@@ -399,7 +451,7 @@ class Scorer:
 
         budget_s = float(_os.environ.get("CCFD_WARMUP_DEADLINE_S", "180"))
         try:
-            self._dispatcher.call(self._warmup_body, budget_s)
+            self._dispatcher.call(body, budget_s)
         except ScorerTimeout:
             self.dispatch_timeouts += 1
             self._wedge.mark_wedged()

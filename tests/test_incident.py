@@ -1,0 +1,273 @@
+"""Incident flight recorder (observability/incident.py): ring boundedness,
+breach edge-trigger -> exactly-one-bundle (re-breach after recovery dumps
+again), exporter /incidents contract, crash-safe bundle writes, schema
+validation, and the dispatch-watchdog ring hook."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ccfd_tpu.metrics.exporter import MetricsExporter
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.incident import (
+    INCIDENT_SCHEMA,
+    FlightRecorder,
+    validate_incident,
+)
+from ccfd_tpu.observability.profile import StageProfiler
+from ccfd_tpu.observability.slo import SLOEngine, SLOSpec
+
+
+def _engine_and_recorder(tmp_path=None, ring=8):
+    regs = {"router": Registry(), "slo": Registry(),
+            "incident": Registry()}
+    hist = regs["router"].histogram("lat_seconds", "x")
+    spec = SLOSpec("rest-p99", kind="latency", metric="lat_seconds",
+                   target_ms=25.0, objective=0.99)
+    clock = [0.0]
+    engine = SLOEngine(
+        [spec], regs, registry=regs["slo"],
+        windows=((3.0, 14.4), (6.0, 14.4), (20.0, 1.0)),
+        clock=lambda: clock[0],
+    )
+    recorder = FlightRecorder(
+        regs, registry=regs["incident"],
+        profiler=StageProfiler(), ring=ring,
+        out_dir=str(tmp_path) if tmp_path is not None else None,
+        clock=lambda: clock[0],
+    )
+    engine.add_breach_listener(recorder.on_breach)
+    return engine, recorder, hist, clock, regs
+
+
+def _burn(hist, n=100, bad=True):
+    hist.observe_many([0.2 if bad else 0.001] * n)
+
+
+class TestRing:
+    def test_bounded(self):
+        _eng, rec, _h, _clock, _regs = _engine_and_recorder(ring=4)
+        for i in range(10):
+            rec.snapshot(reason=f"r{i}")
+        assert len(rec.ring) == 4
+        assert [s["reason"] for s in rec.ring] == ["r6", "r7", "r8", "r9"]
+
+    def test_snapshot_contents_and_deltas(self):
+        _eng, rec, hist, _clock, regs = _engine_and_recorder()
+        regs["router"].counter("transaction_incoming_total").inc(100)
+        s1 = rec.snapshot()
+        regs["router"].counter("transaction_incoming_total").inc(50)
+        s2 = rec.snapshot()
+        assert s1["counters"]["transaction_incoming_total"] == 100
+        assert s2["counter_deltas"]["transaction_incoming_total"] == 50
+        assert "gauges" in s1 and "memory" in s1
+        assert s1["memory"]["rss_bytes"] > 0
+
+    def test_ring_gauge_and_reason_counter(self):
+        _eng, rec, _h, _clock, regs = _engine_and_recorder()
+        rec.snapshot()
+        rec.note_dispatch_timeout()
+        reg = regs["incident"]
+        assert reg.gauge("ccfd_incident_ring_size").value() == 2
+        assert reg.counter("ccfd_incident_snapshots_total").value(
+            {"reason": "dispatch_timeout"}) == 1
+
+
+class TestBreachEdge:
+    def test_exactly_one_bundle_then_rebreach_dumps_again(self, tmp_path):
+        engine, rec, hist, clock, _regs = _engine_and_recorder(tmp_path)
+        _burn(hist, bad=False)
+        clock[0] = 1.0
+        engine.tick()
+        assert rec.incidents() == []
+
+        _burn(hist, bad=True)
+        clock[0] = 2.0
+        engine.tick()
+        assert len(rec.incidents()) == 1
+        # still breaching on later ticks: edge-triggered, no second bundle
+        _burn(hist, bad=True)
+        clock[0] = 3.0
+        engine.tick()
+        clock[0] = 4.0
+        engine.tick()
+        assert len(rec.incidents()) == 1
+        assert engine.breaches("rest-p99") == 1
+
+        # recovery: the bad window ages out of the 3 s/6 s fast pair
+        clock[0] = 30.0
+        _burn(hist, bad=False)
+        engine.tick()
+        assert not engine.tick()["slos"]["rest-p99"]["breaching"]
+
+        # re-breach after recovery: a NEW incident, a second bundle
+        _burn(hist, n=200, bad=True)
+        clock[0] = 31.0
+        engine.tick()
+        assert engine.breaches("rest-p99") == 2
+        assert len(rec.incidents()) == 2
+
+    def test_bundle_shape_and_validation(self, tmp_path):
+        engine, rec, hist, clock, _regs = _engine_and_recorder(tmp_path)
+        rec.snapshot()  # pre-incident flight data
+        _burn(hist)
+        clock[0] = 2.0
+        engine.tick()
+        (summary,) = rec.incidents()
+        doc = rec.incident_doc(summary["id"])
+        assert doc["schema"] == INCIDENT_SCHEMA
+        assert doc["trigger"] == {"type": "slo_breach", "slo": "rest-p99"}
+        assert doc["slo_status"]["slos"]["rest-p99"]["breaching"]
+        assert len(doc["ring"]) >= 2  # the pre-snapshot + the live one
+        assert validate_incident(doc) == []
+        # persisted copy parses to the same bundle
+        with open(doc["path"]) as f:
+            assert json.load(f)["id"] == doc["id"]
+
+    def test_max_bundles_pruned_with_files(self, tmp_path):
+        _eng, rec, _h, _clock, _regs = _engine_and_recorder(tmp_path)
+        rec.max_bundles = 2
+        for _ in range(4):
+            rec.incident({"type": "slo_breach", "slo": "x"})
+        assert len(rec.incidents()) == 2
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+        assert len(files) == 2
+
+
+class TestCrashSafety:
+    def test_torn_write_leaves_previous_bundle_intact(self, tmp_path,
+                                                      monkeypatch):
+        _eng, rec, _h, _clock, _regs = _engine_and_recorder(tmp_path)
+        first = rec.incident({"type": "slo_breach", "slo": "a"})
+        path = first["path"]
+        with open(path) as f:
+            before = f.read()
+        # crash mid-write of a LATER artifact to the same path: os.replace
+        # never runs, the tmp file holds the torn bytes, the original is
+        # untouched
+        import ccfd_tpu.observability.profile as profile_mod
+
+        real_dump = json.dump
+
+        def torn_dump(doc, f, **kw):
+            f.write('{"torn": ')
+            raise OSError("disk full")
+
+        monkeypatch.setattr(profile_mod.json, "dump", torn_dump)
+        with pytest.raises(OSError):
+            profile_mod.write_json_crash_safe(path, {"x": 1})
+        monkeypatch.setattr(profile_mod.json, "dump", real_dump)
+        with open(path) as f:
+            assert f.read() == before
+        assert json.load(open(path))["id"] == first["id"]
+
+    def test_memory_only_mode_serves_without_disk(self):
+        _eng, rec, _h, _clock, _regs = _engine_and_recorder(tmp_path=None)
+        doc = rec.incident({"type": "slo_breach", "slo": "a"})
+        assert "path" not in doc
+        assert rec.incident_doc(doc["id"]) is not None
+
+
+class TestExporterContract:
+    def test_incidents_http_contract(self, tmp_path):
+        engine, rec, hist, clock, regs = _engine_and_recorder(tmp_path)
+        ex = MetricsExporter(regs, recorder=rec).start()
+        try:
+            # empty list is strict JSON, 200
+            with urllib.request.urlopen(
+                    ex.endpoint + "/incidents", timeout=10) as r:
+                assert r.status == 200
+                assert json.loads(r.read().decode()) == {"incidents": []}
+            _burn(hist)
+            clock[0] = 2.0
+            engine.tick()
+            with urllib.request.urlopen(
+                    ex.endpoint + "/incidents", timeout=10) as r:
+                listing = json.loads(r.read().decode())
+            assert len(listing["incidents"]) == 1
+            inc_id = listing["incidents"][0]["id"]
+            with urllib.request.urlopen(
+                    ex.endpoint + f"/incidents/{inc_id}", timeout=10) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                doc = json.loads(r.read().decode())
+            assert validate_incident(doc) == []
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    ex.endpoint + "/incidents/inc-bogus", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            ex.stop()
+
+    def test_incidents_404_without_recorder(self):
+        ex = MetricsExporter({"r": Registry()}).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(ex.endpoint + "/incidents",
+                                       timeout=10)
+            assert ei.value.code == 404
+        finally:
+            ex.stop()
+
+
+class TestValidation:
+    def test_named_failures(self):
+        assert validate_incident(None) == ["document: not a mapping"]
+        errs = validate_incident({"schema": "wrong"})
+        assert any(e.startswith("schema:") for e in errs)
+        assert any(e.startswith("id:") for e in errs)
+        assert any(e.startswith("trigger:") for e in errs)
+        assert any(e.startswith("ring:") for e in errs)
+
+    def test_embedded_profile_validated(self):
+        _eng, rec, _h, _clock, _regs = _engine_and_recorder()
+        doc = rec.incident({"type": "slo_breach", "slo": "a"})
+        doc = dict(doc)
+        doc["stage_profile"] = {"schema": "nope"}
+        assert any(e.startswith("stage_profile.") for e in
+                   validate_incident(doc))
+
+
+class TestWatchdogHook:
+    def test_dispatch_timeout_snapshots_into_ring(self):
+        from ccfd_tpu.runtime.overload import (
+            AdaptiveInflightBudget,
+            OverloadControl,
+        )
+        from ccfd_tpu.serving.dispatch import ScorerTimeout
+
+        reg = Registry()
+        regs = {"router": reg}
+        ov = OverloadControl(
+            reg, AdaptiveInflightBudget(100, registry=reg, stage="router"),
+            dispatch_deadline_ms=50.0)
+        rec = FlightRecorder(regs, registry=reg, ring=4)
+        ov.recorder = rec
+        with pytest.raises(ScorerTimeout):
+            ov.bounded_dispatch(lambda: time.sleep(0.5))
+        assert reg.counter("ccfd_dispatch_timeout_total").value() == 1
+        assert [s["reason"] for s in rec.ring] == ["dispatch_timeout"]
+        # the snapshot already carries the trip in its counters
+        assert rec.ring[0]["counters"]["ccfd_dispatch_timeout_total"] == 1
+
+    def test_timeout_storm_debounced(self):
+        clock = [0.0]
+        rec = FlightRecorder({"r": Registry()}, ring=8,
+                             timeout_debounce_s=2.0,
+                             clock=lambda: clock[0])
+        rec.snapshot("periodic")  # pre-incident context must survive
+        for i in range(20):  # a wedge trips every worker at deadline rate
+            clock[0] = 0.1 * i
+            rec.note_dispatch_timeout()
+        reasons = [s["reason"] for s in rec.ring]
+        # one snapshot per debounce window, ring keeps the history
+        assert reasons == ["periodic", "dispatch_timeout"]
+        clock[0] = 5.0
+        rec.note_dispatch_timeout()
+        assert [s["reason"] for s in rec.ring][-1] == "dispatch_timeout"
+        assert len(rec.ring) == 3

@@ -90,6 +90,15 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_stage_latency_ms",
     "ccfd_xla_compile_events_total",
     "ccfd_xla_compile_seconds_total",
+    # round 13: device & transfer telemetry + incident flight recorder
+    # (observability/device.py, observability/incident.py)
+    "ccfd_device_memory_bytes",
+    "ccfd_h2d_bytes_total",
+    "ccfd_h2d_seconds",
+    "ccfd_compile_stage_seconds_total",
+    "ccfd_incident_snapshots_total",
+    "ccfd_incidents_total",
+    "ccfd_incident_ring_size",
 ]
 
 
@@ -107,7 +116,7 @@ def test_dashboards_cover_contract_metrics():
     assert set(boards) == {
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
-        "ModelLifecycle", "Overload", "SeqServing", "SLO",
+        "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

@@ -423,6 +423,23 @@ def main() -> int:
                 max_score_psi=10.0, min_submit_interval_s=0.0),
             registry=reg_r, breaker=lifecycle_breaker)
         score_fn = lifecycle.wrap_score(score_fn)
+    # -- incident flight recorder + dispatch watchdog (ISSUE 10) -----------
+    # The router-side watchdog (runtime/overload.py bounded_dispatch) gets
+    # a deadline BELOW the scorer's own, so the midpoint wedge trips
+    # ccfd_dispatch_timeout_total — and every trip snapshots the system
+    # state into the FlightRecorder ring: watchdog kills leave post-mortem
+    # flight data, not only SLO breaches.
+    from ccfd_tpu.observability.incident import FlightRecorder
+    from ccfd_tpu.runtime.overload import OverloadControl
+
+    recorder = FlightRecorder({"router": reg_r, "kie": reg_k},
+                              registry=reg_r, ring=32)
+    overload = OverloadControl.from_config(
+        cfg, reg_r, max_batch=4096, workers=max(1, args.workers))
+    if overload is not None:
+        overload.dispatch_deadline_s = max(0.05,
+                                           args.deadline_ms * 0.8 / 1e3)
+        overload.recorder = recorder
     if args.workers > 1:
         # partition-parallel fan-out: the workers split the topic's
         # partitions, share ONE in-flight budget + breaker + coalescing
@@ -435,12 +452,14 @@ def main() -> int:
             cfg, broker, score_fn, engine, reg_r, workers=args.workers,
             max_batch=4096, host_score_fn=host_fn,
             breaker=lifecycle_breaker,
-            degrade=True if args.net_faults else None)
+            degrade=True if args.net_faults else None,
+            overload=overload)
     else:
         router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
                         host_score_fn=host_fn,
                         breaker=lifecycle_breaker,
-                        degrade=True if args.net_faults else None)
+                        degrade=True if args.net_faults else None,
+                        overload=overload)
     coord = CheckpointCoordinator(router, broker, engine_factory,
                                   interval_s=args.checkpoint_s)
     sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
@@ -866,6 +885,16 @@ def main() -> int:
         "bus_reopen_check": bus_check,
         "dispatch_timeouts": scorer.dispatch_timeouts,
         "host_fallback_scores": scorer.host_fallback_scores,
+        # flight-recorder evidence (observability/incident.py): every
+        # router-watchdog kill must have snapshotted into the ring
+        "flight_recorder": {
+            "watchdog_timeouts": int(reg_r.counter(
+                "ccfd_dispatch_timeout_total").value()),
+            "ring_snapshots": len(recorder.ring),
+            "dispatch_timeout_snapshots": sum(
+                1 for s in recorder.ring
+                if s.get("reason") == "dispatch_timeout"),
+        },
         "lifecycle": lifecycle_res,
         "tasks_completed_by_investigators": investigator.completed,
         "net_faults": {
@@ -903,9 +932,14 @@ def main() -> int:
     sup.stop()
     broker.close()
     print(json.dumps(result))
+    fr = result["flight_recorder"]
     ok = (
         total > 0
         and wedge_info.get("device_path_recovered", False)
+        # a watchdog kill without a ring snapshot would be exactly the
+        # un-post-mortem-able kill ISSUE 10 closes
+        and (fr["watchdog_timeouts"] == 0
+             or fr["dispatch_timeout_snapshots"] > 0)
         and wedge_info.get("healed_at_tx", 0) > wedge_info.get("wedged_at_tx", 0)
         and result["engine_kills"] > 0
         and coord.restores > 0

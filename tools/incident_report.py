@@ -1,0 +1,163 @@
+"""Render a ccfd.incident.v1 bundle into the human post-mortem summary.
+
+The FlightRecorder (observability/incident.py) dumps machine-readable
+incident bundles; this tool is the responder's first read — what
+breached, how hard it was burning, which layer/stage ate the latency,
+what the breakers/overload plane/device were doing, and how much flight
+data the ring holds.
+
+    python tools/incident_report.py <bundle.json>          # from disk
+    python tools/incident_report.py --url http://host:9100 # newest bundle
+    python tools/incident_report.py --url ... --id inc-0001-rest-p99
+    python tools/incident_report.py <bundle.json> --json   # machine form
+
+Exit codes: 0 rendered a valid bundle, 2 missing/unreadable, 3 the
+bundle fails schema validation (still rendered best-effort).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ccfd_tpu.observability.incident import validate_incident  # noqa: E402
+
+
+def _fetch(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def load_bundle(args) -> dict | None:
+    if args.url:
+        inc_id = args.id
+        if inc_id is None:
+            listing = _fetch(args.url.rstrip("/") + "/incidents")
+            incidents = listing.get("incidents", [])
+            if not incidents:
+                print("no incidents recorded", file=sys.stderr)
+                return None
+            inc_id = incidents[0]["id"]  # newest first
+        return _fetch(args.url.rstrip("/") + f"/incidents/{inc_id}")
+    try:
+        with open(args.bundle) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read bundle {args.bundle!r}: {e}", file=sys.stderr)
+        return None
+
+
+def _top_stages(doc: dict, n: int = 5) -> list[tuple[str, str, float]]:
+    """(stage, component, p99_ms) sorted worst-first from the bundle's
+    full stage profile."""
+    out = []
+    sp = doc.get("stage_profile") or {}
+    for stage, entry in (sp.get("stages") or {}).items():
+        for comp in ("queue", "service", "dispatch"):
+            d = entry.get(comp)
+            if isinstance(d, dict) and d.get("count"):
+                out.append((stage, comp, float(d.get("p99_ms", 0.0))))
+    return sorted(out, key=lambda t: -t[2])[:n]
+
+
+def render(doc: dict) -> str:
+    lines = []
+    trig = doc.get("trigger", {})
+    when = doc.get("generated_unix")
+    when_s = (time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(when))
+              if isinstance(when, (int, float)) else "?")
+    lines.append(f"INCIDENT {doc.get('id', '?')}  [{when_s}]")
+    lines.append(f"  trigger: {trig.get('type', '?')}"
+                 + (f" slo={trig['slo']}" if trig.get("slo") else ""))
+    slos = doc.get("slo_status", {}).get("slos", {})
+    for name, s in slos.items():
+        burns = ", ".join(f"{w}={b}" for w, b in
+                          (s.get("burn_rate") or {}).items())
+        flag = "BREACHING" if s.get("breaching") else "ok"
+        lines.append(f"  slo {name}: {flag}  burn[{burns}]  "
+                     f"budget_remaining={s.get('error_budget_remaining')}")
+    ledger = doc.get("slo_status", {}).get("budget_ledger")
+    if ledger:
+        lines.append(f"  budget ledger ({ledger.get('slo')}, "
+                     f"target {ledger.get('target_ms')} ms):")
+        for lname, e in (ledger.get("layers") or {}).items():
+            kind = "static" if e.get("static") else f"n={e.get('count', 0)}"
+            lines.append(
+                f"    {lname:<13} spent p99 {e.get('spent_p99_ms', 0):>9} ms"
+                f" / budget {e.get('budget_ms', 0):>7} ms"
+                f"  (ratio {e.get('ratio', 0)}, {kind})")
+    top = _top_stages(doc)
+    if top:
+        lines.append("  worst stages (p99):")
+        for stage, comp, p99 in top:
+            lines.append(f"    {stage:<16} {comp:<9} {p99:>10.3f} ms")
+    snap = doc.get("snapshot", {})
+    gauges = snap.get("gauges", {})
+    breakers = gauges.get("ccfd_breaker_state")
+    if breakers:
+        lines.append("  breakers: " + ", ".join(
+            f"{k}={int(v)}" for k, v in breakers.items()))
+    dev = snap.get("device") or {}
+    h2d = dev.get("h2d") or {}
+    if h2d:
+        t = h2d.get("transfer") or {}
+        lines.append(f"  device h2d: {h2d.get('bytes_total', 0)} bytes "
+                     f"staged, {t.get('count', 0)} timed puts, "
+                     f"p99 {t.get('p99_ms', 'n/a')} ms")
+    mem = dev.get("memory") or {}
+    for device, kinds in mem.items():
+        lines.append(f"  device {device}: " + ", ".join(
+            f"{k}={v}" for k, v in kinds.items()))
+    ring = doc.get("ring", [])
+    reasons: dict[str, int] = {}
+    for s in ring:
+        reasons[s.get("reason", "?")] = reasons.get(s.get("reason", "?"), 0) + 1
+    lines.append(f"  flight ring: {len(ring)} snapshots "
+                 + (f"({', '.join(f'{k}x{v}' for k, v in reasons.items())})"
+                    if reasons else ""))
+    if doc.get("validation_errors"):
+        lines.append(f"  !! validation errors: {doc['validation_errors']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", nargs="?", help="bundle JSON path")
+    ap.add_argument("--url", default="",
+                    help="exporter endpoint; fetch over HTTP instead")
+    ap.add_argument("--id", default=None,
+                    help="incident id (with --url; default: newest)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine summary instead of prose")
+    args = ap.parse_args(argv)
+    if not args.url and not args.bundle:
+        ap.error("need a bundle path or --url")
+    doc = load_bundle(args)
+    if doc is None:
+        return 2
+    errs = validate_incident(doc)
+    if args.json:
+        print(json.dumps({
+            "id": doc.get("id"),
+            "trigger": doc.get("trigger"),
+            "valid": not errs,
+            "errors": errs[:10],
+            "ring_depth": len(doc.get("ring", [])),
+            "slos": {n: s.get("breaching")
+                     for n, s in doc.get("slo_status", {})
+                     .get("slos", {}).items()},
+        }))
+    else:
+        print(render(doc))
+        if errs:
+            print(f"schema: INVALID ({len(errs)} problems)", file=sys.stderr)
+    return 3 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,7 +71,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 class Harness:
     def __init__(self, cr_path: str, windows: str, fault_ms: float,
-                 e2e_target_ms: float | None = None):
+                 e2e_target_ms: float | None = None,
+                 device: bool = False,
+                 incident_dir: str | None = None):
+        """``device=True`` arms the DeviceTelemetry plane (both scorers
+        stage through it, the ledger's h2d layer reads measured values);
+        ``incident_dir`` (may be "") additionally wires a FlightRecorder
+        to the engine's breach edge and the exporter's /incidents —
+        the incident smoke (tools/incident_smoke.py) reuses this harness
+        with both armed."""
         self.cfg = Config(slo_windows=windows)
         # the declarative SLO contract comes from the CR, not this harness
         spec = PlatformSpec.from_yaml(cr_path, cfg=self.cfg)
@@ -95,22 +103,40 @@ class Harness:
         self.profiler = StageProfiler(registry=self.regs["slo"],
                                       overload_registry=self.regs["router"])
         self.profiler.arm_compile_listener()
+        self.telemetry = None
+        if device:
+            from ccfd_tpu.observability.device import DeviceTelemetry
+
+            self.telemetry = DeviceTelemetry(registry=self.regs["slo"])
         self.engine = SLOEngine.from_config(
             self.cfg, self.regs, self.regs["slo"],
             profiler=self.profiler, options=self.slo_options,
+            telemetry=self.telemetry,
         )
+        self.recorder = None
+        if incident_dir is not None:
+            from ccfd_tpu.observability.incident import FlightRecorder
+
+            self.regs["incident"] = Registry()
+            self.recorder = FlightRecorder(
+                self.regs, registry=self.regs["incident"],
+                profiler=self.profiler, telemetry=self.telemetry,
+                ring=16, out_dir=incident_dir or None)
+            self.engine.add_breach_listener(self.recorder.on_breach)
 
         # -- pipeline lane (e2e-p99 + error-rate evidence; NO faults) -----
         self.broker = Broker(default_partitions=2)
         self.kie = build_engine(self.cfg, self.broker, self.regs["kie"], None)
-        scorer = Scorer(model_name="mlp", batch_sizes=(128, 1024, 4096))
+        scorer = Scorer(model_name="mlp", batch_sizes=(128, 1024, 4096),
+                        telemetry=self.telemetry)
         scorer.warmup()
         self.router = Router(self.cfg, self.broker, scorer.score, self.kie,
                              self.regs["router"], max_batch=1024,
                              profiler=self.profiler)
 
         # -- REST serving lane (rest-p99 evidence; fault target) ----------
-        rest_scorer = Scorer(model_name="mlp", batch_sizes=(16, 128, 1024))
+        rest_scorer = Scorer(model_name="mlp", batch_sizes=(16, 128, 1024),
+                             telemetry=self.telemetry)
         rest_scorer.warmup()
         self.fault_plan = FaultPlan(
             {"scorer_rest": FaultSpec(latency_ms=fault_ms)}, active=False)
@@ -132,7 +158,9 @@ class Harness:
         ]
         self.produced = 0
         self.exporter = MetricsExporter(self.regs, profiler=self.profiler,
-                                        sink=None).start()
+                                        sink=None,
+                                        telemetry=self.telemetry,
+                                        recorder=self.recorder).start()
 
     # -- drivers -----------------------------------------------------------
     def pump_pipeline(self, rows: int = 200) -> None:

@@ -41,6 +41,20 @@
 #                                          and the StageProfile artifact
 #                                          round-trips through /profile:
 #                                          SLOSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --incident-smoke exit-code-gated smoke of the
+#                                          incident plane
+#                                          (tools/incident_smoke.py): a
+#                                          fault-injected 200 ms scorer
+#                                          step breaches the rest SLO and
+#                                          dumps EXACTLY ONE schema-valid
+#                                          incident bundle whose stage
+#                                          profile blames the dispatch
+#                                          layer, round-tripped over real
+#                                          HTTP via /incidents/<id>, with
+#                                          the h2d budget layer reporting
+#                                          measured (non-placeholder)
+#                                          values:
+#                                          INCIDENTSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -77,6 +91,18 @@ if [ "${1:-}" = "--slo-smoke" ]; then
     # (see tools/slo_smoke.py; the script prints SLOSMOKE verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/slo_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--incident-smoke" ]; then
+    # exit-code-gated smoke of the incident flight recorder: breach ->
+    # exactly one schema-valid bundle over real HTTP, dispatch-layer
+    # attribution, measured h2d ledger values (see tools/incident_smoke.py;
+    # the script prints INCIDENTSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/incident_smoke.py; then
         exit 0
     fi
     exit 1
