@@ -217,6 +217,48 @@ class Config:
     # (tmp+rename)
     incident_dir: str = ""                 # CCFD_INCIDENT_DIR
 
+    # --- device self-healing (runtime/heal.py; CR block `heal:`) ---
+    # master switch for the DeviceSupervisor: per-device health state
+    # machine (HEALTHY -> SUSPECT -> QUARANTINED -> PROBATION), canary
+    # dispatches, the heal ladder and warm re-promotion (CCFD_HEAL; 0 is
+    # the emergency kill switch — the router ladder then falls back to
+    # breaker-only device gating)
+    heal_enabled: bool = True
+    # supervision tick (canary cadence while healthy; heal-ladder poll
+    # while quarantined)
+    heal_interval_s: float = 5.0           # CCFD_HEAL_INTERVAL_S
+    # hard deadline for one canary dispatch (rides the PR 6
+    # bounded_dispatch watchdog; a hung canary is killed, counted, and
+    # counts as a strike)
+    heal_canary_deadline_ms: float = 250.0  # CCFD_HEAL_CANARY_DEADLINE_MS
+    # consecutive strike-bearing ticks before SUSPECT escalates to
+    # QUARANTINED (1 = quarantine on the first bad tick)
+    heal_suspect_strikes: int = 2          # CCFD_HEAL_SUSPECT_STRIKES
+    # consecutive canary+parity passes PROBATION requires before the warm
+    # re-promotion flip returns serving to the device
+    heal_probation_canaries: int = 3       # CCFD_HEAL_PROBATION_CANARIES
+    # host-vs-device score-parity tolerance for the re-promotion gate
+    # (max abs probability delta; bf16-vs-f32 sits well under 0.05)
+    heal_parity_tol: float = 0.05          # CCFD_HEAL_PARITY_TOL
+    # allocator pressure ratio (bytes_in_use / bytes_limit) treated as
+    # OOM-pressure evidence
+    heal_oom_ratio: float = 0.92           # CCFD_HEAL_OOM_RATIO
+    # serving-stage XLA compiles per second treated as a compile storm
+    heal_compile_storm_per_s: float = 2.0  # CCFD_HEAL_COMPILE_STORM_PER_S
+    # heal-ladder backoff: jittered exponential from base to cap between
+    # attempts (canary retry -> backend reinit -> scorer respawn)
+    heal_backoff_base_s: float = 0.5       # CCFD_HEAL_BACKOFF_BASE_S
+    heal_backoff_cap_s: float = 30.0       # CCFD_HEAL_BACKOFF_CAP_S
+    # flap hysteresis: a re-quarantine inside this window of the last
+    # re-promotion starts the backoff ladder deeper each round
+    heal_flap_window_s: float = 60.0       # CCFD_HEAL_FLAP_WINDOW_S
+    # standing device-fault plan (CCFD_DEVICE_FAULTS,
+    # "device_hang:ms=400;put_fail" — runtime/faults.py device faults,
+    # injected at the scorer dispatch / staging-put / compile seams).
+    # "" = none. The chaos CR block's `device_faults` option is the
+    # storm-scheduled form of the same syntax.
+    device_faults_spec: str = ""
+
     # --- sequence serving (serving/history.py; CR block `scorer.seq_*`) ---
     # HistoryStore stripe count: per-stripe locks keep ParallelRouter
     # workers from convoying on one global lock (CCFD_SEQ_STRIPES)
@@ -395,6 +437,47 @@ class Config:
             ),
             slo_enabled=e.get("CCFD_SLO", "1").strip().lower()
             not in ("0", "false", "no", "off"),
+            heal_enabled=e.get("CCFD_HEAL", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            heal_interval_s=float(
+                e.get("CCFD_HEAL_INTERVAL_S", str(Config.heal_interval_s))
+            ),
+            heal_canary_deadline_ms=float(
+                e.get("CCFD_HEAL_CANARY_DEADLINE_MS",
+                      str(Config.heal_canary_deadline_ms))
+            ),
+            heal_suspect_strikes=int(
+                e.get("CCFD_HEAL_SUSPECT_STRIKES",
+                      str(Config.heal_suspect_strikes))
+            ),
+            heal_probation_canaries=int(
+                e.get("CCFD_HEAL_PROBATION_CANARIES",
+                      str(Config.heal_probation_canaries))
+            ),
+            heal_parity_tol=float(
+                e.get("CCFD_HEAL_PARITY_TOL", str(Config.heal_parity_tol))
+            ),
+            heal_oom_ratio=float(
+                e.get("CCFD_HEAL_OOM_RATIO", str(Config.heal_oom_ratio))
+            ),
+            heal_compile_storm_per_s=float(
+                e.get("CCFD_HEAL_COMPILE_STORM_PER_S",
+                      str(Config.heal_compile_storm_per_s))
+            ),
+            heal_backoff_base_s=float(
+                e.get("CCFD_HEAL_BACKOFF_BASE_S",
+                      str(Config.heal_backoff_base_s))
+            ),
+            heal_backoff_cap_s=float(
+                e.get("CCFD_HEAL_BACKOFF_CAP_S",
+                      str(Config.heal_backoff_cap_s))
+            ),
+            heal_flap_window_s=float(
+                e.get("CCFD_HEAL_FLAP_WINDOW_S",
+                      str(Config.heal_flap_window_s))
+            ),
+            device_faults_spec=e.get("CCFD_DEVICE_FAULTS",
+                                     Config.device_faults_spec),
             device_enabled=e.get("CCFD_DEVICE", "1").strip().lower()
             not in ("0", "false", "no", "off"),
             incident_enabled=e.get("CCFD_INCIDENT", "1").strip().lower()

@@ -569,6 +569,25 @@ class LifecycleController:
             self._c_rolled_back.inc()
         self._rebase_trainer()
 
+    def restore_champion(self) -> None:
+        """Re-assert the champion's CHECKPOINT as the serving params —
+        the device heal ladder's respawn rung (runtime/heal.py): a
+        quarantined scorer respawns from the durable champion checkpoint,
+        not from whatever tree the wedge left on device. Serialized under
+        the controller lock so a respawn racing a concurrent
+        rollback/promotion cannot interleave half of each swap: whichever
+        runs second re-asserts a complete, consistent champion tree (the
+        heal-vs-recovery invariant the PR 4 end-state assertion extends
+        to: serving params == champion checkpoint)."""
+        with self._mu:
+            champion = self.store.get(self.champion)
+            params = self._restore_params(champion)
+            self.scorer.swap_params(params)
+            self._champion_params = params
+            self.store.record_event(
+                self.champion, "heal_respawn_restore",
+                {"checkpoint": champion.checkpoint_step})
+
     def resolve_for_shutdown(self) -> None:
         """Deterministic quiesce: an in-flight candidate is withdrawn so
         the pool is left serving exactly one version (soak/drill

@@ -36,6 +36,10 @@ metric names, one builder per board:
   per-device memory by kind, measured H2D bytes/latency on the scorer
   staging path, per-stage compile attribution, and the incident plane's
   snapshot/bundle economics (new capability; no reference analog)
+- Heal         — device self-healing surface: per-device health state
+  machine, canary outcomes, heal-ladder attempts by rung, quarantine/
+  re-promotion incidents, and the warm-re-promotion compile proof
+  (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -597,6 +601,55 @@ def device_dashboard() -> dict:
     return _dashboard("CCFD Device", "ccfd-device", p)
 
 
+def heal_dashboard() -> dict:
+    """Device-heal board (round 14; runtime/heal.py).
+
+    The device-as-fallible-component surface: the per-device health state
+    machine (one-hot ``ccfd_device_health{device,state}`` — quarantined
+    is the alert), canary dispatch outcomes, heal-ladder attempts by rung
+    (canary retry → backend reinit → scorer respawn), quarantine /
+    re-promotion incident bundles, and the two proofs the re-promotion
+    contract makes: the host tier absorbing traffic while quarantined
+    (``router_degraded_total{tier="host"}``) and zero serving-stage XLA
+    compiles after the warm flip (compile-stage attribution)."""
+    p = [
+        _alert_stat(0, "Device quarantined now",
+                    ['max(ccfd_device_health{state="quarantined"})'],
+                    red_above=1),
+        _panel(1, "Device health state (one-hot by device)",
+               ["ccfd_device_health"]),
+        _panel(2, "Health transitions / s (by target state)",
+               ["rate(ccfd_heal_transitions_total[5m])"]),
+        _panel(3, "Canary outcomes / s",
+               ['rate(ccfd_heal_canary_total{outcome="pass"}[5m])',
+                'rate(ccfd_heal_canary_total{outcome="fail"}[5m])']),
+        _panel(4, "Heal-ladder attempts / s (by rung)",
+               ["rate(ccfd_heal_attempts_total[5m])"]),
+        _panel(5, "Quarantine / re-promotion bundles",
+               ['ccfd_incidents_total{trigger="device_quarantine"}',
+                'ccfd_incidents_total{trigger="device_repromote"}'],
+               "stat"),
+        _panel(6, "Host tier absorbing quarantined traffic (rows/s)",
+               ['rate(router_degraded_total{tier="host"}[5m])',
+                'rate(router_degraded_total{tier="rules"}[5m])']),
+        _alert_stat(7, "Serving-stage compiles / s (warm flip ⇒ 0)",
+                    # non-serving stages excluded: the warm step ITSELF
+                    # emits a heal.warm compile burst (that is the
+                    # contract working, not a violation) — same exclusion
+                    # set as the supervisor's compile-storm signal
+                    ['sum(rate(ccfd_compile_stage_seconds_total{stage!~"'
+                     'total|heal\\\\..*|scorer\\\\.warmup|seq\\\\.warmup|'
+                     'seq\\\\.swap"}[5m]))'],
+                    red_above=0.1),
+        _panel(8, "Compile seconds by stage (heal.warm = the warm step)",
+               ["ccfd_compile_stage_seconds_total"]),
+        _alert_stat(9, "H2D staging put failures / s",
+                    ["rate(ccfd_h2d_put_failures_total[5m])"],
+                    red_above=0.1),
+    ]
+    return _dashboard("CCFD Heal", "ccfd-heal", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -624,6 +677,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "SeqServing": seq_serving_dashboard(),
         "SLO": slo_dashboard(),
         "Device": device_dashboard(),
+        "Heal": heal_dashboard(),
     }
 
 

@@ -76,8 +76,10 @@ class DeviceTelemetry:
         # harnesses).
         self.sample_every = max(1, int(sample_every))
         self._put_seq = 0
+        self._put_failures = 0
         self._sources: dict[str, Callable[[], Any]] = {}
         self._g_mem = self._c_bytes = self._h_seconds = None
+        self._c_put_fail = None
         if registry is not None:
             self._g_mem = registry.gauge(
                 "ccfd_device_memory_bytes",
@@ -96,6 +98,12 @@ class DeviceTelemetry:
                 "wall time of one host->device staging put on the scorer "
                 "dispatch path",
                 buckets=H2D_BUCKETS,
+            )
+            self._c_put_fail = registry.counter(
+                "ccfd_h2d_put_failures_total",
+                "host->device staging puts that raised (real transfer "
+                "failures and injected put_fail device faults alike) — "
+                "one of the DeviceSupervisor's quarantine signals",
             )
 
     # -- H2D transfer accounting ------------------------------------------
@@ -127,6 +135,17 @@ class DeviceTelemetry:
         BudgetLedger's ``h2d`` layer reads when this plane is armed."""
         with self._mu:
             return self._h2d_digest.copy()
+
+    def record_h2d_failure(self) -> None:
+        """One failed staging put (the put raised before bytes landed)."""
+        with self._mu:
+            self._put_failures += 1
+        if self._c_put_fail is not None:
+            self._c_put_fail.inc()
+
+    def h2d_failures(self) -> int:
+        with self._mu:
+            return self._put_failures
 
     # -- device memory ------------------------------------------------------
     @staticmethod
@@ -165,6 +184,20 @@ class DeviceTelemetry:
             pass
         for entry in out.values():
             entry.setdefault("live_buffer_bytes", 0)
+        # injected allocator pressure (runtime/faults.py device_oom): CPU
+        # backends report no allocator stats, so the OOM-pressure signal
+        # the heal supervisor watches would be undrillable in CI without
+        # this overlay — the synthetic bytes ride the same keys the TPU
+        # allocator reports, so the watcher's math is identical
+        from ccfd_tpu.runtime.faults import device_oom_overlay
+
+        ratio = device_oom_overlay()
+        if ratio is not None:
+            limit = 16 * 1024**3  # a plausible HBM size; only the RATIO
+            for entry in out.values():  # matters to the pressure signal
+                entry.setdefault("bytes_limit", limit)
+                entry["bytes_in_use"] = int(
+                    ratio * entry.get("bytes_limit", limit))
         return out
 
     def peak_memory_bytes(self) -> int | None:
@@ -245,14 +278,25 @@ def timed_put(telemetry: "DeviceTelemetry | None", nbytes: int, put_fn):
         telemetry._put_seq += 1
         timed = telemetry._put_seq % telemetry.sample_every == 0
     if not timed:
+        try:
+            out = put_fn()
+        except Exception:
+            telemetry.record_h2d_failure()
+            raise
+        # bytes count only after the put lands (matching the timed
+        # branch): a failed put must not inflate ccfd_h2d_bytes_total
         telemetry.record_h2d(nbytes)
-        return put_fn()
+        return out
     import time
 
     import jax
 
     t0 = time.perf_counter()
-    out = put_fn()
-    jax.block_until_ready(out)
+    try:
+        out = put_fn()
+        jax.block_until_ready(out)
+    except Exception:
+        telemetry.record_h2d_failure()
+        raise
     telemetry.record_h2d(nbytes, time.perf_counter() - t0)
     return out

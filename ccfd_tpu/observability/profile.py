@@ -238,6 +238,16 @@ def _on_compile_event(event: str, secs: float, **_kw) -> None:
         target._record_compile(secs)
 
 
+def record_synthetic_compile(secs: float) -> None:
+    """Feed one synthetic backend_compile event to the armed profiler —
+    the injection point the ``compile_stall`` device fault
+    (runtime/faults.py) uses so a CPU CI drill moves the same
+    compile-storm signal a real re-trace storm would. Bills to the
+    active :func:`compile_stage` label like any real compile. No-op when
+    no profiler armed the listener."""
+    _on_compile_event("backend_compile_duration", float(secs))
+
+
 class StageProfiler:
     """Live per-stage latency decomposition; see the module docstring.
 
@@ -382,6 +392,17 @@ class StageProfiler:
                 self._g_compile_s.set(self._compile.sum)
                 self._g_compile_stage_s.set(d.sum,
                                             labels={"stage": stage})
+
+    def compile_counts(self) -> dict[str, int]:
+        """Per-stage compile-event counts (``total`` included) — the cheap
+        read the DeviceSupervisor's compile-storm signal and the heal
+        drills' warm-re-promotion assertions diff per tick, without
+        paying a full :meth:`snapshot`."""
+        with self._compile_mu:
+            out = {stage: d.count
+                   for stage, d in self._compile_stages.items()}
+            out["total"] = self._compile.count
+        return out
 
     @contextlib.contextmanager
     def profile_device(self, logdir: str) -> Iterator[None]:

@@ -1,0 +1,49 @@
+"""Version-compat shim for ``shard_map`` and the vma helpers around it.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` (renaming the replication check from
+``check_rep`` to ``check_vma``), and grew ``jax.lax.pcast`` for marking
+scan carries varying-across-mesh — neither exists on the older runtimes
+this repo also targets (the container pins jax 0.4.x, where only the
+experimental module is real). Every ``shard_map`` call site in the ops
+and serving layers goes through this shim so one jax upgrade/downgrade
+never reintroduces the tier-1 ``AttributeError: module 'jax' has no
+attribute 'shard_map'`` that blocked the ring/ulysses sequence losses
+(ROADMAP item 2).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where it exists, else the experimental spelling.
+
+    ``check_vma`` maps onto the old API's ``check_rep``; when the caller
+    relies on the new varying-across-mesh annotations (``pcast``, absent
+    on old jax — see :func:`pcast_varying`'s identity fallback), the old
+    replication checker cannot see them, so the fallback always disables
+    the check rather than mis-asserting replication the body never
+    promised.
+    """
+    top = getattr(jax, "shard_map", None)
+    if top is not None:
+        return top(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def pcast_varying(x: Any, axis_name: str) -> Any:
+    """``jax.lax.pcast(x, (axis_name,), to="varying")`` on jax versions
+    that have the vma system; identity otherwise (pre-vma shard_map has
+    no varying annotation for a scan carry to need)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, (axis_name,), to="varying")

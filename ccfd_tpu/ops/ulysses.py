@@ -32,6 +32,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ccfd_tpu.ops.ring_attention import reference_attention
+from ccfd_tpu.ops.shard_compat import shard_map
 
 
 def _ulysses_body(q, k, v, axis_name: str):
@@ -78,7 +79,7 @@ def ulysses_attention(
             f"axis {axis_name!r} size ({n})"
         )
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_ulysses_body, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec, spec, spec),

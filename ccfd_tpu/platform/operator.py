@@ -63,6 +63,9 @@ _COMPONENTS = (
     "incident",   # SLO-breach incident flight recorder: snapshot ring +
                   # schema-validated post-mortem bundles served at
                   # /incidents (new; observability/incident.py)
+    "heal",       # device self-healing: per-device health state machine,
+                  # canary dispatches, quarantine -> heal ladder -> warm
+                  # re-promotion (new; runtime/heal.py)
 )
 
 
@@ -136,6 +139,9 @@ class Platform:
         self.slo = None         # observability/slo.SLOEngine when enabled
         self.device = None      # observability/device.DeviceTelemetry
         self.recorder = None    # observability/incident.FlightRecorder
+        self.heal = None        # runtime/heal.DeviceSupervisor
+        self.device_fault_plan = None  # runtime/faults.DeviceFaultPlan
+        self._device_storm_driven = False  # ChaosMonkey owns its duty cycle
         self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
@@ -175,6 +181,31 @@ class Platform:
                 seed=int(chaos_spec.opt("seed", 0)),
                 active=storm_interval is None,
             )
+        # device faults (runtime/faults.py DeviceFaultPlan): same opt-in
+        # rules as edge faults — CR `chaos.device_faults` (chaos enabled)
+        # or the CCFD_DEVICE_FAULTS env. Installed process-wide because
+        # the seams (scorer dispatch / staging put / telemetry overlay)
+        # sit inside helpers no injector proxy can wrap.
+        cr_dev_text = (chaos_spec.opt("device_faults", "")
+                       if chaos_spec.enabled else "")
+        dev_fault_text = cr_dev_text or cfg.device_faults_spec
+        # only a CR-configured plan under a storm interval is duty-cycled
+        # by the ChaosMonkey; a standing CCFD_DEVICE_FAULTS env plan stays
+        # ACTIVE — an unrelated edge-storm schedule must not disarm it
+        self._device_storm_driven = bool(cr_dev_text) and \
+            storm_interval is not None
+        if dev_fault_text:
+            from ccfd_tpu.runtime.faults import (
+                DeviceFaultPlan,
+                install_device_faults,
+            )
+
+            self.device_fault_plan = DeviceFaultPlan.from_string(
+                dev_fault_text,
+                seed=int(chaos_spec.opt("seed", 0)),
+                active=not self._device_storm_driven,
+            )
+            install_device_faults(self.device_fault_plan)
 
         # 0a. overload control (runtime/overload.py): the CR `overload:`
         # block overlays the CCFD_OVERLOAD_* env KNOBS once, here, so the
@@ -420,6 +451,19 @@ class Platform:
                 reset=self.recorder.reset,
             )
 
+        # 7e. device heal supervisor (runtime/heal.py): the health state
+        #     machine over the local scorer — canary dispatches bounded by
+        #     the router's PR 6 watchdog, quarantine pins the router's
+        #     degradation ladder to the host tier, the heal ladder's
+        #     respawn rung restores the lifecycle champion checkpoint, and
+        #     re-promotion is warm (full executable inventory precompiled
+        #     under the heal.warm label). Default on with a local scorer;
+        #     CCFD_HEAL=0 (or CR heal.enabled: false) kills the plane.
+        heal_spec = spec.component("heal")
+        if (heal_spec.enabled and cfg.heal_enabled
+                and self.scorer is not None):
+            self._up_heal(heal_spec)
+
         # 8. monitoring (README.md:487-537)
         if spec.component("monitoring").enabled:
             from ccfd_tpu.metrics.exporter import MetricsExporter
@@ -473,6 +517,8 @@ class Platform:
                 targets=(list(targets) if targets is not None else None),
                 registry=self._registry("chaos"),
                 fault_plan=self.fault_plan,
+                device_fault_plan=(self.device_fault_plan
+                                   if self._device_storm_driven else None),
                 fault_interval_s=(float(c.opt("fault_interval_s"))
                                   if c.opt("fault_interval_s") else None),
                 fault_duration_s=float(c.opt("fault_duration_s", 2.0)),
@@ -959,6 +1005,59 @@ class Platform:
             reset=router.reset,
         )
 
+    def _up_heal(self, c: ComponentSpec) -> None:
+        from ccfd_tpu.runtime.heal import DeviceSupervisor
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        cfg = self.cfg
+        # respawn rung: with the lifecycle up, respawn restores the
+        # champion CHECKPOINT (serialized under the controller lock so a
+        # respawn racing a rollback leaves one consistent champion tree);
+        # without it, the supervisor's default re-publishes the current
+        # params into fresh device buffers
+        respawn_fn = (self.lifecycle.restore_champion
+                      if self.lifecycle is not None else None)
+        self.heal = DeviceSupervisor(
+            self.scorer,
+            registry=self._registry("heal"),
+            breaker=getattr(self.router, "_breaker", None),
+            telemetry=self.device,
+            profiler=self.profiler,
+            recorder=self.recorder,
+            overload=self._overload,
+            canary_rows=int(c.opt("canary_rows", 16)),
+            canary_deadline_ms=float(
+                c.opt("canary_deadline_ms", cfg.heal_canary_deadline_ms)),
+            suspect_strikes=int(
+                c.opt("suspect_strikes", cfg.heal_suspect_strikes)),
+            probation_canaries=int(
+                c.opt("probation_canaries", cfg.heal_probation_canaries)),
+            parity_tol=float(c.opt("parity_tol", cfg.heal_parity_tol)),
+            oom_ratio=float(c.opt("oom_ratio", cfg.heal_oom_ratio)),
+            compile_storm_per_s=float(
+                c.opt("compile_storm_per_s", cfg.heal_compile_storm_per_s)),
+            backoff_base_s=float(
+                c.opt("backoff_base_s", cfg.heal_backoff_base_s)),
+            backoff_cap_s=float(
+                c.opt("backoff_cap_s", cfg.heal_backoff_cap_s)),
+            flap_window_s=float(
+                c.opt("flap_window_s", cfg.heal_flap_window_s)),
+            respawn_fn=respawn_fn,
+        )
+        if self.router is not None and hasattr(self.router,
+                                               "set_heal_gate"):
+            # quarantine pins the ladder to the host tier, ABOVE the
+            # breaker: even a half-open probe can't leak to a sick device
+            self.router.set_heal_gate(self.heal)
+        interval = float(c.opt("interval_s", cfg.heal_interval_s))
+        self.supervisor.add_thread_service(
+            "heal",
+            lambda: self.heal.run(interval_s=interval),
+            self.heal.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=self.heal.reset,
+        )
+
     def _up_investigator(self) -> None:
         from ccfd_tpu.process.investigator import InvestigatorService
         from ccfd_tpu.runtime.supervisor import RestartPolicy
@@ -1199,6 +1298,13 @@ class Platform:
         # down would race the orderly shutdown
         if self.chaos is not None:
             self.chaos.stop()
+        if self.device_fault_plan is not None:
+            # the plan installed PROCESS-wide; a torn-down platform must
+            # not leave standing device faults for the next one in-process
+            from ccfd_tpu.runtime.faults import install_device_faults
+
+            install_device_faults(None)
+            self.device_fault_plan = None
         if self.recovery is not None:
             self.recovery.stop()
         if self.supervisor:

@@ -92,6 +92,7 @@ class ParallelRouter:
         coalesce_workers: int = 2,
         overload: "Any | None" = None,
         profiler: "Any | None" = None,
+        heal_gate: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -176,7 +177,7 @@ class ParallelRouter:
                 host_score_fn=host_score_fn, breaker=self._breaker,
                 degrade=degrade, max_inflight=self.max_inflight,
                 tracer=tracer, inflight_budget=self._budget, worker_id=i,
-                overload=overload, profiler=profiler,
+                overload=overload, profiler=profiler, heal_gate=heal_gate,
             )
             for i in range(workers)
         ]
@@ -226,6 +227,13 @@ class ParallelRouter:
         after the last one the pool holds a fresh disjoint assignment."""
         for w in self.workers:
             w.recycle_consumers()
+
+    def set_heal_gate(self, gate: "Any | None") -> None:
+        """Point every worker's degradation ladder at the device heal
+        gate (runtime/heal.py) — the pool shares ONE DeviceSupervisor,
+        like it shares one breaker and one budget."""
+        for w in self.workers:
+            w.set_heal_gate(gate)
 
     def swap_engine(self, engine: EngineClient) -> None:
         for w in self.workers:

@@ -317,6 +317,7 @@ class Router:
         worker_id: int | None = None,
         overload: "Any | None" = None,
         profiler: "Any | None" = None,
+        heal_gate: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -450,6 +451,12 @@ class Router:
             self._budget = overload.budget
         else:
             self._budget = InflightBudget(self.max_inflight, registry=r)
+        # device heal gate (runtime/heal.py DeviceSupervisor): while the
+        # device is QUARANTINED (or on heal probation) the ladder is
+        # PINNED to its host tier — the check sits ABOVE the breaker so
+        # not even a half-open probe leaks live traffic to a sick device.
+        # The supervisor itself canaries the device back to health.
+        self._heal_gate = heal_gate
         # stage profiler (observability/profile.py): per micro-batch the
         # router feeds the decomposition no histogram carries — bus
         # queueing delay (poll time minus produce timestamps), decode and
@@ -667,8 +674,16 @@ class Router:
         the bottom tier is pure numpy over data already in hand. ``span``
         (when tracing) gets the degraded-tier flag — a trace scored by a
         fallback tier is always tail-sampled KEEP."""
-        br = self._breaker
-        if br is None or br.allow():
+        gate = self._heal_gate
+        if gate is not None and not gate.device_allowed():
+            # device quarantined (runtime/heal.py): the ladder is pinned
+            # to the host tier. Checked BEFORE the breaker so a HALF_OPEN
+            # probe slot cannot route live rows to the sick device — the
+            # heal supervisor's own canary is the only probe allowed.
+            if span is not None:
+                span.attrs["quarantined"] = True
+        elif self._breaker is None or self._breaker.allow():
+            br = self._breaker
             t0 = time.perf_counter()
             try:
                 ov = self._overload
@@ -711,6 +726,22 @@ class Router:
             span.attrs["degraded"] = "rules"
         return self._rules_proba(x)
 
+    def _score_direct(self, x: np.ndarray, txs: list,
+                      span=None) -> np.ndarray:
+        """Legacy non-ladder path — but the heal gate still binds: a
+        quarantined device must not see live rows even when the
+        degradation ladder is off (``router.degrade: false`` CRs). With
+        no host tier wired here, the always-available rules tier makes
+        the conservative decision until the supervisor re-promotes."""
+        gate = self._heal_gate
+        if gate is not None and not gate.device_allowed():
+            if span is not None:
+                span.attrs["quarantined"] = True
+                span.attrs["degraded"] = "rules"
+            self._c_degraded.inc(len(txs), labels={"tier": "rules"})
+            return self._rules_proba(x)
+        return self._score2(x, txs)
+
     def _score_batch(self, x: np.ndarray, txs: list,
                      batch_span=None) -> np.ndarray:
         if self.tracer is not None and batch_span is not None:
@@ -718,10 +749,10 @@ class Router:
                                   parent=batch_span.context) as sp:
                 if self._degrade:
                     return self._score_tiered(x, txs, span=sp)
-                return self._score2(x, txs)
+                return self._score_direct(x, txs, span=sp)
         if self._degrade:
             return self._score_tiered(x, txs)
-        return self._score2(x, txs)
+        return self._score_direct(x, txs)
 
     # -- one synchronous cycle (used by tests and the run loop) ------------
     def step(self, poll_timeout_s: float = 0.0) -> int:
@@ -917,6 +948,14 @@ class Router:
             except Exception:  # noqa: BLE001 - a dead consumer is fine here
                 pass
             setattr(self, attr, self.broker.consumer(group, topics))
+
+    def set_heal_gate(self, gate: Any) -> None:
+        """Arm (or, with None, disarm) the device heal gate after
+        construction — the operator builds the DeviceSupervisor after the
+        router (it needs the flight recorder from a later bring-up step)
+        and points the ladder at it here. One attribute publish; the next
+        batch sees it."""
+        self._heal_gate = gate
 
     def swap_engine(self, engine: EngineClient) -> None:
         """Point the router at a replacement engine — crash recovery swaps

@@ -41,6 +41,7 @@ class ChaosMonkey:
         targets: list[str] | None = None,
         registry: Registry | None = None,
         fault_plan=None,
+        device_fault_plan=None,
         fault_interval_s: float | None = None,
         fault_duration_s: float = 2.0,
     ):
@@ -51,9 +52,12 @@ class ChaosMonkey:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.history: list[tuple[float, str]] = []  # (monotonic time, service)
-        # network fault storms (runtime/faults.FaultPlan): the plan should
-        # be built active=False; the monkey owns its duty cycle
+        # fault storms: edge plan (runtime/faults.FaultPlan) and/or device
+        # plan (runtime/faults.DeviceFaultPlan — same activation surface).
+        # Storm-driven plans should be built active=False; the monkey owns
+        # their duty cycle and toggles both in lockstep each window
         self._fault_plan = fault_plan
+        self._device_fault_plan = device_fault_plan
         self.fault_interval_s = fault_interval_s
         self.fault_duration_s = fault_duration_s
         self._fault_thread: threading.Thread | None = None
@@ -64,10 +68,10 @@ class ChaosMonkey:
             self._c_injected = registry.counter(
                 "chaos_injections_total", "injected service failures"
             )
-            if fault_plan is not None:
+            if fault_plan is not None or device_fault_plan is not None:
                 self._c_fault_windows = registry.counter(
                     "chaos_fault_windows_total",
-                    "network fault-storm windows driven by the monkey",
+                    "fault-storm windows driven by the monkey",
                 )
 
     def _eligible(self) -> list[str]:
@@ -98,19 +102,23 @@ class ChaosMonkey:
         return name
 
     def fault_storm(self, duration_s: float | None = None) -> None:
-        """Run one fault window now: activate the plan, hold it for the
+        """Run one fault window now: activate the plan(s), hold for the
         duration (interruptible by stop), deactivate."""
-        if self._fault_plan is None:
+        plans = [p for p in (self._fault_plan, self._device_fault_plan)
+                 if p is not None]
+        if not plans:
             return
         dur = self.fault_duration_s if duration_s is None else duration_s
         t0 = time.monotonic()
-        self._fault_plan.activate()
+        for p in plans:
+            p.activate()
         if self._c_fault_windows is not None:
             self._c_fault_windows.inc()
         try:
             self._stop.wait(dur)
         finally:
-            self._fault_plan.deactivate()
+            for p in plans:
+                p.deactivate()
             self.fault_windows.append((t0, time.monotonic()))
 
     def run(self) -> None:
@@ -134,7 +142,9 @@ class ChaosMonkey:
             target=self.run, daemon=True, name="ccfd-chaos"
         )
         self._thread.start()
-        if self._fault_plan is not None and self.fault_interval_s:
+        if ((self._fault_plan is not None
+                or self._device_fault_plan is not None)
+                and self.fault_interval_s):
             self._fault_thread = threading.Thread(
                 target=self._run_faults, daemon=True, name="ccfd-chaos-net"
             )
@@ -147,6 +157,8 @@ class ChaosMonkey:
             self._thread.join(timeout=5.0)
         if self._fault_thread is not None:
             self._fault_thread.join(timeout=5.0)
-            # a storm interrupted mid-window must not leave edges degraded
-            if self._fault_plan is not None:
-                self._fault_plan.deactivate()
+            # a storm interrupted mid-window must not leave edges (or the
+            # device seams) degraded
+            for p in (self._fault_plan, self._device_fault_plan):
+                if p is not None:
+                    p.deactivate()

@@ -294,3 +294,215 @@ class FaultInjector:
 
         return MethodProxy(obj, self.run,
                            frozenset(methods) if methods else None)
+
+
+# ---------------------------------------------------------------------------
+# Device faults: the accelerator itself as a fallible component.
+#
+# The edge faults above perturb RPC hops; the failure taxonomy the heal
+# ladder (runtime/heal.py) defends against lives BELOW every edge — the
+# device wedges mid-dispatch, the allocator runs out of HBM, XLA re-traces
+# in a storm, a host->device staging put fails. These inject at the three
+# seams the serving stack owns (all drillable on CPU CI):
+#
+# - ``dispatch`` — the scorer's device-dispatch loop (Scorer.score_pipelined
+#   / SeqScorer's chunk loop): ``device_hang`` stalls the dispatch past its
+#   watchdog deadline; ``compile_stall`` stalls AND bills a synthetic
+#   backend_compile event to the active compile_stage label, so the
+#   compile-storm signal the DeviceSupervisor watches actually moves.
+# - ``put`` — the staging seam (Scorer._put_batch / SeqScorer._put_hist):
+#   ``put_fail`` raises, and the telemetry plane counts the failure
+#   (ccfd_h2d_put_failures_total — the supervisor's put-failure signal).
+# - telemetry — ``device_oom`` overlays allocator pressure onto
+#   DeviceTelemetry.device_memory() (bytes_in_use ~= bytes_limit), the
+#   OOM-pressure signal, since CPU backends report no allocator stats.
+#
+# A plan installs process-wide (install_device_faults) because the seams
+# sit inside compiled-dispatch helpers no injector proxy can wrap; the
+# activation toggle has the FaultPlan interface, so the ChaosMonkey (and
+# tools/chaos_soak.py --device-faults) schedules device-fault storms with
+# the same machinery that drives edge storms.
+# ---------------------------------------------------------------------------
+
+DEVICE_FAULT_KINDS = ("device_hang", "compile_stall", "device_oom",
+                      "put_fail")
+
+
+class DeviceFaultSpec:
+    """Parameters for one device-fault kind. Times in milliseconds.
+
+    - ``device_hang``: every dispatch stalls ``hang_ms`` (default 400 —
+      comfortably past the CI-scale watchdog deadlines the drills use).
+    - ``compile_stall``: every dispatch stalls ``stall_ms`` and records a
+      synthetic backend_compile of that duration (a re-trace storm).
+    - ``device_oom``: reported allocator pressure ``oom_ratio`` of
+      bytes_limit (default 0.99 — past any sane quarantine threshold).
+    - ``put_fail``: a staging put raises with probability ``rate``
+      (default 1.0).
+    """
+
+    __slots__ = ("hang_ms", "stall_ms", "oom_ratio", "rate")
+
+    def __init__(self, hang_ms: float = 400.0, stall_ms: float = 50.0,
+                 oom_ratio: float = 0.99, rate: float = 1.0):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"rate {rate} outside [0, 1]")
+        if not 0.0 <= oom_ratio <= 1.0:
+            raise ValueError(f"oom_ratio {oom_ratio} outside [0, 1]")
+        for name, v in (("hang_ms", hang_ms), ("stall_ms", stall_ms)):
+            if v < 0:
+                raise ValueError(f"{name} must be >= 0, got {v}")
+        self.hang_ms = float(hang_ms)
+        self.stall_ms = float(stall_ms)
+        self.oom_ratio = float(oom_ratio)
+        self.rate = float(rate)
+
+    @staticmethod
+    def parse(body: str) -> "DeviceFaultSpec":
+        """``"ms=400"`` / ``"ratio=0.95,rate=0.5"`` -> DeviceFaultSpec.
+        ``ms`` sets both hang and stall times (one knob per kind in
+        practice); empty body takes every default."""
+        kw: dict[str, float] = {}
+        for item in body.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, val = item.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(
+                    f"device-fault option {item!r}: expected key=value")
+            if key == "ms":
+                kw["hang_ms"] = kw["stall_ms"] = float(val)
+            elif key == "ratio":
+                kw["oom_ratio"] = float(val)
+            elif key == "rate":
+                kw["rate"] = float(val)
+            else:
+                raise ValueError(
+                    f"unknown device-fault option {key!r}; "
+                    f"known: ms, ratio, rate")
+        return DeviceFaultSpec(**kw)
+
+
+class DeviceFaultPlan:
+    """Active device-fault kinds + the FaultPlan activation interface
+    (``activate``/``deactivate``/``active``/``activations``) so storm
+    schedulers drive device faults exactly like edge faults."""
+
+    def __init__(self, kinds: Mapping[str, DeviceFaultSpec] | None = None,
+                 seed: int = 0, active: bool = True):
+        for k in (kinds or {}):
+            if k not in DEVICE_FAULT_KINDS:
+                raise ValueError(
+                    f"unknown device fault {k!r}; known: "
+                    f"{DEVICE_FAULT_KINDS}")
+        self.kinds = dict(kinds or {})
+        self._rng = random.Random(seed)
+        self._active = threading.Event()
+        if active:
+            self._active.set()
+        self.activations = 0
+        self.injected: dict[str, int] = {}
+        self._oom_counted_epoch = -1  # activation epoch last counted
+
+    @staticmethod
+    def from_string(text: str, seed: int = 0,
+                    active: bool = True) -> "DeviceFaultPlan":
+        """``"device_hang:ms=400;put_fail"`` -> DeviceFaultPlan (the
+        CCFD_DEVICE_FAULTS syntax). Empty text means an empty plan."""
+        kinds: dict[str, DeviceFaultSpec] = {}
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _sep, body = part.partition(":")
+            kinds[kind.strip()] = DeviceFaultSpec.parse(body)
+        return DeviceFaultPlan(kinds, seed=seed, active=active)
+
+    @property
+    def active(self) -> bool:
+        return self._active.is_set()
+
+    def activate(self) -> None:
+        self.activations += 1
+        self._active.set()
+
+    def deactivate(self) -> None:
+        self._active.clear()
+
+    def spec(self, kind: str) -> DeviceFaultSpec | None:
+        """The kind's spec while the plan is ACTIVE, else None."""
+        if not self._active.is_set():
+            return None
+        return self.kinds.get(kind)
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+
+_DEVICE_PLAN: DeviceFaultPlan | None = None
+
+
+def install_device_faults(plan: DeviceFaultPlan | None) -> None:
+    """Install (or, with None, clear) the process-wide device-fault plan
+    the scorer seams consult. Process-wide because the seams live inside
+    dispatch helpers built long before any injector could wrap them."""
+    global _DEVICE_PLAN
+    _DEVICE_PLAN = plan
+
+
+def device_faults() -> DeviceFaultPlan | None:
+    return _DEVICE_PLAN
+
+
+def device_seam(seam: str) -> None:
+    """Fault hook the scorer seams call: ``dispatch`` before each device
+    dispatch, ``put`` before each staging put. No-op (one None check) with
+    no active plan. ``put_fail`` raises :class:`InjectedFault` so the
+    caller's transport-error handling (breaker, ladder, telemetry failure
+    count) engages exactly as for a real staging failure."""
+    plan = _DEVICE_PLAN
+    if plan is None or not plan.active:
+        return
+    if seam == "dispatch":
+        s = plan.spec("device_hang")
+        if s is not None:
+            plan._count("device_hang")
+            time.sleep(s.hang_ms / 1e3)
+        s = plan.spec("compile_stall")
+        if s is not None:
+            plan._count("compile_stall")
+            # a re-trace storm: the dispatch pays a compile it shouldn't,
+            # and the compile-attribution plane must SEE it (that rate is
+            # the signal the DeviceSupervisor quarantines on)
+            from ccfd_tpu.observability.profile import (
+                record_synthetic_compile,
+            )
+
+            record_synthetic_compile(s.stall_ms / 1e3)
+            time.sleep(s.stall_ms / 1e3)
+    elif seam == "put":
+        s = plan.spec("put_fail")
+        if s is not None and plan._rng.random() < s.rate:
+            plan._count("put_fail")
+            raise InjectedFault("staging put failed (injected put_fail)")
+
+
+def device_oom_overlay() -> float | None:
+    """The injected allocator-pressure ratio, or None. Consulted by
+    DeviceTelemetry.device_memory() so the OOM signal is drillable on
+    backends that report no allocator stats (CPU CI)."""
+    plan = _DEVICE_PLAN
+    if plan is None:
+        return None
+    s = plan.spec("device_oom")
+    if s is None:
+        return None
+    # one injection per activation window, not per read: device_memory()
+    # runs on every scrape / bench meter / heal tick, and a read-rate
+    # artifact would make injected[] counts incomparable across kinds
+    if plan._oom_counted_epoch != plan.activations:
+        plan._oom_counted_epoch = plan.activations
+        plan._count("device_oom")
+    return s.oom_ratio

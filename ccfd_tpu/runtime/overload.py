@@ -434,13 +434,22 @@ class OverloadControl:
         self.budget.observe(latency_s)
 
     # -- dispatch watchdog -------------------------------------------------
-    def bounded_dispatch(self, fn: Callable[[], Any]) -> Any:
+    def bounded_dispatch(self, fn: Callable[[], Any],
+                         deadline_s: float | None = None) -> Any:
         """Run a device dispatch under the watchdog deadline. On expiry the
         call raises (the router's ladder records a scorer-edge failure, so
         a hung dispatch trips the existing breaker instead of stalling the
         worker forever), the timeout is counted, and the deadline itself is
-        fed to AIMD as the worst-possible latency sample."""
-        if self.dispatch_deadline_s <= 0:
+        fed to AIMD as the worst-possible latency sample.
+
+        ``deadline_s`` overrides the plane's standing deadline for ONE
+        call — the heal supervisor's canary dispatch (runtime/heal.py)
+        rides this watchdog with its own (tighter) budget, so canary
+        kills share the timeout counter, the AIMD feedback and the
+        flight-recorder snapshot hook with serving kills."""
+        if deadline_s is None:
+            deadline_s = self.dispatch_deadline_s
+        if deadline_s <= 0:
             return fn()
         from ccfd_tpu.serving.dispatch import DeviceDispatcher, ScorerTimeout
 
@@ -451,10 +460,10 @@ class OverloadControl:
                         max_threads=self.dispatch_threads,
                         name="ccfd-router-dispatch")
         try:
-            return self._dispatcher.call(fn, self.dispatch_deadline_s)
+            return self._dispatcher.call(fn, deadline_s)
         except ScorerTimeout:
             self._c_dispatch_timeout.inc()
-            self.budget.observe(self.dispatch_deadline_s + self.budget.target_s)
+            self.budget.observe(deadline_s + self.budget.target_s)
             if self.recorder is not None:
                 try:
                     self.recorder.note_dispatch_timeout()

@@ -66,6 +66,7 @@ from typing import Any
 import numpy as np
 
 from ccfd_tpu.data.ccfd import NUM_FEATURES
+from ccfd_tpu.runtime.faults import device_seam
 
 DEFAULT_STRIPES = 8
 # short-sequence ladder OFF by default: bucketed windows attend fewer
@@ -609,7 +610,16 @@ class SeqScorer:
         )
 
     def _put_hist(self, hist: np.ndarray):
-        """H2D with placement: on a mesh each device gets its row shard."""
+        """H2D with placement: on a mesh each device gets its row shard.
+        Shares the staging fault seam with the row scorer's _put_batch
+        (runtime/faults.py put_fail): an injected staging failure rides
+        the same exception path a real transfer failure would."""
+        try:
+            device_seam("put")
+        except Exception:
+            if self.telemetry is not None:
+                self.telemetry.record_h2d_failure()
+            raise
         if self._batch_sharding is None:
             return hist
         return self._jax.device_put(hist, self._batch_sharding)
@@ -797,6 +807,10 @@ class SeqScorer:
                         params, apply_fn = self.params, self._apply
                     t_asm += time.perf_counter() - t0
                     t0 = time.perf_counter()
+                    # device-fault dispatch seam (runtime/faults.py):
+                    # device_hang / compile_stall drill the heal ladder
+                    # through the seq path's own dispatch loop
+                    device_seam("dispatch")
                     # JAX async dispatch: the call ENQUEUES the executable
                     # and returns; the next group assembles while it runs.
                     dev = apply_fn(params, self._put_hist(sub))

@@ -41,6 +41,7 @@ import numpy as np
 
 from ccfd_tpu.data.ccfd import NUM_FEATURES
 from ccfd_tpu.models.registry import ModelSpec, get_model
+from ccfd_tpu.runtime.faults import device_seam
 
 _DTYPES = {
     "float32": jnp.float32,
@@ -306,11 +307,19 @@ class Scorer:
     def _put_batch(self, chunk: np.ndarray) -> jax.Array:
         """H2D with placement: on a mesh each chip gets only its row shard.
         With the device telemetry plane armed the put is timed and byte-
-        counted (the measured H2D accounting; two perf_counter reads)."""
+        counted (the measured H2D accounting; two perf_counter reads).
+        The staging seam consults the device-fault plan (runtime/faults.py
+        ``put_fail``) INSIDE the put, so an injected staging failure rides
+        the same path — and the same telemetry failure count — a real one
+        would."""
         if self._batch_sharding is None:
-            put = lambda: jnp.asarray(chunk)  # noqa: E731
+            def put():
+                device_seam("put")
+                return jnp.asarray(chunk)
         else:
-            put = lambda: jax.device_put(chunk, self._batch_sharding)  # noqa: E731
+            def put():
+                device_seam("put")
+                return jax.device_put(chunk, self._batch_sharding)
         if self.telemetry is None:
             return put()
         from ccfd_tpu.observability.device import timed_put
@@ -377,6 +386,7 @@ class Scorer:
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
+            from ccfd_tpu.ops.shard_compat import shard_map
             from ccfd_tpu.parallel.mesh import DATA_AXIS
 
             def per_chip(p, xs):
@@ -385,7 +395,7 @@ class Scorer:
                 )
 
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     per_chip,
                     mesh=self.mesh,
                     in_specs=(P(), P(DATA_AXIS, None)),
@@ -746,6 +756,10 @@ class Scorer:
                 chunk = np.concatenate(
                     [chunk, np.zeros((b - take, x.shape[1]), np.float32)]
                 )
+            # device-fault dispatch seam (runtime/faults.py): device_hang
+            # stalls this dispatch past its watchdog, compile_stall bills
+            # a synthetic re-trace — the taxonomy the heal ladder drills
+            device_seam("dispatch")
             if fused_params is not None:
                 try:
                     out = self._fused_dispatch(fused_params, chunk,

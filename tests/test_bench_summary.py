@@ -125,6 +125,56 @@ def test_triage_verdict_folds_the_newest_fresh_artifact(tmp_path):
         b._triage_verdict() or "")
 
 
+def test_fresh_triage_runs_live_and_labels_the_verdict(monkeypatch):
+    """ISSUE 11 satellite: on probe fallback bench invokes
+    tools/tpu_triage.py for a LIVE verdict instead of only folding a
+    cached (≤24 h) artifact — the platform string must never cite stale
+    triage when a live probe just failed."""
+    import subprocess
+
+    b = _load_bench()
+
+    class FakeRun:
+        def __init__(self, stdout):
+            self.stdout = stdout
+            self.returncode = 3
+
+    calls = {}
+
+    def fake_run(cmd, **kw):
+        calls["cmd"] = cmd
+        return FakeRun(json.dumps({
+            "verdict": "wedged_relay_dead", "ts": "2026-08-04T10:00:00Z"}))
+
+    monkeypatch.setattr(b.subprocess, "run", fake_run)
+    v = b._fresh_triage()
+    assert v == "triage: wedged_relay_dead @ 2026-08-04T10:00:00Z (live)"
+    # invoked as a subprocess against the real triage tool, json-only
+    # (never clobbering checked-in artifacts), trace skipped
+    assert calls["cmd"][1].endswith(os.path.join("tools", "tpu_triage.py"))
+    assert "--json" in calls["cmd"] and "--no-trace" in calls["cmd"]
+
+    # a failed/garbled live run falls back to None (callers then use the
+    # cached-artifact path)
+    monkeypatch.setattr(
+        b.subprocess, "run", lambda *a, **k: FakeRun("not json"))
+    assert b._fresh_triage() is None
+
+    def raising_run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr(b.subprocess, "run", raising_run)
+    assert b._fresh_triage() is None
+
+    # the CI kill switch skips the live run without touching subprocess
+    def exploding_run(*a, **k):  # pragma: no cover - must not be reached
+        raise AssertionError("live triage ran despite the kill switch")
+
+    monkeypatch.setattr(b.subprocess, "run", exploding_run)
+    monkeypatch.setenv("CCFD_BENCH_TRIAGE_LIVE", "0")
+    assert b._fresh_triage() is None
+
+
 def test_device_meter_attaches_section_rows():
     """The per-section device rows (h2d bytes delta + peak memory): a
     scorer built AFTER the meter installs itself stages through the

@@ -1,0 +1,844 @@
+"""Device self-healing: taxonomy, state machine, heal ladder, warm
+re-promotion, router pinning, and the heal-vs-recovery races (ISSUE 11)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.device import DeviceTelemetry
+from ccfd_tpu.observability.profile import StageProfiler
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.router import Router
+from ccfd_tpu.runtime import faults
+from ccfd_tpu.runtime.breaker import CircuitBreaker
+from ccfd_tpu.runtime.heal import (
+    RUNGS,
+    STATE_NAMES,
+    DeviceSupervisor,
+)
+from ccfd_tpu.serving.scorer import Scorer
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_device_faults():
+    yield
+    faults.install_device_faults(None)
+
+
+def make_scorer(**kw):
+    kw.setdefault("model_name", "mlp")
+    kw.setdefault("batch_sizes", (16, 128))
+    sc = Scorer(**kw)
+    sc.warmup()
+    return sc
+
+
+def make_sup(scorer, **kw):
+    kw.setdefault("canary_deadline_ms", 150.0)
+    kw.setdefault("suspect_strikes", 2)
+    kw.setdefault("probation_canaries", 2)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.05)
+    return DeviceSupervisor(scorer, **kw)
+
+
+def heal_until(sup, state, ticks=40, sleep_s=0.05):
+    for _ in range(ticks):
+        if sup.tick() == state:
+            return True
+        time.sleep(sleep_s)
+    return sup.state == state
+
+
+# -- device-fault plan (runtime/faults.py) ------------------------------------
+
+
+def test_device_fault_plan_parse_and_toggle():
+    plan = faults.DeviceFaultPlan.from_string(
+        "device_hang:ms=123;put_fail:rate=0.5", active=False)
+    assert plan.kinds["device_hang"].hang_ms == 123.0
+    assert plan.kinds["put_fail"].rate == 0.5
+    assert plan.spec("device_hang") is None  # inactive
+    plan.activate()
+    assert plan.spec("device_hang").hang_ms == 123.0
+    assert plan.activations == 1
+    plan.deactivate()
+    assert plan.spec("device_hang") is None
+
+
+def test_device_fault_plan_rejects_unknown_kinds():
+    with pytest.raises(ValueError, match="unknown device fault"):
+        faults.DeviceFaultPlan.from_string("warp_core_breach")
+    with pytest.raises(ValueError, match="unknown device-fault option"):
+        faults.DeviceFaultSpec.parse("bogus=1")
+
+
+def test_put_fail_raises_through_staging_and_counts_in_telemetry():
+    reg = Registry()
+    tele = DeviceTelemetry(registry=reg, sample_every=1)
+    sc = make_scorer(telemetry=tele)
+    x = np.zeros((300, sc.num_features), np.float32)  # past the host tier
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("put_fail"))
+    with pytest.raises(faults.InjectedFault):
+        sc.score_pipelined(x, depth=1)
+    assert tele.h2d_failures() >= 1
+    assert "ccfd_h2d_put_failures_total" in reg.render()
+    faults.install_device_faults(None)
+    out = sc.score_pipelined(x, depth=1)  # plan cleared: path is clean
+    assert out.shape == (300,)
+
+
+def test_device_oom_overlay_reports_pressure_on_cpu():
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_oom:ratio=0.97"))
+    mem = DeviceTelemetry.device_memory()
+    assert mem, "no devices visible"
+    for kinds in mem.values():
+        assert kinds["bytes_in_use"] / kinds["bytes_limit"] >= 0.96
+    faults.install_device_faults(None)
+    mem = DeviceTelemetry.device_memory()
+    for kinds in mem.values():
+        assert "bytes_limit" not in kinds  # cpu reports no allocator stats
+
+
+def test_compile_stall_bills_synthetic_compiles_to_profiler():
+    prof = StageProfiler(registry=Registry())
+    prof.arm_compile_listener()
+    sc = make_scorer()
+    before = prof.compile_counts().get("total", 0)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("compile_stall:ms=1"))
+    sc.score_pipelined(np.zeros((64, sc.num_features), np.float32), depth=1)
+    assert prof.compile_counts()["total"] > before
+
+
+# -- state machine ------------------------------------------------------------
+
+
+def test_healthy_device_stays_healthy_and_exports_gauge():
+    reg = Registry()
+    sup = make_sup(make_scorer(), registry=reg)
+    assert sup.tick() == "healthy"
+    assert sup.device_allowed()
+    r = reg.render()
+    assert 'ccfd_device_health' in r
+    # one-hot: the healthy series is 1, quarantined 0
+    assert 'state="healthy"} 1' in r.replace("device=", "").replace(
+        sup.device + '",', "")
+
+
+def test_hang_strikes_to_suspect_then_quarantine_with_bundle_per_edge():
+    class Rec:
+        def __init__(self):
+            self.triggers = []
+
+        def incident(self, trigger, slo_status=None):
+            self.triggers.append(dict(trigger))
+            return {}
+
+    rec = Rec()
+    sup = make_sup(make_scorer(), recorder=rec)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "suspect"
+    assert sup.device_allowed()  # SUSPECT still serves the device
+    assert sup.tick() == "quarantined"
+    assert not sup.device_allowed()
+    assert sup.quarantines == 1
+    faults.install_device_faults(None)
+    assert heal_until(sup, "healthy")
+    assert sup.repromotions == 1
+    kinds = [t["type"] for t in rec.triggers]
+    # exactly one bundle per transition edge
+    assert kinds == ["device_quarantine", "device_repromote"]
+
+
+def test_suspect_recovers_without_quarantine_on_transient_blip():
+    sup = make_sup(make_scorer(), suspect_strikes=3)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "suspect"
+    faults.install_device_faults(None)
+    assert sup.tick() == "healthy"
+    assert sup.quarantines == 0
+
+
+def test_oom_pressure_signal_quarantines():
+    tele = DeviceTelemetry()
+    sup = make_sup(make_scorer(telemetry=tele), telemetry=tele,
+                   suspect_strikes=1, oom_ratio=0.9)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_oom:ratio=0.99"))
+    assert sup.tick() == "quarantined"
+    assert any("device_oom" in r for r in sup.status()["reasons"])
+
+
+def test_put_failure_signal_strikes():
+    tele = DeviceTelemetry(sample_every=1)
+    sup = make_sup(make_scorer(telemetry=tele), telemetry=tele,
+                   suspect_strikes=1)
+    tele.record_h2d_failure()
+    assert sup.tick() == "quarantined"
+    assert any("put_fail" in r for r in sup.status()["reasons"])
+
+
+def test_compile_storm_signal_quarantines():
+    clock = [0.0]
+    prof = StageProfiler(registry=Registry())
+    prof.arm_compile_listener()
+    sup = make_sup(make_scorer(), profiler=prof, suspect_strikes=1,
+                   compile_storm_per_s=1.0, clock=lambda: clock[0])
+    assert sup.tick() == "healthy"  # baseline snapshot
+    clock[0] += 5.0
+    from ccfd_tpu.observability.profile import record_synthetic_compile
+
+    for _ in range(10):  # 10 serving-stage compiles in 5s = 2/s > 1/s
+        record_synthetic_compile(0.01)
+    assert sup.tick() == "quarantined"
+    assert any("compile_storm" in r for r in sup.status()["reasons"])
+
+
+def test_warmup_labeled_compiles_do_not_count_as_storm():
+    clock = [0.0]
+    prof = StageProfiler(registry=Registry())
+    prof.arm_compile_listener()
+    sup = make_sup(make_scorer(), profiler=prof, suspect_strikes=1,
+                   compile_storm_per_s=1.0, clock=lambda: clock[0])
+    assert sup.tick() == "healthy"
+    clock[0] += 5.0
+    from ccfd_tpu.observability.profile import (
+        compile_stage,
+        record_synthetic_compile,
+    )
+
+    with compile_stage("heal.warm"):
+        for _ in range(50):
+            record_synthetic_compile(0.01)
+    assert sup.tick() == "healthy"
+
+
+def test_breaker_open_is_a_signal():
+    br = CircuitBreaker(edge="scorer", min_calls=1, failure_ratio=0.5,
+                        cooldown_s=60.0)
+    sup = make_sup(make_scorer(), breaker=br, suspect_strikes=1)
+    br.record_failure()
+    assert br.state == "open"
+    assert sup.tick() == "quarantined"
+    assert any("breaker" in r for r in sup.status()["reasons"])
+
+
+# -- heal ladder + warm re-promotion ------------------------------------------
+
+
+def test_heal_ladder_escalates_rungs_with_backoff():
+    reg = Registry()
+    sup = make_sup(make_scorer(), registry=reg, suspect_strikes=1)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    # fault stays active: every rung fails; the ladder must escalate
+    # canary_retry -> reinit -> respawn and stay on respawn
+    deadline = time.monotonic() + 10.0
+    while (sup.status()["rung"] != RUNGS[-1]
+           and time.monotonic() < deadline):
+        sup.tick()
+        time.sleep(0.02)
+    assert sup.status()["rung"] == "respawn"
+    attempts = reg.counter("ccfd_heal_attempts_total")
+    assert attempts.value({"rung": "canary_retry"}) >= 1
+    assert attempts.value({"rung": "reinit"}) >= 1
+    faults.install_device_faults(None)
+    assert heal_until(sup, "healthy")
+
+
+def test_repromotion_is_warm_no_serving_compiles_after_flip():
+    prof = StageProfiler(registry=Registry())
+    prof.arm_compile_listener()
+    sc = make_scorer()
+    sup = make_sup(sc, profiler=prof, suspect_strikes=1)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    faults.install_device_faults(None)
+    assert heal_until(sup, "healthy")
+    counts = prof.compile_counts()
+    serving_before = sum(
+        v for s, v in counts.items()
+        if s not in ("total", "heal.warm", "scorer.warmup"))
+    # serve through the healed path: no new executable may compile
+    sc.score_pipelined(np.zeros((128, sc.num_features), np.float32))
+    counts = prof.compile_counts()
+    serving_after = sum(
+        v for s, v in counts.items()
+        if s not in ("total", "heal.warm", "scorer.warmup"))
+    assert serving_after == serving_before
+
+
+def test_probation_requires_n_canaries_and_failure_requarantines():
+    sup = make_sup(make_scorer(), suspect_strikes=1, probation_canaries=3)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    faults.install_device_faults(None)
+    assert heal_until(sup, "probation")
+    assert not sup.device_allowed()  # probation still pins the ladder
+    assert sup.tick() == "probation"  # 2nd pass of 3 — still probation
+    # a failure mid-probation re-quarantines (and it's a flap candidate)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    assert sup.quarantines == 2
+
+
+def test_parity_check_blocks_promotion_of_a_scrambled_device():
+    sc = make_scorer()
+    sup = make_sup(sc, suspect_strikes=1)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    faults.install_device_faults(None)
+    # scramble the DEVICE path only: the probation parity check must
+    # catch that device scores no longer agree with the host forward
+    orig = sc.score_pipelined
+    sc.score_pipelined = lambda x, depth=2: np.clip(
+        orig(x, depth) + 0.5, 0.0, 1.0)
+    for _ in range(20):
+        state = sup.tick()
+        time.sleep(0.02)
+        if state == "probation":
+            break
+    state = sup.tick()  # parity canary runs here
+    assert state == "quarantined"
+    assert any("parity" in r for r in sup.status()["reasons"])
+    sc.score_pipelined = orig
+    assert heal_until(sup, "healthy")
+
+
+def test_flap_hysteresis_deepens_backoff():
+    clock = [0.0]
+    sup = make_sup(make_scorer(), suspect_strikes=1, probation_canaries=1,
+                   backoff_base_s=1.0, backoff_cap_s=64.0,
+                   flap_window_s=100.0, clock=lambda: clock[0])
+    plan_on = lambda: faults.install_device_faults(  # noqa: E731
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+
+    def cycle():
+        plan_on()
+        assert sup.tick() == "quarantined"
+        first_wait = sup._next_heal_at - clock[0]
+        faults.install_device_faults(None)
+        clock[0] = sup._next_heal_at + 0.01
+        assert sup.tick() == "probation"
+        assert sup.tick() == "healthy"
+        return first_wait
+
+    w1 = cycle()
+    clock[0] += 1.0  # re-quarantine right after the promote: a flap
+    w2 = cycle()
+    assert w2 > w1  # the flap streak starts the backoff ladder deeper
+    assert sup.status()["flap_streak"] == 1
+
+
+# -- router pinning -----------------------------------------------------------
+
+
+class FakeGate:
+    def __init__(self, allowed):
+        self.allowed = allowed
+
+    def device_allowed(self):
+        return self.allowed
+
+
+def make_router(score_fn, gate=None, breaker=None, cfg=None):
+    cfg = cfg or Config(confidence_threshold=1.0)
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, None)
+    sc = make_scorer()
+    r = Router(cfg, broker, score_fn, engine, reg, max_batch=256,
+               host_score_fn=sc.host_score, breaker=breaker, degrade=True,
+               heal_gate=gate)
+    return r, broker, reg, cfg
+
+
+def test_quarantine_pins_router_ladder_to_host_tier():
+    calls = [0]
+
+    def device_score(x):
+        calls[0] += 1
+        return np.zeros((len(x),), np.float32)
+
+    gate = FakeGate(allowed=False)
+    r, broker, reg, cfg = make_router(device_score, gate=gate)
+    broker.produce_batch(cfg.kafka_topic,
+                         [b"0," * 29 + b"0"] * 32, list(range(32)))
+    assert r.step() == 32
+    assert calls[0] == 0  # the device tier was never touched
+    assert reg.counter("router_degraded_total").value(
+        {"tier": "host"}) == 32
+    gate.allowed = True
+    broker.produce_batch(cfg.kafka_topic,
+                         [b"0," * 29 + b"0"] * 8, list(range(8)))
+    assert r.step() == 8
+    assert calls[0] >= 1  # unpinned: the device serves again
+    r.close()
+
+
+def test_breaker_half_open_probe_does_not_leak_during_quarantine():
+    """ISSUE 11 satellite: an OPEN breaker past its cooldown admits
+    half-open probes — but while the device is QUARANTINED the heal
+    gate sits above the breaker, so not even the probe slot may route
+    live traffic to the sick device."""
+    calls = [0]
+
+    def device_score(x):
+        calls[0] += 1
+        return np.zeros((len(x),), np.float32)
+
+    clock = [0.0]
+    br = CircuitBreaker(edge="scorer", min_calls=1, failure_ratio=0.5,
+                        cooldown_s=0.1, seed=3, clock=lambda: clock[0])
+    br.record_failure()
+    clock[0] += 10.0  # past the cooldown: allow() would admit a probe
+    assert br.state == "half_open"
+    gate = FakeGate(allowed=False)
+    r, broker, reg, cfg = make_router(device_score, gate=gate, breaker=br)
+    broker.produce_batch(cfg.kafka_topic,
+                         [b"0," * 29 + b"0"] * 16, list(range(16)))
+    assert r.step() == 16
+    assert calls[0] == 0  # the half-open probe slot did NOT leak
+    assert br.state == "half_open"  # and the probe slot was not consumed
+    r.close()
+
+
+def test_set_heal_gate_post_construction_and_parallel_fanout():
+    from ccfd_tpu.router.parallel import ParallelRouter
+
+    cfg = Config(confidence_threshold=1.0)
+    broker = Broker(default_partitions=2)
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, None)
+    sc = make_scorer()
+    pr = ParallelRouter(cfg, broker, sc.score, engine, reg, workers=2,
+                        host_score_fn=sc.host_score, degrade=True)
+    gate = FakeGate(allowed=False)
+    pr.set_heal_gate(gate)
+    assert all(w._heal_gate is gate for w in pr.workers)
+    broker.produce_batch(cfg.kafka_topic,
+                         [b"0," * 29 + b"0"] * 64, list(range(64)))
+    assert pr.step() == 64
+    assert reg.counter("router_degraded_total").value(
+        {"tier": "host"}) == 64
+    pr.close()
+
+
+def test_seq_scorer_heals_through_its_own_dispatch_seam():
+    """The seq family rides the same machinery: its chunk-loop dispatch
+    seam carries device_hang, its canary goes through SeqScorer.score,
+    and warm re-promotion precompiles the (L, B) grid via warmup()."""
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.serving.history import SeqScorer
+
+    params = seq_mod.init(jax.random.PRNGKey(0))
+    sc = SeqScorer(params, length=8, batch_sizes=(16, 64),
+                   compute_dtype="float32")
+    sc.warmup()
+    sup = make_sup(sc, suspect_strikes=1, probation_canaries=1,
+                   canary_deadline_ms=400.0)
+    assert sup.tick() == "healthy"
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=900"))
+    assert sup.tick() == "quarantined"
+    faults.install_device_faults(None)
+    assert heal_until(sup, "healthy")
+    assert sup.repromotions == 1
+
+
+# -- canary watchdog integration ---------------------------------------------
+
+
+def test_canary_rides_overload_watchdog_and_counts_timeouts():
+    from ccfd_tpu.runtime.overload import OverloadControl
+
+    reg = Registry()
+    ov = OverloadControl.from_config(
+        Config(), reg, max_batch=256, workers=1)
+    ov.dispatch_deadline_s = 30.0  # serving deadline is generous...
+    sup = make_sup(make_scorer(), overload=ov, suspect_strikes=1,
+                   canary_deadline_ms=100.0)  # ...the canary's is not
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=500"))
+    assert sup.tick() == "quarantined"
+    # the canary kill shares the serving watchdog's timeout counter
+    assert reg.counter("ccfd_dispatch_timeout_total").value() >= 1
+
+
+# -- heal vs recovery races (ISSUE 11 satellite) ------------------------------
+
+
+def _lifecycle_fixture(tmp_path):
+    from ccfd_tpu.lifecycle.controller import (
+        Guardrails,
+        LifecycleController,
+    )
+    from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+    from ccfd_tpu.lifecycle.shadow import ShadowTap
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    cfg = Config()
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    sc = make_scorer()
+    lc = LifecycleController(
+        cfg, sc,
+        store=VersionStore(str(tmp_path / "versions.json")),
+        checkpoints=CheckpointManager(str(tmp_path / "ckpts"), keep=16),
+        shadow=ShadowTap(sc, broker, cfg.shadow_topic, reg),
+        evaluator=ShadowEvaluator(cfg, broker, sc, reg),
+        guardrails=Guardrails(min_labels=1, min_shadow_rows=1,
+                              min_submit_interval_s=0.0),
+        registry=reg,
+    )
+    return lc, sc, broker
+
+
+def test_respawn_restores_champion_checkpoint(tmp_path):
+    lc, sc, broker = _lifecycle_fixture(tmp_path)
+    champion = jax.tree.map(np.asarray, lc._champion_params)
+    # drift the serving params away from the champion (as a wedged device
+    # epoch might leave them)
+    drifted = jax.tree.map(lambda a: a + 0.25 if a.dtype.kind == "f" else a,
+                           champion)
+    sc.swap_params(drifted)
+    sup = make_sup(sc, respawn_fn=lc.restore_champion, suspect_strikes=1)
+    sup._respawn()
+    served = jax.tree.map(np.asarray, sc.params)
+    for a, b in zip(jax.tree.leaves(served), jax.tree.leaves(champion)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    lc.close()
+    broker.close()
+
+
+def test_respawn_racing_rollback_leaves_champion_serving(tmp_path):
+    """The PR 4 end-state assertion, extended: a champion-checkpoint
+    respawn racing a canary rollback must leave serving params equal to
+    the champion checkpoint — whichever side runs second re-asserts one
+    complete champion tree."""
+    lc, sc, broker = _lifecycle_fixture(tmp_path)
+    champion = jax.tree.map(np.asarray, lc._champion_params)
+    cand = jax.tree.map(lambda a: a + 0.1 if a.dtype.kind == "f" else a,
+                        champion)
+    lc.submit_candidate(cand, label_watermark=0)
+    lc.gate.activate(0.1)  # force a live canary slice
+    lc._set_stage(2)
+
+    from ccfd_tpu.lifecycle.evaluator import EvalSnapshot
+
+    snap = EvalSnapshot(version=lc.candidate, n_labels=0, n_shadow_rows=0,
+                        auc_champion=0.5, auc_challenger=0.5,
+                        precision_champion=0.0, precision_challenger=0.0,
+                        alert_rate_champion=0.0, alert_rate_challenger=0.0,
+                        alert_rate_delta=0.0, score_psi=0.0)
+    stop = threading.Event()
+    errors = []
+
+    def respawn_loop():
+        while not stop.is_set():
+            try:
+                lc.restore_champion()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    t = threading.Thread(target=respawn_loop, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with lc._mu:
+        lc._rollback(snap, ["drill: forced rollback"])
+    time.sleep(0.05)
+    stop.set()
+    t.join(timeout=5)
+    assert not errors
+    served = jax.tree.map(np.asarray, sc.params)
+    for a, b in zip(jax.tree.leaves(served), jax.tree.leaves(champion)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    assert lc.serving_consistent()
+    assert sc.challenger_version is None
+    assert not lc.gate.active
+    lc.close()
+    broker.close()
+
+
+# -- operator wiring ----------------------------------------------------------
+
+
+def _platform_cr(extra_heal=None):
+    cr = {"spec": {
+        "store": {"enabled": False},
+        "producer": {"enabled": False},
+        "investigator": {"enabled": False},
+        "analytics": {"enabled": False},
+        "retrain": {"enabled": False},
+        "lifecycle": {"enabled": False},
+        "monitoring": {"enabled": True, "port": 0},
+        "health": {"enabled": False},
+        "scorer": {"enabled": True, "model": "mlp"},
+    }}
+    if extra_heal is not None:
+        cr["spec"]["heal"] = extra_heal
+    return cr
+
+
+def test_operator_heal_default_on_and_gate_wired():
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    spec = PlatformSpec.from_cr(_platform_cr(), cfg=Config())
+    p = Platform(spec).up(wait_ready_s=30)
+    try:
+        assert p.heal is not None
+        assert p.router._heal_gate is p.heal
+        assert "heal" in p.supervisor.status()
+        assert p.supervisor.status()["heal"]["state"] == "Running"
+        # the gauge family reaches the scraped surface
+        assert "ccfd_device_health" in p.registries["heal"].render()
+    finally:
+        p.down()
+
+
+def test_operator_heal_kill_switch():
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    # env kill switch
+    spec = PlatformSpec.from_cr(
+        _platform_cr(), cfg=Config.from_env({"CCFD_HEAL": "0"}))
+    p = Platform(spec).up(wait_ready_s=30)
+    try:
+        assert p.heal is None
+        assert p.router._heal_gate is None
+    finally:
+        p.down()
+    # CR kill switch
+    spec = PlatformSpec.from_cr(_platform_cr({"enabled": False}),
+                                cfg=Config())
+    p = Platform(spec).up(wait_ready_s=30)
+    try:
+        assert p.heal is None
+    finally:
+        p.down()
+
+
+def test_operator_installs_device_fault_plan_from_chaos_block():
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = _platform_cr()
+    cr["spec"]["chaos"] = {"enabled": True, "targets": [],
+                           "device_faults": "device_hang:ms=50",
+                           "interval_s": 3600.0}
+    spec = PlatformSpec.from_cr(cr, cfg=Config())
+    p = Platform(spec).up(wait_ready_s=30)
+    try:
+        assert p.device_fault_plan is not None
+        assert faults.device_faults() is p.device_fault_plan
+        assert p.device_fault_plan.kinds["device_hang"].hang_ms == 50.0
+    finally:
+        p.down()
+    assert faults.device_faults() is None  # down() uninstalls
+
+
+def test_config_heal_knobs_from_env():
+    cfg = Config.from_env({
+        "CCFD_HEAL_INTERVAL_S": "1.5",
+        "CCFD_HEAL_CANARY_DEADLINE_MS": "99",
+        "CCFD_HEAL_SUSPECT_STRIKES": "5",
+        "CCFD_HEAL_PROBATION_CANARIES": "7",
+        "CCFD_HEAL_OOM_RATIO": "0.5",
+        "CCFD_DEVICE_FAULTS": "put_fail",
+    })
+    assert cfg.heal_enabled
+    assert cfg.heal_interval_s == 1.5
+    assert cfg.heal_canary_deadline_ms == 99.0
+    assert cfg.heal_suspect_strikes == 5
+    assert cfg.heal_probation_canaries == 7
+    assert cfg.heal_oom_ratio == 0.5
+    assert cfg.device_faults_spec == "put_fail"
+
+
+def test_state_names_cover_machine():
+    assert set(STATE_NAMES.values()) == {
+        "healthy", "suspect", "quarantined", "probation"}
+
+
+# -- review regressions (round 11) --------------------------------------------
+
+
+def test_warm_failure_escalates_ladder_instead_of_looping_rung0():
+    # canary passes but the warm step fails: the mid-heal re-quarantine
+    # must ESCALATE the rung (reinit/respawn are what could fix a
+    # warm-only failure, e.g. allocator pressure only the big buckets
+    # hit), not reset the ladder to rung 0 forever
+    sc = make_scorer()
+    sup = make_sup(sc, suspect_strikes=1, probation_canaries=1)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    faults.install_device_faults(None)
+    orig_warm = sc.warmup
+
+    def boom():
+        raise RuntimeError("warm boom")
+
+    sc.warmup = boom
+    rungs_seen = set()
+    for _ in range(12):
+        time.sleep(0.06)
+        sup.tick()
+        rungs_seen.add(sup.status()["rung"])
+    assert {"reinit", "respawn"} <= rungs_seen
+    sc.warmup = orig_warm
+    assert heal_until(sup, "healthy")
+
+
+def test_repromotion_force_closes_open_breaker():
+    # record_success from OPEN is a state no-op: without force_close the
+    # residual cooldown both refuses the healed device and re-strikes it
+    # as fresh quarantine evidence on the next tick
+    clock = [0.0]
+    br = CircuitBreaker(edge="scorer", min_calls=1, failure_ratio=0.01,
+                        cooldown_s=30.0, cooldown_max_s=60.0,
+                        clock=lambda: clock[0])
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    sup = make_sup(make_scorer(), breaker=br, suspect_strikes=1,
+                   probation_canaries=1)
+    faults.install_device_faults(
+        faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+    assert sup.tick() == "quarantined"
+    faults.install_device_faults(None)
+    assert heal_until(sup, "healthy")
+    assert br.state == "closed" and br.allow()
+    assert sup.tick() == "healthy"  # no breaker strike from the cooldown
+
+
+def test_chaos_monkey_storm_drives_device_plan():
+    from ccfd_tpu.runtime.chaos import ChaosMonkey
+
+    dev = faults.DeviceFaultPlan.from_string(
+        "device_hang:ms=1", active=False)
+    m = ChaosMonkey(None, device_fault_plan=dev)
+    m.fault_storm(duration_s=0.02)
+    assert dev.activations == 1  # the window toggled the device plan
+    assert not dev.active        # and closed it again
+
+
+def test_storm_scheduled_device_plan_reaches_monkey_env_plan_stays_active():
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    # CR-configured device faults under a storm interval: built inactive
+    # and handed to the ChaosMonkey, whose windows duty-cycle it
+    cr = _platform_cr()
+    cr["spec"]["chaos"] = {"enabled": True, "targets": [],
+                           "device_faults": "device_hang:ms=1",
+                           "interval_s": 3600.0,
+                           "fault_interval_s": 3600.0}
+    spec = PlatformSpec.from_cr(cr, cfg=Config())
+    p = Platform(spec).up(wait_ready_s=30)
+    try:
+        assert p.device_fault_plan is not None
+        assert not p.device_fault_plan.active
+        assert p.chaos is not None
+        assert p.chaos._device_fault_plan is p.device_fault_plan
+        p.chaos.fault_storm(duration_s=0.01)
+        assert p.device_fault_plan.activations >= 1
+        assert not p.device_fault_plan.active
+    finally:
+        p.down()
+    # a standing CCFD_DEVICE_FAULTS env plan must stay ACTIVE even when
+    # the CR schedules edge-fault storms (and the monkey must not own it)
+    cr2 = _platform_cr()
+    cr2["spec"]["chaos"] = {"enabled": True, "targets": [],
+                            "interval_s": 3600.0,
+                            "fault_interval_s": 3600.0}
+    cfg = Config.from_env({"CCFD_DEVICE_FAULTS": "device_hang:ms=1"})
+    spec2 = PlatformSpec.from_cr(cr2, cfg=cfg)
+    p2 = Platform(spec2).up(wait_ready_s=30)
+    try:
+        assert p2.device_fault_plan is not None
+        assert p2.device_fault_plan.active
+        assert p2.chaos is not None
+        assert p2.chaos._device_fault_plan is None
+    finally:
+        p2.down()
+
+
+def test_failed_unsampled_put_does_not_count_h2d_bytes():
+    from ccfd_tpu.observability.device import timed_put
+
+    tele = DeviceTelemetry(registry=Registry(), sample_every=4)
+
+    def boom():
+        raise ConnectionError("put failed")
+
+    with pytest.raises(ConnectionError):
+        timed_put(tele, 1024, boom)  # seq 1 of 4: the unsampled branch
+    assert tele.h2d_failures() == 1
+    assert tele.snapshot()["h2d"]["bytes_total"] == 0
+    timed_put(tele, 512, lambda: np.zeros(1))
+    assert tele.snapshot()["h2d"]["bytes_total"] == 512
+
+
+def test_device_oom_overlay_counts_once_per_activation_window():
+    plan = faults.DeviceFaultPlan.from_string("device_oom:ratio=0.97")
+    faults.install_device_faults(plan)
+    for _ in range(5):
+        DeviceTelemetry.device_memory()  # every scrape/heal tick reads
+    assert plan.injected.get("device_oom", 0) == 1
+    plan.deactivate()
+    plan.activate()
+    DeviceTelemetry.device_memory()
+    assert plan.injected["device_oom"] == 2
+
+
+def test_heal_gate_pins_even_with_ladder_off():
+    # router.degrade=false must not void the quarantine pin: the gate
+    # falls to the always-available rules tier instead of the device
+    reg = Registry()
+    calls = {"n": 0}
+
+    def score(x):
+        calls["n"] += 1
+        return np.zeros(len(x), np.float32)
+
+    r, _, _, _ = make_router(score, gate=FakeGate(False))
+    r._degrade = False
+    x = np.zeros((4, 30), np.float32)
+    out = r._score_batch(x, [object()] * 4)
+    assert calls["n"] == 0  # zero rows touched the quarantined device
+    assert out.shape == (4,)
+    r2, _, _, _ = make_router(score, gate=FakeGate(True))
+    r2._degrade = False
+    r2._score_batch(x, [object()] * 4)
+    assert calls["n"] == 1  # gate open: the direct path serves
+    del reg
+
+
+def test_put_failure_baseline_reads_live_telemetry():
+    reg = Registry()
+    tele = DeviceTelemetry(registry=reg, sample_every=1)
+    tele.record_h2d_failure()
+    tele.record_h2d_failure()  # history that predates the supervisor
+    sup = make_sup(make_scorer(), telemetry=tele)
+    assert sup._prev_put_failures == 2
+    assert sup.tick() == "healthy"  # stale failures are not fresh strikes
+    assert not any("put_fail" in s for s in sup.status()["reasons"])

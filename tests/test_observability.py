@@ -99,6 +99,13 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_incident_snapshots_total",
     "ccfd_incidents_total",
     "ccfd_incident_ring_size",
+    # round 14: device self-healing — health state machine, canary, heal
+    # ladder, warm re-promotion (runtime/heal.py)
+    "ccfd_device_health",
+    "ccfd_heal_transitions_total",
+    "ccfd_heal_attempts_total",
+    "ccfd_heal_canary_total",
+    "ccfd_h2d_put_failures_total",
 ]
 
 
@@ -117,6 +124,7 @@ def test_dashboards_cover_contract_metrics():
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
         "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
+        "Heal",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

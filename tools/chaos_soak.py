@@ -285,6 +285,20 @@ def main() -> int:
                     "pause barrier, shared in-flight budget, coalesced "
                     "dispatch — under the same kills; 1 = the historical "
                     "single router")
+    ap.add_argument("--device-faults", action="store_true",
+                    help="ISSUE 11: drill the DEVICE as the fault target "
+                    "— a DeviceSupervisor (runtime/heal.py) supervises "
+                    "the scorer while device-fault storms "
+                    "(runtime/faults.py device_hang et al.) wedge it; "
+                    "each storm must reach QUARANTINED with the host "
+                    "tier serving (zero accounting violations), heal "
+                    "through the ladder, and re-promote WARM (no "
+                    "serving-stage XLA compiles after the flip)")
+    ap.add_argument("--device-fault-spec", default="device_hang:ms=400",
+                    help="CCFD_DEVICE_FAULTS-syntax plan the device "
+                    "storms activate")
+    ap.add_argument("--device-fault-interval-s", type=float, default=20.0,
+                    help="seconds between device-fault storm windows")
     ap.add_argument("--lifecycle", action="store_true",
                     help="run the model-lifecycle controller (lifecycle/) "
                     "under the storm: candidates cycle through shadow/"
@@ -372,8 +386,10 @@ def main() -> int:
         net_injector = fault_plan.injector("scorer", reg_r)
         if net_injector is not None:
             score_fn = net_injector.wrap_fn(scorer.score)
-        if scorer.has_host_forward:
-            host_fn = scorer.host_score
+    if (args.net_faults or args.device_faults) and scorer.has_host_forward:
+        # both degraded-edge and sick-device drills need the ladder's
+        # host tier armed: quality degrades, progress never stops
+        host_fn = scorer.host_score
     # -- model lifecycle under the storm (--lifecycle) ---------------------
     # The governed-rollout machinery (lifecycle/) runs THROUGH the kills:
     # a submitter cycles perturbed candidates through shadow -> canary ->
@@ -440,6 +456,7 @@ def main() -> int:
         overload.dispatch_deadline_s = max(0.05,
                                            args.deadline_ms * 0.8 / 1e3)
         overload.recorder = recorder
+    degrade = True if (args.net_faults or args.device_faults) else None
     if args.workers > 1:
         # partition-parallel fan-out: the workers split the topic's
         # partitions, share ONE in-flight budget + breaker + coalescing
@@ -452,14 +469,46 @@ def main() -> int:
             cfg, broker, score_fn, engine, reg_r, workers=args.workers,
             max_batch=4096, host_score_fn=host_fn,
             breaker=lifecycle_breaker,
-            degrade=True if args.net_faults else None,
+            degrade=degrade,
             overload=overload)
     else:
         router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
                         host_score_fn=host_fn,
                         breaker=lifecycle_breaker,
-                        degrade=True if args.net_faults else None,
+                        degrade=degrade,
                         overload=overload)
+    # -- device self-healing under storms (--device-faults, ISSUE 11) ------
+    # The DeviceSupervisor owns the soak's scorer: device-fault storms
+    # (scheduled below, interleaved with the service kills) must drive the
+    # full ladder — wedge injected -> QUARANTINED (router pinned to the
+    # host tier, accounting still conserving) -> heal -> WARM re-promotion
+    # (no serving-stage compiles after the flip) -> device serving again.
+    healer = None
+    device_plan = None
+    heal_prof = None
+    device_cycles: list[dict] = []
+    if args.device_faults:
+        from ccfd_tpu.observability.profile import StageProfiler  # noqa: E402
+        from ccfd_tpu.runtime.faults import (  # noqa: E402
+            DeviceFaultPlan,
+            install_device_faults,
+        )
+        from ccfd_tpu.runtime.heal import DeviceSupervisor  # noqa: E402
+
+        heal_prof = StageProfiler(registry=reg_r)
+        heal_prof.arm_compile_listener()
+        device_plan = DeviceFaultPlan.from_string(args.device_fault_spec,
+                                                  seed=17, active=False)
+        install_device_faults(device_plan)
+        healer = DeviceSupervisor(
+            scorer, registry=reg_r,
+            breaker=getattr(router, "_breaker", None),
+            profiler=heal_prof, recorder=recorder, overload=overload,
+            canary_deadline_ms=min(150.0, args.deadline_ms * 0.6),
+            suspect_strikes=2, probation_canaries=2,
+            backoff_base_s=0.1, backoff_cap_s=1.0,
+        )
+        router.set_heal_gate(healer)
     coord = CheckpointCoordinator(router, broker, engine_factory,
                                   interval_s=args.checkpoint_s)
     sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
@@ -486,6 +535,10 @@ def main() -> int:
         bus_booted[0] = True
 
     sup.add_thread_service("bus", bus_run, bus_stop.set, reset=bus_reset)
+    if healer is not None:
+        sup.add_thread_service(
+            "heal", lambda: healer.run(interval_s=0.3), healer.stop,
+            reset=healer.reset)
     if lifecycle is not None:
         sup.add_thread_service(
             "lifecycle", lambda: lifecycle.run(interval_s=0.25),
@@ -660,6 +713,60 @@ def main() -> int:
                          fault_duration_s=args.fault_duration_s)
     monkey.start()
 
+    # -- device-fault storm windows (--device-faults) ----------------------
+    # Interleaved with the service kills above: each window activates the
+    # device plan, requires the healer to QUARANTINE, deactivates, then
+    # requires a heal to HEALTHY followed by a 2 s serving window with
+    # ZERO serving-stage compiles (the warm-re-promotion proof).
+    df_stop = threading.Event()
+    df_thread = None
+    if healer is not None:
+        from ccfd_tpu.runtime.heal import (  # noqa: E402
+            NON_SERVING_COMPILE_STAGES,
+        )
+
+        def serving_compiles() -> int:
+            return sum(v for s, v in heal_prof.compile_counts().items()
+                       if s not in NON_SERVING_COMPILE_STAGES)
+
+        def device_storm_loop() -> None:
+            while not df_stop.wait(args.device_fault_interval_s):
+                if wedged.is_set():
+                    continue  # the midpoint wedge is its own drill
+                cycle = {"at_tx": int(router._c_in.value())}
+                device_plan.activate()
+                t_q = time.time()
+                while (healer.state != "quarantined"
+                       and time.time() - t_q < 20
+                       and not df_stop.is_set()):
+                    time.sleep(0.1)
+                cycle["quarantined"] = healer.state == "quarantined"
+                device_plan.deactivate()
+                t_h = time.time()
+                while (healer.state != "healthy"
+                       and time.time() - t_h < 60
+                       and not df_stop.is_set()):
+                    time.sleep(0.1)
+                cycle["healed"] = healer.state == "healthy"
+                base = serving_compiles()
+                t_w = time.time()
+                while time.time() - t_w < 2.0 and not df_stop.is_set():
+                    time.sleep(0.1)
+                cycle["warm"] = bool(cycle["healed"]
+                                     and serving_compiles() == base)
+                cycle["healed_at_tx"] = int(router._c_in.value())
+                if df_stop.is_set() and not (
+                        cycle["quarantined"] and cycle["healed"]):
+                    # shutdown truncated this window mid-wait: the cycle
+                    # never got its 20/60 s budget, so recording it would
+                    # fail the exit gate on timing, not on behavior
+                    break
+                device_cycles.append(cycle)
+
+        df_thread = threading.Thread(target=device_storm_loop, daemon=True,
+                                     name="soak-device-storms")
+        df_thread.start()
+
     def rss_mb() -> float:
         try:
             with open("/proc/self/status") as f:
@@ -704,6 +811,11 @@ def main() -> int:
             wedge_info["recovered_s_after_heal"] = round(time.time() - t_rec, 1)
             wedge_info["device_path_recovered"] = not scorer._wedge.wedged
 
+    df_stop.set()
+    if df_thread is not None:
+        df_thread.join(timeout=10)
+    if device_plan is not None:
+        device_plan.deactivate()
     stop_feed.set()
     investigator.stop()
     invest_thread.join(timeout=10)
@@ -896,6 +1008,20 @@ def main() -> int:
                 if s.get("reason") == "dispatch_timeout"),
         },
         "lifecycle": lifecycle_res,
+        # device heal evidence (runtime/heal.py): each storm cycle must
+        # have quarantined, healed and re-promoted WARM
+        "device_heal": {
+            "enabled": bool(args.device_faults),
+            "spec": args.device_fault_spec if args.device_faults else "",
+            "cycles": device_cycles,
+            "quarantines": healer.quarantines if healer else 0,
+            "repromotions": healer.repromotions if healer else 0,
+            "canary_failures": healer.canary_failures if healer else 0,
+            "final_state": healer.state if healer else "",
+            "health_gauge_exported": (
+                "ccfd_device_health" in reg_r.render()
+                if healer else False),
+        },
         "tasks_completed_by_investigators": investigator.completed,
         "net_faults": {
             "enabled": bool(args.net_faults),
@@ -960,6 +1086,21 @@ def main() -> int:
                 and lifecycle_res.get("challenger_cleared")
                 and lifecycle_res.get("gate_inactive")
                 and lifecycle_res.get("versions", 0) > 1
+            )
+        )
+        and (
+            not args.device_faults
+            or (
+                # the full heal ladder, end to end, every storm window:
+                # wedge injected -> QUARANTINED (host tier serving, the
+                # acct_ok above proving zero violations) -> healed ->
+                # WARM re-promotion (no serving-stage compiles after the
+                # flip) -> device serving again at the end
+                len(device_cycles) > 0
+                and all(c["quarantined"] and c["healed"] and c["warm"]
+                        for c in device_cycles)
+                and result["device_heal"]["final_state"] == "healthy"
+                and result["device_heal"]["health_gauge_exported"]
             )
         )
         and (

@@ -432,7 +432,16 @@ def run_flash(seconds: float, slo_ms: float, base_rate: float) -> dict:
         out["violations"].append(
             "stage profiler recorded no samples — the queueing-layer "
             "claim has no evidence")
-    elif max(shares, key=shares.get) != "queue":
+    elif (shares["queue"] < 0.30
+          or shares["queue"] < 2.0 * (shares["decode"] + shares["route"])):
+        # the claim is "backpressure parked the crowd in the BUS and the
+        # service layers didn't inflate" — NOT "bus wait outweighs device
+        # compute": on CPU CI the dispatch share tracks host scheduling
+        # load (a strict arg-max over all four shares flips on a busy
+        # machine with no backpressure failure behind it). A real failure
+        # still trips this form: crowd not parked -> the queue share
+        # collapses toward zero; service-time inflation -> decode/route
+        # swallow the budget (and the p99 check catches the rest)
         out["violations"].append(
             f"flash budget burn not concentrated in the queueing layer: "
             f"{shares}")

@@ -55,6 +55,21 @@
 #                                          measured (non-placeholder)
 #                                          values:
 #                                          INCIDENTSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --heal-smoke     exit-code-gated smoke of the
+#                                          device self-healing plane
+#                                          (tools/heal_smoke.py): an
+#                                          injected device_hang reaches
+#                                          QUARANTINED with the host tier
+#                                          serving and accounting
+#                                          conserved, the heal ladder
+#                                          re-promotes WARM (zero
+#                                          serving-stage XLA compiles
+#                                          after the flip), one schema-
+#                                          valid FlightRecorder bundle
+#                                          per transition edge round-
+#                                          trips over real HTTP, and the
+#                                          health gauges scrape live:
+#                                          HEALSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -103,6 +118,17 @@ if [ "${1:-}" = "--incident-smoke" ]; then
     # the script prints INCIDENTSMOKE verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/incident_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--heal-smoke" ]; then
+    # exit-code-gated smoke of the device heal ladder: quarantine ->
+    # host-tier serving -> heal -> warm re-promotion, bundles + gauges
+    # over real HTTP (see tools/heal_smoke.py; prints HEALSMOKE verdict=)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/heal_smoke.py; then
         exit 0
     fi
     exit 1
