@@ -266,18 +266,23 @@ def _hop_buckets(top: int) -> tuple[int, ...]:
     return tuple(b for b in (16, 128, 1024, 4096) if b < top) + (int(top),)
 
 
-def _section_scorer(model, params, top, use_fused=None, host_tier_rows=0):
-    """The shared Scorer construction for the rest/zoo/quant sections:
+def _section_scorer(model, params, top, use_fused=None, host_tier_rows=0,
+                    partitioner=None):
+    """The shared Scorer construction for the rest/zoo/quant/mesh sections:
     same bucket ladder (:func:`_hop_buckets`), same bfloat16 compute
     dtype, differing ONLY in what the section is isolating (fused path
     on/off; host tier 0 for raw device-hop rates, None = auto for the
-    REST section, whose serving policy includes the host tier)."""
+    REST section, whose serving policy includes the host tier;
+    ``partitioner`` shards the same construction over a device mesh — the
+    devices=N scaling row and tools/multichip_scaling.py both build
+    through here so their numbers stay comparable)."""
     from ccfd_tpu.serving.scorer import Scorer
 
     kw = {} if use_fused is None else {"use_fused": use_fused}
     s = Scorer(
         model_name=model, params=params, batch_sizes=_hop_buckets(top),
-        compute_dtype="bfloat16", host_tier_rows=host_tier_rows, **kw,
+        compute_dtype="bfloat16", host_tier_rows=host_tier_rows,
+        partitioner=partitioner, **kw,
     )
     s.warmup()
     return s
@@ -564,36 +569,57 @@ def _bench_pipeline(scorer_params, seconds):
 
 
 def _bench_mesh(params, batch, seconds, depth):
-    """Mesh-sharded scoring over every available device (SURVEY.md §7
-    stage 6): the batch splits over the data axis, params replicated. Runs
-    when >1 device is visible (or a virtual CPU mesh is forced)."""
+    """devices=N scaling row (ROADMAP item 2, mirroring the PR 3
+    worker-scaling row): the SAME work through the SAME
+    :func:`_section_scorer` / :func:`_hop_buckets` construction at mesh
+    1x1 and on the full local mesh (data-parallel partitioner,
+    parallel/partition.py — the live platform's serving construction), so
+    the scaling ratio isolates what sharding adds. Records per-device
+    dispatch counts off the PR 10 executable inventory: on a mesh each
+    dispatch is ONE SPMD launch spanning every device, so the grid's
+    tallies ARE the per-device counts. Runs when >1 device is visible (or
+    a virtual CPU mesh is forced — there the efficiency column measures
+    sharding OVERHEAD, not speedup: all N virtual devices share the same
+    host cores; tools/multichip_scaling.py documents the confound)."""
     import jax
 
-    from ccfd_tpu.parallel.mesh import make_mesh
-    from ccfd_tpu.serving.scorer import Scorer
+    from ccfd_tpu.parallel.mesh import make_named_mesh
+    from ccfd_tpu.parallel.partition import DataParallelPartitioner
 
     n_dev = len(jax.devices())
     if n_dev < 2:
         return None
-    mesh = make_mesh(model_parallel=1)
-    scorer = Scorer(
-        model_name="mlp", params=params, batch_sizes=(batch,),
-        compute_dtype="bfloat16", mesh=mesh, use_fused=False,
-    )
-    scorer.warmup()
     from ccfd_tpu.data.ccfd import synthetic_dataset
 
-    # feed depth x batch rows per call: with a single (batch,) bucket each
+    # feed depth x batch rows per call: with a top (batch,) bucket each
     # call then splits into `depth` chunks whose dispatches actually
     # overlap — one bucket-sized call would drain before returning and
     # the pipelining knob would be inert
     x = synthetic_dataset(n=depth * batch, fraud_rate=0.01, seed=2).X
-    n_rows = 0
-    t0 = time.perf_counter()
-    while time.perf_counter() - t0 < seconds:
-        scorer.score_pipelined(x, depth=depth)
-        n_rows += depth * batch
-    return {"devices": n_dev, "tx_s": round(n_rows / (time.perf_counter() - t0), 1)}
+
+    def rate(scorer):
+        n_rows = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            scorer.score_pipelined(x, depth=depth)
+            n_rows += depth * batch
+        return n_rows / (time.perf_counter() - t0)
+
+    tx_single = rate(_section_scorer("mlp", params, batch))
+    part = DataParallelPartitioner(make_named_mesh(jax.devices()))
+    sharded = _section_scorer("mlp", params, batch, partitioner=part)
+    tx_mesh = rate(sharded)
+    grid = sharded.executable_grid()
+    scaling = tx_mesh / max(tx_single, 1e-9)
+    return {
+        "devices": n_dev,
+        "mesh_axes": grid.get("mesh_axes"),
+        "tx_s": round(tx_mesh, 1),
+        "single_tx_s": round(tx_single, 1),
+        "scaling_x": round(scaling, 2),
+        "efficiency": round(scaling / n_dev, 3),
+        "per_device_dispatches": grid["dispatches"],
+    }
 
 
 def _bench_retrain(seconds):
@@ -609,17 +635,20 @@ def _bench_retrain(seconds):
     from ccfd_tpu.parallel.train import TrainConfig, init_state, make_train_step
 
     n_dev = len(jax.devices())
-    mesh = None
+    partitioner = None
     if n_dev > 1:
-        from ccfd_tpu.parallel.mesh import make_mesh
+        # the live platform's retrain construction (parallel/partition.py):
+        # donated sharded state over the named data-parallel mesh
+        from ccfd_tpu.parallel.mesh import make_named_mesh
+        from ccfd_tpu.parallel.partition import DataParallelPartitioner
 
-        mesh = make_mesh(model_parallel=1)
+        partitioner = DataParallelPartitioner(make_named_mesh())
     ds = synthetic_dataset(n=4096, fraud_rate=0.2, seed=3)
     tc = TrainConfig(compute_dtype="bfloat16")
     params = mlp.init(jax.random.PRNGKey(0))
     params = mlp.set_normalizer(params, ds.X.mean(0), ds.X.std(0))
     state = init_state(params, tc)
-    step = make_train_step(tc, mesh=mesh)
+    step = make_train_step(tc, partitioner=partitioner)
     x = ds.X[:1024]
     y = ds.y[:1024].astype(np.float32)
     state, loss = step(state, x, y)  # compile
@@ -1562,7 +1591,8 @@ def compact_summary(result: dict) -> dict:
          "rows_per_request", "host_tier_rows", "errors")
     pick("pipeline", "tx_s", "paced_rate_tx_s", "p50_ms", "p99_ms",
          "workers", "workers_cpus", "shadow")
-    pick("mesh", "tx_s", "devices")
+    pick("mesh", "tx_s", "single_tx_s", "devices", "scaling_x",
+         "efficiency")
     pick("retrain", "steps_s", "labels_s", "final_loss")
     pick("seq", "histories_s", "batch", "seq_len")
     pick("seq_pipeline", "tx_s", "assembly_ms", "dispatch_ms",

@@ -259,6 +259,28 @@ class Config:
     # storm-scheduled form of the same syntax.
     device_faults_spec: str = ""
 
+    # --- multi-chip mesh serving (parallel/partition.py; CR block
+    # `mesh:`) ---
+    # device count for the serving/retrain mesh: 1 = single-device (the
+    # historical default), 0 = every local device, N = the first N.
+    # With >1 the operator builds the named (data, fsdp, tp) mesh, wraps
+    # it in a partitioner and serves data-parallel through the live
+    # stack (CCFD_MESH_DEVICES)
+    mesh_devices: int = 1
+    # fsdp / tensor-parallel axis sizes; the data axis absorbs the
+    # remainder (CCFD_MESH_FSDP / CCFD_MESH_TP)
+    mesh_fsdp: int = 1
+    mesh_tp: int = 1
+    # param layout: "replicated" (pure data parallel, the serving
+    # default) or "rules" (the model family's regex rule table over
+    # fsdp/tp — partition.mlp_rules/seq_rules) (CCFD_MESH_PARAM_PARTITION)
+    mesh_param_partition: str = "replicated"
+    # sequence-parallel attention for the seq family: none | ring |
+    # ulysses — shards attention L over the tp axis (the previously
+    # dormant ring_attention flag, now operator-selectable)
+    # (CCFD_MESH_SEQ_PARALLEL)
+    mesh_seq_parallel: str = "none"
+
     # --- sequence serving (serving/history.py; CR block `scorer.seq_*`) ---
     # HistoryStore stripe count: per-stripe locks keep ParallelRouter
     # workers from convoying on one global lock (CCFD_SEQ_STRIPES)
@@ -336,6 +358,14 @@ class Config:
         sizes = e.get("CCFD_BATCH_SIZES", "")
         seq_lb = e.get("CCFD_SEQ_LEN_BUCKETS", "")
         return Config(
+            mesh_devices=int(
+                e.get("CCFD_MESH_DEVICES", str(Config.mesh_devices))),
+            mesh_fsdp=int(e.get("CCFD_MESH_FSDP", str(Config.mesh_fsdp))),
+            mesh_tp=int(e.get("CCFD_MESH_TP", str(Config.mesh_tp))),
+            mesh_param_partition=e.get(
+                "CCFD_MESH_PARAM_PARTITION", Config.mesh_param_partition),
+            mesh_seq_parallel=e.get(
+                "CCFD_MESH_SEQ_PARALLEL", Config.mesh_seq_parallel),
             seq_stripes=int(e.get("CCFD_SEQ_STRIPES", str(Config.seq_stripes))),
             seq_inflight=int(
                 e.get("CCFD_SEQ_INFLIGHT", str(Config.seq_inflight))

@@ -260,7 +260,9 @@ class LifecycleController:
             # checkpoint must survive any number of later candidates
             checkpoints.pinned = {v.version}
             checkpoints.save(v.version, self._champion_params)
-            store.set_checkpoint(v.version, v.version)
+            store.set_checkpoint(
+                v.version, v.version,
+                checkpoint_hash=self._fingerprint(self._champion_params))
             store.set_stage(v.version, "CHAMPION", reason="bootstrap")
             self.champion = v.version
         else:
@@ -273,8 +275,28 @@ class LifecycleController:
             # champ.version serves — without this swap the audit trail
             # and the live model disagree after every restart
             self.scorer.swap_params(self._champion_params)
+            restored_hash = self._fingerprint(self._champion_params)
+            if (champ.checkpoint_hash is not None and restored_hash
+                    and restored_hash != champ.checkpoint_hash):
+                # the restored bytes are not the recorded champion: the
+                # checkpoint was GC'd/corrupted and the fallback (live
+                # scorer params) took over — serve, but say so loudly,
+                # and RE-STAMP the lineage record so the next restart of
+                # the now-stable tree doesn't re-raise the same alarm
+                # (the audit event below preserves the divergence)
+                log.error(
+                    "lifecycle restart: champion v%d checkpoint hash "
+                    "mismatch (recorded %s, restored %s) — serving the "
+                    "restored tree, lineage re-stamped",
+                    champ.version, champ.checkpoint_hash[:12],
+                    restored_hash[:12])
+                if champ.checkpoint_step is not None:
+                    store.set_checkpoint(champ.version,
+                                         champ.checkpoint_step,
+                                         checkpoint_hash=restored_hash)
             store.record_event(self.champion, "restart_restore",
-                               {"checkpoint": champ.checkpoint_step})
+                               {"checkpoint": champ.checkpoint_step,
+                                "checkpoint_hash": restored_hash})
             # interrupted candidates did not survive the restart
             # (challenger slot and gate state are process-local). Stage
             # vocabulary stays truthful: only a candidate that actually
@@ -292,7 +314,23 @@ class LifecycleController:
     # -- helpers -----------------------------------------------------------
     @staticmethod
     def _host_copy(params: Any) -> Any:
+        """Fully-gathered host copy — on a mesh, ``np.array`` of a sharded
+        ``jax.Array`` materializes the GLOBAL array (every serving mesh
+        here is single-process/fully-addressable), so checkpoints, hashes
+        and the challenger slot always see whole trees."""
         return jax.tree.map(lambda a: np.array(a), params)
+
+    @staticmethod
+    def _fingerprint(params: Any) -> str | None:
+        """Device-count-invariant checkpoint hash (sha256 over the
+        fully-gathered bytes, parallel/partition.params_fingerprint)."""
+        from ccfd_tpu.parallel.partition import params_fingerprint
+
+        try:
+            return params_fingerprint(params)
+        except Exception:  # noqa: BLE001 - provenance, not control flow
+            log.exception("lifecycle: params fingerprint failed")
+            return None
 
     def _restore_params(self, version) -> Any:
         """Champion params from its checkpoint; falls back to the scorer's
@@ -348,7 +386,9 @@ class LifecycleController:
             v = self.store.create(
                 parent=self.champion, label_watermark=label_watermark)
             self.checkpoints.save(v.version, staged)
-            self.store.set_checkpoint(v.version, v.version)
+            self.store.set_checkpoint(
+                v.version, v.version,
+                checkpoint_hash=self._fingerprint(staged))
             self._candidate = v.version
             self._candidate_params = staged
             self.scorer.install_challenger(v.version, staged)
@@ -564,7 +604,8 @@ class LifecycleController:
                              metrics=snap.to_dict())
         self.store.record_event(
             self.champion, "rollback_restore",
-            {"from_candidate": v, "checkpoint": champion.checkpoint_step})
+            {"from_candidate": v, "checkpoint": champion.checkpoint_step,
+             "checkpoint_hash": self._fingerprint(params)})
         if self._c_rolled_back is not None:
             self._c_rolled_back.inc()
         self._rebase_trainer()
@@ -586,7 +627,8 @@ class LifecycleController:
             self._champion_params = params
             self.store.record_event(
                 self.champion, "heal_respawn_restore",
-                {"checkpoint": champion.checkpoint_step})
+                {"checkpoint": champion.checkpoint_step,
+                 "checkpoint_hash": self._fingerprint(params)})
 
     def resolve_for_shutdown(self) -> None:
         """Deterministic quiesce: an in-flight candidate is withdrawn so

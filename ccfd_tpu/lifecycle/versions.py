@@ -46,6 +46,12 @@ class ModelVersion:
     stage: str = "TRAIN"
     label_watermark: int = 0
     checkpoint_step: int | None = None
+    # sha256 over the FULLY-GATHERED checkpoint bytes
+    # (parallel/partition.params_fingerprint): device-count-invariant —
+    # the same champion audits as the same hash whether its params served
+    # sharded over 8 chips or whole on one (ROADMAP item 2's provenance
+    # requirement under sharded serving)
+    checkpoint_hash: str | None = None
     created_at: float = 0.0
     metrics: dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -61,6 +67,8 @@ class ModelVersion:
             label_watermark=int(d.get("label_watermark", 0)),
             checkpoint_step=(None if d.get("checkpoint_step") is None
                              else int(d["checkpoint_step"])),
+            checkpoint_hash=(None if d.get("checkpoint_hash") is None
+                             else str(d["checkpoint_hash"])),
             created_at=float(d.get("created_at", 0.0)),
             metrics=dict(d.get("metrics", {})),
         )
@@ -231,9 +239,13 @@ class VersionStore:
             self._append_event_locked(version, event, detail or {})
             self._save_locked()
 
-    def set_checkpoint(self, version: int, checkpoint_step: int) -> None:
+    def set_checkpoint(self, version: int, checkpoint_step: int,
+                       checkpoint_hash: str | None = None) -> None:
         with self._mu:
-            self._versions[int(version)].checkpoint_step = int(checkpoint_step)
+            v = self._versions[int(version)]
+            v.checkpoint_step = int(checkpoint_step)
+            if checkpoint_hash is not None:
+                v.checkpoint_hash = str(checkpoint_hash)
             self._save_locked()
 
     def _append_event_locked(self, version: int | None, event: str,

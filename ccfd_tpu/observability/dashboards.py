@@ -597,6 +597,22 @@ def device_dashboard() -> dict:
                        "(each snapshots the ring)",
                     ["rate(ccfd_dispatch_timeout_total[5m])"],
                     red_above=0.1),
+        # -- Mesh row (ISSUE 12; parallel/partition.py): the multi-chip
+        # serving surface — device count + named axis sizes of the live
+        # mesh (absent/0 = unsharded single-device serving), and the
+        # publish path's health: every sharded param swap should pause
+        # the router pool at a batch boundary; a pause TIMEOUT means the
+        # publish went through under double-buffering only (the pool was
+        # not quiescent — investigate a wedged worker)
+        _panel(10, "Mesh devices (serving mesh; 0/absent = unsharded)",
+               ["ccfd_mesh_devices"], "stat"),
+        _panel(11, "Mesh axis sizes (data / fsdp / tp)",
+               ["ccfd_mesh_axis_size"], "stat"),
+        _panel(12, "Sharded param publishes / s (through the pause gate)",
+               ["rate(ccfd_mesh_publishes_total[5m])"]),
+        _alert_stat(13, "Publish pause timeouts / s (pool not quiescent)",
+                    ["rate(ccfd_mesh_publish_pause_timeouts_total[5m])"],
+                    red_above=0.01),
     ]
     return _dashboard("CCFD Device", "ccfd-device", p)
 

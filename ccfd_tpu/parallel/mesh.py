@@ -24,6 +24,14 @@ import numpy as np
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
+# first-class partitioning-layer axis names (parallel/partition.py): the
+# canonical data/fsdp/tp vocabulary the rule tables speak. ``MODEL_AXIS``
+# stays as the legacy 2-D mesh's second axis name; the named mesh below is
+# the serving platform's shape.
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+NAMED_AXES = (DATA_AXIS, FSDP_AXIS, TP_AXIS)
+
 
 def make_mesh(
     devices: list | None = None, model_parallel: int = 1
@@ -37,3 +45,24 @@ def make_mesh(
         )
     grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_named_mesh(
+    devices: list | None = None, fsdp: int = 1, tp: int = 1
+) -> Mesh:
+    """3-D ``(data, fsdp, tp)`` named mesh; data absorbs the remainder.
+
+    The partitioning layer's canonical shape (parallel/partition.py):
+    batches shard over ``data``, param rules speak ``fsdp``/``tp``. Axes
+    an operator leaves at 1 cost nothing — a pure data-parallel serving
+    mesh is ``(n, 1, 1)`` and every rule's fsdp/tp entry lands on a
+    size-1 axis (replication)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    fsdp, tp = max(1, int(fsdp)), max(1, int(tp))
+    if n % (fsdp * tp) != 0:
+        raise ValueError(
+            f"{n} devices not divisible by fsdp*tp={fsdp * tp}"
+        )
+    grid = np.asarray(devices).reshape(n // (fsdp * tp), fsdp, tp)
+    return Mesh(grid, NAMED_AXES)

@@ -56,12 +56,17 @@ class OnlineTrainer:
         seed: int = 0,
         rng: np.random.Generator | None = None,
         lifecycle: Any = None,
+        partitioner: Any = None,
     ):
         self.cfg = cfg
         self.broker = broker
         self.scorer = scorer
         self.tc = tc or TrainConfig()
         self.mesh = mesh
+        # partitioning layer (parallel/partition.py): the train step jits
+        # with explicit shardings and DONATED sharded state; batch sizes
+        # round to data-axis multiples so every shard sees a static shape
+        self.partitioner = partitioner
         self.registry = registry or Registry()
         self.checkpoints = checkpoints
         self.buffer_size = buffer_size
@@ -89,7 +94,8 @@ class OnlineTrainer:
         # lifecycle rebase request (controller thread -> trainer thread):
         # applied at the top of the next step(), never mid-train
         self._rebase_params: Any = None
-        self._step_fn = make_train_step(self.tc, mesh=mesh)
+        self._step_fn = make_train_step(self.tc, mesh=mesh,
+                                        partitioner=partitioner)
         self._stop = threading.Event()
 
         r = self.registry
@@ -157,6 +163,11 @@ class OnlineTrainer:
             return False
         self._new_labels = 0
         batch = min(self.cfg.retrain_batch, len(self._y))
+        if self.partitioner is not None:
+            # static shard shapes: the batch must split evenly over the
+            # data axis (sampling with replacement, so rounding UP to the
+            # axis size is always satisfiable)
+            batch = self.partitioner.round_batch(batch)
         loss = None
         for _ in range(self.steps_per_round):
             idx = self._rng.integers(0, len(self._y), size=batch)

@@ -54,10 +54,14 @@ def make_train_step(
     tc: TrainConfig,
     mesh: Mesh | None = None,
     loss_fn: Callable[..., jax.Array] | None = None,
+    partitioner: Any = None,
 ) -> Callable[[dict, jax.Array, jax.Array], tuple[dict, jax.Array]]:
-    """Jitted (state, x, y) -> (state, loss). With a mesh, the step is pjit-
-    sharded: batch over "data", params/opt-state per mlp_param_spec, donated
-    state. Without a mesh, a plain single-device jit."""
+    """Jitted (state, x, y) -> (state, loss). With a ``partitioner``
+    (parallel/partition.py) the step jits through its explicit-sharding
+    entry point — batch over the data axis, params/opt-state per the
+    partitioner's layout (replicated for pure dp, rule-table for SPMD),
+    donated state. With a bare ``mesh``, the legacy hand-rolled
+    mlp_param_spec layout. Without either, a plain single-device jit."""
     dtype = jnp.bfloat16 if tc.compute_dtype == "bfloat16" else jnp.float32
     base_loss = loss_fn or (
         lambda p, x, y: mlp.loss_fn(p, x, y, pos_weight=tc.pos_weight, compute_dtype=dtype)
@@ -73,6 +77,18 @@ def make_train_step(
             "opt_state": opt_state,
             "step": state["step"] + 1,
         }, loss
+
+    if partitioner is not None:
+        compiled_p: dict[str, Callable] = {}
+
+        def wrapped_p(state: dict, x: jax.Array, y: jax.Array):
+            if "fn" not in compiled_p:
+                compiled_p["fn"] = partitioner.partition_train_step(
+                    step, state)
+            return compiled_p["fn"](state, x, y)
+
+        wrapped_p._compiled = compiled_p  # type: ignore[attr-defined]
+        return wrapped_p
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))

@@ -66,6 +66,10 @@ _COMPONENTS = (
     "heal",       # device self-healing: per-device health state machine,
                   # canary dispatches, quarantine -> heal ladder -> warm
                   # re-promotion (new; runtime/heal.py)
+    "mesh",       # multi-chip partitioning layer: named (data, fsdp, tp)
+                  # mesh + partitioner for data-parallel sharded serving
+                  # and donated sharded retrain (new; parallel/partition.py;
+                  # armed when devices > 1)
 )
 
 
@@ -140,6 +144,8 @@ class Platform:
         self.device = None      # observability/device.DeviceTelemetry
         self.recorder = None    # observability/incident.FlightRecorder
         self.heal = None        # runtime/heal.DeviceSupervisor
+        self.mesh = None        # jax.sharding.Mesh when mesh serving armed
+        self.partitioner = None  # parallel/partition.Partitioner
         self.device_fault_plan = None  # runtime/faults.DeviceFaultPlan
         self._device_storm_driven = False  # ChaosMonkey owns its duty cycle
         self._overload = None   # runtime/overload.OverloadControl (router)
@@ -298,6 +304,16 @@ class Platform:
             from ccfd_tpu.observability.device import DeviceTelemetry
 
             self.device = DeviceTelemetry(registry=self._registry("device"))
+
+        # 0e. multi-chip partitioning layer (parallel/partition.py): the
+        # named (data, fsdp, tp) mesh + partitioner the serving/retrain
+        # components below build AGAINST — constructed first so the scorer
+        # (step 3) shards its params/batches from birth and the trainer
+        # (step 7) jits its donated sharded step through the same layout.
+        # Armed only when the resolved device count is > 1; a 1-device
+        # platform keeps the historical unsharded path byte-for-byte.
+        if spec.component("mesh").enabled:
+            self._up_mesh(spec.component("mesh"))
 
         # 1. store (Ceph/S3, README.md:136-269) — serves the dataset
         if spec.component("store").enabled:
@@ -579,6 +595,76 @@ class Platform:
             secret_access_key=self.cfg.secret_access_key or "ccfd-secret",
         )
 
+    def _up_mesh(self, c: ComponentSpec) -> None:
+        """Build the serving mesh + partitioner (parallel/partition.py).
+
+        CR ``mesh:`` block over the ``CCFD_MESH_*`` env twins: ``devices``
+        (1 = single-device, 0 = every local device, N = the first N),
+        ``fsdp``/``tp`` axis sizes (data absorbs the remainder),
+        ``param_partition`` (replicated | rules) and ``seq_parallel``
+        (none | ring | ulysses — the seq family's L-sharded attention).
+        """
+        import jax
+
+        cfg = self.cfg
+        log_ = logging.getLogger(__name__)
+        n = int(c.opt("devices", cfg.mesh_devices))
+        avail = len(jax.devices())
+        if n == 0:
+            n = avail
+        fsdp = max(1, int(c.opt("fsdp", cfg.mesh_fsdp)))
+        tp = max(1, int(c.opt("tp", cfg.mesh_tp)))
+        self._mesh_seq_parallel = str(
+            c.opt("seq_parallel", cfg.mesh_seq_parallel) or "none")
+        if n > avail:
+            # a CR sized for an 8-chip pod brought up on a laptop must
+            # still serve — clamp, but LOUDLY: the operator asked for
+            # hardware that is not there. The clamped count may break the
+            # CR's fsdp/tp factorization and a 1-device clamp cannot
+            # carry seq_parallel at all, so the whole shape degrades to
+            # what the clamped hardware CAN serve (pure data parallel)
+            # rather than crashing scorer construction.
+            logging.getLogger(__name__).warning(
+                "mesh.devices=%d but only %d local devices; clamping "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count "
+                "for a virtual CPU mesh)", n, avail)
+            n = avail
+            if n % (fsdp * tp) != 0:
+                log_.warning(
+                    "clamped mesh: %d devices not divisible by "
+                    "fsdp*tp=%d; serving pure data-parallel instead",
+                    n, fsdp * tp)
+                fsdp = tp = 1
+        if tp <= 1 and self._mesh_seq_parallel != "none":
+            if n > 1:
+                log_.warning(
+                    "mesh.seq_parallel=%s needs a tp axis > 1 (have "
+                    "tp=%d); disabling sequence parallelism",
+                    self._mesh_seq_parallel, tp)
+            self._mesh_seq_parallel = "none"
+        if n <= 1:
+            self._mesh_seq_parallel = "none"
+            return
+        from ccfd_tpu.parallel.mesh import make_named_mesh
+        from ccfd_tpu.parallel.partition import partitioner_from_config
+
+        model = self.spec.component("scorer").opt("model", cfg.model_name)
+        self.mesh = make_named_mesh(jax.devices()[:n], fsdp=fsdp, tp=tp)
+        self._mesh_param_partition = str(
+            c.opt("param_partition", cfg.mesh_param_partition))
+        self.partitioner = partitioner_from_config(
+            self.mesh, self._mesh_param_partition, model=str(model),
+        )
+        reg = self._registry("mesh")
+        reg.gauge(
+            "ccfd_mesh_devices",
+            "devices in the live serving mesh (absent/0 = unsharded)",
+        ).set(float(n))
+        g_axis = reg.gauge(
+            "ccfd_mesh_axis_size", "named serving-mesh axis sizes")
+        for axis, size in self.mesh.shape.items():
+            g_axis.set(float(size), labels={"axis": str(axis)})
+
     def _up_scorer(self) -> None:
         from ccfd_tpu.serving.scorer import Scorer
 
@@ -618,6 +704,8 @@ class Platform:
                 len_buckets=tuple(
                     c.opt("seq_len_buckets", cfg.seq_len_buckets)),
                 telemetry=self.device,
+                partitioner=self.partitioner,
+                seq_parallel=getattr(self, "_mesh_seq_parallel", "none"),
             )
             self.scorer.warmup()
             if self.device is not None:
@@ -642,6 +730,7 @@ class Platform:
             host_tier_rows=None if cfg.host_tier_rows < 0 else cfg.host_tier_rows,
             dispatch_deadline_ms=cfg.scorer_dispatch_deadline_ms(),
             telemetry=self.device,
+            partitioner=self.partitioner,
         )
         self.scorer.warmup()
         if self.device is not None:
@@ -997,6 +1086,16 @@ class Platform:
                 **common,
             )
         self.router = router
+        if self.partitioner is not None and self.scorer is not None:
+            # swap-vs-dispatch publish path (parallel/partition.py): arm
+            # the partitioner's PublishGate with the router pool's group
+            # pause barrier and route the scorer's swap_params through it,
+            # so a lifecycle promotion/rollback publishing SHARDED params
+            # never interleaves with a worker's in-flight SPMD dispatch
+            self.partitioner.set_barrier(
+                router, registry=self._registry("mesh"))
+            if hasattr(self.scorer, "set_swap_gate"):
+                self.scorer.set_swap_gate(self.partitioner.gate)
         self.supervisor.add_thread_service(
             "router",
             lambda: router.run(poll_timeout_s=0.02),
@@ -1135,6 +1234,7 @@ class Platform:
             registry=self._registry("retrain"),
             seed=int(c.opt("seed", 0)),
             lifecycle=lifecycle,
+            partitioner=self.partitioner,
         )
         if lifecycle is not None:
             # REJECT/ROLLBACK re-bases the trainer onto the champion so
@@ -1264,6 +1364,17 @@ class Platform:
             "services": self.supervisor.status() if self.supervisor else {},
             "endpoints": {},
         }
+        if self.mesh is not None:
+            out["mesh"] = {
+                "devices": int(self.mesh.size),
+                "axes": {str(a): int(s)
+                         for a, s in self.mesh.shape.items()},
+                # the CR vocabulary (replicated | rules), so live status
+                # diffs cleanly against the spec that produced it
+                "param_partition": getattr(
+                    self, "_mesh_param_partition", "replicated"),
+                "seq_parallel": getattr(self, "_mesh_seq_parallel", "none"),
+            }
         if self.store_server:
             out["endpoints"]["store"] = self.store_server.endpoint
         if self.prediction_server:

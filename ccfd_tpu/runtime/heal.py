@@ -95,6 +95,26 @@ def default_device_label() -> str:
         return "device:0"
 
 
+def mesh_domain_label(mesh: Any) -> str:
+    """``mesh:<platform>x<n>`` — the health-domain label for a multi-chip
+    SPMD serving mesh.
+
+    **The mesh is ONE health domain.** Every sharded executable spans
+    every mesh device (one SPMD program, one launch), so there is no
+    per-chip traffic to steer away from a sick chip: a canary kill or an
+    OOM signal "on device 3" still fails the whole dispatch, and
+    quarantining chip 3 alone would leave executables that *require*
+    chip 3 in rotation. The supervisor therefore quarantines the MESH
+    TIER — the router ladder pins to the host tier for the whole heal
+    cycle — and re-promotes the mesh as a unit after the warm gate
+    (documented in ARCHITECTURE "Partitioning & multi-chip serving")."""
+    try:
+        platform = mesh.devices.flat[0].platform
+        return f"mesh:{platform}x{int(mesh.size)}"
+    except Exception:  # noqa: BLE001
+        return "mesh:unknown"
+
+
 class DeviceSupervisor:
     """Per-device health state machine + heal ladder; see the module
     docstring. Runs as a supervised service (``run``/``stop``/``reset``)
@@ -138,7 +158,16 @@ class DeviceSupervisor:
         self.profiler = profiler
         self.recorder = recorder
         self.overload = overload
-        self.device = device or default_device_label()
+        # health-domain resolution: a mesh-sharded scorer is supervised as
+        # ONE domain (see mesh_domain_label — every SPMD executable spans
+        # every mesh device, so quarantine/heal/re-promote act on the
+        # mesh tier, never on an individual chip)
+        scorer_mesh = getattr(scorer, "mesh", None)
+        self.domain = "mesh" if scorer_mesh is not None else "device"
+        if device is None:
+            device = (mesh_domain_label(scorer_mesh)
+                      if scorer_mesh is not None else default_device_label())
+        self.device = device
         self.canary_deadline_s = max(1e-3, float(canary_deadline_ms) / 1e3)
         self.suspect_strikes = max(1, int(suspect_strikes))
         self.probation_canaries = max(1, int(probation_canaries))
@@ -556,6 +585,7 @@ class DeviceSupervisor:
         with self._mu:
             return {
                 "device": self.device,
+                "domain": self.domain,
                 "state": STATE_NAMES[self._state],
                 "strikes": self._strikes,
                 "reasons": list(self._last_reasons),

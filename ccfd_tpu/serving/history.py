@@ -454,12 +454,28 @@ class SeqScorer:
         inflight: int = DEFAULT_INFLIGHT,
         len_buckets: tuple | None = None,
         telemetry: Any = None,
+        partitioner: Any = None,
+        seq_parallel: str = "none",
     ):
         """``mesh``: serve the seq dispatch over a device mesh — history
         batches split over the partitioned axes, params replicated (the
         same SPMD layout the row Scorer's data-axis path uses; history
         ASSEMBLY stays host-side either way). Bucket sizes round up to
         axis-size multiples so every shard gets identical static shapes.
+        ``partitioner`` (parallel/partition.py): the first-class form of
+        the same — supplies the mesh, the PARAM layout (the regex rule
+        table under ``param_partition: rules``, replicated under data
+        parallel; an uncovered tree such as the int8 seq_q8 variant
+        replicates with a warning) and the publish path.
+
+        ``seq_parallel``: ``none`` | ``ring`` | ``ulysses`` — shard the
+        attention's L dim over the mesh's ``tp`` (or legacy ``model``)
+        axis (ops/ring_attention.py / ops/ulysses.py). The previously
+        dormant flag, now operator-selectable (CR ``mesh.seq_parallel``).
+        Blocks whose static shapes can't shard (the readout block's
+        single-query attention; an L bucket not divisible by the axis)
+        fall back to reference attention per-executable — shapes are
+        static at trace time, so the choice costs nothing at runtime.
 
         ``inflight``: async dispatches in flight before the loop blocks
         on the oldest (0 = resolve immediately, the synchronous path).
@@ -489,21 +505,49 @@ class SeqScorer:
         self.len_buckets = tuple(sorted(
             {int(b) for b in len_buckets if 0 < int(b) < length}
             | {int(length)}))
+        self.partitioner = partitioner
+        if partitioner is not None:
+            mesh = partitioner.mesh
         self.mesh = mesh
+        self.seq_parallel = str(seq_parallel or "none").lower()
+        if self.seq_parallel not in ("none", "ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel={seq_parallel!r}: expected none|ring|ulysses")
         self._batch_sharding = None
         self._part_axes = None
+        self._sp_axis = None
+        # trace-time seq-parallel engagement tally (_sp_attention): did
+        # the configured mode ever actually shard an attention block?
+        self._sp_engaged = 0
+        self._sp_fallback = 0
+        self._sp_warned = False
+        if self.seq_parallel != "none" and mesh is None:
+            raise ValueError("seq_parallel needs a mesh")
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            from ccfd_tpu.parallel.sharding import replicated
-
-            # split over EVERY axis the mesh actually has: the data axis
-            # alone would idle the model-axis devices on a
+            if self.seq_parallel != "none":
+                # L shards over the tensor-parallel axis (named mesh
+                # "tp"; legacy 2-D mesh "model") — the batch must NOT
+                # also split over it
+                for a in ("tp", "model"):
+                    if mesh.shape.get(a, 1) > 1:
+                        self._sp_axis = a
+                        break
+                if self._sp_axis is None:
+                    raise ValueError(
+                        f"seq_parallel={self.seq_parallel!r} needs a "
+                        f"tp/model mesh axis of size > 1; mesh axes are "
+                        f"{dict(mesh.shape)}")
+            # split the batch over EVERY non-sp axis the mesh has: the
+            # data axis alone would idle the other devices on a
             # replicated-param elementwise path, and naming an axis the
             # mesh lacks (e.g. a data-only mesh) would raise
-            part_axes = tuple(a for a in ("data", "model")
-                              if mesh.shape.get(a, 1) > 1) \
-                or tuple(mesh.axis_names[:1])
+            part_axes = tuple(
+                a for a in ("data", "fsdp", "tp", "model")
+                if mesh.shape.get(a, 1) > 1 and a != self._sp_axis) \
+                or tuple(a for a in mesh.axis_names
+                         if a != self._sp_axis)[:1]
             dsize = 1
             for a in part_axes:
                 dsize *= mesh.shape[a]
@@ -511,7 +555,9 @@ class SeqScorer:
                 max(1, -(-b // dsize)) * dsize for b in batch_sizes
             )
             self._part_axes = part_axes
-            params = jax.device_put(params, replicated(mesh))
+            # param layout: the partitioner's (rule table under `rules`,
+            # replicated under dp); legacy bare-mesh callers replicate
+            params = jax.device_put(params, self._param_layout(params))
             self._batch_sharding = NamedSharding(
                 mesh, PartitionSpec(part_axes, None, None))
         self.params = params
@@ -532,6 +578,7 @@ class SeqScorer:
         # contexts (the seq analog of tap-inside/gate-outside)
         self.shadow_tap: Any = None
         self.canary_gate: Any = None
+        self._swap_gate: Any = None  # partitioner publish gate (set_swap_gate)
         self._g_customers = None
         self._h_assembly = self._h_dispatch = None
         self._c_bucket = self._c_bucket_rows = None
@@ -582,6 +629,57 @@ class SeqScorer:
 
         return seq_quant.is_quantized(params)
 
+    def _sp_attention(self):
+        """The operator-selected sequence-parallel attention (ring /
+        ulysses over the sp axis), or None. Static-shape gated: the
+        readout block's single-query attention and any L bucket the axis
+        doesn't divide (ulysses additionally: a head count it doesn't
+        divide) take reference attention for that executable — decided at
+        trace time, free at runtime. Engagement is TRACKED at trace time
+        (``_sp_engaged``/``_sp_fallback``) so the executable inventory
+        reports whether the configured mode ever actually sharded an
+        attention block, and an all-fallback config warns loudly instead
+        of silently serving unsharded under a ``seq_parallel`` label."""
+        if self._sp_axis is None:
+            return None
+        mesh, axis = self.mesh, self._sp_axis
+        n = int(mesh.shape[axis])
+        if self.seq_parallel == "ring":
+            from ccfd_tpu.ops.ring_attention import ring_attention as sp_fn
+        else:
+            from ccfd_tpu.ops.ulysses import ulysses_attention as sp_fn
+        needs_heads = self.seq_parallel == "ulysses"
+
+        def attn(q, k, v):
+            shardable = (
+                q.shape[2] == k.shape[2]      # not the readout query
+                and q.shape[2] % n == 0       # L divides the axis
+                and (not needs_heads or q.shape[1] % n == 0)
+            )
+            if not shardable:
+                # trace-time accounting: this executable's block falls
+                # back (the readout query always does — only warn when a
+                # FULL-attention block can't shard, which means the
+                # configured mode never engages for that shape)
+                self._sp_fallback += 1
+                if q.shape[2] == k.shape[2] and not self._sp_warned:
+                    self._sp_warned = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "seq_parallel=%s cannot shard a (heads=%d, L=%d)"
+                        " attention over the %d-way %r axis; that "
+                        "executable serves reference attention",
+                        self.seq_parallel, q.shape[1], q.shape[2], n,
+                        axis)
+                from ccfd_tpu.ops.ring_attention import reference_attention
+
+                return reference_attention(q, k, v)
+            self._sp_engaged += 1
+            return sp_fn(q, k, v, mesh, axis)
+
+        return attn
+
     def _make_apply(self, quantized: bool):
         import jax
 
@@ -603,8 +701,10 @@ class SeqScorer:
         from jax.sharding import NamedSharding, PartitionSpec
 
         fn = seq_quant.logits if quantized else seq_mod.logits_readout
+        attn = self._sp_attention()
         return jax.jit(
-            lambda p, xs: jax.nn.sigmoid(fn(p, xs, dtype, pos_length=plen)),
+            lambda p, xs: jax.nn.sigmoid(
+                fn(p, xs, dtype, attention_fn=attn, pos_length=plen)),
             out_shardings=NamedSharding(self.mesh,
                                         PartitionSpec(self._part_axes)),
         )
@@ -624,16 +724,54 @@ class SeqScorer:
             return hist
         return self._jax.device_put(hist, self._batch_sharding)
 
+    def set_swap_gate(self, gate: Any) -> None:
+        """Arm the partitioner's publish gate (parallel/partition.py):
+        every ``swap_params`` then pauses the router pool at a batch
+        boundary first — same contract as the row Scorer's."""
+        self._swap_gate = gate
+
+    def _param_layout(self, params: Any) -> Any:
+        """Sharding pytree for the seq params on the mesh: the
+        partitioner's layout when one is armed (the rule table under
+        ``param_partition: rules``, replicated under data parallel);
+        a tree the rule table does not cover — the promoted int8
+        ``seq_q8`` variant has its own leaf names — replicates with a
+        LOUD warning rather than crashing the promotion swap (the int8
+        tree is 4x smaller, so replication is the sane fallback)."""
+        from ccfd_tpu.parallel.sharding import replicated
+
+        if self.partitioner is None:
+            return replicated(self.mesh)
+        try:
+            return self.partitioner.param_sharding(params)
+        except ValueError as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "seq param layout: rule table does not cover this tree "
+                "(%s); replicating instead", e)
+            return replicated(self.mesh)
+
     def swap_params(self, params: Any) -> None:
         """Hot-swap model weights (the lifecycle promotion surface; the
         row scorer exposes the same). A variant change — bf16 champion
         replaced by a promoted int8 ``seq_q8`` tree, or back — re-binds
         the jitted apply; same-variant swaps reuse the jit cache (same
-        treedef, same executable)."""
-        if self.mesh is not None:
-            from ccfd_tpu.parallel.sharding import replicated
+        treedef, same executable). All staging (mesh re-layout, variant
+        grid precompile) happens BEFORE the publish gate: with a gate
+        armed the router pool quiesces only for the reference flip."""
+        staged, quantized, new_apply = self._stage_swap(params)
+        gate = getattr(self, "_swap_gate", None)
+        if gate is None:
+            self._commit_swap(staged, quantized, new_apply)
+            return
+        with gate:
+            self._commit_swap(staged, quantized, new_apply)
 
-            params = self._jax.device_put(params, replicated(self.mesh))
+    def _stage_swap(self, params: Any) -> tuple:
+        if self.mesh is not None:
+            params = self._jax.device_put(params,
+                                          self._param_layout(params))
         quantized = self._is_quantized(params)
         new_apply = None
         if quantized != self._quantized:
@@ -652,6 +790,10 @@ class SeqScorer:
                                       np.float32)
                         self._jax.block_until_ready(
                             new_apply(params, self._put_hist(xs)))
+        return params, quantized, new_apply
+
+    def _commit_swap(self, params: Any, quantized: bool,
+                     new_apply: Any) -> None:
         with self._params_lock:
             self.params = params
             if new_apply is not None:
@@ -682,11 +824,20 @@ class SeqScorer:
                     entry["dispatches"] = int(self._c_bucket.value(
                         {"l_bucket": str(lb), "b_bucket": str(b)}))
                 grid.append(entry)
-        return {
+        out = {
             "model": "seq_q8" if self._quantized else "seq",
             "length": int(self.store.length),
             "grid": grid,
         }
+        if self.mesh is not None:
+            out["mesh_devices"] = int(self.mesh.size)
+            out["seq_parallel"] = self.seq_parallel
+            if self.seq_parallel != "none":
+                # truthful telemetry: configured is not engaged — an
+                # operator debugging a missing sp speedup reads whether
+                # any traced executable actually sharded its attention
+                out["seq_parallel_engaged"] = self._sp_engaged > 0
+        return out
 
     def _bucket(self, n: int) -> int:
         for b in self.batch_sizes:

@@ -76,9 +76,19 @@ class Scorer:
         host_tier_rows: int | None = None,
         dispatch_deadline_ms: float | None = None,
         telemetry: Any = None,
+        partitioner: Any = None,
     ):
         self.spec: ModelSpec = get_model(model_name)
         self.num_features = num_features
+        # first-class partitioning layer (parallel/partition.py): when
+        # given, the partitioner owns every sharding decision — batch over
+        # its data axis, params per its layout (replicated or rule-table
+        # SPMD), and param publishes route through its pause-barrier
+        # publish path. The bare ``mesh=`` form keeps the historical
+        # hand-rolled layout (the dryrun's shape).
+        self.partitioner = partitioner
+        if partitioner is not None:
+            mesh = partitioner.mesh
         self.mesh = mesh
         # device telemetry plane (observability/device.py): when armed,
         # every staging put on the dispatch path is timed + byte-counted
@@ -103,7 +113,12 @@ class Scorer:
         self._param_partition = param_partition
         self._batch_sharding = None
         self._param_sharding = None
-        if mesh is not None:
+        if partitioner is not None:
+            self._data_size = partitioner.data_size
+            batch_sizes = {partitioner.round_batch(b) for b in batch_sizes}
+            self._batch_sharding = partitioner.batch_sharding
+            self._out_sharding = partitioner.out_sharding
+        elif mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ccfd_tpu.parallel.mesh import DATA_AXIS
@@ -119,7 +134,10 @@ class Scorer:
         self._params = params if params is not None else self.spec.init(
             jax.random.PRNGKey(seed)
         )
-        if mesh is not None:
+        if partitioner is not None:
+            self._param_sharding = partitioner.param_sharding(self._params)
+            self._params = jax.device_put(self._params, self._param_sharding)
+        elif mesh is not None:
             from ccfd_tpu.parallel import sharding as shardlib
 
             if param_partition == "model":
@@ -130,7 +148,16 @@ class Scorer:
             self._params = jax.device_put(self._params, self._param_sharding)
         else:
             self._params = jax.device_put(self._params)
+        # swap-vs-dispatch publish gate (parallel/partition.py
+        # PublishGate): armed by the operator once the router pool exists;
+        # every swap_params then quiesces the pool's in-flight sharded
+        # dispatches at a batch boundary before re-laying params
+        self._swap_gate: Any = None
         self._lock = threading.Lock()
+        # per-bucket dispatch tally for the executable inventory (PR 10):
+        # on a mesh every dispatch is one SPMD launch spanning all
+        # devices, so per-device counts read straight off this grid
+        self._dispatch_counts: dict[int, int] = {}
         dtype = _DTYPES.get(compute_dtype, jnp.float32)
         # models without a dtype knob (e.g. trees) take (params, x) only
         import inspect
@@ -427,14 +454,24 @@ class Scorer:
         """The compiled-executable set this scorer serves from — the row
         family's entry in the device telemetry plane's inventory (the seq
         family reports its (L, B) grid the same way)."""
-        return {
+        with self._lock:  # a first-dispatch of a new bucket inserts a
+            # key; an unlocked scrape-iteration would race the resize
+            counts = dict(self._dispatch_counts)
+        out = {
             "model": self.spec.name,
             "batch_sizes": list(self.batch_sizes),
             "fused": self.fused,
             "int8_wire": bool(self._preq_wire
                               and self._preq_norm is not None),
             "host_tier_rows": self.host_tier_rows,
+            "dispatches": {str(b): int(n)
+                           for b, n in sorted(counts.items())},
         }
+        if self.mesh is not None:
+            out["mesh_devices"] = int(self.mesh.size)
+            out["mesh_axes"] = {str(a): int(s)
+                                for a, s in self.mesh.shape.items()}
+        return out
 
     def warmup(self) -> None:
         """Compile every bucket (and measure the host-tier crossover).
@@ -604,8 +641,35 @@ class Scorer:
         thr = int(rtt_s * 0.5 / max(host_s_per_row, 1e-9))
         return max(0, min(thr, 8192))
 
+    def set_swap_gate(self, gate: Any) -> None:
+        """Arm the partitioner's publish gate: every ``swap_params`` then
+        pauses the router pool at a batch boundary first, so no worker's
+        in-flight SPMD dispatch interleaves with the sharded re-layout
+        (parallel/partition.py PublishGate; None disarms)."""
+        self._swap_gate = gate
+
     def swap_params(self, new_params: Any) -> None:
         """Atomically publish retrained params without pausing serving.
+
+        All staging (host gather, sharded H2D re-layout, fused fold, host
+        casts) happens BEFORE the publish gate: double buffering keeps an
+        in-flight dispatch safe against new buffers landing, so only the
+        reference flip needs the router pool quiescent — a gated swap
+        pauses the pool for a pointer swap, not a tree transfer."""
+        staged = self._stage_swap(new_params)
+        gate = self._swap_gate
+        if gate is None:
+            listeners, gen = self._commit_swap(*staged)
+        else:
+            with gate:
+                listeners, gen = self._commit_swap(*staged)
+        # listener delivery runs OUTSIDE the gate and the params lock
+        # (listeners may be slow; the pool must not stay paused for them)
+        self._notify_swap(new_params, staged[3], listeners, gen)
+
+    def _stage_swap(self, new_params: Any) -> tuple:
+        """Gate-free staging: every buffer the flip will install, built
+        and device-committed up front.
 
         Copies into fresh buffers: ``device_put`` on already-committed arrays
         is an aliasing no-op, and aliased buffers would be deleted under us
@@ -621,6 +685,7 @@ class Scorer:
             staged = jax.tree.map(lambda a: jnp.array(a, copy=True), new_params)
         jax.block_until_ready(staged)
         staged_fused = None
+        staged_preq_norm = None
         # gate on the fused MODULE, not the current fused params: one
         # unfoldable swap drops to the XLA path, but a later foldable tree
         # must re-enable the kernel. A warmup LOWERING failure, however,
@@ -640,6 +705,13 @@ class Scorer:
         staged_host = None
         if self._host_params is not None:
             staged_host = jax.tree.map(_host_cast, new_params)
+        return staged, staged_fused, staged_preq_norm, staged_host
+
+    def _commit_swap(self, staged: Any, staged_fused: Any,
+                     staged_preq_norm: Any, staged_host: Any
+                     ) -> tuple[list, int]:
+        """The flip: swap the serving references under the lock (the only
+        part a publish gate quiesces the pool for)."""
         with self._lock:
             self._params = staged
             # never keep serving stale fused weights: an unfoldable tree
@@ -653,25 +725,29 @@ class Scorer:
                 self._host_params = staged_host
             listeners = list(self._swap_listeners)
             self._swap_gen += 1
-            gen = self._swap_gen
-        if listeners:
-            host_tree = (
-                staged_host
-                if staged_host is not None
-                else jax.tree.map(_host_cast, new_params)
-            )
-            # outside the params lock (listeners may be slow), but serialized
-            # and generation-checked: if a newer swap already delivered, this
-            # older tree must not overwrite the listeners' copies
-            with self._notify_lock:
-                if gen <= self._swap_delivered_gen:
-                    return
-                self._swap_delivered_gen = gen
-                for fn in listeners:
-                    try:
-                        fn(host_tree)
-                    except Exception:  # noqa: BLE001 - must not break swaps
-                        pass
+            return listeners, self._swap_gen
+
+    def _notify_swap(self, new_params: Any, staged_host: Any,
+                     listeners: list, gen: int) -> None:
+        if not listeners:
+            return
+        host_tree = (
+            staged_host
+            if staged_host is not None
+            else jax.tree.map(_host_cast, new_params)
+        )
+        # outside the params lock (listeners may be slow), but serialized
+        # and generation-checked: if a newer swap already delivered, this
+        # older tree must not overwrite the listeners' copies
+        with self._notify_lock:
+            if gen <= self._swap_delivered_gen:
+                return
+            self._swap_delivered_gen = gen
+            for fn in listeners:
+                try:
+                    fn(host_tree)
+                except Exception:  # noqa: BLE001 - must not break swaps
+                    pass
 
     def add_swap_listener(self, fn: Any) -> None:
         """``fn(host_params_numpy_tree)`` runs after every ``swap_params``."""
@@ -760,6 +836,9 @@ class Scorer:
             # stalls this dispatch past its watchdog, compile_stall bills
             # a synthetic re-trace — the taxonomy the heal ladder drills
             device_seam("dispatch")
+            with self._lock:  # router workers share this scorer: the
+                # read-modify-write must not lose increments
+                self._dispatch_counts[b] = self._dispatch_counts.get(b, 0) + 1
             if fused_params is not None:
                 try:
                     out = self._fused_dispatch(fused_params, chunk,

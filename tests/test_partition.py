@@ -1,0 +1,876 @@
+"""First-class partitioning layer (parallel/partition.py) on the virtual
+8-device CPU mesh: regex rule matching, shard/gather byte identity,
+device-count-invariant checkpoint fingerprints, partitioner-driven scorer
+parity for the row/q8/seq families, the donated sharded train step, the
+sharded lifecycle promote->rollback drill, sharded crash-restore byte
+identity, the swap-vs-dispatch publish gate, and the mesh-as-one-health-
+domain rule (ISSUE 12)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ccfd_tpu.models import mlp
+from ccfd_tpu.parallel.mesh import make_mesh, make_named_mesh
+from ccfd_tpu.parallel.partition import (
+    DataParallelPartitioner,
+    PublishGate,
+    SPMDPartitioner,
+    SpecLayout,
+    match_partition_rules,
+    mlp_rules,
+    params_fingerprint,
+    partitioner_from_config,
+    seq_rules,
+    tree_paths,
+)
+from ccfd_tpu.serving.scorer import Scorer
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices"
+)
+
+
+@pytest.fixture(scope="module")
+def params(dataset):
+    p = mlp.init(jax.random.PRNGKey(0))
+    return mlp.set_normalizer(p, dataset.X.mean(0), dataset.X.std(0))
+
+
+def _dp(n=8, **kw):
+    return DataParallelPartitioner(
+        make_named_mesh(jax.devices()[:n], **kw))
+
+
+# -- regex partition rules ---------------------------------------------------
+
+def test_match_rules_scalar_and_single_element_leaves_skip_rules():
+    tree = {"step": np.zeros(()), "one": np.zeros((1,)),
+            "w": np.zeros((4, 4))}
+    specs = match_partition_rules([("w", P("tp", None))], tree)
+    assert specs["step"] == P() and specs["one"] == P()
+    assert specs["w"] == P("tp", None)
+
+
+def test_match_rules_uncovered_param_raises():
+    with pytest.raises(ValueError, match="mystery"):
+        match_partition_rules(
+            [("w", P())], {"w": np.zeros((2, 2)),
+                           "mystery": np.zeros((3, 3))})
+
+
+def test_match_rules_first_match_wins_ordered():
+    tree = {"layers": [{"w": np.zeros((4, 8))}, {"w": np.zeros((8, 8))}]}
+    specs = match_partition_rules(
+        [(r"layers/0/w", P(None, "tp")), (r"layers/\d+/w", P("tp", None))],
+        tree)
+    assert specs["layers"][0]["w"] == P(None, "tp")
+    assert specs["layers"][1]["w"] == P("tp", None)
+
+
+def test_rules_cover_optimizer_state_trees(params):
+    """Optax momentum traces embed param-structured subtrees whose leaf
+    paths END with the same param names — one rule table covers both."""
+    import optax
+
+    opt_state = optax.sgd(1e-2, momentum=0.9).init(params)
+    specs = match_partition_rules(mlp_rules(), opt_state)  # must not raise
+    flat = dict(zip(tree_paths(opt_state), jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))))
+    # the momentum trace of the first layer's weight shards like the param
+    w_specs = [s for path, s in flat.items() if path.endswith("layers/0/w")]
+    assert w_specs and all(s == P(None, "tp") for s in w_specs)
+
+
+def test_mlp_rules_match_handrolled_layout(params):
+    """The rule table expresses EXACTLY the layout sharding.mlp_param_spec
+    hand-writes (partition.py docstring's parity claim)."""
+    from ccfd_tpu.parallel.sharding import mlp_param_spec
+
+    mesh = make_mesh(model_parallel=2)
+    hand = jax.tree.map(lambda s: s.spec, mlp_param_spec(params, mesh),
+                        is_leaf=lambda x: hasattr(x, "spec"))
+    ruled = match_partition_rules(
+        mlp_rules(SpecLayout(tp_axis="model")), params)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: a == b, hand, ruled,
+        is_leaf=lambda x: isinstance(x, P)))
+
+
+def test_seq_rules_cover_the_history_model():
+    from ccfd_tpu.models import seq as seq_mod
+
+    sp = seq_mod.init(jax.random.PRNGKey(0))
+    specs = match_partition_rules(seq_rules(), sp)  # no gap raises
+    flat = dict(zip(tree_paths(sp), jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))))
+    assert flat["blocks/0/qkv/w"] == P("fsdp", "tp")
+    assert flat["blocks/0/proj/w"] == P("tp", None)
+    assert flat["blocks/0/ln1/scale"] == P()
+    assert flat["head/w"] == P()
+
+
+# -- mesh + partitioner surface ----------------------------------------------
+
+def test_named_mesh_shape_and_divisibility():
+    mesh = make_named_mesh(jax.devices()[:8], fsdp=2, tp=2)
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tp": 2}
+    with pytest.raises(ValueError, match="not divisible"):
+        make_named_mesh(jax.devices()[:8], fsdp=3)
+
+
+def test_round_batch_covers_data_axis():
+    part = _dp(8)
+    assert part.data_size == 8 and part.n_devices == 8
+    assert part.round_batch(1) == 8
+    assert part.round_batch(8) == 8
+    assert part.round_batch(9) == 16
+
+
+def test_partitioner_from_config_resolution():
+    mesh = make_named_mesh(jax.devices()[:8])
+    assert isinstance(partitioner_from_config(mesh, "replicated"),
+                      DataParallelPartitioner)
+    spmd = partitioner_from_config(mesh, "rules", model="seq")
+    assert isinstance(spmd, SPMDPartitioner)
+    with pytest.raises(ValueError, match="param_partition"):
+        partitioner_from_config(mesh, "banana")
+
+
+def test_shard_gather_roundtrip_is_byte_identical(params):
+    for part in (_dp(8),
+                 SPMDPartitioner(make_named_mesh(jax.devices()[:8], tp=2),
+                                 mlp_rules())):
+        sharded = part.shard_params(params)
+        back = part.gather(sharded)
+        host = jax.tree.map(np.asarray, params)
+        assert jax.tree.all(jax.tree.map(
+            lambda a, b: bool(np.array_equal(a, b)), host, back))
+
+
+def test_fingerprint_invariant_across_device_counts(params):
+    """The checkpoint-lineage hash must audit identically whether the
+    champion's params lived whole on 1 device or sharded over 2/4/8 —
+    including a tp-sharded SPMD layout (acceptance criterion)."""
+    host = jax.tree.map(np.asarray, params)
+    want = params_fingerprint(host)
+    for n in (1, 2, 4, 8):
+        part = _dp(n)
+        assert params_fingerprint(part.shard_params(host)) == want
+    spmd = SPMDPartitioner(make_named_mesh(jax.devices()[:8], tp=2),
+                           mlp_rules())
+    assert params_fingerprint(spmd.shard_params(host)) == want
+    # ... and it is a real fingerprint: a changed leaf changes it
+    mutated = jax.tree.map(np.copy, host)
+    mutated["layers"][0]["b"][0] += 1.0
+    assert params_fingerprint(mutated) != want
+
+
+# -- partitioner-driven serving parity ---------------------------------------
+
+def test_scorer_partitioner_parity_row(dataset, params):
+    ref = Scorer(model_name="mlp", params=params, use_fused=False,
+                 compute_dtype="float32").score(dataset.X[:1000])
+    s = Scorer(model_name="mlp", params=params, use_fused=False,
+               compute_dtype="float32", partitioner=_dp(8))
+    assert all(b % 8 == 0 for b in s.batch_sizes)
+    got = s.score(dataset.X[:1000])
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_scorer_partitioner_parity_q8(dataset, params):
+    from ccfd_tpu.ops import quant
+
+    q8 = quant.quantize_mlp(params)
+    ref = Scorer(model_name="mlp_q8", params=q8,
+                 use_fused=False).score(dataset.X[:512])
+    got = Scorer(model_name="mlp_q8", params=q8, use_fused=False,
+                 partitioner=_dp(8)).score(dataset.X[:512])
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_scorer_spmd_rules_parity(dataset, params):
+    """The rule-table layout over fsdp x tp computes the same model (up to
+    collective reduction order)."""
+    part = SPMDPartitioner(make_named_mesh(jax.devices()[:8], tp=2),
+                           mlp_rules())
+    ref = Scorer(model_name="mlp", params=params, use_fused=False,
+                 compute_dtype="float32").score(dataset.X[:512])
+    got = Scorer(model_name="mlp", params=params, use_fused=False,
+                 compute_dtype="float32",
+                 partitioner=part).score(dataset.X[:512])
+    np.testing.assert_allclose(ref, got, rtol=2e-2, atol=2e-3)
+
+
+def _seq_parity(partitioner, seq_parallel="none", n_rows=24):
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.serving.history import SeqScorer
+
+    sp = seq_mod.init(jax.random.PRNGKey(1))
+    rng = np.random.default_rng(5)
+    rows = rng.normal(size=(n_rows, 30)).astype(np.float32)
+    ids = [f"c{i % 6}" for i in range(n_rows)]
+    mk = lambda **kw: SeqScorer(  # noqa: E731
+        sp, length=8, batch_sizes=(n_rows,), compute_dtype="float32",
+        max_customers=64, **kw)
+    single, sharded = mk(), mk(partitioner=partitioner,
+                              seq_parallel=seq_parallel)
+    for s in (single, sharded):
+        s.score(rows, ids)  # fill histories identically
+    p_ref = single.score(rows, ids)
+    p_got = sharded.score(rows, ids)
+    np.testing.assert_allclose(p_ref, p_got, rtol=2e-2, atol=2e-3)
+
+
+def test_seq_scorer_partitioner_parity():
+    _seq_parity(_dp(8))
+
+
+def test_seq_scorer_ring_attention_operator_flag():
+    """The previously dormant ring_attention flag, now a real option: L
+    shards over the named mesh's tp axis, scores match single-device."""
+    _seq_parity(_dp(8, tp=2), seq_parallel="ring")
+
+
+def test_seq_scorer_ulysses_operator_flag():
+    _seq_parity(_dp(8, tp=2), seq_parallel="ulysses")
+
+
+def test_seq_scorer_rules_layout_lands_sharded_with_parity():
+    """param_partition: rules is REAL for the seq family: qkv lands
+    fsdp x tp sharded on device (not silently replicated) and scores
+    match single-device."""
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.serving.history import SeqScorer
+
+    part = SPMDPartitioner(
+        make_named_mesh(jax.devices()[:8], fsdp=2, tp=2), seq_rules())
+    sp = seq_mod.init(jax.random.PRNGKey(1))
+    s = SeqScorer(sp, length=8, batch_sizes=(16,),
+                  compute_dtype="float32", max_customers=64,
+                  partitioner=part)
+    qkv = s.params["blocks"][0]["qkv"]["w"]
+    assert qkv.sharding.spec == P("fsdp", "tp")
+    _seq_parity(part, n_rows=16)
+
+
+def test_seq_q8_swap_under_rules_replicates_with_parity():
+    """A promoted int8 seq_q8 tree has leaf names the rule table does
+    not cover: the swap must fall back to replication (loudly) and keep
+    serving, not crash the promotion."""
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.ops.seq_quant import quantize_seq
+    from ccfd_tpu.serving.history import SeqScorer
+
+    part = SPMDPartitioner(
+        make_named_mesh(jax.devices()[:8], fsdp=2, tp=2), seq_rules())
+    sp = seq_mod.init(jax.random.PRNGKey(1))
+    s = SeqScorer(sp, length=8, batch_sizes=(16,),
+                  compute_dtype="float32", max_customers=64,
+                  partitioner=part)
+    rng = np.random.default_rng(6)
+    rows = rng.normal(size=(16, 30)).astype(np.float32)
+    s.score(rows, list(range(16)))
+    s.swap_params(quantize_seq(jax.tree.map(np.asarray, sp)))
+    out = s.score(rows, list(range(16)))
+    assert out.shape == (16,) and np.isfinite(out).all()
+
+
+def test_seq_scorer_seq_parallel_needs_tp_axis():
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.serving.history import SeqScorer
+
+    sp = seq_mod.init(jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="tp/model mesh axis"):
+        SeqScorer(sp, length=8, batch_sizes=(16,), partitioner=_dp(8),
+                  seq_parallel="ring")
+
+
+# -- donated sharded train step ----------------------------------------------
+
+def test_partitioned_train_step_matches_single_device(dataset, params):
+    from ccfd_tpu.parallel.train import (TrainConfig, init_state,
+                                         make_train_step)
+
+    tc = TrainConfig(compute_dtype="float32", learning_rate=0.01)
+    x = dataset.X[:256]
+    y = dataset.y[:256].astype(np.float32)
+
+    def run(partitioner):
+        state = init_state(jax.tree.map(np.asarray, params), tc)
+        step = make_train_step(tc, partitioner=partitioner)
+        loss = None
+        for _ in range(4):
+            state, loss = step(state, x, y)
+        return float(loss), jax.tree.map(np.asarray, state["params"])
+
+    loss1, p1 = run(None)
+    loss8, p8 = run(_dp(8))
+    assert np.isfinite(loss8)
+    np.testing.assert_allclose(loss1, loss8, rtol=1e-4)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool(np.allclose(a, b, rtol=1e-4, atol=1e-5)), p1, p8))
+
+
+def test_partitioned_train_state_lands_sharded(params):
+    from ccfd_tpu.parallel.train import (TrainConfig, init_state,
+                                         make_train_step)
+
+    tc = TrainConfig(compute_dtype="float32")
+    part = _dp(8)
+    state = init_state(jax.tree.map(np.asarray, params), tc)
+    step = make_train_step(tc, partitioner=part)
+    x = np.zeros((64, 30), np.float32)
+    y = np.zeros((64,), np.float32)
+    state, _ = step(state, x, y)
+    # the donated state comes back laid out on the mesh, not on one device
+    w = state["params"]["layers"][0]["w"]
+    assert len(w.sharding.device_set) == 8
+
+
+def test_online_trainer_rounds_batch_to_data_axis(dataset):
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES
+    from ccfd_tpu.parallel.online import OnlineTrainer
+    from ccfd_tpu.parallel.train import TrainConfig
+
+    cfg = Config(retrain_min_labels=8, retrain_batch=13)
+    broker = Broker()
+    scorer = Scorer(model_name="mlp", compute_dtype="float32",
+                    partitioner=_dp(8), use_fused=False)
+    trainer = OnlineTrainer(
+        cfg, broker, scorer, scorer.params,
+        tc=TrainConfig(compute_dtype="float32"),
+        partitioner=scorer.partitioner, steps_per_round=1)
+    for i in range(16):
+        broker.produce(cfg.labels_topic, {
+            "transaction": dict(
+                zip(FEATURE_NAMES, map(float, dataset.X[i]))),
+            "label": int(dataset.y[i])})
+    assert trainer.step() is True  # 13 rounds UP to 16: shapes stay static
+    assert int(trainer._state["step"]) == 1
+    trainer.close()
+
+
+# -- lifecycle under sharded params ------------------------------------------
+
+def _sharded_lifecycle_stack(tmp_path, params):
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.lifecycle.controller import (Guardrails,
+                                               LifecycleController)
+    from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+    from ccfd_tpu.lifecycle.shadow import ShadowTap
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    scorer = Scorer(model_name="mlp", params=params,
+                    batch_sizes=(16, 128, 1024, 4096),
+                    compute_dtype="float32", use_fused=False,
+                    partitioner=_dp(8))
+    cfg = Config()
+    broker = Broker()
+    reg = Registry()
+    store = VersionStore(str(tmp_path / "versions.json"))
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), keep=8)
+    shadow = ShadowTap(scorer, broker, cfg.shadow_topic, reg)
+    ev = ShadowEvaluator(cfg, broker, scorer, reg)
+    g = Guardrails(min_labels=32, min_shadow_rows=256,
+                   canary_min_labels=16, max_score_psi=5.0,
+                   min_submit_interval_s=0.0)
+    ctl = LifecycleController(cfg, scorer, store=store, checkpoints=ckpt,
+                              shadow=shadow, evaluator=ev, guardrails=g,
+                              registry=reg)
+    return scorer, cfg, broker, reg, store, shadow, ctl
+
+
+def _improved(params, bias=0.01):
+    p = jax.tree.map(np.asarray, params)
+    p = {"norm": p["norm"], "layers": [dict(l) for l in p["layers"]]}
+    p["layers"][-1] = {"w": p["layers"][-1]["w"],
+                       "b": p["layers"][-1]["b"] + np.float32(bias)}
+    return p
+
+
+def test_lifecycle_promote_then_rollback_with_sharded_params(
+        tmp_path, dataset, params):
+    """The acceptance drill: shadow -> canary -> PROMOTE publishes sharded
+    params (and records a device-count-invariant checkpoint hash), then a
+    second candidate's canary breach ROLLS BACK to the sharded champion —
+    serving scores stay equal to the promoted tree throughout."""
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES
+    from ccfd_tpu.lifecycle.controller import STAGE_CANARY, STAGE_IDLE
+
+    scorer, cfg, broker, reg, store, shadow, ctl = (
+        _sharded_lifecycle_stack(tmp_path, params))
+    served = ctl.wrap_score(scorer.host_score)
+    improved = _improved(params)
+    v = ctl.submit_candidate(improved, label_watermark=10)
+    # the candidate checkpoint hash is the fully-gathered fingerprint
+    assert store.get(v).checkpoint_hash == params_fingerprint(
+        jax.tree.map(np.asarray, improved))
+
+    rng = np.random.default_rng(1)
+    promoted = False
+    for _ in range(24):
+        idx = rng.integers(0, len(dataset.X), size=256)
+        served(dataset.X[idx])
+        shadow.step()
+        for j in rng.integers(0, len(dataset.X), size=16):
+            broker.produce(cfg.labels_topic, {
+                "transaction": dict(
+                    zip(FEATURE_NAMES, map(float, dataset.X[j]))),
+                "label": int(dataset.y[j])})
+        ctl.step()
+        if ctl.stage == STAGE_IDLE and store.get(v).stage == "CHAMPION":
+            promoted = True
+            break
+    assert promoted, "sharded candidate never promoted"
+    # serving runs the promoted tree, sharded over 8 devices
+    p_layer = scorer.params["layers"][0]["w"]
+    assert len(p_layer.sharding.device_set) == 8
+    expected = Scorer(model_name="mlp", params=improved,
+                      compute_dtype="float32",
+                      use_fused=False).score(dataset.X[:64])
+    np.testing.assert_allclose(scorer.score(dataset.X[:64]), expected,
+                               rtol=1e-5, atol=1e-6)
+
+    # second candidate reaches canary, regresses, rolls back to the
+    # sharded champion checkpoint
+    v2 = ctl.submit_candidate(_improved(params, bias=0.02),
+                              label_watermark=20)
+    rng2 = np.random.default_rng(2)
+    for _ in range(24):
+        idx = rng2.integers(0, len(dataset.X), size=256)
+        served(dataset.X[idx])
+        shadow.step()
+        if ctl.stage != STAGE_CANARY:
+            for j in rng2.integers(0, len(dataset.X), size=16):
+                broker.produce(cfg.labels_topic, {
+                    "transaction": dict(
+                        zip(FEATURE_NAMES, map(float, dataset.X[j]))),
+                    "label": int(dataset.y[j])})
+        ctl.step()
+        if ctl.stage == STAGE_CANARY:
+            break
+    assert ctl.stage == STAGE_CANARY, "second candidate never hit canary"
+    for _ in range(12):
+        broker.produce(cfg.shadow_topic, {
+            "version": v2, "champion": [0.05] * 256,
+            "challenger": [0.99] * 256})
+    ctl.step()
+    assert store.get(v2).stage == "ROLLED_BACK"
+    np.testing.assert_allclose(scorer.score(dataset.X[:64]), expected,
+                               rtol=1e-5, atol=1e-5)
+    # the rollback-restore audit event carries the champion's hash
+    events = [e for e in store.audit_trail()
+              if e["event"] == "rollback_restore"]
+    assert events and events[-1]["detail"]["checkpoint_hash"] == (
+        store.get(v).checkpoint_hash)
+    assert ctl.serving_consistent()
+    ctl.close()
+
+
+def test_restart_restore_hash_matches_across_device_counts(
+        tmp_path, dataset, params):
+    """Crash-restore acceptance: a controller restarted over the SAME
+    state_dir — but serving on a different device count — restores the
+    champion and records the SAME checkpoint hash in the audit trail."""
+    scorer, cfg, broker, reg, store, shadow, ctl = (
+        _sharded_lifecycle_stack(tmp_path, params))
+    genesis_hash = store.get(ctl.champion).checkpoint_hash
+    assert genesis_hash  # bootstrap recorded it
+    ctl.close()
+
+    from ccfd_tpu.lifecycle.controller import (Guardrails,
+                                               LifecycleController)
+    from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+    from ccfd_tpu.lifecycle.shadow import ShadowTap
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    # restart single-device (device count changed under the lineage)
+    scorer2 = Scorer(model_name="mlp", params=params,
+                     compute_dtype="float32", use_fused=False)
+    store2 = VersionStore(str(tmp_path / "versions.json"))
+    ctl2 = LifecycleController(
+        cfg, scorer2, store=store2,
+        checkpoints=CheckpointManager(str(tmp_path / "ckpt"), keep=8),
+        shadow=ShadowTap(scorer2, broker, cfg.shadow_topic, Registry()),
+        evaluator=ShadowEvaluator(cfg, broker, scorer2, Registry()),
+        guardrails=Guardrails(), registry=Registry())
+    restores = [e for e in store2.audit_trail()
+                if e["event"] == "restart_restore"]
+    assert restores and restores[-1]["detail"]["checkpoint_hash"] == (
+        genesis_hash)
+    ctl2.close()
+
+
+# -- crash restore with a sharded seq model ----------------------------------
+
+def test_crash_restore_byte_identity_with_sharded_seq_model():
+    """The PR 8 restore-replay invariant survives sharding: a SeqScorer
+    serving through the partitioner rebuilds byte-identical histories
+    after a cut restore + bus replay."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import FEATURE_NAMES
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.models import seq as seq_mod
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.runtime.recovery import CheckpointCoordinator
+    from ccfd_tpu.serving.history import SeqScorer
+
+    cfg = Config(fraud_threshold=0.99)
+    broker = Broker()
+    reg = Registry()
+    factory = lambda: build_engine(cfg, broker, reg)  # noqa: E731
+    sp = seq_mod.init(jax.random.PRNGKey(3))
+    scorer = SeqScorer(sp, length=8, batch_sizes=(16,),
+                       compute_dtype="float32", partitioner=_dp(8))
+    router = Router(cfg, broker, scorer, factory(), Registry())
+    coord = CheckpointCoordinator(router, broker, factory, interval_s=999.0)
+    coord.register_state("history", scorer.store.snapshot,
+                         scorer.store.restore)
+    t = router.start(poll_timeout_s=0.01)
+    try:
+        def feed(lo, hi):
+            broker.produce_batch(
+                cfg.kafka_topic,
+                [{FEATURE_NAMES[j]: float(i) for j in range(30)}
+                 | {"id": "cust", "customer_id": "cust"}
+                 for i in range(lo, hi)],
+                keys=["cust"] * (hi - lo))
+
+        feed(0, 4)
+        deadline = time.time() + 10
+        while router._c_in.value() < 4 and time.time() < deadline:
+            time.sleep(0.02)
+        assert coord.checkpoint() is not None
+        feed(4, 7)
+        deadline = time.time() + 10
+        while router._c_in.value() < 7 and time.time() < deadline:
+            time.sleep(0.02)
+        coord.restore(reason="test")
+        deadline = time.time() + 10
+        while router._c_in.value() < 10 and time.time() < deadline:
+            time.sleep(0.02)
+        router.pause(5.0)
+        (key, buf, filled), = scorer.store.snapshot()["customers"]
+        assert key == "cust" and filled == 7
+        # byte identity: the replayed rows are EXACTLY one copy each
+        assert buf[-1][0] == 6.0 and buf[-2][0] == 5.0
+    finally:
+        router.resume()
+        router.stop()
+        t.join(timeout=5)
+
+
+# -- publish gate (swap-vs-dispatch small fix) -------------------------------
+
+class _Barrier:
+    def __init__(self, ok=True):
+        self.ok = ok
+        self.pauses = 0
+        self.resumes = 0
+
+    def pause(self, timeout_s=10.0):
+        self.pauses += 1
+        return self.ok
+
+    def resume(self):
+        self.resumes += 1
+
+
+def test_publish_gate_pause_resume_and_reentrancy():
+    b = _Barrier()
+    gate = PublishGate(b)
+    with gate:
+        with gate:  # a respawn swapping inside an outer publish
+            pass
+    assert b.pauses == 1 and b.resumes == 1
+    assert gate.publishes == 1 and gate.pause_timeouts == 0
+
+
+def test_publish_gate_timeout_does_not_block_publish_and_releases_hold():
+    b = _Barrier(ok=False)
+    gate = PublishGate(b)
+    with gate:
+        pass
+    assert gate.pause_timeouts == 1
+    # the hold MUST release even without an ack: pause() takes its
+    # holders before awaiting acks, and a leaked hold would park every
+    # worker at its next batch boundary forever
+    assert b.resumes == 1
+
+
+def test_swap_racing_dispatching_workers_is_quiescent(dataset, params):
+    """ISSUE 12 small fix: ParallelRouter workers sharing one sharded
+    scorer must not interleave swap_params with an in-flight sharded
+    dispatch — the partitioner's publish path takes the group pause
+    barrier, so every swap lands at a batch boundary."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.parallel import ParallelRouter
+
+    cfg = Config(confidence_threshold=1.0)
+    broker = Broker(default_partitions=2)
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, None)
+    part = _dp(8)
+    scorer = Scorer(model_name="mlp", params=params,
+                    compute_dtype="float32", use_fused=False,
+                    batch_sizes=(16, 128), partitioner=part)
+    scorer.warmup()
+    pr = ParallelRouter(cfg, broker, scorer.score, engine, reg, workers=2,
+                        max_batch=64)
+    part.set_barrier(pr)
+    scorer.set_swap_gate(part.gate)
+    t = pr.start(poll_timeout_s=0.01)
+    stop = threading.Event()
+    swap_errors: list[BaseException] = []
+
+    def swapper():
+        host = jax.tree.map(np.asarray, params)
+        while not stop.is_set():
+            try:
+                scorer.swap_params(host)
+            except BaseException as e:  # noqa: BLE001 - the regression
+                swap_errors.append(e)  # under test
+                return
+            time.sleep(0.005)
+
+    sw = threading.Thread(target=swapper, daemon=True)
+    sw.start()
+    try:
+        n = 512
+        broker.produce_batch(cfg.kafka_topic,
+                             [b"0," * 29 + b"0"] * n, list(range(n)))
+        deadline = time.time() + 30
+        c_in = reg.counter("transaction_incoming_total")
+        while c_in.value() < n and time.time() < deadline:
+            time.sleep(0.02)
+        assert c_in.value() == n
+    finally:
+        stop.set()
+        sw.join(timeout=5)
+        pr.close()
+        t.join(timeout=5)
+    assert not swap_errors, swap_errors
+    assert part.gate.publishes > 0
+    # every pause was acknowledged: no swap interleaved a live dispatch
+    assert part.gate.pause_timeouts == 0
+
+
+# -- mesh is ONE health domain (heal-vs-mesh semantics fix) ------------------
+
+def test_mesh_supervised_as_one_health_domain(params):
+    from ccfd_tpu.runtime.heal import DeviceSupervisor
+
+    scorer = Scorer(model_name="mlp", params=params, use_fused=False,
+                    batch_sizes=(16, 128), partitioner=_dp(8))
+    scorer.warmup()
+    sup = DeviceSupervisor(scorer, canary_deadline_ms=150.0)
+    assert sup.domain == "mesh"
+    assert sup.device == "mesh:cpux8"
+    assert sup.status()["domain"] == "mesh"
+
+
+def test_mesh_fault_quarantines_the_mesh_tier_not_a_chip(params):
+    """A canary kill on ANY mesh device quarantines the whole mesh tier
+    (every sharded executable spans every chip — there is no per-chip
+    traffic to steer), and the router ladder pins to the host tier."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.runtime import faults
+    from ccfd_tpu.runtime.heal import DeviceSupervisor
+
+    scorer = Scorer(model_name="mlp", params=params, use_fused=False,
+                    batch_sizes=(16, 128), partitioner=_dp(8))
+    scorer.warmup()
+    sup = DeviceSupervisor(scorer, canary_deadline_ms=120.0,
+                           suspect_strikes=2, backoff_base_s=5.0,
+                           backoff_cap_s=5.0)
+    plan = faults.DeviceFaultPlan.from_string("device_hang:ms=400")
+    faults.install_device_faults(plan)
+    try:
+        for _ in range(4):
+            if sup.tick() == "quarantined":
+                break
+        assert sup.state == "quarantined"
+        # the quarantine label names the MESH DOMAIN, not one chip
+        assert sup.device.startswith("mesh:")
+        assert not sup.device_allowed()
+    finally:
+        faults.install_device_faults(None)
+
+    # the router's heal gate sees the mesh-tier quarantine: host serves
+    cfg = Config(confidence_threshold=1.0)
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    engine = build_engine(cfg, broker, reg, None)
+    r = Router(cfg, broker, scorer.score, engine, reg, max_batch=256,
+               host_score_fn=scorer.host_score, degrade=True,
+               heal_gate=sup)
+    try:
+        broker.produce_batch(cfg.kafka_topic,
+                             [b"0," * 29 + b"0"] * 32, list(range(32)))
+        assert r.step() == 32
+        assert reg.counter("router_degraded_total").value(
+            {"tier": "host"}) == 32
+    finally:
+        r.close()
+
+
+# -- operator wiring ---------------------------------------------------------
+
+def test_operator_arms_mesh_partitioner_and_gate(tmp_path):
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "mesh": {"enabled": True, "devices": 8},
+        "scorer": {"enabled": True, "model": "mlp"},
+        "bus": {"partitions": 2},
+        "router": {"workers": 2},
+        "retrain": {"enabled": True},
+        "engine": {"enabled": True},
+        "producer": {"enabled": False},
+        "monitoring": {"enabled": False},
+        "health": {"enabled": False},
+        "investigator": {"enabled": False},
+        "analytics": {"enabled": False},
+        "notify": {"enabled": False},
+        "heal": {"enabled": False},
+    }}
+    p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up()
+    try:
+        assert p.mesh is not None and p.partitioner is not None
+        assert p.scorer.mesh is p.mesh
+        assert p.scorer.partitioner is p.partitioner
+        # publish path armed with the live router pool
+        assert p.partitioner.gate is not None
+        assert p.partitioner.gate.barrier is p.router
+        assert p.scorer._swap_gate is p.partitioner.gate
+        st = p.status()["mesh"]
+        assert st["devices"] == 8 and st["axes"]["data"] == 8
+        reg = p.registries["mesh"]
+        assert reg.gauge("ccfd_mesh_devices").value() == 8.0
+    finally:
+        p.down()
+
+
+def test_operator_clamps_oversized_cr_to_servable_shape():
+    """A CR sized for hardware that is not there (16 devices, tp=3,
+    ring attention) must still SERVE: clamp to the local device count,
+    fall back to pure data parallel when the clamped count breaks the
+    fsdp*tp factorization, and drop seq_parallel with tp gone."""
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "mesh": {"enabled": True, "devices": 16, "tp": 3,
+                 "seq_parallel": "ring"},
+        "scorer": {"enabled": True, "model": "mlp"},
+        "bus": {"partitions": 1},
+        "router": {"enabled": False},
+        "engine": {"enabled": False},
+        "notify": {"enabled": False},
+        "retrain": {"enabled": False},
+        "producer": {"enabled": False},
+        "monitoring": {"enabled": False},
+        "health": {"enabled": False},
+        "investigator": {"enabled": False},
+        "analytics": {"enabled": False},
+        "lifecycle": {"enabled": False},
+        "heal": {"enabled": False},
+    }}
+    p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up()
+    try:
+        st = p.status()["mesh"]
+        assert st["devices"] == 8
+        assert st["axes"] == {"data": 8, "fsdp": 1, "tp": 1}
+        assert st["seq_parallel"] == "none"
+        assert p.scorer.mesh is p.mesh
+    finally:
+        p.down()
+
+
+def test_restart_hash_mismatch_restamps_lineage(tmp_path, dataset, params):
+    """A GC'd/corrupted champion checkpoint falls back to the live tree
+    at restart; the mismatch is logged AND the lineage record re-stamps
+    to the served tree's hash, so the next restart of the now-stable
+    tree doesn't re-raise the same alarm."""
+    import shutil
+
+    from ccfd_tpu.lifecycle.versions import VersionStore
+
+    scorer, cfg, broker, reg, store, shadow, ctl = (
+        _sharded_lifecycle_stack(tmp_path, params))
+    recorded = store.get(ctl.champion).checkpoint_hash
+    ctl.close()
+    shutil.rmtree(str(tmp_path / "ckpt"))  # the checkpoint is gone
+
+    from ccfd_tpu.lifecycle.controller import (Guardrails,
+                                               LifecycleController)
+    from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+    from ccfd_tpu.lifecycle.shadow import ShadowTap
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    other = _improved(params, bias=0.5)  # the fallback live tree differs
+    scorer2 = Scorer(model_name="mlp", params=other,
+                     compute_dtype="float32", use_fused=False)
+    store2 = VersionStore(str(tmp_path / "versions.json"))
+    ctl2 = LifecycleController(
+        cfg, scorer2, store=store2,
+        checkpoints=CheckpointManager(str(tmp_path / "ckpt"), keep=8),
+        shadow=ShadowTap(scorer2, broker, cfg.shadow_topic, Registry()),
+        evaluator=ShadowEvaluator(cfg, broker, scorer2, Registry()),
+        guardrails=Guardrails(), registry=Registry())
+    restamped = store2.get(ctl2.champion).checkpoint_hash
+    assert restamped == params_fingerprint(
+        jax.tree.map(np.asarray, other))
+    assert restamped != recorded
+    ctl2.close()
+
+
+def test_operator_single_device_mesh_stays_unsharded():
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    cr = {"spec": {
+        "mesh": {"enabled": True, "devices": 1},
+        "scorer": {"enabled": True, "model": "mlp"},
+        "bus": {"partitions": 1},
+        "router": {"enabled": False},
+        "engine": {"enabled": False},
+        "notify": {"enabled": False},
+        "retrain": {"enabled": False},
+        "producer": {"enabled": False},
+        "monitoring": {"enabled": False},
+        "health": {"enabled": False},
+        "investigator": {"enabled": False},
+        "analytics": {"enabled": False},
+        "lifecycle": {"enabled": False},
+        "heal": {"enabled": False},
+    }}
+    p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up()
+    try:
+        assert p.mesh is None and p.partitioner is None
+        assert p.scorer.mesh is None  # the historical path, untouched
+    finally:
+        p.down()

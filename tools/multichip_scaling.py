@@ -19,6 +19,13 @@ Sections per mesh size n: data-sharded scoring forward, dp-sharded train
 step, and sequence-parallel attention (ring + ulysses at sp=n) vs the
 single-device attention on the same (batch, seq) work.
 
+The scoring/train sections build through bench.py's ``_section_scorer`` /
+``_hop_buckets`` construction and the live platform's partitioner
+(parallel/partition.py DataParallelPartitioner over a named mesh) — the
+SAME bucket ladder, compute dtype and dispatch surface bench's devices=N
+scaling row measures, so dryrun and bench numbers are directly comparable
+(ISSUE 12 satellite).
+
 Run: python tools/multichip_scaling.py [sizes...]   (default 2 4 8)
 """
 from __future__ import annotations
@@ -41,13 +48,10 @@ import numpy as np
 n = int(os.environ["CCFD_SCALE_DEVICES"])
 assert len(jax.devices()) >= n, (len(jax.devices()), n)
 
-from ccfd_tpu.parallel import multihost
 from ccfd_tpu.parallel.train import TrainConfig, init_state, make_train_step
-from ccfd_tpu.parallel.sharding import batch_spec, label_spec
 from ccfd_tpu.models import mlp
 
 devices = jax.devices()[:n]
-mesh = multihost.make_global_mesh(model_parallel=1, devices=devices)
 
 COMM_OPS = ("all-reduce", "all-gather", "reduce-scatter",
             "collective-permute", "all-to-all")
@@ -76,25 +80,36 @@ def timed(fn, *args, budget_s=1.5):
 
 out = {"devices": n}
 
-# --- scoring forward: same 16384-row global batch, unsharded vs sharded --
+# --- scoring: same 16384-row global work through the SHARED bench
+# construction (_section_scorer/_hop_buckets + the live platform's
+# partitioner), unsharded vs data-sharded — dryrun and bench scaling-row
+# numbers are built on one scorer surface and stay comparable
+import bench
+from ccfd_tpu.parallel.mesh import make_named_mesh
+from ccfd_tpu.parallel.partition import DataParallelPartitioner
+
 X = np.random.default_rng(0).standard_normal((16384, 30)).astype(np.float32)
 params = mlp.init(jax.random.PRNGKey(0), hidden=256)
-fwd1 = jax.jit(lambda p, x: mlp.apply(p, x, jnp.float32))
-x_one = jax.device_put(X, devices[0])
-p_one = jax.device_put(params, devices[0])
-t_un = timed(fwd1, p_one, x_one)
+s_un = bench._section_scorer("mlp", params, X.shape[0], use_fused=False)
+t_un = timed(lambda: s_un.score_pipelined(X, depth=1))
 
-fwd_n = jax.jit(lambda p, x: mlp.apply(p, x, jnp.float32),
-                in_shardings=(None, batch_spec(mesh)))
-x_sh = jax.device_put(X, batch_spec(mesh))
-fwd_n_c = compile_once(fwd_n, params, x_sh)
-t_sh = timed(fwd_n_c, params, x_sh)
+part = DataParallelPartitioner(make_named_mesh(devices))
+s_sh = bench._section_scorer("mlp", params, X.shape[0], use_fused=False,
+                             partitioner=part)
+t_sh = timed(lambda: s_sh.score_pipelined(X, depth=1))
+# the sharded serving executable's comm-op count: the Scorer's jitted
+# apply with the partitioner's in/out shardings (same surface the row
+# above serves from)
+xb = s_sh._put_batch(np.zeros((s_sh.batch_sizes[-1], 30), np.float32))
 out["score"] = {
     "global_rows": int(X.shape[0]),
+    "construction": "bench._section_scorer (_hop_buckets ladder, "
+                    "bf16, partitioner-sharded)",
     "unsharded_ms": round(t_un * 1e3, 3),
     "sharded_ms": round(t_sh * 1e3, 3),
     "overhead_pct": round((t_sh / t_un - 1) * 100, 1),
-    "comm_ops": comm_counts(fwd_n_c),
+    "comm_ops": comm_counts(
+        s_sh._apply.lower(s_sh.params, xb).compile()),
 }
 
 # --- train step: same 4096-row global batch, dp-sharded vs unsharded -----
@@ -111,10 +126,12 @@ def train_once_un(s=[state1]):
 t_un = timed(train_once_un)
 
 params_n = mlp.init(jax.random.PRNGKey(1), hidden=256)
-step_n = make_train_step(tc, mesh=mesh)
+# the live platform's retrain construction: the partitioner's explicit-
+# sharding donated step (parallel/partition.py), same as OnlineTrainer's
+step_n = make_train_step(tc, partitioner=part)
 state_n = init_state(params_n, tc)
-xb_sh = jax.device_put(xb, batch_spec(mesh))
-yb_sh = jax.device_put(yb, label_spec(mesh))
+xb_sh = jax.device_put(xb, part.batch_sharding)
+yb_sh = jax.device_put(yb, part.out_sharding)
 def train_once_sh(s=[state_n]):
     s[0], loss = step_n(s[0], xb_sh, yb_sh)
     return loss
@@ -131,7 +148,7 @@ grad_jit = jax.jit(
             pp, xx, yy, pos_weight=tc.pos_weight, compute_dtype=jnp.float32
         )
     )(p, x, y),
-    in_shardings=(None, batch_spec(mesh), label_spec(mesh)),
+    in_shardings=(None, part.batch_sharding, part.out_sharding),
 )
 out["retrain"] = {
     "global_rows": int(xb.shape[0]),

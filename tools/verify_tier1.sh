@@ -55,6 +55,20 @@
 #                                          measured (non-placeholder)
 #                                          values:
 #                                          INCIDENTSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --mesh-smoke     exit-code-gated smoke of
+#                                          multi-chip sharded serving
+#                                          (tools/mesh_smoke.py): the
+#                                          live operator platform on a
+#                                          forced 8-device CPU mesh —
+#                                          sharded serving with
+#                                          accounting conserved, single-
+#                                          device vs mesh score parity,
+#                                          one lifecycle swap under load
+#                                          riding the partitioner's
+#                                          publish gate, and the mesh
+#                                          gauges scraped over real
+#                                          HTTP:
+#                                          MESHSMOKE verdict=PASS|FAIL
 #   tools/verify_tier1.sh --heal-smoke     exit-code-gated smoke of the
 #                                          device self-healing plane
 #                                          (tools/heal_smoke.py): an
@@ -118,6 +132,19 @@ if [ "${1:-}" = "--incident-smoke" ]; then
     # the script prints INCIDENTSMOKE verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/incident_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--mesh-smoke" ]; then
+    # exit-code-gated smoke of multi-chip sharded serving: the operator
+    # platform on a forced 8-device CPU mesh must serve sharded with
+    # accounting conserved, score parity vs single-device, and a
+    # lifecycle swap under load through the publish gate (see
+    # tools/mesh_smoke.py; the script prints MESHSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/mesh_smoke.py; then
         exit 0
     fi
     exit 1
