@@ -40,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ccfd_tpu.config import Config
 from ccfd_tpu.data.ccfd import FEATURE_NAMES, NUM_FEATURES
+from ccfd_tpu.runtime.durability import CorruptArtifactError
 from ccfd_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
 DEFAULT_NBINS = 32
@@ -65,26 +66,32 @@ class Report(NamedTuple):
         baseline survives restarts — the DriftMonitor otherwise loses its
         reference distribution on every bring-up and must re-summarize the
         training set before the first drift score."""
-        import os
+        import io
 
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:
-            np.savez(
-                f,
-                n=np.int64(self.n),
-                **{k: np.asarray(getattr(self, k))
-                   for k in ("mean", "std", "min", "max", "hist", "edges",
-                             "corr", "class_counts", "amount_sum_by_class")},
-            )
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from ccfd_tpu.runtime.durability import write_artifact
+
+        buf = io.BytesIO()
+        np.savez(
+            buf,
+            n=np.int64(self.n),
+            **{k: np.asarray(getattr(self, k))
+               for k in ("mean", "std", "min", "max", "hist", "edges",
+                         "corr", "class_counts", "amount_sum_by_class")},
+        )
+        write_artifact(path, buf.getvalue(), artifact="drift_reference")
         return path
 
     @staticmethod
     def load(path: str) -> "Report":
-        data = np.load(path)
+        """Verified read (runtime/durability.py): a corrupt reference
+        quarantines and the last-good retained generation loads — the PSI
+        baseline degrades to slightly stale, never to garbage."""
+        import io
+
+        from ccfd_tpu.runtime.durability import read_artifact
+
+        data = np.load(io.BytesIO(read_artifact(
+            path, artifact="drift_reference")))
         return Report(
             n=int(data["n"]),
             mean=data["mean"], std=data["std"],
@@ -327,9 +334,11 @@ class DriftMonitor:
                         self.reference = loaded
                 # np.load surfaces corruption as BadZipFile (truncated
                 # archive) or EOFError (empty file), neither an OSError —
-                # all of them mean "rebuild", never "refuse to start"
+                # and the durability layer raises CorruptArtifactError
+                # when NO retained generation verifies. All of them mean
+                # "rebuild", never "refuse to start"
                 except (OSError, KeyError, ValueError, EOFError,
-                        zipfile.BadZipFile) as e:
+                        zipfile.BadZipFile, CorruptArtifactError) as e:
                     import logging
 
                     logging.getLogger(__name__).warning(

@@ -92,8 +92,44 @@ def decode_entry(payload: bytes) -> tuple[Any, float, Any]:
     return key, float(header.get("ts", 0.0)), value
 
 
+# bound the byte-wise resync scan after a mid-file corrupt frame: the
+# scan is corruption-path-only, but a 64 MiB segment must not stall
+# reopen for minutes hunting a resync point through garbage
+_RESYNC_SCAN_BYTES = 8 * 1024 * 1024
+
+
+def _count_records_past_corruption(buf: bytes, valid: int) -> int:
+    """How many VALID records sit beyond a corrupt frame at ``valid``.
+
+    Truncating at the first corrupt frame is the only offset-safe
+    recovery (later records' offsets would silently shift), but doing it
+    SILENTLY hides that mid-file corruption — unlike a torn tail — drops
+    real, durable records. Resync by scanning forward for the next
+    parseable frame chain and count what the truncation discards, so the
+    loss is loud (``ccfd_storage_log_truncated_records_total``) instead
+    of invisible."""
+    import binascii
+    import struct
+
+    end = len(buf)
+    limit = min(end - 8, valid + 1 + _RESYNC_SCAN_BYTES)
+    pos = valid + 1
+    while pos <= limit:
+        ln, crc = struct.unpack_from("<II", buf, pos)
+        if 0 < ln <= end - pos - 8 and (
+                binascii.crc32(buf[pos + 8: pos + 8 + ln]) & 0xFFFFFFFF
+                == crc):
+            recs, _consumed, _corrupt = scan_records(buf[pos:])
+            return len(recs)
+        pos += 1
+    return 0
+
+
 class SegmentFile:
-    """One append-only framed file. Replay truncates a torn/corrupt tail."""
+    """One append-only framed file. Replay truncates a torn/corrupt tail;
+    mid-file corruption (bitrot, not a crash) truncates too — offsets
+    must stay stable — but counts and loudly logs the valid records the
+    truncation drops (ISSUE 13 satellite)."""
 
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
@@ -105,8 +141,22 @@ class SegmentFile:
             return []
         with open(self.path, "rb") as f:
             buf = f.read()
-        payloads, valid, _corrupt = scan_records(buf)
+        payloads, valid, corrupt = scan_records(buf)
         if valid < len(buf):  # crashed tail: recover the valid prefix
+            if corrupt:
+                dropped = _count_records_past_corruption(buf, valid)
+                if dropped:
+                    import logging
+
+                    from ccfd_tpu.runtime.durability import note
+
+                    note("log_truncated_records", dropped)
+                    logging.getLogger(__name__).error(
+                        "segment %s: corrupt frame at byte %d drops %d "
+                        "VALID later record(s) — truncating to the valid "
+                        "prefix (offsets must stay stable); re-drive from "
+                        "an earlier cut recovers them", self.path, valid,
+                        dropped)
             with open(self.path, "r+b") as f:
                 f.truncate(valid)
         return payloads
@@ -275,6 +325,12 @@ class BusLog:
         self.fsync = fsync
         self.segment_bytes = segment_bytes
         os.makedirs(directory, exist_ok=True)
+        # a crash mid-compaction (or mid-write anywhere in this dir)
+        # leaves orphan *.tmp debris — e.g. offsets.log's compaction tmp;
+        # swept at open, counted in ccfd_storage_tmp_swept_total
+        from ccfd_tpu.runtime.durability import sweep_tmp
+
+        sweep_tmp(directory)
         self._meta = SegmentFile(os.path.join(directory, self.META), fsync)
         self._offsets = SegmentFile(os.path.join(directory, self.OFFSETS), fsync)
         self._topic_ids: dict[str, int] = {}
@@ -319,7 +375,11 @@ class BusLog:
         # crash mid-compaction leaves either the old or the new file intact.
         if n_raw > max(64, 4 * n_unique):
             tmp = self._offsets.path + ".tmp"
-            compacted = SegmentFile(tmp, fsync=self.fsync)
+            # fsync=True regardless of the bus's per-append policy: this
+            # is a REWRITE, not an append — a rename that survives a host
+            # crash whose data did not would lose every committed offset
+            # (appends merely lose their tail; ISSUE 13 satellite)
+            compacted = SegmentFile(tmp, fsync=True)
             try:
                 os.unlink(tmp)
             except FileNotFoundError:

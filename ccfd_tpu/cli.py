@@ -329,43 +329,58 @@ def _save_gbt_params(gbt_dir: str, params) -> str:
     family's artifact is four arrays, not an optimizer-bearing pytree, so
     a plain npz beats an orbax checkpoint here (humanly inspectable,
     loadable without the model's init shapes)."""
+    import io
+
     import numpy as np
 
+    from ccfd_tpu.runtime.durability import write_artifact
+
     d = gbt_dir or _GBT_DIR
-    os.makedirs(d, exist_ok=True)
     path = os.path.join(d, "params.npz")
-    # atomic swap: a crash mid-save (or a reader racing a refresh) must
-    # never surface a half-written artifact or destroy the previous one
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(
-            f,
-            feature=np.asarray(params["feature"]),
-            threshold=np.asarray(params["threshold"]),
-            leaf=np.asarray(params["leaf"]),
-            base=np.asarray(params["base"]),
-        )
-    os.replace(tmp, path)
+    # checksummed atomic swap (runtime/durability.py — the hand-rolled
+    # tmp+rename here skipped the fsync, so a power loss could lose BOTH
+    # copies): a crash mid-save or a reader racing a refresh never sees a
+    # half-written artifact, and a corrupt file falls back to the
+    # retained last-good generation on read
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        feature=np.asarray(params["feature"]),
+        threshold=np.asarray(params["threshold"]),
+        leaf=np.asarray(params["leaf"]),
+        base=np.asarray(params["base"]),
+    )
+    write_artifact(path, buf.getvalue(), artifact="gbt_params",
+                   best_effort=False)
     return path
 
 
 def _restore_gbt_params(gbt_dir: str):
     """The `train --family hgb` artifact as served gbt params, or None."""
-    import numpy as np
-
-    path = os.path.join(gbt_dir or _GBT_DIR, "params.npz")
-    if not os.path.exists(path):
-        return None
+    import io
     import zipfile
 
     import jax.numpy as jnp
+    import numpy as np
 
+    from ccfd_tpu.runtime.durability import (
+        CorruptArtifactError,
+        read_artifact,
+    )
+
+    path = os.path.join(gbt_dir or _GBT_DIR, "params.npz")
     try:
-        with np.load(path) as z:
+        # verified read: a corrupt file quarantines and the last-good
+        # retained generation serves; legacy unframed files still load
+        raw = read_artifact(path, artifact="gbt_params")
+        with np.load(io.BytesIO(raw)) as z:
             params = {k: jnp.asarray(z[k])
                       for k in ("feature", "threshold", "leaf", "base")}
+    except FileNotFoundError:
+        return None
     # BadZipFile subclasses Exception directly — a truncated npz raises it
-    except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile,
+            CorruptArtifactError) as e:
         print(f"[checkpoint] unreadable gbt params at {path} ({e!r}); "
               "serving fresh init", file=sys.stderr)
         return None

@@ -217,6 +217,28 @@ class Config:
     # (tmp+rename)
     incident_dir: str = ""                 # CCFD_INCIDENT_DIR
 
+    # --- durable-state integrity (runtime/durability.py; CR block
+    # `durability:`) ---
+    # generations retained per single-file artifact (lineage, recovery
+    # cuts, engine snapshots, usertask/drift npz): a corrupt live file
+    # quarantines to *.corrupt and the newest verifiable generation
+    # serves instead (CCFD_STORAGE_RETAIN; 0 disables retention — reads
+    # then fail hard to cold-start on corruption)
+    storage_retain: int = 3
+    # fsync before every atomic rename (CCFD_STORAGE_FSYNC; 0 trades
+    # host-crash durability for write latency — process-crash safety is
+    # kept either way)
+    storage_fsync: bool = True
+    # startup sweep of orphaned *.tmp files a crash mid-write leaves
+    # behind (CCFD_STORAGE_SWEEP; counted ccfd_storage_tmp_swept_total)
+    storage_sweep: bool = True
+    # standing storage-fault plan (CCFD_STORAGE_FAULTS,
+    # "bitrot;torn_write:rate=0.5;slow_disk:ms=10" — runtime/faults.py
+    # storage faults, injected at the durability seam every persistent
+    # writer/reader shares). "" = none. The chaos CR block's
+    # `storage_faults` option is the storm-scheduled form.
+    storage_faults_spec: str = ""
+
     # --- device self-healing (runtime/heal.py; CR block `heal:`) ---
     # master switch for the DeviceSupervisor: per-device health state
     # machine (HEALTHY -> SUSPECT -> QUARANTINED -> PROBATION), canary
@@ -508,6 +530,15 @@ class Config:
             ),
             device_faults_spec=e.get("CCFD_DEVICE_FAULTS",
                                      Config.device_faults_spec),
+            storage_retain=int(
+                e.get("CCFD_STORAGE_RETAIN", str(Config.storage_retain))
+            ),
+            storage_fsync=e.get("CCFD_STORAGE_FSYNC", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            storage_sweep=e.get("CCFD_STORAGE_SWEEP", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            storage_faults_spec=e.get("CCFD_STORAGE_FAULTS",
+                                      Config.storage_faults_spec),
             device_enabled=e.get("CCFD_DEVICE", "1").strip().lower()
             not in ("0", "false", "no", "off"),
             incident_enabled=e.get("CCFD_INCIDENT", "1").strip().lower()

@@ -184,6 +184,8 @@ class LifecycleController:
         guardrails: Guardrails | None = None,
         registry: Any = None,
         breaker: Any = None,
+        storage_pin: Callable[[str], None] | None = None,
+        storage_unpin: Callable[[], None] | None = None,
     ):
         self.cfg = cfg
         self.scorer = scorer
@@ -194,6 +196,14 @@ class LifecycleController:
         self.gate = gate if gate is not None else CanaryGate(scorer, registry)
         self.guardrails = guardrails or Guardrails()
         self.breaker = breaker  # scorer-edge CircuitBreaker (may be None)
+        # storage-integrity pin (runtime/durability.StoragePinGate): when
+        # the champion's checkpoint — and every verifiable fallback step —
+        # is corrupt, serving must pin to the RULES tier through the PR 11
+        # heal-gate seam rather than publish an unverified tree; cleared
+        # the moment a verified tree is published again
+        self._storage_pin = storage_pin
+        self._storage_unpin = storage_unpin
+        self.storage_pinned = False
         # rebase hook (wired by the operator to OnlineTrainer.rebase): on
         # REJECT/ROLLBACK the trainer's training state re-bases onto the
         # champion, so later candidates genuinely DESCEND from the
@@ -333,19 +343,108 @@ class LifecycleController:
             return None
 
     def _restore_params(self, version) -> Any:
-        """Champion params from its checkpoint; falls back to the scorer's
-        live tree when the checkpoint is gone (GC'd or first boot)."""
+        """Champion params from its checkpoint, with integrity fallback
+        (ISSUE 13): a corrupt recorded checkpoint is quarantined by the
+        CheckpointManager and the restore walks to the NEWEST VERIFIABLE
+        step — the pinned set (the champion's own) first, then the
+        remaining steps newest-first, which reaches the parent champion's
+        retained checkpoint. When a checkpoint was recorded but NOTHING
+        verifies, serving pins to the rules tier (storage_pin) instead of
+        publishing an unverified tree; the caller's existing hash-mismatch
+        alarm fires and re-stamps the lineage on any fallback serve."""
+        from ccfd_tpu.runtime.durability import CorruptArtifactError
+
         like = self._host_copy(self.scorer.params)
         step = version.checkpoint_step
-        if step is not None:
+        if step is None:
+            return like  # genesis bootstrap: nothing recorded yet
+        order: list[int] = [step]
+        order += sorted(self.checkpoints.pinned, reverse=True)
+        seen: set[int] = set()
+        saw_corrupt = False
+        for s in order:
+            if s in seen:
+                continue
+            seen.add(s)
             try:
-                restored = self.checkpoints.restore(like, step=step)
-                if restored is not None:
-                    return restored[0]
+                restored = self.checkpoints.restore(like, step=s)
+            except CorruptArtifactError:
+                saw_corrupt = True
+                log.error("champion v%d checkpoint step %d corrupt "
+                          "(quarantined); trying the next verifiable step",
+                          version.version, s)
+                continue
             except (FileNotFoundError, OSError, ValueError):
-                log.warning("champion v%d checkpoint %s missing; using the "
-                            "scorer's live params", version.version, step)
+                continue
+            if restored is not None:
+                self._note_storage_restore(version, s, step)
+                return restored[0]
+        # the recorded step (and every pin) failed: newest verifiable step
+        # of the whole retained history, the parent champion included
+        s = self.checkpoints.newest_verified_step()
+        if s is not None and s not in seen:
+            try:
+                restored = self.checkpoints.restore(like, step=s)
+                if restored is not None:
+                    self._note_storage_restore(version, s, step)
+                    return restored[0]
+            except (CorruptArtifactError, FileNotFoundError, OSError,
+                    ValueError):
+                pass
+        if not saw_corrupt and self.checkpoints.latest_step() is None:
+            # nothing on disk at all — every step MISSING (GC'd root,
+            # wiped volume), none corrupt: the scorer's live tree is a
+            # healthy verified init, not quarantined evidence. Serve it
+            # with the historical warning; the pin is for the
+            # corruption-detected case only (saw_corrupt also covers a
+            # lone corrupt genesis step the walk just quarantined out of
+            # the listing).
+            log.warning("champion v%d checkpoint %s missing (no steps on "
+                        "disk); using the scorer's live params",
+                        version.version, step)
+            self._clear_storage_pin()
+            return like
+        log.error(
+            "champion v%d: no checkpoint generation verifies (recorded "
+            "step %s); pinning serving to the RULES tier rather than "
+            "publishing an unverified tree", version.version, step)
+        self._pin_storage(
+            f"no verifiable checkpoint for champion v{version.version}")
         return like
+
+    def _note_storage_restore(self, version, served_step: int,
+                              recorded_step: int) -> None:
+        """A verified tree is about to serve: clear any storage pin, and
+        audit a fallback serve (the hash re-stamp alarm in the restart
+        path fires on top of this when the bytes differ)."""
+        self._clear_storage_pin()
+        if served_step != recorded_step:
+            self.store.record_event(
+                version.version, "storage_fallback_restore",
+                {"recorded_step": recorded_step, "served_step": served_step,
+                 "note": "recorded checkpoint unverifiable; newest "
+                         "verifiable generation served"})
+
+    def _pin_storage(self, reason: str) -> None:
+        self.storage_pinned = True
+        if self._storage_pin is not None:
+            try:
+                self._storage_pin(reason)
+            except Exception:  # noqa: BLE001 - the pin is protective
+                log.exception("storage pin hook failed")
+        self.store.record_event(None, "storage_pin", {"reason": reason})
+
+    def _clear_storage_pin(self) -> None:
+        if not self.storage_pinned:
+            return
+        self.storage_pinned = False
+        if self._storage_unpin is not None:
+            try:
+                self._storage_unpin()
+            except Exception:  # noqa: BLE001
+                log.exception("storage unpin hook failed")
+        self.store.record_event(None, "storage_unpin",
+                                {"reason": "verified params published"})
 
     def wrap_score(self, score_fn: Callable) -> Callable:
         """Compose the serving lane: shadow tap inside (sees pure champion
@@ -562,6 +661,9 @@ class LifecycleController:
         old_champion = self.champion
         self.gate.deactivate()
         self.scorer.swap_params(params)
+        # the promoted tree was checkpointed (verified) at submit: a
+        # storage pin from an earlier unverifiable restart clears here
+        self._clear_storage_pin()
         self.shadow.disarm()
         self.scorer.clear_challenger()
         self.evaluator.end()

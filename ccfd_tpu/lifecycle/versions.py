@@ -19,11 +19,12 @@ keeps everything in memory (tests, ephemeral runs).
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import threading
 import time
 from typing import Any, Iterable
+
+from ccfd_tpu.runtime import durability
 
 # stage vocabulary — the state machine the controller walks plus the
 # terminal stamps the audit trail distinguishes
@@ -98,34 +99,55 @@ class VersionStore:
         self._versions: dict[int, ModelVersion] = {}
         self._audit: list[dict[str, Any]] = []
         self._next = 1
-        if path and os.path.exists(path):
+        if path and recover:
+            # recover=False is the read-only inspection surface: it must
+            # never mutate the live directory (no sweep, no quarantine) —
+            # a live writer's in-flight unique tmp is not debris
+            durability.sweep_tmp(os.path.dirname(os.path.abspath(path)))
+        if path and (os.path.exists(path) or durability.has_generations(path)):
             try:
-                self._load()
-            except (OSError, ValueError, KeyError, TypeError) as e:
+                self._load(recover=recover)
+            except (OSError, ValueError, KeyError, TypeError,
+                    durability.CorruptArtifactError) as e:
                 if not recover:
                     # read-only consumers (the inspection CLI) must
                     # REPORT corruption, never quarantine the live file
                     raise
-                # a corrupt/truncated lineage file must not brick every
-                # subsequent bring-up: preserve the evidence out of the
-                # way and start a fresh lineage (the loss is logged; the
+                # NOTHING verifies — not the live file (quarantined to
+                # *.corrupt by the durability layer) nor any retained
+                # generation: the last resort is a fresh lineage rather
+                # than a bricked bring-up (the loss is logged; the
                 # champion re-bootstraps from the scorer's live params)
                 import logging
 
-                quarantine = f"{path}.corrupt"
-                try:
-                    os.replace(path, quarantine)
-                except OSError:
-                    quarantine = "<unmovable>"
                 logging.getLogger(__name__).error(
-                    "lifecycle lineage %s unreadable (%r); moved to %s "
-                    "and starting a FRESH lineage", path, e, quarantine)
+                    "lifecycle lineage %s unreadable (%r) with no "
+                    "verifiable generation; starting a FRESH lineage",
+                    path, e)
                 self._versions, self._audit, self._next = {}, [], 1
 
     # -- persistence -------------------------------------------------------
-    def _load(self) -> None:
-        with open(self.path) as f:
-            data = json.load(f)
+    def _load(self, recover: bool = True) -> None:
+        # verified read: a torn/bit-flipped lineage quarantines and falls
+        # back to the last-good retained generation (runtime/durability.py).
+        # A LEGACY (unframed) file carries no checksum, so its corruption
+        # only surfaces at the JSON parse — quarantine it then and retry,
+        # which reads straight from the generations.
+        import json
+
+        data = None
+        for attempt in (0, 1):
+            payload = durability.read_artifact(
+                self.path, artifact="lineage", fallback=True,
+                quarantine=recover)
+            try:
+                data = json.loads(payload)
+                break
+            except ValueError:
+                if not recover or attempt:
+                    raise
+                durability.note("corrupt", artifact="lineage")
+                os.replace(self.path, f"{self.path}.corrupt")
         self._versions = {
             int(v["version"]): ModelVersion.from_dict(v)
             for v in data.get("versions", [])
@@ -141,26 +163,23 @@ class VersionStore:
     def _save_locked(self) -> None:
         if not self.path:
             return
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        tmp = f"{self.path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                {
-                    "next_version": self._next,
-                    "versions": [
-                        v.to_dict() for _, v in sorted(self._versions.items())
-                    ],
-                    "audit": self._audit,
-                },
-                f,
-                indent=1,
-            )
-            # flush data blocks before the rename: a rename that survives
-            # a power loss whose data did not is exactly the truncated
-            # file the constructor's quarantine path exists for
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        # checksummed + fsynced + atomic, with generation retention: the
+        # constructor's verified read falls back to the newest retained
+        # generation when the live file is torn or bit-flipped. A failed
+        # write (full disk, injected fault) keeps the last-good state —
+        # lineage lives in memory and lands on the next transition.
+        durability.write_json_artifact(
+            self.path,
+            {
+                "next_version": self._next,
+                "versions": [
+                    v.to_dict() for _, v in sorted(self._versions.items())
+                ],
+                "audit": self._audit,
+            },
+            artifact="lineage",
+            indent=1,
+        )
 
     # -- lineage -----------------------------------------------------------
     def create(

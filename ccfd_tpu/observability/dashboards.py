@@ -666,6 +666,44 @@ def heal_dashboard() -> dict:
     return _dashboard("CCFD Heal", "ccfd-heal", p)
 
 
+def storage_dashboard() -> dict:
+    """Durable-state integrity board (ISSUE 13; runtime/durability.py).
+
+    The disk-as-fallible-component surface: corrupt artifacts detected
+    and quarantined (the alert — every count here is a file that would
+    previously have crashed bring-up or silently served garbage),
+    last-good generation fallbacks, failed durable writes (full disk /
+    injected storage faults; in-memory state stays authoritative and
+    re-lands on the next save), verified vs legacy-unverified reads, the
+    startup orphan-tmp sweep, mid-file bus-log corruption (valid records
+    dropped past a corrupt frame — offset safety demands the truncation,
+    this counter makes the loss loud), and the rules-tier storage pin
+    (1 = NO params generation verifies; serving refuses unverified
+    trees)."""
+    p = [
+        _alert_stat(0, "Corrupt artifacts detected (quarantined)",
+                    ["sum(ccfd_storage_corrupt_total)"], red_above=1),
+        _alert_stat(1, "Serving pinned to rules tier (storage)",
+                    ["max(ccfd_storage_pinned)"], red_above=1),
+        _panel(2, "Corruption by artifact / s",
+               ["rate(ccfd_storage_corrupt_total[5m])"]),
+        _panel(3, "Last-good generation fallbacks",
+               ["ccfd_storage_fallback_total"], "stat"),
+        _alert_stat(4, "Durable write errors / s",
+                    ["sum(rate(ccfd_storage_write_errors_total[5m]))"],
+                    red_above=0.1),
+        _panel(5, "Reads: verified vs legacy-unverified / s",
+               ["sum(rate(ccfd_storage_verified_reads_total[5m]))",
+                "sum(rate(ccfd_storage_unverified_reads_total[5m]))"]),
+        _panel(6, "Orphan tmp files swept at startup",
+               ["ccfd_storage_tmp_swept_total"], "stat"),
+        _alert_stat(7, "Bus-log records dropped past mid-file corruption",
+                    ["ccfd_storage_log_truncated_records_total"],
+                    red_above=1),
+    ]
+    return _dashboard("CCFD Storage", "ccfd-storage", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -694,6 +732,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "SLO": slo_dashboard(),
         "Device": device_dashboard(),
         "Heal": heal_dashboard(),
+        "Storage": storage_dashboard(),
     }
 
 

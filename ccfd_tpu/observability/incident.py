@@ -290,10 +290,11 @@ class FlightRecorder:
                 old_id, old = self._bundles.popitem(last=False)
                 old_path = old.get("path")
                 if old_path:
-                    try:
-                        os.remove(old_path)
-                    except OSError:
-                        pass
+                    for p in (old_path, old_path + ".sha256"):
+                        try:
+                            os.remove(p)
+                        except OSError:
+                            pass
         if self._c_incidents is not None:
             self._c_incidents.inc(
                 labels={"trigger": str(trigger.get("type", "unknown"))})

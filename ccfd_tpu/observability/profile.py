@@ -495,15 +495,18 @@ class StageProfiler:
 
 
 def write_json_crash_safe(path: str, doc: Mapping[str, Any]) -> None:
-    """tmp+rename JSON write: a crash mid-write leaves the previous
-    artifact intact, never a torn file. The one writer every profile-
-    family artifact shares (StageProfiler.write, tools/slo_report.py,
-    tools/trace_report.py --json)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
+    """Crash-safe JSON write — tmp + fsync + rename plus a ``.sha256``
+    sidecar (runtime/durability.write_json_interchange): a crash
+    mid-write leaves the previous artifact intact, never a torn file,
+    and the sidecar lets consumers verify the bytes. The one writer
+    every profile-family artifact shares (StageProfiler.write,
+    tools/slo_report.py, tools/trace_report.py --json, the
+    FlightRecorder's incident bundles). Raises OSError on failure, like
+    the open() it replaced."""
+    from ccfd_tpu.runtime.durability import write_json_interchange
+
+    write_json_interchange(path, doc, artifact="profile_doc",
+                           best_effort=False, indent=1, sort_keys=True)
 
 
 def _digest_errors(where: str, d: Any) -> list[str]:

@@ -9,17 +9,39 @@ retrained weights, and retraining must resume from the last step.
 Uses ``orbax.checkpoint`` when importable (the production path — async,
 sharding-aware) and falls back to a plain ``.npz`` of the flattened pytree
 otherwise, so checkpointing never becomes an install-time dependency.
-"""
+
+Integrity (runtime/durability.py): the npz path writes ``params.npz``
+framed under a sha256 (atomic, fsynced); the orbax path — whose internal
+files are not ours to frame — gets a checksum manifest over the step dir.
+``restore`` VERIFIES before loading: a corrupt checkpoint is quarantined
+(the step dir renamed ``*.corrupt``, so it leaves the step listing and is
+never retried) and raises :class:`CorruptArtifactError`, and callers fall
+back to :meth:`newest_verified_step` — the lifecycle controller walks the
+pinned/parent steps and, when NOTHING verifies, pins serving to the rules
+tier instead of publishing an unverified tree. Step dirs written before
+this plane existed load as legacy (unverified, counted)."""
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
-from typing import Any
+from typing import Any, Iterable
 
 import jax
 import numpy as np
+
+from ccfd_tpu.runtime.durability import (
+    CorruptArtifactError,
+    note,
+    read_artifact,
+    sweep_tmp,
+    verify_dir_manifest,
+    verify_file,
+    write_artifact,
+    write_dir_manifest,
+)
 
 
 def _step_dirs(root: str) -> list[tuple[int, str]]:
@@ -43,6 +65,8 @@ class CheckpointManager:
         # rollback/restart restore from
         self.pinned: set[int] = set()
         os.makedirs(root, exist_ok=True)
+        # a crash mid-save leaves orphan tmp debris in the step dirs
+        sweep_tmp(root, *(p for _s, p in _step_dirs(root)))
         if use_orbax is None:
             try:
                 import orbax.checkpoint  # noqa: F401
@@ -61,25 +85,90 @@ class CheckpointManager:
             ckptr = ocp.PyTreeCheckpointer()
             ckptr.save(os.path.abspath(path), jax.tree.map(np.asarray, params),
                        force=True)
+            # integrity manifest over orbax's internal files: restore (and
+            # verify_step) checks every file's sha256 against it
+            write_dir_manifest(path, artifact="checkpoint")
         else:
             os.makedirs(path, exist_ok=True)
             leaves, treedef = jax.tree.flatten(params)
+            buf = io.BytesIO()
             np.savez(
-                os.path.join(path, "params.npz"),
+                buf,
                 **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
             )
-            with open(os.path.join(path, "treedef.json"), "w") as f:
-                json.dump({"n_leaves": len(leaves)}, f)
+            # framed + fsynced + atomic; a failed write (full disk,
+            # injected fault) keeps the previous state — restore-side
+            # verification and the newest-verified fallback own recovery
+            write_artifact(os.path.join(path, "params.npz"), buf.getvalue(),
+                           artifact="checkpoint", retain=0)
+            write_artifact(
+                os.path.join(path, "treedef.json"),
+                json.dumps({"n_leaves": len(leaves)}).encode(),
+                artifact="checkpoint", retain=0)
         self._gc()
         return path
+
+    # -- verification -----------------------------------------------------
+    def verify_step(self, step: int) -> bool | None:
+        """True when the step's checkpoint verifies (or predates the
+        integrity plane — legacy, nothing to check against), False when
+        it fails its checksum, None when no such step exists."""
+        match = [d for d in _step_dirs(self.root) if d[0] == step]
+        if not match:
+            return None
+        _step, path = match[0]
+        npz = os.path.join(path, "params.npz")
+        if os.path.exists(npz):
+            return bool(verify_file(npz))
+        return verify_dir_manifest(path, artifact="checkpoint") is not False
+
+    def newest_verified_step(self, prefer: Iterable[int] = ()) -> int | None:
+        """The first step that verifies, trying ``prefer`` in order first
+        and then every step newest-first — the champion-restore fallback
+        order (pinned/parent before arbitrary history)."""
+        seen: set[int] = set()
+        steps = [s for s, _p in _step_dirs(self.root)]
+        for s in list(prefer) + sorted(steps, reverse=True):
+            if s is None or s in seen or s not in steps:
+                continue
+            seen.add(s)
+            if self.verify_step(s):
+                return s
+        return None
+
+    def quarantine_step(self, step: int) -> str | None:
+        """Move a corrupt step dir out of the listing (``*.corrupt``) so
+        restart/rollback never re-reads it; returns the new path."""
+        match = [d for d in _step_dirs(self.root) if d[0] == step]
+        if not match:
+            return None
+        _step, path = match[0]
+        dest = f"{path}.corrupt"
+        try:
+            os.replace(path, dest)
+        except OSError:
+            return None
+        note("corrupt", artifact="checkpoint")
+        import logging
+
+        logging.getLogger(__name__).error(
+            "corrupt checkpoint step %d quarantined to %s", step, dest)
+        return dest
 
     # -- restore ----------------------------------------------------------
     def latest_step(self) -> int | None:
         dirs = _step_dirs(self.root)
         return dirs[-1][0] if dirs else None
 
-    def restore(self, like: Any, step: int | None = None) -> tuple[Any, int] | None:
-        """Restore params structured like ``like``; returns (params, step)."""
+    def restore(self, like: Any, step: int | None = None,
+                verify: bool = True) -> tuple[Any, int] | None:
+        """Restore params structured like ``like``; returns (params, step).
+
+        With ``verify`` (default), a checkpoint that fails its checksum —
+        or whose bytes no longer load — is QUARANTINED and raises
+        :class:`CorruptArtifactError`; callers fall back to
+        :meth:`newest_verified_step` (the lifecycle controller's champion
+        restore does) instead of serving corruption."""
         dirs = _step_dirs(self.root)
         if not dirs:
             return None
@@ -90,7 +179,13 @@ class CheckpointManager:
             if not match:
                 raise FileNotFoundError(f"no checkpoint for step {step} in {self.root}")
             step, path = match[0]
-        if self.use_orbax:
+        if self.use_orbax and not os.path.exists(
+                os.path.join(path, "params.npz")):
+            if verify and verify_dir_manifest(
+                    path, artifact="checkpoint") is False:
+                self.quarantine_step(step)
+                raise CorruptArtifactError(
+                    f"checkpoint step {step} failed manifest verification")
             import orbax.checkpoint as ocp
 
             ckptr = ocp.PyTreeCheckpointer()
@@ -99,8 +194,23 @@ class CheckpointManager:
             leaves = jax.tree.leaves(restored)
             treedef = jax.tree.structure(like)
             return jax.tree.unflatten(treedef, leaves), step
-        data = np.load(os.path.join(path, "params.npz"))
-        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        import zipfile
+
+        npz_path = os.path.join(path, "params.npz")
+        try:
+            raw = read_artifact(npz_path, artifact="checkpoint",
+                                fallback=False, quarantine=False)
+            data = np.load(io.BytesIO(raw))
+            leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        except (CorruptArtifactError, zipfile.BadZipFile, ValueError,
+                KeyError) as e:
+            # quarantine the WHOLE step dir (params + treedef move
+            # together) so the step leaves the listing
+            if verify:
+                self.quarantine_step(step)
+                raise CorruptArtifactError(
+                    f"checkpoint step {step} unreadable: {e!r}") from e
+            raise
         treedef = jax.tree.structure(like)
         return jax.tree.unflatten(treedef, leaves), step
 

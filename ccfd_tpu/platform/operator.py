@@ -70,6 +70,10 @@ _COMPONENTS = (
                   # mesh + partitioner for data-parallel sharded serving
                   # and donated sharded retrain (new; parallel/partition.py;
                   # armed when devices > 1)
+    "durability", # durable-state integrity plane: checksummed artifacts,
+                  # quarantine + last-good recovery, orphan-tmp sweep,
+                  # rules-tier pin when nothing verifies (new;
+                  # runtime/durability.py)
 )
 
 
@@ -148,6 +152,9 @@ class Platform:
         self.partitioner = None  # parallel/partition.Partitioner
         self.device_fault_plan = None  # runtime/faults.DeviceFaultPlan
         self._device_storm_driven = False  # ChaosMonkey owns its duty cycle
+        self.storage_fault_plan = None  # runtime/faults.StorageFaultPlan
+        self._storage_storm_driven = False
+        self.storage_gate = None  # runtime/durability.StoragePinGate
         self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
@@ -212,6 +219,52 @@ class Platform:
                 active=not self._device_storm_driven,
             )
             install_device_faults(self.device_fault_plan)
+        # storage faults (runtime/faults.py StorageFaultPlan): same opt-in
+        # and storm rules — CR `chaos.storage_faults` or CCFD_STORAGE_FAULTS.
+        # Installed process-wide: the seam (durability.atomic_write_bytes)
+        # sits inside constructors and module helpers.
+        cr_sto_text = (chaos_spec.opt("storage_faults", "")
+                       if chaos_spec.enabled else "")
+        sto_fault_text = cr_sto_text or cfg.storage_faults_spec
+        self._storage_storm_driven = bool(cr_sto_text) and \
+            storm_interval is not None
+        if sto_fault_text:
+            from ccfd_tpu.runtime.faults import (
+                StorageFaultPlan,
+                install_storage_faults,
+            )
+
+            self.storage_fault_plan = StorageFaultPlan.from_string(
+                sto_fault_text,
+                seed=int(chaos_spec.opt("seed", 0)),
+                active=not self._storage_storm_driven,
+            )
+            install_storage_faults(self.storage_fault_plan)
+
+        # 0b. durable-state integrity plane (runtime/durability.py): the
+        # CR `durability:` block overlays the CCFD_STORAGE_* knobs, the
+        # ccfd_storage_* counters land in a scraped registry, and the
+        # StoragePinGate (rules-tier pin when NO params generation
+        # verifies) is created here so the lifecycle controller (step 7)
+        # can arm it before the router (step 6c... order: router then
+        # heal compose it into the heal-gate seam).
+        from ccfd_tpu.runtime import durability
+
+        dur_spec = spec.component("durability")
+        if dur_spec.enabled:
+            durability.configure(
+                retain=int(dur_spec.opt("retain", cfg.storage_retain)),
+                fsync=bool(dur_spec.opt("fsync", cfg.storage_fsync)),
+                sweep=bool(dur_spec.opt("sweep", cfg.storage_sweep)),
+            )
+            durability.bind_registry(self._registry("storage"))
+            self.storage_gate = durability.StoragePinGate(
+                registry=self._registry("storage"))
+        else:
+            # legacy mode: no retention copies, no sweep, no rules pin —
+            # reads still verify frames they find (integrity itself has
+            # no off switch; a checksum mismatch is never servable)
+            durability.configure(retain=0, sweep=False)
 
         # 0a. overload control (runtime/overload.py): the CR `overload:`
         # block overlays the CCFD_OVERLOAD_* env KNOBS once, here, so the
@@ -457,6 +510,11 @@ class Platform:
                 self.slo.add_breach_listener(self.recorder.on_breach)
             if self._overload is not None:
                 self._overload.recorder = self.recorder
+            if self.storage_gate is not None:
+                # storage quarantines dump a post-mortem bundle too
+                from ccfd_tpu.runtime import durability
+
+                durability.set_recorder(self.recorder.incident)
             inc_interval = float(
                 inc_spec.opt("interval_s", cfg.incident_interval_s))
             self.supervisor.add_thread_service(
@@ -535,6 +593,8 @@ class Platform:
                 fault_plan=self.fault_plan,
                 device_fault_plan=(self.device_fault_plan
                                    if self._device_storm_driven else None),
+                storage_fault_plan=(self.storage_fault_plan
+                                    if self._storage_storm_driven else None),
                 fault_interval_s=(float(c.opt("fault_interval_s"))
                                   if c.opt("fault_interval_s") else None),
                 fault_duration_s=float(c.opt("fault_duration_s", 2.0)),
@@ -818,6 +878,14 @@ class Platform:
             cfg, self.scorer, store=store, checkpoints=checkpoints,
             shadow=shadow, evaluator=evaluator, guardrails=guardrails,
             registry=registry,
+            # storage-integrity pin (runtime/durability.py): when no
+            # champion checkpoint generation verifies at restore, serving
+            # pins to the rules tier through the heal-gate seam instead
+            # of publishing an unverified tree
+            storage_pin=(self.storage_gate.pin
+                         if self.storage_gate is not None else None),
+            storage_unpin=(self.storage_gate.unpin
+                           if self.storage_gate is not None else None),
         )
         if is_seq:
             # the router calls a SeqScorer as an OBJECT (score_with_ids),
@@ -876,7 +944,15 @@ class Platform:
             )
             self._usertask_state_file = c.opt("usertask_state_file", "") or None
             if self._usertask_state_file and os.path.exists(self._usertask_state_file):
-                self.usertask_model.load(self._usertask_state_file)
+                try:
+                    self.usertask_model.load(self._usertask_state_file)
+                except Exception:  # noqa: BLE001 - an unrecoverable state
+                    # file (quarantined by the durability layer, no
+                    # verifiable generation) must read as a cold model,
+                    # never brick bring-up
+                    logging.getLogger(__name__).exception(
+                        "usertask state %s unusable; starting cold",
+                        self._usertask_state_file)
             pred = self.usertask_model
             listener = self.usertask_model.observe
         else:
@@ -901,7 +977,12 @@ class Platform:
         state_file = c.opt("state_file", "")
         self._engine_state_file = state_file or None
         if state_file and os.path.exists(state_file):
-            self.engine.load(state_file)
+            try:
+                self.engine.load(state_file)
+            except Exception:  # noqa: BLE001 - corrupt beyond every
+                # retained generation: cold engine beats a bricked boot
+                logging.getLogger(__name__).exception(
+                    "engine state %s unusable; starting cold", state_file)
         if state_file or getattr(self, "_usertask_state_file", None):
             # periodic checkpoint: a crash between saves loses at most
             # save_interval_s of process state — save-on-down alone would
@@ -1086,6 +1167,12 @@ class Platform:
                 **common,
             )
         self.router = router
+        if self.storage_gate is not None and hasattr(router,
+                                                     "set_heal_gate"):
+            # the storage pin binds even with the heal component off
+            # (CCFD_HEAL=0): unverifiable params pin serving to the rules
+            # tier regardless; _up_heal composes the DeviceSupervisor in
+            router.set_heal_gate(self.storage_gate)
         if self.partitioner is not None and self.scorer is not None:
             # swap-vs-dispatch publish path (parallel/partition.py): arm
             # the partitioner's PublishGate with the router pool's group
@@ -1146,8 +1233,17 @@ class Platform:
         if self.router is not None and hasattr(self.router,
                                                "set_heal_gate"):
             # quarantine pins the ladder to the host tier, ABOVE the
-            # breaker: even a half-open probe can't leak to a sick device
-            self.router.set_heal_gate(self.heal)
+            # breaker: even a half-open probe can't leak to a sick device.
+            # Composed with the storage pin (runtime/durability.py): an
+            # unverifiable-params pin blocks the HOST tier too (it would
+            # forward the same unverified tree) — rules only.
+            if self.storage_gate is not None:
+                from ccfd_tpu.runtime.durability import ComposedHealGate
+
+                self.router.set_heal_gate(
+                    ComposedHealGate(self.storage_gate, self.heal))
+            else:
+                self.router.set_heal_gate(self.heal)
         interval = float(c.opt("interval_s", cfg.heal_interval_s))
         self.supervisor.add_thread_service(
             "heal",
@@ -1416,6 +1512,14 @@ class Platform:
 
             install_device_faults(None)
             self.device_fault_plan = None
+        if self.storage_fault_plan is not None:
+            from ccfd_tpu.runtime.faults import install_storage_faults
+
+            install_storage_faults(None)
+            self.storage_fault_plan = None
+        from ccfd_tpu.runtime import durability
+
+        durability.set_recorder(None)
         if self.recovery is not None:
             self.recovery.stop()
         if self.supervisor:

@@ -834,15 +834,19 @@ class Engine:
                 self._audit = None
 
     def save(self, path: str) -> None:
-        """Atomic snapshot-to-file (tmp + rename)."""
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.snapshot(), f)
-        os.replace(tmp, path)
+        """Checksummed atomic snapshot-to-file (tmp + fsync + rename with
+        generation retention, runtime/durability.py)."""
+        from ccfd_tpu.runtime.durability import write_json_artifact
+
+        write_json_artifact(path, self.snapshot(),
+                            artifact="engine_snapshot")
 
     def load(self, path: str) -> None:
-        with open(path) as f:
-            self.restore(json.load(f))
+        """Verified restore: a corrupt snapshot quarantines and the
+        last-good retained generation loads instead."""
+        from ccfd_tpu.runtime.durability import read_json_artifact
+
+        self.restore(read_json_artifact(path, artifact="engine_snapshot"))
 
     # -- internals --------------------------------------------------------
     def _note_completed(self, pid: int, now: float | None = None) -> None:

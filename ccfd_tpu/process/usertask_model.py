@@ -284,20 +284,30 @@ class OnlineUserTaskModel:
 
     # -- persistence (restarts must not discard investigator supervision) --
     def save(self, path: str) -> None:
-        """Atomic .npz of params + example buffer (tmp + rename)."""
+        """Checksummed atomic .npz of params + example buffer (tmp +
+        fsync + rename with generation retention, runtime/durability.py)."""
+        import io
+
+        from ccfd_tpu.runtime.durability import write_artifact
+
         with self._lock:
             params = {k: np.asarray(v) for k, v in self._params.items()}
             x = np.stack(self._x) if self._x else np.zeros((0, NUM_TASK_FEATURES), np.float32)
             y = np.asarray(self._y, np.float32)
             trained = self._trained
             seen = self._seen
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:  # file object: savez won't append .npz
-            np.savez(f, x=x, y=y, trained=trained, seen=seen, **params)
-        os.replace(tmp, path)
+        buf = io.BytesIO()  # file object: savez won't append .npz
+        np.savez(buf, x=x, y=y, trained=trained, seen=seen, **params)
+        write_artifact(path, buf.getvalue(), artifact="usertask")
 
     def load(self, path: str) -> None:
-        data = np.load(path)
+        """Verified restore: a corrupt file quarantines and falls back to
+        the last-good retained generation."""
+        import io
+
+        from ccfd_tpu.runtime.durability import read_artifact
+
+        data = np.load(io.BytesIO(read_artifact(path, artifact="usertask")))
         with self._lock:
             self._params = {
                 k: jnp.asarray(data[k]) for k in ("w", "b", "mean", "scale")

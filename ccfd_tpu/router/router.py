@@ -675,6 +675,7 @@ class Router:
         (when tracing) gets the degraded-tier flag — a trace scored by a
         fallback tier is always tail-sampled KEEP."""
         gate = self._heal_gate
+        host_blocked = False
         if gate is not None and not gate.device_allowed():
             # device quarantined (runtime/heal.py): the ladder is pinned
             # to the host tier. Checked BEFORE the breaker so a HALF_OPEN
@@ -682,6 +683,12 @@ class Router:
             # heal supervisor's own canary is the only probe allowed.
             if span is not None:
                 span.attrs["quarantined"] = True
+            # storage pin (runtime/durability.StoragePinGate): when NO
+            # params generation verifies, the host tier would forward the
+            # very same unverified tree — the ladder pins all the way to
+            # the rules floor until a verified tree is published
+            host_ok = getattr(gate, "host_allowed", None)
+            host_blocked = callable(host_ok) and not host_ok()
         elif self._breaker is None or self._breaker.allow():
             br = self._breaker
             t0 = time.perf_counter()
@@ -711,7 +718,7 @@ class Router:
                 self._c_score_err.inc(len(txs))
         elif span is not None:
             span.attrs["breaker_open"] = True
-        if self._host_score is not None:
+        if self._host_score is not None and not host_blocked:
             try:
                 proba = np.asarray(self._host_score(x), np.float32)
                 if proba.shape == (len(txs),) and np.isfinite(proba).all():

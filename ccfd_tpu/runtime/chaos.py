@@ -42,6 +42,7 @@ class ChaosMonkey:
         registry: Registry | None = None,
         fault_plan=None,
         device_fault_plan=None,
+        storage_fault_plan=None,
         fault_interval_s: float | None = None,
         fault_duration_s: float = 2.0,
     ):
@@ -52,12 +53,14 @@ class ChaosMonkey:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.history: list[tuple[float, str]] = []  # (monotonic time, service)
-        # fault storms: edge plan (runtime/faults.FaultPlan) and/or device
-        # plan (runtime/faults.DeviceFaultPlan — same activation surface).
-        # Storm-driven plans should be built active=False; the monkey owns
-        # their duty cycle and toggles both in lockstep each window
+        # fault storms: edge plan (runtime/faults.FaultPlan), device
+        # plan (runtime/faults.DeviceFaultPlan) and/or storage plan
+        # (runtime/faults.StorageFaultPlan) — all share one activation
+        # surface. Storm-driven plans should be built active=False; the
+        # monkey owns their duty cycle and toggles all in lockstep
         self._fault_plan = fault_plan
         self._device_fault_plan = device_fault_plan
+        self._storage_fault_plan = storage_fault_plan
         self.fault_interval_s = fault_interval_s
         self.fault_duration_s = fault_duration_s
         self._fault_thread: threading.Thread | None = None
@@ -68,7 +71,8 @@ class ChaosMonkey:
             self._c_injected = registry.counter(
                 "chaos_injections_total", "injected service failures"
             )
-            if fault_plan is not None or device_fault_plan is not None:
+            if (fault_plan is not None or device_fault_plan is not None
+                    or storage_fault_plan is not None):
                 self._c_fault_windows = registry.counter(
                     "chaos_fault_windows_total",
                     "fault-storm windows driven by the monkey",
@@ -104,7 +108,8 @@ class ChaosMonkey:
     def fault_storm(self, duration_s: float | None = None) -> None:
         """Run one fault window now: activate the plan(s), hold for the
         duration (interruptible by stop), deactivate."""
-        plans = [p for p in (self._fault_plan, self._device_fault_plan)
+        plans = [p for p in (self._fault_plan, self._device_fault_plan,
+                              self._storage_fault_plan)
                  if p is not None]
         if not plans:
             return
@@ -143,7 +148,8 @@ class ChaosMonkey:
         )
         self._thread.start()
         if ((self._fault_plan is not None
-                or self._device_fault_plan is not None)
+                or self._device_fault_plan is not None
+                or self._storage_fault_plan is not None)
                 and self.fault_interval_s):
             self._fault_thread = threading.Thread(
                 target=self._run_faults, daemon=True, name="ccfd-chaos-net"
@@ -159,6 +165,7 @@ class ChaosMonkey:
             self._fault_thread.join(timeout=5.0)
             # a storm interrupted mid-window must not leave edges (or the
             # device seams) degraded
-            for p in (self._fault_plan, self._device_fault_plan):
+            for p in (self._fault_plan, self._device_fault_plan,
+                      self._storage_fault_plan):
                 if p is not None:
                     p.deactivate()

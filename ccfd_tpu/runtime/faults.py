@@ -489,6 +489,161 @@ def device_seam(seam: str) -> None:
             raise InjectedFault("staging put failed (injected put_fail)")
 
 
+# ---------------------------------------------------------------------------
+# Storage faults: the DISK as a fallible component.
+#
+# The device class above injects at the scorer seams; the storage class
+# injects at the durable-state seam every persistent writer/reader now
+# shares (runtime/durability.py atomic_write_bytes). The taxonomy is the
+# classic storage failure set, each drillable on CPU CI:
+#
+# - ``torn_write``  — the process dies mid-write: a prefix lands in the
+#   tmp file, the rename never happens (orphan tmp for the startup
+#   sweep; the artifact keeps its previous bytes).
+# - ``rename_lost`` — data written and fsynced but the rename's metadata
+#   never commits (power cut before the journal): the caller believes
+#   the write succeeded, the artifact silently keeps its OLD contents.
+# - ``bitrot``      — latent media corruption after a successful write:
+#   the landed file gets a flipped byte, which the checksummed read side
+#   must quarantine and recover from (last-good generation).
+# - ``enospc``      — the volume is full: the write raises ENOSPC.
+# - ``fsync_fail``  — the sync fails (dying disk, thin-provisioned
+#   volume): the write raises EIO before the rename.
+# - ``slow_disk``   — degraded I/O: every write stalls ``ms``.
+#
+# Same activation surface as the other plans, so the ChaosMonkey storm-
+# schedules storage degradation windows with the machinery that already
+# drives edge and device storms (CCFD_STORAGE_FAULTS env / CR
+# ``chaos.storage_faults``; tools/chaos_soak.py --storage-faults).
+# ---------------------------------------------------------------------------
+
+STORAGE_FAULT_KINDS = ("torn_write", "rename_lost", "bitrot", "enospc",
+                       "fsync_fail", "slow_disk")
+
+
+class StorageFaultSpec:
+    """Parameters for one storage-fault kind.
+
+    - ``rate`` probability the fault fires per write (default 1.0)
+    - ``ms``   added latency for ``slow_disk`` (default 25)
+    - ``frac`` fraction of the payload a ``torn_write`` lands (default
+      0.5 — enough bytes that a frame header parses but the checksum
+      cannot)
+    """
+
+    __slots__ = ("rate", "ms", "frac")
+
+    def __init__(self, rate: float = 1.0, ms: float = 25.0,
+                 frac: float = 0.5):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"rate {rate} outside [0, 1]")
+        if not 0.0 <= frac <= 1.0:
+            raise ValueError(f"frac {frac} outside [0, 1]")
+        if ms < 0:
+            raise ValueError(f"ms must be >= 0, got {ms}")
+        self.rate = float(rate)
+        self.ms = float(ms)
+        self.frac = float(frac)
+
+    @staticmethod
+    def parse(body: str) -> "StorageFaultSpec":
+        """``"rate=0.5,ms=10,frac=0.3"`` -> StorageFaultSpec; empty body
+        takes every default."""
+        kw: dict[str, float] = {}
+        for item in body.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, val = item.partition("=")
+            key = key.strip()
+            if not sep:
+                raise ValueError(
+                    f"storage-fault option {item!r}: expected key=value")
+            if key not in ("rate", "ms", "frac"):
+                raise ValueError(
+                    f"unknown storage-fault option {key!r}; "
+                    f"known: rate, ms, frac")
+            kw[key] = float(val)
+        return StorageFaultSpec(**kw)
+
+
+class StorageFaultPlan:
+    """Active storage-fault kinds + the FaultPlan activation interface,
+    so storm schedulers drive disk degradation exactly like edge and
+    device faults."""
+
+    def __init__(self, kinds: Mapping[str, StorageFaultSpec] | None = None,
+                 seed: int = 0, active: bool = True):
+        for k in (kinds or {}):
+            if k not in STORAGE_FAULT_KINDS:
+                raise ValueError(
+                    f"unknown storage fault {k!r}; known: "
+                    f"{STORAGE_FAULT_KINDS}")
+        self.kinds = dict(kinds or {})
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self._active = threading.Event()
+        if active:
+            self._active.set()
+        self.activations = 0
+        self.injected: dict[str, int] = {}
+
+    @staticmethod
+    def from_string(text: str, seed: int = 0,
+                    active: bool = True) -> "StorageFaultPlan":
+        """``"bitrot;torn_write:rate=0.5"`` -> StorageFaultPlan (the
+        CCFD_STORAGE_FAULTS syntax). Empty text means an empty plan."""
+        kinds: dict[str, StorageFaultSpec] = {}
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _sep, body = part.partition(":")
+            kinds[kind.strip()] = StorageFaultSpec.parse(body)
+        return StorageFaultPlan(kinds, seed=seed, active=active)
+
+    @property
+    def active(self) -> bool:
+        return self._active.is_set()
+
+    def activate(self) -> None:
+        self.activations += 1
+        self._active.set()
+
+    def deactivate(self) -> None:
+        self._active.clear()
+
+    def draw(self, kind: str) -> StorageFaultSpec | None:
+        """The kind's spec when the plan is active AND its rate draw
+        fires — one call per write per kind (runtime/durability.py)."""
+        if not self._active.is_set():
+            return None
+        s = self.kinds.get(kind)
+        if s is None:
+            return None
+        with self._mu:
+            if self._rng.random() >= s.rate:
+                return None
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+        return s
+
+
+_STORAGE_PLAN: StorageFaultPlan | None = None
+
+
+def install_storage_faults(plan: StorageFaultPlan | None) -> None:
+    """Install (or, with None, clear) the process-wide storage-fault plan
+    the durability seam consults. Process-wide for the same reason the
+    device plan is: the seam sits inside constructors and module-level
+    helpers no injector proxy could wrap."""
+    global _STORAGE_PLAN
+    _STORAGE_PLAN = plan
+
+
+def storage_faults() -> StorageFaultPlan | None:
+    return _STORAGE_PLAN
+
+
 def device_oom_overlay() -> float | None:
     """The injected allocator-pressure ratio, or None. Consulted by
     DeviceTelemetry.device_memory() so the OOM signal is drillable on

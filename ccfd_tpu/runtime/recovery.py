@@ -67,6 +67,7 @@ class CheckpointCoordinator:
         pause_timeout_s: float = 10.0,
         on_swap: Callable[[Engine], None] | None = None,
         path: str | None = None,
+        retain: int | None = None,
     ):
         self.router = router
         self.broker = broker
@@ -89,11 +90,18 @@ class CheckpointCoordinator:
         # durable bus (log_dir), that is the complete crash story:
         # engine state from the cut, the gap re-driven from the log
         self.path = path
+        # generations of the cut retained on disk (runtime/durability.py):
+        # a torn/bit-flipped newest cut falls back to the previous one —
+        # a crash a few seconds earlier — instead of a cold start
+        self.retain = retain
         if path:
             import os
 
+            from ccfd_tpu.runtime.durability import sweep_tmp
+
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
+            sweep_tmp(os.path.dirname(os.path.abspath(path)))
         self._io_lock = threading.Lock()  # orders cut writes off _lock
         # additional PIPELINE STATE that must ride the cut: anything a
         # rewound record replay would otherwise double-apply — e.g. the
@@ -194,21 +202,30 @@ class CheckpointCoordinator:
         # not wait behind a large snapshot's serialize+write. _io_lock
         # alone orders writers; a slightly stale cut on disk is exactly
         # as recoverable as a crash a moment earlier.
+        wrote = True
         if self.path:
-            import os
+            from ccfd_tpu.runtime.durability import write_json_artifact
 
             with self._io_lock:
-                tmp = f"{self.path}.tmp"
-                with open(tmp, "w") as f:
-                    json.dump({"version": 1, **cut}, f)
-                os.replace(tmp, self.path)
+                # checksummed + fsynced + atomic with generation retention
+                # (a failed write keeps the previous cut — exactly as
+                # recoverable as a crash one interval earlier)
+                wrote = write_json_artifact(self.path, {"version": 1, **cut},
+                                            artifact="recovery_cut",
+                                            retain=self.retain)
         # Pin retention only AFTER the cut is durable: until the atomic
         # replace lands, the newest cut a cold start can load is the
         # PREVIOUS one, and the previous pin is what keeps that cut's
         # replay records alive. Pinning first would let retention trim
         # [old cut, new cut) while disk still holds the old cut — a crash
-        # in that window would restore a cut whose records are gone.
-        self._pin_retention(cut["offsets"])
+        # in that window would restore a cut whose records are gone. The
+        # same invariant on a FAILED durable write (full disk, injected
+        # storage fault — write_json_artifact is best-effort): the newest
+        # cut on disk is still the previous one, so the previous pin must
+        # stand; advancing it would un-protect exactly the replay window
+        # that cut needs.
+        if wrote:
+            self._pin_retention(cut["offsets"])
         return cut
 
     def _peek_disk_cut_offsets(self) -> dict[str, list[int]]:
@@ -216,21 +233,23 @@ class CheckpointCoordinator:
         seed — {} when there is no (usable) cut on disk. Deliberately
         tolerant: a corrupt file reads as no-cut here exactly as it does
         in restore_from_disk()."""
-        import json
-        import os
+        from ccfd_tpu.runtime.durability import read_json_artifact
 
-        if not self.path or not os.path.exists(self.path):
+        if not self.path:
             return {}
         try:
-            with open(self.path) as f:
-                cut = json.load(f)
+            # quarantine=False: the peek must not mutate disk state the
+            # upcoming restore_from_disk() will judge for itself
+            cut = read_json_artifact(self.path, artifact="recovery_cut",
+                                     fallback=True, quarantine=False)
             offsets = cut["offsets"] if cut.get("version") == 1 else {}
             return {
                 k: [int(o) for o in v]
                 for k, v in offsets.items()
                 if isinstance(v, list)
             }
-        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        except Exception:  # noqa: BLE001 - a corrupt/missing file reads
+            # as no-cut here exactly as it does in restore_from_disk()
             return {}
 
     def _pin_retention(self, cut_offsets: dict[str, list[int]]) -> None:
@@ -433,14 +452,17 @@ class CheckpointCoordinator:
         wait for. Returns the restored engine, or None when no usable cut
         exists (missing/corrupt file reads as a cold start, never a
         crash)."""
-        import json
-        import os
+        from ccfd_tpu.runtime.durability import read_json_artifact
 
-        if not self.path or not os.path.exists(self.path):
+        if not self.path:
             return None
         try:
-            with open(self.path) as f:
-                cut = json.load(f)
+            # verified read (runtime/durability.py): a torn/bit-flipped
+            # newest cut is QUARANTINED and the last-good retained
+            # generation restores instead — replay from a slightly older
+            # cut beats both a crash and a cold start
+            cut = read_json_artifact(self.path, artifact="recovery_cut",
+                                     fallback=True)
             # valid JSON is not necessarily a valid cut: guard the shape,
             # not just the parse (null / [] / non-dict snap must all read
             # as cold starts)
@@ -451,8 +473,9 @@ class CheckpointCoordinator:
             if not isinstance(last["snap"], dict) or not isinstance(
                     last["offsets"], dict):
                 raise ValueError("cut fields have wrong shapes")
-        except (OSError, ValueError, KeyError, TypeError,
-                AttributeError) as e:
+        except FileNotFoundError:
+            return None  # cold start, nothing ever written
+        except Exception as e:  # noqa: BLE001 - includes CorruptArtifact
             import logging
 
             logging.getLogger(__name__).warning(

@@ -135,12 +135,13 @@ class ObjectStore:
             b = self._bucket(bucket)
             b[key] = (data, now)
             if self._root:
+                # the shared atomic-write helper (tmp + fsync + rename,
+                # runtime/durability.py): NO frame — object bytes are the
+                # caller's payload, integrity rides the etag
+                from ccfd_tpu.runtime.durability import atomic_write_bytes
+
                 p = self._path(bucket, key)
-                os.makedirs(os.path.dirname(p), exist_ok=True)
-                tmp = p + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                os.replace(tmp, p)
+                atomic_write_bytes(p, data, artifact="object")
         return ObjectInfo(key, len(data), _etag(data), now)
 
     def get(self, bucket: str, key: str) -> bytes:

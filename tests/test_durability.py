@@ -1,0 +1,845 @@
+"""Durable-state integrity plane (ISSUE 13; runtime/durability.py).
+
+Coverage: checksum round-trip per artifact type, every storage-fault
+kind at the durability seam, quarantine + last-good fallback, the
+corrupt-champion restart drill (verified fallback step; heal-gate pin to
+the rules tier when NOTHING verifies), generation retention bounds, the
+orphan-tmp sweep, mid-file bus-log corruption accounting, and the
+ChaosMonkey storage-storm scheduling."""
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.runtime import durability, faults
+from ccfd_tpu.runtime.durability import (
+    ComposedHealGate,
+    CorruptArtifactError,
+    StoragePinGate,
+)
+
+CFG = Config(confidence_threshold=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts with no installed plan, no bound registry, no
+    recorder hook, and stock defaults — durability state is process-wide
+    by design, so tests must not leak through it."""
+    faults.install_storage_faults(None)
+    durability.configure(retain=3, fsync=True, sweep=True)
+    yield
+    faults.install_storage_faults(None)
+    durability.set_recorder(None)
+    durability.configure(retain=3, fsync=True, sweep=True)
+
+
+def _delta(before, after, metric):
+    return (sum(after.get(metric, {}).values())
+            - sum(before.get(metric, {}).values()))
+
+
+# -- framing + round trips ---------------------------------------------------
+
+def test_frame_round_trip_and_legacy():
+    payload = b"\x00\x01hello\xff" * 7
+    framed = durability.frame(payload)
+    out, is_framed = durability.parse_frame(framed)
+    assert out == payload and is_framed
+    # legacy (unframed) bytes pass through, flagged unverified
+    out, is_framed = durability.parse_frame(payload)
+    assert out == payload and not is_framed
+    # a framed file that was torn or bit-flipped fails verification
+    assert durability.parse_frame(framed[: len(framed) // 2])[0] is None
+    flipped = bytearray(framed)
+    flipped[-1] ^= 0xFF
+    assert durability.parse_frame(bytes(flipped))[0] is None
+
+
+def test_json_artifact_round_trip(tmp_path):
+    p = str(tmp_path / "doc.json")
+    doc = {"a": [1, 2, 3], "b": "x"}
+    assert durability.write_json_artifact(p, doc, artifact="t")
+    assert durability.read_json_artifact(p, artifact="t") == doc
+
+
+def test_npz_artifact_round_trip(tmp_path):
+    p = str(tmp_path / "arr.npz")
+    buf = io.BytesIO()
+    np.savez(buf, w=np.arange(12, dtype=np.float32).reshape(3, 4))
+    durability.write_artifact(p, buf.getvalue(), artifact="t")
+    data = np.load(io.BytesIO(durability.read_artifact(p, artifact="t")))
+    assert np.array_equal(data["w"], np.arange(12).reshape(3, 4))
+
+
+def test_legacy_unframed_file_reads_and_counts(tmp_path):
+    p = str(tmp_path / "legacy.json")
+    with open(p, "w") as f:
+        json.dump({"old": 1}, f)
+    before = durability.counts()
+    assert durability.read_json_artifact(p, artifact="t") == {"old": 1}
+    assert _delta(before, durability.counts(), "unverified") == 1
+
+
+def test_missing_artifact_raises_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        durability.read_artifact(str(tmp_path / "nope"), artifact="t")
+
+
+# -- quarantine + last-good fallback ----------------------------------------
+
+def test_corrupt_main_quarantines_and_serves_last_good(tmp_path):
+    p = str(tmp_path / "a.json")
+    for i in range(4):
+        durability.write_json_artifact(p, {"i": i}, artifact="t", retain=3)
+    durability.flip_bytes(p)
+    before = durability.counts()
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 3}
+    after = durability.counts()
+    assert _delta(before, after, "corrupt") == 1
+    assert _delta(before, after, "fallback") == 1
+    assert os.path.exists(p + ".corrupt")
+    # idempotent: the quarantined main is gone, generations still serve
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 3}
+
+
+def test_all_generations_corrupt_raises(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 0}, artifact="t", retain=2)
+    durability.flip_bytes(p)
+    for _s, gp in durability._generations(p):
+        durability.flip_bytes(gp)
+    with pytest.raises(CorruptArtifactError):
+        durability.read_json_artifact(p, artifact="t")
+    # corrupt generations were quarantined too — never retried
+    assert not durability.has_generations(p)
+
+
+def test_quarantine_fires_recorder_hook(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 1}, artifact="lineage")
+    durability.flip_bytes(p)
+    triggers = []
+    durability.set_recorder(triggers.append)
+    durability.read_json_artifact(p, artifact="lineage")
+    assert triggers and triggers[0]["type"] == "storage_corrupt"
+    assert triggers[0]["artifact"] == "lineage"
+
+
+def test_peek_read_does_not_quarantine(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 1}, artifact="t", retain=2)
+    durability.flip_bytes(p)
+    assert durability.read_json_artifact(p, artifact="t",
+                                         quarantine=False) == {"i": 1}
+    assert os.path.exists(p) and not os.path.exists(p + ".corrupt")
+
+
+def test_generation_retention_bounds(tmp_path):
+    p = str(tmp_path / "a.json")
+    for i in range(10):
+        durability.write_json_artifact(p, {"i": i}, artifact="t", retain=3)
+    gens = durability._generations(p)
+    assert len(gens) == 3
+    # newest generation carries the newest payload; pruning never
+    # renumbers (monotone seq like the bus log's segment bases)
+    assert [s for s, _p in gens] == [8, 9, 10]
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 9}
+    # retain=0 writes no generations at all
+    p0 = str(tmp_path / "b.json")
+    durability.write_json_artifact(p0, {}, artifact="t", retain=0)
+    assert not durability.has_generations(p0)
+
+
+def test_verify_file_verdicts(tmp_path):
+    p = str(tmp_path / "a.bin")
+    assert durability.verify_file(p) is None
+    durability.write_artifact(p, b"payload", artifact="t", retain=0)
+    assert durability.verify_file(p) is True
+    durability.flip_bytes(p)
+    assert durability.verify_file(p) is False
+    legacy = str(tmp_path / "l.bin")
+    with open(legacy, "wb") as f:
+        f.write(b"unframed")
+    assert durability.verify_file(legacy) is True  # nothing to check
+
+
+# -- every storage-fault kind at the seam -----------------------------------
+
+def test_fault_enospc_counts_write_error_keeps_last_good(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 0}, artifact="t")
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("enospc"))
+    before = durability.counts()
+    assert not durability.write_json_artifact(p, {"i": 1}, artifact="t")
+    assert _delta(before, durability.counts(), "write_errors") == 1
+    faults.install_storage_faults(None)
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 0}
+
+
+def test_fault_enospc_best_effort_false_raises(tmp_path):
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("enospc"))
+    with pytest.raises(OSError):
+        durability.write_json_artifact(str(tmp_path / "x"), {},
+                                       artifact="t", best_effort=False)
+
+
+def test_fault_torn_write_leaves_orphan_tmp_and_old_artifact(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 0}, artifact="t")
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("torn_write:frac=0.5"))
+    assert not durability.write_json_artifact(p, {"i": 1}, artifact="t")
+    faults.install_storage_faults(None)
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 0}
+    tmps = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert tmps  # crash debris for the startup sweep
+
+
+def test_fault_rename_lost_silently_keeps_old_bytes(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 0}, artifact="t", retain=0)
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("rename_lost"))
+    # the caller BELIEVES the write landed — that is the fault's point
+    assert durability.write_json_artifact(p, {"i": 1}, artifact="t",
+                                          retain=0)
+    faults.install_storage_faults(None)
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 0}
+
+
+def test_fault_bitrot_corrupts_landed_file(tmp_path):
+    p = str(tmp_path / "a.json")
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("bitrot"))
+    durability.write_json_artifact(p, {"i": 1}, artifact="t", retain=0)
+    faults.install_storage_faults(None)
+    assert durability.verify_file(p) is False
+
+
+def test_fault_fsync_fail_keeps_last_good(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 0}, artifact="t")
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("fsync_fail"))
+    assert not durability.write_json_artifact(p, {"i": 1}, artifact="t")
+    faults.install_storage_faults(None)
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 0}
+
+
+def test_fault_slow_disk_delays_writes(tmp_path):
+    faults.install_storage_faults(
+        faults.StorageFaultPlan.from_string("slow_disk:ms=60"))
+    t0 = time.perf_counter()
+    durability.write_json_artifact(str(tmp_path / "a"), {}, artifact="t",
+                                   retain=0)
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_fault_rate_and_activation_gate_draws():
+    plan = faults.StorageFaultPlan.from_string("bitrot:rate=0.0")
+    assert plan.draw("bitrot") is None  # rate 0 never fires
+    plan2 = faults.StorageFaultPlan.from_string("bitrot", active=False)
+    assert plan2.draw("bitrot") is None  # inactive plan never fires
+    plan2.activate()
+    assert plan2.draw("bitrot") is not None
+    assert plan2.injected.get("bitrot") == 1
+    plan2.deactivate()
+    assert plan2.draw("bitrot") is None
+
+
+def test_storage_fault_plan_parse_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown storage fault"):
+        faults.StorageFaultPlan.from_string("disk_gremlin")
+    with pytest.raises(ValueError, match="unknown storage-fault option"):
+        faults.StorageFaultSpec.parse("volume=3")
+
+
+def test_chaos_monkey_drives_storage_storms():
+    from ccfd_tpu.runtime.chaos import ChaosMonkey
+    from ccfd_tpu.runtime.supervisor import Supervisor
+
+    plan = faults.StorageFaultPlan.from_string("bitrot", active=False)
+    monkey = ChaosMonkey(Supervisor(), targets=[], storage_fault_plan=plan)
+    assert not plan.active
+    monkey._stop.set()  # fault_storm's hold returns immediately
+    monkey.fault_storm(duration_s=0.01)
+    assert plan.activations == 1 and not plan.active  # toggled + restored
+    assert len(monkey.fault_windows) == 1
+
+
+# -- orphan-tmp sweep --------------------------------------------------------
+
+def test_sweep_tmp_counts_and_removes(tmp_path):
+    for n in ("a.json.123.0.tmp", "offsets.log.tmp"):
+        (tmp_path / n).write_bytes(b"debris")
+    (tmp_path / "keep.json").write_bytes(b"live")
+    before = durability.counts()
+    assert durability.sweep_tmp(str(tmp_path)) == 2
+    assert _delta(before, durability.counts(), "tmp_swept") == 2
+    assert sorted(os.listdir(tmp_path)) == ["keep.json"]
+    # disabled sweep leaves debris alone
+    (tmp_path / "more.tmp").write_bytes(b"")
+    durability.configure(sweep=False)
+    assert durability.sweep_tmp(str(tmp_path)) == 0
+    assert (tmp_path / "more.tmp").exists()
+
+
+def test_bus_log_open_sweeps_compaction_tmp(tmp_path):
+    from ccfd_tpu.bus.log import BusLog
+
+    d = str(tmp_path / "bus")
+    os.makedirs(d)
+    orphan = os.path.join(d, "offsets.log.tmp")  # crashed mid-compaction
+    with open(orphan, "wb") as f:
+        f.write(b"half a compaction")
+    before = durability.counts()
+    log = BusLog(d)
+    log.close()
+    assert not os.path.exists(orphan)
+    assert _delta(before, durability.counts(), "tmp_swept") == 1
+
+
+# -- mid-file bus-log corruption accounting (satellite 3) --------------------
+
+def test_segment_replay_counts_records_dropped_past_corruption(tmp_path):
+    from ccfd_tpu.bus.log import SegmentFile, encode_entry
+
+    path = str(tmp_path / "seg.log")
+    seg = SegmentFile(path)
+    payloads = [encode_entry(i, 0.0, {"v": i}) for i in range(8)]
+    seg.append(*payloads)
+    seg.close()
+    with open(path, "rb") as f:
+        raw = f.read()
+    # flip a byte INSIDE record 2's payload: records 3..7 are still valid
+    # on disk but sit past the corrupt frame
+    off = len(payloads[0]) + 8 + len(payloads[1]) + 8 + 12
+    torn = bytearray(raw)
+    torn[off] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(torn))
+    before = durability.counts()
+    recovered = SegmentFile(path).replay()
+    assert len(recovered) == 2  # truncated at the corrupt frame
+    # ... and the 5 valid-but-dropped later records were COUNTED, loudly
+    assert _delta(before, durability.counts(),
+                  "log_truncated_records") == 5
+
+
+def test_segment_replay_clean_tail_counts_nothing(tmp_path):
+    from ccfd_tpu.bus.log import SegmentFile, encode_entry
+
+    path = str(tmp_path / "seg.log")
+    seg = SegmentFile(path)
+    seg.append(encode_entry(1, 0.0, {"v": 1}), encode_entry(2, 0.0, {"v": 2}))
+    seg.close()
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[:-3])  # torn tail, not corruption
+    before = durability.counts()
+    assert len(SegmentFile(path).replay()) == 1
+    assert _delta(before, durability.counts(),
+                  "log_truncated_records") == 0
+
+
+# -- artifact-type round trips through the real writers ----------------------
+
+def test_engine_snapshot_save_load_verified(tmp_path):
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.process.fraud import build_engine
+
+    broker = Broker(default_partitions=1)
+    engine = build_engine(CFG, broker, Registry())
+    path = str(tmp_path / "engine.json")
+    engine.save(path)
+    assert durability.verify_file(path) is True
+    engine2 = build_engine(CFG, broker, Registry())
+    engine2.load(path)  # verified read round-trips
+    # corrupt main -> the retained generation restores
+    durability.flip_bytes(path)
+    engine3 = build_engine(CFG, broker, Registry())
+    engine3.load(path)
+    broker.close()
+
+
+def test_usertask_model_save_load_verified(tmp_path):
+    from ccfd_tpu.process.usertask_model import OnlineUserTaskModel
+
+    m = OnlineUserTaskModel(min_examples=1)
+    path = str(tmp_path / "usertask.npz")
+    m.save(path)
+    assert durability.verify_file(path) is True
+    m2 = OnlineUserTaskModel(min_examples=1)
+    m2.load(path)
+    durability.flip_bytes(path)
+    m3 = OnlineUserTaskModel(min_examples=1)
+    m3.load(path)  # last-good generation
+
+
+def test_drift_reference_save_load_verified(tmp_path):
+    from ccfd_tpu.analytics.engine import AnalyticsEngine, Report
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+
+    ds = synthetic_dataset(n=256, fraud_rate=0.05, seed=3)
+    rep = AnalyticsEngine(nbins=8).summarize(ds.X, ds.y)
+    path = str(tmp_path / "ref.npz")
+    rep.save(path)
+    assert durability.verify_file(path) is True
+    loaded = Report.load(path)
+    assert loaded.n == rep.n
+    durability.flip_bytes(path)
+    again = Report.load(path)  # last-good generation
+    assert again.n == rep.n
+
+
+def test_recovery_cut_corrupt_falls_back_to_previous_generation(tmp_path):
+    """A torn newest cut restores the PREVIOUS cut (a crash a few seconds
+    earlier), not a cold start."""
+    from tests.test_recovery import _drain, _pipeline
+
+    broker, router, coord = _pipeline()
+    coord.path = str(tmp_path / "cut.json")
+    t = router.start(poll_timeout_s=0.01)
+    try:
+        broker.produce(CFG.kafka_topic, {"id": 1, "amount": 10.0})
+        _drain(router, 1)
+        assert coord.checkpoint() is not None
+        broker.produce(CFG.kafka_topic, {"id": 2, "amount": 10.0})
+        _drain(router, 2)
+        assert coord.checkpoint() is not None
+    finally:
+        router.stop()
+        t.join(timeout=5)
+    # bitrot the live cut AND its own retained copy (the newest
+    # generation is a good twin of the same write — flipping only the
+    # main file would recover the SAME cut, losslessly)
+    durability.flip_bytes(coord.path)
+    gens = durability._generations(coord.path)
+    durability.flip_bytes(gens[-1][1])
+    restored = coord.restore_from_disk()
+    assert restored is not None and coord.restores == 1
+    assert os.path.exists(coord.path + ".corrupt")
+    # the served cut is the FIRST checkpoint's generation: its offsets
+    # sit one record behind the torn newest cut
+    offs = coord._last["offsets"][f"router\x00{CFG.kafka_topic}"]
+    assert sum(offs) == 1
+    broker.close()
+
+
+def test_recovery_cut_all_corrupt_cold_starts(tmp_path):
+    from tests.test_recovery import _drain, _pipeline
+
+    broker, router, coord = _pipeline()
+    coord.path = str(tmp_path / "cut.json")
+    t = router.start(poll_timeout_s=0.01)
+    try:
+        broker.produce(CFG.kafka_topic, {"id": 1, "amount": 10.0})
+        _drain(router, 1)
+        assert coord.checkpoint() is not None
+    finally:
+        router.stop()
+        t.join(timeout=5)
+    durability.flip_bytes(coord.path)
+    for _s, gp in durability._generations(coord.path):
+        durability.flip_bytes(gp)
+    assert coord.restore_from_disk() is None  # cold start, no crash
+    broker.close()
+
+
+# -- checkpoints: verify / quarantine / newest-verified ----------------------
+
+def _mlp_params(delta=0.0):
+    from ccfd_tpu.models import mlp
+
+    p = mlp.init(jax.random.PRNGKey(0))
+    p = {"norm": p["norm"], "layers": [dict(l) for l in p["layers"]]}
+    last = dict(p["layers"][-1])
+    last["b"] = np.asarray(last["b"]) + np.float32(delta)
+    p["layers"][-1] = last
+    return p
+
+
+def test_checkpoint_verify_quarantine_and_newest_verified(tmp_path):
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), keep=8, use_orbax=False)
+    like = _mlp_params()
+    mgr.save(1, _mlp_params(1.0))
+    mgr.save(2, _mlp_params(2.0))
+    assert mgr.verify_step(1) is True and mgr.verify_step(2) is True
+    durability.flip_bytes(str(tmp_path / "step_2" / "params.npz"))
+    assert mgr.verify_step(2) is False
+    assert mgr.newest_verified_step(prefer=[2]) == 1
+    with pytest.raises(CorruptArtifactError):
+        mgr.restore(like, step=2)
+    assert os.path.exists(str(tmp_path / "step_2.corrupt"))
+    assert mgr.latest_step() == 1  # quarantined steps leave the listing
+    restored = mgr.restore(like, step=1)
+    assert restored is not None and restored[1] == 1
+
+
+@pytest.mark.skipif(
+    not __import__("importlib").util.find_spec("orbax"),
+    reason="orbax not installed")
+def test_checkpoint_orbax_manifest_catches_bitrot(tmp_path):
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.runtime.durability import MANIFEST_NAME
+
+    mgr = CheckpointManager(str(tmp_path), keep=8, use_orbax=True)
+    like = _mlp_params()
+    mgr.save(1, _mlp_params(1.0))
+    assert mgr.verify_step(1) is True
+    step1 = str(tmp_path / "step_1")
+    victim = None
+    for root, _dirs, files in os.walk(step1):
+        for fn in files:
+            p = os.path.join(root, fn)
+            if fn != MANIFEST_NAME and not fn.endswith(".tmp") \
+                    and os.path.getsize(p) > 0:
+                victim = p
+    durability.flip_bytes(victim)
+    assert mgr.verify_step(1) is False
+    with pytest.raises(CorruptArtifactError):
+        mgr.restore(like, step=1)
+
+
+# -- the rules-tier pin ------------------------------------------------------
+
+def test_storage_pin_gate_and_composition():
+    reg = Registry()
+    gate = StoragePinGate(registry=reg)
+    assert gate.device_allowed() and gate.host_allowed()
+    gate.pin("nothing verifies")
+    assert not gate.device_allowed() and not gate.host_allowed()
+    assert "ccfd_storage_pinned" in reg.render()
+
+    class FakeHeal:  # DeviceSupervisor shape: device gate only
+        def device_allowed(self):
+            return True
+
+    comp = ComposedHealGate(gate, FakeHeal())
+    assert not comp.device_allowed() and not comp.host_allowed()
+    gate.unpin()
+    assert comp.device_allowed() and comp.host_allowed()
+
+
+def test_router_pins_to_rules_when_storage_gate_pinned():
+    """The acceptance shape: with the storage gate pinned, every decision
+    comes from the rules floor — zero device, zero HOST (the host tier
+    would forward the same unverified tree) — accounting conserved."""
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.serving.scorer import Scorer
+
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    engine = build_engine(CFG, broker, Registry())
+    scorer = Scorer(model_name="mlp", batch_sizes=(16, 128),
+                    host_tier_rows=0)
+    gate = StoragePinGate()
+    gate.pin("drill")
+    router = Router(CFG, broker, scorer.score, engine, reg, max_batch=128,
+                    host_score_fn=scorer.host_score, degrade=True,
+                    heal_gate=gate)
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+
+    ds = synthetic_dataset(n=64, fraud_rate=0.1, seed=5)
+    rows = [",".join(f"{v:.6g}" for v in ds.X[i]).encode()
+            for i in range(64)]
+    broker.produce_batch(CFG.kafka_topic, rows, list(range(64)))
+    while router.step() > 0:
+        pass
+    deg = reg.counter("router_degraded_total")
+    assert deg.value({"tier": "rules"}) == 64
+    assert deg.value({"tier": "host"}) == 0
+    c_in = reg.counter("transaction_incoming_total").total()
+    c_out = reg.counter("transaction_outgoing_total").total()
+    assert c_in == 64 and c_out == 64
+    # unpinned -> the device path serves again
+    gate.unpin()
+    broker.produce_batch(CFG.kafka_topic, rows, list(range(64)))
+    while router.step() > 0:
+        pass
+    assert deg.total() == 64  # no new degraded rows
+    router.close()
+    broker.close()
+
+
+# -- the corrupt-champion restart drill (controller level) -------------------
+
+def _controller(scorer, store, ckpts, gate=None):
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.lifecycle.controller import (
+        Guardrails,
+        LifecycleController,
+    )
+    from ccfd_tpu.lifecycle.evaluator import ShadowEvaluator
+    from ccfd_tpu.lifecycle.shadow import ShadowTap
+
+    broker = Broker(default_partitions=1)
+    reg = Registry()
+    lc = LifecycleController(
+        CFG, scorer, store=store, checkpoints=ckpts,
+        shadow=ShadowTap(scorer, broker, CFG.shadow_topic, reg),
+        evaluator=ShadowEvaluator(CFG, broker, scorer, reg),
+        guardrails=Guardrails(), registry=reg,
+        storage_pin=(gate.pin if gate is not None else None),
+        storage_unpin=(gate.unpin if gate is not None else None),
+    )
+    return lc, broker
+
+
+def _seed_two_eras(tmp_path):
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.parallel.partition import params_fingerprint
+    from ccfd_tpu.serving.scorer import Scorer
+
+    params_a, params_b = _mlp_params(-1.0), _mlp_params(2.0)
+    lineage = str(tmp_path / "versions.json")
+    ckpt_dir = str(tmp_path / "ckpts")
+    scorer = Scorer(model_name="mlp", params=params_a,
+                    batch_sizes=(16, 128), host_tier_rows=0)
+    store = VersionStore(lineage)
+    ckpts = CheckpointManager(ckpt_dir, keep=8, use_orbax=False)
+    lc, broker = _controller(scorer, store, ckpts)
+    store.set_stage(1, "RETIRED", reason="era 2")
+    v2 = store.create(parent=1, stage="TRAIN")
+    ckpts.pinned = {v2.version}
+    ckpts.save(v2.version, params_b)
+    store.set_checkpoint(v2.version, v2.version,
+                         checkpoint_hash=params_fingerprint(params_b))
+    store.set_stage(v2.version, "CHAMPION", reason="era 2")
+    lc.close()
+    broker.close()
+    return lineage, ckpt_dir, params_a, params_b
+
+
+def test_corrupt_champion_restart_falls_back_to_parent_step(tmp_path):
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.parallel.partition import params_fingerprint
+    from ccfd_tpu.serving.scorer import Scorer
+
+    lineage, ckpt_dir, params_a, _params_b = _seed_two_eras(tmp_path)
+    durability.flip_bytes(os.path.join(ckpt_dir, "step_2", "params.npz"))
+    gate = StoragePinGate()
+    scorer = Scorer(model_name="mlp", batch_sizes=(16, 128),
+                    host_tier_rows=0)
+    store = VersionStore(lineage)
+    ckpts = CheckpointManager(ckpt_dir, keep=8, use_orbax=False)
+    lc, broker = _controller(scorer, store, ckpts, gate=gate)
+    try:
+        # the parent era's step restored; serving == lineage hash after
+        # the re-stamp alarm; no pin — something verifiable served
+        fp = params_fingerprint(jax.tree.map(np.asarray, scorer.params))
+        assert fp == params_fingerprint(params_a)
+        assert store.get(2).checkpoint_hash == fp
+        assert not gate.pinned and not lc.storage_pinned
+        events = [e["event"] for e in store.audit_trail()]
+        assert "storage_fallback_restore" in events
+        assert os.path.exists(os.path.join(ckpt_dir, "step_2.corrupt"))
+    finally:
+        lc.close()
+        broker.close()
+
+
+def test_unverifiable_champion_pins_and_promotion_unpins(tmp_path):
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.serving.scorer import Scorer
+
+    lineage, ckpt_dir, _a, _b = _seed_two_eras(tmp_path)
+    for name in os.listdir(ckpt_dir):
+        npz = os.path.join(ckpt_dir, name, "params.npz")
+        if os.path.exists(npz):
+            durability.flip_bytes(npz)
+    gate = StoragePinGate()
+    scorer = Scorer(model_name="mlp", batch_sizes=(16, 128),
+                    host_tier_rows=0)
+    store = VersionStore(lineage)
+    ckpts = CheckpointManager(ckpt_dir, keep=8, use_orbax=False)
+    lc, broker = _controller(scorer, store, ckpts, gate=gate)
+    try:
+        assert gate.pinned and lc.storage_pinned
+        assert not gate.device_allowed() and not gate.host_allowed()
+        events = [e["event"] for e in store.audit_trail()]
+        assert "storage_pin" in events
+        # a verified publish clears the pin: drive a candidate through
+        # submit (fresh checkpoint) and force the promote step directly
+        v = lc.submit_candidate(_mlp_params(5.0), label_watermark=1)
+        assert v is not None
+        lc._promote(lc.evaluator.snapshot())
+        assert not gate.pinned and not lc.storage_pinned
+        events = [e["event"] for e in store.audit_trail()]
+        assert "storage_unpin" in events
+    finally:
+        lc.close()
+        broker.close()
+
+
+def test_torn_lineage_recovers_last_good_generation(tmp_path):
+    from ccfd_tpu.lifecycle.versions import VersionStore
+
+    lineage, _ckpt_dir, _a, _b = _seed_two_eras(tmp_path)
+    with open(lineage, "rb") as f:
+        raw = f.read()
+    with open(lineage, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    store = VersionStore(lineage)
+    champ = store.champion()
+    assert champ is not None and champ.version == 2
+    assert os.path.exists(lineage + ".corrupt")
+    # the version counter resumed past the recovered lineage
+    assert store.create(parent=2).version == 3
+
+
+def test_lineage_all_corrupt_starts_fresh(tmp_path):
+    from ccfd_tpu.lifecycle.versions import VersionStore
+
+    lineage = str(tmp_path / "versions.json")
+    store = VersionStore(lineage)
+    store.create(parent=None)
+    durability.flip_bytes(lineage)
+    for _s, gp in durability._generations(lineage):
+        durability.flip_bytes(gp)
+    fresh = VersionStore(lineage)
+    assert fresh.versions() == []
+    assert fresh.create(parent=None).version == 1
+
+
+# -- review-hardening regressions --------------------------------------------
+
+def test_unreadable_main_file_falls_back_to_generations(tmp_path):
+    """EIO-class read failures (dying media) must recover from the
+    retained generations, not propagate and read as a fresh start."""
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 7}, artifact="t", retain=2)
+    os.unlink(p)
+    os.mkdir(p)  # open() now raises IsADirectoryError (OSError, not ENOENT)
+    before = durability.counts()
+    assert durability.read_json_artifact(p, artifact="t") == {"i": 7}
+    assert _delta(before, durability.counts(), "fallback") == 1
+
+
+def test_failed_cut_write_does_not_advance_retention_pin(tmp_path):
+    """checkpoint(): the retention pin must only move once the cut is
+    DURABLE — a failed write (full disk / injected fault) keeps the
+    previous pin, or retention could trim the previous cut's replay
+    window."""
+    from ccfd_tpu.bus.broker import RETENTION_PIN_GROUP
+
+    from tests.test_recovery import _drain, _pipeline
+
+    broker, router, coord = _pipeline()
+    coord.path = str(tmp_path / "cut.json")
+    t = router.start(poll_timeout_s=0.01)
+    try:
+        broker.produce(CFG.kafka_topic, {"id": 1, "amount": 10.0})
+        _drain(router, 1)
+        assert coord.checkpoint() is not None
+        pin_before = broker.committed_offsets(RETENTION_PIN_GROUP,
+                                              CFG.kafka_topic)
+        broker.produce(CFG.kafka_topic, {"id": 2, "amount": 10.0})
+        _drain(router, 2)
+        faults.install_storage_faults(
+            faults.StorageFaultPlan.from_string("enospc"))
+        try:
+            assert coord.checkpoint() is not None  # in-memory cut taken
+        finally:
+            faults.install_storage_faults(None)
+        # the durable write failed: the pin must still cover the cut
+        # that IS on disk (the first one)
+        assert broker.committed_offsets(RETENTION_PIN_GROUP,
+                                        CFG.kafka_topic) == pin_before
+        assert coord.checkpoint() is not None  # healthy again: pin moves
+        assert broker.committed_offsets(
+            RETENTION_PIN_GROUP, CFG.kafka_topic) != pin_before
+    finally:
+        router.stop()
+        t.join(timeout=5)
+    broker.close()
+
+
+def test_missing_checkpoints_serve_live_params_without_pin(tmp_path):
+    """Every step MISSING (wiped root) is not corruption: the scorer's
+    live tree serves and the rules-tier pin stays clear."""
+    import shutil
+
+    from ccfd_tpu.lifecycle.versions import VersionStore
+    from ccfd_tpu.parallel.checkpoint import CheckpointManager
+    from ccfd_tpu.serving.scorer import Scorer
+
+    lineage, ckpt_dir, _a, _b = _seed_two_eras(tmp_path)
+    shutil.rmtree(ckpt_dir)
+    gate = StoragePinGate()
+    scorer = Scorer(model_name="mlp", batch_sizes=(16, 128),
+                    host_tier_rows=0)
+    lc, broker = _controller(
+        scorer, VersionStore(lineage),
+        CheckpointManager(ckpt_dir, keep=8, use_orbax=False), gate=gate)
+    try:
+        assert not gate.pinned and not lc.storage_pinned
+    finally:
+        lc.close()
+        broker.close()
+
+
+def test_version_store_read_only_does_not_sweep(tmp_path):
+    """recover=False is the inspection surface: it must not unlink a live
+    writer's in-flight tmp files."""
+    from ccfd_tpu.lifecycle.versions import VersionStore
+
+    path = str(tmp_path / "versions.json")
+    VersionStore(path).create(parent=None)
+    live_tmp = tmp_path / "versions.json.999.0.tmp"
+    live_tmp.write_bytes(b"in flight")
+    ro = VersionStore(path, recover=False)
+    assert live_tmp.exists()
+    assert [v.version for v in ro.versions()] == [1]
+    # ... while a recovering (writer) bring-up sweeps it
+    VersionStore(path)
+    assert not live_tmp.exists()
+
+
+# -- interchange documents + metrics surface ---------------------------------
+
+def test_interchange_write_and_verify(tmp_path):
+    p = str(tmp_path / "doc.json")
+    assert durability.write_json_interchange(p, {"a": 1})
+    with open(p) as f:  # the body stays plain JSON for external readers
+        assert json.load(f) == {"a": 1}
+    assert durability.verify_interchange(p) is True
+    durability.flip_bytes(p)
+    assert durability.verify_interchange(p) is False
+    os.unlink(p + ".sha256")
+    assert durability.verify_interchange(p) is None  # legacy: unverified
+
+
+def test_bind_registry_replays_prior_counts(tmp_path):
+    p = str(tmp_path / "a.json")
+    durability.write_json_artifact(p, {"i": 0}, artifact="replay_test")
+    durability.flip_bytes(p)
+    durability.read_json_artifact(p, artifact="replay_test")
+    reg = Registry()
+    durability.bind_registry(reg)  # counts collected BEFORE binding land
+    scrape = reg.render()
+    assert "ccfd_storage_corrupt_total" in scrape
+    assert 'artifact="replay_test"' in scrape
+    # ... and post-bind events hit the live counter
+    before = reg.counter("ccfd_storage_fallback_total").total()
+    durability.read_json_artifact(p, artifact="replay_test")
+    assert reg.counter("ccfd_storage_fallback_total").total() > before

@@ -214,10 +214,11 @@ def test_platform_periodic_checkpoint_survives_crash(tmp_path):
         deadline = _time.monotonic() + 10.0
         while _time.monotonic() < deadline:
             if os.path.exists(state):
-                import json as _json
+                # engine snapshots are sha256-framed (runtime/durability)
+                from ccfd_tpu.runtime.durability import read_json_artifact
 
-                with open(state) as f:
-                    snap = _json.load(f)
+                snap = read_json_artifact(state, artifact="engine_snapshot",
+                                          quarantine=False)
                 if any(s["pid"] == pid for s in snap["instances"]):
                     break
             _time.sleep(0.05)

@@ -608,7 +608,15 @@ def test_operator_heal_default_on_and_gate_wired():
     p = Platform(spec).up(wait_ready_s=30)
     try:
         assert p.heal is not None
-        assert p.router._heal_gate is p.heal
+        # the router's gate composes the DeviceSupervisor with the
+        # storage pin (ISSUE 13): quarantine still pins the ladder, and
+        # an unverifiable-params pin blocks the host tier too
+        from ccfd_tpu.runtime.durability import ComposedHealGate
+
+        gate = p.router._heal_gate
+        assert isinstance(gate, ComposedHealGate)
+        assert p.heal in gate.gates and p.storage_gate in gate.gates
+        assert gate.device_allowed() and gate.host_allowed()
         assert "heal" in p.supervisor.status()
         assert p.supervisor.status()["heal"]["state"] == "Running"
         # the gauge family reaches the scraped surface
@@ -626,7 +634,8 @@ def test_operator_heal_kill_switch():
     p = Platform(spec).up(wait_ready_s=30)
     try:
         assert p.heal is None
-        assert p.router._heal_gate is None
+        # with heal off, the storage pin still binds the gate seam
+        assert p.router._heal_gate is p.storage_gate
     finally:
         p.down()
     # CR kill switch

@@ -148,21 +148,20 @@ class TestCrashSafety:
         path = first["path"]
         with open(path) as f:
             before = f.read()
-        # crash mid-write of a LATER artifact to the same path: os.replace
-        # never runs, the tmp file holds the torn bytes, the original is
-        # untouched
+        # crash mid-write of a LATER artifact to the same path, injected
+        # at the real durability seam (runtime/faults.py torn_write):
+        # os.replace never runs, the tmp file holds the torn bytes, the
+        # original is untouched
         import ccfd_tpu.observability.profile as profile_mod
+        from ccfd_tpu.runtime import faults
 
-        real_dump = json.dump
-
-        def torn_dump(doc, f, **kw):
-            f.write('{"torn": ')
-            raise OSError("disk full")
-
-        monkeypatch.setattr(profile_mod.json, "dump", torn_dump)
-        with pytest.raises(OSError):
-            profile_mod.write_json_crash_safe(path, {"x": 1})
-        monkeypatch.setattr(profile_mod.json, "dump", real_dump)
+        faults.install_storage_faults(
+            faults.StorageFaultPlan.from_string("torn_write"))
+        try:
+            with pytest.raises(OSError):
+                profile_mod.write_json_crash_safe(path, {"x": 1})
+        finally:
+            faults.install_storage_faults(None)
         with open(path) as f:
             assert f.read() == before
         assert json.load(open(path))["id"] == first["id"]

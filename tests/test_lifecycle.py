@@ -584,17 +584,19 @@ def test_evaluator_window_isolates_canary_evidence(champion_params, dataset):
 
 def test_version_store_quarantines_corrupt_file(tmp_path):
     """A truncated/corrupt lineage file must not brick bring-up: it is
-    quarantined and a fresh lineage starts."""
+    quarantined and — since the durability plane retains generations —
+    the LAST-GOOD lineage is recovered, not a fresh one (ISSUE 13)."""
     path = str(tmp_path / "versions.json")
     store = VersionStore(path)
     store.create(parent=None)
     with open(path, "w") as f:
         f.write('{"versions": [')  # torn write
     fresh = VersionStore(path)
-    assert fresh.versions() == []
-    v = fresh.create(parent=None)
-    assert v.version == 1
     assert os.path.exists(path + ".corrupt")
+    # the torn file was quarantined and the retained generation recovered
+    # the full lineage: version 1 survives, the counter resumes at 2
+    assert [v.version for v in fresh.versions()] == [1]
+    assert fresh.create(parent=None).version == 2
 
 
 def test_evaluator_bounds_label_accumulators(champion_params, dataset):

@@ -106,6 +106,18 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_heal_attempts_total",
     "ccfd_heal_canary_total",
     "ccfd_h2d_put_failures_total",
+    # round 16: durable-state integrity plane (runtime/durability.py) —
+    # corruption quarantines, last-good fallbacks, write errors, the
+    # orphan-tmp sweep, mid-file bus-log truncation and the rules-tier
+    # storage pin
+    "ccfd_storage_corrupt_total",
+    "ccfd_storage_fallback_total",
+    "ccfd_storage_write_errors_total",
+    "ccfd_storage_verified_reads_total",
+    "ccfd_storage_unverified_reads_total",
+    "ccfd_storage_tmp_swept_total",
+    "ccfd_storage_log_truncated_records_total",
+    "ccfd_storage_pinned",
 ]
 
 
@@ -124,7 +136,7 @@ def test_dashboards_cover_contract_metrics():
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
         "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
-        "Heal",
+        "Heal", "Storage",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

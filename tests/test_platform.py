@@ -322,8 +322,11 @@ class TestCrashRecovery:
         finally:
             p1.down()
         # the authoritative cut is whatever actually landed on disk
-        with open(str(tmp_path / "cut.json")) as f:
-            cut = json.load(f)
+        # (sha256-framed by the durability plane)
+        from ccfd_tpu.runtime.durability import read_json_artifact
+
+        cut = read_json_artifact(str(tmp_path / "cut.json"),
+                                 artifact="recovery_cut", quarantine=False)
         cut_consumed = sum(cut["offsets"][f"router\x00{cfg.kafka_topic}"])
         p2 = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
         try:

@@ -299,6 +299,24 @@ def main() -> int:
                     "storms activate")
     ap.add_argument("--device-fault-interval-s", type=float, default=20.0,
                     help="seconds between device-fault storm windows")
+    ap.add_argument("--storage-faults", action="store_true",
+                    help="ISSUE 13: drill the DISK as the fault target — "
+                    "storage-fault storms (runtime/faults.py torn_write/"
+                    "rename_lost/bitrot/enospc/fsync_fail/slow_disk) "
+                    "degrade every durable write at the durability seam "
+                    "while the ChaosMonkey kills services mid-write; the "
+                    "run must end with accounting exactly conserved, "
+                    "serving-params fingerprint == the lineage champion's "
+                    "checkpoint_hash, every detected corruption "
+                    "quarantined (never served), and zero unswept tmp "
+                    "debris. Implies --lifecycle (the hash-parity claim "
+                    "needs the lineage) and a durable on-disk cut.")
+    ap.add_argument("--storage-fault-spec",
+                    default="bitrot:rate=0.25;torn_write:rate=0.25;"
+                            "rename_lost:rate=0.15;fsync_fail:rate=0.1;"
+                            "slow_disk:ms=2,rate=0.5",
+                    help="CCFD_STORAGE_FAULTS-syntax plan the storage "
+                    "storms activate")
     ap.add_argument("--lifecycle", action="store_true",
                     help="run the model-lifecycle controller (lifecycle/) "
                     "under the storm: candidates cycle through shadow/"
@@ -307,6 +325,10 @@ def main() -> int:
     ap.add_argument("--lifecycle-submit-s", type=float, default=15.0,
                     help="seconds between candidate submissions")
     args = ap.parse_args()
+    if args.storage_faults:
+        # the end-of-run hash-parity claim (serving fingerprint ==
+        # lineage champion checkpoint_hash) needs the lineage running
+        args.lifecycle = True
 
     bus_dir = args.bus_log or tempfile.mkdtemp(prefix="ccfd_soak_bus_")
     # audit ON: it is the accounting ledger this soak asserts over
@@ -509,8 +531,33 @@ def main() -> int:
             backoff_base_s=0.1, backoff_cap_s=1.0,
         )
         router.set_heal_gate(healer)
+    # -- storage-fault storms (--storage-faults, ISSUE 13) ------------------
+    # The durability seam (runtime/durability.py) is the fault target:
+    # every lineage save, candidate checkpoint and recovery-cut write runs
+    # degraded during storm windows (torn/lost/bit-flipped/failed writes)
+    # while the ChaosMonkey kills services mid-write. Recovery must come
+    # from quarantine + last-good generations — never from serving a
+    # corrupt artifact.
+    storage_plan = None
+    cut_path = None
+    if args.storage_faults:
+        from ccfd_tpu.runtime import durability  # noqa: E402
+        from ccfd_tpu.runtime.faults import (  # noqa: E402
+            StorageFaultPlan,
+            install_storage_faults,
+        )
+
+        durability.bind_registry(reg_r)
+        storage_plan = StorageFaultPlan.from_string(args.storage_fault_spec,
+                                                    seed=29, active=False)
+        install_storage_faults(storage_plan)
+        # a durable on-disk cut: full-process crash recovery writes ride
+        # the same degraded seam (torn cuts must fall back to last-good)
+        cut_path = os.path.join(tempfile.mkdtemp(prefix="ccfd_soak_cut_"),
+                                "cut.json")
     coord = CheckpointCoordinator(router, broker, engine_factory,
-                                  interval_s=args.checkpoint_s)
+                                  interval_s=args.checkpoint_s,
+                                  path=cut_path)
     sup = Supervisor(backoff_initial_s=0.05, backoff_cap_s=0.5)
     sup.add_thread_service(
         "router", lambda: router.run(poll_timeout_s=0.02), router.stop,
@@ -708,8 +755,11 @@ def main() -> int:
     monkey = ChaosMonkey(sup, seed=11, targets=targets,
                          registry=reg_c, interval_s=args.chaos_interval_s,
                          fault_plan=fault_plan,
+                         storage_fault_plan=storage_plan,
                          fault_interval_s=(args.fault_interval_s
-                                           if args.net_faults else None),
+                                           if (args.net_faults
+                                               or args.storage_faults)
+                                           else None),
                          fault_duration_s=args.fault_duration_s)
     monkey.start()
 
@@ -816,6 +866,8 @@ def main() -> int:
         df_thread.join(timeout=10)
     if device_plan is not None:
         device_plan.deactivate()
+    if storage_plan is not None:
+        storage_plan.deactivate()
     stop_feed.set()
     investigator.stop()
     invest_thread.join(timeout=10)
@@ -846,11 +898,18 @@ def main() -> int:
         lifecycle.resolve_for_shutdown()
         champ = lifecycle.store.champion()
         served = jax.tree.map(np.asarray, scorer.params)
+        from ccfd_tpu.runtime.durability import CorruptArtifactError
         try:
             restored = lifecycle.checkpoints.restore(
                 served, step=champ.checkpoint_step)
         except FileNotFoundError:
             restored = None  # champion ckpt GC'd (very long soak): fail
+        except CorruptArtifactError:
+            # storm bitrot landed on the champion's on-disk bytes AFTER
+            # the stamp: quarantined, never served — the hash-parity
+            # check below (recorded fingerprint vs the tree actually
+            # serving) is the integrity claim that still must hold
+            restored = None
         params_match = restored is not None and all(
             np.allclose(a, b, atol=1e-6)
             for a, b in zip(jax.tree.leaves(served),
@@ -876,6 +935,31 @@ def main() -> int:
             "challenger_cleared": scorer.challenger_version is None,
             "gate_inactive": not lifecycle.gate.active,
         }
+        if args.storage_faults:
+            from ccfd_tpu.parallel.partition import params_fingerprint
+            from ccfd_tpu.runtime import durability as _dur
+
+            serving_fp = params_fingerprint(served)
+            lc_events = [e["event"] for e in lifecycle.store.audit_trail()]
+            lifecycle_res["storage"] = {
+                "storm_windows": storage_plan.activations,
+                "injected": dict(storage_plan.injected),
+                "counts": {k: sum(v.values())
+                           for k, v in _dur.counts().items()},
+                # the integrity claim: what serves is what the lineage
+                # recorded — byte-corruption on disk was quarantined (and
+                # possibly recovered from a generation), never published
+                "serving_fp_matches_lineage": bool(
+                    champ.checkpoint_hash is not None
+                    and serving_fp == champ.checkpoint_hash),
+                # divergence is only legal when the audit trail explains
+                # it: a fallback restore (verified older generation
+                # served, re-stamped) or a rules pin (nothing verified)
+                "fallback_restores": lc_events.count(
+                    "storage_fallback_restore"),
+                "storage_pins": lc_events.count("storage_pin"),
+                "pinned_at_end": lifecycle.storage_pinned,
+            }
 
     total = router._c_in.value()
     final_engine = router.engine
@@ -1080,12 +1164,30 @@ def main() -> int:
                 # the pool ends on ONE consistent model version: serving
                 # params equal the champion checkpoint, no challenger slot
                 # or canary gate dangling, and transitions actually cycled
-                # under the storm
-                lifecycle_res.get("serving_matches_champion_checkpoint")
+                # under the storm. Under --storage-faults the on-disk
+                # champion bytes may be storm-corrupt (quarantined, never
+                # served) — the recorded-fingerprint parity or an audited
+                # fallback/pin then carries the consistency claim.
+                (lifecycle_res.get("serving_matches_champion_checkpoint")
+                 or (args.storage_faults and (
+                     lifecycle_res["storage"]["serving_fp_matches_lineage"]
+                     or lifecycle_res["storage"]["fallback_restores"] > 0
+                     or lifecycle_res["storage"]["storage_pins"] > 0)))
                 and lifecycle_res.get("serving_consistent")
                 and lifecycle_res.get("challenger_cleared")
                 and lifecycle_res.get("gate_inactive")
                 and lifecycle_res.get("versions", 0) > 1
+            )
+        )
+        and (
+            not args.storage_faults
+            or (
+                # storage storms actually fired and injected, writes
+                # failed LOUDLY (counted) or corruption was quarantined —
+                # and the run survived them all with the accounting claim
+                # (acct_ok above) intact: zero corrupt artifacts served
+                lifecycle_res["storage"]["storm_windows"] > 0
+                and sum(lifecycle_res["storage"]["injected"].values()) > 0
             )
         )
         and (

@@ -84,6 +84,23 @@
 #                                          trips over real HTTP, and the
 #                                          health gauges scrape live:
 #                                          HEALSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --storage-smoke  exit-code-gated smoke of the
+#                                          durable-state integrity plane
+#                                          (tools/storage_smoke.py): an
+#                                          injected corrupt champion
+#                                          checkpoint + torn lineage at
+#                                          restart are QUARANTINED and
+#                                          the newest verifiable
+#                                          generation restores with
+#                                          serving-params fingerprint ==
+#                                          lineage checkpoint_hash; with
+#                                          ALL generations corrupted the
+#                                          router pins to the rules tier
+#                                          instead of serving unverified
+#                                          params; orphan-tmp sweep and
+#                                          ccfd_storage_* gauges over
+#                                          real HTTP:
+#                                          STORAGESMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -156,6 +173,18 @@ if [ "${1:-}" = "--heal-smoke" ]; then
     # over real HTTP (see tools/heal_smoke.py; prints HEALSMOKE verdict=)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/heal_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--storage-smoke" ]; then
+    # exit-code-gated smoke of the durable-state integrity plane:
+    # corrupt-champion quarantine -> last-good restore + hash parity ->
+    # rules-tier pin when nothing verifies, gauges over real HTTP (see
+    # tools/storage_smoke.py; prints STORAGESMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/storage_smoke.py; then
         exit 0
     fi
     exit 1
