@@ -458,13 +458,150 @@ def cmd_quantize(args: argparse.Namespace) -> int:
     return 0
 
 
+def _audit_fetch_json(url: str):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def cmd_audit_reconstruct(args: argparse.Namespace, cfg) -> int:
+    """``ccfd_tpu audit <tx_id>``: the regulator question, answered from
+    one command — the DecisionRecord stamped at the route seam, joined
+    to the lifecycle lineage (version + checkpoint hash, with a parity
+    verdict), the incident bundle open when the decision was made, and
+    the kept trace when the tail sampler sampled it. Reads the live
+    exporter with ``--url``; otherwise reconstructs OFFLINE from the
+    on-disk artifacts — which is exactly what a crash-restore drill
+    exercises (tools/audit_smoke.py)."""
+    doc: dict = {"tx_id": args.tx_id}
+    record = None
+    base = args.url.rstrip("/") if args.url else ""
+    if base:
+        record = _audit_fetch_json(f"{base}/decisions/{args.tx_id}")
+    if record is None:
+        audit_dir = args.dir or cfg.audit_dir
+        if audit_dir:
+            from ccfd_tpu.observability.audit import AuditLog
+
+            # readonly: an inspection command must never truncate the
+            # live log out from under a running platform. The ring is
+            # sized from config so recovery rebuilds as deep a view as
+            # the configured platform would (CCFD_AUDIT_RING).
+            log = AuditLog(dir=audit_dir, readonly=True,
+                           max_records=cfg.audit_ring)
+            record = log.get(args.tx_id)
+    if record is None:
+        print(f"[audit] no decision record for {args.tx_id!r} (checked "
+              + (f"{base}/decisions and " if base else "")
+              + f"dir={args.dir or cfg.audit_dir or '<unset>'})",
+              file=sys.stderr)
+        return 2
+    doc["record"] = record
+
+    # -- lineage join: the version that scored it, hash parity ------------
+    lc_dir = args.lifecycle_dir or cfg.lifecycle_dir
+    if lc_dir and record.get("version") is not None:
+        from ccfd_tpu.lifecycle.versions import VersionStore
+
+        path = os.path.join(lc_dir, "versions.json")
+        try:
+            store = VersionStore(path, recover=False)
+            v = store.get(int(record["version"]))
+            doc["lineage"] = {
+                "version": v.to_dict(),
+                "events": store.audit_trail(v.version),
+                # the compliance check: the hash stamped on the decision
+                # equals the hash the lineage records for that version
+                "hash_parity": (record.get("hash") is not None
+                                and v.checkpoint_hash == record.get("hash")),
+            }
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            doc["lineage"] = {"error": repr(e)}
+
+    # -- incident join: what was burning while this decision was made -----
+    inc_id = record.get("incident")
+    if inc_id:
+        bundle = None
+        if base:
+            bundle = _audit_fetch_json(f"{base}/incidents/{inc_id}")
+        if bundle is None:
+            inc_dir = args.incident_dir or cfg.incident_dir
+            if inc_dir:
+                try:
+                    with open(os.path.join(inc_dir, f"{inc_id}.json")) as f:
+                        bundle = json.load(f)
+                except (OSError, ValueError):
+                    bundle = None
+        if bundle is not None:
+            doc["incident"] = {
+                "id": bundle.get("id"),
+                "trigger": bundle.get("trigger"),
+                "generated_unix": bundle.get("generated_unix"),
+                "found": True,
+            }
+        else:
+            doc["incident"] = {"id": inc_id, "found": False}
+
+    # -- trace join: only the live sink holds kept traces -----------------
+    trace_id = record.get("trace")
+    if trace_id and base:
+        tr = _audit_fetch_json(f"{base}/traces/{trace_id}")
+        doc["trace"] = ({"trace_id": trace_id,
+                         "spans": len(tr.get("spans", [])), "kept": True}
+                        if tr is not None
+                        else {"trace_id": trace_id, "kept": False})
+    elif trace_id:
+        doc["trace"] = {"trace_id": trace_id, "kept": None}
+
+    if args.json:
+        print(json.dumps(doc, indent=1, default=str))
+        return 0
+    r = record
+    print(f"decision tx={r.get('tx')} uid={r.get('uid')} seq={r.get('seq')}")
+    print(f"  score: proba={r.get('proba')} threshold={r.get('threshold')} "
+          f"-> rule={r.get('rule')} branch={r.get('branch')} "
+          f"pid={r.get('pid')}")
+    tier = r.get("tier", "?")
+    cause = f" ({r['cause']})" if r.get("cause") else ""
+    print(f"  served by: {tier} tier{cause}  priority={r.get('priority')}"
+          + (f"  events={r['events']}" if r.get("events") else ""))
+    print(f"  model: version={r.get('version')} hash={r.get('hash')}")
+    lin = doc.get("lineage")
+    if lin and "version" in lin:
+        parity = "OK" if lin["hash_parity"] else "MISMATCH"
+        v = lin["version"]
+        print(f"  lineage: v{v['version']} stage={v['stage']} "
+              f"ckpt={v['checkpoint_step']} hash parity: {parity} "
+              f"({len(lin['events'])} audit events)")
+    inc = doc.get("incident")
+    if inc:
+        mark = "" if inc.get("found") else " (bundle not found)"
+        print(f"  incident: {inc['id']}{mark}"
+              + (f" trigger={inc['trigger']}" if inc.get("trigger") else ""))
+    trc = doc.get("trace")
+    if trc:
+        kept = {True: "kept", False: "not retained",
+                None: "offline (query --url for spans)"}[trc.get("kept")]
+        print(f"  trace: {trc['trace_id']} [{kept}]")
+    return 0
+
+
 def cmd_audit(args: argparse.Namespace) -> int:
-    """Tail the engine's audit stream (CCFD_AUDIT_TOPIC): one JSON event
-    per line — the operator view of jBPM's process-instance history.
-    ``--follow`` keeps consuming; otherwise drains what's there and exits."""
+    """With a tx id: reconstruct that decision end-to-end (provenance
+    plane, observability/audit.py). Without one: tail the engine's audit
+    stream (CCFD_AUDIT_TOPIC) — one JSON event per line, the operator
+    view of jBPM's process-instance history. ``--follow`` keeps
+    consuming; otherwise drains what's there and exits."""
     from ccfd_tpu.config import Config
 
     cfg = Config.from_env()
+    if args.tx_id:
+        return cmd_audit_reconstruct(args, cfg)
     topic = args.topic or cfg.audit_topic
     if not topic:
         # surface the misconfiguration instead of an empty-but-successful
@@ -1453,7 +1590,28 @@ def main(argv: list[str] | None = None) -> int:
     q.add_argument("--test-frac", type=float, default=0.2)
     q.set_defaults(fn=cmd_quantize)
 
-    au = sub.add_parser("audit", help="tail the engine's audit event stream")
+    au = sub.add_parser(
+        "audit",
+        help="reconstruct one decision by tx id (decision provenance "
+             "plane), or tail the engine's audit event stream",
+    )
+    au.add_argument("tx_id", nargs="?", default=None,
+                    help="transaction id (or partition:offset uid) to "
+                    "reconstruct; omit to tail the engine audit stream")
+    au.add_argument("--dir", default="",
+                    help="audit log dir (default: CCFD_AUDIT_DIR)")
+    au.add_argument("--lifecycle-dir", default="",
+                    help="lifecycle state dir for the lineage join "
+                    "(default: CCFD_LIFECYCLE_DIR)")
+    au.add_argument("--incident-dir", default="",
+                    help="incident bundle dir for the incident join "
+                    "(default: CCFD_INCIDENT_DIR)")
+    au.add_argument("--url", default="",
+                    help="live exporter endpoint: fetch the record, "
+                    "incident bundle and kept trace over HTTP instead "
+                    "of (or in addition to) the on-disk artifacts")
+    au.add_argument("--json", action="store_true",
+                    help="emit the full reconstruction document as JSON")
     au.add_argument("--topic", default="", help="default: CCFD_AUDIT_TOPIC")
     au.add_argument("--group", default="audit-tail",
                     help="consumer group (offsets persist per group)")

@@ -217,6 +217,28 @@ class Config:
     # (tmp+rename)
     incident_dir: str = ""                 # CCFD_INCIDENT_DIR
 
+    # --- decision provenance audit (observability/audit.py; CR block
+    # `audit:`) ---
+    # master switch for the per-transaction DecisionRecord plane: the
+    # router stamps one compact record per routed transaction at the
+    # route seam, queryable at /decisions/<tx_id> and reconstructable
+    # after a crash-restore (CCFD_AUDIT; 0 is the emergency kill switch —
+    # no records stamped, both exporter endpoints 404)
+    audit_enabled: bool = True
+    # segmented append-only log dir ("" = ring only: decisions queryable
+    # live but NOT reconstructable across a restart)
+    audit_dir: str = ""                    # CCFD_AUDIT_DIR
+    # bounded query-ring depth (records; oldest evicted, counted)
+    audit_ring: int = 65536                # CCFD_AUDIT_RING
+    # log segment rotation size and retained-segment count (the PR 13
+    # generation-retention idea applied to an append-only log)
+    audit_segment_bytes: int = 4 * 1024 * 1024  # CCFD_AUDIT_SEGMENT_BYTES
+    audit_segments: int = 8                # CCFD_AUDIT_SEGMENTS
+    # supervised flusher cadence: pending records land as one framed
+    # block per tick (a crash loses at most one tick of records — the
+    # torn tail truncates and counts at the next bring-up)
+    audit_flush_interval_s: float = 0.25   # CCFD_AUDIT_FLUSH_INTERVAL_S
+
     # --- durable-state integrity (runtime/durability.py; CR block
     # `durability:`) ---
     # generations retained per single-file artifact (lineage, recovery
@@ -530,6 +552,21 @@ class Config:
             ),
             device_faults_spec=e.get("CCFD_DEVICE_FAULTS",
                                      Config.device_faults_spec),
+            audit_enabled=e.get("CCFD_AUDIT", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            audit_dir=e.get("CCFD_AUDIT_DIR", Config.audit_dir),
+            audit_ring=int(e.get("CCFD_AUDIT_RING", str(Config.audit_ring))),
+            audit_segment_bytes=int(
+                e.get("CCFD_AUDIT_SEGMENT_BYTES",
+                      str(Config.audit_segment_bytes))
+            ),
+            audit_segments=int(
+                e.get("CCFD_AUDIT_SEGMENTS", str(Config.audit_segments))
+            ),
+            audit_flush_interval_s=float(
+                e.get("CCFD_AUDIT_FLUSH_INTERVAL_S",
+                      str(Config.audit_flush_interval_s))
+            ),
             storage_retain=int(
                 e.get("CCFD_STORAGE_RETAIN", str(Config.storage_retain))
             ),

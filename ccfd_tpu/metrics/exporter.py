@@ -26,6 +26,15 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
                                first (observability/incident.py)
     GET /incidents/<id>        one full schema-validated incident bundle
                                (JSON); unknown ids 404
+    GET /decisions             decision-record summaries (JSON), newest
+                               first; ?since=<unix_ts> filters on decide
+                               time, ?limit=N bounds the page
+                               (observability/audit.py)
+    GET /decisions/<tx_id>     one full DecisionRecord by transaction id
+                               (or "partition:offset" uid); unknown ids
+                               404 — strict JSON either way, and both
+                               endpoints 404 entirely when the audit
+                               plane is off (CCFD_AUDIT=0)
     GET /debug/device          live device-telemetry snapshot (JSON):
                                per-device memory, measured H2D accounting,
                                executable inventory (observability/device.py)
@@ -137,12 +146,14 @@ class MetricsExporter:
                  memory_probes: dict[str, "object"] | None = None,
                  profiler=None,
                  telemetry=None,
-                 recorder=None):
+                 recorder=None,
+                 audit=None):
         self._registries = dict(registries)
         self._sink = sink  # observability.trace.SpanSink (or None)
         self._profiler = profiler  # observability.profile.StageProfiler
         self._telemetry = telemetry  # observability.device.DeviceTelemetry
         self._recorder = recorder  # observability.incident.FlightRecorder
+        self._audit = audit  # observability.audit.AuditLog
         self._capture_lock = threading.Lock()  # one device capture at a time
         self._lock = threading.Lock()
         # memory-drift surface (observability/memory.py): a "process"
@@ -230,6 +241,8 @@ class MetricsExporter:
                     "application/json")
         if path == "/incidents" or path.startswith("/incidents/"):
             return self._incidents(path), "application/json"
+        if path == "/decisions" or path.startswith("/decisions/"):
+            return self._decisions(path, query), "application/json"
         if path == "/debug/device":
             if self._telemetry is None:
                 return None, "application/json"
@@ -267,6 +280,33 @@ class MetricsExporter:
         if doc is None:
             return None
         return json.dumps(doc)
+
+    def _decisions(self, path: str, query: str) -> str | None:
+        """Decision-provenance queries (observability/audit.py). With the
+        plane off (CCFD_AUDIT=0 -> no AuditLog wired) BOTH endpoints 404
+        — the kill-switch contract, like /debug/* under CCFD_DEVICE=0."""
+        if self._audit is None:
+            return None
+        if path.rstrip("/") == "/decisions":
+            from urllib.parse import parse_qs
+
+            q = parse_qs(query or "")
+            since = None
+            try:
+                if q.get("since"):
+                    since = float(q["since"][0])
+            except ValueError:
+                since = None
+            try:
+                limit = int((q.get("limit") or ["256"])[0])
+            except ValueError:
+                limit = 256
+            return json.dumps(
+                {"decisions": self._audit.list(since=since, limit=limit)})
+        rec = self._audit.get(path[len("/decisions/"):])
+        if rec is None:
+            return None
+        return json.dumps(rec)
 
     def _device_capture(self, query: str) -> str | None:
         """On-demand jax.profiler trace (/debug/profile?seconds=N): the

@@ -704,6 +704,40 @@ def storage_dashboard() -> dict:
     return _dashboard("CCFD Storage", "ccfd-storage", p)
 
 
+def audit_dashboard() -> dict:
+    """Decision provenance board (ISSUE 14; observability/audit.py).
+
+    The compliance surface: decision records stamped per routed
+    transaction (the conservation claim — this rate must track the
+    outgoing rate exactly), the two durable-loss alerts kept in their
+    OWN units (log_write counts RECORDS whose append failed; torn_tail
+    counts truncation EVENTS at crash recovery — the records inside a
+    torn frame are unparseable, so an event is the honest unit), the
+    segmented log's on-disk footprint, and the bounded query ring's
+    depth."""
+    p = [
+        _panel(0, "Decision records stamped / s",
+               ["rate(ccfd_audit_records_total[5m])"]),
+        _panel(1, "Routed vs recorded / s (conservation: identical)",
+               ["sum(rate(transaction_outgoing_total[5m]))",
+                "rate(ccfd_audit_records_total[5m])"]),
+        _alert_stat(2, "Records lost to failed appends",
+                    ["sum(ccfd_audit_dropped_total"
+                     "{reason=\"log_write\"})"],
+                    red_above=1),
+        _alert_stat(3, "Torn tails truncated at recovery (events)",
+                    ["sum(ccfd_audit_dropped_total"
+                     "{reason=\"torn_tail\"})"],
+                    red_above=1),
+        _panel(4, "Drops by reason / s",
+               ["rate(ccfd_audit_dropped_total[5m])"]),
+        _panel(5, "Audit log bytes on disk", ["ccfd_audit_log_bytes"],
+               "stat"),
+        _panel(6, "Query-ring depth", ["ccfd_audit_ring_records"]),
+    ]
+    return _dashboard("CCFD Audit", "ccfd-audit", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -733,6 +767,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Device": device_dashboard(),
         "Heal": heal_dashboard(),
         "Storage": storage_dashboard(),
+        "Audit": audit_dashboard(),
     }
 
 

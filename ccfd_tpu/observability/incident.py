@@ -15,13 +15,18 @@ module closes the loop:
   immediately, so watchdog post-mortems have flight data too.
 - **Incident bundles** — the SLOEngine's breach edge-trigger calls
   :meth:`FlightRecorder.on_breach`, which dumps ONE schema-validated
-  (:data:`INCIDENT_SCHEMA` = ``ccfd.incident.v1``) bundle per breach
+  (:data:`INCIDENT_SCHEMA` = ``ccfd.incident.v2``) bundle per breach
   entry: trigger, full SLO status, the complete StageProfile document,
-  the ring as it stood, a live snapshot, and the device telemetry plane's
-  view. Bundles persist crash-safely (tmp+rename) under ``out_dir`` when
-  configured, are bounded (``max_bundles``, oldest pruned), and are
-  served by the exporter at ``/incidents`` + ``/incidents/<id>``.
-  ``tools/incident_report.py`` renders the human summary.
+  the ring as it stood, a live snapshot, the device telemetry plane's
+  view — and, with the decision-audit plane armed, the last N
+  **decision-record summaries** from the breach window
+  (``observability/audit.py``), so ``incident_report`` shows WHICH
+  transactions were in flight when the objective failed, not just which
+  layer ate the latency (schema v1 -> v2). Bundles persist crash-safely
+  (tmp+rename) under ``out_dir`` when configured, are bounded
+  (``max_bundles``, oldest pruned), and are served by the exporter at
+  ``/incidents`` + ``/incidents/<id>``. ``tools/incident_report.py``
+  renders the human summary.
 
 Edge semantics match the breach counter's: one bundle per ENTRY into the
 breaching state — a recovery followed by a re-breach dumps again.
@@ -40,7 +45,7 @@ from ccfd_tpu.observability.profile import (
     write_json_crash_safe,
 )
 
-INCIDENT_SCHEMA = "ccfd.incident.v1"
+INCIDENT_SCHEMA = "ccfd.incident.v2"
 
 # counters whose totals every snapshot records (and diffs against the
 # previous snapshot): the accounting a responder reads first
@@ -93,11 +98,18 @@ class FlightRecorder:
         max_bundles: int = 16,
         timeout_debounce_s: float = 2.0,
         clock: Callable[[], float] = time.time,
+        audit=None,
     ):
         self._registries = registries
         self.profiler = profiler
         self.telemetry = telemetry
         self.sink = sink
+        # decision-audit plane (observability/audit.py): when wired,
+        # every bundle embeds the last N decision-record summaries — the
+        # transactions in flight across the breach window
+        self.audit = audit
+        self.decisions_embedded = 16
+        self._last_incident_id: str | None = None
         self.out_dir = out_dir or None
         self.max_bundles = max(1, int(max_bundles))
         self._clock = clock
@@ -272,6 +284,14 @@ class FlightRecorder:
                 doc["stage_profile"] = self.profiler.snapshot()
             except Exception:  # noqa: BLE001
                 doc["stage_profile"] = None
+        if self.audit is not None:
+            # which transactions were IN FLIGHT: the newest decision
+            # records as they stood at the breach edge (schema v2)
+            try:
+                doc["decisions"] = self.audit.recent_summaries(
+                    self.decisions_embedded)
+            except Exception:  # noqa: BLE001 - evidence, never a crash
+                doc["decisions"] = []
         errs = validate_incident(doc)
         if errs:  # never ship an invalid bundle silently
             doc["validation_errors"] = errs[:10]
@@ -286,6 +306,7 @@ class FlightRecorder:
             doc["path"] = path
         with self._mu:
             self._bundles[inc_id] = doc
+            self._last_incident_id = inc_id
             while len(self._bundles) > self.max_bundles:
                 old_id, old = self._bundles.popitem(last=False)
                 old_path = old.get("path")
@@ -319,6 +340,13 @@ class FlightRecorder:
         with self._mu:
             return self._bundles.get(inc_id)
 
+    def last_incident_id(self) -> str | None:
+        """Newest bundle's id — the decision-audit plane stamps it onto
+        routed transactions while the SLO engine reports the breaching
+        state still open (operator wiring)."""
+        with self._mu:
+            return self._last_incident_id
+
     # -- supervised-service surface ----------------------------------------
     def reset(self) -> None:
         self._stop.clear()
@@ -346,10 +374,12 @@ def _snapshot_errors(where: str, snap: Any) -> list[str]:
 
 
 def validate_incident(doc: Any) -> list[str]:
-    """Schema check for a ``ccfd.incident.v1`` bundle -> list of problems
+    """Schema check for a ``ccfd.incident.v2`` bundle -> list of problems
     ([] = valid). Hand-rolled like ``validate_profile``, and reusing it
     for the embedded StageProfile: the smoke and the exporter contract
-    both gate on NAMED failures."""
+    both gate on NAMED failures. v2 adds the optional ``decisions``
+    embed (decision-record summaries from the breach window); when
+    present it must be a list of record mappings."""
     errs: list[str] = []
     if not isinstance(doc, Mapping):
         return ["document: not a mapping"]
@@ -376,4 +406,14 @@ def validate_incident(doc: Any) -> list[str]:
     sp = doc.get("stage_profile")
     if sp is not None:
         errs.extend(f"stage_profile.{e}" for e in validate_profile(sp))
+    decisions = doc.get("decisions")
+    if decisions is not None:
+        if not isinstance(decisions, list):
+            errs.append("decisions: must be a list when present")
+        else:
+            for i, d in enumerate(decisions):
+                if not isinstance(d, Mapping) or "seq" not in d:
+                    errs.append(f"decisions[{i}]: not a decision-record "
+                                "summary (mapping with 'seq')")
+                    break
     return errs

@@ -398,6 +398,14 @@ class SLOEngine:
     def breaches(self, slo: str) -> int:
         return int(self._c_breach.value({"slo": slo}))
 
+    def any_breaching(self) -> bool:
+        """True while ANY objective sits in the breaching state (between a
+        breach edge and its recovery tick) — the decision-audit plane's
+        definition of "an incident is open": routed transactions stamped
+        in this window carry the newest incident bundle's id."""
+        with self._mu:
+            return any(tr.breaching for tr in self._trackers.values())
+
     # -- supervised-service surface ---------------------------------------
     def reset(self) -> None:
         self._stop.clear()

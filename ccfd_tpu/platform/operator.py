@@ -74,6 +74,10 @@ _COMPONENTS = (
                   # quarantine + last-good recovery, orphan-tmp sweep,
                   # rules-tier pin when nothing verifies (new;
                   # runtime/durability.py)
+    "audit",      # decision provenance plane: one DecisionRecord per
+                  # routed transaction stamped at the route seam, ring +
+                  # segmented crash-safe log, /decisions endpoints (new;
+                  # observability/audit.py)
 )
 
 
@@ -155,6 +159,7 @@ class Platform:
         self.storage_fault_plan = None  # runtime/faults.StorageFaultPlan
         self._storage_storm_driven = False
         self.storage_gate = None  # runtime/durability.StoragePinGate
+        self.audit = None       # observability/audit.AuditLog when enabled
         self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
@@ -358,6 +363,39 @@ class Platform:
 
             self.device = DeviceTelemetry(registry=self._registry("device"))
 
+        # 0f. decision provenance plane (observability/audit.py): ONE
+        # AuditLog shared by every router worker — the route seam stamps
+        # one DecisionRecord per routed transaction into a bounded ring
+        # plus (with a dir) a segmented crash-safe log written through
+        # the durability seam's framing. Built before the router so the
+        # workers construct against it; the lifecycle (3b) wires the
+        # per-batch lineage sample and the incident recorder (7d) the
+        # open-incident join. CCFD_AUDIT=0 (or CR audit.enabled: false)
+        # kills the plane: no records stamped, /decisions 404s.
+        aud_spec = spec.component("audit")
+        if aud_spec.enabled and cfg.audit_enabled:
+            from ccfd_tpu.observability.audit import AuditLog
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            self.audit = AuditLog(
+                dir=(aud_spec.opt("dir", cfg.audit_dir) or None),
+                max_records=int(aud_spec.opt("ring", cfg.audit_ring)),
+                segment_bytes=int(
+                    aud_spec.opt("segment_bytes", cfg.audit_segment_bytes)),
+                retain_segments=int(
+                    aud_spec.opt("segments", cfg.audit_segments)),
+                registry=self._registry("audit"),
+            )
+            flush_s = float(
+                aud_spec.opt("flush_interval_s", cfg.audit_flush_interval_s))
+            self.supervisor.add_thread_service(
+                "audit",
+                lambda: self.audit.run(interval_s=flush_s),
+                self.audit.stop,
+                policy=RestartPolicy.ALWAYS,
+                reset=self.audit.reset,
+            )
+
         # 0e. multi-chip partitioning layer (parallel/partition.py): the
         # named (data, fsdp, tp) mesh + partitioner the serving/retrain
         # components below build AGAINST — constructed first so the scorer
@@ -505,9 +543,25 @@ class Platform:
                 max_bundles=int(inc_spec.opt("max_bundles", 16)),
                 timeout_debounce_s=float(
                     inc_spec.opt("timeout_debounce_s", 2.0)),
+                audit=self.audit,  # bundles embed in-flight decisions
             )
             if self.slo is not None:
                 self.slo.add_breach_listener(self.recorder.on_breach)
+            if self.audit is not None:
+                # open-incident join for the decision records: while any
+                # SLO is in the breaching state, routed transactions are
+                # stamped with the newest bundle's id — "this score was
+                # made DURING inc-0007" is a query, not a log dig. With
+                # no burn-rate state (CCFD_SLO=0) there is no notion of
+                # "still open", so nothing links (documented).
+                rec, eng = self.recorder, self.slo
+
+                def _open_incident():
+                    if eng is None or not eng.any_breaching():
+                        return None
+                    return rec.last_incident_id()
+
+                self.audit.incident_fn = _open_incident
             if self._overload is not None:
                 self._overload.recorder = self.recorder
             if self.storage_gate is not None:
@@ -551,6 +605,7 @@ class Platform:
                 profiler=self.profiler,  # /profile StageProfile endpoint
                 telemetry=self.device,  # device gauges + /debug endpoints
                 recorder=self.recorder,  # /incidents + /incidents/<id>
+                audit=self.audit,  # /decisions + /decisions/<tx_id>
             ).start()
             self._wire_memory_probes()
 
@@ -911,6 +966,16 @@ class Platform:
                     "gates will include ladder-rung noise (conservative)",
                     self.scorer.len_buckets,
                 )
+        if self.audit is not None:
+            # per-batch lineage sample for the decision records: the route
+            # seam joins each batch to the serving champion's version id +
+            # checkpoint hash — sampled once per batch, never per row
+            def _lineage_sample(store=store):
+                v = store.champion()
+                return ((v.version, v.checkpoint_hash)
+                        if v is not None else (None, None))
+
+            self.audit.lineage_fn = _lineage_sample
         interval = float(c.opt("interval_s", 0.25))
         self.supervisor.add_thread_service(
             "lifecycle",
@@ -1146,6 +1211,7 @@ class Platform:
             tracer=router_tracer,
             overload=overload,
             profiler=self.profiler,
+            audit=self.audit,
         )
         # partition-parallel fan-out (router/parallel.py): CR
         # `router.workers` over CCFD_ROUTER_WORKERS; 1 = the historical
@@ -1524,6 +1590,13 @@ class Platform:
             self.recovery.stop()
         if self.supervisor:
             self.supervisor.stop()
+        if self.audit is not None:
+            # the supervised flusher's shutdown already lands the tail;
+            # this covers platforms torn down before the supervisor ran
+            try:
+                self.audit.flush()
+            except Exception:  # noqa: BLE001 - teardown must not raise
+                pass
         if self.lifecycle is not None:
             try:
                 self.lifecycle.close()  # releases the evaluator consumers

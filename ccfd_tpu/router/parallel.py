@@ -93,6 +93,7 @@ class ParallelRouter:
         overload: "Any | None" = None,
         profiler: "Any | None" = None,
         heal_gate: "Any | None" = None,
+        audit: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -178,6 +179,10 @@ class ParallelRouter:
                 degrade=degrade, max_inflight=self.max_inflight,
                 tracer=tracer, inflight_budget=self._budget, worker_id=i,
                 overload=overload, profiler=profiler, heal_gate=heal_gate,
+                # ONE shared decision-provenance log: every worker stamps
+                # into the same ring/segments, so conservation (routed ==
+                # recorded) holds across the pool, like the budget bound
+                audit=audit,
             )
             for i in range(workers)
         ]

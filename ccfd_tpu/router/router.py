@@ -318,6 +318,7 @@ class Router:
         overload: "Any | None" = None,
         profiler: "Any | None" = None,
         heal_gate: "Any | None" = None,
+        audit: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -463,6 +464,25 @@ class Router:
         # route service time, and the scorer dispatch round trip, batch-
         # size-conditioned. None costs one attribute read per batch.
         self._profiler = profiler
+        # decision provenance plane (observability/audit.py AuditLog):
+        # when armed, the route seam stamps one compact DecisionRecord
+        # per routed transaction — tx/uid/score/branch, the serving tier
+        # that produced the score (threaded through a per-batch meta
+        # dict so the pipelined loop's concurrent score/route stages
+        # can't cross batches), admission priority, and the batch-
+        # sampled lineage/incident joins. None costs one attribute read
+        # per batch.
+        self._audit = audit
+        self._rec_pri = self._pri_names = None
+        if audit is not None:
+            # lazy: runtime/overload.py imports this module
+            from ccfd_tpu.runtime.overload import (
+                PRIORITY_NAMES,
+                record_priority,
+            )
+
+            self._pri_names = PRIORITY_NAMES
+            self._rec_pri = record_priority
         # worker identity (ParallelRouter): labels this loop's batches and
         # trace spans so per-stage attribution survives the fan-out
         self.worker_id = worker_id
@@ -621,6 +641,24 @@ class Router:
                     service_s=time.perf_counter() - t0, batch=n, rows=n)
         return x, txs, ts
 
+    # -- decision provenance -----------------------------------------------
+    def _audit_meta(self, records: list) -> dict | None:
+        """Per-batch audit context, built while the bus records (the only
+        carriers of partition/offset and priority headers) are still in
+        scope. Rides WITH the batch through score and route — the
+        pipelined loop scores batch k while routing k-1, so batch-scoped
+        state must never live on ``self``."""
+        if self._audit is None:
+            return None
+        names, pri = self._pri_names, self._rec_pri
+        return {
+            "uids": [f"{r.partition}:{r.offset}" for r in records],
+            "pris": [names[pri(r)] for r in records],
+            "events": [],
+            "tier": "device",
+            "cause": None,
+        }
+
     # -- degradation ladder ------------------------------------------------
     def _shed_oldest(self, records: list) -> list:
         """Bounded in-flight: drop the OLDEST consumed records when a poll
@@ -669,11 +707,13 @@ class Router:
         return np.where(risky, thr, np.float32(0.0)).astype(np.float32)
 
     def _score_tiered(self, x: np.ndarray, txs: list,
-                      span=None) -> np.ndarray:
+                      span=None, meta=None) -> np.ndarray:
         """device scorer → host numpy forward → rules-only. Never raises:
         the bottom tier is pure numpy over data already in hand. ``span``
         (when tracing) gets the degraded-tier flag — a trace scored by a
-        fallback tier is always tail-sampled KEEP."""
+        fallback tier is always tail-sampled KEEP. ``meta`` (when the
+        audit plane is armed) records the tier that actually produced
+        the batch's scores and why the ladder fell."""
         gate = self._heal_gate
         host_blocked = False
         if gate is not None and not gate.device_allowed():
@@ -689,6 +729,9 @@ class Router:
             # the rules floor until a verified tree is published
             host_ok = getattr(gate, "host_allowed", None)
             host_blocked = callable(host_ok) and not host_ok()
+            if meta is not None:
+                meta["cause"] = ("storage_pin" if host_blocked
+                                 else "quarantine")
         elif self._breaker is None or self._breaker.allow():
             br = self._breaker
             t0 = time.perf_counter()
@@ -712,12 +755,26 @@ class Router:
                 if br is not None:
                     br.record_success(lat)
                 return proba
-            except Exception:
+            except Exception as e:
                 if br is not None:
                     br.record_failure(time.perf_counter() - t0)
                 self._c_score_err.inc(len(txs))
-        elif span is not None:
-            span.attrs["breaker_open"] = True
+                if meta is not None:
+                    # a watchdog kill is its own event class: the record
+                    # must say "this decision fell to a fallback tier
+                    # because the device dispatch was killed", not just
+                    # "an error happened"
+                    ev = ("watchdog_timeout"
+                          if type(e).__name__ == "ScorerTimeout"
+                          else "score_error")
+                    meta["events"].append(ev)
+                    meta["cause"] = meta["cause"] or ev
+        elif span is not None or meta is not None:
+            if span is not None:
+                span.attrs["breaker_open"] = True
+            if meta is not None:
+                meta["events"].append("breaker_open")
+                meta["cause"] = meta["cause"] or "breaker_open"
         if self._host_score is not None and not host_blocked:
             try:
                 proba = np.asarray(self._host_score(x), np.float32)
@@ -725,16 +782,20 @@ class Router:
                     self._c_degraded.inc(len(txs), labels={"tier": "host"})
                     if span is not None:
                         span.attrs["degraded"] = "host"
+                    if meta is not None:
+                        meta["tier"] = "host"
                     return proba
             except Exception:  # noqa: BLE001 - fall to the rules tier
                 pass
         self._c_degraded.inc(len(txs), labels={"tier": "rules"})
         if span is not None:
             span.attrs["degraded"] = "rules"
+        if meta is not None:
+            meta["tier"] = "rules"
         return self._rules_proba(x)
 
     def _score_direct(self, x: np.ndarray, txs: list,
-                      span=None) -> np.ndarray:
+                      span=None, meta=None) -> np.ndarray:
         """Legacy non-ladder path — but the heal gate still binds: a
         quarantined device must not see live rows even when the
         degradation ladder is off (``router.degrade: false`` CRs). With
@@ -745,21 +806,24 @@ class Router:
             if span is not None:
                 span.attrs["quarantined"] = True
                 span.attrs["degraded"] = "rules"
+            if meta is not None:
+                meta["tier"] = "rules"
+                meta["cause"] = "quarantine"
             self._c_degraded.inc(len(txs), labels={"tier": "rules"})
             return self._rules_proba(x)
         return self._score2(x, txs)
 
     def _score_batch(self, x: np.ndarray, txs: list,
-                     batch_span=None) -> np.ndarray:
+                     batch_span=None, meta=None) -> np.ndarray:
         if self.tracer is not None and batch_span is not None:
             with self.tracer.span("router.score",
                                   parent=batch_span.context) as sp:
                 if self._degrade:
-                    return self._score_tiered(x, txs, span=sp)
-                return self._score_direct(x, txs, span=sp)
+                    return self._score_tiered(x, txs, span=sp, meta=meta)
+                return self._score_direct(x, txs, span=sp, meta=meta)
         if self._degrade:
-            return self._score_tiered(x, txs)
-        return self._score_direct(x, txs)
+            return self._score_tiered(x, txs, meta=meta)
+        return self._score_direct(x, txs, meta=meta)
 
     # -- one synchronous cycle (used by tests and the run loop) ------------
     def step(self, poll_timeout_s: float = 0.0) -> int:
@@ -772,11 +836,12 @@ class Router:
         if not records:
             return 0
         batch_sp = None
+        meta = self._audit_meta(records)
         try:
             batch_sp = self._begin_batch_span(records)
             x, txs, ts = self._decode_batch(records, batch_sp)
             t0 = time.perf_counter()
-            proba = self._score_batch(x, txs, batch_sp)
+            proba = self._score_batch(x, txs, batch_sp, meta)
             score_s = time.perf_counter() - t0
             self._h_score_s.observe(
                 score_s,
@@ -789,7 +854,8 @@ class Router:
             if self._profiler is not None:
                 self._profiler.observe("router.score", dispatch_s=score_s,
                                        batch=len(txs), rows=len(txs))
-            return self._route(x, txs, proba, ts, batch_span=batch_sp)
+            return self._route(x, txs, proba, ts, batch_span=batch_sp,
+                               meta=meta)
         except BaseException:
             # a crashed batch is exactly the trace an operator needs:
             # error status forces the tail sampler's keep
@@ -802,7 +868,8 @@ class Router:
                 self.tracer.finish(batch_sp)
 
     def _route(self, x: np.ndarray, txs: list, proba: np.ndarray,
-               ts: np.ndarray | None = None, batch_span=None) -> int:
+               ts: np.ndarray | None = None, batch_span=None,
+               meta=None) -> int:
         route_sp = None
         if self.tracer is not None and batch_span is not None:
             route_sp = self.tracer.start("router.route",
@@ -811,14 +878,14 @@ class Router:
         try:
             if route_sp is None:
                 return self._route_inner(x, txs, proba, ts, batch_span,
-                                         route_sp)
+                                         route_sp, meta)
             # activate on THIS thread: the engine calls below (and the
             # notification records the engine produces inside them,
             # process/fraud.py notify) read current_context() to join the
             # trace — an unactivated span would orphan the engine/notify leg
             with self.tracer.activate(route_sp.context):
                 return self._route_inner(x, txs, proba, ts, batch_span,
-                                         route_sp)
+                                         route_sp, meta)
         finally:
             if self._profiler is not None:
                 self._profiler.observe(
@@ -828,7 +895,8 @@ class Router:
                 self.tracer.finish(route_sp)
 
     def _route_inner(self, x: np.ndarray, txs: list, proba: np.ndarray,
-                     ts: np.ndarray | None, batch_span, route_sp) -> int:
+                     ts: np.ndarray | None, batch_span, route_sp,
+                     meta=None) -> int:
         fired = self.rules.evaluate(x, proba)
         # group the micro-batch by fired rule: one batched process-start per
         # (rule, process) instead of one engine round-trip per transaction —
@@ -842,7 +910,18 @@ class Router:
         # constant factor IS the parallel fan-out's scaling ceiling.
         groups: dict[int, list[dict]] = {}
         rules = self.rules.rules
-        for tx, p, ridx in zip(txs, proba.tolist(), fired.tolist()):
+        plist = proba.tolist()
+        # audit plane armed: track each group's original row indices so a
+        # successful start stamps THAT row's tx/uid/priority/timestamp —
+        # and only successful starts (conservation: routed == recorded;
+        # a failed start is counted in router_process_start_errors_total,
+        # not in the provenance stream)
+        gidx: dict[int, list[int]] | None = \
+            {} if (self._audit is not None and meta is not None) else None
+        audit_rows: list[dict] = []
+        ts_list = (ts.tolist()
+                   if gidx is not None and ts is not None else None)
+        for i, (tx, p, ridx) in enumerate(zip(txs, plist, fired.tolist())):
             variables = {
                 "transaction": tx,
                 "proba": p,
@@ -856,6 +935,12 @@ class Router:
                 groups[ridx] = [variables]
             else:
                 g.append(variables)
+            if gidx is not None:
+                gi = gidx.get(ridx)
+                if gi is None:
+                    gidx[ridx] = [i]
+                else:
+                    gi.append(i)
         for ridx, vars_list in groups.items():
             rule = self.rules.rules[ridx]
             try:
@@ -889,6 +974,33 @@ class Router:
                 if route_sp is not None and "fraud" in rule.process:
                     # fraud-routed batches are always tail-sampled KEEP
                     route_sp.attrs["fraud"] = True
+                if gidx is not None:
+                    idx_list = gidx[ridx]
+                    for j, pid in enumerate(pids):
+                        if pid is None:
+                            continue
+                        i = idx_list[j]
+                        audit_rows.append({
+                            "tx": txs[i].get("id"),
+                            "uid": meta["uids"][i],
+                            "ts": ts_list[i] if ts_list is not None else None,
+                            "proba": plist[i],
+                            "rule": rule.name,
+                            "branch": rule.process,
+                            "pid": pid,
+                            "priority": meta["pris"][i],
+                        })
+        if audit_rows:
+            self._audit.record_batch(
+                audit_rows,
+                tier=meta.get("tier", "device"),
+                cause=meta.get("cause"),
+                events=tuple(meta.get("events", ())),
+                worker=self.worker_id,
+                trace_id=(batch_span.trace_id
+                          if batch_span is not None else None),
+                threshold=self.cfg.fraud_threshold,
+            )
         if ts is not None and len(ts):
             self._h_decision_s.observe_many(time.time() - ts)
         return len(txs)
@@ -1012,13 +1124,16 @@ class Router:
         """
         from concurrent.futures import ThreadPoolExecutor
 
-        def timed_score(x: np.ndarray, txs: list, batch_sp) -> np.ndarray:
+        def timed_score(x: np.ndarray, txs: list, batch_sp,
+                        meta) -> np.ndarray:
             # time INSIDE the worker so the histogram records the scorer
             # round trip, not dispatch + however long the loop polled.
-            # batch_sp rides along explicitly — the worker thread has no
-            # ambient trace context (contextvars are per-thread)
+            # batch_sp (and the audit meta) ride along explicitly — the
+            # worker thread has no ambient trace context (contextvars are
+            # per-thread), and batch-scoped audit state must never live
+            # on self while two batches are in flight
             t0 = time.perf_counter()
-            proba = self._score_batch(x, txs, batch_sp)
+            proba = self._score_batch(x, txs, batch_sp, meta)
             score_s = time.perf_counter() - t0
             self._h_score_s.observe(
                 score_s,
@@ -1032,7 +1147,7 @@ class Router:
             return proba
 
         def finish(pending: tuple) -> None:
-            pfut, px, ptxs, pts, psp = pending
+            pfut, px, ptxs, pts, psp, pmeta = pending
             try:
                 try:
                     proba = pfut.result()
@@ -1043,7 +1158,8 @@ class Router:
                     if psp is not None:
                         psp.status = "error"
                     return
-                self._route(px, ptxs, proba, pts, batch_span=psp)
+                self._route(px, ptxs, proba, pts, batch_span=psp,
+                            meta=pmeta)
             except BaseException:
                 if psp is not None:  # _route crashed: force-keep the trace
                     psp.status = "error"
@@ -1089,10 +1205,11 @@ class Router:
                 fut = None
                 if records:
                     batch_sp = None
+                    meta = self._audit_meta(records)
                     try:
                         batch_sp = self._begin_batch_span(records)
                         x, txs, ts = self._decode_batch(records, batch_sp)
-                        fut = ex.submit(timed_score, x, txs, batch_sp)
+                        fut = ex.submit(timed_score, x, txs, batch_sp, meta)
                     except BaseException:
                         # reserved rows must not leak out of a crashed
                         # loop (with a SHARED budget the leak would
@@ -1104,7 +1221,7 @@ class Router:
                             batch_sp.status = "error"
                             self.tracer.finish(batch_sp)
                         raise
-                done, pending = pending, ((fut, x, txs, ts, batch_sp)
+                done, pending = pending, ((fut, x, txs, ts, batch_sp, meta)
                                           if fut is not None else None)
                 if done is not None:
                     try:
@@ -1115,7 +1232,7 @@ class Router:
                         # (shared-budget leak-proofing), count it as
                         # dropped, and keep its trace
                         if pending is not None:
-                            _, _, ptxs, _, psp = pending
+                            _, _, ptxs, _, psp, _pm = pending
                             pending = None
                             self._budget.release(len(ptxs))
                             self._c_score_err.inc(len(ptxs))

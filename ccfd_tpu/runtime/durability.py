@@ -327,6 +327,41 @@ def parse_frame(data: bytes) -> tuple[bytes | None, bool]:
     return payload, True
 
 
+def scan_frames(data: bytes) -> tuple[list[tuple[int, bytes]], int, bool]:
+    """Streaming scan of CONCATENATED :func:`frame` blocks (append-only
+    logs, e.g. the decision-audit segments) -> ``([(start_offset,
+    payload), ...], valid_prefix_bytes, torn)``. Verification stops at
+    the first bad frame: in an append-only file everything after it
+    postdates the corruption and is unreachable — the caller truncates
+    to the valid prefix (the bus-log reopen contract). One scanner so
+    the frame format has a single owner (:func:`parse_frame` handles
+    the one-frame-per-file artifacts)."""
+    frames: list[tuple[int, bytes]] = []
+    pos = 0
+    n = len(data)
+    while pos < n:
+        if not data.startswith(MAGIC, pos):
+            return frames, pos, True
+        nl = data.find(b"\n", pos + len(MAGIC))
+        if nl < 0:
+            return frames, pos, True
+        try:
+            hexdigest, length = data[pos + len(MAGIC):nl].split()
+            length = int(length)
+        except ValueError:
+            return frames, pos, True
+        end = nl + 1 + length
+        if end > n:
+            return frames, pos, True
+        payload = data[nl + 1:end]
+        if hashlib.sha256(payload).hexdigest() != hexdigest.decode(
+                "ascii", "replace"):
+            return frames, pos, True
+        frames.append((pos, payload))
+        pos = end
+    return frames, pos, False
+
+
 def _generations(path: str) -> list[tuple[int, str]]:
     """Retained generations of ``path``, ascending ``[(seq, path)]``."""
     d = os.path.dirname(os.path.abspath(path))

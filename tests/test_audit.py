@@ -1,0 +1,511 @@
+"""Decision provenance plane (ISSUE 14; observability/audit.py).
+
+Ring + segmented-log mechanics (bounded eviction, rotation/retention,
+torn-tail truncation counting, recovery), the router's route-seam
+stamping (conservation, tier/cause truth under the degradation ladder and
+the storage pin), the per-batch lineage/incident joins, the incident
+bundle's v2 decisions embed, the exporter's strict-JSON /decisions
+contract over real HTTP (including the CCFD_AUDIT=0 kill switch), and the
+operator's default-on wiring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.exporter import MetricsExporter
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.audit import AuditLog, summarize
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.router import Router
+
+
+def _rows(rec_list):
+    """Minimal route-seam row dicts (what the router builds per routed tx)."""
+    return [
+        {"tx": f"tx-{i}", "uid": f"0:{i}", "ts": 100.0 + i,
+         "proba": 0.9, "rule": "fraud", "branch": "fraud", "pid": i,
+         "priority": "normal"}
+        for i in rec_list
+    ]
+
+
+class TestAuditLogCore:
+    def test_record_batch_stamps_batch_fields_once(self):
+        calls = {"lineage": 0, "incident": 0}
+
+        def lineage():
+            calls["lineage"] += 1
+            return (3, "abc123")
+
+        def incident():
+            calls["incident"] += 1
+            return "inc-0001-x"
+
+        log = AuditLog(lineage_fn=lineage, incident_fn=incident)
+        log.record_batch(_rows(range(8)), tier="device", worker=2,
+                         trace_id="t" * 32, threshold=0.5)
+        # batch-granular joins sampled ONCE, not per row
+        assert calls == {"lineage": 1, "incident": 1}
+        rec = log.get("tx-3")
+        assert rec["version"] == 3 and rec["hash"] == "abc123"
+        assert rec["incident"] == "inc-0001-x"
+        assert rec["tier"] == "device" and rec["threshold"] == 0.5
+        assert rec["worker"] == 2 and rec["trace"] == "t" * 32
+        assert rec["seq"] == 3 and rec["priority"] == "normal"
+        # uid lookup works too
+        assert log.get("0:3") == rec
+
+    def test_ring_bound_eviction_counted_and_index_cleaned(self):
+        reg = Registry()
+        log = AuditLog(max_records=4, registry=reg)
+        log.record_batch(_rows(range(10)))
+        assert log.ring_size == 4
+        assert log.get("tx-0") is None  # evicted, index cleaned
+        assert log.get("tx-9") is not None
+        assert reg.counter("ccfd_audit_dropped_total").value(
+            {"reason": "ring"}) == 6
+        assert reg.gauge("ccfd_audit_ring_records").value() == 4
+        assert reg.counter("ccfd_audit_records_total").value() == 10
+
+    def test_restamp_latest_wins(self):
+        log = AuditLog()
+        log.record_batch(_rows([1]), tier="device")
+        log.record_batch(_rows([1]), tier="rules")  # crash-replay re-drive
+        assert log.ring_size == 1
+        assert log.restamped == 1
+        assert log.get("tx-1")["tier"] == "rules"
+
+    def test_list_since_and_limit(self):
+        now = {"t": 1000.0}
+        log = AuditLog(clock=lambda: now["t"])
+        log.record_batch(_rows(range(4)))
+        now["t"] = 2000.0
+        log.record_batch(_rows(range(10, 13)))
+        assert len(log.list()) == 7
+        late = log.list(since=1500.0)
+        assert [d["tx"] for d in late] == ["tx-12", "tx-11", "tx-10"]
+        assert len(log.list(limit=2)) == 2
+        assert set(late[0]) <= set(
+            summarize(log.get("tx-12")).keys() | {"incident"})
+
+
+class TestSegmentedLog:
+    def test_flush_rotation_retention_and_bytes_gauge(self, tmp_path):
+        reg = Registry()
+        log = AuditLog(dir=str(tmp_path), registry=reg, segment_bytes=4096,
+                       retain_segments=2, fsync=False)
+        for i in range(6):
+            log.record_batch(_rows(range(i * 20, i * 20 + 20)))
+            assert log.flush() == 20
+        segs = [n for n in os.listdir(tmp_path) if n.startswith("audit-")]
+        # rotated past 4096 bytes/segment and pruned to the retained set
+        # (+1: the live segment the next append opens)
+        assert 1 <= len(segs) <= 3
+        assert reg.gauge("ccfd_audit_log_bytes").value() == sum(
+            os.path.getsize(tmp_path / n) for n in segs)
+
+    def test_recovery_rebuilds_ring_and_continues_seq(self, tmp_path):
+        log = AuditLog(dir=str(tmp_path), fsync=False)
+        log.record_batch(_rows(range(5)))
+        log.flush()
+        log2 = AuditLog(dir=str(tmp_path))
+        assert log2.ring_size == 5 and log2.recovered == 5
+        assert log2.get("tx-4")["proba"] == 0.9
+        log2.record_batch(_rows([99]))
+        assert log2.get("tx-99")["seq"] == 5  # monotone across restart
+
+    def test_torn_tail_truncated_and_counted(self, tmp_path):
+        reg = Registry()
+        log = AuditLog(dir=str(tmp_path), fsync=False)
+        log.record_batch(_rows(range(5)))
+        log.flush()
+        seg = os.path.join(str(tmp_path), "audit-00000000.log")
+        good = os.path.getsize(seg)
+        with open(seg, "ab") as f:
+            f.write(b"CCFDSUM1 " + b"00" * 32 + b" 999\npartial")
+        log2 = AuditLog(dir=str(tmp_path), registry=reg)
+        assert log2.truncated_frames == 1
+        assert reg.counter("ccfd_audit_dropped_total").value(
+            {"reason": "torn_tail"}) == 1
+        assert log2.ring_size == 5  # the valid prefix fully recovered
+        assert os.path.getsize(seg) == good  # truncated back to valid
+        # the repaired segment appends cleanly afterwards
+        log2.record_batch(_rows([50]))
+        log2.flush()
+        log3 = AuditLog(dir=str(tmp_path))
+        assert log3.get("tx-50") is not None and log3.truncated_frames == 0
+
+    def test_readonly_recovery_never_mutates(self, tmp_path):
+        log = AuditLog(dir=str(tmp_path), fsync=False)
+        log.record_batch(_rows(range(3)))
+        log.flush()
+        seg = os.path.join(str(tmp_path), "audit-00000000.log")
+        with open(seg, "ab") as f:
+            f.write(b"garbage-tail")
+        size = os.path.getsize(seg)
+        ro = AuditLog(dir=str(tmp_path), readonly=True)
+        assert ro.ring_size == 3 and ro.truncated_frames == 1
+        assert os.path.getsize(seg) == size  # inspection left disk alone
+
+    def test_failed_append_never_poisons_later_frames(self, tmp_path):
+        """A torn/short append from a LIVE process rolls the segment back
+        to its pre-append length: later successful frames must survive
+        the next recovery (recovery stops at the first bad frame, so a
+        lingering partial frame would silently destroy everything
+        appended after it)."""
+        from ccfd_tpu.runtime import faults
+
+        log = AuditLog(dir=str(tmp_path), fsync=False)
+        log.record_batch(_rows(range(3)))
+        log.flush()
+        log.record_batch(_rows(range(10, 13)))
+        plan = faults.StorageFaultPlan.from_string("torn_write", active=True)
+        faults.install_storage_faults(plan)
+        try:
+            assert log.flush() == 0  # failed append, partial rolled back
+        finally:
+            faults.install_storage_faults(None)
+        log.record_batch(_rows(range(20, 25)))
+        assert log.flush() == 5  # lands cleanly AFTER the failure
+        log2 = AuditLog(dir=str(tmp_path))
+        assert log2.truncated_frames == 0  # no torn bytes ever landed
+        assert log2.get("tx-2") is not None
+        assert log2.get("tx-22") is not None  # post-failure frame intact
+        assert log2.get("tx-11") is None  # the failed batch IS the loss
+
+    def test_write_failure_counted_ring_authoritative(self, tmp_path):
+        from ccfd_tpu.runtime import faults
+
+        reg = Registry()
+        log = AuditLog(dir=str(tmp_path), registry=reg, fsync=False)
+        log.record_batch(_rows(range(4)))
+        plan = faults.StorageFaultPlan.from_string("enospc", active=True)
+        faults.install_storage_faults(plan)
+        try:
+            assert log.flush() == 0
+        finally:
+            faults.install_storage_faults(None)
+        assert reg.counter("ccfd_audit_dropped_total").value(
+            {"reason": "log_write"}) == 4
+        assert log.get("tx-2") is not None  # ring stays authoritative
+
+
+def _pipeline(cfg, reg, audit, score_fn=None, **router_kw):
+    broker = Broker(default_partitions=2)
+    engine = build_engine(cfg, broker, Registry(), None)
+    if score_fn is None:
+        def score_fn(x):
+            return np.full(len(x), 0.9, np.float32)
+    router = Router(cfg, broker, score_fn, engine, reg, max_batch=256,
+                    audit=audit, **router_kw)
+    return broker, router
+
+
+def _pump(cfg, broker, router, n=32):
+    rows = [b"0.1," * 29 + b"5.0" for _ in range(n)]
+    broker.produce_batch(cfg.kafka_topic, rows,
+                         [f"tx-{i}" for i in range(n)])
+    while router.step() > 0:
+        pass
+
+
+class TestRouteSeam:
+    def test_one_record_per_routed_tx_device_tier(self):
+        cfg = Config()
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+        broker, router = _pipeline(cfg, reg, audit)
+        _pump(cfg, broker, router, n=48)
+        assert reg.counter("transaction_outgoing_total").total() == 48
+        assert reg.counter("ccfd_audit_records_total").value() == 48
+        rec = audit.get("tx-7")
+        assert rec["tier"] == "device" and "cause" not in rec
+        assert rec["uid"].count(":") == 1 and rec["pid"] is not None
+        assert rec["branch"] == "fraud" and rec["proba"] == pytest.approx(0.9)
+        assert rec["threshold"] == cfg.fraud_threshold
+        router.close()
+        broker.close()
+
+    def test_score_error_falls_to_host_tier_and_records_cause(self):
+        cfg = Config()
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+
+        def bad_score(x):
+            raise RuntimeError("edge down")
+
+        broker, router = _pipeline(
+            cfg, reg, audit, score_fn=bad_score, degrade=True,
+            host_score_fn=lambda x: np.full(len(x), 0.2, np.float32))
+        _pump(cfg, broker, router, n=8)
+        rec = audit.get("tx-1")
+        assert rec["tier"] == "host"
+        assert rec["cause"] == "score_error"
+        assert "score_error" in rec["events"]
+        router.close()
+        broker.close()
+
+    def test_storage_pin_stamps_rules_tier(self):
+        from ccfd_tpu.runtime.durability import StoragePinGate
+
+        cfg = Config()
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+        gate = StoragePinGate()
+        gate.pin("nothing verifies")
+        broker, router = _pipeline(
+            cfg, reg, audit, degrade=True,
+            host_score_fn=lambda x: np.full(len(x), 0.2, np.float32),
+            heal_gate=gate)
+        _pump(cfg, broker, router, n=8)
+        rec = audit.get("tx-1")
+        assert rec["tier"] == "rules" and rec["cause"] == "storage_pin"
+        router.close()
+        broker.close()
+
+    def test_quarantine_with_host_tier_stamps_cause(self):
+        class Gate:  # heal-shaped: device pinned, host allowed
+            def device_allowed(self):
+                return False
+
+        cfg = Config()
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+        broker, router = _pipeline(
+            cfg, reg, audit, degrade=True,
+            host_score_fn=lambda x: np.full(len(x), 0.2, np.float32),
+            heal_gate=Gate())
+        _pump(cfg, broker, router, n=8)
+        rec = audit.get("tx-1")
+        assert rec["tier"] == "host" and rec["cause"] == "quarantine"
+        router.close()
+        broker.close()
+
+    def test_failed_starts_not_recorded_conservation(self):
+        """A tx whose process start fails is counted in start_errors, NOT
+        in the provenance stream: recorded == routed exactly."""
+        cfg = Config()
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+
+        class FlakyEngine:
+            def __init__(self, inner):
+                self.inner = inner
+                self.n = 0
+
+            def definitions(self):
+                return self.inner.definitions()
+
+            def start_process(self, def_id, variables):
+                self.n += 1
+                if self.n % 4 == 0:
+                    raise RuntimeError("boom")
+                return self.inner.start_process(def_id, variables)
+
+            def signal(self, pid, name, payload=None):
+                return self.inner.signal(pid, name, payload)
+
+        broker = Broker(default_partitions=2)
+        engine = FlakyEngine(build_engine(cfg, broker, Registry(), None))
+        router = Router(cfg, broker,
+                        lambda x: np.full(len(x), 0.9, np.float32),
+                        engine, reg, max_batch=256, audit=audit)
+        _pump(cfg, broker, router, n=32)
+        routed = reg.counter("transaction_outgoing_total").total()
+        errs = reg.counter("router_process_start_errors_total").total()
+        assert errs > 0 and routed + errs == 32
+        assert reg.counter("ccfd_audit_records_total").value() == routed
+        router.close()
+        broker.close()
+
+
+class TestJoins:
+    def test_incident_bundle_embeds_decisions_schema_v2(self):
+        from ccfd_tpu.observability.incident import (
+            FlightRecorder,
+            validate_incident,
+        )
+
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+        audit.record_batch(_rows(range(20)))
+        rec = FlightRecorder({"router": reg}, registry=reg, ring=4,
+                             audit=audit)
+        doc = rec.incident({"type": "drill"})
+        assert doc["schema"] == "ccfd.incident.v2"
+        assert validate_incident(doc) == []
+        assert len(doc["decisions"]) == 16  # last N, newest first
+        assert doc["decisions"][0]["tx"] == "tx-19"
+        assert rec.last_incident_id() == doc["id"]
+        # a malformed embed is NAMED by the validator
+        bad = dict(doc)
+        bad["decisions"] = [{"no_seq": 1}]
+        assert any("decisions[0]" in e for e in validate_incident(bad))
+
+    def test_open_incident_gated_on_breaching(self):
+        """The operator's join: records carry the newest bundle id ONLY
+        while the SLO engine reports a breaching objective."""
+        from ccfd_tpu.observability.incident import FlightRecorder
+
+        reg = Registry()
+        audit = AuditLog(registry=reg)
+        recorder = FlightRecorder({"router": reg}, registry=reg, audit=audit)
+
+        class Eng:
+            breaching = False
+
+            def any_breaching(self):
+                return self.breaching
+
+        eng = Eng()
+
+        def open_incident():
+            if not eng.any_breaching():
+                return None
+            return recorder.last_incident_id()
+
+        audit.incident_fn = open_incident
+        recorder.incident({"type": "drill"})
+        audit.record_batch(_rows([1]))
+        assert "incident" not in audit.get("tx-1")  # green: no link
+        eng.breaching = True
+        audit.record_batch(_rows([2]))
+        assert audit.get("tx-2")["incident"] == recorder.last_incident_id()
+
+    def test_slo_engine_any_breaching_default_false(self):
+        from ccfd_tpu.observability.slo import SLOEngine
+
+        reg = Registry()
+        eng = SLOEngine.from_config(Config(), {"router": reg}, reg)
+        eng.tick()
+        assert eng.any_breaching() is False
+
+
+class TestExporterContract:
+    def _exporter(self, audit):
+        return MetricsExporter({"audit": Registry()}, audit=audit).start()
+
+    def test_decisions_list_fetch_404_over_http(self):
+        audit = AuditLog()
+        audit.record_batch(_rows(range(6)))
+        ex = self._exporter(audit)
+        try:
+            base = ex.endpoint
+            with urllib.request.urlopen(base + "/decisions",
+                                        timeout=10) as resp:
+                assert "application/json" in resp.headers["Content-Type"]
+                listing = json.loads(resp.read().decode())
+            assert [d["tx"] for d in listing["decisions"][:2]] == [
+                "tx-5", "tx-4"]
+            with urllib.request.urlopen(base + "/decisions?limit=2",
+                                        timeout=10) as resp:
+                assert len(json.loads(resp.read().decode())
+                           ["decisions"]) == 2
+            with urllib.request.urlopen(base + "/decisions/tx-3",
+                                        timeout=10) as resp:
+                rec = json.loads(resp.read().decode())
+            assert rec == audit.get("tx-3")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/decisions/nope", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            ex.stop()
+
+    def test_kill_switch_404s_both_endpoints(self):
+        ex = self._exporter(None)  # CCFD_AUDIT=0: no AuditLog wired
+        try:
+            for path in ("/decisions", "/decisions/tx-1"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(ex.endpoint + path, timeout=10)
+                assert ei.value.code == 404
+        finally:
+            ex.stop()
+
+
+class TestOperatorWiring:
+    CR = {"spec": {
+        "store": {"enabled": False},
+        "bus": {"partitions": 2},
+        "scorer": {"enabled": True, "model": "mlp", "train_steps": 0},
+        "engine": {"enabled": True},
+        "notify": {"enabled": False},
+        "router": {"enabled": True},
+        "retrain": {"enabled": False},
+        "producer": {"enabled": False},
+        "monitoring": {"enabled": True},
+        "health": {"enabled": False},
+        "analytics": {"enabled": False},
+        "heal": {"enabled": False},
+        "incident": {"enabled": False},
+    }}
+
+    def test_default_on_routes_land_at_decisions(self, tmp_path):
+        import time
+
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        cr = json.loads(json.dumps(self.CR))
+        cr["spec"]["audit"] = {"dir": str(tmp_path / "audit"),
+                               "flush_interval_s": 0.05}
+        cfg = Config()
+        p = Platform(PlatformSpec.from_cr(cr, cfg=cfg)).up(wait_ready_s=20.0)
+        try:
+            assert p.audit is not None
+            # the router pool stamps into the shared log
+            rows = [b"0.1," * 29 + b"5.0" for _ in range(16)]
+            p.broker.produce_batch(cfg.kafka_topic, rows,
+                                   [f"tx-{i}" for i in range(16)])
+            deadline = time.monotonic() + 10
+            reg = p.registries["router"]
+            while time.monotonic() < deadline:
+                if reg.counter("transaction_outgoing_total").total() >= 16:
+                    break
+                time.sleep(0.05)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and p.audit.get("tx-3") is None:
+                time.sleep(0.05)
+            base = p.exporter.endpoint
+            with urllib.request.urlopen(base + "/decisions/tx-3",
+                                        timeout=10) as resp:
+                rec = json.loads(resp.read().decode())
+            assert rec["tier"] == "device" and rec["branch"] == "fraud"
+            # lifecycle join wired: the champion's version+hash stamped
+            if p.lifecycle is not None:
+                champ = p.lifecycle.store.champion()
+                assert rec["version"] == champ.version
+                assert rec["hash"] == champ.checkpoint_hash
+            # the supervised flusher landed segments on disk
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    not os.listdir(tmp_path / "audit"):
+                time.sleep(0.05)
+            assert os.listdir(tmp_path / "audit")
+        finally:
+            p.down()
+
+    def test_kill_switch_disables_plane(self):
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        cfg = Config(audit_enabled=False)  # CCFD_AUDIT=0
+        p = Platform(PlatformSpec.from_cr(
+            json.loads(json.dumps(self.CR)), cfg=cfg)).up(wait_ready_s=20.0)
+        try:
+            assert p.audit is None
+            router = (p.router.workers[0]
+                      if hasattr(p.router, "workers") else p.router)
+            assert router._audit is None
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    p.exporter.endpoint + "/decisions", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            p.down()

@@ -118,6 +118,13 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_storage_tmp_swept_total",
     "ccfd_storage_log_truncated_records_total",
     "ccfd_storage_pinned",
+    # round 17: decision provenance plane (observability/audit.py) —
+    # per-transaction records stamped at the route seam, drop accounting,
+    # the segmented log footprint and the bounded query ring
+    "ccfd_audit_records_total",
+    "ccfd_audit_dropped_total",
+    "ccfd_audit_log_bytes",
+    "ccfd_audit_ring_records",
 ]
 
 
@@ -136,7 +143,7 @@ def test_dashboards_cover_contract_metrics():
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
         "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
-        "Heal", "Storage",
+        "Heal", "Storage", "Audit",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

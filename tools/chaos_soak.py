@@ -324,6 +324,12 @@ def main() -> int:
                     "the pool ends on a single consistent model version")
     ap.add_argument("--lifecycle-submit-s", type=float, default=15.0,
                     help="seconds between candidate submissions")
+    ap.add_argument("--audit", action="store_true",
+                    help="arm the decision-provenance plane "
+                    "(observability/audit.py): every routed tx stamps a "
+                    "DecisionRecord through kill-storms; the ok-gate "
+                    "requires exact conservation (routed == recorded) "
+                    "and that re-stamps only appear with crash restores")
     args = ap.parse_args()
     if args.storage_faults:
         # the end-of-run hash-parity claim (serving fingerprint ==
@@ -479,6 +485,28 @@ def main() -> int:
                                            args.deadline_ms * 0.8 / 1e3)
         overload.recorder = recorder
     degrade = True if (args.net_faults or args.device_faults) else None
+    # -- decision-provenance plane (--audit, ISSUE 14) ---------------------
+    # One shared AuditLog across the whole pool: the ok-gate folds the
+    # conservation claim (every routed tx stamped exactly once — counter
+    # equality survives kill-storms because the stamp happens at the same
+    # seam as transaction_outgoing_total) into the soak's accounting.
+    decision_audit = None
+    audit_flusher = None
+    if args.audit:
+        from ccfd_tpu.observability.audit import AuditLog  # noqa: E402
+
+        decision_audit = AuditLog(
+            dir=tempfile.mkdtemp(prefix="ccfd_soak_audit_"),
+            registry=reg_r)
+        # the flusher runs for the WHOLE soak (the production shape: the
+        # operator supervises it) — pending records drain to segments
+        # every tick instead of accumulating in memory for the run, so
+        # segment rotation and the failed-append accounting are actually
+        # drilled under the storm
+        audit_flusher = threading.Thread(
+            target=lambda: decision_audit.run(interval_s=0.25),
+            daemon=True, name="soak-audit-flush")
+        audit_flusher.start()
     if args.workers > 1:
         # partition-parallel fan-out: the workers split the topic's
         # partitions, share ONE in-flight budget + breaker + coalescing
@@ -492,13 +520,13 @@ def main() -> int:
             max_batch=4096, host_score_fn=host_fn,
             breaker=lifecycle_breaker,
             degrade=degrade,
-            overload=overload)
+            overload=overload, audit=decision_audit)
     else:
         router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
                         host_score_fn=host_fn,
                         breaker=lifecycle_breaker,
                         degrade=degrade,
-                        overload=overload)
+                        overload=overload, audit=decision_audit)
     # -- device self-healing under storms (--device-faults, ISSUE 11) ------
     # The DeviceSupervisor owns the soak's scorer: device-fault storms
     # (scheduled below, interleaved with the service kills) must drive the
@@ -993,6 +1021,32 @@ def main() -> int:
     unaudited = active_now - acct["open_at_end"]
     acct_ok = not acct["violation_count"] and not ghost and not unaudited
 
+    # decision-record conservation (--audit): every routed tx stamped
+    # exactly ONCE — the recorded counter must equal the outgoing counter
+    # through every kill/restore, and duplicates (re-stamps of the same
+    # bus coordinate) are only legal when a crash restore re-drove records
+    audit_res: dict = {}
+    if decision_audit is not None:
+        decision_audit.stop()
+        if audit_flusher is not None:
+            audit_flusher.join(timeout=10)
+        decision_audit.flush()
+        routed_total = int(reg_r.counter(
+            "transaction_outgoing_total").total())
+        recorded_total = int(reg_r.counter(
+            "ccfd_audit_records_total").value())
+        a_counts = decision_audit.counts()
+        audit_res = {
+            "routed": routed_total,
+            "recorded": recorded_total,
+            "conserved": routed_total == recorded_total,
+            "restamped": a_counts["restamped"],
+            "ring": a_counts["ring"],
+            "truncated_frames": a_counts["truncated_frames"],
+            "dropped_log_write": int(reg_r.counter(
+                "ccfd_audit_dropped_total").value({"reason": "log_write"})),
+        }
+
     kills: dict[str, int] = {}
     for _ts, name in monkey.history:
         kills[name] = kills.get(name, 0) + 1
@@ -1092,6 +1146,7 @@ def main() -> int:
                 if s.get("reason") == "dispatch_timeout"),
         },
         "lifecycle": lifecycle_res,
+        "audit": audit_res,
         # device heal evidence (runtime/heal.py): each storm cycle must
         # have quarantined, healed and re-promoted WARM
         "device_heal": {
@@ -1158,6 +1213,19 @@ def main() -> int:
         and ("bus" not in targets
              or (result["bus_kills"] > 0 and broker.crash_restarts > 0))
         and acct_ok
+        and (
+            not args.audit
+            or (
+                # decision-record conservation through the storm: routed
+                # == recorded exactly, nothing silently lost to the audit
+                # disk, and re-stamped coordinates only where a crash
+                # restore legitimately re-drove the stream
+                audit_res.get("conserved", False)
+                and audit_res.get("dropped_log_write", 0) == 0
+                and (audit_res.get("restamped", 0) == 0
+                     or coord.restores > 0)
+            )
+        )
         and (
             not args.lifecycle
             or (

@@ -1,10 +1,11 @@
-"""Render a ccfd.incident.v1 bundle into the human post-mortem summary.
+"""Render a ccfd.incident.v2 bundle into the human post-mortem summary.
 
 The FlightRecorder (observability/incident.py) dumps machine-readable
 incident bundles; this tool is the responder's first read — what
 breached, how hard it was burning, which layer/stage ate the latency,
-what the breakers/overload plane/device were doing, and how much flight
-data the ring holds.
+what the breakers/overload plane/device were doing, WHICH transactions
+were in flight (the decision-record embed, schema v2), and how much
+flight data the ring holds.
 
     python tools/incident_report.py <bundle.json>          # from disk
     python tools/incident_report.py --url http://host:9100 # newest bundle
@@ -113,6 +114,18 @@ def render(doc: dict) -> str:
     for device, kinds in mem.items():
         lines.append(f"  device {device}: " + ", ".join(
             f"{k}={v}" for k, v in kinds.items()))
+    decisions = doc.get("decisions") or []
+    if decisions:
+        lines.append(f"  in-flight decisions ({len(decisions)}, newest "
+                     "first):")
+        for d in decisions[:8]:
+            inc = f"  incident={d['incident']}" if d.get("incident") else ""
+            ver = (f" v{d['version']}" if d.get("version") is not None
+                   else "")
+            lines.append(
+                f"    tx={d.get('tx')} uid={d.get('uid')} "
+                f"p={d.get('proba'):.4f} -> {d.get('branch')} "
+                f"[{d.get('tier')}{ver}]{inc}")
     ring = doc.get("ring", [])
     reasons: dict[str, int] = {}
     for s in ring:
@@ -148,6 +161,7 @@ def main(argv=None) -> int:
             "valid": not errs,
             "errors": errs[:10],
             "ring_depth": len(doc.get("ring", [])),
+            "decisions": len(doc.get("decisions") or []),
             "slos": {n: s.get("breaching")
                      for n, s in doc.get("slo_status", {})
                      .get("slos", {}).items()},

@@ -101,6 +101,23 @@
 #                                          ccfd_storage_* gauges over
 #                                          real HTTP:
 #                                          STORAGESMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --audit-smoke    exit-code-gated smoke of the
+#                                          decision-provenance plane
+#                                          (tools/audit_smoke.py): live
+#                                          traffic stamps one Decision-
+#                                          Record per routed tx (routed
+#                                          == recorded, 0 duplicates,
+#                                          armed overhead within CI
+#                                          noise); after a torn-tail
+#                                          crash + restore, `ccfd_tpu
+#                                          audit <tx_id>` reconstructs a
+#                                          pre-crash fraud decision with
+#                                          checkpoint hash == lineage
+#                                          champion hash, device tier
+#                                          and open-incident linkage
+#                                          intact; /decisions + counters
+#                                          over real HTTP:
+#                                          AUDITSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -185,6 +202,18 @@ if [ "${1:-}" = "--storage-smoke" ]; then
     # tools/storage_smoke.py; prints STORAGESMOKE verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/storage_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--audit-smoke" ]; then
+    # exit-code-gated smoke of the decision-provenance plane: crash-
+    # restore reconstruction by tx id, conservation, hash parity with
+    # the lineage, incident linkage, /decisions over real HTTP (see
+    # tools/audit_smoke.py; prints AUDITSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/audit_smoke.py; then
         exit 0
     fi
     exit 1
