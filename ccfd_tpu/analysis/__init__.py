@@ -1,0 +1,37 @@
+"""ccfd-lint: the repo's review findings as machine-checked invariants.
+
+Fourteen PRs of review hardening kept re-finding the same defect classes
+by hand: persistent writers bypassing the durability seam (PR 13's whole
+motivation), ``time.time()`` pairs used as durations (the PR 2 NTP-step
+bug), silent drops that never touched a counter (the "no silent caps"
+invariant), breaker paths recording zero or two outcomes, host syncs on
+the device hot path, and lock inversions that only live drills caught
+(PR 8's eviction-stamp race, PR 12's publish-gate leak). The repo's
+conventions are structured enough to check mechanically (PRETZEL's
+white-box thesis applied to correctness tooling), so this package turns
+each class into a named rule over Python ``ast``:
+
+- :mod:`ccfd_tpu.analysis.core` — rule registry, per-line suppression
+  pragmas (``# ccfd-lint: disable=<rule> -- why``), a checked-in baseline
+  for grandfathered findings, human + strict-JSON reports.
+- :mod:`ccfd_tpu.analysis.rules` — the seven invariants (see each rule's
+  ``invariant`` string for the PR that motivated it).
+- :mod:`ccfd_tpu.analysis.lockcheck` — the runtime half of the lock-order
+  rule: ``CCFD_LOCKCHECK=1`` wraps ``threading.Lock``/``RLock`` so the
+  per-thread acquisition-order graph is recorded live and a cycle fails
+  the process instead of deadlocking a drill three PRs later.
+
+Run via ``ccfd_tpu lint`` (gated in ``tools/verify_tier1.sh --lint``).
+This package must stay importable without jax: the lint gate and the
+lock sanitizer both run in contexts (CI shells, conftest bootstrap)
+where initializing an accelerator backend is wrong.
+"""
+
+from ccfd_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintReport,
+    Rule,
+    lint_sources,
+    load_baseline,
+    run_lint,
+)

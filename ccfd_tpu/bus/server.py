@@ -127,13 +127,19 @@ class BrokerServer:
         self._g_retained = r.gauge(
             "bus_topic_retained_records", "retained records by topic/partition"
         )
-        self._g_trimmed = r.gauge(
+        # true counters (ccfd-lint metric-naming: a *_total gauge reads as
+        # a broken counter to rate()/increase()): published as DELTAS of
+        # the broker's monotonic tallies at scrape time, so a broker
+        # crash_restart mid-soak reads as a flat spot, not a reset
+        self._c_trimmed = r.counter(
             "bus_records_trimmed_total", "records deleted by retention"
         )
-        self._g_oor = r.gauge(
+        self._c_oor = r.counter(
             "bus_offset_out_of_range_resets_total",
             "fetches/rewinds clamped to the log start",
         )
+        self._last_trimmed = 0
+        self._last_oor = 0
 
     def refresh_health_gauges(self) -> None:
         """Publish per-topic end offsets and per-group backlog (lag) the way
@@ -152,10 +158,17 @@ class BrokerServer:
                 if begins is not None:
                     self._g_start_offset.set(begins[p], labels=labels)
                     self._g_retained.set(end - begins[p], labels=labels)
-        if hasattr(self.broker, "records_trimmed"):
-            self._g_trimmed.set(self.broker.records_trimmed)
-        if hasattr(self.broker, "oor_resets"):
-            self._g_oor.set(self.broker.oor_resets)
+        # delta fold under the server lock: two concurrent scrapes racing
+        # the read-inc-update sequence would double-count a delta
+        with self._lock:
+            if hasattr(self.broker, "records_trimmed"):
+                cur = int(self.broker.records_trimmed)
+                self._c_trimmed.inc(max(0, cur - self._last_trimmed))
+                self._last_trimmed = cur
+            if hasattr(self.broker, "oor_resets"):
+                cur = int(self.broker.oor_resets)
+                self._c_oor.inc(max(0, cur - self._last_oor))
+                self._last_oor = cur
         for g, tps in groups.items():
             lag_by_topic: dict[str, int] = {}
             for (tname, p), committed in tps.items():

@@ -146,9 +146,16 @@ class AuditLog:
         self.recovered = 0      # records rebuilt from the log at bring-up
         self.truncated_frames = 0  # torn frames dropped at recovery
         self._stop = threading.Event()
-        self._c_records = self._c_dropped = None
+        self._c_records = self._c_dropped = self._c_join_err = None
         self._g_log_bytes = self._g_ring = None
         if registry is not None:
+            self._c_join_err = registry.counter(
+                "ccfd_audit_join_errors_total",
+                "provenance-join probe failures by source (lineage/"
+                "incident): the records still land, but WITHOUT that "
+                "join — a regulator reconstruction would come back "
+                "partial, so the gap must be visible while it happens",
+            )
             self._c_records = registry.counter(
                 "ccfd_audit_records_total",
                 "decision records stamped at the route seam (one per "
@@ -298,13 +305,15 @@ class AuditLog:
             try:
                 ver, hsh = self.lineage_fn()
             except Exception:  # noqa: BLE001 - provenance must not crash routing
-                pass
+                if self._c_join_err is not None:
+                    self._c_join_err.inc(labels={"source": "lineage"})
         inc = None
         if self.incident_fn is not None:
             try:
                 inc = self.incident_fn()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - provenance must not crash routing
+                if self._c_join_err is not None:
+                    self._c_join_err.inc(labels={"source": "incident"})
         thr = threshold
         now = self._clock()
         ev = list(events) if events else None
@@ -389,6 +398,7 @@ class AuditLog:
             from ccfd_tpu.runtime import faults
 
             plan = faults.storage_faults()
+        # ccfd-lint: disable=counted-drops -- nothing dropped: only the fault-INJECTION overlay is absent; the append below proceeds unfaulted
         except Exception:  # noqa: BLE001 - fault plumbing must not block audit
             plan = None
 

@@ -270,8 +270,8 @@ class StageProfiler:
         self._compile_stages: dict[str, LatencyDigest] = {}
         self._compile_armed = False
         self.registry = registry
-        self._g_stage = self._c_compile = self._g_compile_s = None
-        self._g_compile_stage_s = None
+        self._g_stage = self._c_compile = self._c_compile_s = None
+        self._c_compile_stage_s = None
         if registry is not None:
             self._g_stage = registry.gauge(
                 "ccfd_stage_latency_ms",
@@ -284,11 +284,15 @@ class StageProfiler:
                 "(jax.monitoring hook; a mid-traffic compile explains a "
                 "stage p99 spike)",
             )
-            self._g_compile_s = registry.gauge(
+            # true counters (ccfd-lint metric-naming): a *_total gauge
+            # set() out of order moves the series backwards, which
+            # rate()/increase() reads as a counter reset — inc() under
+            # the compile lock is monotonic by construction
+            self._c_compile_s = registry.counter(
                 "ccfd_xla_compile_seconds_total",
                 "cumulative wall seconds spent in XLA backend compiles",
             )
-            self._g_compile_stage_s = registry.gauge(
+            self._c_compile_stage_s = registry.counter(
                 "ccfd_compile_stage_seconds_total",
                 "cumulative XLA backend-compile seconds attributed to the "
                 "stage that triggered them (compile_stage labels; "
@@ -361,6 +365,7 @@ class StageProfiler:
         if not self._compile_armed:
             try:
                 import jax.monitoring as monitoring
+            # ccfd-lint: disable=counted-drops -- capability probe: no jax.monitoring means compile attribution is off, reported via the False return
             except Exception:  # noqa: BLE001 - profile without jax works
                 return False
             global _COMPILE_HOOK_REGISTERED
@@ -368,6 +373,7 @@ class StageProfiler:
                 try:
                     monitoring.register_event_duration_secs_listener(
                         _on_compile_event)
+                # ccfd-lint: disable=counted-drops -- capability probe: older jax without the hook, reported via the False return
                 except Exception:  # noqa: BLE001 - older jax, no hook
                     return False
                 _COMPILE_HOOK_REGISTERED = True
@@ -383,14 +389,10 @@ class StageProfiler:
             if d is None:
                 d = self._compile_stages[stage] = LatencyDigest()
             d.add(float(secs))
-            # the *_total gauges publish under the same lock that computed
-            # them: two concurrent compiles setting out of order would
-            # move a cumulative series BACKWARDS, which rate()/increase()
-            # reads as a counter reset
             if self._c_compile is not None:
                 self._c_compile.inc()
-                self._g_compile_s.set(self._compile.sum)
-                self._g_compile_stage_s.set(d.sum,
+                self._c_compile_s.inc(float(secs))
+                self._c_compile_stage_s.inc(float(secs),
                                             labels={"stage": stage})
 
     def compile_counts(self) -> dict[str, int]:
@@ -436,6 +438,7 @@ class StageProfiler:
                 m = reg.get(name)
                 if m is not None and hasattr(m, "total"):
                     out[name] = m.total()
+        # ccfd-lint: disable=counted-drops -- read-side export fallback: the overload section is simply absent from /profile, which the reader sees
         except Exception:  # noqa: BLE001 - profile export must never 500
             pass
         return out

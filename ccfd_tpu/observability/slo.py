@@ -258,6 +258,12 @@ class SLOEngine:
             "ccfd_slo_breaching",
             "1 while the SLO's fast-window pair is above threshold",
         )
+        self._c_listener_err = r.counter(
+            "ccfd_slo_listener_errors_total",
+            "breach-listener callbacks that raised: the breach evaluated, "
+            "but its evidence capture (flight recorder, planner hook) "
+            "did not run",
+        )
 
     # -- construction helpers ---------------------------------------------
     @staticmethod
@@ -392,7 +398,7 @@ class SLOEngine:
                 try:
                     fn(name, out)
                 except Exception:  # noqa: BLE001 - evidence capture must
-                    pass           # never fail the evaluation loop
+                    self._c_listener_err.inc()  # never fail the evaluation
         return out
 
     def breaches(self, slo: str) -> int:

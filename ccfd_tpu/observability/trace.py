@@ -241,6 +241,11 @@ class SpanSink:
                                    "traces currently held for /traces")
         self._g_pending = r.gauge("ccfd_traces_pending",
                                   "traces awaiting a sampling decision")
+        self._c_listener_err = r.counter(
+            "ccfd_trace_listener_errors_total",
+            "span-listener callbacks that raised (the span still lands; "
+            "the listener — profiler ingestion, incident taps — missed it)",
+        )
 
     # -- ingestion ---------------------------------------------------------
     def add_listener(self, fn) -> None:
@@ -254,7 +259,7 @@ class SpanSink:
             try:
                 fn(span)
             except Exception:  # noqa: BLE001 - listener bug must not drop spans
-                pass
+                self._c_listener_err.inc()
         self._c_spans.inc(labels={"component": span.component})
         with self._lock:
             retained = self._retained.get(span.trace_id)

@@ -79,6 +79,7 @@ def fold_for_kernel(params: Mapping[str, Any]) -> dict[str, jax.Array]:
     }
 
 
+# ccfd-lint: hot-path
 def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, out_ref):
     x = x_ref[:].astype(jnp.bfloat16)
     h = jnp.dot(x, w1_ref[:].astype(jnp.bfloat16), preferred_element_type=jnp.float32)
@@ -102,6 +103,7 @@ def pad_features(x: jax.Array) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("tile", "interpret"))
+# ccfd-lint: hot-path
 def fused_mlp_score(
     kernel_params: Mapping[str, jax.Array],
     x: jax.Array,

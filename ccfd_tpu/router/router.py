@@ -37,6 +37,7 @@ semantics hold: a scorer failure drops that batch, counted.
 from __future__ import annotations
 
 import contextlib
+import logging
 import operator
 import threading
 import time
@@ -426,6 +427,12 @@ class Router:
             "scorer-edge failures: transactions dropped, or absorbed by "
             "degraded tiers when the ladder is on",
         )
+        self._c_host_err = r.counter(
+            "router_host_score_errors_total",
+            "host-tier numpy-forward failures while the ladder was "
+            "already degraded (the fall continues to the rules tier); "
+            "its own family so the device-edge series stays label-uniform",
+        )
         # -- degradation ladder (see module docstring) ---------------------
         self._host_score = host_score_fn
         self._degrade = (degrade if degrade is not None
@@ -629,6 +636,7 @@ class Router:
             # bus queueing delay: how long this batch's rows waited on the
             # topic before the poll (mean across the batch — the component
             # that sums with service/dispatch to the decision latency)
+            # ccfd-lint: disable=monotonic-durations -- record timestamps are wall-clock by contract (cross-process); max(0,...) clamps an NTP step
             queue_s = max(0.0, time.time() - float(ts.mean()))
             if batch_span is not None:
                 # ride the span too: the profiler's span-ingestion path
@@ -786,7 +794,10 @@ class Router:
                         meta["tier"] = "host"
                     return proba
             except Exception:  # noqa: BLE001 - fall to the rules tier
-                pass
+                # a host-forward failure was invisible before: the ladder
+                # fell straight through and only the rules-tier counter
+                # moved, so "host tier is broken" never had its own signal
+                self._c_host_err.inc(len(txs))
         self._c_degraded.inc(len(txs), labels={"tier": "rules"})
         if span is not None:
             span.attrs["degraded"] = "rules"
@@ -956,6 +967,7 @@ class Router:
                             pids.append(
                                 self.engine.start_process(rule.process, variables)
                             )
+                        # ccfd-lint: disable=counted-drops -- the None sentinel is counted below (n_err -> router_process_start_errors_total)
                         except Exception:
                             pids.append(None)
             except Exception:
@@ -1002,6 +1014,7 @@ class Router:
                 threshold=self.cfg.fraud_threshold,
             )
         if ts is not None and len(ts):
+            # ccfd-lint: disable=monotonic-durations -- produce stamps are wall-clock record timestamps (cross-process decision latency)
             self._h_decision_s.observe_many(time.time() - ts)
         return len(txs)
 
@@ -1065,7 +1078,9 @@ class Router:
             try:
                 getattr(self, attr).close()
             except Exception:  # noqa: BLE001 - a dead consumer is fine here
-                pass
+                logging.getLogger("ccfd_tpu.router").debug(
+                    "stale consumer %s failed to close during recycle",
+                    attr, exc_info=True)
             setattr(self, attr, self.broker.consumer(group, topics))
 
     def set_heal_gate(self, gate: Any) -> None:

@@ -18,10 +18,13 @@ its ticket abandoned — and the pool refuses new device work once
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from typing import Any, Callable
+
+log = logging.getLogger("ccfd_tpu.dispatch")
 
 
 class ScorerTimeout(Exception):
@@ -78,6 +81,7 @@ class DeviceDispatcher:
                 continue
             try:
                 ticket.result = fn()
+            # ccfd-lint: disable=counted-drops -- not a drop: ticket.error re-raises at the waiter in call()
             except BaseException as e:  # noqa: BLE001 - delivered to waiter
                 ticket.error = e
             ticket.done.set()
@@ -157,7 +161,8 @@ class WedgeMonitor:
             try:
                 self.on_change(True)
             except Exception:  # noqa: BLE001 - observer must not break serving
-                pass
+                log.warning("wedge observer raised on mark_wedged",
+                            exc_info=True)
 
     def _clear(self) -> None:
         with self._lock:
@@ -166,8 +171,8 @@ class WedgeMonitor:
         if was and self.on_change is not None:
             try:
                 self.on_change(False)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - observer must not break serving
+                log.warning("wedge observer raised on clear", exc_info=True)
 
     def _probe_loop(self) -> None:
         while True:
@@ -183,6 +188,7 @@ class WedgeMonitor:
             except ScorerTimeout:
                 time.sleep(self._probe_interval_s)
                 continue
+            # ccfd-lint: disable=counted-drops -- a failing probe is the wedged steady state, already exported via the wedge gauge; per-interval logs would spam
             except Exception:  # noqa: BLE001 - a failing probe is not recovery
                 time.sleep(self._probe_interval_s)
                 continue

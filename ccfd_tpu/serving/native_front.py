@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import logging
 import threading
 import time
 
@@ -531,7 +532,10 @@ class NativeFront:
                 # Retry-After); the C++ responder has no header channel,
                 # so the extra headers ride only in the JSON body here
                 status, ctype, resp = res[0], res[1], res[2]
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - fail the request, not the loop
+                logging.getLogger("ccfd_tpu.native_front").warning(
+                    "misc handler raised for %s %s; answered 500",
+                    method, path, exc_info=True)
                 status, ctype, resp = 500, "text/plain", b"internal error"
             self._lib.ccfd_front_respond_misc(
                 handle, req_id, status, ctype.encode(), resp, len(resp)

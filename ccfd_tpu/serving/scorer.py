@@ -30,6 +30,7 @@ follows the latency plan in SURVEY.md §7 "hard parts":
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Any, Sequence
@@ -747,7 +748,12 @@ class Scorer:
                 try:
                     fn(host_tree)
                 except Exception:  # noqa: BLE001 - must not break swaps
-                    pass
+                    # a listener that can't take the new tree (the shadow
+                    # tap, the native host model) is now serving STALE
+                    # params — that must be visible, not silent
+                    logging.getLogger("ccfd_tpu.scorer").warning(
+                        "swap listener %r raised; it may be serving stale "
+                        "params", fn, exc_info=True)
 
     def add_swap_listener(self, fn: Any) -> None:
         """``fn(host_params_numpy_tree)`` runs after every ``swap_params``."""
@@ -843,6 +849,7 @@ class Scorer:
                 try:
                     out = self._fused_dispatch(fused_params, chunk,
                                                preq_norm)
+                # ccfd-lint: disable=counted-drops -- _disable_fused logs the failure with its latch decision; the request then scores on the XLA path
                 except Exception as e:  # noqa: BLE001 - first dispatch of a
                     # swap-re-enabled kernel compiles HERE, not at warmup;
                     # a lowering failure must degrade this request to the

@@ -14,6 +14,18 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# CCFD_LOCKCHECK=1 arms the runtime lock-order sanitizer BEFORE anything
+# constructs a lock: every threading.Lock/RLock created by ccfd_tpu code
+# from here on records its acquisition order, and an inversion raises
+# LockOrderError at the acquire that closes the cycle (analysis/
+# lockcheck.py — the dynamic half of the lock-order lint rule). The
+# import is deliberately pre-jax and jax-free.
+_LOCKCHECK_GRAPH = None
+if os.environ.get("CCFD_LOCKCHECK"):
+    from ccfd_tpu.analysis import lockcheck as _lockcheck
+
+    _LOCKCHECK_GRAPH = _lockcheck.install()
+
 import jax  # noqa: E402
 
 # The axon (TPU-tunnel) plugin's site hook force-updates jax_platforms to
@@ -36,3 +48,17 @@ def dataset():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_gate():
+    """With CCFD_LOCKCHECK=1, fail the session if any lock-order
+    inversion was recorded — including ones swallowed by worker threads
+    whose LockOrderError never reached a test."""
+    yield
+    if _LOCKCHECK_GRAPH is not None:
+        v = _LOCKCHECK_GRAPH.violations
+        assert not v, (
+            f"lock-order inversions recorded during the run: "
+            f"{[x['cycle'] for x in v]}"
+        )

@@ -1,0 +1,557 @@
+"""ccfd-lint: per-rule positive/negative fixtures, pragma + baseline
+round-trip, strict-JSON schema, and the runtime lock-order sanitizer
+(deliberate inversion caught; healthy ordering silent)."""
+
+import json
+import threading
+
+import pytest
+
+from ccfd_tpu.analysis import core as lint_core
+from ccfd_tpu.analysis import lockcheck
+from ccfd_tpu.analysis.rules import metric_name_ok
+
+
+def run_rule(rule, src, path="ccfd_tpu/serving/fake_mod.py", extra=None):
+    """Finding list for one rule over a virtual source file."""
+    sources = {path: src}
+    if extra:
+        sources.update(extra)
+    report = lint_core.lint_sources(sources, rule_names=[rule])
+    return report.findings
+
+
+# -- rule 1: durability-seam -------------------------------------------------
+
+class TestDurabilitySeam:
+    def test_flags_open_write_rename_jsondump_savez(self):
+        src = (
+            "import json, os\n"
+            "import numpy as np\n"
+            "def save(path, doc, arr):\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump(doc, f)\n"
+            "    os.replace(path + '.tmp', path)\n"
+            "    np.savez(path, arr=arr)\n"
+        )
+        rules_hit = [f.line for f in run_rule("durability-seam", src)]
+        assert rules_hit == [4, 5, 6, 7]
+
+    def test_read_mode_and_seam_module_pass(self):
+        src = "def load(path):\n    return open(path).read()\n"
+        assert run_rule("durability-seam", src) == []
+        write = "import os\ndef sw(a, b):\n    os.replace(a, b)\n"
+        assert run_rule("durability-seam", write,
+                        path="ccfd_tpu/runtime/durability.py") == []
+
+    def test_savez_into_bytesio_buffer_is_sanctioned(self):
+        src = (
+            "import io\n"
+            "import numpy as np\n"
+            "def save(arr):\n"
+            "    buf = io.BytesIO()\n"
+            "    np.savez(buf, arr=arr)\n"
+            "    return buf.getvalue()\n"
+        )
+        assert run_rule("durability-seam", src) == []
+
+
+# -- rule 2: monotonic-durations ---------------------------------------------
+
+class TestMonotonicDurations:
+    def test_flags_time_time_pair(self):
+        src = (
+            "import time\n"
+            "def work():\n"
+            "    t0 = time.time()\n"
+            "    do()\n"
+            "    return time.time() - t0\n"
+        )
+        fs = run_rule("monotonic-durations", src)
+        assert [f.line for f in fs] == [5]
+
+    def test_flags_two_wall_names(self):
+        src = (
+            "import time\n"
+            "def work(rec):\n"
+            "    a = time.time()\n"
+            "    b = time.time()\n"
+            "    return b - a\n"
+        )
+        assert len(run_rule("monotonic-durations", src)) == 1
+
+    def test_perf_counter_and_plain_timestamps_pass(self):
+        src = (
+            "import time\n"
+            "def work(record):\n"
+            "    t0 = time.perf_counter()\n"
+            "    do()\n"
+            "    record['ts'] = time.time()\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert run_rule("monotonic-durations", src) == []
+
+
+# -- rule 3: counted-drops ---------------------------------------------------
+
+class TestCountedDrops:
+    def test_flags_silent_broad_swallow(self):
+        src = (
+            "def drain(self):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        fs = run_rule("counted-drops", src,
+                      path="ccfd_tpu/router/fake.py")
+        assert [f.line for f in fs] == [4]
+
+    def test_counter_log_raise_and_future_delivery_pass(self):
+        src = (
+            "def a(self):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        self._c_dropped.inc()\n"
+            "def b(self):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        log.warning('dropped', exc_info=True)\n"
+            "def c(self):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        raise\n"
+            "def d(self, fut):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception as e:\n"
+            "        fut.set_exception(e)\n"
+        )
+        assert run_rule("counted-drops", src,
+                        path="ccfd_tpu/bus/fake.py") == []
+
+    def test_narrow_catches_and_foreign_modules_out_of_scope(self):
+        src = (
+            "def a(self):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except (OSError, ValueError):\n"
+            "        pass\n"
+        )
+        assert run_rule("counted-drops", src,
+                        path="ccfd_tpu/serving/fake.py") == []
+        broad = src.replace("(OSError, ValueError)", "Exception")
+        # runtime/ has its own noqa-documented swallow conventions
+        assert run_rule("counted-drops", broad,
+                        path="ccfd_tpu/runtime/fake.py") == []
+
+
+# -- rule 4: metric-naming ---------------------------------------------------
+
+class TestMetricNaming:
+    def test_flags_bad_kinds(self):
+        src = (
+            "def build(r):\n"
+            "    r.counter('things_done')\n"
+            "    r.gauge('events_total')\n"
+            "    r.histogram('latency')\n"
+        )
+        fs = run_rule("metric-naming", src)
+        assert [f.line for f in fs] == [2, 3, 4]
+
+    def test_convention_and_reference_names_pass(self):
+        src = (
+            "def build(r):\n"
+            "    r.counter('things_done_total')\n"
+            "    r.gauge('queue_depth')\n"
+            "    r.histogram('latency_seconds')\n"
+            "    r.histogram('fraud_approved_amount')\n"
+            "    r.gauge('proba_1')\n"  # ModelPrediction.json reference name
+        )
+        assert run_rule("metric-naming", src) == []
+
+    def test_helper_is_shared_contract(self):
+        assert metric_name_ok("counter", "x_total") is None
+        assert metric_name_ok("counter", "x") is not None
+        assert metric_name_ok("gauge", "x_total") is not None
+        assert metric_name_ok("histogram", "x_seconds") is None
+        assert metric_name_ok("gauge", "proba_1") is None  # reference
+
+
+# -- rule 5: breaker-outcome -------------------------------------------------
+
+class TestBreakerOutcome:
+    def test_flags_gated_call_with_zero_outcomes(self):
+        src = (
+            "def call(self):\n"
+            "    if not self._breaker.allow():\n"
+            "        raise ConnectionError\n"
+            "    return do()\n"
+        )
+        fs = run_rule("breaker-outcome", src)
+        assert len(fs) == 1 and "never" in fs[0].message
+
+    def test_flags_missing_failure_path(self):
+        src = (
+            "def call(self):\n"
+            "    if not self._breaker.allow():\n"
+            "        raise ConnectionError\n"
+            "    out = do()\n"
+            "    self._breaker.record_success(0.0)\n"
+            "    return out\n"
+        )
+        fs = run_rule("breaker-outcome", src)
+        assert len(fs) == 1 and "record_failure" in fs[0].message
+
+    def test_flags_double_record_on_one_path(self):
+        src = (
+            "def call(self):\n"
+            "    if not self._breaker.allow():\n"
+            "        raise ConnectionError\n"
+            "    try:\n"
+            "        out = do()\n"
+            "    except Exception:\n"
+            "        self._breaker.record_failure(0.0)\n"
+            "        raise\n"
+            "    self._breaker.record_success(0.0)\n"
+            "    self._breaker.record_success(0.0)\n"
+            "    return out\n"
+        )
+        fs = run_rule("breaker-outcome", src)
+        assert any("two breaker outcomes" in f.message for f in fs)
+
+    def test_balanced_gate_passes(self):
+        src = (
+            "def call(self):\n"
+            "    if not self._breaker.allow():\n"
+            "        raise ConnectionError\n"
+            "    try:\n"
+            "        out = do()\n"
+            "    except Exception:\n"
+            "        self._breaker.record_failure(0.0)\n"
+            "        raise\n"
+            "    self._breaker.record_success(0.0)\n"
+            "    return out\n"
+        )
+        assert run_rule("breaker-outcome", src) == []
+
+
+# -- rule 6: hot-path-sync ---------------------------------------------------
+
+class TestHotPathSync:
+    def test_flags_syncs_only_in_marked_functions(self):
+        src = (
+            "import numpy as np\n"
+            "# ccfd-lint: hot-path\n"
+            "def hot(dev):\n"
+            "    x = np.asarray(dev)\n"
+            "    y = dev.item()\n"
+            "    z = float(dev)\n"
+            "    return x, y, z\n"
+            "def cold(dev):\n"
+            "    return np.asarray(dev)\n"
+        )
+        fs = run_rule("hot-path-sync", src)
+        assert [f.line for f in fs] == [4, 5, 6]
+
+    def test_clean_hot_path_passes(self):
+        src = (
+            "# ccfd-lint: hot-path\n"
+            "def hot(dev, fn):\n"
+            "    return fn(dev)\n"
+        )
+        assert run_rule("hot-path-sync", src) == []
+
+
+# -- rule 7: lock-order (static) ---------------------------------------------
+
+class TestLockOrderStatic:
+    def test_lexical_inversion_flagged(self):
+        src = (
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            with self._mu:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self._mu:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+        )
+        fs = run_rule("lock-order", src)
+        assert len(fs) == 1 and "cycle" in fs[0].message
+
+    def test_consistent_order_passes(self):
+        src = (
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            with self._mu:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self._lock:\n"
+            "            with self._mu:\n"
+            "                pass\n"
+        )
+        assert run_rule("lock-order", src) == []
+
+    def test_multi_item_with_records_the_order(self):
+        """`with a, b:` acquires a then b — an inversion against that
+        order must be flagged exactly like the nested form."""
+        src = (
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._lock, self._mu:\n"
+            "            pass\n"
+            "    def g(self):\n"
+            "        with self._mu:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+        )
+        fs = run_rule("lock-order", src)
+        assert len(fs) == 1 and "cycle" in fs[0].message
+
+
+# -- suppression pragmas + baseline round-trip -------------------------------
+
+class TestSuppressionAndBaseline:
+    SRC = (
+        "import time\n"
+        "def work():\n"
+        "    t0 = time.time()\n"
+        "    return time.time() - t0\n"
+    )
+
+    def test_inline_pragma_with_justification_suppresses(self):
+        src = self.SRC.replace(
+            "    return time.time() - t0\n",
+            "    # ccfd-lint: disable=monotonic-durations -- wall-clock by contract\n"
+            "    return time.time() - t0\n",
+        )
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": src},
+                                        rule_names=["monotonic-durations"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+        assert report.exit_code == 0
+
+    def test_bare_pragma_is_itself_a_finding(self):
+        src = self.SRC.replace(
+            "    return time.time() - t0\n",
+            "    return time.time() - t0  # ccfd-lint: disable=monotonic-durations\n",
+        )
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": src},
+                                        rule_names=["monotonic-durations"])
+        assert [f.rule for f in report.findings] == ["bare-pragma"]
+
+    def test_file_level_disable(self):
+        src = ("# ccfd-lint: disable-file=monotonic-durations -- fixture\n"
+               + self.SRC)
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": src},
+                                        rule_names=["monotonic-durations"])
+        assert report.findings == []
+
+    def test_pragma_inside_string_literal_is_inert(self):
+        """Help text or a docstring DOCUMENTING the pragma syntax must
+        never act as a live suppression (pragmas are comments only)."""
+        src = (
+            'HELP = "# ccfd-lint: disable-file=monotonic-durations -- doc"\n'
+            + self.SRC)
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": src},
+                                        rule_names=["monotonic-durations"])
+        assert len(report.findings) == 1
+
+    def test_baseline_round_trip(self, tmp_path):
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": self.SRC},
+                                        rule_names=["monotonic-durations"])
+        assert report.exit_code == 1
+        path = str(tmp_path / "baseline.json")
+        lint_core.write_baseline(path, report.findings)
+        baseline = lint_core.load_baseline(path)
+        again = lint_core.lint_sources({"ccfd_tpu/x.py": self.SRC},
+                                       rule_names=["monotonic-durations"],
+                                       baseline=baseline)
+        assert again.exit_code == 0
+        assert len(again.baselined) == 1 and again.findings == []
+
+    def test_baseline_key_survives_line_drift(self):
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": self.SRC},
+                                        rule_names=["monotonic-durations"])
+        drifted = lint_core.lint_sources(
+            {"ccfd_tpu/x.py": "import os\n\n\n" + self.SRC.replace(
+                "import time\n", "import time  # moved\n")},
+            rule_names=["monotonic-durations"])
+        assert report.findings[0].key() == drifted.findings[0].key()
+        assert report.findings[0].line != drifted.findings[0].line
+
+    def test_missing_baseline_reads_empty(self, tmp_path):
+        assert lint_core.load_baseline(str(tmp_path / "nope.json")) == {}
+
+    def test_malformed_baseline_entry_raises_value_error(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"version": 1,
+                                 "findings": [{"rule": "x"}]}))  # no key
+        with pytest.raises(ValueError, match="key"):
+            lint_core.load_baseline(str(p))
+
+    def test_nonexistent_lint_target_is_an_error(self, tmp_path):
+        """A typo'd target must fail the gate, never scan zero files and
+        report a clean tree."""
+        with pytest.raises(ValueError, match="matched no python files"):
+            lint_core.run_lint(str(tmp_path), paths=["no/such/dir"])
+
+    def test_write_baseline_is_idempotent_over_grandfathered(self, tmp_path):
+        """Regenerating the baseline must see findings the CURRENT
+        baseline grandfathers — filtering first would empty the file on
+        the second consecutive --write-baseline run (the CLI lints with
+        baseline_path=None for exactly this reason)."""
+        path = str(tmp_path / "baseline.json")
+        report = lint_core.lint_sources({"ccfd_tpu/x.py": self.SRC},
+                                        rule_names=["monotonic-durations"])
+        lint_core.write_baseline(path, report.findings)
+        n1 = len(lint_core.load_baseline(path))
+        # the regeneration path: lint WITHOUT the baseline, then write
+        again = lint_core.lint_sources({"ccfd_tpu/x.py": self.SRC},
+                                       rule_names=["monotonic-durations"],
+                                       baseline=None)
+        lint_core.write_baseline(path, again.findings)
+        assert len(lint_core.load_baseline(path)) == n1 == 1
+
+
+# -- strict-JSON report schema ----------------------------------------------
+
+def test_json_report_schema():
+    report = lint_core.lint_sources({
+        "ccfd_tpu/x.py": TestSuppressionAndBaseline.SRC,
+    })
+    doc = json.loads(json.dumps(report.to_json()))  # must be JSON-clean
+    assert doc["version"] == lint_core.LINT_SCHEMA_VERSION
+    assert doc["tool"] == "ccfd-lint"
+    assert isinstance(doc["files_scanned"], int)
+    rule_names = {r["name"] for r in doc["rules"]}
+    assert rule_names == {
+        "durability-seam", "monotonic-durations", "counted-drops",
+        "metric-naming", "breaker-outcome", "hot-path-sync", "lock-order",
+    }
+    for r in doc["rules"]:
+        assert r["invariant"] and r["motivated_by"]
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "snippet", "key"}
+        assert isinstance(f["line"], int) and f["line"] >= 1
+    assert set(doc["counts"]) == {"active", "suppressed", "baselined"}
+    assert doc["exit"] in (0, 1)
+    assert doc["exit"] == 1  # the fixture has a real finding
+
+
+def test_repo_tree_is_lint_clean():
+    """The merge bar: the shipped tree lints clean with an EMPTY baseline
+    (every grandfathered site is a justified inline pragma instead)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline = lint_core.load_baseline(
+        os.path.join(root, "tools", "lint_baseline.json"))
+    assert baseline == {}, "the baseline must stay empty — fix or justify inline"
+    report = lint_core.run_lint(root)
+    assert report.parse_errors == []
+    assert report.findings == [], "\n".join(report.human_lines())
+
+
+# -- runtime lock-order sanitizer --------------------------------------------
+
+class TestLockcheckRuntime:
+    def test_deliberate_inversion_raises(self):
+        g = lockcheck.LockGraph(raise_on_cycle=True)
+        a = g.wrap(lockcheck.raw_lock(), "a")
+        b = g.wrap(lockcheck.raw_lock(), "b")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(lockcheck.LockOrderError):
+                a.acquire()
+            # the refused lock must NOT be left held behind the raise
+            assert not a.locked()
+        assert len(g.violations) == 1
+        assert set(g.violations[0]["cycle"][:2]) <= {"a", "b"}
+        # detection is NOT one-shot: a repeat of the same inversion (the
+        # first raise may have been swallowed by a broad except) must
+        # re-detect and re-raise, never ride the known-edge fast path
+        # into the real deadlock
+        with b:
+            with pytest.raises(lockcheck.LockOrderError):
+                a.acquire()
+        assert len(g.violations) == 2
+
+    def test_consistent_order_and_reentrancy_silent(self):
+        g = lockcheck.LockGraph(raise_on_cycle=True)
+        a = g.wrap(lockcheck.raw_lock(), "a")
+        b = g.wrap(lockcheck.raw_lock(), "b")
+        r = g.wrap(lockcheck.raw_rlock(), "r")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        with r:
+            with r:  # RLock reentry: no self-edge
+                with a:
+                    pass
+        assert g.violations == []
+
+    def test_inversion_across_threads_detected(self):
+        g = lockcheck.LockGraph(raise_on_cycle=False)
+        a = g.wrap(lockcheck.raw_lock(), "a")
+        b = g.wrap(lockcheck.raw_lock(), "b")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        with b:
+            with a:  # opposite order, but never concurrent: STILL flagged
+                pass
+        assert len(g.violations) == 1
+
+    def test_condition_wait_keeps_bookkeeping_consistent(self):
+        g = lockcheck.LockGraph(raise_on_cycle=True)
+        lk = g.wrap(lockcheck.raw_lock(), "cond-lock")
+        cond = threading.Condition(lk)
+        hit = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                hit.append(True)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        for _ in range(100):
+            with cond:
+                cond.notify_all()
+            if hit:
+                break
+            threading.Event().wait(0.01)
+        th.join(timeout=5)
+        assert hit and g.violations == []
+
+    def test_install_uninstall_round_trip(self):
+        if lockcheck.installed():
+            pytest.skip("globally armed (CCFD_LOCKCHECK run): the global "
+                        "graph must not be torn down mid-session")
+        graph = lockcheck.install()
+        try:
+            assert lockcheck.installed()
+            lk = threading.Lock()  # constructed from tests/ -> out of scope
+            assert not isinstance(lk, lockcheck._CheckedLock)
+            assert lockcheck.violations() == []
+        finally:
+            lockcheck.uninstall()
+        assert not lockcheck.installed()
+        assert threading.Lock is lockcheck._REAL_LOCK

@@ -303,30 +303,36 @@ _EXTERNAL_METRICS = re.compile(
 )
 
 
-def _registered_metric_names() -> set[str]:
-    """Every metric name the codebase registers, by static scan: the
-    registry factory calls plus direct metric constructions."""
+def _registered_metric_kinds() -> dict[str, set[str]]:
+    """Metric name -> registered kind(s), by static scan: the registry
+    factory calls plus direct metric constructions."""
     import os
 
     pkg = os.path.join(os.path.dirname(__file__), "..", "ccfd_tpu")
     pat = re.compile(
-        r"(?:\.(?:counter|gauge|histogram)|\b(?:Counter|Gauge|Histogram))\(\s*"
+        r"(?:\.(counter|gauge|histogram)|\b(Counter|Gauge|Histogram))\(\s*"
         r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]"
     )
-    names: set[str] = set()
+    kinds: dict[str, set[str]] = {}
     for root, _dirs, files in os.walk(pkg):
         for fn in files:
             if fn.endswith(".py"):
                 with open(os.path.join(root, fn)) as f:
-                    names.update(pat.findall(f.read()))
+                    for method, cls, name in pat.findall(f.read()):
+                        kinds.setdefault(name, set()).add(
+                            (method or cls).lower())
     # registered through a named constant, not a literal, so the literal
     # scan can't see it — import the authoritative name instead
     from ccfd_tpu.metrics.prom import LABELSETS_DROPPED
 
-    names.add(LABELSETS_DROPPED)
+    kinds.setdefault(LABELSETS_DROPPED, set()).add("counter")
     # native-code observers fold into histograms registered in Python, so
     # the scan above is the full set
-    return names
+    return kinds
+
+
+def _registered_metric_names() -> set[str]:
+    return set(_registered_metric_kinds())
 
 
 def test_every_dashboard_expr_metric_is_exported():
@@ -355,6 +361,41 @@ def test_every_dashboard_expr_metric_is_exported():
         "dashboard exprs reference metrics nothing exports: "
         f"{unknown[:10]}"
     )
+
+
+def test_contract_metrics_obey_naming_conventions():
+    """ccfd-lint rule 4 folded into the contract test: every metric the
+    dashboard contract names must satisfy the naming conventions the
+    linter enforces — counters end _total, histograms carry a unit
+    suffix, gauges never claim _total — under the kind(s) the codebase
+    ACTUALLY registers it as (scanned from the registration sites, never
+    inferred from the name: suffix-derived kinds would make the counter
+    check circular). One shared validator (analysis/rules.metric_name_ok)
+    so the test suite and the lint gate cannot drift apart."""
+    from ccfd_tpu.analysis.rules import (
+        GRANDFATHERED_NAMES,
+        REFERENCE_BOARD_NAMES,
+        metric_name_ok,
+    )
+
+    kinds = _registered_metric_kinds()
+    bad = []
+    for name in REFERENCE_CONTRACT_METRICS:
+        registered_kinds = kinds.get(name)
+        assert registered_kinds, f"contract metric {name} never registered"
+        for kind in sorted(registered_kinds):
+            err = metric_name_ok(kind, name)
+            if err:
+                bad.append(err)
+    assert not bad, bad
+    # the exemption lists must name (kind, metric) pairs the codebase
+    # actually registers — a dead grandfather entry would silently
+    # re-admit a future misnamed metric under a stale name
+    stale = {(k, n) for k, n in GRANDFATHERED_NAMES
+             if k not in kinds.get(n, set())}
+    stale |= {("gauge", n) for n in REFERENCE_BOARD_NAMES
+              if "gauge" not in kinds.get(n, set())}
+    assert not stale, f"exemption entries nothing registers: {stale}"
 
 
 def test_cli_demo_smoke(capsys):

@@ -330,7 +330,22 @@ def main() -> int:
                     "DecisionRecord through kill-storms; the ok-gate "
                     "requires exact conservation (routed == recorded) "
                     "and that re-stamps only appear with crash restores")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="arm the runtime lock-order sanitizer (analysis/"
+                    "lockcheck.py; CCFD_LOCKCHECK=1 implies it): every "
+                    "lock ccfd_tpu constructs records its acquisition "
+                    "order through the kill-storm, and ANY recorded "
+                    "inversion fails the soak — the ccfd-lint lock-order "
+                    "rule's dynamic half, under real chaos")
     args = ap.parse_args()
+    lock_graph = None
+    if args.lockcheck or os.environ.get("CCFD_LOCKCHECK"):
+        from ccfd_tpu.analysis import lockcheck as _lockcheck
+
+        # record-don't-raise: a soak must run to its accounting walk and
+        # report, not die mid-storm — the ok-gate below fails on any
+        # recorded inversion
+        lock_graph = _lockcheck.install(raise_on_cycle=False)
     if args.storage_faults:
         # the end-of-run hash-parity claim (serving fingerprint ==
         # lineage champion checkpoint_hash) needs the lineage running
@@ -1179,6 +1194,13 @@ def main() -> int:
             # through the same registry the exporter scrapes
             "breaker_gauge_exported": "ccfd_breaker_state" in reg_r.render(),
         },
+        "lockcheck": {
+            "enabled": lock_graph is not None,
+            "violations": (len(lock_graph.violations)
+                           if lock_graph is not None else 0),
+            "cycles": ([v["cycle"] for v in lock_graph.violations]
+                       if lock_graph is not None else []),
+        },
         "accounting": {
             "starts": acct["starts"],
             "completes": acct["completes"],
@@ -1200,6 +1222,7 @@ def main() -> int:
     fr = result["flight_recorder"]
     ok = (
         total > 0
+        and (lock_graph is None or not lock_graph.violations)
         and wedge_info.get("device_path_recovered", False)
         # a watchdog kill without a ring snapshot would be exactly the
         # un-post-mortem-able kill ISSUE 10 closes

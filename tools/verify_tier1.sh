@@ -19,6 +19,26 @@
 #   tools/verify_tier1.sh                  run the suite, then tally
 #   tools/verify_tier1.sh --parse-only F   tally an existing log file F
 #                                          (used by tests/test_verify_tier1.py)
+#   tools/verify_tier1.sh --lint           machine-checked invariant gate
+#                                          (`ccfd_tpu lint`, ccfd_tpu/
+#                                          analysis/): AST rules encoding
+#                                          14 PRs of review findings —
+#                                          durability-seam, monotonic-
+#                                          durations, counted-drops,
+#                                          metric-naming, breaker-outcome,
+#                                          hot-path-sync, lock-order.
+#                                          Exit non-zero on any
+#                                          unsuppressed finding:
+#                                          LINT verdict=PASS|FAIL
+#   tools/verify_tier1.sh --lint-smoke     runtime lock-order sanitizer
+#                                          deflake gate (CCFD_LOCKCHECK=1,
+#                                          analysis/lockcheck.py): the
+#                                          lint + parallel-router suites
+#                                          and a short kill-storm chaos
+#                                          soak with every ccfd_tpu lock
+#                                          order-checked must stay
+#                                          violation-free:
+#                                          LINTSMOKE verdict=PASS|FAIL
 #   tools/verify_tier1.sh --overload-smoke run the traffic-shape SLO
 #                                          harness's short flash-crowd
 #                                          regime (tools/load_shape.py)
@@ -122,6 +142,44 @@ set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
+
+if [ "${1:-}" = "--lint" ]; then
+    # machine-checked invariant gate (ccfd_tpu/analysis/): exit non-zero
+    # on ANY unsuppressed, unbaselined finding. jax-free by design — this
+    # gate must run even when the accelerator attachment is wedged.
+    cd "$REPO_DIR" || exit 2
+    if python -m ccfd_tpu lint; then
+        echo "LINT verdict=PASS"
+        exit 0
+    fi
+    echo "LINT verdict=FAIL"
+    exit 1
+fi
+
+if [ "${1:-}" = "--lint-smoke" ]; then
+    # dynamic half of the lock-order rule: the healthy tree must stay
+    # SILENT under the sanitizer — (a) the parallel-router suite (the
+    # densest real lock interleavings: coalesced dispatch, pause
+    # barriers, crash recycle) and (b) a short kill-storm chaos soak,
+    # both with every ccfd_tpu lock order-checked. A deliberate
+    # inversion failing is tests/test_lint.py's job; this gate proves
+    # the absence of false positives where it matters.
+    cd "$REPO_DIR" || exit 2
+    if ! CCFD_LOCKCHECK=1 JAX_PLATFORMS=cpu python -m pytest \
+            tests/test_lint.py tests/test_parallel_router.py \
+            -o addopts= -q -p no:cacheprovider; then
+        echo "LINTSMOKE verdict=FAIL stage=lockcheck-pytest"
+        exit 1
+    fi
+    if ! JAX_PLATFORMS=cpu python tools/chaos_soak.py --lockcheck \
+            --seconds 30 --wedge-s 4 --chaos-interval-s 6 \
+            --checkpoint-s 1.5; then
+        echo "LINTSMOKE verdict=FAIL stage=lockcheck-soak"
+        exit 1
+    fi
+    echo "LINTSMOKE verdict=PASS"
+    exit 0
+fi
 
 if [ "${1:-}" = "--overload-smoke" ]; then
     # exit-code-gated smoke of the overload plane: a 5x flash crowd must
