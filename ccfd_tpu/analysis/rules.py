@@ -177,7 +177,8 @@ class MonotonicDurationsRule(Rule):
 
 # -- rule 3: counted-drops ---------------------------------------------------
 
-_DROP_SCOPES = ("/router/", "/bus/", "/serving/", "/observability/")
+_DROP_SCOPES = ("/router/", "/bus/", "/serving/", "/observability/",
+                "/fleet/")
 _LOG_METHODS = frozenset(
     ("debug", "info", "warning", "error", "exception", "critical", "log"))
 
@@ -213,8 +214,8 @@ def _body_accounts(handler: ast.ExceptHandler) -> bool:
 class CountedDropsRule(Rule):
     name = "counted-drops"
     invariant = ("no silent caps: a broad except that drops work in "
-                 "router/bus/serving/observability must re-raise, log via "
-                 "slog, or increment a *_total counter")
+                 "router/bus/serving/observability/fleet must re-raise, "
+                 "log via slog, or increment a *_total counter")
     motivated_by = ("recurring since PR 1; PR 6 made it the overload "
                     "plane's core guarantee (every shed is counted by "
                     "priority) and reviews still kept finding bare "

@@ -112,6 +112,27 @@ class _Partition:
         return n
 
 
+class StaleEpochError(RuntimeError):
+    """A manual commit was fenced: it carried a group epoch older than the
+    group's current rebalance epoch, or named a partition the committer no
+    longer owns. The Kafka analog is a ``CommitFailedError`` after a
+    generation change — a member whose partitions were re-assigned (death,
+    join, fence) must NOT be able to move the group's committed offsets,
+    or the new owner's position silently jumps past records it never saw
+    (a drop) or behind records it already routed (a double-route)."""
+
+    def __init__(self, group_id: str, epoch: int, current_epoch: int,
+                 detail: str = ""):
+        msg = (f"stale epoch {epoch} for group {group_id!r} "
+               f"(current {current_epoch})")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.group_id = group_id
+        self.epoch = epoch
+        self.current_epoch = current_epoch
+
+
 class _Topic:
     def __init__(self, name: str, n_partitions: int,
                  bases: list[int] | None = None):
@@ -179,6 +200,11 @@ class Broker:
         self._topics: dict[str, _Topic] = {}
         self._groups: dict[str, dict[tuple[str, int], int]] = {}  # group -> {(t,p): offset}
         self._members: dict[str, list["Consumer"]] = {}
+        # group -> rebalance epoch (Kafka's group generation): bumped on
+        # EVERY membership change, including down to zero members, so a
+        # commit from a member that was fenced out can never match
+        self._group_epochs: dict[str, int] = {}
+        self.fenced_commits = 0  # lifetime count of refused stale commits
         self._lock = threading.Lock()
         self._data_ready = threading.Condition(self._lock)
         self.retention_records = retention_records or None
@@ -273,9 +299,15 @@ class Broker:
             self._since_retention.clear()
             self._open_and_replay_log()
             # surviving members are clients reconnecting to the restarted
-            # broker: re-register their topics and rebalance each group
+            # broker: re-register their topics and rebalance each group.
+            # Manual fetch positions are dropped wholesale — a torn-tail
+            # truncation may have shortened the log below a position, and
+            # a stale position above the replayed end would silently skip
+            # records produced at those slots after restart; resuming from
+            # the (replay-clamped) committed offset is the safe cut.
             for g, members in self._members.items():
                 for m in members:
+                    m._positions.clear()
                     for tname in m.topics:
                         self._topic(tname)
                 self._rebalance(g)
@@ -445,14 +477,26 @@ class Broker:
             return len(values)
 
     # -- consume ----------------------------------------------------------
-    def consumer(self, group_id: str, topics: Iterable[str]) -> "Consumer":
+    def consumer(self, group_id: str, topics: Iterable[str],
+                 auto_commit: bool = True) -> "Consumer":
+        """``auto_commit=False`` gives manual-commit (at-least-once)
+        semantics: poll advances a private per-consumer position, and
+        nothing moves the group's committed offset until
+        :meth:`Consumer.commit` — which is epoch-fenced against
+        rebalances (see :class:`StaleEpochError`)."""
         with self._lock:
             for t in topics:
                 self._topic(t)
-            c = Consumer(self, group_id, tuple(topics))
+            c = Consumer(self, group_id, tuple(topics),
+                         auto_commit=auto_commit)
             self._members.setdefault(group_id, []).append(c)
             self._rebalance(group_id)
             return c
+
+    def group_epoch(self, group_id: str) -> int:
+        """Current rebalance epoch for a group (0 = never had a member)."""
+        with self._lock:
+            return self._group_epochs.get(group_id, 0)
 
     def _close(self, consumer: "Consumer") -> None:
         with self._lock:
@@ -462,7 +506,21 @@ class Broker:
                 self._rebalance(consumer.group_id)
 
     def _rebalance(self, group_id: str) -> None:
-        """Round-robin partition assignment over live group members."""
+        """Round-robin partition assignment over live group members.
+
+        Bumps the group epoch FIRST — even when the group just lost its
+        last member — so any in-flight manual commit stamped with the
+        pre-rebalance epoch is fenced (StaleEpochError), Kafka's group
+        generation. Manual consumers' private positions are cleared
+        WHOLESALE: a batch polled under the old epoch can never commit
+        (the fence), so its records must redeliver from the committed
+        offset to whichever member now owns the partition — including
+        the same member. Pruning to the kept assignment instead would
+        silently DROP fenced in-flight records on retained partitions
+        (position advanced past them, commit refused, never re-read)."""
+        self._group_epochs[group_id] = (
+            self._group_epochs.get(group_id, 0) + 1)
+        epoch = self._group_epochs[group_id]
         members = self._members.get(group_id, [])
         if not members:
             return
@@ -473,6 +531,7 @@ class Broker:
             all_parts.extend((tname, p) for p in range(t.n_partitions))
         for m in members:
             m._assignment = []
+            m.epoch = epoch
         for i, tp in enumerate(all_parts):
             owner = members[i % len(members)]
             if tp[0] in owner.topics:
@@ -482,6 +541,9 @@ class Broker:
                     if tp[0] in m.topics:
                         m._assignment.append(tp)
                         break
+        for m in members:
+            if not m._auto_commit:
+                m._positions.clear()
 
     def committed_offsets(self, group_id: str, topic: str) -> list[int]:
         """Committed offset per partition for a consumer group — the
@@ -529,6 +591,13 @@ class Broker:
                 g[(topic, p)] = off
                 if self._log is not None:
                     self._log.commit_offset(group_id, topic, p, off)
+            # manual-mode consumers must see the rewind: drop their
+            # private positions for this topic so the next fetch re-reads
+            # from the (reset) committed offset
+            for m in self._members.get(group_id, []):
+                if not m._auto_commit:
+                    for p in range(t.n_partitions):
+                        m._positions.pop((topic, p), None)
             # rewound consumers may have records to re-read right now
             self._data_ready.notify_all()
 
@@ -616,10 +685,52 @@ class Broker:
             if self._log is not None:
                 self._log.commit_offset(group_id, tp[0], tp[1], offset)
 
+    def _consumer_commit(
+        self, consumer: "Consumer",
+        offsets: Mapping[tuple[str, int], int] | None = None,
+        epoch: int | None = None,
+    ) -> dict[tuple[str, int], int]:
+        """Epoch-fenced manual commit (Consumer.commit body, under lock).
+
+        ``epoch=None`` fences against the epoch stamped at the consumer's
+        last poll — the epoch the records being committed were DELIVERED
+        under. A rebalance between poll and commit (member death, join,
+        supervisor fence) refuses the commit: the records redeliver to
+        the partitions' new owners instead of being marked consumed by a
+        member that no longer owns them."""
+        with self._lock:
+            cur = self._group_epochs.get(consumer.group_id, 0)
+            eff = consumer._poll_epoch if epoch is None else int(epoch)
+            members = self._members.get(consumer.group_id, [])
+            if consumer._closed or consumer not in members:
+                self.fenced_commits += 1
+                raise StaleEpochError(consumer.group_id, eff, cur,
+                                      "consumer fenced out of the group")
+            if eff != cur:
+                self.fenced_commits += 1
+                raise StaleEpochError(consumer.group_id, eff, cur)
+            if offsets is None:
+                to_commit = dict(consumer._positions)
+            else:
+                assigned = set(consumer._assignment)
+                to_commit = {}
+                for tp, off in offsets.items():
+                    tp = (tp[0], int(tp[1]))
+                    if tp not in assigned:
+                        self.fenced_commits += 1
+                        raise StaleEpochError(
+                            consumer.group_id, eff, cur,
+                            f"partition {tp} not assigned to committer")
+                    to_commit[tp] = int(off)
+            for tp, off in to_commit.items():
+                self._commit(consumer.group_id, tp, off)
+            return to_commit
+
     def _fetch(
         self, consumer: "Consumer", max_records: int
     ) -> list[Record]:
         out: list[Record] = []
+        consumer._poll_epoch = self._group_epochs.get(consumer.group_id, 0)
         # Rotate the scan start across polls (Kafka clients do the same):
         # a loaded partition early in a fixed order would otherwise starve
         # later ones for as long as it keeps filling max_records — found
@@ -633,7 +744,15 @@ class Broker:
             if len(out) >= max_records:
                 break
             t = self._topic(tname)
-            start = self._committed(consumer.group_id, (tname, p))
+            tp = (tname, p)
+            if consumer._auto_commit:
+                start = self._committed(consumer.group_id, tp)
+            else:
+                # manual mode: a private fetch position rides ahead of
+                # the group's committed offset; nothing below moves the
+                # committed offset until Consumer.commit
+                start = consumer._positions.get(
+                    tp, self._committed(consumer.group_id, tp))
             eff, take = t.partitions[p].slice(start, max_records - len(out))
             if eff > start:
                 # committed position fell below the log-start (possible
@@ -645,28 +764,62 @@ class Broker:
                 # forever on a topic that had exactly one reset.
                 self.oor_resets += 1
                 if not take:
-                    self._commit(consumer.group_id, (tname, p), eff)
+                    if consumer._auto_commit:
+                        self._commit(consumer.group_id, tp, eff)
+                    else:
+                        consumer._positions[tp] = eff
             if take:
                 # stored as exact tuples (GC untracking, see Record);
                 # consumers get the Record view
                 out.extend(map(Record._make, take))
-                self._commit(consumer.group_id, (tname, p), eff + len(take))
+                if consumer._auto_commit:
+                    self._commit(consumer.group_id, tp, eff + len(take))
+                else:
+                    consumer._positions[tp] = eff + len(take)
         consumer._fetch_start = first + 1
         return out
 
 
 class Consumer:
-    """Poll-based consumer. Offsets auto-commit on poll (at-most-once hand-off
-    inside one process; the in-process broker never loses the log, so replay
-    is available by resetting the group offset)."""
+    """Poll-based consumer. With ``auto_commit=True`` (default) offsets
+    commit on poll (at-most-once hand-off inside one process; the
+    in-process broker never loses the log, so replay is available by
+    resetting the group offset). With ``auto_commit=False`` poll advances
+    a private position and :meth:`commit` moves the group offset under an
+    epoch fence — the at-least-once mode the fleet's commit-after-route
+    discipline runs on."""
 
-    def __init__(self, broker: Broker, group_id: str, topics: tuple[str, ...]):
+    def __init__(self, broker: Broker, group_id: str, topics: tuple[str, ...],
+                 auto_commit: bool = True):
         self._broker = broker
         self.group_id = group_id
         self.topics = topics
         self._assignment: list[tuple[str, int]] = []
         self._fetch_start = 0  # rotating fetch fairness cursor (_fetch)
         self._closed = False
+        self._auto_commit = auto_commit
+        self._positions: dict[tuple[str, int], int] = {}
+        self.epoch = 0       # group epoch stamped at the last rebalance
+        self._poll_epoch = 0  # group epoch stamped at the last poll
+
+    def assignment(self) -> list[tuple[str, int]]:
+        """Currently owned (topic, partition) pairs (Kafka assignment())."""
+        with self._broker._lock:
+            return list(self._assignment)
+
+    def commit(
+        self,
+        offsets: Mapping[tuple[str, int], int] | None = None,
+        epoch: int | None = None,
+    ) -> dict[tuple[str, int], int]:
+        """Manual commit (``auto_commit=False`` mode). ``offsets=None``
+        commits the broker-held fetch positions; an explicit mapping
+        ``{(topic, partition): next_offset}`` commits exactly those.
+        Fenced by ``epoch`` (default: the epoch of this consumer's last
+        poll) — raises :class:`StaleEpochError` if the group rebalanced
+        since, or if an explicit partition is not currently assigned to
+        this consumer. Returns what was committed."""
+        return self._broker._consumer_commit(self, offsets, epoch)
 
     def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[Record]:
         deadline = time.monotonic() + timeout_s

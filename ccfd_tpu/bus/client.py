@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import Any, Iterable
 
+from ccfd_tpu.bus.broker import StaleEpochError
 from ccfd_tpu.bus.server import decode_value, encode_value
 from ccfd_tpu.utils.httpclient import PooledHTTPClient
 
@@ -158,13 +159,34 @@ class RemoteBroker:
                 f"reset offsets for {group_id!r}/{topic!r} failed: "
                 f"{code} {body}")
 
-    def consumer(self, group_id: str, topics: Iterable[str]) -> "RemoteConsumer":
+    def group_epoch(self, group_id: str) -> int:
+        """Current rebalance epoch for a group (0 = never had a member)."""
+        code, body = self._request("GET", f"/groups/{group_id}/epoch")
+        if code != 200:
+            raise RemoteBusError(f"group epoch for {group_id!r} failed: {code}")
+        return int(body["epoch"])
+
+    def fence_group(self, group_id: str, idle_s: float = 0.0) -> dict:
+        """Explicitly fence a group's idle consumers server-side (the fleet
+        supervisor's member-death actuator); returns {closed, epoch}."""
         code, body = self._request(
-            "POST", "/consumers", {"group": group_id, "topics": list(topics)}
+            "POST", f"/groups/{group_id}/fence", {"idle_s": float(idle_s)})
+        if code != 200:
+            raise RemoteBusError(f"fence for {group_id!r} failed: {code} {body}")
+        return body
+
+    def consumer(self, group_id: str, topics: Iterable[str],
+                 auto_commit: bool = True) -> "RemoteConsumer":
+        code, body = self._request(
+            "POST", "/consumers",
+            {"group": group_id, "topics": list(topics),
+             "auto_commit": bool(auto_commit)},
         )
         if code != 201:
             raise RemoteBusError(f"consumer create failed: {code} {body}")
-        return RemoteConsumer(self, int(body["consumer_id"]), group_id, tuple(topics))
+        return RemoteConsumer(self, int(body["consumer_id"]), group_id,
+                              tuple(topics), auto_commit=auto_commit,
+                              epoch=int(body.get("epoch", 0)))
 
     def close(self) -> None:
         self._http.close()
@@ -188,7 +210,8 @@ class _RemoteRecord:
 
 class RemoteConsumer:
     def __init__(
-        self, broker: RemoteBroker, cid: int, group_id: str, topics: tuple[str, ...]
+        self, broker: RemoteBroker, cid: int, group_id: str,
+        topics: tuple[str, ...], auto_commit: bool = True, epoch: int = 0,
     ):
         self._broker = broker
         self._cid = cid
@@ -196,14 +219,27 @@ class RemoteConsumer:
         self.topics = topics
         self._seq = 0
         self._closed = False
+        self._auto_commit = auto_commit
+        # group epoch this consumer last synced with the server; in manual
+        # mode updated to the DELIVERY epoch of each poll — the fence every
+        # subsequent commit() carries
+        self.epoch = epoch
+        self.assignment: list[tuple[str, int]] = []
 
     def _poll_once(
         self, seq: int, max_records: int, timeout_s: float
     ) -> tuple[int, Any]:
         # idempotent BECAUSE of the seq: a retry re-requests the same batch
+        payload: dict[str, Any] = {
+            "max_records": max_records, "timeout_s": timeout_s, "seq": seq,
+        }
+        if not self._auto_commit:
+            # manual mode declares its epoch: a rebalance under this
+            # consumer surfaces as 409 BEFORE records are consumed under
+            # an assignment it no longer holds
+            payload["epoch"] = self.epoch
         return self._broker._request(
-            "POST", f"/consumers/{self._cid}/poll",
-            {"max_records": max_records, "timeout_s": timeout_s, "seq": seq},
+            "POST", f"/consumers/{self._cid}/poll", payload,
         )
 
     def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[_RemoteRecord]:
@@ -218,8 +254,20 @@ class RemoteConsumer:
         seq = self._seq + 1
         code, body = self._poll_once(seq, max_records, timeout_s)
         if code == 404:  # reaped by session timeout: re-register and retry once
-            fresh = self._broker.consumer(self.group_id, self.topics)
+            fresh = self._broker.consumer(self.group_id, self.topics,
+                                          auto_commit=self._auto_commit)
             self._cid = fresh._cid
+            self.epoch = fresh.epoch
+            code, body = self._poll_once(seq, max_records, timeout_s)
+        if code == 409:
+            # the group rebalanced under us (member died/joined/was
+            # fenced): transparent resync — adopt the new epoch and
+            # assignment, retry once. Anything uncommitted from the old
+            # epoch redelivers to the partitions' current owners.
+            self.epoch = int(body.get("epoch", self.epoch))
+            asn = body.get("assignment")
+            if asn is not None:
+                self.assignment = [tuple(tp) for tp in asn]
             code, body = self._poll_once(seq, max_records, timeout_s)
         if code != 200:
             raise RemoteBusError(f"poll failed: {code} {body}")
@@ -231,7 +279,50 @@ class RemoteConsumer:
         except (KeyError, ValueError, TypeError) as e:
             raise RemoteBusError(f"undecodable poll batch: {e}") from e
         self._seq = seq
+        self.epoch = int(body.get("epoch", self.epoch))
+        asn = body.get("assignment")
+        if asn is not None:
+            self.assignment = [tuple(tp) for tp in asn]
         return records
+
+    def commit(
+        self,
+        offsets: dict[tuple[str, int], int] | None = None,
+        epoch: int | None = None,
+    ) -> dict[tuple[str, int], int]:
+        """Manual commit (``auto_commit=False`` mode), epoch-fenced.
+
+        ``offsets=None`` commits the server-held fetch positions;
+        an explicit ``{(topic, partition): next_offset}`` mapping commits
+        exactly those. The commit carries ``epoch`` (default: the epoch
+        of the last poll — the epoch its records were delivered under);
+        a group rebalance since then refuses the commit with
+        :class:`StaleEpochError`. A 404 — this consumer already reaped or
+        fenced at the broker — is ALSO StaleEpochError, never a
+        re-register: a fenced member's in-flight commit must die with its
+        registration, or the fence is a fiction."""
+        body: dict[str, Any] = {
+            "epoch": self.epoch if epoch is None else int(epoch)}
+        if offsets is not None:
+            wire: dict[str, dict[str, int]] = {}
+            for (t, p), off in offsets.items():
+                wire.setdefault(t, {})[str(int(p))] = int(off)
+            body["offsets"] = wire
+        code, resp = self._broker._request(
+            "POST", f"/consumers/{self._cid}/commit", body)
+        if code == 404:
+            raise StaleEpochError(
+                self.group_id, int(body["epoch"]), -1,
+                "consumer fenced (reaped) at broker")
+        if code == 409:
+            raise StaleEpochError(
+                self.group_id, int(body["epoch"]),
+                int(resp.get("epoch", -1)) if isinstance(resp, dict) else -1)
+        if code != 200:
+            raise RemoteBusError(f"commit failed: {code} {resp}")
+        self.epoch = int(resp.get("epoch", self.epoch))
+        return {(t, int(p)): int(off)
+                for t, p, off in resp.get("committed", [])}
 
     def close(self) -> None:
         if not self._closed:

@@ -310,7 +310,14 @@ class KafkaAdapter:
         return len(values)
 
     # -- consume ----------------------------------------------------------
-    def consumer(self, group_id: str, topics: Iterable[str]) -> "KafkaConsumerAdapter":
+    def consumer(self, group_id: str, topics: Iterable[str],
+                 auto_commit: bool = True) -> "KafkaConsumerAdapter":
+        """``auto_commit=False`` defers the offset commit to an explicit
+        :meth:`KafkaConsumerAdapter.commit` call (at-least-once, the fleet
+        router's commit-after-route discipline); the default keeps the
+        historical commit-on-poll hand-off. Either way the underlying
+        kafka-python consumer runs ``enable_auto_commit=False`` — the
+        difference is only WHO calls commit, and when."""
         kc = self._kafka.KafkaConsumer(
             *topics,
             bootstrap_servers=self.bootstrap,
@@ -320,7 +327,8 @@ class KafkaAdapter:
             value_deserializer=_loads,
             key_deserializer=_loads,
         )
-        return KafkaConsumerAdapter(kc, group_id, tuple(topics))
+        return KafkaConsumerAdapter(kc, group_id, tuple(topics),
+                                    auto_commit=auto_commit)
 
     def close(self) -> None:
         self._producer.close()
@@ -343,11 +351,13 @@ class KafkaConsumerAdapter:
     rather than redelivering it, identically on both transports.
     """
 
-    def __init__(self, kc: Any, group_id: str, topics: tuple[str, ...]):
+    def __init__(self, kc: Any, group_id: str, topics: tuple[str, ...],
+                 auto_commit: bool = True):
         self._kc = kc
         self.group_id = group_id
         self.topics = topics
         self._closed = False
+        self._auto_commit = auto_commit
 
     def poll(self, max_records: int = 500, timeout_s: float = 0.0) -> list[Record]:
         if self._closed:
@@ -380,9 +390,54 @@ class KafkaConsumerAdapter:
                             getattr(r, "headers", None)),
                     )
                 )
-        if out:
+        if out and self._auto_commit:
             self._kc.commit()
         return out
+
+    def assignment(self) -> list[tuple[str, int]]:
+        """Currently owned (topic, partition) pairs."""
+        return sorted((tp.topic, tp.partition)
+                      for tp in (self._kc.assignment() or ()))
+
+    def commit(self, offsets: Any = None, epoch: Any = None
+               ) -> dict[tuple[str, int], int]:
+        """Manual commit (``auto_commit=False`` mode). Kafka's own group
+        generation is the epoch fence on this transport: a commit from a
+        member fenced by a rebalance raises CommitFailedError, surfaced
+        as the same :class:`~ccfd_tpu.bus.broker.StaleEpochError` the
+        in-process and HTTP transports raise. ``offsets`` maps
+        ``{(topic, partition): next_offset}``; ``None`` commits the
+        consumed positions. ``epoch`` is accepted for surface parity and
+        ignored — the broker's generation check is authoritative here."""
+        from ccfd_tpu.bus.broker import StaleEpochError
+
+        kw = {}
+        if offsets is not None:
+            tp_cls = self._kafka_tp_cls()
+            meta_cls = self._kafka_meta_cls()
+            kw["offsets"] = {
+                tp_cls(t, int(p)): meta_cls(int(off), None)
+                for (t, p), off in offsets.items()
+            }
+        try:
+            self._kc.commit(**kw)
+        except Exception as e:  # kafka.errors.CommitFailedError et al.
+            if type(e).__name__ in ("CommitFailedError",
+                                    "RebalanceInProgressError",
+                                    "IllegalGenerationError"):
+                raise StaleEpochError(self.group_id, -1, -1, str(e)) from e
+            raise
+        return dict(offsets or {})
+
+    def _kafka_tp_cls(self):
+        from kafka.structs import TopicPartition
+
+        return TopicPartition
+
+    def _kafka_meta_cls(self):
+        from kafka.structs import OffsetAndMetadata
+
+        return OffsetAndMetadata
 
     def close(self) -> None:
         if not self._closed:

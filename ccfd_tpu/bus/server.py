@@ -14,10 +14,22 @@ Contract (JSON bodies; bytes values ride base64 under ``{"__b64__": ...}``):
 
     POST /topics/{topic}/produce     {records: [{value, key?}...]} -> metas
     GET  /topics/{topic}/offsets                                   -> [int]
-    POST /consumers                  {group, topics[]}   -> {consumer_id}
-    POST /consumers/{id}/poll        {max_records, timeout_s} -> {records}
+    POST /consumers                  {group, topics[], auto_commit?}
+                                            -> {consumer_id, epoch}
+    POST /consumers/{id}/poll        {max_records, timeout_s, epoch?}
+                                            -> {records, epoch} | 409 stale
+    POST /consumers/{id}/commit      {offsets?, epoch?}
+                                            -> {committed, epoch} | 409 fenced
     POST /consumers/{id}/close                                      -> {}
+    GET  /groups/{group}/epoch                                  -> {epoch}
+    POST /groups/{group}/fence       {idle_s}         -> {closed, epoch}
     GET  /metrics | /health/status
+
+Manual-commit consumers (``auto_commit: false``) get at-least-once
+semantics under an epoch fence: every rebalance (member join, death/reap,
+explicit fence) bumps the group epoch, and a commit stamped with an older
+epoch — e.g. from a killed member's in-flight batch — is refused with 409,
+never silently applied (bus/broker.py StaleEpochError).
 
 Long-polling maps straight onto ``Consumer.poll(timeout_s=...)`` — the
 handler thread parks on the broker's condition variable, so an idle
@@ -40,14 +52,17 @@ from typing import Any
 
 from ccfd_tpu.utils.httpserver import FrameworkHTTPServer
 
-from ccfd_tpu.bus.broker import Broker, Consumer, Record
+from ccfd_tpu.bus.broker import Broker, Consumer, Record, StaleEpochError
 from ccfd_tpu.metrics.prom import Registry
 
 _PRODUCE = re.compile(r"^/topics/([\w.-]+)/produce$")
 _OFFSETS = re.compile(r"^/topics/([\w.-]+)/offsets$")
 _BEGIN = re.compile(r"^/topics/([\w.-]+)/offsets/begin$")
 _GROUP_OFFSETS = re.compile(r"^/groups/([\w.-]+)/topics/([\w.-]+)/offsets$")
+_GROUP_EPOCH = re.compile(r"^/groups/([\w.-]+)/epoch$")
+_GROUP_FENCE = re.compile(r"^/groups/([\w.-]+)/fence$")
 _POLL = re.compile(r"^/consumers/(\d+)/poll$")
+_COMMIT = re.compile(r"^/consumers/(\d+)/commit$")
 _CLOSE = re.compile(r"^/consumers/(\d+)/close$")
 
 
@@ -181,15 +196,43 @@ class BrokerServer:
                 self._g_backlog.set(lag, labels={"group": g, "topic": tname})
 
     # -- consumer registry -------------------------------------------------
-    def _register(self, group: str, topics: list[str]) -> int:
+    def _register(self, group: str, topics: list[str],
+                  auto_commit: bool = True) -> int:
         with self._lock:
             self._reap_locked()
             self._cid += 1
             cid = self._cid
-            self._consumers[cid] = self.broker.consumer(group, tuple(topics))
+            self._consumers[cid] = self.broker.consumer(
+                group, tuple(topics), auto_commit=auto_commit)
             self._last_poll[cid] = time.monotonic()
             self._g_consumers.set(len(self._consumers))
             return cid
+
+    def fence_group(self, group: str, idle_s: float = 0.0) -> int:
+        """Explicitly fence a group's idle consumers (the supervisor's
+        member-death actuator): every consumer of ``group`` that has not
+        polled within ``idle_s`` is closed NOW — its partitions rebalance
+        to survivors and the group epoch bumps, so any commit the dead
+        member still had in flight is refused (StaleEpochError). Faster
+        than waiting out ``consumer_ttl_s``; returns consumers closed."""
+        now = time.monotonic()
+        closed: list[Consumer] = []
+        with self._lock:
+            dead = [
+                cid for cid, c in self._consumers.items()
+                if c.group_id == group
+                and now - self._last_poll.get(cid, 0.0) >= idle_s
+            ]
+            for cid in dead:
+                c = self._consumers.pop(cid, None)
+                self._last_poll.pop(cid, None)
+                self._delivered.pop(cid, None)
+                if c is not None:
+                    closed.append(c)
+            self._g_consumers.set(len(self._consumers))
+        for c in closed:
+            c.close()
+        return len(closed)
 
     def _consumer(self, cid: int) -> Consumer | None:
         with self._lock:
@@ -273,6 +316,11 @@ class BrokerServer:
                 if m:
                     self._send_json(200, server.broker.committed_offsets(
                         m.group(1), m.group(2)))
+                    return
+                m = _GROUP_EPOCH.match(path)
+                if m:
+                    self._send_json(
+                        200, {"epoch": server.broker.group_epoch(m.group(1))})
                     return
                 self._send_json(404, {"error": "not found"})
 
@@ -379,8 +427,13 @@ class BrokerServer:
                     if not group or not isinstance(topics, list) or not topics:
                         self._send_json(400, {"error": "need group and topics[]"})
                         return
-                    cid = server._register(str(group), [str(t) for t in topics])
-                    self._send_json(201, {"consumer_id": cid})
+                    auto_commit = bool(payload.get("auto_commit", True))
+                    cid = server._register(str(group), [str(t) for t in topics],
+                                           auto_commit=auto_commit)
+                    self._send_json(201, {
+                        "consumer_id": cid,
+                        "epoch": server.broker.group_epoch(str(group)),
+                    })
                     return
                 m = _POLL.match(path)
                 if m:
@@ -389,6 +442,22 @@ class BrokerServer:
                     if c is None:
                         self._send_json(404, {"error": "no such consumer"})
                         return
+                    # optional client-epoch fence: a manual-commit client
+                    # sends the epoch it last synced; a mismatch means the
+                    # group rebalanced under it — 409 with the new epoch +
+                    # assignment lets it resync BEFORE consuming records
+                    # it would later be fenced from committing
+                    want_epoch = payload.get("epoch")
+                    if want_epoch is not None:
+                        cur = server.broker.group_epoch(c.group_id)
+                        if int(want_epoch) != cur:
+                            self._send_json(409, {
+                                "error": "stale epoch",
+                                "epoch": cur,
+                                "assignment": [list(tp)
+                                               for tp in c.assignment()],
+                            })
+                            return
                     seq = payload.get("seq")
                     if seq is not None:
                         with server._lock:
@@ -396,7 +465,9 @@ class BrokerServer:
                         if cached is not None and cached[0] == seq:
                             # response to this seq was lost in transit:
                             # redeliver, don't advance past the batch
-                            self._send_json(200, {"records": cached[1]})
+                            self._send_json(
+                                200, {"records": cached[1],
+                                      "epoch": cached[2]})
                             return
                     timeout = min(float(payload.get("timeout_s", 0.0)), 30.0)
                     recs = c.poll(
@@ -404,11 +475,71 @@ class BrokerServer:
                         timeout_s=timeout,
                     )
                     views = [record_view(r) for r in recs]
+                    # the epoch these records were DELIVERED under — the
+                    # commit fence for this batch
+                    poll_epoch = c._poll_epoch
                     if seq is not None and recs:
                         with server._lock:
-                            server._delivered[cid] = (seq, views)
+                            server._delivered[cid] = (seq, views, poll_epoch)
                     server._c_delivered.inc(len(recs))
-                    self._send_json(200, {"records": views})
+                    self._send_json(200, {"records": views,
+                                          "epoch": poll_epoch,
+                                          "assignment": [list(tp) for tp in
+                                                         c.assignment()]})
+                    return
+                m = _COMMIT.match(path)
+                if m:
+                    cid = int(m.group(1))
+                    c = server._consumer(cid)
+                    if c is None:
+                        # a reaped/fenced consumer CANNOT commit — the 404
+                        # is the fence for a killed member whose commit
+                        # raced its own reaping (the client maps this to
+                        # StaleEpochError, never to re-register)
+                        self._send_json(404, {"error": "no such consumer"})
+                        return
+                    offsets = payload.get("offsets")
+                    conv = None
+                    if offsets is not None:
+                        if not isinstance(offsets, dict):
+                            self._send_json(
+                                400, {"error": "offsets must be an object"})
+                            return
+                        try:
+                            conv = {
+                                (str(t), int(p)): int(off)
+                                for t, parts in offsets.items()
+                                for p, off in parts.items()
+                            }
+                        except (TypeError, ValueError, AttributeError):
+                            self._send_json(
+                                400,
+                                {"error": "offsets must be "
+                                          "{topic: {partition: offset}}"})
+                            return
+                    try:
+                        done = c.commit(conv, epoch=payload.get("epoch"))
+                    except StaleEpochError as e:
+                        self._send_json(409, {
+                            "error": "stale epoch",
+                            "epoch": e.current_epoch,
+                            "detail": str(e),
+                        })
+                        return
+                    self._send_json(200, {
+                        "committed": [[t, p, off]
+                                      for (t, p), off in done.items()],
+                        "epoch": server.broker.group_epoch(c.group_id),
+                    })
+                    return
+                m = _GROUP_FENCE.match(path)
+                if m:
+                    idle_s = float(payload.get("idle_s", 0.0))
+                    n = server.fence_group(m.group(1), idle_s=idle_s)
+                    self._send_json(200, {
+                        "closed": n,
+                        "epoch": server.broker.group_epoch(m.group(1)),
+                    })
                     return
                 m = _CLOSE.match(path)
                 if m:

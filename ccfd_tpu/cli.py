@@ -886,6 +886,120 @@ def cmd_up(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fleet_member(args: argparse.Namespace) -> int:
+    """One fleet member: a full operator Platform from a CR-shaped JSON
+    spec file (written by fleet/supervisor.py), sharing the networked bus
+    named in its ``bus.url``. Runs until SIGTERM/SIGINT — or SIGKILL,
+    which is the point: the fleet drill proves the FLEET survives that."""
+    from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+    with open(args.spec) as f:
+        cr = json.load(f)
+    platform = Platform(PlatformSpec.from_cr(cr)).up()
+    fleet = platform.fleet
+    print(json.dumps({
+        "member": (fleet.member if fleet is not None else None),
+        "heartbeat": (fleet.endpoint if fleet is not None else None),
+        "status": platform.status(),
+    }, indent=2), file=sys.stderr)
+    _tune_gc()
+    rc = _serve_forever()
+    platform.down()
+    return rc
+
+
+def cmd_fleet_up(args: argparse.Namespace) -> int:
+    """Bring up an N-member fleet on this box: one shared bus server
+    (embedded unless --bus names one) + N member processes, babysat until
+    interrupted. The drill form of this command lives in
+    tools/fleet_drill.py (kill/respawn + invariant assertions)."""
+    from ccfd_tpu.fleet.supervisor import (
+        FleetSupervisor,
+        _free_port,
+        build_member_cr,
+    )
+
+    bus_url = args.bus
+    bus_srv = None
+    if not bus_url:
+        from ccfd_tpu.bus.broker import Broker
+        from ccfd_tpu.bus.server import BrokerServer
+
+        broker = Broker(default_partitions=args.partitions)
+        bus_srv = BrokerServer(broker)
+        port = bus_srv.start("127.0.0.1", 0)
+        bus_url = f"http://127.0.0.1:{port}"
+        print(f"[fleet] embedded bus on {bus_url}", file=sys.stderr)
+    names = [f"m{i:02d}" for i in range(args.members)]
+    ports = {n: _free_port() for n in names}
+    endpoints = {n: f"http://127.0.0.1:{p}" for n, p in ports.items()}
+    sup = FleetSupervisor(bus_url, args.state_dir)
+    for n in names:
+        sup.add_member(n, build_member_cr(
+            n, bus_url, ports[n],
+            [endpoints[o] for o in names if o != n],
+            args.state_dir,
+            ttl_s=args.ttl_s,
+            global_max_inflight=args.global_max_inflight,
+        ))
+        sup.spawn(n)
+    try:
+        sup.wait_ready(timeout_s=120.0)
+        print(json.dumps(sup.status(), indent=2), file=sys.stderr)
+        rc = _serve_forever()
+    finally:
+        sup.stop_all()
+        if bus_srv is not None:
+            bus_srv.stop()
+    return rc
+
+
+def cmd_fleet_status(args: argparse.Namespace) -> int:
+    """Fleet health by heartbeat endpoint: membership, partition
+    ownership (with disjointness verdict) and champion parity."""
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    from ccfd_tpu.fleet.member import HEALTH_PATH
+    from ccfd_tpu.fleet.protocol import (
+        check_disjoint_ownership,
+        check_fingerprint_parity,
+    )
+
+    health: dict[str, Any] = {}
+    for peer in [p.strip() for p in args.peers.split(",") if p.strip()]:
+        try:
+            with urlopen(peer.rstrip("/") + HEALTH_PATH, timeout=2.0) as r:
+                health[peer] = json.loads(r.read().decode())
+        except (URLError, OSError, ValueError):
+            health[peer] = None
+    up = {p: h for p, h in health.items() if h is not None}
+    owners = {h["member"]: h.get("partitions", []) for h in up.values()}
+    n_partitions = (max((max(ps) for ps in owners.values() if ps),
+                        default=-1) + 1)
+    doc = {
+        "members": health,
+        "ownership_violations": check_disjoint_ownership(
+            owners, n_partitions),
+        "parity": check_fingerprint_parity(
+            {h["member"]: h.get("fingerprint") for h in up.values()}),
+    }
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        for peer, h in health.items():
+            if h is None:
+                print(f"{peer}: DOWN")
+            else:
+                print(f"{peer}: {h['member']} partitions={h.get('partitions')} "
+                      f"epoch={h.get('epoch')} "
+                      f"quarantined={h.get('quarantined')}")
+        print(f"ownership: "
+              f"{doc['ownership_violations'] or 'disjoint, all owned'}")
+        print(f"parity: {doc['parity']}")
+    return 0 if not doc["ownership_violations"] else 1
+
+
 def _tracing_for(cfg, registry, component):
     """(tracer, sink) for a standalone service role, or (None, None) when
     CCFD_TRACE_SAMPLE=0 turns tracing off. The tracer lands spans in the
@@ -1554,10 +1668,11 @@ def _probe_backend_or_fallback() -> None:
 # commands whose code path imports jax; the others (bus, notify, producer,
 # store, engine) stay jax-free and must not pay the import at startup
 _JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up",
-             "score", "quantize"}
+             "score", "quantize", "fleet"}
 
 
-_SERVICE_CMDS = {"serve", "bus", "engine", "router", "notify", "store", "up"}
+_SERVICE_CMDS = {"serve", "bus", "engine", "router", "notify", "store", "up",
+                 "fleet"}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1777,6 +1892,41 @@ def main(argv: list[str] | None = None) -> int:
     u.add_argument("--exit-after-producer", action="store_true")
     u.add_argument("--drain-s", type=float, default=120.0)
     u.set_defaults(fn=cmd_up)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="multi-host fleet: N operator processes over one shared bus "
+             "(membership, admission shares, champion parity; fleet/)",
+    )
+    flsub = fl.add_subparsers(dest="action", required=True)
+    flm = flsub.add_parser(
+        "member", help="run ONE fleet member from a CR-shaped JSON spec "
+                       "(normally exec'd by the fleet supervisor)")
+    flm.add_argument("--spec", required=True,
+                     help="member spec file (fleet/supervisor.py "
+                          "build_member_cr shape)")
+    flm.set_defaults(fn=cmd_fleet_member)
+    flu = flsub.add_parser(
+        "up", help="spawn an N-member fleet (embedded bus unless --bus)")
+    flu.add_argument("--members", type=int, default=2)
+    flu.add_argument("--bus", default="",
+                     help="shared bus URL (default: start an embedded "
+                          "bus server on a free port)")
+    flu.add_argument("--state-dir", default="./fleet-state")
+    flu.add_argument("--partitions", type=int, default=4,
+                     help="tx-topic partitions for the embedded bus")
+    flu.add_argument("--ttl-s", type=float, default=3.0,
+                     help="membership lease")
+    flu.add_argument("--global-max-inflight", type=int, default=0,
+                     help="fleet-wide admission ceiling (0 = per-member "
+                          "budgets stand alone)")
+    flu.set_defaults(fn=cmd_fleet_up)
+    fls = flsub.add_parser(
+        "status", help="fleet health by peer heartbeat endpoints")
+    fls.add_argument("--peers", required=True,
+                     help="comma-separated heartbeat endpoints")
+    fls.add_argument("--json", action="store_true")
+    fls.set_defaults(fn=cmd_fleet_status)
 
     tk = sub.add_parser(
         "tasks", help="investigator workflow: list/complete engine user tasks"

@@ -303,6 +303,34 @@ class Config:
     # storm-scheduled form of the same syntax.
     device_faults_spec: str = ""
 
+    # --- fleet serving (fleet/; CR block `fleet:`) ---
+    # this process's member name within the fleet ("" = member-<pid>);
+    # stamps every heartbeat, fleet gauge and ledger entry
+    # (CCFD_FLEET_MEMBER)
+    fleet_member: str = ""
+    # heartbeat HTTP port (0 = ephemeral; fleets pin real ports so the
+    # peer list can be written before any process exists)
+    # (CCFD_FLEET_HEARTBEAT_PORT)
+    fleet_heartbeat_port: int = 0
+    # comma-separated peer heartbeat endpoints,
+    # "http://127.0.0.1:7101,http://127.0.0.1:7102" (CCFD_FLEET_PEERS)
+    fleet_peers: str = ""
+    # membership lease: a member whose last heartbeat is older than this
+    # is DEAD to the fleet — its partitions re-adopted (bus fence), its
+    # admission share redistributed (CCFD_FLEET_TTL_S)
+    fleet_ttl_s: float = 3.0
+    # gossip tick: peer heartbeat dial + fleet-actuator cadence
+    # (CCFD_FLEET_GOSSIP_INTERVAL_S)
+    fleet_gossip_interval_s: float = 0.5
+    # fleet-wide admission ceiling, split equally over LIVE members and
+    # applied as each member's AIMD budget ceiling; 0 = no fleet bound
+    # (each member keeps its own overload max_inflight)
+    # (CCFD_FLEET_GLOBAL_MAX_INFLIGHT)
+    fleet_global_max_inflight: int = 0
+    # bus topic carrying per-transaction route dispositions — the fleet's
+    # durable conservation ledger (CCFD_FLEET_LEDGER_TOPIC)
+    fleet_ledger_topic: str = "fleet.ledger"
+
     # --- multi-chip mesh serving (parallel/partition.py; CR block
     # `mesh:`) ---
     # device count for the serving/retrain mesh: 1 = single-device (the
@@ -552,6 +580,25 @@ class Config:
             ),
             device_faults_spec=e.get("CCFD_DEVICE_FAULTS",
                                      Config.device_faults_spec),
+            fleet_member=e.get("CCFD_FLEET_MEMBER", Config.fleet_member),
+            fleet_heartbeat_port=int(
+                e.get("CCFD_FLEET_HEARTBEAT_PORT",
+                      str(Config.fleet_heartbeat_port))
+            ),
+            fleet_peers=e.get("CCFD_FLEET_PEERS", Config.fleet_peers),
+            fleet_ttl_s=float(
+                e.get("CCFD_FLEET_TTL_S", str(Config.fleet_ttl_s))
+            ),
+            fleet_gossip_interval_s=float(
+                e.get("CCFD_FLEET_GOSSIP_INTERVAL_S",
+                      str(Config.fleet_gossip_interval_s))
+            ),
+            fleet_global_max_inflight=int(
+                e.get("CCFD_FLEET_GLOBAL_MAX_INFLIGHT",
+                      str(Config.fleet_global_max_inflight))
+            ),
+            fleet_ledger_topic=e.get("CCFD_FLEET_LEDGER_TOPIC",
+                                     Config.fleet_ledger_topic),
             audit_enabled=e.get("CCFD_AUDIT", "1").strip().lower()
             not in ("0", "false", "no", "off"),
             audit_dir=e.get("CCFD_AUDIT_DIR", Config.audit_dir),

@@ -1,0 +1,25 @@
+"""Fleet plane: N operator processes over ONE shared bus (ISSUE 16).
+
+Horizontal scaling for the serving pipeline — the reference system's k8s
+replicas-over-Kafka story (SURVEY.md §2), built from parts this repo
+already has: the networked bus (bus/server.py) carries partition
+ownership via consumer groups with an epoch fence, each member is a full
+``platform.operator`` process, and the fleet layer adds membership
+(heartbeat gossip), fleet-wide admission rescale, champion-parity
+quarantine, and a supervisor that kills/fences/respawns members.
+
+    protocol.py    pure membership/assignment/parity functions (no jax,
+                   CI-gated by tier-1 tests)
+    member.py      FleetMember: heartbeat server + gossip loop + gauges
+    supervisor.py  FleetSupervisor: spawn/kill/fence/respawn member procs
+    ledger.py      FleetLedgerTap: per-tx route dispositions to a bus
+                   topic — the durable fleet accounting ledger
+"""
+
+from ccfd_tpu.fleet.protocol import (  # noqa: F401
+    check_disjoint_ownership,
+    check_fingerprint_parity,
+    elect_aggregator,
+    live_members,
+    plan_partition_assignment,
+)

@@ -78,6 +78,11 @@ _COMPONENTS = (
                   # routed transaction stamped at the route seam, ring +
                   # segmented crash-safe log, /decisions endpoints (new;
                   # observability/audit.py)
+    "fleet",      # multi-host fleet plane: heartbeat gossip membership,
+                  # fleet-wide admission shares, champion-parity
+                  # quarantine, per-tx conservation ledger over the
+                  # SHARED bus (new; fleet/ — one member per process,
+                  # processes spawned by fleet/supervisor.py)
 )
 
 
@@ -100,13 +105,14 @@ class PlatformSpec:
             comps[name] = ComponentSpec(
                 # absent blocks default on, EXCEPT: producer/store (traffic
                 # and data sources are explicit choices), chaos (fault
-                # injection is opt-in), and the investigator simulation
-                # (a real deployment has real humans on the console)
+                # injection is opt-in), the investigator simulation
+                # (a real deployment has real humans on the console), and
+                # fleet (a single-process platform is the default shape)
                 enabled=bool(
                     block.get(
                         "enabled",
                         name not in ("producer", "store", "chaos",
-                                     "investigator"),
+                                     "investigator", "fleet"),
                     )
                 ),
                 options={k: v for k, v in block.items() if k != "enabled"},
@@ -160,6 +166,8 @@ class Platform:
         self._storage_storm_driven = False
         self.storage_gate = None  # runtime/durability.StoragePinGate
         self.audit = None       # observability/audit.AuditLog when enabled
+        self.fleet = None       # fleet/member.FleetMember when enabled
+        self.fleet_ledger = None  # fleet/ledger.FleetLedgerTap (fleet on)
         self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
@@ -167,6 +175,7 @@ class Platform:
         self.recovery = None  # CheckpointCoordinator when crash_recovery on
         self._engine_factory = None
         self._producer_done = threading.Event()
+        self._broker_is_client = False  # bus.url: RemoteBroker/adapter
         self._up = False
 
     # -- bring-up, in the run-book's dependency order ---------------------
@@ -410,17 +419,35 @@ class Platform:
         if spec.component("store").enabled:
             self._up_store()
 
-        # 2. bus (Kafka, README.md:87-134)
+        # 2. bus (Kafka, README.md:87-134). With a `bus.url` (or a
+        # non-inproc BROKER_URL) the platform is a CLIENT of a shared
+        # networked bus — the fleet shape: N operator processes over ONE
+        # broker, partition ownership via the bus's consumer groups.
+        # Without one, the historical in-process Broker.
         if spec.component("bus").enabled:
-            from ccfd_tpu.bus.broker import Broker
-
             bus_spec = spec.component("bus")
-            log_dir = bus_spec.opt("log_dir", "") or None
-            self.broker = Broker(
-                default_partitions=int(bus_spec.opt("partitions", 3)),
-                log_dir=log_dir,
-                fsync=bool(bus_spec.opt("fsync", False)),
-            )
+            bus_url = bus_spec.opt("url", "") or (
+                "" if cfg.broker_url.startswith("inproc")
+                else cfg.broker_url)
+            if bus_url:
+                from ccfd_tpu.bus.client import broker_from_url
+
+                self._broker_is_client = True
+                self.broker = broker_from_url(
+                    bus_url, registry=self._registry("bus"))
+                if self.broker is None:
+                    raise ValueError(
+                        f"bus.url {bus_url!r}: expected http:// (networked "
+                        "bus server) or kafka:// (real cluster)")
+            else:
+                from ccfd_tpu.bus.broker import Broker
+
+                log_dir = bus_spec.opt("log_dir", "") or None
+                self.broker = Broker(
+                    default_partitions=int(bus_spec.opt("partitions", 3)),
+                    log_dir=log_dir,
+                    fsync=bool(bus_spec.opt("fsync", False)),
+                )
         else:
             needs_bus = [
                 n for n in ("engine", "notify", "router", "retrain",
@@ -618,6 +645,15 @@ class Platform:
                 host=h.opt("host", "127.0.0.1"),
                 port=int(h.opt("port", 0)),
             ).start()
+
+        # 8b. fleet member plane (fleet/member.py): heartbeat endpoint +
+        #     gossip loop + fleet actuators (admission rescale, parity
+        #     quarantine, aggregator duty). Built after everything it
+        #     observes (router, overload, scorer, recorder) and before
+        #     the supervisor starts so the gossip loop runs supervised.
+        fl_spec = spec.component("fleet")
+        if fl_spec.enabled and self.broker is not None:
+            self._up_fleet(fl_spec)
 
         self.supervisor.start()
         if not self.supervisor.wait_ready(timeout_s=wait_ready_s):
@@ -1199,6 +1235,30 @@ class Platform:
         # kept for the incident recorder (7d): a dispatch-watchdog kill
         # snapshots into the flight recorder's ring
         self._overload = overload
+        # fleet mode (fleet/): the audit seam is wrapped with the ledger
+        # tap (per-tx dispositions onto the shared bus, stamped with the
+        # poll epoch) and offsets move to commit-after-route — a member
+        # SIGKILLed mid-batch leaves the batch uncommitted for a survivor
+        # to redeliver, and its own late commit is fenced by the bus
+        fleet_spec = self.spec.component("fleet")
+        audit_sink = self.audit
+        commit_after_route = False
+        if fleet_spec.enabled and self.broker is not None:
+            from ccfd_tpu.fleet.ledger import FleetLedgerTap
+
+            member_name = str(
+                fleet_spec.opt("member", self.cfg.fleet_member)
+                or f"member-{os.getpid()}")
+            self.fleet_ledger = FleetLedgerTap(
+                self.broker,
+                member_name,
+                topic=str(fleet_spec.opt("ledger_topic",
+                                         self.cfg.fleet_ledger_topic)),
+                inner=self.audit,
+                registry=self._registry("fleet"),
+            )
+            audit_sink = self.fleet_ledger
+            commit_after_route = True
         common = dict(
             host_score_fn=host_score_fn,
             breaker=breaker,
@@ -1211,7 +1271,8 @@ class Platform:
             tracer=router_tracer,
             overload=overload,
             profiler=self.profiler,
-            audit=self.audit,
+            audit=audit_sink,
+            commit_after_route=commit_after_route,
         )
         # partition-parallel fan-out (router/parallel.py): CR
         # `router.workers` over CCFD_ROUTER_WORKERS; 1 = the historical
@@ -1233,6 +1294,15 @@ class Platform:
                 **common,
             )
         self.router = router
+        if self.fleet_ledger is not None:
+            # ledger entries stamp the tx consumer's poll epoch (members
+            # run workers=1, so the consumer read through the router IS
+            # the one that polled the batch; read lazily — the consumer
+            # is rebuilt on crash-recycle). A ParallelRouter has no
+            # single consumer: entries stay epoch=None, which the
+            # conservation checker treats conservatively.
+            self.fleet_ledger.epoch_fn = lambda: getattr(
+                getattr(router, "_tx_consumer", None), "epoch", None)
         if self.storage_gate is not None and hasattr(router,
                                                      "set_heal_gate"):
             # the storage pin binds even with the heal component off
@@ -1317,6 +1387,92 @@ class Platform:
             self.heal.stop,
             policy=RestartPolicy.ALWAYS,
             reset=self.heal.reset,
+        )
+
+    def _up_fleet(self, c: ComponentSpec) -> None:
+        from ccfd_tpu.fleet.member import FleetMember
+        from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+        cfg = self.cfg
+        member = str(c.opt("member", cfg.fleet_member)
+                     or f"member-{os.getpid()}")
+        peers = c.opt("peers", None)
+        if peers is None:
+            peers = [p.strip() for p in cfg.fleet_peers.split(",")
+                     if p.strip()]
+        fingerprint_fn = None
+        if self.scorer is not None and hasattr(self.scorer, "params"):
+            from ccfd_tpu.parallel.partition import params_fingerprint
+
+            scorer = self.scorer
+            fingerprint_fn = lambda: params_fingerprint(scorer.params)  # noqa: E731
+        router = self.router
+
+        def consumers_fn():
+            if router is None:
+                return []
+            if hasattr(router, "workers"):  # ParallelRouter pool
+                return [w._tx_consumer for w in router.workers
+                        if getattr(w, "_tx_consumer", None) is not None]
+            tx = getattr(router, "_tx_consumer", None)
+            return [tx] if tx is not None else []
+
+        router_reg = self.registries.get("router")
+
+        def counters_fn():
+            def tot(name):
+                m = (router_reg.get(name)
+                     if router_reg is not None else None)
+                return int(m.total()) if m is not None else 0
+
+            return {
+                "incoming": tot("transaction_incoming_total"),
+                "routed": tot("transaction_outgoing_total"),
+                "shed": tot("router_shed_total"),
+                "errors": (tot("router_score_errors_total")
+                           + tot("router_process_start_errors_total")
+                           + tot("transaction_decode_errors_total")),
+            }
+
+        gmi = int(c.opt("global_max_inflight",
+                        cfg.fleet_global_max_inflight))
+        self.fleet = FleetMember(
+            member,
+            self._registry("fleet"),
+            peers=peers,
+            heartbeat_host=c.opt("heartbeat_host", "127.0.0.1"),
+            heartbeat_port=int(
+                c.opt("heartbeat_port", cfg.fleet_heartbeat_port)),
+            ttl_s=float(c.opt("ttl_s", cfg.fleet_ttl_s)),
+            overload=self._overload if gmi > 0 else None,
+            recorder=self.recorder,
+            fingerprint_fn=fingerprint_fn,
+            consumers_fn=consumers_fn,
+            counters_fn=counters_fn,
+            global_max_inflight=gmi or None,
+        )
+        self.fleet.start_server()
+        if router is not None and hasattr(router, "set_heal_gate"):
+            # the parity gate composes with whatever already guards the
+            # ladder (storage pin, device heal): ANY quarantine pins
+            # DOWN, and a stale champion blocks the host tier too (the
+            # host forward serves the same stale tree) — rules only
+            gates = [g for g in (self.storage_gate, self.heal,
+                                 self.fleet.parity_gate) if g is not None]
+            if len(gates) > 1:
+                from ccfd_tpu.runtime.durability import ComposedHealGate
+
+                router.set_heal_gate(ComposedHealGate(*gates))
+            else:
+                router.set_heal_gate(gates[0])
+        interval = float(
+            c.opt("gossip_interval_s", cfg.fleet_gossip_interval_s))
+        self.supervisor.add_thread_service(
+            "fleet",
+            lambda: self.fleet.run(interval_s=interval),
+            self.fleet.stop,
+            policy=RestartPolicy.ALWAYS,
+            reset=self.fleet.reset,
         )
 
     def _up_investigator(self) -> None:
@@ -1600,6 +1756,19 @@ class Platform:
         if self.lifecycle is not None:
             try:
                 self.lifecycle.close()  # releases the evaluator consumers
+            except Exception:  # noqa: BLE001
+                pass
+        if self.fleet is not None:
+            try:
+                self.fleet.close()  # heartbeat server + peer clients
+            except Exception:  # noqa: BLE001
+                pass
+        if self._broker_is_client and self.broker is not None:
+            # a bus-client broker owns sockets to the SHARED bus server;
+            # the in-process Broker is left alone (its segment logs are
+            # torn down with the process, matching historical behavior)
+            try:
+                self.broker.close()
             except Exception:  # noqa: BLE001
                 pass
         # a ParallelRouter owns coalescing-batcher threads the supervisor

@@ -94,6 +94,7 @@ class ParallelRouter:
         profiler: "Any | None" = None,
         heal_gate: "Any | None" = None,
         audit: "Any | None" = None,
+        commit_after_route: bool = False,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -183,6 +184,7 @@ class ParallelRouter:
                 # into the same ring/segments, so conservation (routed ==
                 # recorded) holds across the pool, like the budget bound
                 audit=audit,
+                commit_after_route=commit_after_route,
             )
             for i in range(workers)
         ]

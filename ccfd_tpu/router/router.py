@@ -45,7 +45,7 @@ from typing import Any, Callable, Mapping, Protocol
 
 import numpy as np
 
-from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.bus.broker import Broker, StaleEpochError
 from ccfd_tpu.config import Config
 from ccfd_tpu.data.ccfd import FEATURE_NAMES
 from ccfd_tpu.metrics.prom import Registry
@@ -320,6 +320,7 @@ class Router:
         profiler: "Any | None" = None,
         heal_gate: "Any | None" = None,
         audit: "Any | None" = None,
+        commit_after_route: bool = False,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -378,17 +379,29 @@ class Router:
         self._start_nocopy = bool(getattr(engine, "start_batch_nocopy",
                                           False))
 
+        # commit-after-route discipline (fleet plane, ISSUE 16): the tx
+        # consumer runs manual-commit — a batch's offsets commit only
+        # once every record has a terminal disposition (routed, shed, or
+        # counted error). A member killed mid-batch leaves its offsets
+        # UNcommitted, so the batch redelivers to whichever member
+        # re-adopts the partitions (no drop); the bus's epoch fence
+        # refuses the dead member's in-flight commit (no double-route
+        # within an epoch). Off by default: the single-process platform
+        # keeps the historical commit-on-poll hand-off.
+        self._commit_after_route = bool(commit_after_route)
         # single source of truth for the consumer wiring: __init__ AND
-        # recycle_consumers (crash recovery) both build from this
+        # recycle_consumers (crash recovery) both build from this.
+        # manual=True marks the consumer that must be built
+        # auto_commit=False when commit-after-route is armed.
         self._consumer_specs = (
-            ("_tx_consumer", "router", (cfg.kafka_topic,)),
+            ("_tx_consumer", "router", (cfg.kafka_topic,), True),
             ("_resp_consumer", "router-responses",
-             (cfg.customer_response_topic,)),
+             (cfg.customer_response_topic,), False),
             ("_notif_watcher", "router-notifications",
-             (cfg.customer_notification_topic,)),
+             (cfg.customer_notification_topic,), False),
         )
-        for attr, group, topics in self._consumer_specs:
-            setattr(self, attr, broker.consumer(group, topics))
+        for attr, group, topics, manual in self._consumer_specs:
+            setattr(self, attr, self._build_consumer(group, topics, manual))
 
         r = self.registry
         self._c_in = r.counter("transaction_incoming_total", "transactions consumed")
@@ -505,6 +518,18 @@ class Router:
             "transactions dropped by bounded-in-flight load shedding "
             "(oldest first)",
         )
+        self._c_fenced = r.counter(
+            "router_fenced_commits_total",
+            "post-route offset commits refused by the bus epoch fence "
+            "(group rebalanced mid-batch): the batch redelivers to the "
+            "partitions' new owners — an at-least-once duplicate, never "
+            "a silent loss",
+        )
+        self._c_commit_err = r.counter(
+            "router_commit_errors_total",
+            "post-route offset commits lost to bus transport errors "
+            "(not fences): the batch stays uncommitted and redelivers",
+        )
         self._c_worker_batch = r.counter(
             "router_worker_batches_total",
             "scoring batches per router worker loop (worker 0 == the "
@@ -524,6 +549,54 @@ class Router:
         # and one holder's resume() must not release the other's hold
         self._pause_mu = threading.Lock()
         self._pause_holders = 0
+
+    # -- commit-after-route (fleet plane) ----------------------------------
+    def _build_consumer(self, group: str, topics: tuple, manual: bool):
+        """Build one bus consumer; the tx consumer (``manual=True``) gets
+        auto_commit=False when commit-after-route is armed. Brokers
+        without the kwarg (older test doubles) fall back to auto-commit —
+        and commit-after-route disarms itself, because the discipline is
+        a lie over a consumer that commits on poll."""
+        if not (manual and self._commit_after_route):
+            return self.broker.consumer(group, topics)
+        try:
+            return self.broker.consumer(group, topics, auto_commit=False)
+        except TypeError:
+            self._commit_after_route = False
+            return self.broker.consumer(group, topics)
+
+    @staticmethod
+    def _tx_offsets(records: list) -> dict[tuple[str, int], int] | None:
+        """Commit positions for one poll's records: max offset + 1 per
+        (topic, partition). Computed BEFORE admission — shed records are
+        disposed (counted in router_shed_total) and must commit with the
+        batch, or they would redeliver forever."""
+        if not records:
+            return None
+        offs: dict[tuple[str, int], int] = {}
+        for r in records:
+            tp = (r.topic, r.partition)
+            nxt = r.offset + 1
+            if nxt > offs.get(tp, 0):
+                offs[tp] = nxt
+        return offs
+
+    def _commit_routed(self, offs: dict | None) -> None:
+        """Commit a fully-disposed batch's offsets (manual mode only).
+
+        A fence (the group rebalanced since this batch was polled) is
+        COUNTED and absorbed: the records redeliver to the partitions'
+        current owners — the at-least-once outcome the fleet accounting
+        tracks as cross-epoch redeliveries, never a loop crash. Transport
+        errors likewise leave the batch uncommitted (it redelivers)."""
+        if not self._commit_after_route or offs is None:
+            return
+        try:
+            self._tx_consumer.commit(offs)
+        except StaleEpochError:
+            self._c_fenced.inc()
+        except Exception:  # noqa: BLE001 - bus edge down; batch redelivers
+            self._c_commit_err.inc()
 
     # -- loop stages (composed by step() and the pipelined run loop) -------
     def _drain_signals(self) -> None:
@@ -843,8 +916,12 @@ class Router:
         records = self._poll_batch(poll_timeout_s)
         if not records:
             return 0
+        offs = self._tx_offsets(records)
         records = self._admit(records)
         if not records:
+            # fully shed: every record is disposed (counted), the batch
+            # is complete — commit it
+            self._commit_routed(offs)
             return 0
         batch_sp = None
         meta = self._audit_meta(records)
@@ -865,8 +942,13 @@ class Router:
             if self._profiler is not None:
                 self._profiler.observe("router.score", dispatch_s=score_s,
                                        batch=len(txs), rows=len(txs))
-            return self._route(x, txs, proba, ts, batch_span=batch_sp,
-                               meta=meta)
+            n = self._route(x, txs, proba, ts, batch_span=batch_sp,
+                            meta=meta)
+            # commit ONLY after every record has a terminal disposition
+            # (routed/shed/errored); a crash above leaves the batch
+            # uncommitted, so it redelivers instead of vanishing
+            self._commit_routed(offs)
+            return n
         except BaseException:
             # a crashed batch is exactly the trace an operator needs:
             # error status forces the tail sampler's keep
@@ -1074,14 +1156,14 @@ class Router:
         sequence is a cheap rebalance. The recreated consumers resume at
         the (about-to-be-rewound) committed offsets, like any group
         member."""
-        for attr, group, topics in self._consumer_specs:
+        for attr, group, topics, manual in self._consumer_specs:
             try:
                 getattr(self, attr).close()
             except Exception:  # noqa: BLE001 - a dead consumer is fine here
                 logging.getLogger("ccfd_tpu.router").debug(
                     "stale consumer %s failed to close during recycle",
                     attr, exc_info=True)
-            setattr(self, attr, self.broker.consumer(group, topics))
+            setattr(self, attr, self._build_consumer(group, topics, manual))
 
     def set_heal_gate(self, gate: Any) -> None:
         """Arm (or, with None, disarm) the device heal gate after
@@ -1162,19 +1244,24 @@ class Router:
             return proba
 
         def finish(pending: tuple) -> None:
-            pfut, px, ptxs, pts, psp, pmeta = pending
+            pfut, px, ptxs, pts, psp, pmeta, poffs = pending
             try:
                 try:
                     proba = pfut.result()
                 except Exception:
                     # a transient scorer failure (e.g. remote model timeout)
-                    # drops this batch, not the routing loop
+                    # drops this batch, not the routing loop. The drop IS
+                    # a terminal disposition (counted in
+                    # router_score_errors_total), so the batch commits —
+                    # redelivering it would double-count the error
                     self._c_score_err.inc(len(ptxs))
                     if psp is not None:
                         psp.status = "error"
+                    self._commit_routed(poffs)
                     return
                 self._route(px, ptxs, proba, pts, batch_span=psp,
                             meta=pmeta)
+                self._commit_routed(poffs)
             except BaseException:
                 if psp is not None:  # _route crashed: force-keep the trace
                     psp.status = "error"
@@ -1210,6 +1297,7 @@ class Router:
                 records = self._poll_batch(
                     0.0 if pending is not None else poll_timeout_s
                 )
+                offs = self._tx_offsets(records)
                 if records:
                     # bounded in-flight: batch k-1's rows are still
                     # reserved (consumed-but-unrouted) while k is being
@@ -1217,6 +1305,9 @@ class Router:
                     # accounts for them (and, under ParallelRouter, for
                     # every other worker's in-flight rows too)
                     records = self._admit(records)
+                    if not records:
+                        # fully shed: disposed (counted) — commit now
+                        self._commit_routed(offs)
                 fut = None
                 if records:
                     batch_sp = None
@@ -1236,8 +1327,9 @@ class Router:
                             batch_sp.status = "error"
                             self.tracer.finish(batch_sp)
                         raise
-                done, pending = pending, ((fut, x, txs, ts, batch_sp, meta)
-                                          if fut is not None else None)
+                done, pending = pending, (
+                    (fut, x, txs, ts, batch_sp, meta, offs)
+                    if fut is not None else None)
                 if done is not None:
                     try:
                         finish(done)
@@ -1247,7 +1339,7 @@ class Router:
                         # (shared-budget leak-proofing), count it as
                         # dropped, and keep its trace
                         if pending is not None:
-                            _, _, ptxs, _, psp, _pm = pending
+                            _, _, ptxs, _, psp, _pm, _po = pending
                             pending = None
                             self._budget.release(len(ptxs))
                             self._c_score_err.inc(len(ptxs))

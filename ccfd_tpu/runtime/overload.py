@@ -187,6 +187,23 @@ class AdaptiveInflightBudget(InflightBudget):
         self._cooldown_until = 0.0
         self._inc_next = 0.0
 
+    def rescale_ceiling(self, max_limit: int,
+                        min_limit: int | None = None) -> None:
+        """Re-bound the AIMD range live — the fleet plane's per-host
+        admission actuator (ISSUE 16): each member runs its own AIMD
+        budget under ``global_ceiling / live_members``, so a membership
+        change rescales every survivor's ceiling instead of letting N-1
+        hosts keep admitting as if the dead host still shared the load.
+        The current limit clamps into the new range; AIMD keeps moving it
+        from there (a sick host still sheds locally below its share)."""
+        with self._mu:
+            self.max_limit = max(1, int(max_limit))
+            if min_limit is not None:
+                self.min_limit = max(1, int(min_limit))
+            self.min_limit = min(self.min_limit, self.max_limit)
+            self.limit = max(self.min_limit, min(self.limit, self.max_limit))
+            self._set_gauges_locked()
+
     def observe(self, latency_s: float) -> None:
         """Feed one stage-latency sample; adjusts the limit AIMD-style."""
         now = self._clock()

@@ -1,0 +1,296 @@
+"""Fleet member/ledger mechanics (ccfd_tpu/fleet/member.py, ledger.py).
+
+ISSUE 16 satellite coverage for the parts the pure-protocol tests
+(tests/test_fleet_protocol.py) cannot reach: the FleetParityGate's
+heal-gate surface, the FleetMember gossip/actuator tick under a FAKE
+clock (real loopback heartbeat HTTP, deterministic time — lease expiry
+and backoff windows are driven by the test, not by sleeps), the
+once-per-incarnation member-kill bundle, the fleet admission rescale,
+the FleetLedgerTap's audit-seam forwarding + best-effort publish
+accounting, and the member-CR builder the supervisor feeds to spawned
+processes.
+"""
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from ccfd_tpu.fleet.ledger import LEDGER_TOPIC, FleetLedgerTap, flatten_ledger
+from ccfd_tpu.fleet.member import FleetMember, FleetParityGate
+from ccfd_tpu.fleet.supervisor import build_member_cr
+from ccfd_tpu.metrics.prom import Registry
+
+TTL = 3.0
+
+
+# -- parity gate -------------------------------------------------------------
+
+
+def test_parity_gate_heal_gate_surface():
+    reg = Registry()
+    gate = FleetParityGate(reg)
+    assert gate.device_allowed() and gate.host_allowed()
+    assert reg.get("ccfd_fleet_quarantined").value() == 0.0
+    gate.quarantine("fingerprint diverged")
+    assert not gate.device_allowed() and not gate.host_allowed()
+    assert gate.reason == "fingerprint diverged"
+    assert reg.get("ccfd_fleet_quarantined").value() == 1.0
+    gate.release()
+    assert gate.device_allowed() and gate.host_allowed()
+    assert reg.get("ccfd_fleet_quarantined").value() == 0.0
+
+
+def test_parity_gate_composes_with_heal_gate_chain():
+    from ccfd_tpu.runtime.durability import ComposedHealGate
+
+    gate = FleetParityGate(Registry())
+    other = SimpleNamespace(device_allowed=lambda: True,
+                            host_allowed=lambda: True)
+    composed = ComposedHealGate(other, gate)
+    assert composed.device_allowed() and composed.host_allowed()
+    gate.quarantine("stale")
+    assert not composed.device_allowed()
+    assert not composed.host_allowed()
+
+
+# -- member gossip / actuators ----------------------------------------------
+
+
+class _FakeBudget:
+    def __init__(self, max_limit=100):
+        self.max_limit = max_limit
+        self.ceilings = []
+
+    def rescale_ceiling(self, v):
+        self.ceilings.append(int(v))
+        self.max_limit = int(v)
+
+
+class _FakeRecorder:
+    def __init__(self):
+        self.incidents = []
+        self._mu = threading.Lock()
+
+    def incident(self, trigger):
+        with self._mu:
+            self.incidents.append(dict(trigger))
+
+
+@pytest.fixture()
+def pair():
+    """Two live members on real loopback heartbeat HTTP, FAKE clock."""
+    clk = [0.0]
+    made = []
+
+    def member(name, peers=(), **kw):
+        m = FleetMember(name, Registry(), peers=peers, heartbeat_port=0,
+                        ttl_s=TTL, clock=lambda: clk[0],
+                        gossip_timeout_s=2.0, **kw)
+        m.start_server()
+        made.append(m)
+        return m
+
+    yield clk, member
+    for m in made:
+        m.close()
+
+
+def test_gossip_membership_aggregator_and_gauges(pair):
+    clk, member = pair
+    b = member("b")
+    a = member("a", peers=[b.endpoint])
+    view = a.tick()
+    assert view["live"] == ["a", "b"]
+    assert view["aggregator"] == "a"  # lexicographically first live member
+    assert a.registry.get("ccfd_fleet_members").value() == 2.0
+    assert a.registry.get("ccfd_fleet_aggregator").value() == 1.0
+    # b has no peers configured: it only sees itself, and is NOT the
+    # aggregator of the fleet it can see... it is of its own singleton view
+    assert b.tick()["live"] == ["b"]
+
+
+def test_lease_expiry_marks_peer_dead_without_sleeping(pair):
+    clk, member = pair
+    b = member("b")
+    a = member("a", peers=[b.endpoint])
+    assert a.tick()["live"] == ["a", "b"]
+    b.close()  # hard stop: the endpoint vanishes mid-lease
+    clk[0] = TTL + 1.0  # b's lease (granted at t=0) expires
+    view = a.tick()
+    assert view["live"] == ["a"]
+    assert view["dead"] == ["b"]
+    assert a.registry.get("ccfd_fleet_members").value() == 1.0
+
+
+def test_kill_bundle_fires_once_per_incarnation(pair):
+    clk, member = pair
+    rec = _FakeRecorder()
+    b = member("b")
+    first_inc = b.incarnation
+    a = member("a", peers=[b.endpoint], recorder=rec)
+    a.tick()
+    b.close()
+    clk[0] = TTL + 1.0
+    a.tick()  # death detected: exactly one bundle
+    clk[0] += TTL + 1.0  # past the redial backoff cap (ttl_s)
+    a.tick()  # still dead: NO second bundle for the same incarnation
+    assert len(rec.incidents) == 1
+    inc = rec.incidents[0]
+    assert inc["type"] == "fleet_member_kill"
+    assert inc["member"] == "b" and inc["incarnation"] == first_inc
+    assert inc["survivors"] == ["a"]
+    assert a.registry.get("fleet_member_kill_bundles_total").value() == 1.0
+
+    # respawn on the same endpoint (a's configured peer URL must keep
+    # working): a NEW incarnation joins...
+    b2 = FleetMember("b", Registry(), heartbeat_port=b.heartbeat_port,
+                     ttl_s=TTL, clock=lambda: clk[0])
+    b2.start_server()
+    try:
+        assert b2.incarnation != first_inc
+        clk[0] += TTL + 1.0  # clear the redial backoff again
+        assert a.tick()["live"] == ["a", "b"]  # rejoined
+        # ...and killing the NEW incarnation yields a SECOND bundle
+        b2.close()
+        clk[0] += TTL + 1.0
+        a.tick()
+        assert len(rec.incidents) == 2
+        assert rec.incidents[1]["incarnation"] == b2.incarnation
+    finally:
+        b2.close()
+
+
+def test_admission_share_rescales_on_death_and_rejoin(pair):
+    clk, member = pair
+    budget = _FakeBudget(max_limit=100)
+    b = member("b")
+    a = member("a", peers=[b.endpoint],
+               overload=SimpleNamespace(budget=budget),
+               global_max_inflight=100)
+    view = a.tick()
+    assert view["admission_ceiling"] == 50  # equal split over 2 live
+    b.close()
+    clk[0] = TTL + 1.0
+    view = a.tick()
+    assert view["admission_ceiling"] == 100  # sole survivor absorbs all
+    assert budget.ceilings[-2:] == [50, 100]
+    assert a.registry.get("ccfd_fleet_admission_ceiling").value() == 100.0
+
+
+def test_stale_member_self_quarantines_and_releases(pair):
+    clk, member = pair
+    fp_b = ["aaa"]
+    b = member("b", fingerprint_fn=lambda: fp_b[0])
+    b.tick()  # publish b's fingerprint into its own table
+    a = member("a", peers=[b.endpoint], fingerprint_fn=lambda: "bbb")
+    a.tick()
+    # two-member split ties: lexicographic tiebreak picks "aaa", so the
+    # member serving "bbb" — a itself — is the stale side
+    assert a.parity_gate.quarantined
+    assert not a.parity_gate.device_allowed()
+    assert a.registry.get("ccfd_fleet_parity").value() == 0.0
+    # b heals a (or a swaps): fingerprints agree again -> release
+    fp_b[0] = "bbb"
+    a.tick()
+    assert not a.parity_gate.quarantined
+    assert a.registry.get("ccfd_fleet_parity").value() == 1.0
+
+
+def test_health_snapshot_reads_live_consumers(pair):
+    clk, member = pair
+    consumers = [SimpleNamespace(assignment=[("t", 0), ("t", 2)], epoch=4),
+                 SimpleNamespace(assignment=[("t", 1)], epoch=3)]
+    a = member("a", consumers_fn=lambda: consumers,
+               counters_fn=lambda: {"incoming": 5, "routed": 5,
+                                    "shed": 0, "errors": 0})
+    a.tick()
+    snap = a.health_snapshot()
+    assert snap["member"] == "a"
+    assert snap["partitions"] == [0, 1, 2]
+    assert snap["epoch"] == 4  # max over consumers: the freshest view
+    assert snap["counters"]["incoming"] == 5
+    assert snap["quarantined"] is False
+    assert snap["aggregator"] is True
+
+
+# -- ledger tap --------------------------------------------------------------
+
+
+class _FakeBroker:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.produced = []
+
+    def produce(self, topic, value, key=None):
+        if self.fail:
+            raise ConnectionError("bus edge down")
+        self.produced.append((topic, value, key))
+
+
+def test_ledger_tap_publishes_batch_and_forwards_inner():
+    reg = Registry()
+    broker = _FakeBroker()
+    seen = []
+    inner = SimpleNamespace(
+        record_batch=lambda rows, **kw: seen.append((rows, kw)))
+    tap = FleetLedgerTap(broker, "m00", inner=inner, epoch_fn=lambda: 7,
+                         registry=reg)
+    rows = [{"tx": "a", "uid": "u1"}, {"tx": "b", "uid": "u2"}]
+    tap.record_batch(rows, tier="device", worker=0)
+    # inner audit plane saw the SAME rows (fleet stacks on provenance)
+    assert seen and seen[0][0] is rows
+    topic, value, key = broker.produced[0]
+    assert topic == LEDGER_TOPIC and key == "m00"
+    assert value["member"] == "m00" and value["epoch"] == 7
+    assert [e["tx"] for e in value["entries"]] == ["a", "b"]
+    assert reg.get("fleet_ledger_entries_total").value() == 2.0
+    # empty batches publish nothing
+    tap.record_batch([])
+    assert len(broker.produced) == 1
+
+
+def test_ledger_tap_bus_failure_is_counted_never_raised():
+    reg = Registry()
+    tap = FleetLedgerTap(_FakeBroker(fail=True), "m00", registry=reg)
+    tap.record_batch([{"tx": "a", "uid": "u"}])  # must not raise
+    assert reg.get("fleet_ledger_publish_errors_total").value(
+        labels={"stage": "produce"}) == 1.0
+    assert reg.get("fleet_ledger_entries_total").value() == 0.0
+
+
+def test_flatten_ledger_restamps_member_and_epoch():
+    recs = [
+        SimpleNamespace(value={"member": "m00", "epoch": 1,
+                               "entries": [{"tx": "a", "uid": "u",
+                                            "tier": "device"}]}),
+        {"member": "m01", "epoch": 2,
+         "entries": [{"tx": "b", "uid": "v", "tier": "host"}]},
+        SimpleNamespace(value="not-a-ledger-record"),  # skipped, not fatal
+    ]
+    flat = flatten_ledger(recs)
+    assert [(e["tx"], e["member"], e["epoch"]) for e in flat] == [
+        ("a", "m00", 1), ("b", "m01", 2)]
+
+
+# -- supervisor CR builder ---------------------------------------------------
+
+
+def test_build_member_cr_shape():
+    cr = build_member_cr(
+        "m01", "http://127.0.0.1:9", 8123,
+        ["http://127.0.0.1:8001"], "/tmp/fleet-state",
+        ttl_s=2.0, global_max_inflight=64)
+    spec = cr["spec"]
+    assert spec["bus"]["url"] == "http://127.0.0.1:9"
+    fl = spec["fleet"]
+    assert fl["enabled"] is True and fl["member"] == "m01"
+    assert fl["heartbeat_port"] == 8123
+    assert fl["peers"] == ["http://127.0.0.1:8001"]
+    assert fl["ttl_s"] == 2.0 and fl["global_max_inflight"] == 64
+    # a member must NOT bring up the planes that collide across
+    # processes (shared dirs) or fork the champion (retrain/lifecycle)
+    for comp in ("retrain", "lifecycle", "audit", "durability"):
+        assert spec[comp] is False, comp
+    assert spec["engine"]["enabled"] is True
+    assert spec["incident"]["dir"].endswith("incidents-m01")

@@ -1,0 +1,124 @@
+"""Fenced commits across consumer-group rebalance, over the real HTTP bus.
+
+ISSUE 16 satellite: the fleet kill drill's correctness rests on the bus
+refusing a KILLED member's in-flight commit. The corpse polled a batch
+under epoch E, was SIGKILLed, the supervisor fenced its registration
+(group rebalance -> epoch E+1, survivors re-adopt its partitions) — and
+then the commit the corpse had already serialized arrives at the broker.
+Silently applying it would mark records consumed that the SURVIVOR is
+about to re-process (double-route) or, worse, records the corpse never
+finished routing (drop). The contract: the commit is REFUSED — 404
+(registration fenced) or 409 (epoch stale) — surfaced to the caller as
+StaleEpochError, the committed offsets stay untouched, and the batch
+redelivers to the partitions' current owner.
+"""
+
+import pytest
+
+from ccfd_tpu.bus.broker import Broker, StaleEpochError
+from ccfd_tpu.bus.client import RemoteBroker
+from ccfd_tpu.bus.server import BrokerServer
+
+
+@pytest.fixture()
+def bus():
+    srv = BrokerServer(Broker(default_partitions=2))
+    port = srv.start(host="127.0.0.1", port=0)
+    client = RemoteBroker(f"http://127.0.0.1:{port}")
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+def _drain(consumer, want, timeout_s=5.0):
+    import time
+
+    got = []
+    deadline = time.monotonic() + timeout_s
+    while len(got) < want and time.monotonic() < deadline:
+        got.extend(consumer.poll(max_records=100, timeout_s=0.2))
+    return got
+
+
+def test_killed_member_commit_fenced_not_applied(bus):
+    """The drill scenario end-to-end: poll -> fence (kill) -> in-flight
+    commit refused as StaleEpochError -> zero offsets applied -> full
+    redelivery to the group's next owner."""
+    srv, client = bus
+    for i in range(10):
+        client.produce("t", i, key=str(i).encode())
+    corpse = client.consumer("g", ("t",), auto_commit=False)
+    recs = _drain(corpse, 10)
+    assert len(recs) == 10
+
+    # the supervisor's member-death actuator: close idle registrations,
+    # bump the group epoch (idle_s=0 — the corpse stopped polling when
+    # it "died", so it is idle by definition)
+    fenced = client.fence_group("g", idle_s=0.0)
+    assert fenced["closed"] >= 1
+
+    # the corpse's in-flight commit lands AFTER the fence: refused, and
+    # never a silent re-register (that would resurrect the dead member)
+    with pytest.raises(StaleEpochError):
+        corpse.commit()
+    assert sum(client.committed_offsets("g", "t")) == 0
+
+    # no drop: the survivor (next registration in the group) replays the
+    # whole batch the corpse consumed-but-never-committed
+    survivor = client.consumer("g", ("t",), auto_commit=False)
+    replay = _drain(survivor, 10)
+    assert sorted(r.value for r in replay) == sorted(r.value for r in recs)
+    survivor.commit()
+    assert sum(client.committed_offsets("g", "t")) == 10
+    survivor.close()
+
+
+def test_stale_epoch_commit_refused_after_member_join(bus):
+    """Rebalance via a JOIN (not a death) fences just the same: a commit
+    carrying the pre-join epoch is a 409 -> StaleEpochError, with the
+    explicit offsets NOT partially applied."""
+    srv, client = bus
+    for i in range(8):
+        client.produce("t", i, key=str(i).encode())
+    c1 = client.consumer("g", ("t",), auto_commit=False)
+    recs = _drain(c1, 8)
+    assert len(recs) == 8
+    old_epoch = c1.epoch
+
+    c2 = client.consumer("g", ("t",), auto_commit=False)  # join: epoch bump
+    assert client.group_epoch("g") > old_epoch
+
+    explicit = {("t", 0): 4, ("t", 1): 4}
+    with pytest.raises(StaleEpochError):
+        c1.commit(explicit, epoch=old_epoch)
+    assert sum(client.committed_offsets("g", "t")) == 0
+
+    # the SAME consumer recovers by re-polling (adopting the new epoch)
+    # and committing under it — the fence rejects staleness, not members
+    recovered = _drain(c1, 1, timeout_s=5.0) + _drain(c2, 1, timeout_s=5.0)
+    assert recovered  # redelivery happened under the new epoch
+    for c in (c1, c2):
+        if c.assignment:
+            c.commit()
+    assert sum(client.committed_offsets("g", "t")) > 0
+    c1.close()
+    c2.close()
+
+
+def test_fresh_epoch_commit_applies_exactly(bus):
+    """Control case: with no rebalance in between, the manual commit is
+    accepted and lands exactly the polled positions."""
+    srv, client = bus
+    for i in range(6):
+        client.produce("t", i)
+    c = client.consumer("g", ("t",), auto_commit=False)
+    recs = _drain(c, 6)
+    assert len(recs) == 6
+    committed = c.commit()
+    assert sum(committed.values()) == 6
+    assert sum(client.committed_offsets("g", "t")) == 6
+    # idempotent under the same epoch: recommitting the same positions
+    # is accepted, not fenced
+    c.commit()
+    assert sum(client.committed_offsets("g", "t")) == 6
+    c.close()

@@ -1,0 +1,210 @@
+"""Fleet protocol pure functions (ccfd_tpu/fleet/protocol.py).
+
+ISSUE 16 satellite: the fleet's decision logic — membership leases,
+aggregator election, partition-ownership disjointness, champion
+fingerprint parity, accounting conservation, admission shares, and the
+multihost drill's report invariants — as fast tier-1 unit tests. No jax,
+no jax.distributed, no processes: the functions are pure by design so
+this file IS the protocol's CI gate; the drills (tools/fleet_drill.py,
+tools/multihost_drill.py) only feed them live data.
+"""
+
+import pytest
+
+from ccfd_tpu.fleet.protocol import (
+    admission_share,
+    check_disjoint_ownership,
+    check_fingerprint_parity,
+    check_ledger_conservation,
+    check_member_accounting,
+    check_multihost_reports,
+    elect_aggregator,
+    live_members,
+    plan_partition_assignment,
+)
+
+# -- membership / election ---------------------------------------------------
+
+
+def test_live_members_lease_window_boundary():
+    last_seen = {"m00": 10.0, "m01": 7.0, "m02": 6.9}
+    # lease = last heartbeat + ttl; exactly-at-ttl is still alive
+    assert live_members(last_seen, now=10.0, ttl_s=3.0) == ["m00", "m01"]
+    assert live_members(last_seen, now=13.0, ttl_s=3.0) == ["m00"]
+    assert live_members({}, now=0.0, ttl_s=3.0) == []
+
+
+def test_elect_aggregator_deterministic_and_stable_under_death():
+    assert elect_aggregator(["m01", "m00", "m02"]) == "m00"
+    # the aggregator dying elects the NEXT member, same rule everywhere
+    assert elect_aggregator(["m01", "m02"]) == "m01"
+    assert elect_aggregator([]) is None
+
+
+# -- partition ownership -----------------------------------------------------
+
+
+def test_plan_partition_assignment_round_robin():
+    plan = plan_partition_assignment(["m01", "m00"], 4)
+    assert plan == {0: "m00", 1: "m01", 2: "m00", 3: "m01"}
+    assert plan_partition_assignment([], 4) == {}
+    # survivors absorb everything when alone
+    assert plan_partition_assignment(["m00"], 3) == {
+        0: "m00", 1: "m00", 2: "m00"}
+
+
+def test_disjoint_ownership_accepts_exact_cover():
+    owners = {"m00": [0, 2], "m01": [1, 3]}
+    assert check_disjoint_ownership(owners, 4) == []
+
+
+def test_disjoint_ownership_flags_double_route_precursor():
+    violations = check_disjoint_ownership(
+        {"m00": [0, 1], "m01": [1]}, 2)
+    assert any("owned by both" in v for v in violations)
+
+
+def test_disjoint_ownership_flags_orphan_and_out_of_range():
+    violations = check_disjoint_ownership({"m00": [0, 9]}, 3)
+    assert any("no owner" in v for v in violations)          # 1, 2 orphaned
+    assert any("out-of-range" in v for v in violations)      # 9
+
+
+# -- champion parity ---------------------------------------------------------
+
+
+def test_fingerprint_parity_majority_and_stale():
+    out = check_fingerprint_parity(
+        {"m00": "aaa", "m01": "aaa", "m02": "bbb"})
+    assert out["majority"] == "aaa"
+    assert out["stale"] == ["m02"]
+    assert out["parity"] is False
+
+
+def test_fingerprint_parity_tie_breaks_lexicographically():
+    # 50/50 split: every member must quarantine the SAME side, so the
+    # tie breaks on the fingerprint string, deterministically
+    out = check_fingerprint_parity({"m00": "bbb", "m01": "aaa"})
+    assert out["majority"] == "aaa"
+    assert out["stale"] == ["m00"]
+
+
+def test_fingerprint_parity_unknown_is_not_stale():
+    # a warming-up member (no fingerprint published yet) must NOT be
+    # quarantined — cold-start flapping would take the fleet down
+    out = check_fingerprint_parity({"m00": "aaa", "m01": None})
+    assert out["stale"] == []
+    assert out["unknown"] == ["m01"]
+    assert out["parity"] is True
+    # nobody has published: vacuous parity, no majority
+    empty = check_fingerprint_parity({"m00": None, "m01": None})
+    assert empty["majority"] is None and empty["parity"] is True
+
+
+# -- accounting --------------------------------------------------------------
+
+
+def test_member_accounting_conserves_and_aggregates():
+    ok = {
+        "m00": {"incoming": 10, "routed": 8, "shed": 1, "errors": 1},
+        "m01": {"incoming": 5, "routed": 5, "shed": 0, "errors": 0},
+    }
+    assert check_member_accounting(ok) == []
+    bad = {"m00": {"incoming": 10, "routed": 8, "shed": 0, "errors": 0}}
+    violations = check_member_accounting(bad)
+    assert any("m00" in v for v in violations)
+    assert any(v.startswith("fleet:") for v in violations)
+
+
+def _entry(tx, member="m00", epoch=1):
+    return {"tx": tx, "member": member, "epoch": epoch}
+
+
+def test_ledger_conservation_clean_run():
+    out = check_ledger_conservation(
+        ["a", "b"], [_entry("a"), _entry("b", member="m01")])
+    assert out["conserved"] is True
+    assert out["produced"] == out["disposed"] == 2
+    assert out["cross_epoch_redeliveries"] == 0
+
+
+def test_ledger_conservation_flags_drop_and_ghost():
+    out = check_ledger_conservation(["a", "b"], [_entry("a"), _entry("c")])
+    assert out["dropped"] == ["b"]
+    assert out["ghosts"] == ["c"]
+    assert out["conserved"] is False
+
+
+def test_ledger_same_epoch_dupe_is_violation_cross_epoch_is_not():
+    # same tx twice under ONE epoch: the fence failed (double-route)
+    out = check_ledger_conservation(
+        ["a"], [_entry("a", epoch=1), _entry("a", member="m01", epoch=1)])
+    assert out["same_epoch_dupes"] and out["conserved"] is False
+    # same tx across a rebalance: legitimate at-least-once redelivery —
+    # counted, never a violation
+    out = check_ledger_conservation(
+        ["a"], [_entry("a", epoch=1), _entry("a", member="m01", epoch=2)])
+    assert out["conserved"] is True
+    assert out["cross_epoch_redeliveries"] == 1
+
+
+# -- admission shares --------------------------------------------------------
+
+
+def test_admission_share_redistributes_on_membership_change():
+    assert admission_share(120, 3) == 40
+    assert admission_share(120, 2) == 60   # survivors absorb the dead share
+    assert admission_share(120, 4) == 30   # rejoin lowers it back
+    assert admission_share(1, 8) == 1      # floor: never admit zero
+    assert admission_share(100, 0) == 100  # degenerate: sole implicit member
+
+
+# -- multihost drill invariants ---------------------------------------------
+
+
+def _report(pid, n_proc=2, local=4, fingerprint=None, losses=(0.7, 0.6),
+            score_mean=0.5, ring_delta=1e-6, local_rows=64):
+    return {
+        "process_id": pid,
+        "process_count": n_proc,
+        "global_devices": n_proc * local,
+        "local_devices": local,
+        "input_fingerprint": (
+            fingerprint if fingerprint is not None else 100.0 + pid),
+        "losses": list(losses),
+        "score_mean": score_mean,
+        "global_batch": local_rows * n_proc,
+        "ring_positions": n_proc * local // 2,
+        "ring_vs_dense_max_delta": ring_delta,
+    }
+
+
+def test_multihost_reports_all_green():
+    reports = [_report(0), _report(1)]
+    checks = check_multihost_reports(
+        reports, n_processes=2, local_devices=4, model_parallel=2,
+        local_rows=64)
+    assert checks == {k: True for k in checks}
+
+
+@pytest.mark.parametrize(
+    "mutate, failing",
+    [
+        # identical per-process inputs: the drill proved nothing crossed
+        # a process boundary
+        (lambda r: r.update(input_fingerprint=100.0), "distinct_inputs"),
+        # diverged losses: the cross-process all-reduce did not run
+        (lambda r: r.update(losses=[0.7, 0.61]), "losses_agree"),
+        (lambda r: r.update(losses=[float("nan"), 0.6]), "losses_finite"),
+        (lambda r: r.update(score_mean=0.51), "score_means_agree"),
+        (lambda r: r.update(ring_vs_dense_max_delta=1e-2), "ring_parity"),
+        (lambda r: r.update(local_devices=2, global_devices=4), "counts"),
+    ],
+)
+def test_multihost_reports_catch_each_violation(mutate, failing):
+    reports = [_report(0), _report(1)]
+    mutate(reports[1])
+    checks = check_multihost_reports(
+        reports, n_processes=2, local_devices=4, model_parallel=2,
+        local_rows=64)
+    assert checks[failing] is False

@@ -125,6 +125,18 @@ REFERENCE_CONTRACT_METRICS = [
     "ccfd_audit_dropped_total",
     "ccfd_audit_log_bytes",
     "ccfd_audit_ring_records",
+    # round 18: multi-host fleet plane (ccfd_tpu/fleet/) — membership vs
+    # lease TTL, disjoint partition ownership, champion parity +
+    # self-quarantine, epoch-fenced commits, fleet-ledger health
+    "ccfd_fleet_members",
+    "ccfd_fleet_epoch",
+    "ccfd_fleet_partition_owner",
+    "ccfd_fleet_parity",
+    "ccfd_fleet_quarantined",
+    "ccfd_fleet_admission_ceiling",
+    "router_fenced_commits_total",
+    "fleet_ledger_entries_total",
+    "fleet_member_kill_bundles_total",
 ]
 
 
@@ -143,7 +155,7 @@ def test_dashboards_cover_contract_metrics():
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
         "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
-        "Heal", "Storage", "Audit",
+        "Heal", "Storage", "Audit", "Fleet",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Fleet smoke: the member-kill drill at CI size, with a one-line verdict.
+
+Runs tools/fleet_drill.py's scenario small and fast — a 2-member fleet
+over one real-HTTP bus, hard-kill one member mid-traffic, assert
+partition re-adoption, exact fleet-ledger conservation, champion-parity
+gauges green and exactly one member-kill incident bundle. Prints
+``FLEETSMOKE verdict=PASS|FAIL`` and exits 0/1; wired into
+``tools/verify_tier1.sh --fleet-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.fleet_drill import run_drill  # noqa: E402
+
+
+def main() -> int:
+    out = run_drill(members=2, partitions=4, txs_before=200, txs_after=200,
+                    ttl_s=2.0)
+    print(json.dumps(out, indent=2))
+    failed = sorted(k for k, v in out["checks"].items() if not v)
+    if failed:
+        print(f"FLEETSMOKE failed checks: {failed}", file=sys.stderr)
+    print(f"FLEETSMOKE verdict={'PASS' if out['ok'] else 'FAIL'}")
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,6 +43,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 N_PROCESSES = 2
 LOCAL_DEVICES = 4
@@ -220,41 +222,14 @@ def run_topology(n_processes: int, local_devices: int, model_parallel: int,
     ok = len(reports) == n_processes and not errors
     checks: dict = {}
     if ok:
-        rs = sorted(reports, key=lambda r: r["process_id"])
-        r0 = rs[0]
-        checks = {
-            "counts": all(
-                r["process_count"] == n_processes
-                and r["global_devices"] == n_processes * local_devices
-                and r["local_devices"] == local_devices
-                for r in rs
-            ),
-            # different inputs per process...
-            "distinct_inputs": len(
-                {r["input_fingerprint"] for r in rs}) == n_processes,
-            # ...yet identical replicated losses: the cross-process
-            # all-reduce really happened, every step
-            "losses_agree": all(r["losses"] == r0["losses"] for r in rs),
-            "losses_finite": all(
-                l == l and abs(l) != float("inf")
-                for r in rs for l in r["losses"]
-            ),
-            "score_means_agree": all(
-                r["score_mean"] == r0["score_mean"] for r in rs
-            ),
-            "global_batch": r0["global_batch"] == LOCAL_ROWS * n_processes,
-            # exact attention over a ring whose edges cross the process
-            # boundary: parity vs dense computed in the same jit
-            "ring_crosses_processes": all(
-                r["ring_positions"] == n_processes * local_devices
-                // model_parallel for r in rs
-            ),
-            "ring_parity": all(
-                r["ring_vs_dense_max_delta"] < 1e-4 for r in rs
-            ),
-            "ring_agree": len(
-                {r["ring_vs_dense_max_delta"] for r in rs}) == 1,
-        }
+        # the invariant logic lives in fleet/protocol.py as a pure
+        # function over the reports, so tier-1 tests exercise it without
+        # jax.distributed (tests/test_fleet_protocol.py)
+        from ccfd_tpu.fleet.protocol import check_multihost_reports
+
+        checks = check_multihost_reports(
+            reports, n_processes, local_devices, model_parallel,
+            local_rows=LOCAL_ROWS)
         ok = all(checks.values())
     return {
         "ok": ok,

@@ -138,6 +138,24 @@
 #                                          intact; /decisions + counters
 #                                          over real HTTP:
 #                                          AUDITSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --fleet-smoke    exit-code-gated smoke of the
+#                                          multi-host fleet plane
+#                                          (tools/fleet_smoke.py): a
+#                                          2-member operator fleet over
+#                                          ONE real-HTTP bus, one member
+#                                          SIGKILLed mid-traffic; the
+#                                          survivors re-adopt its
+#                                          partitions disjointly, every
+#                                          produced tx is disposed in
+#                                          the fleet ledger (no drop, no
+#                                          same-epoch double-route),
+#                                          champion fingerprint parity
+#                                          holds, membership/parity
+#                                          gauges scrape green over real
+#                                          HTTP, and the elected
+#                                          aggregator dumps EXACTLY ONE
+#                                          member-kill incident bundle:
+#                                          FLEETSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -272,6 +290,20 @@ if [ "${1:-}" = "--audit-smoke" ]; then
     # tools/audit_smoke.py; prints AUDITSMOKE verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/audit_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--fleet-smoke" ]; then
+    # exit-code-gated smoke of the multi-host fleet plane: a 2-member
+    # fleet over one real-HTTP bus, one member SIGKILLed mid-traffic —
+    # partitions re-adopted disjointly, fleet-ledger conservation exact,
+    # champion parity + membership gauges green over HTTP, exactly one
+    # member-kill incident bundle (see tools/fleet_smoke.py; the script
+    # prints FLEETSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/fleet_smoke.py; then
         exit 0
     fi
     exit 1
