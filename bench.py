@@ -29,7 +29,9 @@ Prints ONE JSON line; primary fields:
 plus sections ``rest`` / ``pipeline`` / ``fused_ab`` / ``mesh`` /
 ``retrain`` / ``seq`` / ``zoo`` (logreg + GBT scorer hop) /
 ``quant_int8`` (int8 vs the bf16 headline on the same hop; TPU-gated,
-force with CCFD_BENCH_QUANT=1).
+force with CCFD_BENCH_QUANT=1) / ``replay`` (bulk re-score rate of a
+recorded window through the live path at bulk priority, with the live
+lane's fast-window SLO breach count — held zero — alongside).
 
 ``vs_baseline`` is the ratio against the 50,000 tx/s north-star target
 (BASELINE.json; the reference publishes no numbers of its own). ``p99_ms``
@@ -54,8 +56,8 @@ CCFD_BENCH_PROBE_ATTEMPTS (default 5), CCFD_BENCH_PROBE_BACKOFF_S (default
 45), CCFD_BENCH_REST_CLIENTS (default 4), CCFD_BENCH_REST_ROWS (rows per
 request, default 128 - the sweep-measured best configuration,
 REST_SWEEP_r04_cpu.json; the sweep artifact carries the full grid),
-CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq,zoo,quant to skip
-sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
+CCFD_BENCH_SKIP=rest,pipeline,ab,mesh,retrain,seq,zoo,quant,replay to
+skip sections, CCFD_BENCH_MAX_S (whole-bench watchdog, default 1500 —
 a tunnel that wedges MID-run would otherwise hang the bench forever;
 on expiry every section that COMPLETED before the wedge is printed,
 clearly labeled partial, with the newest cached TPU result attached,
@@ -1324,6 +1326,144 @@ def _bench_seq(seconds):
     return result
 
 
+def _bench_replay(seconds):
+    """Bulk replay & backtest plane (ROADMAP round 17): re-score a
+    recorded window through the LIVE bus -> router -> scorer path at
+    ``bulk`` priority while live traffic keeps flowing, with the
+    burn-rate engine armed. The row is the sustained re-score rate over
+    repeated window passes — never a single warmup-shaped pass — next to
+    the live lane's fast-window breach count, which must stay zero (the
+    overload plane's bulk ceiling is the mechanism under test) and the
+    parity tally (every pass must re-produce the recorded verdicts
+    byte-stable; a bench that scores fast but diverges measures a bug)."""
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ccfd_tpu.bus.broker import Broker
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.data.ccfd import synthetic_dataset
+    from ccfd_tpu.metrics.prom import Registry
+    from ccfd_tpu.observability.audit import AuditLog
+    from ccfd_tpu.observability.slo import SLOEngine
+    from ccfd_tpu.parallel.partition import params_fingerprint
+    from ccfd_tpu.process.fraud import build_engine
+    from ccfd_tpu.replay.service import ReplayService, ReplayVerdictTap
+    from ccfd_tpu.router.router import Router
+    from ccfd_tpu.runtime.overload import OverloadControl
+    from ccfd_tpu.serving.scorer import Scorer
+
+    state = tempfile.mkdtemp(prefix="ccfd_bench_replay_")
+    # short burn windows so the fast-window verdict lands inside the
+    # bench budget; targets carry the replay_smoke CI-box margin — the
+    # row gates on "zero breaches WHILE replay saturates bulk", not on
+    # this box hitting the production latency objective
+    cfg = Config(confidence_threshold=1.0, slo_windows="2,4,12",
+                 slo_e2e_target_ms=250.0, slo_rest_target_ms=250.0)
+    regs = {n: Registry() for n in ("router", "kie", "slo", "replay")}
+    slo_engine = SLOEngine.from_config(cfg, regs, regs["slo"])
+
+    broker = Broker(default_partitions=2)
+    kie = build_engine(cfg, broker, regs["kie"], None)
+    scorer = Scorer(model_name="mlp", batch_sizes=(128, 1024, 4096),
+                    host_tier_rows=0)
+    scorer.warmup()
+    fp = params_fingerprint(jax.tree.map(np.asarray, scorer.params))
+    overload = OverloadControl.from_config(cfg, regs["router"],
+                                           max_batch=1024, workers=1)
+    audit = AuditLog(dir=os.path.join(state, "audit"),
+                     registry=regs["router"])
+    audit.lineage_fn = lambda: ("bench", fp)
+    tap = ReplayVerdictTap(inner=audit, registry=regs["replay"])
+    router = Router(cfg, broker, scorer.score, kie, regs["router"],
+                    max_batch=1024, overload=overload, audit=tap)
+    svc = ReplayService(cfg, broker, audit, tap=tap,
+                        registry=regs["replay"],
+                        state_dir=os.path.join(state, "replay"),
+                        overload=overload,
+                        lineage_fn=lambda: ("bench", fp))
+
+    # record the window through the live stack (capture armed by svc)
+    n_rows = 2048
+    ds = synthetic_dataset(n=n_rows, fraud_rate=0.01, seed=17)
+    rows = [",".join(f"{v:.6g}" for v in ds.X[i]).encode()
+            for i in range(n_rows)]
+    broker.produce_batch(cfg.kafka_topic, rows,
+                         [f"tx-{i:05d}" for i in range(n_rows)])
+    while router.step() > 0:
+        pass
+    audit.flush()
+    recs = audit.scan_window()
+    if len(recs) != n_rows:
+        return {"error": f"recorded {len(recs)}/{n_rows} rows"}
+    since, until = int(recs[0]["seq"]), int(recs[-1]["seq"])
+
+    # live lane keeps flowing for the whole re-drive; burn engine ticks
+    stop = threading.Event()
+    live_rows = [0]
+
+    def drive():
+        i, next_tick = 0, 0.0
+        while not stop.is_set():
+            broker.produce_batch(cfg.kafka_topic, rows[:16],
+                                 [f"live-{i}-{j}" for j in range(16)])
+            live_rows[0] += 16
+            i += 1
+            router.step()
+            now = time.monotonic()
+            if now >= next_tick:
+                slo_engine.tick()
+                next_tick = now + 0.3
+            time.sleep(0.005)
+
+    driver = threading.Thread(target=drive, daemon=True,
+                              name="bench-replay-drive")
+    driver.start()
+
+    budget = max(2.0, seconds)
+    replayed = match = divergence = passes = 0
+    parity = True
+    t0 = time.perf_counter()
+    while passes == 0 or time.perf_counter() - t0 < budget:
+        rep = svc.run_window(since, until,
+                             window_id=f"bench-{passes}", resume=False)
+        passes += 1
+        replayed += rep["replayed"]
+        match += rep["match"]
+        divergence += rep["divergence"]
+        parity = parity and rep["parity"]
+    elapsed = time.perf_counter() - t0
+    # cross the fast burn window before reading the breach verdict
+    time.sleep(max(1.0, 1.5 * slo_engine.windows[0][0]))
+    status = slo_engine.tick()
+    stop.set()
+    driver.join(timeout=10)
+    svc.stop()
+    router.close()
+    broker.close()
+
+    breaches = sum(int(s.get("breaches", 0))
+                   for s in status["slos"].values())
+    return {
+        "tx_s": round(replayed / elapsed, 1),
+        "window_rows": n_rows,
+        "passes": passes,
+        "replayed": replayed,
+        "match": match,
+        "divergence": divergence,
+        "parity": parity,
+        "bulk_ceiling": cfg.replay_bulk_ceiling,
+        "bulk_ceiling_restored": overload.bulk_ceiling == 1.0,
+        "live_rows": live_rows[0],
+        "live_fast_breaches": breaches,
+        "live_slo_green": not any(
+            s.get("breaching") or s.get("breaches")
+            for s in status["slos"].values()),
+    }
+
+
 def main() -> None:
     _arm_watchdog()
     platform_forced = os.environ.get("CCFD_BENCH_PLATFORM", "")
@@ -1497,6 +1637,14 @@ def main() -> None:
         _PARTIAL["seq_pipeline"] = _bench_seq_pipeline(max(3.0, seconds))
         meter.section(_PARTIAL["seq_pipeline"])
 
+    if "replay" not in skip:
+        meter.section(None)  # replay builds its own full stack: fresh H2D
+        try:
+            _PARTIAL["replay"] = _bench_replay(max(2.0, seconds / 2))
+        except Exception as e:  # noqa: BLE001 - a red replay row must not
+            _PARTIAL["replay"] = {"error": repr(e)[:200]}  # kill the bench
+        meter.section(_PARTIAL["replay"])
+
     zoo_res = None
     if "zoo" not in skip:
         zoo_res = _bench_zoo(max(1.0, seconds / 3))
@@ -1614,6 +1762,8 @@ def compact_summary(result: dict) -> dict:
          "speedup_vs_full_l", "full_l_sync_tx_s", "r05_path_tx_s",
          "speedup_vs_r05_path", "cold_fraction")
     pick("quant_int8", "tx_s", "fused_tx_s", "preq_tx_s", "batch")
+    pick("replay", "tx_s", "passes", "parity", "divergence",
+         "live_fast_breaches", "live_slo_green", "bulk_ceiling")
     pick("roofline", "wire_mb_s", "h2d_mb_s_measured", "mfu_pct", "bound")
     zoo = result.get("zoo")
     if isinstance(zoo, dict):

@@ -635,6 +635,108 @@ def cmd_audit(args: argparse.Namespace) -> int:
         consumer.close()
 
 
+def cmd_replay(args: argparse.Namespace) -> int:
+    """``ccfd_tpu replay``: the bulk replay & backtest console (replay/).
+
+    Offline (default): scan the recorded window out of the audit
+    segments read-only and summarize it; with ``--what-if-threshold``
+    run the host-side backtest diff (which recorded decisions flip under
+    the new threshold) — no platform, no bus. With ``--live``: bring the
+    platform up, re-produce the window through the real
+    producer→bus→router→scorer path under ``bulk`` admission, and print
+    the verdict-parity report (divergences classified by cause)."""
+    from ccfd_tpu.config import Config
+
+    cfg = Config.from_env()
+    audit_dir = args.dir or cfg.audit_dir
+    if not audit_dir:
+        print("[replay] no audit dir: pass --dir or set CCFD_AUDIT_DIR "
+              "(windows are reconstructed from the audit segments)",
+              file=sys.stderr)
+        return 2
+    since, until = args.since_seq, args.until_seq
+    if args.from_incident:
+        from ccfd_tpu.replay.service import bundle_window
+
+        with open(args.from_incident) as f:
+            rng = bundle_window(json.load(f))
+        if rng is None:
+            print(f"[replay] {args.from_incident} embeds no decision "
+                  "summaries; nothing to re-drive", file=sys.stderr)
+            return 2
+        since, until = rng
+
+    if args.live:
+        _honor_platform_env()
+        _probe_backend_or_fallback()
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        if args.cr:
+            spec = PlatformSpec.from_yaml(args.cr, cfg=cfg)
+        else:
+            # minimal replay platform: bus + scorer + engine + router +
+            # the audit/replay planes over the recorded segments
+            spec = PlatformSpec.from_cr({"spec": {
+                "audit": {"dir": audit_dir},
+                "replay": {"enabled": True,
+                           "dir": args.state_dir or cfg.replay_dir},
+                "monitoring": {"enabled": False},
+                "health": {"enabled": False},
+                "analytics": {"enabled": False},
+                "retrain": {"enabled": False},
+                "notify": {"enabled": False},
+            }}, cfg=cfg)
+        p = Platform(spec).up()
+        try:
+            if p.replay is None:
+                print("[replay] the platform came up without the replay "
+                      "component (CR replay.enabled / audit plane off?)",
+                      file=sys.stderr)
+                return 2
+            report = p.replay.run_window(
+                since, until,
+                window_id=(args.window_id or None),
+                resume=not args.no_resume)
+        finally:
+            p.down()
+        print(json.dumps(report if args.json else {
+            k: report[k] for k in ("window_id", "total", "replayed",
+                                   "match", "divergence", "drop", "ghost",
+                                   "causes", "parity", "rows_per_s")}))
+        return 0 if report.get("parity") else 1
+
+    from ccfd_tpu.observability.audit import AuditLog
+    from ccfd_tpu.replay.service import ReplayService
+
+    audit = AuditLog(dir=audit_dir, readonly=True,
+                     max_records=cfg.audit_ring)
+    if args.what_if_threshold is not None:
+        svc = ReplayService(cfg, None, audit,
+                            state_dir=(args.state_dir or None))
+        report = svc.run_window(since, until, mode="whatif",
+                                threshold=args.what_if_threshold,
+                                window_id=(args.window_id or None))
+        print(json.dumps(report if args.json else {
+            k: report[k] for k in ("window_id", "total", "threshold",
+                                   "flips", "flip_rate",
+                                   "mean_abs_delta")}))
+        return 0
+    recs = audit.scan_window(since, until)
+    tiers: dict[str, int] = {}
+    for r in recs:
+        t = str(r.get("tier", "device"))
+        tiers[t] = tiers.get(t, 0) + 1
+    doc = {
+        "records": len(recs),
+        "rescorable": sum(1 for r in recs if r.get("row") is not None),
+        "seq": ([int(recs[0].get("seq", -1)),
+                 int(recs[-1].get("seq", -1))] if recs else None),
+        "tiers": tiers,
+    }
+    print(json.dumps(doc))
+    return 0
+
+
 def cmd_lifecycle(args: argparse.Namespace) -> int:
     """Model-lifecycle console: the versioned lineage + transition audit
     trail the controller persists (lifecycle/versions.py). Reads the
@@ -1672,7 +1774,7 @@ _JAX_CMDS = {"demo", "serve", "train", "analyze", "bench", "router", "up",
 
 
 _SERVICE_CMDS = {"serve", "bus", "engine", "router", "notify", "store", "up",
-                 "fleet"}
+                 "fleet", "replay"}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1784,6 +1886,45 @@ def main(argv: list[str] | None = None) -> int:
     au.add_argument("--follow", action="store_true", help="keep consuming")
     au.add_argument("--limit", type=int, default=0, help="stop after N events")
     au.set_defaults(fn=cmd_audit)
+
+    rp = sub.add_parser(
+        "replay",
+        help="bulk replay & backtest: re-score a recorded audit window "
+             "with verdict-parity conservation (replay plane)",
+    )
+    rp.add_argument("--dir", default="",
+                    help="audit log dir holding the recorded window "
+                    "(default: CCFD_AUDIT_DIR)")
+    rp.add_argument("--since-seq", type=int, default=None,
+                    help="window start (DecisionRecord seq, inclusive)")
+    rp.add_argument("--until-seq", type=int, default=None,
+                    help="window end (DecisionRecord seq, inclusive)")
+    rp.add_argument("--from-incident", default="",
+                    help="incident bundle JSON: re-drive the decisions "
+                    "in flight across the breach window")
+    rp.add_argument("--what-if-threshold", type=float, default=None,
+                    help="host-side backtest: which recorded decisions "
+                    "flip under this FRAUD_THRESHOLD (never touches the "
+                    "live path)")
+    rp.add_argument("--live", action="store_true",
+                    help="bring the platform up and re-produce the window "
+                    "through the live serving path under bulk admission")
+    rp.add_argument("--cr", default="",
+                    help="CR file for --live (default: a minimal replay "
+                    "platform over --dir)")
+    rp.add_argument("--state-dir", default="",
+                    help="durable replay-cursor dir (default: "
+                    "CCFD_REPLAY_DIR)")
+    rp.add_argument("--window-id", default="",
+                    help="explicit window id (cursor key; default: the "
+                    "seq range)")
+    rp.add_argument("--no-resume", action="store_true",
+                    help="ignore an existing cursor and restart the "
+                    "window from its first row")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the full report (bounded findings "
+                    "included) as JSON")
+    rp.set_defaults(fn=cmd_replay)
 
     lc = sub.add_parser(
         "lifecycle",

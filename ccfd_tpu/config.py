@@ -239,6 +239,32 @@ class Config:
     # torn tail truncates and counts at the next bring-up)
     audit_flush_interval_s: float = 0.25   # CCFD_AUDIT_FLUSH_INTERVAL_S
 
+    # --- bulk replay & backtest (replay/; CR block `replay:`) ---
+    # master switch: arms feature-row capture at the route seam, the
+    # verdict tap and the supervised replay worker (CCFD_REPLAY; off by
+    # default — capture grows audit records by ~30 floats each)
+    replay_enabled: bool = False
+    # rows re-produced per replay batch (one cursor commit per batch —
+    # the crash-resume granularity) (CCFD_REPLAY_BATCH)
+    replay_batch: int = 256
+    # verdict-join wait per production attempt before re-producing the
+    # unanswered remainder (CCFD_REPLAY_TIMEOUT_S)
+    replay_timeout_s: float = 10.0
+    # re-production attempts per batch beyond the first; bulk rows shed
+    # under live load come back on the next attempt
+    # (CCFD_REPLAY_RETRIES)
+    replay_retries: int = 3
+    # fraction of the adaptive admission budget bulk/replay work may
+    # occupy while a window runs — the zero-live-SLO-impact guarantee
+    # (CCFD_REPLAY_BULK_CEILING)
+    replay_bulk_ceiling: float = 0.5
+    # pacing in rows/second; 0 saturates the bulk share
+    # (CCFD_REPLAY_PACING)
+    replay_pacing_rows_s: float = 0.0
+    # durable-cursor directory ("" = resume disabled: a killed window
+    # restarts from its first row) (CCFD_REPLAY_DIR)
+    replay_dir: str = ""
+
     # --- durable-state integrity (runtime/durability.py; CR block
     # `durability:`) ---
     # generations retained per single-file artifact (lineage, recovery
@@ -614,6 +640,25 @@ class Config:
                 e.get("CCFD_AUDIT_FLUSH_INTERVAL_S",
                       str(Config.audit_flush_interval_s))
             ),
+            replay_enabled=e.get("CCFD_REPLAY", "0").strip().lower()
+            in ("1", "true", "yes", "on"),
+            replay_batch=int(
+                e.get("CCFD_REPLAY_BATCH", str(Config.replay_batch))
+            ),
+            replay_timeout_s=float(
+                e.get("CCFD_REPLAY_TIMEOUT_S", str(Config.replay_timeout_s))
+            ),
+            replay_retries=int(
+                e.get("CCFD_REPLAY_RETRIES", str(Config.replay_retries))
+            ),
+            replay_bulk_ceiling=float(
+                e.get("CCFD_REPLAY_BULK_CEILING",
+                      str(Config.replay_bulk_ceiling))
+            ),
+            replay_pacing_rows_s=float(
+                e.get("CCFD_REPLAY_PACING", str(Config.replay_pacing_rows_s))
+            ),
+            replay_dir=e.get("CCFD_REPLAY_DIR", Config.replay_dir),
             storage_retain=int(
                 e.get("CCFD_STORAGE_RETAIN", str(Config.storage_retain))
             ),

@@ -27,9 +27,9 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
     GET /incidents/<id>        one full schema-validated incident bundle
                                (JSON); unknown ids 404
     GET /decisions             decision-record summaries (JSON), newest
-                               first; ?since=<unix_ts> filters on decide
-                               time, ?limit=N bounds the page
-                               (observability/audit.py)
+                               first; ?since=<unix_ts>&until=<unix_ts>
+                               bracket decide time, ?limit=N bounds the
+                               page (observability/audit.py)
     GET /decisions/<tx_id>     one full DecisionRecord by transaction id
                                (or "partition:offset" uid); unknown ids
                                404 — strict JSON either way, and both
@@ -291,18 +291,24 @@ class MetricsExporter:
             from urllib.parse import parse_qs
 
             q = parse_qs(query or "")
-            since = None
+            since = until = None
             try:
                 if q.get("since"):
                     since = float(q["since"][0])
             except ValueError:
                 since = None
             try:
+                if q.get("until"):
+                    until = float(q["until"][0])
+            except ValueError:
+                until = None
+            try:
                 limit = int((q.get("limit") or ["256"])[0])
             except ValueError:
                 limit = 256
             return json.dumps(
-                {"decisions": self._audit.list(since=since, limit=limit)})
+                {"decisions": self._audit.list(since=since, until=until,
+                                               limit=limit)})
         rec = self._audit.get(path[len("/decisions/"):])
         if rec is None:
             return None

@@ -131,6 +131,10 @@ class AuditLog:
         self.retain_segments = max(1, int(retain_segments))
         self._fsync = fsync
         self.readonly = bool(readonly)
+        # armed by the replay plane: when True the route seam embeds the
+        # decoded feature row in each record (``row`` key) so a window
+        # scanned off the segments is self-contained and re-scorable
+        self.capture_rows = False
         self.lineage_fn = lineage_fn
         self.incident_fn = incident_fn
         self._clock = clock
@@ -495,25 +499,86 @@ class AuditLog:
             return dict(rec) if rec is not None else None
 
     def list(self, since: float | None = None,
-             limit: int = 256) -> list[dict]:
+             limit: int = 256, until: float | None = None) -> list[dict]:
         """Compact summaries, newest first — the ``/decisions?since=``
-        body. ``since`` filters on ``decided_ts`` (unix seconds).
+        body. ``since``/``until`` filter on ``decided_ts`` (unix
+        seconds): records with ``since < decided_ts <= until``.
 
         The scan is bounded while holding the stamp mutex: ring order IS
         decide order (a re-stamp re-inserts at the tail), so iterating
         newest-first can STOP at the first record at/under ``since``
         instead of walking 64k older entries under the lock the route
         seam needs — and ``limit`` is clamped so an unbounded
-        ``?limit=`` cannot turn a poll into a full-ring scan either."""
+        ``?limit=`` cannot turn a poll into a full-ring scan either.
+        ``until`` records SKIPPED at the newest end still count against
+        the same scan bound (limit + skips capped together), keeping the
+        worst case at one bounded walk rather than a full ring."""
         limit = min(max(1, int(limit)), 4096)
+        scan_cap = limit + 4096  # bounded even when `until` skips newest
         out: list[dict] = []
+        scanned = 0
         with self._mu:
             for rec in reversed(self._ring.values()):
-                if since is not None and rec.get("decided_ts", 0.0) <= since:
+                scanned += 1
+                if scanned > scan_cap:
                     break
+                ts = rec.get("decided_ts", 0.0)
+                if since is not None and ts <= since:
+                    break
+                if until is not None and ts > until:
+                    continue
                 out.append(summarize(rec))
                 if len(out) >= limit:
                     break
+        return out
+
+    def scan_window(self, since_seq: int | None = None,
+                    until_seq: int | None = None,
+                    limit: int = 262_144) -> list[dict]:
+        """Bounded windowed scan over the ON-DISK segments — the replay
+        plane's window source. Returns full records with
+        ``since_seq <= seq <= until_seq``, ascending by ``seq``, one per
+        ``uid`` (a crash-replay re-stamp means a uid can appear twice in
+        the log; the LATEST stamp is the decision of record, matching
+        the ring's latest-wins rule).
+
+        Read-only by construction (the PR 14 readonly-scan rule): the
+        scan opens segments for reading and NEVER truncates a torn tail
+        — a frame torn by a concurrent live append simply stops that
+        segment's scan at the valid prefix, and the caller sees a
+        shorter window rather than a mutated log. Memory is inherently
+        bounded by segment retention (``retain_segments`` x
+        ``segment_bytes``); ``limit`` backstops the result set."""
+        if not self.dir:
+            return []
+        lo = None if since_seq is None else int(since_seq)
+        hi = None if until_seq is None else int(until_seq)
+        limit = max(1, int(limit))
+        best: dict[str, dict] = {}
+        for _idx, path in self._segments():
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            records, _valid, _torn = self._scan_frames(data)
+            for rec in records:
+                try:
+                    seq = int(rec.get("seq", -1))
+                except (TypeError, ValueError):
+                    continue
+                if (lo is not None and seq < lo) or (
+                        hi is not None and seq > hi):
+                    continue
+                uid = str(rec.get("uid") or f"seq-{seq}")
+                prev = best.get(uid)
+                if prev is None or int(prev.get("seq", -1)) <= seq:
+                    best[uid] = rec
+        out = sorted(best.values(), key=lambda r: int(r.get("seq", -1)))
+        if len(out) > limit:
+            log.warning("audit scan_window clamped %d -> %d records",
+                        len(out), limit)
+            out = out[:limit]
         return out
 
     def recent_summaries(self, n: int = 16,

@@ -784,6 +784,48 @@ def fleet_dashboard() -> dict:
     return _dashboard("CCFD Fleet", "ccfd-fleet", p)
 
 
+def replay_dashboard() -> dict:
+    """Bulk replay & backtest board (ISSUE 17; ccfd_tpu/replay/).
+
+    The conservation surface: replayed rows by outcome (match must be
+    the only moving series), divergences by classified cause with the
+    one alert that matters — ``nondeterminism`` must stay 0 (every other
+    cause is an EXPLAINED finding: a promote, a tier change, a threshold
+    move), drops/ghosts (window accounting holes), replay throughput
+    next to the bulk admission ceiling actually in force (the
+    zero-live-SLO-impact evidence reads alongside the SLO board's burn
+    rates), verdicts diverted at the route seam, and the durable
+    cursor's progress (flat while rows flow = a wedged window)."""
+    p = [
+        _panel(0, "Replayed rows by outcome / s",
+               ["rate(ccfd_replay_rows_total[5m])"]),
+        _alert_stat(1, "Unexplained divergences (nondeterminism)",
+                    ["sum(ccfd_replay_divergence_total"
+                     "{cause=\"nondeterminism\"})"],
+                    red_above=1),
+        _panel(2, "Divergences by cause / s",
+               ["rate(ccfd_replay_divergence_total[5m])"]),
+        _alert_stat(3, "Window rows dropped (no verdict after retries)",
+                    ["sum(ccfd_replay_rows_total{outcome=\"drop\"})"],
+                    red_above=1),
+        _alert_stat(4, "Ghost verdicts (uid outside the window)",
+                    ["sum(ccfd_replay_rows_total{outcome=\"ghost\"})"],
+                    red_above=1),
+        _panel(5, "Replay throughput (rows / s, last window)",
+               ["ccfd_replay_rows_per_s"]),
+        _panel(6, "Bulk admission ceiling in force (by stage)",
+               ["ccfd_bulk_ceiling"]),
+        _panel(7, "Bulk rows shed at the ceiling / s",
+               ["sum(rate(ccfd_shed_total{stage=\"bulk_ceiling\"}[5m]))"]),
+        _panel(8, "Replay verdicts at the route seam / s (by fate)",
+               ["rate(ccfd_replay_verdicts_total[5m])"]),
+        _panel(9, "Durable cursor seq", ["ccfd_replay_cursor_seq"]),
+        _panel(10, "Windows completed (clean vs findings)",
+               ["sum(ccfd_replay_windows_total)"], "stat"),
+    ]
+    return _dashboard("CCFD Replay", "ccfd-replay", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -815,6 +857,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Storage": storage_dashboard(),
         "Audit": audit_dashboard(),
         "Fleet": fleet_dashboard(),
+        "Replay": replay_dashboard(),
     }
 
 

@@ -42,6 +42,9 @@ import numpy as np
 Params = Mapping[str, Any]
 
 _EPS = 1e-8
+# finite ceiling for the host-tier per-layer activations: far above any
+# healthy model's range, far below float32 overflow (see apply_numpy)
+_H_CLAMP = 1e30
 
 
 def quantize_mlp(params: Params) -> Params:
@@ -115,9 +118,16 @@ def apply_numpy(params: Params, x: np.ndarray) -> np.ndarray:
         s_x = np.maximum(amax / 127.0, _EPS)
         q = np.clip(np.rint(h / s_x[:, None]), -127, 127).astype(np.int8)
         acc = q.astype(np.int32) @ np.asarray(layer["wq"], np.int32)
-        h = acc.astype(np.float32) * s_x[:, None] * np.asarray(
-            layer["scale"], np.float32
-        )[None, :] + np.asarray(layer["b"], np.float32)
+        # scales combine FIRST: with a degenerate (activation-exploding)
+        # model, acc * s_x can overflow float32 to inf and a zero weight
+        # channel (scale 0) then turns it into nan (inf * 0); the combined
+        # per-(row, channel) scale keeps every factor finite, and the clamp
+        # stops an inf from one layer poisoning the next layer's s_x.
+        # For healthy models both are no-ops modulo float rounding.
+        h = acc.astype(np.float32) * (
+            s_x[:, None] * np.asarray(layer["scale"], np.float32)[None, :]
+        ) + np.asarray(layer["b"], np.float32)
+        h = np.clip(h, -_H_CLAMP, _H_CLAMP)
         if li < len(layers) - 1:
             h = np.maximum(h, 0.0)
     return stable_sigmoid(h.reshape(x.shape[0]))

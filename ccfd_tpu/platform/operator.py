@@ -83,6 +83,10 @@ _COMPONENTS = (
                   # quarantine, per-tx conservation ledger over the
                   # SHARED bus (new; fleet/ — one member per process,
                   # processes spawned by fleet/supervisor.py)
+    "replay",     # bulk replay & backtest plane: re-score recorded audit
+                  # windows through the live stack under bulk admission,
+                  # verdict-parity conservation with classified
+                  # divergences, crash-resumable cursor (new; replay/)
 )
 
 
@@ -112,7 +116,7 @@ class PlatformSpec:
                     block.get(
                         "enabled",
                         name not in ("producer", "store", "chaos",
-                                     "investigator", "fleet"),
+                                     "investigator", "fleet", "replay"),
                     )
                 ),
                 options={k: v for k, v in block.items() if k != "enabled"},
@@ -167,6 +171,8 @@ class Platform:
         self.storage_gate = None  # runtime/durability.StoragePinGate
         self.audit = None       # observability/audit.AuditLog when enabled
         self.fleet = None       # fleet/member.FleetMember when enabled
+        self.replay = None      # replay/service.ReplayService when enabled
+        self.replay_tap = None  # replay/service.ReplayVerdictTap (replay on)
         self.fleet_ledger = None  # fleet/ledger.FleetLedgerTap (fleet on)
         self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
@@ -1259,6 +1265,19 @@ class Platform:
             )
             audit_sink = self.fleet_ledger
             commit_after_route = True
+        # replay plane (replay/): the verdict tap wraps the (possibly
+        # fleet-wrapped) audit seam — live decisions pass through to the
+        # provenance log; replay-marked ones divert to the parity join.
+        # The tap also answers capture_rows for the route seam, arming
+        # feature-row embeds so recorded windows are re-scorable.
+        replay_spec = self.spec.component("replay")
+        if ((replay_spec.enabled or self.cfg.replay_enabled)
+                and self.audit is not None and self.broker is not None):
+            from ccfd_tpu.replay.service import ReplayVerdictTap
+
+            self.replay_tap = ReplayVerdictTap(
+                inner=audit_sink, registry=self._registry("replay"))
+            audit_sink = self.replay_tap
         common = dict(
             host_score_fn=host_score_fn,
             breaker=breaker,
@@ -1303,6 +1322,47 @@ class Platform:
             # conservation checker treats conservatively.
             self.fleet_ledger.epoch_fn = lambda: getattr(
                 getattr(router, "_tx_consumer", None), "epoch", None)
+        if self.replay_tap is not None:
+            # replay plane (replay/): the service re-produces recorded
+            # windows through THIS router under bulk admission; the tap
+            # (already wrapping the audit seam) hands the replayed
+            # verdicts to its parity join. Registered as a supervised
+            # component so a crashed worker restarts and resumes from
+            # its durable cursor.
+            from ccfd_tpu.replay.service import ReplayService
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            rcfg = self.cfg
+
+            def _replay_lineage():
+                fn = getattr(self.audit, "lineage_fn", None)
+                return fn() if fn is not None else (None, None)
+
+            self.replay = ReplayService(
+                rcfg, self.broker, self.audit, tap=self.replay_tap,
+                registry=self._registry("replay"),
+                state_dir=(str(replay_spec.opt("dir", rcfg.replay_dir))
+                           or None),
+                overload=overload,
+                lineage_fn=_replay_lineage,
+            )
+            self.replay.batch = max(1, int(
+                replay_spec.opt("batch", rcfg.replay_batch)))
+            self.replay.timeout_s = float(
+                replay_spec.opt("timeout_s", rcfg.replay_timeout_s))
+            self.replay.retries = max(0, int(
+                replay_spec.opt("retries", rcfg.replay_retries)))
+            self.replay.bulk_ceiling = min(1.0, max(0.0, float(
+                replay_spec.opt("bulk_ceiling", rcfg.replay_bulk_ceiling))))
+            self.replay.set_pacing(float(
+                replay_spec.opt("pacing_rows_s", rcfg.replay_pacing_rows_s)))
+            self.supervisor.add_thread_service(
+                "replay",
+                self.replay.run,
+                self.replay.stop,
+                policy=RestartPolicy.ALWAYS,
+                reset=self.replay.reset,
+            )
         if self.storage_gate is not None and hasattr(router,
                                                      "set_heal_gate"):
             # the storage pin binds even with the heal component off
@@ -1692,6 +1752,13 @@ class Platform:
                 "param_partition": getattr(
                     self, "_mesh_param_partition", "replicated"),
                 "seq_parallel": getattr(self, "_mesh_seq_parallel", "none"),
+            }
+        if self.replay is not None:
+            out["replay"] = {
+                "bulk_ceiling": self.replay.bulk_ceiling,
+                "pacing_rows_s": self.replay.pacing_rows_s,
+                "batch": self.replay.batch,
+                "last_report": self.replay.last_report,
             }
         if self.store_server:
             out["endpoints"]["store"] = self.store_server.endpoint

@@ -1,0 +1,18 @@
+"""Bulk replay & backtest plane (ISSUE 17, ROADMAP item 5).
+
+Re-scores recorded decision history through the SAME serving stack that
+made the original calls, under ``bulk`` admission so live traffic keeps
+its SLO, and holds the verdict-parity conservation law
+``replayed == recorded`` — every divergence is a classified finding,
+never a silent diff. See :mod:`ccfd_tpu.replay.service`.
+"""
+
+from ccfd_tpu.replay.service import (  # noqa: F401
+    CAUSE_CHAMPION_HASH,
+    CAUSE_NONDETERMINISM,
+    CAUSE_THRESHOLD,
+    CAUSE_TIER,
+    ReplayService,
+    ReplayVerdictTap,
+    classify_divergence,
+)

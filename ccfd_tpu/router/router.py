@@ -1014,6 +1014,12 @@ class Router:
         audit_rows: list[dict] = []
         ts_list = (ts.tolist()
                    if gidx is not None and ts is not None else None)
+        # replay plane armed: embed the DECODED feature row per record so
+        # audit segments alone reconstruct a re-scorable window (one
+        # C-speed tolist outside the loop; off = zero cost)
+        x_list = (x.tolist()
+                  if gidx is not None
+                  and getattr(self._audit, "capture_rows", False) else None)
         for i, (tx, p, ridx) in enumerate(zip(txs, plist, fired.tolist())):
             variables = {
                 "transaction": tx,
@@ -1074,7 +1080,7 @@ class Router:
                         if pid is None:
                             continue
                         i = idx_list[j]
-                        audit_rows.append({
+                        row = {
                             "tx": txs[i].get("id"),
                             "uid": meta["uids"][i],
                             "ts": ts_list[i] if ts_list is not None else None,
@@ -1083,7 +1089,18 @@ class Router:
                             "branch": rule.process,
                             "pid": pid,
                             "priority": meta["pris"][i],
-                        })
+                        }
+                        # a replayed transaction carries its origin marker
+                        # through the decode seam; stamping it onto the
+                        # record lets the ReplayVerdictTap divert the
+                        # verdict to the parity join instead of the
+                        # provenance log
+                        mk = txs[i].get("_replay")
+                        if mk is not None:
+                            row["replay"] = mk
+                        if x_list is not None:
+                            row["row"] = x_list[i]
+                        audit_rows.append(row)
         if audit_rows:
             self._audit.record_batch(
                 audit_rows,

@@ -271,6 +271,16 @@ def _admission_counter(registry):
     )
 
 
+def _bulk_ceiling_gauge(registry):
+    return registry.gauge(
+        "ccfd_bulk_ceiling",
+        "operator-settable bulk admission ceiling by stage: the fraction "
+        "of the stage's adaptive budget that bulk-class work (replay "
+        "re-drives, backtests) may occupy — the replay plane's pacing "
+        "actuator; 1.0 means bulk is bounded only by priority shedding",
+    )
+
+
 class OverloadControl:
     """Router/bus-side overload plane; ONE instance per router pool.
 
@@ -317,6 +327,13 @@ class OverloadControl:
         )
         self._dispatcher = None
         self._mu = threading.Lock()
+        # bulk ceiling (replay pacing hook): the fraction of the adaptive
+        # budget limit that bulk rows may occupy within one poll's
+        # admission — live (normal/critical) traffic keeps the rest of
+        # the stage no matter how hard a replay saturates the bus
+        self._bulk_ceiling = 1.0
+        self._g_bulk_ceiling = _bulk_ceiling_gauge(registry)
+        self._g_bulk_ceiling.set(1.0, labels={"stage": "bus"})
         # incident flight recorder (observability/incident.py): when wired
         # by the operator, every watchdog kill snapshots the system state
         # into the recorder's ring — post-mortem evidence for hung-
@@ -403,6 +420,24 @@ class OverloadControl:
                 keep_idx = kept
 
         keep_idx = list(keep_idx)
+        frac = self._bulk_ceiling
+        if frac < 1.0 and keep_idx:
+            # cap bulk occupancy at frac x the CURRENT adaptive limit:
+            # the ceiling tracks AIMD, so a stage that slows under live
+            # load automatically tightens the replay share too
+            cap = max(0, int(frac * self.budget.limit))
+            kept: list[int] = []
+            bulk_kept = 0
+            for i in keep_idx:
+                if pris[i] == PRIORITY_BULK:
+                    if bulk_kept >= cap:
+                        key = (pris[i], "bulk_ceiling")
+                        shed_by[key] = shed_by.get(key, 0) + 1
+                        shed_rows += 1
+                        continue
+                    bulk_kept += 1
+                kept.append(i)
+            keep_idx = kept
         if prepaid:
             # every consumed row was reserved at poll time; hand the shed
             # rows' reservation back
@@ -444,6 +479,18 @@ class OverloadControl:
         if len(keep_idx) == n:
             return records, 0
         return [records[i] for i in keep_idx], shed_rows
+
+    # -- bulk ceiling (the replay plane's pacing actuator) -----------------
+    def set_bulk_ceiling(self, frac: float) -> None:
+        """Clamp bulk-class bus admission to ``frac`` of the adaptive
+        budget limit (0..1). 1.0 restores shed-order-only semantics."""
+        frac = min(1.0, max(0.0, float(frac)))
+        self._bulk_ceiling = frac
+        self._g_bulk_ceiling.set(frac, labels={"stage": "bus"})
+
+    @property
+    def bulk_ceiling(self) -> float:
+        return self._bulk_ceiling
 
     # -- stage feedback ----------------------------------------------------
     def observe_stage(self, latency_s: float) -> None:
@@ -510,6 +557,12 @@ class AdmissionGate:
         self.retry_after_s = float(retry_after_s)
         self._c_admit = _admission_counter(registry)
         self._c_shed = _shed_counter(registry)
+        # per-instance ceilings so the replay plane can tighten/relax the
+        # bulk share live without touching the class default
+        self._ceilings = dict(self.UTIL_CEILING)
+        self._g_bulk_ceiling = _bulk_ceiling_gauge(registry)
+        self._g_bulk_ceiling.set(self._ceilings[PRIORITY_BULK],
+                                 labels={"stage": self.stage})
 
     @staticmethod
     def from_config(cfg, registry, max_rows: int) -> "AdmissionGate | None":
@@ -522,8 +575,19 @@ class AdmissionGate:
         )
         return AdmissionGate(budget, registry)
 
+    def set_bulk_ceiling(self, frac: float) -> None:
+        """Move the bulk utilization ceiling live (0..1) — the serving-
+        side half of the replay pacing knob."""
+        frac = min(1.0, max(0.0, float(frac)))
+        self._ceilings[PRIORITY_BULK] = frac
+        self._g_bulk_ceiling.set(frac, labels={"stage": self.stage})
+
+    @property
+    def bulk_ceiling(self) -> float:
+        return self._ceilings[PRIORITY_BULK]
+
     def try_admit(self, rows: int, priority: int = PRIORITY_NORMAL) -> bool:
-        ceiling = self.UTIL_CEILING.get(priority, 0.9)
+        ceiling = self._ceilings.get(priority, 0.9)
         ok = self.budget.try_reserve(rows, ceiling=ceiling)
         name = PRIORITY_NAMES.get(priority, "normal")
         self._c_admit.inc(rows, labels={

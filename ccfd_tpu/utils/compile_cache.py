@@ -7,9 +7,11 @@ compilation cache keeps compiled executables on disk keyed by HLO +
 compile options + platform, so only the FIRST process ever pays.
 
 ``enable()`` is called by the CLI for jax-using commands and by bench.py;
-CCFD_COMPILE_CACHE overrides the location, ``0``/``off`` disables.
-Failures (read-only fs, old jax) degrade silently to no caching — the
-cache is an optimization, never a requirement.
+CCFD_COMPILE_CACHE overrides the location, ``0``/``off`` disables. On the
+CPU backend it is OFF unless explicitly pointed at a directory — XLA:CPU
+reload of persisted executables is unsafe (see ``enable``). Failures
+(read-only fs, old jax) degrade silently to no caching — the cache is an
+optimization, never a requirement.
 """
 
 from __future__ import annotations
@@ -41,20 +43,35 @@ def _host_fingerprint() -> str:
 
 def enable(path: str | None = None) -> str | None:
     """Point jax at a persistent on-disk compilation cache; returns the
-    directory in use, or None when disabled/unavailable."""
+    directory in use, or None when disabled/unavailable.
+
+    On the CPU backend the cache defaults OFF unless an explicit ``path``
+    or CCFD_COMPILE_CACHE directory opts in: XLA:CPU's cache RELOAD is not
+    trustworthy. Beyond the cross-host SIGILL risk above, reloading a
+    donated multi-device executable written by a previous process can
+    return one that computes garbage — observed with the 8-virtual-device
+    sharded train step, which reloads to a deterministically wrong loss on
+    its first step and scribbled donated buffers after. CPU compiles cost
+    seconds; the cache exists for the 20-40s-per-shape TPU tunnel
+    compiles, where executables are serialized protos, not AOT machine
+    code.
+    """
     env = os.environ.get("CCFD_COMPILE_CACHE", "")
     if env.strip().lower() in ("0", "off", "false", "no"):
         return None
-    base = path or env or os.path.join(
-        os.path.expanduser("~"), ".cache", "ccfd_tpu", "xla"
-    )
-    # fingerprint under overridden bases too: a shared CCFD_COMPILE_CACHE
-    # on a heterogeneous fleet is exactly where cross-host AOT reuse bites
-    target = os.path.join(base, _host_fingerprint())
     try:
-        os.makedirs(target, exist_ok=True)
         import jax
 
+        if path is None and not env.strip() and jax.default_backend() == "cpu":
+            return None
+        base = path or env or os.path.join(
+            os.path.expanduser("~"), ".cache", "ccfd_tpu", "xla"
+        )
+        # fingerprint under overridden bases too: a shared
+        # CCFD_COMPILE_CACHE on a heterogeneous fleet is exactly where
+        # cross-host AOT reuse bites
+        target = os.path.join(base, _host_fingerprint())
+        os.makedirs(target, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", target)
         # cache even quick compiles: the tunnel round trip dominates, and
         # the scorer's small buckets compile fast but re-run often

@@ -5,7 +5,9 @@ kill switch. The host fingerprint matters because XLA:CPU persists AOT
 machine code for the build host's exact CPU features; a different host
 loading those artifacts risks SIGILL (cpu_aot_loader warns about this),
 so each CPU identity must get its own directory — including under an
-operator-overridden base, where cross-host sharing is most likely.
+operator-overridden base, where cross-host sharing is most likely. The
+cpu-backend default-off gate matters because even same-host XLA:CPU
+reloads are wrong for donated multi-device executables.
 """
 
 import os
@@ -37,12 +39,28 @@ def test_fingerprint_stable_and_short():
 
 
 def test_enable_uses_fingerprinted_dir(tmp_path, _restore_jax_cache_config):
+    # a tpu backend gets the cache by default; cpu is gated (test below)
     with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": ""}), \
-         mock.patch("os.path.expanduser", return_value=str(tmp_path)):
+         mock.patch("os.path.expanduser", return_value=str(tmp_path)), \
+         mock.patch("jax.default_backend", return_value="tpu"):
         target = compile_cache.enable()
     assert target is not None
     assert os.path.basename(target) == compile_cache._host_fingerprint()
     assert os.path.isdir(target)
+
+
+def test_enable_defaults_off_on_cpu_backend(tmp_path, _restore_jax_cache_config):
+    """XLA:CPU reload of a donated multi-device executable from a prior
+    process computes garbage (the order-dependent test_partition flake),
+    so a bare enable() on the cpu backend must stay off; pointing
+    CCFD_COMPILE_CACHE at a directory is an explicit operator opt-in."""
+    assert jax.default_backend() == "cpu"
+    with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": ""}):
+        assert compile_cache.enable() is None
+    opt_in = str(tmp_path / "forced")
+    with mock.patch.dict(os.environ, {"CCFD_COMPILE_CACHE": opt_in}):
+        target = compile_cache.enable()
+    assert target == os.path.join(opt_in, compile_cache._host_fingerprint())
 
 
 def test_enable_off_switch():

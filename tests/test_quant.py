@@ -47,7 +47,10 @@ def test_quantized_numpy_matches_device_math():
     qp = quant.quantize_mlp(params)
     dev = np.asarray(quant.apply(qp, jnp.asarray(ds.X[:256])))
     host = quant.apply_numpy(jax.tree.map(np.asarray, qp), ds.X[:256])
-    np.testing.assert_allclose(host, dev, atol=2e-5)
+    # numpy and XLA accumulate the float32 scale-multiply in different
+    # orders (XLA fuses/splits by thread count); 1e-4 on a probability is
+    # still ~300× finer than the 0.03 accuracy contract above
+    np.testing.assert_allclose(host, dev, atol=1e-4)
 
 
 def test_weights_are_int8_and_scales_per_channel():
@@ -101,7 +104,8 @@ def test_registered_model_serves_through_scorer():
     out_host = s.score(ds.X[:32])      # host tier (numpy quantized math)
     out_dev = s.score_pipelined(ds.X[:128], depth=1)[:32]  # device path
     assert out_host.shape == (32,)
-    np.testing.assert_allclose(out_host, out_dev, atol=2e-5)
+    # host numpy vs device XLA: same int8 math, reduction-order-only drift
+    np.testing.assert_allclose(out_host, out_dev, atol=1e-4)
     want = np.asarray(
         mlp.apply(params, jnp.asarray(ds.X[:32]), compute_dtype=jnp.float32)
     )

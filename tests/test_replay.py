@@ -1,0 +1,525 @@
+"""Bulk replay & backtest plane (ISSUE 17; ccfd_tpu/replay/).
+
+Divergence classification precedence, the route-seam verdict tap (live
+rows forwarded / replay rows diverted / never raising), the windowed
+read-only segment scan + ``?until=`` listing bound, the overload plane's
+bulk admission ceiling, crash-resume through the durability-seam cursor
+(kill at the cursor boundary AND mid-batch, torn-cursor generation
+fallback — exactly-once accounting every time), what-if backtests, and
+the operator/CLI wiring.
+
+The live stack here is an echo router: a thread consuming the bus topic
+and stamping verdicts through the tap exactly like the route seam does,
+with a deterministic score (the first feature) so parity is byte-exact
+by construction — these tests pin the replay plane's mechanics; the
+full-stack byte-parity claim is tools/replay_smoke.py's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.audit import AuditLog
+from ccfd_tpu.replay.service import (
+    CAUSE_CHAMPION_HASH,
+    CAUSE_NONDETERMINISM,
+    CAUSE_THRESHOLD,
+    CAUSE_TIER,
+    ReplayKilled,
+    ReplayService,
+    ReplayVerdictTap,
+    bundle_window,
+    classify_divergence,
+)
+
+
+def _rec(i: int, proba: float = 0.5, **over) -> dict:
+    row = [0.0] * len(FEATURE_NAMES)
+    row[0] = proba  # the echo stack scores the first feature
+    base = {
+        "tx": f"tx-{i}", "uid": f"0:{i}", "seq": i, "ts": 100.0 + i,
+        "proba": proba, "rule": "none", "branch": "legit",
+        "tier": "device", "threshold": 0.5, "hash": "h1", "row": row,
+    }
+    base.update(over)
+    return base
+
+
+def _window(n: int) -> list[dict]:
+    return [_rec(i, proba=0.25 + i / 1000.0) for i in range(n)]
+
+
+class EchoStack:
+    """The live path, minimally: bus consumer -> deterministic score ->
+    tap.record_batch — the same seam shape the router drives."""
+
+    def __init__(self, broker, cfg, tap, *, tier="device", threshold=0.5):
+        self.tap = tap
+        self.tier = tier
+        self.threshold = threshold
+        self.scored: list[str] = []  # every uid scored (at-least-once log)
+        self._consumer = broker.consumer("echo", (cfg.kafka_topic,))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            recs = self._consumer.poll(1024, timeout_s=0.05)
+            rows = []
+            for r in recs:
+                tx = r.value
+                mk = tx.get("_replay")
+                if mk is not None:
+                    self.scored.append(str(mk.get("uid")))
+                rows.append({
+                    "tx": tx.get("id"), "uid": f"{r.partition}:{r.offset}",
+                    "ts": 0.0, "proba": float(tx[FEATURE_NAMES[0]]),
+                    "rule": "none", "branch": "legit", "pid": None,
+                    "replay": mk,
+                })
+            if rows:
+                self.tap.record_batch(rows, tier=self.tier,
+                                      threshold=self.threshold)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._consumer.close()
+
+
+@pytest.fixture
+def stack(tmp_path):
+    cfg = Config()
+    broker = Broker(default_partitions=1)
+    tap = ReplayVerdictTap(registry=Registry())
+    echo = EchoStack(broker, cfg, tap)
+    svc = ReplayService(cfg, broker, None, tap=tap, registry=Registry(),
+                        state_dir=str(tmp_path / "replay"))
+    svc.timeout_s = 5.0
+    yield cfg, broker, tap, echo, svc
+    svc.stop()
+    echo.close()
+    broker.close()
+
+
+class TestClassification:
+    def test_parity_holds_when_verdict_byte_equal(self):
+        assert classify_divergence(_rec(0), _rec(0)) is None
+        # a hash mismatch alone is NOT a divergence: the verdict is what
+        # conserves, and a promote that decides identically holds parity
+        assert classify_divergence(_rec(0), _rec(0, hash="h2")) is None
+
+    def test_precedence_champion_hash_first(self):
+        rec = _rec(0, proba=0.3)
+        rep = _rec(0, proba=0.4, hash="h2", tier="host", threshold=0.6)
+        assert classify_divergence(rec, rep) == CAUSE_CHAMPION_HASH
+
+    def test_tier_then_threshold_then_nondeterminism(self):
+        rec = _rec(0, proba=0.3)
+        assert classify_divergence(
+            rec, _rec(0, proba=0.4, tier="host")) == CAUSE_TIER
+        assert classify_divergence(
+            rec, _rec(0, proba=0.3, threshold=0.9)) == CAUSE_THRESHOLD
+        assert classify_divergence(
+            rec, _rec(0, proba=0.30000001)) == CAUSE_NONDETERMINISM
+
+    def test_missing_hash_never_blames_the_champion(self):
+        rec = _rec(0, proba=0.3, hash=None)
+        rep = _rec(0, proba=0.4, hash="h2")
+        assert classify_divergence(rec, rep) == CAUSE_NONDETERMINISM
+
+    def test_bundle_window_brackets_decisions(self):
+        assert bundle_window({"decisions": [
+            {"seq": 7}, {"seq": 3}, {"seq": 11}, {"seq": "bad"},
+        ]}) == (3, 11)
+        assert bundle_window({"decisions": []}) is None
+        assert bundle_window({}) is None
+
+
+class TestVerdictTap:
+    def test_splits_live_from_replay(self):
+        inner = AuditLog()
+        reg = Registry()
+        tap = ReplayVerdictTap(inner=inner, registry=reg)
+        got: list = []
+        tap.arm(lambda rows, **kw: got.extend(rows))
+        live = {"tx": "tx-a", "uid": "0:0", "ts": 1.0, "proba": 0.1,
+                "rule": "none", "branch": "legit", "pid": None}
+        rep = dict(live, tx="tx-b", uid="0:1",
+                   replay={"w": "w1", "uid": "0:9"})
+        tap.record_batch([live, rep], tier="device")
+        assert inner.get("tx-a") is not None  # live forwarded
+        assert inner.get("tx-b") is None      # replay diverted
+        assert len(got) == 1 and got[0]["replay"]["uid"] == "0:9"
+        assert reg.counter("ccfd_replay_verdicts_total").value(
+            {"fate": "joined"}) == 1
+
+    def test_orphaned_when_no_window_armed_and_sink_errors_swallowed(self):
+        reg = Registry()
+        tap = ReplayVerdictTap(registry=reg)
+        rep = {"tx": "t", "uid": "0:0", "ts": 1.0, "proba": 0.1,
+               "rule": "none", "branch": "legit", "pid": None,
+               "replay": {"w": "w1", "uid": "0:0"}}
+        tap.record_batch([rep], tier="device")  # no sink: orphaned
+        assert reg.counter("ccfd_replay_verdicts_total").value(
+            {"fate": "orphaned"}) == 1
+
+        def boom(rows, **kw):
+            raise RuntimeError("join died")
+
+        tap.arm(boom)
+        tap.record_batch([rep], tier="device")  # must not raise
+
+    def test_capture_rows_delegates_to_inner(self):
+        inner = AuditLog()
+        tap = ReplayVerdictTap(inner=inner)
+        assert tap.capture_rows is False
+        inner.capture_rows = True
+        assert tap.capture_rows is True
+
+
+class TestWindowScan:
+    def _log(self, tmp_path, n=10):
+        # ticking clock: one record_batch per row so each record gets a
+        # distinct decided_ts (what /decisions?since=&until= filters on)
+        ticks = iter(float(100 + i) for i in range(1000))
+        log = AuditLog(dir=str(tmp_path / "audit"), registry=Registry(),
+                       clock=lambda: next(ticks))
+        log.capture_rows = True
+        for i in range(n):
+            log.record_batch([
+                {"tx": f"tx-{i}", "uid": f"0:{i}", "ts": 100.0 + i,
+                 "proba": 0.5, "rule": "none", "branch": "legit",
+                 "pid": None, "row": [float(i)] * 3}
+            ], tier="device", threshold=0.5)
+        log.flush()
+        return log
+
+    def test_scan_window_bounds_inclusive_and_rows_embedded(self, tmp_path):
+        log = self._log(tmp_path)
+        recs = log.scan_window(3, 6)
+        assert [r["seq"] for r in recs] == [3, 4, 5, 6]
+        assert all(r["row"] == [float(r["seq"])] * 3 for r in recs)
+
+    def test_scan_dedupes_latest_stamp_wins(self, tmp_path):
+        log = self._log(tmp_path, n=4)
+        # crash-replay re-drive: same bus coordinate re-stamped
+        log.record_batch([
+            {"tx": "tx-2", "uid": "0:2", "ts": 999.0, "proba": 0.9,
+             "rule": "none", "branch": "legit", "pid": None,
+             "row": [2.0] * 3}
+        ], tier="rules")
+        log.flush()
+        recs = log.scan_window()
+        assert len(recs) == 4
+        assert {r["uid"]: r["tier"] for r in recs}["0:2"] == "rules"
+
+    def test_scan_never_mutates_segments(self, tmp_path):
+        log = self._log(tmp_path)
+        seg_dir = str(tmp_path / "audit")
+        newest = sorted(os.listdir(seg_dir))[-1]
+        with open(os.path.join(seg_dir, newest), "ab") as f:
+            f.write(b"CCFDSUM1 torn")  # a crash's torn tail
+        before = {f: os.path.getsize(os.path.join(seg_dir, f))
+                  for f in os.listdir(seg_dir)}
+        recs = log.scan_window()
+        assert len(recs) == 10  # the valid prefix still scans
+        after = {f: os.path.getsize(os.path.join(seg_dir, f))
+                 for f in os.listdir(seg_dir)}
+        assert after == before  # read-only: the torn tail survives
+
+    def test_list_until_bounds_the_listing(self, tmp_path):
+        log = self._log(tmp_path)
+        out = log.list(since=101.5, until=104.5, limit=100)
+        assert [s["tx"] for s in out] == ["tx-4", "tx-3", "tx-2"]
+
+
+class TestBulkCeiling:
+    def test_overload_admit_caps_bulk_share(self):
+        from ccfd_tpu.runtime.overload import (
+            AdaptiveInflightBudget,
+            OverloadControl,
+        )
+
+        reg = Registry()
+        ov = OverloadControl(
+            reg, AdaptiveInflightBudget(100, registry=reg))
+        recs = [type("R", (), {"headers": {"priority": "bulk"},
+                               "value": i})() for i in range(80)]
+        keep, shed = ov.admit(recs)
+        assert len(keep) == 80  # ceiling 1.0: everything fits the budget
+        ov.budget.release(len(keep))
+        ov.set_bulk_ceiling(0.25)
+        assert ov.bulk_ceiling == 0.25
+        keep, shed = ov.admit(recs)
+        assert len(keep) == 25  # int(0.25 * limit 100)
+        ov.budget.release(len(keep))
+        assert reg.counter("ccfd_shed_total").value(
+            {"priority": "bulk", "stage": "bulk_ceiling"}) == 55
+        assert reg.gauge("ccfd_bulk_ceiling").value(
+            {"stage": "bus"}) == 0.25
+
+    def test_gate_ceiling_settable_live(self):
+        from ccfd_tpu.runtime.overload import (
+            AdaptiveInflightBudget,
+            AdmissionGate,
+            PRIORITY_BULK,
+        )
+
+        reg = Registry()
+        gate = AdmissionGate(AdaptiveInflightBudget(100, registry=reg), reg)
+        gate.set_bulk_ceiling(0.1)
+        assert gate.bulk_ceiling == 0.1
+        assert gate.try_admit(10, PRIORITY_BULK) is True
+        assert gate.try_admit(10, PRIORITY_BULK) is False  # over 10%
+
+    def test_service_sets_and_restores_ceilings(self, stack):
+        cfg, broker, tap, echo, svc = stack
+
+        class FakeOv:
+            bulk_ceiling = 1.0
+
+            def set_bulk_ceiling(self, f):
+                self.bulk_ceiling = f
+
+        ov = FakeOv()
+        svc.overload = ov
+        svc.bulk_ceiling = 0.4
+        seen = []
+        svc.crash_hook = lambda ev, bi: seen.append(ov.bulk_ceiling)
+        svc.run_window(window=_window(8), window_id="w-ceil")
+        assert seen and all(c == 0.4 for c in seen)  # in force mid-window
+        assert ov.bulk_ceiling == 1.0                # restored after
+
+
+class TestReplayWindow:
+    def test_clean_window_holds_parity(self, stack):
+        cfg, broker, tap, echo, svc = stack
+        svc.lineage_fn = lambda: ("v1", "h1")
+        report = svc.run_window(window=_window(20), window_id="w-clean")
+        assert report["parity"] is True
+        assert report["match"] == report["total"] == report["replayed"] == 20
+        assert report["divergence"] == report["drop"] == report["ghost"] == 0
+
+    def test_divergence_counted_and_classified(self, stack):
+        cfg, broker, tap, echo, svc = stack
+        svc.lineage_fn = lambda: ("v2", "h2")
+        win = _window(10)
+        win[3] = dict(win[3], proba=0.9)  # recorded under the old champion
+        report = svc.run_window(window=win, window_id="w-div")
+        assert report["parity"] is False
+        assert report["match"] == 9 and report["divergence"] == 1
+        assert report["causes"] == {CAUSE_CHAMPION_HASH: 1}
+        f = [x for x in report["findings"] if x["kind"] == "divergence"][0]
+        assert f["uid"] == "0:3" and f["cause"] == CAUSE_CHAMPION_HASH
+
+    def test_rows_without_features_are_counted_not_replayed(self, stack):
+        cfg, broker, tap, echo, svc = stack
+        svc.lineage_fn = lambda: ("v1", "h1")
+        win = _window(6)
+        win[1] = dict(win[1])
+        win[1].pop("row")  # recorded before capture was armed
+        report = svc.run_window(window=win, window_id="w-norow")
+        assert report["no_row"] == 1
+        assert report["total"] == 5 and report["match"] == 5
+
+
+class TestCrashResume:
+    def _svc(self, cfg, broker, tap, state_dir):
+        svc = ReplayService(cfg, broker, None, tap=tap, registry=Registry(),
+                            state_dir=state_dir)
+        svc.batch = 4
+        svc.timeout_s = 5.0
+        svc.lineage_fn = lambda: ("v1", "h1")
+        return svc
+
+    def test_kill_at_cursor_boundary_resumes_exactly_once(self, stack,
+                                                          tmp_path):
+        cfg, broker, tap, echo, svc0 = stack
+        svc0.stop()
+        state = str(tmp_path / "cursor-a")
+        win = _window(12)
+
+        svc = self._svc(cfg, broker, tap, state)
+
+        def kill(event, bi):
+            if event == "committed" and bi == 0:
+                raise ReplayKilled()
+
+        svc.crash_hook = kill
+        with pytest.raises(ReplayKilled):
+            svc.run_window(window=win, window_id="w-kill")
+
+        # restart: a FRESH worker, same durable state dir
+        svc2 = self._svc(cfg, broker, tap, state)
+        report = svc2.run_window(window=win, window_id="w-kill")
+        assert report["resumed_at"] == 4  # batch 0 never re-scored
+        assert report["match"] == report["total"] == 12  # no gap, no double
+        assert report["parity"] is True and report["dup"] == 0
+        # exactly-once accounting even though re-production is
+        # at-least-once: batch 0's uids were scored exactly once
+        batch0 = {f"0:{i}" for i in range(4)}
+        assert all(echo.scored.count(u) == 1 for u in batch0)
+
+    def test_kill_mid_batch_completes_without_gap(self, stack, tmp_path):
+        cfg, broker, tap, echo, svc0 = stack
+        svc0.stop()
+        state = str(tmp_path / "cursor-b")
+        win = _window(12)
+
+        svc = self._svc(cfg, broker, tap, state)
+
+        def kill(event, bi):
+            # after batch 1 hit the bus, before its verdicts committed
+            if event == "produced" and bi == 1:
+                raise ReplayKilled()
+
+        svc.crash_hook = kill
+        with pytest.raises(ReplayKilled):
+            svc.run_window(window=win, window_id="w-mid")
+
+        # batch 1 is on the bus: let its verdicts land in the DEAD
+        # worker's join (tap still armed there, harmless) before the
+        # fresh worker re-arms — a real restart has this gap too, and
+        # any verdict arriving between arm and window registration
+        # would count as a ghost
+        b1 = {f"0:{i}" for i in range(4, 8)}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with svc._cv:
+                if b1 <= set(svc._inbox.get("w-mid", {})):
+                    break
+            time.sleep(0.02)
+
+        svc2 = self._svc(cfg, broker, tap, state)
+        report = svc2.run_window(window=win, window_id="w-mid")
+        assert report["resumed_at"] == 4   # cursor held batch 0 only
+        assert report["match"] == report["total"] == 12
+        assert report["parity"] is True
+        # batch 1 legitimately re-produced (at-least-once) but every
+        # verdict joined exactly once into the final accounting
+        assert report["dup"] == 0
+
+    def test_torn_cursor_falls_back_a_generation(self, stack, tmp_path):
+        cfg, broker, tap, echo, svc0 = stack
+        svc0.stop()
+        state = str(tmp_path / "cursor-c")
+        win = _window(12)
+
+        svc = self._svc(cfg, broker, tap, state)
+
+        def kill(event, bi):
+            if event == "committed" and bi == 1:
+                raise ReplayKilled()
+
+        svc.crash_hook = kill
+        with pytest.raises(ReplayKilled):
+            svc.run_window(window=win, window_id="w-torn")
+
+        # tear the main cursor AND its newest retained generation (every
+        # write lands a same-content generation copy, so main alone
+        # would fall back losslessly): the durability seam must serve
+        # the PREVIOUS generation — one batch earlier — not crash or
+        # restart the window. Torn bytes keep the frame magic, like a
+        # real crash mid-write of a framed artifact.
+        cur_path = svc._cursor_path("w-torn")
+        base = os.path.basename(cur_path)
+        gens = sorted(f for f in os.listdir(state)
+                      if f.startswith(base + ".g"))
+        assert len(gens) >= 2  # one per committed batch
+        for victim in (cur_path, os.path.join(state, gens[-1])):
+            with open(victim, "wb") as f:
+                f.write(b"CCFDSUM1 torn-mid-write")
+
+        svc2 = self._svc(cfg, broker, tap, state)
+        report = svc2.run_window(window=win, window_id="w-torn")
+        # generation fallback resumed one batch earlier: the lost batch
+        # re-joins (idempotent), nothing gaps and nothing double-counts
+        assert report["resumed_at"] == 4
+        assert report["match"] == report["total"] == 12
+        assert report["parity"] is True
+
+    def test_unrecoverable_cursor_restarts_the_window(self, stack,
+                                                      tmp_path):
+        cfg, broker, tap, echo, svc0 = stack
+        svc0.stop()
+        state = str(tmp_path / "cursor-d")
+        win = _window(8)
+
+        svc = self._svc(cfg, broker, tap, state)
+
+        def kill(event, bi):
+            if event == "committed" and bi == 0:
+                raise ReplayKilled()
+
+        svc.crash_hook = kill
+        with pytest.raises(ReplayKilled):
+            svc.run_window(window=win, window_id="w-dead")
+
+        # main AND every generation corrupted: restart from zero
+        cur_path = svc._cursor_path("w-dead")
+        base = os.path.basename(cur_path)
+        for f in os.listdir(state):
+            if f.startswith(base):
+                with open(os.path.join(state, f), "wb") as fh:
+                    fh.write(b"CCFDSUM1 torn")
+        svc2 = self._svc(cfg, broker, tap, state)
+        report = svc2.run_window(window=win, window_id="w-dead")
+        assert report["resumed_at"] == 0
+        assert report["match"] == report["total"] == 8
+
+
+class TestWhatIf:
+    def test_threshold_swap_diffs_host_side(self):
+        cfg = Config()
+        svc = ReplayService(cfg, None, None)  # no bus: backtests are local
+        win = [_rec(i, proba=0.1 * i) for i in range(10)]  # 0.0 .. 0.9
+        report = svc.run_window(window=win, mode="whatif", threshold=0.8)
+        # recorded threshold 0.5: rows 0.5-0.7 flip fraud -> legit
+        assert report["mode"] == "whatif" and report["flips"] == 3
+        assert report["mean_abs_delta"] == 0.0  # same scores, new line
+        flipped = {f["uid"] for f in report["findings"]}
+        assert flipped == {"0:5", "0:6", "0:7"}
+
+    def test_challenger_score_fn_diffs_scores(self):
+        import numpy as np
+
+        cfg = Config()
+        svc = ReplayService(cfg, None, None)
+        win = [_rec(i, proba=0.2) for i in range(4)]
+
+        def challenger(x: "np.ndarray") -> "np.ndarray":
+            return np.full((x.shape[0],), 0.9, np.float32)
+
+        report = svc.run_window(window=win, mode="whatif",
+                                score_fn=challenger)
+        assert report["challenger"] is True
+        assert report["flips"] == 4  # 0.2 < 0.5 <= 0.9: all flip to fraud
+        assert report["mean_abs_delta"] == pytest.approx(0.7, abs=1e-6)
+
+
+class TestServiceLoop:
+    def test_submit_drains_through_supervised_run(self, stack):
+        cfg, broker, tap, echo, svc = stack
+        svc.lineage_fn = lambda: ("v1", "h1")
+        t = threading.Thread(target=svc.run, daemon=True)
+        t.start()
+        svc.submit(window=_window(6), window_id="w-loop")
+        deadline = time.monotonic() + 10
+        while svc.last_report is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        svc.stop()
+        t.join(timeout=5)
+        assert svc.last_report is not None
+        assert svc.last_report["window_id"] == "w-loop"
+        assert svc.last_report["parity"] is True

@@ -330,6 +330,18 @@ def main() -> int:
                     "DecisionRecord through kill-storms; the ok-gate "
                     "requires exact conservation (routed == recorded) "
                     "and that re-stamps only appear with crash restores")
+    ap.add_argument("--replay", action="store_true",
+                    help="ISSUE 17: fold verdict-parity into the ok-gate "
+                    "— after the storm settles, a window recorded DURING "
+                    "the storm is re-scored through the same live stack "
+                    "(ccfd_tpu/replay/) at bulk priority; any drop, "
+                    "ghost or unexplained divergence fails the exit "
+                    "gate (champion_hash divergences are tolerated only "
+                    "when --lifecycle actually promoted). Implies "
+                    "--audit (the window source is the decision log).")
+    ap.add_argument("--replay-rows", type=int, default=512,
+                    help="size of the storm-recorded window the replay "
+                    "drill re-scores")
     ap.add_argument("--lockcheck", action="store_true",
                     help="arm the runtime lock-order sanitizer (analysis/"
                     "lockcheck.py; CCFD_LOCKCHECK=1 implies it): every "
@@ -350,6 +362,9 @@ def main() -> int:
         # the end-of-run hash-parity claim (serving fingerprint ==
         # lineage champion checkpoint_hash) needs the lineage running
         args.lifecycle = True
+    if args.replay:
+        # the replay drill's window source is the decision log
+        args.audit = True
 
     bus_dir = args.bus_log or tempfile.mkdtemp(prefix="ccfd_soak_bus_")
     # audit ON: it is the accounting ledger this soak asserts over
@@ -507,12 +522,44 @@ def main() -> int:
     # seam as transaction_outgoing_total) into the soak's accounting.
     decision_audit = None
     audit_flusher = None
+    router_audit = None
+    replay_tap = None
+    replay_lineage = None
     if args.audit:
         from ccfd_tpu.observability.audit import AuditLog  # noqa: E402
 
         decision_audit = AuditLog(
             dir=tempfile.mkdtemp(prefix="ccfd_soak_audit_"),
             registry=reg_r)
+        router_audit = decision_audit
+        if args.replay:
+            # ISSUE 17: the replay drill below re-scores a storm-recorded
+            # window through THIS stack. Feature capture must be armed for
+            # the whole storm (windows are only re-scorable if the route
+            # seam embedded the decoded rows), and the route seam's audit
+            # sink becomes the tap that diverts replay-marked verdicts to
+            # the join instead of re-stamping the provenance log
+            from ccfd_tpu.replay.service import (  # noqa: E402
+                ReplayVerdictTap,
+            )
+
+            decision_audit.capture_rows = True
+            if lifecycle is not None:
+                # stamp the champion lineage on every record so a promote
+                # that lands mid-storm classifies as champion_hash (an
+                # explained finding), never as nondeterminism
+                def replay_lineage():
+                    try:
+                        ch = lifecycle.store.champion()
+                        return ((ch.version, ch.checkpoint_hash)
+                                if ch else (None, None))
+                    except Exception:  # noqa: BLE001 - probe races kills
+                        return (None, None)
+
+                decision_audit.lineage_fn = replay_lineage
+            replay_tap = ReplayVerdictTap(inner=decision_audit,
+                                          registry=reg_r)
+            router_audit = replay_tap
         # the flusher runs for the WHOLE soak (the production shape: the
         # operator supervises it) — pending records drain to segments
         # every tick instead of accumulating in memory for the run, so
@@ -535,13 +582,13 @@ def main() -> int:
             max_batch=4096, host_score_fn=host_fn,
             breaker=lifecycle_breaker,
             degrade=degrade,
-            overload=overload, audit=decision_audit)
+            overload=overload, audit=router_audit)
     else:
         router = Router(cfg, broker, score_fn, engine, reg_r, max_batch=4096,
                         host_score_fn=host_fn,
                         breaker=lifecycle_breaker,
                         degrade=degrade,
-                        overload=overload, audit=decision_audit)
+                        overload=overload, audit=router_audit)
     # -- device self-healing under storms (--device-faults, ISSUE 11) ------
     # The DeviceSupervisor owns the soak's scorer: device-fault storms
     # (scheduled below, interleaved with the service kills) must drive the
@@ -1062,6 +1109,56 @@ def main() -> int:
                 "ccfd_audit_dropped_total").value({"reason": "log_write"})),
         }
 
+    # -- verdict-parity replay drill (--replay, ISSUE 17) -------------------
+    # Runs strictly AFTER the conservation numbers above are frozen: the
+    # re-drive routes through the same stack (incrementing the routed
+    # counters) but the tap diverts every replay-marked verdict away from
+    # the provenance log, so routed == recorded stays exactly what the
+    # storm produced. The router must be live again for the drive.
+    replay_res: dict = {}
+    if args.replay and decision_audit is not None:
+        from ccfd_tpu.replay.service import ReplayService  # noqa: E402
+
+        router.resume()
+        recs = decision_audit.scan_window()
+        # a storm-recorded window: re-scorable rows stamped on the device
+        # tier (host-tier rows — small trailing poll batches — replay on
+        # device and may differ in the last ulp; the drill's claim is
+        # byte-parity through the SAME serving tier)
+        window = [r for r in recs
+                  if r.get("row") is not None
+                  and r.get("tier", "device") == "device"]
+        window = window[-max(1, args.replay_rows):]
+        svc = ReplayService(
+            cfg, broker, decision_audit, tap=replay_tap, registry=reg_r,
+            state_dir=tempfile.mkdtemp(prefix="ccfd_soak_replay_"),
+            overload=overload, lineage_fn=replay_lineage)
+        rep = svc.run_window(window=window, window_id="soak-storm")
+        svc.stop()
+        promotions = int(reg_r.counter(
+            "ccfd_lifecycle_promotions_total").value()) if lifecycle else 0
+        # champion_hash is the one EXPLAINED cause a storm can legally
+        # produce (a promote landed between the stamp and the re-drive);
+        # everything else — and any drop or ghost — fails the gate
+        explained = (promotions > 0
+                     and set(rep["causes"]) <= {"champion_hash"})
+        replay_res = {
+            "window": len(window),
+            "recorded_total": len(recs),
+            "replayed": rep["replayed"],
+            "match": rep["match"],
+            "divergence": rep["divergence"],
+            "drop": rep["drop"],
+            "ghost": rep["ghost"],
+            "dup": rep["dup"],
+            "causes": rep["causes"],
+            "rows_per_s": round(rep["rows_per_s"], 1),
+            "parity": rep["parity"],
+            "ok": bool(len(window) > 0 and not rep["stopped"]
+                       and rep["drop"] == 0 and rep["ghost"] == 0
+                       and (rep["parity"] or explained)),
+        }
+
     kills: dict[str, int] = {}
     for _ts, name in monkey.history:
         kills[name] = kills.get(name, 0) + 1
@@ -1162,6 +1259,7 @@ def main() -> int:
         },
         "lifecycle": lifecycle_res,
         "audit": audit_res,
+        "replay": replay_res,
         # device heal evidence (runtime/heal.py): each storm cycle must
         # have quarantined, healed and re-promoted WARM
         "device_heal": {
@@ -1249,6 +1347,10 @@ def main() -> int:
                      or coord.restores > 0)
             )
         )
+        # verdict-parity conservation (--replay): the storm-recorded
+        # window re-scored through the same stack with zero drops, zero
+        # ghosts and no divergence a lifecycle promote doesn't explain
+        and (not args.replay or replay_res.get("ok", False))
         and (
             not args.lifecycle
             or (

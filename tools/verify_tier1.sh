@@ -156,6 +156,24 @@
 #                                          aggregator dumps EXACTLY ONE
 #                                          member-kill incident bundle:
 #                                          FLEETSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --replay-smoke   exit-code-gated smoke of the
+#                                          bulk replay & backtest plane
+#                                          (tools/replay_smoke.py): a
+#                                          recorded window re-scored
+#                                          through the SAME live stack at
+#                                          bulk priority holds byte-
+#                                          stable verdict parity (match
+#                                          == total, 0 drop/ghost), the
+#                                          tap keeps replay verdicts out
+#                                          of the provenance log, one
+#                                          injected swapped-champion
+#                                          divergence is detected AND
+#                                          classified champion_hash, and
+#                                          the scraped burn gauges show
+#                                          zero live-SLO fast-window
+#                                          breaches at full bulk
+#                                          admission:
+#                                          REPLAYSMOKE verdict=PASS|FAIL
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -304,6 +322,18 @@ if [ "${1:-}" = "--fleet-smoke" ]; then
     # prints FLEETSMOKE verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/fleet_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--replay-smoke" ]; then
+    # exit-code-gated smoke of the replay plane: record -> re-drive at
+    # bulk priority -> byte-stable parity, injected divergence detected
+    # + cause-classified, zero live-SLO breaches from the scraped burn
+    # gauges (see tools/replay_smoke.py; prints REPLAYSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/replay_smoke.py; then
         exit 0
     fi
     exit 1
