@@ -217,6 +217,26 @@ class Config:
     # (tmp+rename)
     incident_dir: str = ""                 # CCFD_INCIDENT_DIR
 
+    # --- capacity observatory (observability/capacity.py; CR block
+    # `capacity:`) ---
+    # master switch for the queueing-model plane: per-stage utilization/
+    # headroom/bottleneck fitting, predicted-p99 vs observed, /capacity +
+    # /capacity/whatif, and the service-curve regression sentinel
+    # (CCFD_CAPACITY; 0 is the emergency kill switch — both endpoints 404
+    # and no capacity gauges export)
+    capacity_enabled: bool = True
+    # fit-window tick for the supervised refresh service
+    capacity_interval_s: float = 2.0       # CCFD_CAPACITY_INTERVAL_S
+    # persisted service-curve baseline file ("" = in-memory baseline only:
+    # the sentinel re-arms from live traffic after a restart); writes ride
+    # the PR 13 durability seam (tmp+rename+sha256 sidecar)
+    capacity_baseline_file: str = ""       # CCFD_CAPACITY_BASELINE
+    # sentinel tolerance as a fractional departure from baseline: 1.0
+    # fires past 2x (or under 0.5x) the baseline fitted mean
+    capacity_regression_tolerance: float = 1.0  # CCFD_CAPACITY_REGRESSION_TOL
+    # samples a stage needs before its baseline is captured
+    capacity_min_samples: int = 50         # CCFD_CAPACITY_MIN_SAMPLES
+
     # --- decision provenance audit (observability/audit.py; CR block
     # `audit:`) ---
     # master switch for the per-transaction DecisionRecord plane: the
@@ -680,6 +700,22 @@ class Config:
                 e.get("CCFD_INCIDENT_RING", str(Config.incident_ring))
             ),
             incident_dir=e.get("CCFD_INCIDENT_DIR", Config.incident_dir),
+            capacity_enabled=e.get("CCFD_CAPACITY", "1").strip().lower()
+            not in ("0", "false", "no", "off"),
+            capacity_interval_s=float(
+                e.get("CCFD_CAPACITY_INTERVAL_S",
+                      str(Config.capacity_interval_s))
+            ),
+            capacity_baseline_file=e.get("CCFD_CAPACITY_BASELINE",
+                                         Config.capacity_baseline_file),
+            capacity_regression_tolerance=float(
+                e.get("CCFD_CAPACITY_REGRESSION_TOL",
+                      str(Config.capacity_regression_tolerance))
+            ),
+            capacity_min_samples=int(
+                e.get("CCFD_CAPACITY_MIN_SAMPLES",
+                      str(Config.capacity_min_samples))
+            ),
             slo_interval_s=float(
                 e.get("CCFD_SLO_INTERVAL_S", str(Config.slo_interval_s))
             ),

@@ -35,6 +35,24 @@ paths so the reference's scrape configs (deploy/prometheus.yaml here) remap
                                404 — strict JSON either way, and both
                                endpoints 404 entirely when the audit
                                plane is off (CCFD_AUDIT=0)
+    GET /capacity              fitted capacity-model document (JSON,
+                               schema ccfd.capacity.v1): per-stage
+                               utilization/headroom/knee, predicted vs
+                               observed p50/p99, bottleneck attribution
+                               (observability/capacity.py); 404s entirely
+                               when the plane is off (CCFD_CAPACITY=0)
+    GET /capacity/whatif?workers=&batch=&deadline_ms=&max_inflight=
+                               the same document re-evaluated under the
+                               requested actuator overrides, with a
+                               `whatif` section carrying the predicted-p99
+                               delta — nothing live is touched
+    GET /healthz               one-stop readiness rollup (strict JSON):
+                               200 healthy / 503 degraded, composed from
+                               supervisor service states, device health,
+                               the storage pin gate, fleet parity and the
+                               scorer-edge breaker with per-source cause
+                               strings; 404 when no health composer is
+                               wired (standalone harnesses)
     GET /debug/device          live device-telemetry snapshot (JSON):
                                per-device memory, measured H2D accounting,
                                executable inventory (observability/device.py)
@@ -147,13 +165,17 @@ class MetricsExporter:
                  profiler=None,
                  telemetry=None,
                  recorder=None,
-                 audit=None):
+                 audit=None,
+                 capacity=None,
+                 health=None):
         self._registries = dict(registries)
         self._sink = sink  # observability.trace.SpanSink (or None)
         self._profiler = profiler  # observability.profile.StageProfiler
         self._telemetry = telemetry  # observability.device.DeviceTelemetry
         self._recorder = recorder  # observability.incident.FlightRecorder
         self._audit = audit  # observability.audit.AuditLog
+        self._capacity = capacity  # observability.capacity.CapacityModel
+        self._health = health  # callable -> readiness doc (see healthz())
         self._capture_lock = threading.Lock()  # one device capture at a time
         self._lock = threading.Lock()
         # memory-drift surface (observability/memory.py): a "process"
@@ -183,14 +205,21 @@ class MetricsExporter:
                 openmetrics = "application/openmetrics-text" in (
                     self.headers.get("Accept") or ""
                 )
-                body, ctype = exporter.respond(path, openmetrics, query)
+                if path == "/healthz":
+                    # the one path whose STATUS CODE is the verdict: load
+                    # balancers and probes read 200/503, not the body
+                    body, status = exporter.healthz()
+                    ctype = "application/json"
+                else:
+                    body, ctype = exporter.respond(path, openmetrics, query)
+                    status = 200
                 if body is None:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 data = body.encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -243,6 +272,8 @@ class MetricsExporter:
             return self._incidents(path), "application/json"
         if path == "/decisions" or path.startswith("/decisions/"):
             return self._decisions(path, query), "application/json"
+        if path == "/capacity" or path == "/capacity/whatif":
+            return self._capacity_doc(path, query), "application/json"
         if path == "/debug/device":
             if self._telemetry is None:
                 return None, "application/json"
@@ -313,6 +344,49 @@ class MetricsExporter:
         if rec is None:
             return None
         return json.dumps(rec)
+
+    def _capacity_doc(self, path: str, query: str) -> str | None:
+        """Capacity-model documents (observability/capacity.py). With the
+        plane off (CCFD_CAPACITY=0 -> no model wired) BOTH endpoints 404
+        — the kill-switch contract, like /decisions under CCFD_AUDIT=0."""
+        if self._capacity is None:
+            return None
+        if path == "/capacity":
+            return json.dumps(self._capacity.snapshot())
+        from urllib.parse import parse_qs
+
+        q = parse_qs(query or "")
+
+        def _int(name: str) -> int | None:
+            try:
+                return int(q[name][0]) if q.get(name) else None
+            except ValueError:
+                return None
+
+        def _float(name: str) -> float | None:
+            try:
+                return float(q[name][0]) if q.get(name) else None
+            except ValueError:
+                return None
+
+        return json.dumps(self._capacity.whatif(
+            workers=_int("workers"), batch=_int("batch"),
+            deadline_ms=_float("deadline_ms"),
+            max_inflight=_int("max_inflight")))
+
+    def healthz(self) -> tuple[str | None, int]:
+        """/healthz readiness rollup -> (body, status): None/404 when no
+        health composer is wired (standalone harnesses), else the
+        composed verdict document with 200 healthy / 503 degraded."""
+        if self._health is None:
+            return None, 404
+        try:
+            doc = self._health()
+        # ccfd-lint: disable=counted-drops -- the degraded 503 body carries the probe failure as its cause string
+        except Exception as e:  # noqa: BLE001 - a probe bug reads degraded
+            doc = {"healthy": False, "sources": {},
+                   "causes": [f"health composer error: {e!r}"[:200]]}
+        return json.dumps(doc), (200 if doc.get("healthy") else 503)
 
     def _device_capture(self, query: str) -> str | None:
         """On-demand jax.profiler trace (/debug/profile?seconds=N): the

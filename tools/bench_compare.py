@@ -1,0 +1,221 @@
+"""Bench trajectory ledger: BENCH_r*.json -> append-only BENCH_HISTORY.jsonl.
+
+Each growth round leaves a ``BENCH_r<NN>.json`` capture (run metadata +
+the bench's ``parsed`` summary payload), but the captures are islands:
+nothing compares round N against round N-1, and a capture taken on the
+CPU fallback would compare nonsensically against a TPU capture. This
+tool normalizes every capture into one schema'd JSONL ledger row —
+**platform-labeled** (the ``platform`` field's first token, so
+``"cpu (fallback: ...)"`` rows are ``cpu`` rows and never compare
+against ``tpu`` rows) — and emits a per-row **regression verdict**
+against the last SAME-PLATFORM capture before it: throughput down or
+p99 up by more than the threshold = regressed.
+
+The ledger is append-only: captures already present (by capture name)
+are never rewritten, so history survives re-runs byte for byte and the
+diff of a new round is exactly its own rows. A capture whose ``parsed``
+payload is null (the bench printed no parseable summary — rc may still
+be 0) becomes an ``unparseable`` row with no verdict: the gap is
+RECORDED, not skipped silently.
+
+    python tools/bench_compare.py                  # update + report
+    python tools/bench_compare.py --check          # no writes, verdicts only
+    tools/verify_tier1.sh --bench-compare          # CI gate
+
+Exit codes: 0 ledger updated / verified and no NEW regression, 1 a
+newly-appended row regressed against its platform's prior capture, 2
+unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+HISTORY_SCHEMA = "ccfd.bench_history.v1"
+
+# numeric fields lifted verbatim from the parsed payload into the row
+_FIELDS = ("value", "vs_baseline", "p50_ms", "p99_ms", "p99_e2e_ms",
+           "p99_vs_target", "latency_batch")
+
+
+def normalize_platform(raw) -> str | None:
+    """First token of the bench's platform string: ``"cpu (fallback:
+    accelerator probe failed)"`` -> ``cpu``; ``tpu`` -> ``tpu``."""
+    if not isinstance(raw, str) or not raw.strip():
+        return None
+    return raw.strip().split()[0].lower()
+
+
+def normalize_capture(path: str) -> dict:
+    """One BENCH_r*.json -> one ledger row (without the verdict)."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    with open(path, encoding="utf-8") as f:
+        cap = json.load(f)
+    if not isinstance(cap, dict):
+        raise ValueError(f"{path}: capture is not a mapping")
+    parsed = cap.get("parsed")
+    rc = cap.get("rc")
+    row: dict = {
+        "schema": HISTORY_SCHEMA,
+        "capture": name,
+        "round": cap.get("n"),
+        "rc": rc,
+    }
+    if rc not in (0, None):
+        row["status"] = "failed"
+        row["platform"] = None
+        return row
+    if not isinstance(parsed, dict):
+        # the bench ran but printed no parseable summary line; the hole
+        # in the trajectory is recorded instead of silently dropped
+        row["status"] = "unparseable"
+        row["platform"] = None
+        return row
+    row["status"] = "ok"
+    row["platform"] = normalize_platform(parsed.get("platform"))
+    row["metric"] = parsed.get("metric")
+    row["unit"] = parsed.get("unit")
+    for k in _FIELDS:
+        v = parsed.get(k)
+        if isinstance(v, (int, float)):
+            row[k] = v
+    return row
+
+
+def verdict(row: dict, prior: dict | None, threshold: float) -> dict:
+    """Per-row regression verdict vs the last same-platform capture."""
+    if prior is None:
+        return {"vs": None, "verdict": "no_prior"}
+    out: dict = {"vs": prior["capture"], "verdict": "ok"}
+    regressed = []
+    v0, v1 = prior.get("value"), row.get("value")
+    if isinstance(v0, (int, float)) and isinstance(v1, (int, float)) and v0:
+        ratio = v1 / v0
+        out["throughput_ratio"] = round(ratio, 4)
+        if ratio < 1.0 - threshold:
+            regressed.append(f"throughput x{ratio:.3f}")
+    p0, p1 = prior.get("p99_ms"), row.get("p99_ms")
+    if isinstance(p0, (int, float)) and isinstance(p1, (int, float)) and p0:
+        ratio = p1 / p0
+        out["p99_ratio"] = round(ratio, 4)
+        if ratio > 1.0 + threshold:
+            regressed.append(f"p99 x{ratio:.3f}")
+    if regressed:
+        out["verdict"] = "regressed"
+        out["causes"] = regressed
+    return out
+
+
+def _round_key(row: dict):
+    m = re.search(r"(\d+)$", row["capture"])
+    return int(m.group(1)) if m else 0
+
+
+def load_history(path: str) -> list[dict]:
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i + 1}: corrupt ledger line "
+                                 f"({e})") from e
+            if row.get("schema") != HISTORY_SCHEMA:
+                raise ValueError(f"{path}:{i + 1}: unexpected schema "
+                                 f"{row.get('schema')!r}")
+            rows.append(row)
+    return rows
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--captures", default=os.path.join(repo, "BENCH_r*.json"),
+                    help="glob of bench captures")
+    ap.add_argument("--history", default=os.path.join(
+        repo, "BENCH_HISTORY.jsonl"))
+    ap.add_argument("--threshold", type=float, default=0.3,
+                    help="regression band: throughput below (1-t)x or p99 "
+                    "above (1+t)x the prior same-platform capture")
+    ap.add_argument("--check", action="store_true",
+                    help="verify + report only; write nothing")
+    args = ap.parse_args(argv)
+
+    try:
+        history = load_history(args.history)
+    except ValueError as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    seen = {r["capture"] for r in history}
+
+    captures = sorted(glob.glob(args.captures))
+    if not captures:
+        print(f"bench_compare: no captures match {args.captures!r}",
+              file=sys.stderr)
+        return 2
+    fresh: list[dict] = []
+    for path in captures:
+        name = os.path.splitext(os.path.basename(path))[0]
+        if name in seen:
+            continue
+        try:
+            fresh.append(normalize_capture(path))
+        except (OSError, ValueError) as e:
+            print(f"bench_compare: {e}", file=sys.stderr)
+            return 2
+    fresh.sort(key=_round_key)
+
+    # verdicts: each fresh row vs the last SAME-PLATFORM row before it
+    # (ledger rows first, then earlier fresh rows), never cross-platform
+    last_by_platform: dict[str, dict] = {}
+    for row in sorted(history, key=_round_key):
+        if row.get("status") == "ok" and row.get("platform"):
+            last_by_platform[row["platform"]] = row
+    new_regressions = []
+    for row in fresh:
+        if row["status"] != "ok" or not row.get("platform"):
+            continue
+        prior = last_by_platform.get(row["platform"])
+        row["baseline"] = verdict(row, prior, args.threshold)
+        if row["baseline"]["verdict"] == "regressed":
+            new_regressions.append(row)
+        last_by_platform[row["platform"]] = row
+
+    if fresh and not args.check:
+        with open(args.history, "a", encoding="utf-8") as f:
+            for row in fresh:
+                f.write(json.dumps(row, sort_keys=True) + "\n")
+
+    for row in history + fresh:
+        b = row.get("baseline") or {}
+        mark = {"regressed": "!!", "ok": "  ", "no_prior": "--"}.get(
+            b.get("verdict"), "~~")
+        line = (f"{mark} {row['capture']:<12} {row.get('status'):<12} "
+                f"platform={row.get('platform')}")
+        if row.get("status") == "ok":
+            line += (f" value={row.get('value')} {row.get('unit', '')}"
+                     f" p99={row.get('p99_ms')}ms")
+            if b.get("vs"):
+                line += (f"  vs {b['vs']}:"
+                         f" tp x{b.get('throughput_ratio', '?')}"
+                         f" p99 x{b.get('p99_ratio', '?')}"
+                         f" -> {b['verdict'].upper()}")
+        print(line)
+    print(f"bench_compare: {len(fresh)} new row(s), "
+          f"{len(new_regressions)} regression(s), ledger "
+          f"{'unchanged (--check)' if args.check else args.history}",
+          file=sys.stderr)
+    return 1 if new_regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""Render a ccfd.capacity.v1 document into the human capacity summary.
+
+The CapacityModel (observability/capacity.py) serves its fitted queueing
+model at ``/capacity``; this tool is the operator's first read — which
+stage is the bottleneck and at what admitted rate, per-stage utilization
+and headroom, predicted vs observed p99 with the model's own error
+ratio, any service-curve regressions in flight — and, with ``--workers/
+--batch/--deadline-ms/--max-inflight``, the what-if verdict for a
+proposed actuator move.
+
+    python tools/capacity_report.py --url http://host:9100
+    python tools/capacity_report.py --url ... --workers 4 --batch 2048
+    python tools/capacity_report.py capacity.json        # from disk
+    python tools/capacity_report.py ... --json           # machine form
+
+Exit codes: 0 rendered a valid document, 2 missing/unreadable, 3 the
+document fails schema validation (still rendered best-effort).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ccfd_tpu.observability.capacity import validate_capacity  # noqa: E402
+
+
+def load_doc(args) -> dict | None:
+    if args.url:
+        query = {k: v for k, v in (
+            ("workers", args.workers), ("batch", args.batch),
+            ("deadline_ms", args.deadline_ms),
+            ("max_inflight", args.max_inflight)) if v is not None}
+        path = "/capacity/whatif" if query else "/capacity"
+        url = args.url.rstrip("/") + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    try:
+        with open(args.doc) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read document {args.doc!r}: {e}", file=sys.stderr)
+        return None
+
+
+def render(doc: dict) -> str:
+    lines = []
+    when = doc.get("generated_unix")
+    when_s = (time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(when))
+              if isinstance(when, (int, float)) else "?")
+    model = doc.get("model", {})
+    lines.append(f"CAPACITY [{when_s}]  model={model.get('kind', '?')}  "
+                 f"window={doc.get('window_s')}s  "
+                 f"refreshes={doc.get('refreshes')}")
+    act = doc.get("actuators", {})
+    lines.append("  actuators: " + ", ".join(
+        f"{k}={v}" for k, v in act.items() if v is not None))
+    bn = doc.get("bottleneck")
+    if bn:
+        cap = (f" of {bn.get('max_rows_per_s')} max"
+               if bn.get("max_rows_per_s") else "")
+        lines.append(
+            f"  bottleneck: {bn.get('stage')} [{bn.get('layer')}]  "
+            f"headroom {bn.get('headroom_ratio')}x  "
+            f"rho={bn.get('utilization')}  "
+            f"admitted {bn.get('admitted_rows_per_s')} rows/s{cap}")
+    e2e = doc.get("e2e", {})
+    if e2e:
+        err = e2e.get("error_ratio")
+        lines.append(
+            f"  e2e p99: predicted {e2e.get('predicted_p99_ms')} ms vs "
+            f"observed {e2e.get('observed_p99_ms')} ms"
+            + (f"  (error ratio {err} — trust the model while this is "
+               "small)" if err is not None else ""))
+    stages = doc.get("stages", {})
+    if stages:
+        lines.append("  stage             layer     rows/s      rho  "
+                     "headroom  pred p99    obs p99")
+        for name in sorted(stages):
+            e = stages[name]
+            knee = e.get("knee") or {}
+            lines.append(
+                f"    {name:<15} {e.get('layer', '?'):<9}"
+                f"{e.get('arrival_rows_per_s', 0):>9} "
+                f"{e.get('utilization', 0):>8} "
+                f"{e.get('headroom_ratio', 0):>8}x "
+                f"{e.get('predicted_p99_ms', '-'):>9} "
+                f"{e.get('observed_p99_ms', '-'):>10}"
+                + (f"   knee@{knee['batch']}" if knee else ""))
+        regs = {
+            name: e["regression"] for name, e in sorted(stages.items())
+            if (e.get("regression") or {}).get("fired_total")
+            or (e.get("regression") or {}).get("in_regression")
+        }
+        for name, r in regs.items():
+            flag = "IN REGRESSION" if r.get("in_regression") else "recovered"
+            lines.append(
+                f"  !! {name}: service curve {flag} — fitted/baseline "
+                f"ratio {r.get('ratio')} (baseline "
+                f"{r.get('baseline_mean_ms')} ms, fired "
+                f"{r.get('fired_total')}x)")
+    wi = doc.get("whatif")
+    if wi:
+        req = ", ".join(f"{k}={v}" for k, v in
+                        (wi.get("requested") or {}).items())
+        delta = wi.get("delta_p99_ms")
+        arrow = "worsens" if (delta or 0) > 0 else "improves"
+        lines.append(
+            f"  what-if [{req}]: predicted e2e p99 "
+            f"{wi.get('base_predicted_p99_ms')} -> "
+            f"{wi.get('predicted_p99_ms')} ms ({arrow} by "
+            f"{abs(delta) if delta is not None else '?'} ms)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("doc", nargs="?", help="capacity JSON path")
+    ap.add_argument("--url", default="",
+                    help="exporter endpoint; fetch over HTTP instead")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="what-if: router/batcher worker count")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="what-if: batch size")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="what-if: batcher deadline")
+    ap.add_argument("--max-inflight", type=int, default=None,
+                    help="what-if: admission ceiling")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine summary instead of prose")
+    args = ap.parse_args(argv)
+    if not args.url and not args.doc:
+        ap.error("need a document path or --url")
+    doc = load_doc(args)
+    if doc is None:
+        return 2
+    errs = validate_capacity(doc)
+    if args.json:
+        print(json.dumps({
+            "bottleneck": (doc.get("bottleneck") or {}).get("stage"),
+            "predicted_p99_ms": doc.get("e2e", {}).get("predicted_p99_ms"),
+            "observed_p99_ms": doc.get("e2e", {}).get("observed_p99_ms"),
+            "error_ratio": doc.get("e2e", {}).get("error_ratio"),
+            "whatif": doc.get("whatif"),
+            "valid": not errs,
+            "errors": errs[:10],
+        }))
+    else:
+        print(render(doc))
+        if errs:
+            print(f"schema: INVALID ({len(errs)} problems)", file=sys.stderr)
+    return 3 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

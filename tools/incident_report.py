@@ -1,11 +1,13 @@
-"""Render a ccfd.incident.v2 bundle into the human post-mortem summary.
+"""Render a ccfd.incident.v3 bundle into the human post-mortem summary.
 
 The FlightRecorder (observability/incident.py) dumps machine-readable
 incident bundles; this tool is the responder's first read — what
 breached, how hard it was burning, which layer/stage ate the latency,
 what the breakers/overload plane/device were doing, WHICH transactions
-were in flight (the decision-record embed, schema v2), and how much
-flight data the ring holds.
+were in flight (the decision-record embed, schema v2), what the
+capacity model believed at the breach edge (bottleneck stage, headroom,
+predicted-vs-observed p99 — schema v3), and how much flight data the
+ring holds.
 
     python tools/incident_report.py <bundle.json>          # from disk
     python tools/incident_report.py --url http://host:9100 # newest bundle
@@ -126,6 +128,30 @@ def render(doc: dict) -> str:
                 f"    tx={d.get('tx')} uid={d.get('uid')} "
                 f"p={d.get('proba'):.4f} -> {d.get('branch')} "
                 f"[{d.get('tier')}{ver}]{inc}")
+    cap = doc.get("capacity") or {}
+    if cap:
+        bn = cap.get("bottleneck") or {}
+        e2e = cap.get("e2e") or {}
+        lines.append("  capacity model at breach:")
+        if bn:
+            lines.append(
+                f"    bottleneck {bn.get('stage')} "
+                f"[{bn.get('layer')}]  headroom "
+                f"{bn.get('headroom_ratio')}x  util "
+                f"{bn.get('utilization')}  admitted "
+                f"{bn.get('admitted_rows_per_s')} rows/s"
+                + (f" / max {bn.get('max_rows_per_s')}"
+                   if bn.get("max_rows_per_s") else ""))
+        if e2e:
+            lines.append(
+                f"    e2e p99 predicted {e2e.get('predicted_p99_ms')} ms"
+                f" vs observed {e2e.get('observed_p99_ms')} ms"
+                + (f"  (error ratio {e2e.get('error_ratio')})"
+                   if e2e.get("error_ratio") is not None else ""))
+        regs = cap.get("regressions") or {}
+        if regs:
+            lines.append("    service-curve regressions: " + ", ".join(
+                f"{s}x{n}" for s, n in regs.items()))
     ring = doc.get("ring", [])
     reasons: dict[str, int] = {}
     for s in ring:
@@ -162,6 +188,8 @@ def main(argv=None) -> int:
             "errors": errs[:10],
             "ring_depth": len(doc.get("ring", [])),
             "decisions": len(doc.get("decisions") or []),
+            "bottleneck": ((doc.get("capacity") or {})
+                           .get("bottleneck") or {}).get("stage"),
             "slos": {n: s.get("breaching")
                      for n, s in doc.get("slo_status", {})
                      .get("slos", {}).items()},

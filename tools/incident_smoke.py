@@ -9,7 +9,7 @@ DEVICE TELEMETRY plane and the FLIGHT RECORDER armed:
 2. A fault-injected 200 ms scorer-latency step on the REST lane breaches
    the rest SLO. Required outcome:
    - EXACTLY ONE incident bundle (edge-triggered with the breach
-     counter), schema-valid (``ccfd.incident.v2``), round-tripped over
+     counter), schema-valid (``ccfd.incident.v3``), round-tripped over
      REAL HTTP via ``/incidents`` + ``/incidents/<id>`` (and an unknown
      id 404s);
    - the bundle's stage profile + budget ledger attribute the damage to

@@ -174,6 +174,36 @@
 #                                          breaches at full bulk
 #                                          admission:
 #                                          REPLAYSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --capacity-smoke exit-code-gated smoke of the
+#                                          capacity observatory
+#                                          (tools/capacity_smoke.py):
+#                                          /capacity serves a schema-
+#                                          valid queueing-model doc over
+#                                          real HTTP with steady-state
+#                                          predicted e2e p99 within 2x
+#                                          of observed and the error
+#                                          gauge exported; what-if moves
+#                                          p99 in the measured direction
+#                                          for worker-count and batcher-
+#                                          deadline changes; an injected
+#                                          200 ms scorer step moves the
+#                                          fitted service curve, fires
+#                                          the regression sentinel
+#                                          EXACTLY ONCE, and re-
+#                                          attributes the bottleneck to
+#                                          the dispatch layer; the
+#                                          baseline run stays silent:
+#                                          CAPACITYSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --bench-compare  normalize BENCH_r*.json
+#                                          captures into the append-only
+#                                          BENCH_HISTORY.jsonl ledger
+#                                          (tools/bench_compare.py) and
+#                                          gate on the per-row verdict
+#                                          vs the last SAME-PLATFORM
+#                                          capture: exit 1 iff a newly
+#                                          appended row regressed
+#                                          (throughput < 0.7x or p99 >
+#                                          1.3x its prior)
 set -u
 
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -336,6 +366,36 @@ if [ "${1:-}" = "--replay-smoke" ]; then
     if JAX_PLATFORMS=cpu python tools/replay_smoke.py; then
         exit 0
     fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--capacity-smoke" ]; then
+    # exit-code-gated smoke of the capacity observatory: schema-valid
+    # /capacity over real HTTP, steady-state prediction within 2x of
+    # observed, what-if direction checks, injected 200 ms step -> curve
+    # moves + sentinel fires exactly once + bottleneck re-attributed to
+    # dispatch (see tools/capacity_smoke.py; prints CAPACITYSMOKE
+    # verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/capacity_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--bench-compare" ]; then
+    # bench trajectory gate: fold fresh BENCH_r*.json captures into the
+    # append-only, platform-labeled BENCH_HISTORY.jsonl ledger and fail
+    # iff a newly appended row regressed against the last same-platform
+    # capture (see tools/bench_compare.py)
+    cd "$REPO_DIR" || exit 2
+    python tools/bench_compare.py
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        echo "BENCHCOMPARE verdict=PASS"
+        exit 0
+    fi
+    echo "BENCHCOMPARE verdict=FAIL rc=${rc}"
     exit 1
 fi
 
