@@ -1015,6 +1015,135 @@ def _preq_hop_rate(qp, x, seconds):
     return round(n / (time.perf_counter() - t0), 1)
 
 
+def _bench_fused_decision(params, X, seconds, batch):
+    """Staged vs fused decision on the SAME rows and the SAME Scorer.
+
+    Staged = the pre-PR-19 serving shape: score_pipelined to host probas,
+    then ``RuleSet.evaluate`` walks the rule base in numpy between score
+    and route. Fused = ops/fused_decision.py: score + FRAUD_THRESHOLD
+    compare + first-match rule argmax inside ONE executable, one packed
+    (B, 2) transfer back. ``host_syncs_per_batch`` comes from the
+    structural counters on each path (scorer.host_syncs / fds.host_syncs)
+    so the "the transfer is the only sync left" claim is a recorded
+    number; ``parity_bit_exact`` is measured on this box, not assumed.
+
+    The two paths run in ALTERNATING short windows and the row records
+    per-path MEDIANS: the deltas under test (host rule walk vs in-
+    executable eval) are a few percent of the forward, and a sequential
+    A-then-B layout folds machine drift into the ratio.
+
+    Two shapes, because the win lives in different places:
+    - ``latency``: the serving micro-batch (one bucket). What fusion
+      displaces here is the per-decision FIXED cost — the extra host
+      materialization plus the Python/numpy rule walk between score and
+      route — which is why this is the headline ``speedup``.
+    - ``throughput``: a multi-chunk batch where the fused rule work
+      rides inside the depth-2 pending window. On CPU device == host so
+      this is near parity by construction; on TPU the removed sync is
+      the point, and the row records it either way."""
+    import statistics
+
+    import numpy as np
+
+    from ccfd_tpu.config import Config
+    from ccfd_tpu.router.rules import Condition, Rule, RuleSet
+    from ccfd_tpu.serving.fused import FusedDecisionScorer
+
+    b = int(min(batch, 65536))
+    x = np.asarray(X[:b], np.float32)
+    # top bucket BELOW b: the A/B wants the multi-chunk serving shape
+    top = max(s for s in _hop_buckets(max(b // 4, 16)))
+    scorer = _section_scorer("mlp", params, top)
+    # a serving-shaped rule base (threshold route + amount band + feature
+    # guards), not the 2-rule default: the staged cost being displaced is
+    # the per-batch host walk over exactly this kind of table
+    thr = Config().fraud_threshold
+    rules = RuleSet([
+        Rule("fraud_hi", process="fraud", salience=20,
+             when=(Condition("proba", ">=", thr),
+                   Condition("Amount", ">", 0.0))),
+        Rule("fraud", process="fraud", salience=15,
+             when=(Condition("proba", ">=", thr),)),
+        Rule("review_band", process="standard", salience=10,
+             when=(Condition("proba", "between", [thr / 2, thr]),)),
+        Rule("v1_guard", process="standard", salience=5,
+             when=(Condition("V1", ">", 0.0),
+                   Condition("V2", "<=", 0.0))),
+        Rule("standard", process="standard"),
+    ])
+    fds = FusedDecisionScorer(scorer, rules)
+    if not fds.enabled:
+        return {"error": "fused decision plane declined to arm"}
+    fds.warmup()
+
+    def staged_hop(xb):
+        proba = scorer.score_pipelined(xb)
+        rules.evaluate(xb, proba)
+
+    calls = {"staged": 0, "fused": 0}
+
+    def ab(rows, staged, fused, rounds=4):
+        """Alternating windows, per-path median rows/s."""
+        def window(label, hop):
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds / (2 * rounds):
+                hop(rows)
+                n += rows.shape[0]
+                calls[label] += 1
+            return n / (time.perf_counter() - t0)
+
+        staged(rows)
+        fused(rows)
+        rates: dict[str, list[float]] = {"staged": [], "fused": []}
+        for _ in range(rounds):
+            rates["staged"].append(window("staged", staged))
+            rates["fused"].append(window("fused", fused))
+        return (statistics.median(rates["staged"]),
+                statistics.median(rates["fused"]))
+
+    # latency shape: one serving micro-batch through the SAME seam the
+    # router runs — np.asarray(score(x)) then the host rule walk
+    def staged_lat(xb):
+        rules.evaluate(xb, np.asarray(scorer.score(xb)))
+
+    lat_b = 128
+    s_lat, f_lat = ab(x[:lat_b], staged_lat, fds.decide)
+
+    calls["staged"] = calls["fused"] = 0  # syncs/batch counts thr only
+    s0_staged, s0_fused = scorer.host_syncs, fds.host_syncs
+    s_thr, f_thr = ab(x, staged_hop, fds.decide)
+    staged_syncs = round((scorer.host_syncs - s0_staged)
+                         / max(calls["staged"], 1), 2)
+    fused_syncs = round((fds.host_syncs - s0_fused)
+                        / max(calls["fused"], 1), 2)
+
+    p_s = scorer.score(x)
+    p_f, f_f = fds.decide(x)
+    parity = bool(
+        f_f is not None
+        and np.array_equal(p_f, p_s)
+        and np.array_equal(f_f, rules.evaluate(x, p_s))
+    )
+    grid = fds.executable_grid()
+    return {
+        "batch": b,
+        "latency_batch": lat_b,
+        "rules": len(rules.rules),
+        "staged_decide_us": round(lat_b / s_lat * 1e6, 1),
+        "fused_decide_us": round(lat_b / f_lat * 1e6, 1),
+        "speedup": round(f_lat / max(s_lat, 1e-9), 3),
+        "staged_tx_s": round(s_thr, 1),
+        "fused_tx_s": round(f_thr, 1),
+        "throughput_speedup": round(f_thr / max(s_thr, 1e-9), 3),
+        "staged_host_syncs_per_batch": staged_syncs,
+        "fused_host_syncs_per_batch": fused_syncs,
+        "parity_bit_exact": parity,
+        "staged_fallbacks": grid["staged_fallbacks"],
+        "forward": grid["forward"],
+    }
+
+
 def _arm_watchdog() -> None:
     """The tunnel can wedge MID-bench (after a successful probe), leaving a
     device wait blocked forever inside XLA — unkillable from Python. If the
@@ -1657,6 +1786,16 @@ def main() -> None:
         _PARTIAL["quant_int8"] = quant_res
         meter.section(quant_res)
 
+    if "fused_decision" not in skip:
+        meter.section(None)  # fresh H2D baseline for the A/B
+        try:
+            _PARTIAL["fused_decision"] = _bench_fused_decision(
+                params, ds.X, max(1.0, seconds / 2), batch,
+            )
+        except Exception as e:  # noqa: BLE001 - a red fused row must not
+            _PARTIAL["fused_decision"] = {"error": repr(e)[:200]}  # kill it
+        meter.section(_PARTIAL["fused_decision"])
+
     if "roofline" not in skip:
         try:
             _PARTIAL["roofline"] = _bench_roofline(
@@ -1762,6 +1901,10 @@ def compact_summary(result: dict) -> dict:
          "speedup_vs_full_l", "full_l_sync_tx_s", "r05_path_tx_s",
          "speedup_vs_r05_path", "cold_fraction")
     pick("quant_int8", "tx_s", "fused_tx_s", "preq_tx_s", "batch")
+    pick("fused_decision", "speedup", "throughput_speedup",
+         "staged_decide_us", "fused_decide_us", "staged_tx_s",
+         "fused_tx_s", "parity_bit_exact", "staged_fallbacks",
+         "staged_host_syncs_per_batch", "fused_host_syncs_per_batch")
     pick("replay", "tx_s", "passes", "parity", "divergence",
          "live_fast_breaches", "live_slo_green", "bulk_ceiling")
     pick("roofline", "wire_mb_s", "h2d_mb_s_measured", "mfu_pct", "bound")

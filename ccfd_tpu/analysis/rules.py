@@ -405,6 +405,13 @@ _SYNC_CALLS = frozenset((
     "np.asarray", "numpy.asarray", "onp.asarray", "jax.device_get",
 ))
 _SYNC_ATTRS = frozenset(("item", "block_until_ready", "tolist"))
+# the router's score->route seam (the functions between the device
+# dispatch and _route): with the fused decision kernel the verdict comes
+# back in ONE packed transfer, so the only sync these functions may
+# contain is materializing a dispatch result — np.asarray(<call>().
+# Any sync on an already-bound name (np.asarray(proba), proba.tolist())
+# is a NEW host round trip sneaking in between score and route.
+_SEAM_FUNCS = frozenset(("_score_tiered", "_score_direct", "_score_batch"))
 
 
 @register
@@ -412,42 +419,57 @@ class HotPathSyncRule(Rule):
     name = "hot-path-sync"
     invariant = ("functions marked `# ccfd-lint: hot-path` must not "
                  "force a device->host sync (np.asarray/.item()/float()/"
-                 "block_until_ready): the overlap IS the throughput")
+                 "block_until_ready): the overlap IS the throughput. "
+                 "The router's score->route seam (_score_tiered/"
+                 "_score_direct/_score_batch in router/router.py) is "
+                 "implicitly hot, with ONE allowed sync shape: "
+                 "np.asarray(<dispatch call>) — the transfer itself")
     motivated_by = ("PR 8: one stray float(proba) in the seq dispatch "
                     "loop serialized the whole overlapped dataflow back "
-                    "to 2k tx/s")
+                    "to 2k tx/s; PR 19: the fused decision kernel deletes "
+                    "the host rules pass, and the seam check keeps a "
+                    "second sync from growing back between score and route")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         out: list[Finding] = []
+        seam_file = ctx.path.endswith("router/router.py")
         for fn in _functions(ctx.tree):
             marked = (fn.lineno in ctx.hot_path_lines
                       or (fn.lineno - 1) in ctx.hot_path_lines
                       or any(d.lineno - 1 in ctx.hot_path_lines
                              or d.lineno in ctx.hot_path_lines
                              for d in fn.decorator_list))
-            if not marked:
+            seam = seam_file and fn.name in _SEAM_FUNCS
+            if not marked and not seam:
                 continue
+            where = ("score->route seam" if seam and not marked
+                     else "hot-path")
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 fname = _dotted(node.func)
                 if fname in _SYNC_CALLS:
+                    if (seam and not marked and node.args
+                            and isinstance(node.args[0], ast.Call)):
+                        # the single allowed seam sync: materializing a
+                        # dispatch result as it crosses to the host
+                        continue
                     out.append(ctx.finding(
                         self.name, node,
-                        f"{fname}() inside hot-path {fn.name}(): forces a "
+                        f"{fname}() inside {where} {fn.name}(): forces a "
                         "device->host sync"))
                 elif (isinstance(node.func, ast.Attribute)
                         and node.func.attr in _SYNC_ATTRS
                         and not node.args):
                     out.append(ctx.finding(
                         self.name, node,
-                        f".{node.func.attr}() inside hot-path {fn.name}():"
+                        f".{node.func.attr}() inside {where} {fn.name}():"
                         " forces a device->host sync"))
                 elif (fname == "float" and node.args
                         and not isinstance(node.args[0], ast.Constant)):
                     out.append(ctx.finding(
                         self.name, node,
-                        f"float(...) inside hot-path {fn.name}(): on a "
+                        f"float(...) inside {where} {fn.name}(): on a "
                         "device array this blocks on the transfer"))
         return out
 

@@ -431,6 +431,18 @@ class Config:
     # (the reference's SELDON_TIMEOUT applied inside the server): -1 = auto
     # (accelerator backends: seldon_timeout_ms; cpu/mesh: off), 0 = off,
     # >0 = explicit deadline
+    # --- fused decision kernel (ops/fused_decision.py, serving/fused.py;
+    # CR `scorer.fused_decision`) ---
+    # one jitted executable per batch bucket returns (proba, fired rule)
+    # together: score, FRAUD_THRESHOLD compare and the vectorizable rule
+    # base all on device, ONE transfer back. Off by default: arming it is
+    # a routing-semantics statement (device-evaluated rules), even though
+    # parity with the staged path is bit-exact (CCFD_FUSED_DECISION)
+    fused_decision: bool = False
+    # strict = refuse to start (RuntimeError) when the fused plane cannot
+    # arm (unvectorizable rules, incompatible scorer) instead of the
+    # default warn-and-serve-staged (CCFD_FUSED_DECISION_STRICT)
+    fused_decision_strict: bool = False
     serve_host: str = "0.0.0.0"
     serve_port: int = 8000
 
@@ -804,6 +816,11 @@ class Config:
             host_tier_rows=int(
                 e.get("CCFD_HOST_TIER_ROWS", str(Config.host_tier_rows))
             ),
+            fused_decision=e.get("CCFD_FUSED_DECISION", "0").strip().lower()
+            in ("1", "true", "yes", "on"),
+            fused_decision_strict=e.get(
+                "CCFD_FUSED_DECISION_STRICT", "0").strip().lower()
+            in ("1", "true", "yes", "on"),
             serve_host=e.get("CCFD_SERVE_HOST", Config.serve_host),
             serve_port=int(e.get("CCFD_SERVE_PORT", str(Config.serve_port))),
         )

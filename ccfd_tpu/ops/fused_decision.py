@@ -1,0 +1,216 @@
+"""Fused decision program: score + threshold + rules in ONE executable.
+
+The serving hot path used to be staged: device dispatch produces (B,)
+probabilities, the host materializes them, then ``RuleSet.evaluate``
+re-walks the batch rule by rule in numpy before the router can group
+process starts. PRETZEL's white-box result (PAPERS.md) is that the wins
+live in collapsing the pipeline's operator graph into one executable —
+so this module compiles the *rule base itself* into tensors and builds a
+jitted program that takes the staged feature batch and returns routed
+verdicts: ``(proba, fired_rule_index)`` packed as one (B, 2) float32
+array, i.e. exactly ONE device->host transfer per dispatch and zero host
+compute between score and route.
+
+Compilation: every vectorizable :class:`~ccfd_tpu.router.rules.Condition`
+(``>/>=/</<=/==/!=/between`` over the 30 features or ``proba``) becomes
+one slot of a stacked predicate tensor — an operand column index
+``idx (R, C)``, an op code ``op (R, C)`` and bounds ``lo/hi (R, C)``.
+Inside the jit the batch evaluates as one gather
+(``vals = take([x | proba], idx, axis=1)``), an op-coded compare, an
+AND-reduce over each rule's conjunction and an argmax over the
+salience-ordered match matrix — bit-for-bit ``RuleSet.evaluate``
+first-match semantics, because:
+
+- rules stay in ``RuleSet.rules`` order (already salience-sorted, stable)
+  and ``argmax`` over booleans returns the FIRST max index;
+- every bound is pre-cast with ``np.float32`` — the same
+  ``col.dtype.type(value)`` cast ``Condition.mask`` applies (x and proba
+  are float32 columns on both paths);
+- the gather moves values verbatim, no arithmetic touches them.
+
+Non-vectorizable rules (a custom ``when_fn`` callable) CANNOT compile:
+:func:`compile_rules` raises :class:`UnvectorizableRuleSet` so the caller
+forces the staged path for the WHOLE rule set with one loud warning —
+never a silent per-row fallback that would split a batch across two
+semantics (see serving/fused.py).
+
+The model forward composes into the same jit: the Pallas fused kernels
+(ops/fused_mlp.py, ops/fused_mlp_q8.py) or the model's XLA graph — the
+builder takes the forward as a traceable callable, so whatever the
+serving Scorer dispatches is what fuses here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccfd_tpu.data.ccfd import FEATURE_NAMES
+from ccfd_tpu.router.rules import PROBA_FIELD, RuleSet
+
+# op codes for the stacked predicate tensor; OP_TRUE pads rules with
+# fewer conditions than the widest one (and the default rule's empty
+# conjunction) so the AND-reduce is rectangular
+OP_GT, OP_GE, OP_LT, OP_LE, OP_EQ, OP_NE, OP_BETWEEN, OP_TRUE = range(8)
+_OP_CODES = {">": OP_GT, ">=": OP_GE, "<": OP_LT, "<=": OP_LE,
+             "==": OP_EQ, "!=": OP_NE, "between": OP_BETWEEN}
+
+
+class UnvectorizableRuleSet(ValueError):
+    """The rule base contains a predicate that cannot compile to the
+    stacked tensor form (a custom ``when_fn`` callable). The whole set
+    must serve staged — semantics may not split within a batch."""
+
+
+@dataclass(frozen=True)
+class RulePlan:
+    """A RuleSet compiled to stacked predicate tensors.
+
+    ``sel``  (R, C, F+1) float32 one-hot column selector (slot F = proba)
+    ``idx``  (R, C) int32 operand column index (= argmax of ``sel``; the
+    dense gather form — evaluating through ``sel`` would pay an F-wide
+    einsum per condition for the same exact value)
+    ``op``   (R, C) int32 op codes (OP_TRUE = padding / empty conjunction)
+    ``lo``   (R, C) float32 lower/scalar bound, pre-cast like the host path
+    ``hi``   (R, C) float32 upper bound (``between`` only; else == lo)
+    ``processes`` / ``names``: per-rule RHS bookkeeping for the route seam
+    ``needs_features``: any condition reads a feature column — the
+    decision dispatch must then ship float32 rows (a reduced-precision
+    wire would round the very values the predicates compare)
+    """
+
+    sel: np.ndarray
+    idx: np.ndarray
+    op: np.ndarray
+    lo: np.ndarray
+    hi: np.ndarray
+    processes: tuple[str, ...]
+    names: tuple[str, ...]
+    needs_features: bool
+    rules: Any  # the source RuleSet: identity-checked at the route seam
+
+    @property
+    def n_rules(self) -> int:
+        return self.sel.shape[0]
+
+
+def compile_rules(rules: RuleSet,
+                  feature_names: Sequence[str] = FEATURE_NAMES) -> RulePlan:
+    """RuleSet -> RulePlan, or raise :class:`UnvectorizableRuleSet`.
+
+    Raising (instead of returning a partial plan) is the satellite-3
+    contract: one non-vectorizable rule forces the STAGED path for the
+    whole set, decided loudly at compile time — a per-row fallback would
+    evaluate half a batch under tensor semantics and half under host
+    semantics, and any drift between them would split routing decisions
+    within one micro-batch.
+    """
+    n_feat = len(feature_names)
+    for r in rules.rules:
+        if getattr(r, "when_fn", None) is not None:
+            raise UnvectorizableRuleSet(
+                f"rule {r.name!r} carries a custom when_fn callable; "
+                f"callables cannot compile to the stacked predicate "
+                f"tensor — the whole rule set serves staged"
+            )
+    n_rules = len(rules.rules)
+    width = max(1, max(len(r.when) for r in rules.rules))
+    sel = np.zeros((n_rules, width, n_feat + 1), np.float32)
+    idx = np.zeros((n_rules, width), np.int32)  # padding gathers col 0;
+    op = np.full((n_rules, width), OP_TRUE, np.int32)  # OP_TRUE masks it
+    lo = np.zeros((n_rules, width), np.float32)
+    hi = np.zeros((n_rules, width), np.float32)
+    needs_features = False
+    for i, rule in enumerate(rules.rules):
+        for j, cond in enumerate(rule.when):
+            if cond.fld == PROBA_FIELD:
+                col = n_feat
+            else:
+                col = feature_names.index(cond.fld)
+                needs_features = True
+            sel[i, j, col] = 1.0
+            idx[i, j] = col
+            op[i, j] = _OP_CODES[cond.op]
+            # the SAME cast Condition.mask applies (col.dtype.type(value)
+            # on float32 columns): ==/!= against a non-dyadic literal must
+            # hit or miss identically on both paths
+            if cond.op == "between":
+                lo[i, j] = np.float32(cond.value[0])
+                hi[i, j] = np.float32(cond.value[1])
+            else:
+                lo[i, j] = np.float32(cond.value)
+                hi[i, j] = lo[i, j]
+    return RulePlan(sel=sel, idx=idx, op=op, lo=lo, hi=hi,
+                    processes=tuple(r.process for r in rules.rules),
+                    names=tuple(r.name for r in rules.rules),
+                    needs_features=needs_features, rules=rules)
+
+
+def eval_plan(plan: RulePlan, x: jax.Array, proba: jax.Array) -> jax.Array:
+    """(B, F) float32 rows + (B,) float32 proba -> (B,) int32 fired index.
+
+    Traceable; runs inside the decision jit. One gather pulls every
+    condition's operand column (``plan.idx`` — exact, and R*C elements
+    per row instead of the one-hot einsum's R*C*F multiply-adds; proba
+    slots broadcast in via ``where`` rather than concatenating proba
+    onto x, which would copy the whole feature block per dispatch), one
+    op-coded compare builds the (B, R, C) predicate tensor, the
+    AND-reduce collapses conjunctions, and argmax over the
+    salience-ordered (B, R) match matrix IS first-match-wins (argmax
+    returns the first True). A default rule (empty ``when`` -> all
+    OP_TRUE) guarantees every row matches something, exactly like
+    ``RuleSet.evaluate``.
+    """
+    xf = x.astype(jnp.float32)
+    pf = proba.astype(jnp.float32)
+    n_feat = xf.shape[1]
+    idx = jnp.asarray(plan.idx)  # (R, C); slot n_feat = proba
+    feat = jnp.take(xf, jnp.clip(idx, 0, n_feat - 1), axis=1)  # (B, R, C)
+    vals = jnp.where(idx[None, :, :] == n_feat, pf[:, None, None], feat)
+    op = jnp.asarray(plan.op)[None, :, :]  # (1, R, C)
+    lo = jnp.asarray(plan.lo)[None, :, :]
+    hi = jnp.asarray(plan.hi)[None, :, :]
+    pred = jnp.select(
+        [op == OP_GT, op == OP_GE, op == OP_LT, op == OP_LE,
+         op == OP_EQ, op == OP_NE, op == OP_BETWEEN],
+        [vals > lo, vals >= lo, vals < lo, vals <= lo,
+         vals == lo, vals != lo, (vals >= lo) & (vals <= hi)],
+        default=jnp.ones_like(vals, bool),  # OP_TRUE padding
+    )
+    matches = pred.all(axis=2)  # (B, R)
+    return jnp.argmax(matches, axis=1).astype(jnp.int32)
+
+
+def build_decision_fn(forward: Callable[[Any, jax.Array], jax.Array],
+                      plan: RulePlan) -> Callable[[Any, jax.Array], jax.Array]:
+    """One jitted program: staged rows -> packed routed verdicts.
+
+    ``forward(params, x)`` is whatever the serving path dispatches — the
+    Pallas fused kernel, the XLA graph, the q8 readout — traced INTO the
+    same executable as the rules evaluation. Returns (B, 2) float32:
+    column 0 the probability (identical bits to the staged forward),
+    column 1 the fired rule index (small ints are exact in float32; one
+    packed array = one D2H transfer carrying the whole verdict).
+
+    jit caches one executable per batch bucket shape — the (L, B) grid
+    generalization of the scorer's bucket ladder; warmup precompiles it
+    under the ``fused.warm`` compile stage (serving/fused.py).
+    """
+
+    @jax.jit
+    def decide(params: Any, x: jax.Array) -> jax.Array:
+        proba = forward(params, x).astype(jnp.float32)
+        fired = eval_plan(plan, x, proba)
+        return jnp.stack([proba, fired.astype(jnp.float32)], axis=1)
+
+    return decide
+
+
+__all__ = [
+    "RulePlan", "UnvectorizableRuleSet", "compile_rules", "eval_plan",
+    "build_decision_fn",
+]

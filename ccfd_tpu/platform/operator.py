@@ -180,6 +180,7 @@ class Platform:
         self.replay = None      # replay/service.ReplayService when enabled
         self.replay_tap = None  # replay/service.ReplayVerdictTap (replay on)
         self.fleet_ledger = None  # fleet/ledger.FleetLedgerTap (fleet on)
+        self.fused_decision = None  # serving/fused.FusedDecisionScorer
         self._overload = None   # runtime/overload.OverloadControl (router)
         self.lifecycle = None   # lifecycle.LifecycleController when enabled
         self.router = None
@@ -1334,7 +1335,69 @@ class Platform:
             self.replay_tap = ReplayVerdictTap(
                 inner=audit_sink, registry=self._registry("replay"))
             audit_sink = self.replay_tap
+        # fused decision plane (ops/fused_decision.py, serving/fused.py):
+        # CR `scorer.fused_decision` over CCFD_FUSED_DECISION. One device
+        # dispatch returns (proba, fired rule index) — score, threshold
+        # and the vectorizable rule base in ONE executable — and the
+        # router's host rules pass disappears on the healthy path. Armed
+        # only for an in-process row Scorer: seq/remote scorers have no
+        # fusable decision program, and the lifecycle canary gate rewrites
+        # scores AFTER the scorer returns — a fused verdict would have
+        # fired on the pre-override score, splitting proba and rule.
+        decision_fn = None
+        rules = None
+        sc_spec = self.spec.component("scorer")
+        if bool(sc_spec.opt("fused_decision", self.cfg.fused_decision)):
+            from ccfd_tpu.serving.history import SeqScorer
+
+            fused_strict = bool(sc_spec.opt(
+                "fused_decision_strict", self.cfg.fused_decision_strict))
+            log_f = logging.getLogger(__name__)
+            if self.scorer is None or isinstance(self.scorer, SeqScorer):
+                msg = ("scorer.fused_decision needs an in-process row "
+                       "Scorer (remote and seq scorers have no fusable "
+                       "decision program); serving the staged path")
+                if fused_strict:
+                    raise RuntimeError(msg)
+                log_f.warning(msg)
+            elif self.lifecycle is not None:
+                msg = ("scorer.fused_decision is incompatible with the "
+                       "lifecycle serving lane (the canary gate overrides "
+                       "scores after the fused verdict fires); serving "
+                       "the staged path")
+                if fused_strict:
+                    raise RuntimeError(msg)
+                log_f.warning(msg)
+            else:
+                from ccfd_tpu.router.rules import RuleSet, default_rules
+                from ccfd_tpu.serving.fused import FusedDecisionScorer
+
+                # the Router's own precedence (explicit arg > CCFD_RULES
+                # file > threshold default), applied HERE so the fused
+                # plan and the router provably share ONE RuleSet instance
+                # (the router disarms on identity mismatch)
+                rules = (RuleSet.from_file(self.cfg.rules_file)
+                         if self.cfg.rules_file
+                         else default_rules(self.cfg.fraud_threshold))
+                fds = FusedDecisionScorer(
+                    self.scorer, rules, registry=reg,
+                    profiler=self.profiler, strict=fused_strict)
+                if fds.enabled:
+                    fds.warmup()  # every (L,B) bucket under fused.warm
+                    if self.device is not None:
+                        self.device.register_executable_source(
+                            "fused_decision", fds.executable_grid)
+                    # param swaps precompile the fused grid against the
+                    # STAGED tree before publishing (scorer prepublish
+                    # seam) — zero serving-stage compiles after a swap
+                    self.scorer.add_prepublish_hook(fds.prepublish)
+                    decision_fn = fds
+                    self.fused_decision = fds
+                else:  # refused (unvectorizable rules, mesh scorer):
+                    rules = None  # the warning already said why; staged
         common = dict(
+            rules=rules,
+            decision_fn=decision_fn,
             host_score_fn=host_score_fn,
             breaker=breaker,
             # the ladder is the production default: a sick scorer edge

@@ -95,6 +95,7 @@ class ParallelRouter:
         heal_gate: "Any | None" = None,
         audit: "Any | None" = None,
         commit_after_route: bool = False,
+        decision_fn: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -139,7 +140,13 @@ class ParallelRouter:
 
         self.batcher = None
         worker_score: Any = score_fn
-        if (coalesce and workers > 1
+        # The fused decision plane bypasses the coalescing batcher the
+        # same way history-aware scorers do: its decide() IS the device
+        # dispatch (score + rules in one executable) and chunks on the
+        # scorer's own bucket ladder — a row-concatenating batcher in
+        # front would only re-split what decide re-buckets anyway, and
+        # its proba-only wire cannot carry the fired-index column back.
+        if (coalesce and workers > 1 and decision_fn is None
                 and not callable(getattr(score_fn, "score_with_ids", None))):
             from ccfd_tpu.serving.batcher import DynamicBatcher
 
@@ -185,6 +192,7 @@ class ParallelRouter:
                 # recorded) holds across the pool, like the budget bound
                 audit=audit,
                 commit_after_route=commit_after_route,
+                decision_fn=decision_fn,
             )
             for i in range(workers)
         ]

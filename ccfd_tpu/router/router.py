@@ -321,6 +321,7 @@ class Router:
         heal_gate: "Any | None" = None,
         audit: "Any | None" = None,
         commit_after_route: bool = False,
+        decision_fn: "Any | None" = None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -339,9 +340,10 @@ class Router:
         # records alongside the feature matrix; plain scorers get (x,)
         score_with_ids = getattr(score_fn, "score_with_ids", None)
         if callable(score_with_ids):
-            self._score2 = lambda x, txs: np.asarray(score_with_ids(txs, x))
+            self._score2 = lambda x, txs: (
+                np.asarray(score_with_ids(txs, x)), None)
         else:
-            self._score2 = lambda x, txs: np.asarray(self.score(x))
+            self._score2 = lambda x, txs: (np.asarray(self.score(x)), None)
         self.engine = engine
         self.registry = registry or Registry()
         self.max_batch = max_batch
@@ -354,6 +356,28 @@ class Router:
                 else default_rules(cfg.fraud_threshold)
             )
         self.rules = rules
+        # Fused decision plane (serving/fused.py): one device dispatch
+        # returns (proba, fired) — score, threshold and the vectorizable
+        # rule base evaluated in ONE executable, so _route_inner skips the
+        # host rules pass entirely. The decision fn REPLACES the score
+        # seam (same tuple contract as _score2); its staged fallback
+        # returns fired=None and the host rules pass resumes — the
+        # degradation ladder below it (host forward, rules floor) is
+        # untouched. Guard: the fused plan must have been compiled from
+        # THIS router's rule base, or device-computed fired indices would
+        # silently index a different rule table.
+        if decision_fn is not None:
+            dec_rules = getattr(decision_fn, "rules", None)
+            if dec_rules is not None and dec_rules is not self.rules:
+                logging.getLogger("ccfd_tpu.router").warning(
+                    "decision_fn was compiled against a different RuleSet "
+                    "than this router serves; fused decisions disarmed — "
+                    "pass the same RuleSet instance to both")
+                decision_fn = None
+            else:
+                dec = getattr(decision_fn, "decide", decision_fn)
+                self._score2 = lambda x, txs: dec(x)
+        self._decision_fn = decision_fn
         # Fail fast on a rule naming a process the engine doesn't have —
         # discovering it on the first matching transaction would kill the
         # routing loop mid-batch. Remote (REST) engines don't expose a
@@ -788,13 +812,19 @@ class Router:
         return np.where(risky, thr, np.float32(0.0)).astype(np.float32)
 
     def _score_tiered(self, x: np.ndarray, txs: list,
-                      span=None, meta=None) -> np.ndarray:
+                      span=None, meta=None) -> tuple:
         """device scorer → host numpy forward → rules-only. Never raises:
         the bottom tier is pure numpy over data already in hand. ``span``
         (when tracing) gets the degraded-tier flag — a trace scored by a
         fallback tier is always tail-sampled KEEP. ``meta`` (when the
         audit plane is armed) records the tier that actually produced
-        the batch's scores and why the ladder fell."""
+        the batch's scores and why the ladder fell.
+
+        Returns ``(proba, fired)``: ``fired`` is the device-computed rule
+        index vector when the fused decision plane produced this batch's
+        verdicts, else None (host rules pass runs in ``_route_inner``).
+        Fallback tiers always return fired=None — a degraded score must
+        re-enter the full host rule base, never a stale device verdict."""
         gate = self._heal_gate
         host_blocked = False
         if gate is not None and not gate.device_allowed():
@@ -823,19 +853,27 @@ class Router:
                     # seq path measured 1412 ms, BENCH_r05) is killed at
                     # the deadline and lands in this except — one breaker
                     # failure and a ladder fall, not a stalled worker
-                    proba = np.asarray(
-                        ov.bounded_dispatch(lambda: self._score2(x, txs)))
+                    proba, fired = ov.bounded_dispatch(
+                        lambda: self._score2(x, txs))
                 else:
-                    proba = np.asarray(self._score2(x, txs))
+                    proba, fired = self._score2(x, txs)
                 lat = time.perf_counter() - t0
                 # corrupt-response validation: a fault-injected (or truly
                 # version-skewed) reply with the wrong shape or non-finite
                 # values must degrade, not route garbage decisions
                 if proba.shape != (len(txs),) or not np.isfinite(proba).all():
                     raise ValueError("invalid scorer response")
+                # fused verdicts get the same treatment: an index vector
+                # of the wrong shape or out of the rule table's range
+                # must degrade this batch, not mis-route it
+                if fired is not None and (
+                        getattr(fired, "shape", None) != (len(txs),)
+                        or int(fired.min()) < 0
+                        or int(fired.max()) >= len(self.rules.rules)):
+                    raise ValueError("invalid scorer response")
                 if br is not None:
                     br.record_success(lat)
-                return proba
+                return proba, fired
             except Exception as e:
                 if br is not None:
                     br.record_failure(time.perf_counter() - t0)
@@ -865,7 +903,7 @@ class Router:
                         span.attrs["degraded"] = "host"
                     if meta is not None:
                         meta["tier"] = "host"
-                    return proba
+                    return proba, None
             except Exception:  # noqa: BLE001 - fall to the rules tier
                 # a host-forward failure was invisible before: the ladder
                 # fell straight through and only the rules-tier counter
@@ -876,10 +914,10 @@ class Router:
             span.attrs["degraded"] = "rules"
         if meta is not None:
             meta["tier"] = "rules"
-        return self._rules_proba(x)
+        return self._rules_proba(x), None
 
     def _score_direct(self, x: np.ndarray, txs: list,
-                      span=None, meta=None) -> np.ndarray:
+                      span=None, meta=None) -> tuple:
         """Legacy non-ladder path — but the heal gate still binds: a
         quarantined device must not see live rows even when the
         degradation ladder is off (``router.degrade: false`` CRs). With
@@ -894,11 +932,11 @@ class Router:
                 meta["tier"] = "rules"
                 meta["cause"] = "quarantine"
             self._c_degraded.inc(len(txs), labels={"tier": "rules"})
-            return self._rules_proba(x)
+            return self._rules_proba(x), None
         return self._score2(x, txs)
 
     def _score_batch(self, x: np.ndarray, txs: list,
-                     batch_span=None, meta=None) -> np.ndarray:
+                     batch_span=None, meta=None) -> tuple:
         if self.tracer is not None and batch_span is not None:
             with self.tracer.span("router.score",
                                   parent=batch_span.context) as sp:
@@ -929,7 +967,7 @@ class Router:
             batch_sp = self._begin_batch_span(records)
             x, txs, ts = self._decode_batch(records, batch_sp)
             t0 = time.perf_counter()
-            proba = self._score_batch(x, txs, batch_sp, meta)
+            proba, fired = self._score_batch(x, txs, batch_sp, meta)
             score_s = time.perf_counter() - t0
             self._h_score_s.observe(
                 score_s,
@@ -943,7 +981,7 @@ class Router:
                 self._profiler.observe("router.score", dispatch_s=score_s,
                                        batch=len(txs), rows=len(txs))
             n = self._route(x, txs, proba, ts, batch_span=batch_sp,
-                            meta=meta)
+                            meta=meta, fired=fired)
             # commit ONLY after every record has a terminal disposition
             # (routed/shed/errored); a crash above leaves the batch
             # uncommitted, so it redelivers instead of vanishing
@@ -962,7 +1000,7 @@ class Router:
 
     def _route(self, x: np.ndarray, txs: list, proba: np.ndarray,
                ts: np.ndarray | None = None, batch_span=None,
-               meta=None) -> int:
+               meta=None, fired: np.ndarray | None = None) -> int:
         route_sp = None
         if self.tracer is not None and batch_span is not None:
             route_sp = self.tracer.start("router.route",
@@ -971,14 +1009,14 @@ class Router:
         try:
             if route_sp is None:
                 return self._route_inner(x, txs, proba, ts, batch_span,
-                                         route_sp, meta)
+                                         route_sp, meta, fired)
             # activate on THIS thread: the engine calls below (and the
             # notification records the engine produces inside them,
             # process/fraud.py notify) read current_context() to join the
             # trace — an unactivated span would orphan the engine/notify leg
             with self.tracer.activate(route_sp.context):
                 return self._route_inner(x, txs, proba, ts, batch_span,
-                                         route_sp, meta)
+                                         route_sp, meta, fired)
         finally:
             if self._profiler is not None:
                 self._profiler.observe(
@@ -989,8 +1027,9 @@ class Router:
 
     def _route_inner(self, x: np.ndarray, txs: list, proba: np.ndarray,
                      ts: np.ndarray | None, batch_span, route_sp,
-                     meta=None) -> int:
-        fired = self.rules.evaluate(x, proba)
+                     meta=None, fired: np.ndarray | None = None) -> int:
+        if fired is None:
+            fired = self.rules.evaluate(x, proba)
         # group the micro-batch by fired rule: one batched process-start per
         # (rule, process) instead of one engine round-trip per transaction —
         # the engine amortizes its lock (and the remote client its HTTP hop)
@@ -1239,7 +1278,7 @@ class Router:
         from concurrent.futures import ThreadPoolExecutor
 
         def timed_score(x: np.ndarray, txs: list, batch_sp,
-                        meta) -> np.ndarray:
+                        meta) -> tuple:
             # time INSIDE the worker so the histogram records the scorer
             # round trip, not dispatch + however long the loop polled.
             # batch_sp (and the audit meta) ride along explicitly — the
@@ -1247,7 +1286,7 @@ class Router:
             # per-thread), and batch-scoped audit state must never live
             # on self while two batches are in flight
             t0 = time.perf_counter()
-            proba = self._score_batch(x, txs, batch_sp, meta)
+            proba, fired = self._score_batch(x, txs, batch_sp, meta)
             score_s = time.perf_counter() - t0
             self._h_score_s.observe(
                 score_s,
@@ -1258,13 +1297,13 @@ class Router:
             if self._profiler is not None:
                 self._profiler.observe("router.score", dispatch_s=score_s,
                                        batch=len(txs), rows=len(txs))
-            return proba
+            return proba, fired
 
         def finish(pending: tuple) -> None:
             pfut, px, ptxs, pts, psp, pmeta, poffs = pending
             try:
                 try:
-                    proba = pfut.result()
+                    proba, fired = pfut.result()
                 except Exception:
                     # a transient scorer failure (e.g. remote model timeout)
                     # drops this batch, not the routing loop. The drop IS
@@ -1277,7 +1316,7 @@ class Router:
                     self._commit_routed(poffs)
                     return
                 self._route(px, ptxs, proba, pts, batch_span=psp,
-                            meta=pmeta)
+                            meta=pmeta, fired=fired)
                 self._commit_routed(poffs)
             except BaseException:
                 if psp is not None:  # _route crashed: force-keep the trace

@@ -93,18 +93,37 @@ class Condition:
 
 @dataclass(frozen=True)
 class Rule:
-    """LHS = conjunction of conditions; RHS = start ``process`` with vars."""
+    """LHS = conjunction of conditions; RHS = start ``process`` with vars.
+
+    ``when_fn`` (programmatic rule bases only — JSON cannot carry code):
+    an arbitrary ``(x, proba) -> (B,) bool`` predicate AND-ed with the
+    declarative conditions. The escape hatch for policies the Condition
+    grammar cannot express — but it is host-only: a rule base with ANY
+    ``when_fn`` cannot compile to the fused decision kernel's predicate
+    tensors, and the whole set serves the staged path with one loud
+    warning (ops/fused_decision.py compile_rules). Never a per-row split.
+    """
 
     name: str
     process: str
     when: tuple[Condition, ...] = ()
     salience: int = 0
     set_vars: Mapping[str, Any] = field(default_factory=dict)
+    when_fn: Any = None
+
+    def __post_init__(self):
+        if self.when_fn is not None and not callable(self.when_fn):
+            raise ValueError(
+                f"rule {self.name!r}: when_fn must be callable "
+                f"(x, proba) -> bool mask, got {type(self.when_fn).__name__}"
+            )
 
     def mask(self, x: np.ndarray, proba: np.ndarray) -> np.ndarray:
         m = np.ones(proba.shape[0], bool)
         for c in self.when:
             m &= c.mask(x, proba)
+        if self.when_fn is not None:
+            m &= np.asarray(self.when_fn(x, proba), bool)
         return m
 
 

@@ -80,7 +80,7 @@ RUNGS = ("canary_retry", "reinit", "respawn")
 # and after a re-promotion flip it would mean the re-promotion was COLD.
 NON_SERVING_COMPILE_STAGES = frozenset({
     "total", "heal.warm", "heal.canary", "scorer.warmup", "seq.warmup",
-    "seq.swap",
+    "seq.swap", "fused.warm",
 })
 
 

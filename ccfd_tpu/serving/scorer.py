@@ -226,6 +226,16 @@ class Scorer:
         self._notify_lock = threading.Lock()
         self._swap_gen = 0
         self._swap_delivered_gen = 0
+        # prepublish hooks: planes that compile executables against the
+        # params (the fused decision grid) precompile against the STAGED
+        # buffers here, before the flip — so the swap publishes with every
+        # bucket warm, exactly like the seq variant swap. Hooks run gate-
+        # free (staging side); a failing hook never blocks the publish.
+        self._prepublish_hooks: list[Any] = []
+        # host materializations per score_pipelined call site: the staged
+        # path pays one np.asarray(done) sync per chunk; the fused decision
+        # bench reads this to report host_syncs_per_batch for BOTH paths.
+        self.host_syncs = 0
         # challenger slot (lifecycle/shadow.py): a second, double-buffered
         # (version, host_params) pair living NEXT TO the champion — shadow
         # and canary scoring read it via the host numpy forward, so the
@@ -658,6 +668,19 @@ class Scorer:
         reference flip needs the router pool quiescent — a gated swap
         pauses the pool for a pointer swap, not a tree transfer."""
         staged = self._stage_swap(new_params)
+        # prepublish: let dependent planes (fused decision grid) precompile
+        # against the staged buffers BEFORE the gate/flip, so the first
+        # serving dispatch after publish finds every bucket warm. Still on
+        # the staging side — a slow or failing hook delays the publish, but
+        # never pauses the pool and never blocks the flip itself.
+        for hook in list(self._prepublish_hooks):
+            try:
+                hook(*staged)
+            except Exception:  # noqa: BLE001 - must not break swaps
+                logging.getLogger("ccfd_tpu.scorer").warning(
+                    "prepublish hook %r raised; first serving dispatch "
+                    "after this swap may pay its compile", hook,
+                    exc_info=True)
         gate = self._swap_gate
         if gate is None:
             listeners, gen = self._commit_swap(*staged)
@@ -754,6 +777,15 @@ class Scorer:
                     logging.getLogger("ccfd_tpu.scorer").warning(
                         "swap listener %r raised; it may be serving stale "
                         "params", fn, exc_info=True)
+
+    def add_prepublish_hook(self, fn: Any) -> None:
+        """``fn(staged, staged_fused, staged_preq_norm, staged_host)`` runs
+        inside every ``swap_params`` AFTER staging and BEFORE the publish
+        gate/flip — the seam where the fused decision plane precompiles its
+        (L, B) executable grid against the incoming params so the swap
+        publishes warm. Hook errors are logged, never propagated."""
+        with self._lock:
+            self._prepublish_hooks.append(fn)
 
     def add_swap_listener(self, fn: Any) -> None:
         """``fn(host_params_numpy_tree)`` runs after every ``swap_params``."""
@@ -862,9 +894,11 @@ class Scorer:
             pending.append((out, take))
             if len(pending) >= depth:
                 done, took = pending.pop(0)
+                self.host_syncs += 1
                 chunks.append(np.asarray(done)[:took])
             start += take
         for done, took in pending:
+            self.host_syncs += 1
             chunks.append(np.asarray(done)[:took])
         return np.concatenate(chunks).astype(np.float32)
 

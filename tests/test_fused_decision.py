@@ -1,0 +1,610 @@
+"""Fused decision kernel (ISSUE 19; ops/fused_decision.py, serving/fused.py).
+
+One jitted executable per batch bucket takes the staged rows and returns
+routed verdicts — score, FRAUD_THRESHOLD compare and the vectorizable
+rule base — in ONE packed transfer. Pinned here: bit-exact score/fired/
+branch parity vs the staged path across buckets and model variants,
+first-match precedence, the whole-set staged refusal for unvectorizable
+rules, the degradation ladder under an injected device_hang, Decision-
+Record equality fused vs staged, zero serving-stage compiles after
+warmup, the router's score->route seam lint, and the operator's
+default-off -> CR-armed wiring.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from ccfd_tpu.bus.broker import Broker
+from ccfd_tpu.config import Config
+from ccfd_tpu.metrics.prom import Registry
+from ccfd_tpu.observability.audit import AuditLog
+from ccfd_tpu.ops.fused_decision import (
+    UnvectorizableRuleSet,
+    build_decision_fn,
+    compile_rules,
+    eval_plan,
+)
+from ccfd_tpu.process.fraud import build_engine
+from ccfd_tpu.router.rules import Condition, Rule, RuleSet, default_rules
+from ccfd_tpu.router.router import Router
+from ccfd_tpu.runtime import faults
+from ccfd_tpu.serving.fused import FusedDecisionScorer
+from ccfd_tpu.serving.scorer import Scorer
+
+BUCKETS = (16, 128, 1024)
+# odd sizes force padding; bucket-exact sizes hit each executable head-on
+SIZES = (1, 7, 16, 100, 128, 777, 1024, 2000)
+
+
+def _rows(rng, n):
+    return rng.normal(size=(n, 30)).astype(np.float32)
+
+
+@contextlib.contextmanager
+def _tap_logger(name: str, level: int = logging.WARNING):
+    """Capture records at the logger ITSELF: once any platform test has
+    run, slog's non-propagating JSON handlers sit on the ccfd_tpu.*
+    loggers and caplog (root-based) sees nothing."""
+    records: list[logging.LogRecord] = []
+
+    class _Tap(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    tap = _Tap(level=level)
+    logger = logging.getLogger(name)
+    old_level = logger.level
+    logger.addHandler(tap)
+    if logger.getEffectiveLevel() > level:
+        logger.setLevel(level)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(tap)
+        logger.setLevel(old_level)
+
+
+def rich_rules(thr: float) -> RuleSet:
+    """Every vectorizable op + feature and proba operands (so the plan
+    needs the f32 rows on the wire), with salience overlap."""
+    return RuleSet([
+        Rule("vip", process="standard",
+             when=(Condition("Amount", "between", [-0.5, 0.5]),
+                   Condition("proba", "<", thr)),
+             salience=20),
+        Rule("fraud_hi", process="fraud",
+             when=(Condition("proba", ">=", thr),
+                   Condition("V1", ">", 0.0)),
+             salience=15),
+        Rule("fraud", process="fraud",
+             when=(Condition("proba", ">=", thr),), salience=10),
+        Rule("oddball", process="standard",
+             when=(Condition("V2", "!=", 0.25),), salience=5),
+        Rule("standard", process="standard"),
+    ])
+
+
+class TestParity:
+    @pytest.mark.parametrize("model", ["mlp", "mlp_q8"])
+    def test_bit_exact_across_buckets_and_variants(self, model):
+        cfg = Config()
+        sc = Scorer(model_name=model, batch_sizes=BUCKETS,
+                    host_tier_rows=0)
+        sc.warmup()
+        rules = rich_rules(cfg.fraud_threshold)
+        fds = FusedDecisionScorer(sc, rules)
+        assert fds.enabled
+        fds.warmup()
+        rng = np.random.default_rng(0)
+        for n in SIZES:
+            x = _rows(rng, n)
+            proba, fired = fds.decide(x)
+            ps = sc.score(x)
+            fs = rules.evaluate(x, ps)
+            # BIT-exact: the acceptance bar, not approx
+            assert np.array_equal(proba, ps), (model, n)
+            assert np.array_equal(fired, fs), (model, n)
+            # branch parity follows from fired parity over the same table
+            assert [rules.rules[i].process for i in fired.tolist()] == \
+                   [rules.rules[i].process for i in fs.tolist()]
+        assert fds.staged_fallbacks == 0
+        grid = fds.executable_grid()
+        assert grid["enabled"] and grid["rules"] == 5
+        assert grid["needs_features"] is True
+        # per-bucket dispatch counters: every bucket the sizes map to
+        assert set(grid["dispatches"]) == {"16", "128", "1024"}
+
+    def test_default_rules_proba_only_wire(self):
+        cfg = Config()
+        sc = Scorer(model_name="mlp", batch_sizes=(16, 128))
+        sc.warmup()
+        rules = default_rules(cfg.fraud_threshold)
+        fds = FusedDecisionScorer(sc, rules)
+        fds.warmup()
+        assert fds.executable_grid()["needs_features"] is False
+        x = _rows(np.random.default_rng(1), 200)
+        proba, fired = fds.decide(x)
+        assert np.array_equal(proba, sc.score(x))
+        assert np.array_equal(fired, rules.evaluate(x, proba))
+
+
+class TestRulesCompiler:
+    def test_first_match_precedence_pinned(self):
+        import jax.numpy as jnp
+
+        rules = rich_rules(0.5)
+        plan = compile_rules(rules)
+        # rule order in the plan IS RuleSet.rules order (salience-sorted,
+        # stable) — argmax-first-True == first-match-wins
+        assert plan.names == tuple(r.name for r in rules.rules)
+        rng = np.random.default_rng(2)
+        x = _rows(rng, 512)
+        # probas engineered to sit ON the threshold boundary too
+        proba = rng.uniform(size=512).astype(np.float32)
+        proba[:16] = np.float32(0.5)
+        fired = np.asarray(eval_plan(plan, jnp.asarray(x),
+                                     jnp.asarray(proba)))
+        assert np.array_equal(fired, rules.evaluate(x, proba))
+
+    def test_equal_salience_keeps_authoring_order(self):
+        import jax.numpy as jnp
+
+        rules = RuleSet([
+            Rule("first", process="standard",
+                 when=(Condition("proba", ">=", 0.0),), salience=5),
+            Rule("second", process="fraud",
+                 when=(Condition("proba", ">=", 0.0),), salience=5),
+            Rule("standard", process="standard"),
+        ])
+        plan = compile_rules(rules)
+        x = np.zeros((8, 30), np.float32)
+        proba = np.full(8, 0.9, np.float32)
+        fired = np.asarray(eval_plan(plan, jnp.asarray(x),
+                                     jnp.asarray(proba)))
+        assert (fired == 0).all()  # "first" wins everywhere, like the host
+        assert np.array_equal(fired, rules.evaluate(x, proba))
+
+    def test_decision_fn_packs_proba_and_fired(self):
+        import jax.numpy as jnp
+
+        plan = compile_rules(default_rules(0.5))
+        decide = build_decision_fn(
+            lambda params, x: jnp.clip(x[:, 0], 0.0, 1.0), plan)
+        x = np.zeros((16, 30), np.float32)
+        x[:, 0] = np.linspace(0, 1, 16)
+        packed = np.asarray(decide(None, jnp.asarray(x)))
+        assert packed.shape == (16, 2)
+        assert np.array_equal(
+            packed[:, 1].astype(np.int64),
+            plan.rules.evaluate(x, packed[:, 0]))
+
+
+class TestUnvectorizable:
+    def test_when_fn_refuses_whole_set_at_compile_time(self):
+        rules = RuleSet([
+            Rule("custom", process="fraud", salience=5,
+                 when=(Condition("proba", ">=", 0.5),),
+                 when_fn=lambda x, p: x[:, 0] > 0),
+            Rule("standard", process="standard"),
+        ])
+        with pytest.raises(UnvectorizableRuleSet, match="custom"):
+            compile_rules(rules)
+
+    def test_scorer_refusal_is_one_loud_warning_never_per_row(self):
+        rules = RuleSet([
+            Rule("custom", process="fraud",
+                 when_fn=lambda x, p: p >= 0.5),
+            Rule("standard", process="standard"),
+        ])
+        sc = Scorer(model_name="mlp", batch_sizes=(16, 128))
+        sc.warmup()
+        with _tap_logger("ccfd_tpu.serving.fused") as records:
+            fds = FusedDecisionScorer(sc, rules)
+        assert not fds.enabled
+        warns = [r for r in records
+                 if "staged" in r.getMessage().lower()]
+        assert len(warns) == 1  # ONE compile-time warning, not per batch
+        # the WHOLE set serves staged: fired=None for every row, so the
+        # router re-enters the full host rule base (when_fn included)
+        x = _rows(np.random.default_rng(3), 50)
+        proba, fired = fds.decide(x)
+        assert fired is None
+        assert np.array_equal(proba, sc.score(x))
+        assert fds.staged_fallbacks >= 1
+
+    def test_strict_refusal_raises(self):
+        rules = RuleSet([
+            Rule("custom", process="fraud", when_fn=lambda x, p: p > 0),
+            Rule("standard", process="standard"),
+        ])
+        sc = Scorer(model_name="mlp", batch_sizes=(16,))
+        with pytest.raises(RuntimeError):
+            FusedDecisionScorer(sc, rules, strict=True)
+
+    def test_when_fn_host_semantics_anded(self):
+        rules = RuleSet([
+            Rule("gated", process="fraud",
+                 when=(Condition("proba", ">=", 0.5),),
+                 when_fn=lambda x, p: x[:, 0] > 0, salience=5),
+            Rule("standard", process="standard"),
+        ])
+        x = np.zeros((4, 30), np.float32)
+        x[:2, 0] = 1.0
+        proba = np.array([0.9, 0.1, 0.9, 0.9], np.float32)
+        fired = rules.evaluate(x, proba)
+        # row 0: both conjuncts hold; rows 1-3 miss one each
+        assert fired.tolist() == [0, 1, 1, 1]
+
+    def test_when_fn_must_be_callable(self):
+        with pytest.raises(ValueError, match="callable"):
+            Rule("bad", process="x", when_fn="not-a-callable")
+
+
+def _audit_pipeline(cfg, reg, scorer, rules=None, decision_fn=None,
+                    **router_kw):
+    broker = Broker(default_partitions=2)
+    engine = build_engine(cfg, broker, Registry(), None)
+    audit = AuditLog(registry=reg)
+    router = Router(cfg, broker, scorer.score, engine, reg, max_batch=256,
+                    audit=audit, rules=rules, decision_fn=decision_fn,
+                    **router_kw)
+    return broker, router, audit
+
+
+def _pump(cfg, broker, router, n=32):
+    rng = np.random.default_rng(7)
+    rows = [(",".join(f"{v:.6f}" for v in rng.normal(size=29))
+             + f",{abs(rng.normal()) * 100:.2f}").encode()
+            for _ in range(n)]
+    broker.produce_batch(cfg.kafka_topic, rows,
+                         [f"tx-{i}" for i in range(n)])
+    while router.step() > 0:
+        pass
+    return rows
+
+
+class TestRouterIntegration:
+    def test_decision_record_equality_fused_vs_staged(self):
+        cfg = Config()
+        sc = Scorer(model_name="mlp", batch_sizes=BUCKETS,
+                    host_tier_rows=0)
+        sc.warmup()
+        rules = rich_rules(cfg.fraud_threshold)
+        fds = FusedDecisionScorer(sc, rules)
+        fds.warmup()
+        reg_f, reg_s = Registry(), Registry()
+        bf, rf, af = _audit_pipeline(cfg, reg_f, sc, rules=rules,
+                                     decision_fn=fds)
+        bs, rs, as_ = _audit_pipeline(cfg, reg_s, sc,
+                                      rules=rich_rules(cfg.fraud_threshold))
+        try:
+            # identical records through both stacks (same seed)
+            _pump(cfg, bf, rf, n=64)
+            _pump(cfg, bs, rs, n=64)
+            assert fds.staged_fallbacks == 0
+            assert sum(fds._dispatch_counts.values()) >= 1
+            for i in range(64):
+                a = af.get(f"tx-{i}")
+                b = as_.get(f"tx-{i}")
+                assert a is not None and b is not None, i
+                # same tier/cause/fired-rule/branch/proba — the fused
+                # verdict is indistinguishable in the provenance stream
+                for k in ("tier", "rule", "branch", "proba", "threshold"):
+                    assert a.get(k) == b.get(k), (i, k)
+                assert a["tier"] == "device"
+                assert "cause" not in a and "cause" not in b
+        finally:
+            rf.close(), rs.close(), bf.close(), bs.close()
+
+    def test_ladder_falls_to_host_under_injected_device_hang(self):
+        from ccfd_tpu.runtime.overload import (
+            AdaptiveInflightBudget,
+            OverloadControl,
+        )
+
+        cfg = Config()
+        reg = Registry()
+        sc = Scorer(model_name="mlp", batch_sizes=(16, 128),
+                    host_tier_rows=0)
+        sc.warmup()
+        rules = default_rules(cfg.fraud_threshold)
+        fds = FusedDecisionScorer(sc, rules)
+        fds.warmup()
+        budget = AdaptiveInflightBudget(
+            1024, min_limit=64, max_limit=1024, target_s=0.05,
+            registry=reg)
+        ov = OverloadControl(reg, budget, dispatch_deadline_ms=60.0)
+        broker = Broker(default_partitions=1)
+        engine = build_engine(cfg, broker, Registry(), None)
+        audit = AuditLog(registry=reg)
+        router = Router(cfg, broker, sc.score, engine, reg, max_batch=64,
+                        rules=rules, decision_fn=fds, overload=ov,
+                        degrade=True, audit=audit,
+                        host_score_fn=sc.host_score)
+        faults.install_device_faults(
+            faults.DeviceFaultPlan.from_string("device_hang:ms=400"))
+        try:
+            rows = [b"0.0" + b",0.0" * 29] * 8
+            broker.produce_batch(cfg.kafka_topic, rows,
+                                 [f"tx-{i}" for i in range(8)])
+            assert router.step() == 8  # every row still decided
+            rec = audit.get("tx-1")
+            assert rec["tier"] == "host"
+            assert rec["cause"] == "watchdog_timeout"
+            assert reg.counter("router_degraded_total").value(
+                {"tier": "host"}) == 8
+        finally:
+            faults.install_device_faults(None)
+            router.close()
+            broker.close()
+
+    def test_invalid_fired_degrades_not_misroutes(self):
+        cfg = Config()
+        reg = Registry()
+        rules = default_rules(cfg.fraud_threshold)
+
+        class BadDecision:
+            def __init__(self):
+                self.rules = rules
+
+            def decide(self, x):
+                # out-of-range rule indices: version-skew/corruption class
+                return (np.zeros(len(x), np.float32),
+                        np.full(len(x), 99, np.int64))
+
+        broker = Broker(default_partitions=1)
+        engine = build_engine(cfg, broker, Registry(), None)
+        router = Router(cfg, broker,
+                        lambda x: np.zeros(len(x), np.float32),
+                        engine, reg, max_batch=64, rules=rules,
+                        decision_fn=BadDecision(), degrade=True,
+                        host_score_fn=lambda x: np.full(
+                            len(x), 0.2, np.float32))
+        try:
+            rows = [b"0.0" + b",0.0" * 29] * 8
+            broker.produce_batch(cfg.kafka_topic, rows, list(range(8)))
+            assert router.step() == 8
+            assert reg.counter("router_degraded_total").value(
+                {"tier": "host"}) == 8
+        finally:
+            router.close()
+            broker.close()
+
+    def test_rules_identity_mismatch_disarms(self):
+        cfg = Config()
+        reg = Registry()
+        calls = {"n": 0}
+
+        class Foreign:
+            rules = default_rules(cfg.fraud_threshold)  # NOT the router's
+
+            def decide(self, x):
+                calls["n"] += 1
+                return np.zeros(len(x), np.float32), None
+
+        broker = Broker(default_partitions=1)
+        engine = build_engine(cfg, broker, Registry(), None)
+        with _tap_logger("ccfd_tpu.router") as records:
+            router = Router(cfg, broker,
+                            lambda x: np.full(len(x), 0.9, np.float32),
+                            engine, reg, max_batch=64,
+                            rules=default_rules(cfg.fraud_threshold),
+                            decision_fn=Foreign())
+        assert any("disarmed" in r.getMessage() for r in records)
+        try:
+            rows = [b"0.0" + b",0.0" * 29] * 4
+            broker.produce_batch(cfg.kafka_topic, rows, list(range(4)))
+            assert router.step() == 4
+            assert calls["n"] == 0  # foreign decision fn never consulted
+        finally:
+            router.close()
+            broker.close()
+
+
+class TestWarmAndSwap:
+    def test_zero_serving_stage_compiles_after_warmup(self):
+        from ccfd_tpu.observability.profile import StageProfiler
+        from ccfd_tpu.runtime.heal import NON_SERVING_COMPILE_STAGES
+
+        assert "fused.warm" in NON_SERVING_COMPILE_STAGES
+        prof = StageProfiler(registry=Registry())
+        prof.arm_compile_listener()
+        cfg = Config()
+        sc = Scorer(model_name="mlp", batch_sizes=BUCKETS)
+        sc.warmup()
+        fds = FusedDecisionScorer(sc, rich_rules(cfg.fraud_threshold))
+        fds.warmup()
+        counts = prof.compile_counts()
+        assert counts.get("fused.warm", 0) >= 1  # attribution landed
+        before = sum(v for s, v in counts.items()
+                     if s not in NON_SERVING_COMPILE_STAGES)
+        rng = np.random.default_rng(5)
+        for n in SIZES:
+            fds.decide(_rows(rng, n))
+        after = sum(v for s, v in prof.compile_counts().items()
+                    if s not in NON_SERVING_COMPILE_STAGES)
+        assert after == before  # the grid was fully warm
+
+    def test_swap_params_precompiles_and_rearms(self):
+        import jax
+
+        cfg = Config()
+        sc = Scorer(model_name="mlp", batch_sizes=(16, 128))
+        sc.warmup()
+        fds = FusedDecisionScorer(sc, default_rules(cfg.fraud_threshold))
+        fds.warmup()
+        sc.add_prepublish_hook(fds.prepublish)
+        # transient (non-latched) disable: the next healthy swap
+        # precompile must RE-ARM the plane, like the seq variant swap
+        fds._disabled = True
+        x = _rows(np.random.default_rng(6), 40)
+        proba, fired = fds.decide(x)
+        assert fired is None and fds.staged_fallbacks == 1
+        sc.swap_params(jax.tree.map(lambda a: np.array(a), sc._params))
+        proba, fired = fds.decide(x)
+        assert fired is not None  # re-armed by the prepublish hook
+        assert np.array_equal(proba, sc.score(x))
+
+    def test_failing_prepublish_hook_never_blocks_publish(self):
+        import jax
+
+        sc = Scorer(model_name="mlp", batch_sizes=(16,))
+        sc.warmup()
+        sc.add_prepublish_hook(
+            lambda *a: (_ for _ in ()).throw(RuntimeError("boom")))
+        gen = sc._swap_gen
+        sc.swap_params(jax.tree.map(lambda a: np.array(a), sc._params))
+        assert sc._swap_gen == gen + 1  # the flip still published
+
+
+class TestSeamLint:
+    def _findings(self, src):
+        from ccfd_tpu.analysis import core as lint_core
+
+        report = lint_core.lint_sources(
+            {"ccfd_tpu/router/router.py": src},
+            rule_names=["hot-path-sync"])
+        return report.findings
+
+    def test_dispatch_transfer_is_the_single_allowed_sync(self):
+        src = (
+            "import numpy as np\n"
+            "class R:\n"
+            "    def _score_tiered(self, x, txs):\n"
+            "        proba = np.asarray(self._score2(x, txs))\n"
+            "        return proba, None\n"
+        )
+        assert self._findings(src) == []
+
+    def test_new_sync_between_score_and_route_is_flagged(self):
+        src = (
+            "import numpy as np\n"
+            "class R:\n"
+            "    def _score_tiered(self, x, txs):\n"
+            "        proba, fired = self._score2(x, txs)\n"
+            "        proba = np.asarray(proba)\n"       # sync on a Name
+            "        fired.tolist()\n"                   # second sync
+            "        fired.block_until_ready()\n"        # third
+            "        return proba, fired\n"
+        )
+        msgs = [f.message for f in self._findings(src)]
+        assert len(msgs) == 3
+        assert all("score->route seam" in m for m in msgs)
+
+    def test_seam_scope_is_router_file_and_seam_functions_only(self):
+        src = (
+            "import numpy as np\n"
+            "class R:\n"
+            "    def _route_inner(self, proba):\n"
+            "        return proba.tolist()\n"  # host-side loop: fine
+        )
+        assert self._findings(src) == []
+        from ccfd_tpu.analysis import core as lint_core
+
+        # same source under another path: the seam rule does not apply
+        report = lint_core.lint_sources(
+            {"ccfd_tpu/serving/other.py":
+             "import numpy as np\n"
+             "def _score_tiered(x):\n"
+             "    return np.asarray(x)\n"},
+            rule_names=["hot-path-sync"])
+        assert report.findings == []
+
+    def test_real_router_seam_is_clean(self):
+        from ccfd_tpu.analysis import core as lint_core
+
+        with open("ccfd_tpu/router/router.py") as f:
+            src = f.read()
+        report = lint_core.lint_sources(
+            {"ccfd_tpu/router/router.py": src},
+            rule_names=["hot-path-sync"])
+        assert report.findings == []
+
+
+def _cr(**scorer_extra):
+    spec = {
+        "store": {"enabled": False},
+        "bus": {"partitions": 2},
+        "scorer": {"enabled": True, "model": "mlp", "train_steps": 0,
+                   **scorer_extra},
+        "lifecycle": {"enabled": False},
+        "engine": {"enabled": True},
+        "notify": {"enabled": True, "seed": 0},
+        "router": {"enabled": True},
+        "producer": {"enabled": False},
+        "monitoring": {"enabled": False},
+        "health": {"enabled": False},
+    }
+    return {"apiVersion": "ccfd.tpu/v1",
+            "kind": "FraudDetectionPlatform", "spec": spec}
+
+
+class TestOperatorWiring:
+    def test_default_off_then_cr_armed(self):
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        cfg = Config()
+        p = Platform(PlatformSpec.from_cr(_cr(), cfg=cfg)).up(
+            wait_ready_s=30.0)
+        try:
+            assert p.fused_decision is None  # default off
+        finally:
+            p.down()
+        p = Platform(PlatformSpec.from_cr(
+            _cr(fused_decision=True), cfg=cfg)).up(wait_ready_s=30.0)
+        try:
+            fds = p.fused_decision
+            assert fds is not None and fds.enabled
+            rows = [b"0.1," * 29 + b"5.0"] * 40
+            p.broker.produce_batch(cfg.kafka_topic, rows,
+                                   [f"t-{i}" for i in range(40)])
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if sum(fds._dispatch_counts.values()) >= 1:
+                    break
+                time.sleep(0.2)
+            assert sum(fds._dispatch_counts.values()) >= 1
+            assert fds.staged_fallbacks == 0
+        finally:
+            p.down()
+
+    def test_env_knob_parses(self):
+        cfg = Config.from_env({"CCFD_FUSED_DECISION": "1",
+                               "CCFD_FUSED_DECISION_STRICT": "true"})
+        assert cfg.fused_decision and cfg.fused_decision_strict
+        assert not Config.from_env({}).fused_decision
+
+    def test_lifecycle_conflict_warns_and_serves_staged(self):
+        from ccfd_tpu.platform.operator import Platform, PlatformSpec
+
+        cr = _cr(fused_decision=True)
+        cr["spec"]["lifecycle"] = {"enabled": True}
+        # the operator logger runs a non-propagating JSON handler, so
+        # capture at the logger itself rather than through caplog
+        records: list[logging.LogRecord] = []
+
+        class _Tap(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        log = logging.getLogger("ccfd_tpu.platform.operator")
+        tap = _Tap(level=logging.WARNING)
+        log.addHandler(tap)
+        try:
+            p = Platform(PlatformSpec.from_cr(cr, cfg=Config())).up(
+                wait_ready_s=30.0)
+        finally:
+            log.removeHandler(tap)
+        try:
+            assert p.fused_decision is None
+            assert any("lifecycle" in r.getMessage()
+                       and "fused_decision" in r.getMessage()
+                       for r in records)
+        finally:
+            p.down()

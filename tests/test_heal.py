@@ -832,9 +832,10 @@ def test_heal_gate_pins_even_with_ladder_off():
     r, _, _, _ = make_router(score, gate=FakeGate(False))
     r._degrade = False
     x = np.zeros((4, 30), np.float32)
-    out = r._score_batch(x, [object()] * 4)
+    out, fired = r._score_batch(x, [object()] * 4)
     assert calls["n"] == 0  # zero rows touched the quarantined device
     assert out.shape == (4,)
+    assert fired is None  # degraded scores re-enter the host rule base
     r2, _, _, _ = make_router(score, gate=FakeGate(True))
     r2._degrade = False
     r2._score_batch(x, [object()] * 4)

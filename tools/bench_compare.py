@@ -41,6 +41,17 @@ HISTORY_SCHEMA = "ccfd.bench_history.v1"
 _FIELDS = ("value", "vs_baseline", "p50_ms", "p99_ms", "p99_e2e_ms",
            "p99_vs_target", "latency_batch")
 
+# fused-decision A/B numerics (PR 19): lifted from the section subdict
+# with a ``fused_`` prefix collision guard — the section's own
+# ``fused_tx_s`` keeps its name, ``speedup`` becomes ``fused_speedup``
+_FUSED_FIELDS = {
+    "speedup": "fused_speedup",
+    "throughput_speedup": "fused_throughput_speedup",
+    "staged_decide_us": "staged_decide_us",
+    "fused_decide_us": "fused_decide_us",
+    "parity_bit_exact": "fused_parity_bit_exact",
+}
+
 
 def normalize_platform(raw) -> str | None:
     """First token of the bench's platform string: ``"cpu (fallback:
@@ -83,6 +94,12 @@ def normalize_capture(path: str) -> dict:
         v = parsed.get(k)
         if isinstance(v, (int, float)):
             row[k] = v
+    fd = parsed.get("fused_decision")
+    if isinstance(fd, dict) and "error" not in fd:
+        for src, dst in _FUSED_FIELDS.items():
+            v = fd.get(src)
+            if isinstance(v, (int, float, bool)):
+                row[dst] = v
     return row
 
 
@@ -104,6 +121,18 @@ def verdict(row: dict, prior: dict | None, threshold: float) -> dict:
         out["p99_ratio"] = round(ratio, 4)
         if ratio > 1.0 + threshold:
             regressed.append(f"p99 x{ratio:.3f}")
+    f0, f1 = prior.get("fused_speedup"), row.get("fused_speedup")
+    if isinstance(f0, (int, float)) and isinstance(f1, (int, float)) and f0:
+        # the fused-decision win eroding across rounds is a regression of
+        # this PR's tentpole even when the headline throughput holds
+        ratio = f1 / f0
+        out["fused_speedup_ratio"] = round(ratio, 4)
+        if ratio < 1.0 - threshold:
+            regressed.append(f"fused_decision speedup x{ratio:.3f}")
+    if row.get("fused_parity_bit_exact") is False:
+        # parity is a hard invariant, not a trend: a capture that measured
+        # drift between the fused and staged verdicts always regresses
+        regressed.append("fused_decision parity broken")
     if regressed:
         out["verdict"] = "regressed"
         out["causes"] = regressed

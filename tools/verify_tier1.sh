@@ -194,6 +194,22 @@
 #                                          the dispatch layer; the
 #                                          baseline run stays silent:
 #                                          CAPACITYSMOKE verdict=PASS|FAIL
+#   tools/verify_tier1.sh --fused-smoke    exit-code-gated smoke of the
+#                                          fused decision kernel
+#                                          (tools/fused_smoke.py): the
+#                                          live operator platform routes
+#                                          512 tx through the fused path
+#                                          with accounting exactly
+#                                          conserved, proba/fired-rule/
+#                                          branch parity 0 delta vs the
+#                                          staged path on the same
+#                                          records, the fused (L,B) grid
+#                                          in the executable inventory
+#                                          with per-bucket dispatch
+#                                          counts scraped over real HTTP,
+#                                          and zero serving-stage
+#                                          compiles after warmup:
+#                                          FUSEDSMOKE verdict=PASS|FAIL
 #   tools/verify_tier1.sh --bench-compare  normalize BENCH_r*.json
 #                                          captures into the append-only
 #                                          BENCH_HISTORY.jsonl ledger
@@ -378,6 +394,18 @@ if [ "${1:-}" = "--capacity-smoke" ]; then
     # verdict=...)
     cd "$REPO_DIR" || exit 2
     if JAX_PLATFORMS=cpu python tools/capacity_smoke.py; then
+        exit 0
+    fi
+    exit 1
+fi
+
+if [ "${1:-}" = "--fused-smoke" ]; then
+    # exit-code-gated smoke of the fused decision kernel: one device
+    # dispatch -> routed verdict, conservation exact, bit parity vs the
+    # staged path, fused grid + per-bucket dispatch counters over real
+    # HTTP (see tools/fused_smoke.py; prints FUSEDSMOKE verdict=...)
+    cd "$REPO_DIR" || exit 2
+    if JAX_PLATFORMS=cpu python tools/fused_smoke.py; then
         exit 0
     fi
     exit 1
